@@ -1,0 +1,22 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas/pjit re-design with the capabilities of the
+Deeplearning4j reference stack (see SURVEY.md): declarative layer-config DSL,
+sequential (MultiLayerNetwork) and DAG (ComputationGraph) models, DL4J-semantic
+updaters and weight inits, evaluation / early stopping / transfer learning,
+checkpointing + Keras import, a model zoo, NLP embeddings, clustering, and
+mesh-sharded distributed training over ICI/DCN.
+
+The compute path is pure-functional JAX: layers are (init_params, forward)
+pairs, gradients come from ``jax.grad`` over the whole-model loss, and the
+training step is a single jitted, donated-buffer function. Distribution is
+expressed with ``jax.sharding`` over a device ``Mesh`` — not thread replication.
+"""
+
+__version__ = "0.1.0"
+
+from deeplearning4j_tpu.nn.conf import (  # noqa: F401
+    NeuralNetConfiguration,
+    MultiLayerConfiguration,
+    InputType,
+)

@@ -1,0 +1,1 @@
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator  # noqa: F401

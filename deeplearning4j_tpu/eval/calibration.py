@@ -1,0 +1,44 @@
+"""Calibration evaluation (DL4J ``eval/EvaluationCalibration.java``):
+reliability diagram bins + residual plot histograms."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class EvaluationCalibration:
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.rel_bins = reliability_bins
+        self.hist_bins = histogram_bins
+        self.bin_counts = np.zeros(reliability_bins, np.int64)
+        self.bin_pos = np.zeros(reliability_bins, np.int64)
+        self.bin_prob_sum = np.zeros(reliability_bins, np.float64)
+        self.residual_hist = np.zeros(histogram_bins, np.int64)
+
+    def eval(self, labels, predictions, mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels, np.float64)
+        preds = np.asarray(predictions, np.float64)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            preds = preds[:, None]
+        probs = preds.ravel()
+        truth = labels.ravel()
+        bins = np.clip((probs * self.rel_bins).astype(int), 0, self.rel_bins - 1)
+        np.add.at(self.bin_counts, bins, 1)
+        np.add.at(self.bin_pos, bins, (truth > 0.5).astype(np.int64))
+        np.add.at(self.bin_prob_sum, bins, probs)
+        residuals = np.abs(truth - probs)
+        rbins = np.clip((residuals * self.hist_bins).astype(int), 0, self.hist_bins - 1)
+        np.add.at(self.residual_hist, rbins, 1)
+
+    def reliability_diagram(self):
+        """Returns (mean_predicted_prob, observed_frequency) per bin."""
+        counts = np.maximum(self.bin_counts, 1)
+        return self.bin_prob_sum / counts, self.bin_pos / counts
+
+    def expected_calibration_error(self) -> float:
+        mean_p, obs = self.reliability_diagram()
+        w = self.bin_counts / max(self.bin_counts.sum(), 1)
+        return float(np.sum(w * np.abs(mean_p - obs)))

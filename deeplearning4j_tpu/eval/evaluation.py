@@ -1,0 +1,171 @@
+"""Classification evaluation: accuracy / precision / recall / F1 / confusion.
+
+Reference: ``deeplearning4j-nn/.../eval/Evaluation.java:72``. Metrics follow
+DL4J conventions: macro-averaged precision/recall/F1 over classes that have
+at least one true/predicted instance; per-timestep rnn output is flattened
+with the label mask applied.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None, labels_list=None):
+        self.num_classes = num_classes
+        self.labels_list = labels_list
+        self.confusion: Optional[np.ndarray] = None  # [true, predicted]
+
+    # ----------------------------------------------------------------- eval
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # [N,T,C] → flatten time, applying mask
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, -1)
+            if mask is not None:
+                m = np.asarray(mask).reshape(n * t).astype(bool)
+                labels = labels[m]
+                predictions = predictions[m]
+        elif mask is not None:
+            m = np.asarray(mask).astype(bool).ravel()
+            labels = labels[m]
+            predictions = predictions[m]
+
+        if labels.ndim == 2 and labels.shape[1] > 1:
+            true_idx = np.argmax(labels, axis=1)
+            nc = labels.shape[1]
+        else:
+            true_idx = labels.astype(int).ravel()
+            nc = self.num_classes or int(max(true_idx.max(), 0)) + 1
+        if predictions.ndim == 2 and predictions.shape[1] > 1:
+            pred_idx = np.argmax(predictions, axis=1)
+            nc = max(nc, predictions.shape[1])
+        else:
+            pred_idx = (predictions.ravel() > 0.5).astype(int)
+            nc = max(nc, 2)
+
+        # grow the confusion matrix if a later batch reveals a higher class
+        needed = max(nc, int(true_idx.max(initial=0)) + 1,
+                     int(pred_idx.max(initial=0)) + 1,
+                     self.num_classes or 0)
+        if self.num_classes is None or needed > self.num_classes:
+            old = self.confusion
+            self.num_classes = needed
+            self.confusion = np.zeros((needed, needed), np.int64)
+            if old is not None:
+                self.confusion[:old.shape[0], :old.shape[1]] = old
+        elif self.confusion is None:
+            self.confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
+        np.add.at(self.confusion, (true_idx, pred_idx), 1)
+
+    def eval_time_series(self, labels, predictions, labels_mask=None):
+        self.eval(labels, predictions, mask=labels_mask)
+
+    # -------------------------------------------------------------- metrics
+    def _check(self):
+        if self.confusion is None:
+            raise ValueError("No evaluation data; call eval() first")
+
+    def accuracy(self) -> float:
+        self._check()
+        total = self.confusion.sum()
+        return float(np.trace(self.confusion)) / max(total, 1)
+
+    def _tp(self, i) -> int:
+        return int(self.confusion[i, i])
+
+    def _fp(self, i) -> int:
+        return int(self.confusion[:, i].sum() - self.confusion[i, i])
+
+    def _fn(self, i) -> int:
+        return int(self.confusion[i, :].sum() - self.confusion[i, i])
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        self._check()
+        if cls is not None:
+            denom = self._tp(cls) + self._fp(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.precision(i) for i in range(self.num_classes)
+                if self.confusion[:, i].sum() + self.confusion[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        self._check()
+        if cls is not None:
+            denom = self._tp(cls) + self._fn(cls)
+            return self._tp(cls) / denom if denom else 0.0
+        vals = [self.recall(i) for i in range(self.num_classes)
+                if self.confusion[:, i].sum() + self.confusion[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            return 2 * p * r / (p + r) if (p + r) else 0.0
+        self._check()
+        vals = [self.f1(i) for i in range(self.num_classes)
+                if self.confusion[:, i].sum() + self.confusion[i, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        self._check()
+        tn = self.confusion.sum() - self._tp(cls) - self._fp(cls) - self._fn(cls)
+        denom = self._fp(cls) + tn
+        return self._fp(cls) / denom if denom else 0.0
+
+    def matthews_correlation(self, cls: int) -> float:
+        self._check()
+        tp, fp, fn = self._tp(cls), self._fp(cls), self._fn(cls)
+        tn = int(self.confusion.sum()) - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return ((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def confusion_matrix(self) -> np.ndarray:
+        self._check()
+        return self.confusion.copy()
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        if other.confusion is not None:
+            if self.confusion is None:
+                self.num_classes = other.num_classes
+                self.confusion = other.confusion.copy()
+            else:
+                self.confusion += other.confusion
+        return self
+
+    # ---------------------------------------------------------------- serde
+    def to_json(self) -> str:
+        return json.dumps({
+            "num_classes": self.num_classes,
+            "confusion": None if self.confusion is None else self.confusion.tolist(),
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "Evaluation":
+        d = json.loads(s)
+        e = Evaluation(num_classes=d["num_classes"])
+        if d["confusion"] is not None:
+            e.confusion = np.asarray(d["confusion"], np.int64)
+        return e
+
+    def stats(self) -> str:
+        self._check()
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {self.num_classes}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+            str(self.confusion),
+        ]
+        return "\n".join(lines)

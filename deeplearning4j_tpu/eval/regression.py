@@ -1,0 +1,88 @@
+"""Regression evaluation (DL4J ``eval/RegressionEvaluation.java``):
+per-column MSE / MAE / RMSE / RSE / PC (Pearson) / R²."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class RegressionEvaluation:
+    def __init__(self, n_columns: Optional[int] = None):
+        self.n = 0
+        self.labels_sum = None
+        self.labels_sq_sum = None
+        self.preds_sum = None
+        self.preds_sq_sum = None
+        self.cross_sum = None
+        self.abs_err_sum = None
+        self.sq_err_sum = None
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:
+            labels = labels.reshape(-1, labels.shape[-1])
+            predictions = predictions.reshape(-1, predictions.shape[-1])
+            if mask is not None:
+                m = np.asarray(mask).reshape(-1).astype(bool)
+                labels, predictions = labels[m], predictions[m]
+        if self.labels_sum is None:
+            c = labels.shape[-1]
+            self.labels_sum = np.zeros(c)
+            self.labels_sq_sum = np.zeros(c)
+            self.preds_sum = np.zeros(c)
+            self.preds_sq_sum = np.zeros(c)
+            self.cross_sum = np.zeros(c)
+            self.abs_err_sum = np.zeros(c)
+            self.sq_err_sum = np.zeros(c)
+        self.n += labels.shape[0]
+        self.labels_sum += labels.sum(0)
+        self.labels_sq_sum += (labels ** 2).sum(0)
+        self.preds_sum += predictions.sum(0)
+        self.preds_sq_sum += (predictions ** 2).sum(0)
+        self.cross_sum += (labels * predictions).sum(0)
+        self.abs_err_sum += np.abs(labels - predictions).sum(0)
+        self.sq_err_sum += ((labels - predictions) ** 2).sum(0)
+
+    def mean_squared_error(self, col: int = 0) -> float:
+        return float(self.sq_err_sum[col] / self.n)
+
+    def mean_absolute_error(self, col: int = 0) -> float:
+        return float(self.abs_err_sum[col] / self.n)
+
+    def root_mean_squared_error(self, col: int = 0) -> float:
+        return float(np.sqrt(self.sq_err_sum[col] / self.n))
+
+    def relative_squared_error(self, col: int = 0) -> float:
+        mean_label = self.labels_sum[col] / self.n
+        denom = self.labels_sq_sum[col] - self.n * mean_label ** 2
+        return float(self.sq_err_sum[col] / denom) if denom else float("inf")
+
+    def pearson_correlation(self, col: int = 0) -> float:
+        n = self.n
+        num = self.cross_sum[col] - self.labels_sum[col] * self.preds_sum[col] / n
+        d1 = self.labels_sq_sum[col] - self.labels_sum[col] ** 2 / n
+        d2 = self.preds_sq_sum[col] - self.preds_sum[col] ** 2 / n
+        denom = np.sqrt(d1 * d2)
+        return float(num / denom) if denom else 0.0
+
+    def r_squared(self, col: int = 0) -> float:
+        mean_label = self.labels_sum[col] / self.n
+        ss_tot = self.labels_sq_sum[col] - self.n * mean_label ** 2
+        return float(1.0 - self.sq_err_sum[col] / ss_tot) if ss_tot else 0.0
+
+    def average_mean_squared_error(self) -> float:
+        return float(np.mean(self.sq_err_sum / self.n))
+
+    def stats(self) -> str:
+        cols = len(self.labels_sum)
+        lines = ["Column    MSE            MAE            RMSE           R^2"]
+        for c in range(cols):
+            lines.append(f"col_{c}    {self.mean_squared_error(c):.6f}    "
+                         f"{self.mean_absolute_error(c):.6f}    "
+                         f"{self.root_mean_squared_error(c):.6f}    "
+                         f"{self.r_squared(c):.6f}")
+        return "\n".join(lines)

@@ -1,0 +1,122 @@
+"""ROC / AUC evaluation (DL4J ``eval/ROC.java``, ``ROCBinary``, ``ROCMultiClass``).
+
+Exact (threshold-free) AUROC/AUPRC via sorting, equivalent to DL4J's
+``thresholdSteps=0`` exact mode.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _auc_roc(labels: np.ndarray, scores: np.ndarray) -> float:
+    pos = labels > 0.5
+    n_pos = int(pos.sum())
+    n_neg = len(labels) - n_pos
+    if n_pos == 0 or n_neg == 0:
+        return 0.0
+    order = np.argsort(scores)
+    ranks = np.empty(len(scores), dtype=np.float64)
+    # average ranks for ties
+    sorted_scores = scores[order]
+    ranks[order] = np.arange(1, len(scores) + 1)
+    i = 0
+    while i < len(scores):
+        j = i
+        while j + 1 < len(scores) and sorted_scores[j + 1] == sorted_scores[i]:
+            j += 1
+        if j > i:
+            avg = (i + j) / 2 + 1
+            ranks[order[i:j + 1]] = avg
+        i = j + 1
+    sum_pos_ranks = ranks[pos].sum()
+    return float((sum_pos_ranks - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg))
+
+
+def _auc_pr(labels: np.ndarray, scores: np.ndarray) -> float:
+    order = np.argsort(-scores)
+    l = labels[order] > 0.5
+    tp = np.cumsum(l)
+    fp = np.cumsum(~l)
+    n_pos = int(l.sum())
+    if n_pos == 0:
+        return 0.0
+    precision = tp / np.maximum(tp + fp, 1)
+    recall = tp / n_pos
+    # step-wise integration
+    prev_r = 0.0
+    area = 0.0
+    for p, r in zip(precision, recall):
+        area += p * (r - prev_r)
+        prev_r = r
+    return float(area)
+
+
+class ROC:
+    """Binary ROC: labels [N] or [N,2] (prob of class 1 scored)."""
+
+    def __init__(self):
+        self.labels = []
+        self.scores = []
+
+    def eval(self, labels: np.ndarray, predictions: np.ndarray,
+             mask: Optional[np.ndarray] = None) -> None:
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            labels = labels[:, 1]
+            predictions = predictions[:, 1]
+        elif labels.ndim == 2 and labels.shape[1] == 1:
+            labels = labels[:, 0]
+            predictions = predictions[:, 0]
+        if mask is not None:
+            m = np.asarray(mask).astype(bool).ravel()
+            labels, predictions = labels[m], predictions[m]
+        self.labels.append(labels.ravel())
+        self.scores.append(predictions.ravel())
+
+    def calculate_auc(self) -> float:
+        return _auc_roc(np.concatenate(self.labels), np.concatenate(self.scores))
+
+    def calculate_auc_pr(self) -> float:
+        return _auc_pr(np.concatenate(self.labels), np.concatenate(self.scores))
+
+
+class ROCBinary:
+    """Per-output binary ROC for multi-label outputs [N, C]."""
+
+    def __init__(self):
+        self.labels = []
+        self.scores = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        self.labels.append(np.asarray(labels, np.float64))
+        self.scores.append(np.asarray(predictions, np.float64))
+
+    def calculate_auc(self, col: int) -> float:
+        l = np.concatenate(self.labels)[:, col]
+        s = np.concatenate(self.scores)[:, col]
+        return _auc_roc(l, s)
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class for softmax outputs [N, C]."""
+
+    def __init__(self):
+        self.labels = []
+        self.scores = []
+
+    def eval(self, labels, predictions, mask=None) -> None:
+        self.labels.append(np.asarray(labels, np.float64))
+        self.scores.append(np.asarray(predictions, np.float64))
+
+    def calculate_auc(self, cls: int) -> float:
+        l = np.concatenate(self.labels)
+        s = np.concatenate(self.scores)
+        if l.ndim == 2:
+            binary = l[:, cls]
+        else:
+            binary = (l == cls).astype(np.float64)
+        return _auc_roc(binary, s[:, cls])

@@ -1,0 +1,86 @@
+"""Input type inference — the DL4J ``InputType`` system.
+
+Reference: ``nn/conf/inputs/InputType.java`` (kinds FF / RNN / CNN / CNNFlat).
+Shape convention is TPU-first: convolutional activations are **NHWC**
+(channels-last) so XLA lowers convs straight onto the MXU without layout
+transposes; DL4J's NCHW is converted at the import boundary only.
+
+Recurrent activations are **[batch, time, size]** (time-major inside
+``lax.scan`` is handled by the layer impls), vs DL4J's [batch, size, time].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn3d" | "cnn1d"
+    size: int = 0                      # ff / rnn feature size
+    timesteps: Optional[int] = None    # rnn (None = variable)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+    depth: int = 0                     # cnn3d
+
+    # -- factories mirroring InputType.feedForward(...) etc. ----------------
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn_flat", height=int(height), width=int(width),
+                         channels=int(channels), size=int(height * width * channels))
+
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn3d", depth=int(depth), height=int(height),
+                         width=int(width), channels=int(channels))
+
+    @staticmethod
+    def recurrent1d(size: int, timesteps: Optional[int] = None) -> "InputType":
+        # Convolution1D operates on [batch, time, channels] == rnn layout
+        return InputType.recurrent(size, timesteps)
+
+    # -- helpers -----------------------------------------------------------
+    def flat_size(self) -> int:
+        if self.kind == "ff":
+            return self.size
+        if self.kind == "rnn":
+            return self.size
+        if self.kind in ("cnn", "cnn_flat"):
+            return self.height * self.width * self.channels
+        if self.kind == "cnn3d":
+            return self.depth * self.height * self.width * self.channels
+        raise ValueError(self.kind)
+
+    def batch_shape(self, batch: int = 1) -> Tuple[int, ...]:
+        """Example array shape for one batch of this type (NHWC / NTC)."""
+        if self.kind == "ff" or self.kind == "cnn_flat":
+            return (batch, self.flat_size())
+        if self.kind == "rnn":
+            t = self.timesteps if self.timesteps is not None else 1
+            return (batch, t, self.size)
+        if self.kind == "cnn":
+            return (batch, self.height, self.width, self.channels)
+        if self.kind == "cnn3d":
+            return (batch, self.depth, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)

@@ -1,0 +1,304 @@
+"""Network configuration DSL — NeuralNetConfiguration / MultiLayerConfiguration.
+
+Reference: ``nn/conf/NeuralNetConfiguration.java:578`` (Builder) and ``:203,738``
+(ListBuilder / ``list()``), ``MultiLayerConfiguration.java``. The fluent
+builder produces an immutable JSON-serializable configuration; global training
+hyperparameters flow into layers that didn't override them; InputType
+inference sets each layer's n_in and inserts automatic reshape preprocessors
+(DL4J's ``InputPreProcessor`` system).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
+from deeplearning4j_tpu.nn.updaters import Updater, resolve_updater
+from deeplearning4j_tpu.nn.weights import Distribution
+
+
+@dataclasses.dataclass
+class GlobalConf:
+    """Global (per-network) defaults, inherited by layers (DL4J Builder fields)."""
+
+    seed: int = 12345
+    activation: Optional[str] = None
+    weight_init: Optional[str] = "xavier"
+    distribution: Optional[Distribution] = None
+    bias_init: Optional[float] = 0.0
+    updater: Optional[Updater] = None
+    bias_updater: Optional[Updater] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    l1_bias: Optional[float] = None
+    l2_bias: Optional[float] = None
+    dropout: Optional[float] = None
+    gradient_normalization: Optional[str] = None
+    gradient_normalization_threshold: float = 1.0
+    mini_batch: bool = True
+    dtype: str = "float32"
+    optimization_algo: str = "stochastic_gradient_descent"
+    max_num_line_search_iterations: int = 5
+
+    def jnp_dtype(self):
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float16": jnp.float16, "float64": jnp.float64}[self.dtype]
+
+
+class NeuralNetConfiguration:
+    """Entry point: ``NeuralNetConfiguration.builder()`` (DL4J ``new
+    NeuralNetConfiguration.Builder()``)."""
+
+    @staticmethod
+    def builder() -> "Builder":
+        return Builder()
+
+
+class Builder:
+    def __init__(self):
+        self._g = GlobalConf()
+
+    # fluent setters (DL4J Builder method names, snake_cased) ---------------
+    def seed(self, s: int) -> "Builder":
+        self._g.seed = int(s)
+        return self
+
+    def activation(self, a: str) -> "Builder":
+        self._g.activation = a
+        return self
+
+    def weight_init(self, w: str, distribution: Optional[Distribution] = None) -> "Builder":
+        self._g.weight_init = w
+        if distribution is not None:
+            self._g.distribution = distribution
+        return self
+
+    def dist(self, d: Distribution) -> "Builder":
+        self._g.distribution = d
+        self._g.weight_init = "distribution"
+        return self
+
+    def bias_init(self, b: float) -> "Builder":
+        self._g.bias_init = b
+        return self
+
+    def updater(self, u: Union[str, Updater]) -> "Builder":
+        self._g.updater = resolve_updater(u)
+        return self
+
+    def bias_updater(self, u: Union[str, Updater]) -> "Builder":
+        self._g.bias_updater = resolve_updater(u)
+        return self
+
+    def l1(self, v: float) -> "Builder":
+        self._g.l1 = v
+        return self
+
+    def l2(self, v: float) -> "Builder":
+        self._g.l2 = v
+        return self
+
+    def l1_bias(self, v: float) -> "Builder":
+        self._g.l1_bias = v
+        return self
+
+    def l2_bias(self, v: float) -> "Builder":
+        self._g.l2_bias = v
+        return self
+
+    def dropout(self, keep_prob: float) -> "Builder":
+        self._g.dropout = keep_prob
+        return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0) -> "Builder":
+        self._g.gradient_normalization = mode
+        self._g.gradient_normalization_threshold = threshold
+        return self
+
+    def dtype(self, dt: str) -> "Builder":
+        self._g.dtype = dt
+        return self
+
+    def mini_batch(self, b: bool) -> "Builder":
+        self._g.mini_batch = b
+        return self
+
+    def optimization_algo(self, algo: str) -> "Builder":
+        self._g.optimization_algo = algo
+        return self
+
+    def list(self) -> "ListBuilder":
+        return ListBuilder(self._g)
+
+    def graph_builder(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+        return GraphBuilder(self._g)
+
+
+class ListBuilder:
+    """DL4J ``NeuralNetConfiguration.ListBuilder``."""
+
+    def __init__(self, g: GlobalConf):
+        self._g = g
+        self._layers: List[Layer] = []
+        self._input_type: Optional[InputType] = None
+        self._backprop_type: str = "standard"
+        self._tbptt_fwd: int = 20
+        self._tbptt_bwd: int = 20
+
+    def layer(self, layer: Layer, index: Optional[int] = None) -> "ListBuilder":
+        if index is not None and index != len(self._layers):
+            raise ValueError("layers must be added in order")
+        self._layers.append(layer)
+        return self
+
+    def set_input_type(self, input_type: InputType) -> "ListBuilder":
+        self._input_type = input_type
+        return self
+
+    def backprop_type(self, t: str) -> "ListBuilder":
+        self._backprop_type = t.lower()
+        return self
+
+    def t_bptt_length(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
+        self._tbptt_fwd = fwd
+        self._tbptt_bwd = bwd if bwd is not None else fwd
+        self._backprop_type = "truncated_bptt"
+        return self
+
+    def build(self) -> "MultiLayerConfiguration":
+        conf = MultiLayerConfiguration(
+            global_conf=self._g,
+            layers=list(self._layers),
+            input_type=self._input_type,
+            backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_bwd_length=self._tbptt_bwd,
+        )
+        conf.finalize()
+        return conf
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    global_conf: GlobalConf
+    layers: List[Layer]
+    input_type: Optional[InputType] = None
+    backprop_type: str = "standard"
+    tbptt_fwd_length: int = 20
+    tbptt_bwd_length: int = 20
+    # computed in finalize():
+    preprocessors: dict = dataclasses.field(default_factory=dict)  # idx -> fn
+    layer_input_types: List[InputType] = dataclasses.field(default_factory=list)
+    _finalized: bool = False
+
+    def finalize(self) -> None:
+        """Propagate global defaults and infer shapes (DL4J's config build +
+        InputType propagation)."""
+        if self._finalized:
+            return
+        if not self.layers:
+            raise ValueError("Configuration has no layers")
+        for l in self.layers:
+            l.apply_global_defaults(self.global_conf)  # type: ignore[arg-type]
+        it = self.input_type
+        self.layer_input_types = []
+        for i, l in enumerate(self.layers):
+            if it is not None:
+                pre = l.input_preprocessor(it)
+                if pre is not None:
+                    fn, it = pre
+                    self.preprocessors[i] = fn
+                l.set_n_in(it)
+                self.layer_input_types.append(it)
+                it = l.output_type(it)
+            else:
+                self.layer_input_types.append(None)  # type: ignore[arg-type]
+        self._finalized = True
+
+    # -- introspection -------------------------------------------------------
+    def output_type(self) -> Optional[InputType]:
+        if self.input_type is None:
+            return None
+        it = self.layer_input_types[-1]
+        return self.layers[-1].output_type(it)
+
+    def num_params(self) -> int:
+        return sum(l.num_params() for l in self.layers)
+
+    def memory_report(self, batch: int = 1) -> dict:
+        """Analytic per-layer memory forecast (NetworkMemoryReport parity)."""
+        import math
+        report = {"layers": [], "total_param_bytes": 0, "total_activation_bytes": 0}
+        bytes_per = 4 if self.global_conf.dtype == "float32" else 2
+        it = self.input_type
+        for i, l in enumerate(self.layers):
+            n_params = l.num_params()
+            act_elems = 0
+            if it is not None:
+                out = l.output_type(self.layer_input_types[i])
+                act_elems = int(math.prod(out.batch_shape(batch)))
+                it = out
+            entry = {
+                "name": l.name or f"layer{i}",
+                "type": type(l).__name__,
+                "params": n_params,
+                "param_bytes": n_params * bytes_per,
+                "activation_bytes": act_elems * bytes_per,
+            }
+            report["layers"].append(entry)
+            report["total_param_bytes"] += entry["param_bytes"]
+            report["total_activation_bytes"] += entry["activation_bytes"]
+        return report
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        g = dataclasses.asdict(self.global_conf)
+        if self.global_conf.updater is not None:
+            g["updater"] = self.global_conf.updater.to_dict()
+        if self.global_conf.bias_updater is not None:
+            g["bias_updater"] = self.global_conf.bias_updater.to_dict()
+        if self.global_conf.distribution is not None:
+            g["distribution"] = self.global_conf.distribution.to_dict()
+        return {
+            "format": "deeplearning4j_tpu.MultiLayerConfiguration",
+            "version": 1,
+            "global": g,
+            "layers": [l.to_dict() for l in self.layers],
+            "input_type": None if self.input_type is None else self.input_type.to_dict(),
+            "backprop_type": self.backprop_type,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_bwd_length": self.tbptt_bwd_length,
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        g = dict(d["global"])
+        if isinstance(g.get("updater"), dict):
+            g["updater"] = Updater.from_dict(g["updater"])
+        if isinstance(g.get("bias_updater"), dict):
+            g["bias_updater"] = Updater.from_dict(g["bias_updater"])
+        if isinstance(g.get("distribution"), dict):
+            g["distribution"] = Distribution.from_dict(g["distribution"])
+        conf = MultiLayerConfiguration(
+            global_conf=GlobalConf(**g),
+            layers=[layer_from_dict(ld) for ld in d["layers"]],
+            input_type=None if d.get("input_type") is None else InputType.from_dict(d["input_type"]),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_bwd_length=d.get("tbptt_bwd_length", 20),
+        )
+        conf.finalize()
+        return conf
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))

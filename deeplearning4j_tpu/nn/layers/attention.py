@@ -1,0 +1,146 @@
+"""Self-attention layers.
+
+The reference snapshot has no attention layer (SURVEY.md §5 long-context), but
+BASELINE.json's BERT-import config requires attention ops; DL4J's later
+releases added ``SelfAttentionLayer``/``LearnedSelfAttentionLayer`` on
+SameDiff. Built TPU-first: one fused QKV projection (single MXU matmul),
+scaled dot-product attention with optional masking, bf16-friendly. The op is
+sequence-shardable — see ``parallel/ring.py`` for the ring-attention variant
+used under sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def dot_product_attention(q, k, v, mask=None, dropout_rate=0.0, rng=None, train=False):
+    """q,k,v: [N, H, T, Dh]; mask: [N, T] (1=valid) or [N, 1, Tq, Tk]."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    if mask is not None:
+        if mask.ndim == 2:
+            m = mask[:, None, None, :]
+        else:
+            m = mask
+        scores = jnp.where(m > 0, scores, jnp.finfo(scores.dtype).min)
+    w = jax.nn.softmax(scores, axis=-1)
+    if train and dropout_rate > 0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, w.shape)
+        w = jnp.where(keep, w / (1.0 - dropout_rate), 0.0)
+    return jnp.einsum("nhqk,nhkd->nhqd", w, v)
+
+
+@register_layer
+@dataclasses.dataclass
+class SelfAttentionLayer(Layer):
+    """Multi-head self-attention over [N,T,C] with optional output projection."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+    attn_dropout: float = 0.0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def _dh(self):
+        return self.head_size or self.n_out // self.n_heads
+
+    def param_shapes(self):
+        dh = self._dh()
+        inner = self.n_heads * dh
+        shapes = {"Wqkv": (self.n_in, 3 * inner), "bqkv": (3 * inner,)}
+        if self.project_input:
+            shapes["Wo"] = (inner, self.n_out)
+            shapes["bo"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        dh = self._dh()
+        inner = self.n_heads * dh
+        if not self.project_input and inner != self.n_out:
+            raise ValueError(
+                f"project_input=False requires n_heads*head_size == n_out "
+                f"(got {inner} != {self.n_out})")
+        k1, k2 = jax.random.split(rng)
+        p = {
+            "Wqkv": self._init_w(k1, (self.n_in, 3 * inner), self.n_in, 3 * inner, dtype),
+            "bqkv": jnp.zeros((3 * inner,), dtype),
+        }
+        if self.project_input:
+            p["Wo"] = self._init_w(k2, (inner, self.n_out), inner, self.n_out, dtype)
+            p["bo"] = jnp.zeros((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        n, t, _ = x.shape
+        h, dh = self.n_heads, self._dh()
+        qkv = x @ params["Wqkv"] + params["bqkv"]              # [N,T,3*H*Dh]
+        qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)  # [3,N,H,T,Dh]
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = dot_product_attention(q, k, v, mask=mask, dropout_rate=self.attn_dropout,
+                                    rng=rng, train=train)
+        y = out.transpose(0, 2, 1, 3).reshape(n, t, h * dh)
+        if self.project_input:
+            y = y @ params["Wo"] + params["bo"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class LearnedSelfAttentionLayer(SelfAttentionLayer):
+    """Attention with n_queries learned query vectors (DL4J
+    LearnedSelfAttentionLayer): output is [N, n_queries, n_out]."""
+
+    n_queries: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def param_shapes(self):
+        dh = self._dh()
+        inner = self.n_heads * dh
+        return {"Wkv": (self.n_in, 2 * inner), "bkv": (2 * inner,),
+                "Q": (self.n_queries, self.n_heads, dh),
+                "Wo": (inner, self.n_out), "bo": (self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        dh = self._dh()
+        inner = self.n_heads * dh
+        k1, k2, k3 = jax.random.split(rng, 3)
+        return {
+            "Wkv": self._init_w(k1, (self.n_in, 2 * inner), self.n_in, 2 * inner, dtype),
+            "bkv": jnp.zeros((2 * inner,), dtype),
+            "Q": self._init_w(k2, (self.n_queries, self.n_heads, dh), dh, dh, dtype),
+            "Wo": self._init_w(k3, (inner, self.n_out), inner, self.n_out, dtype),
+            "bo": jnp.zeros((self.n_out,), dtype),
+        }
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        n, t, _ = x.shape
+        h, dh = self.n_heads, self._dh()
+        kv = x @ params["Wkv"] + params["bkv"]
+        kv = kv.reshape(n, t, 2, h, dh).transpose(2, 0, 3, 1, 4)
+        k, v = kv[0], kv[1]
+        q = jnp.broadcast_to(params["Q"].transpose(1, 0, 2)[None], (n, h, self.n_queries, dh))
+        out = dot_product_attention(q, k, v, mask=mask, dropout_rate=self.attn_dropout,
+                                    rng=rng, train=train)
+        out = out.transpose(0, 2, 1, 3).reshape(n, self.n_queries, h * dh)
+        y = out @ params["Wo"] + params["bo"]
+        return self.act_fn()(y), state or {}

@@ -1,0 +1,72 @@
+"""Denoising AutoEncoder layer (DL4J ``nn/conf/layers/AutoEncoder.java``).
+
+Forward pass in a network = encoder. ``pretrain_loss`` gives the denoising
+reconstruction objective used by layerwise pretraining (corruption +
+reconstruction cross-entropy/MSE), replacing DL4J's pretrain param phase.
+Params follow DL4J's PretrainParamInitializer: W, b (encoder), vb (visible
+bias; decoder uses W^T).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn import losses as loss_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class AutoEncoderLayer(Layer):
+    n_in: int = 0
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    loss: str = "mse"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "sigmoid"
+
+    def is_pretrain_layer(self) -> bool:
+        return True
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out), "b": (self.n_out,), "vb": (self.n_in,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {
+            "W": self._init_w(rng, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def encode(self, params, x):
+        return self.act_fn()(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self.act_fn()(h @ params["W"].T + params["vb"])
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self.encode(params, x), state or {}
+
+    def pretrain_loss(self, params, x, rng):
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            corrupted = jnp.where(keep, x, 0.0)
+        else:
+            corrupted = x
+        recon = self.decode(params, self.encode(params, corrupted))
+        fn, _ = loss_mod.resolve(self.loss, None)
+        return fn(x, recon)

@@ -1,0 +1,430 @@
+"""Convolution layer family — NHWC, lowered straight to XLA convolutions.
+
+Reference configs: ``nn/conf/layers/ConvolutionLayer.java`` (+
+``Convolution1DLayer``, ``Deconvolution2D``, ``SeparableConvolution2D``,
+``DepthwiseConvolution2D``, ``ZeroPaddingLayer``, ``Cropping2D``,
+``SpaceToDepthLayer``, ``SpaceToBatchLayer``, ``Upsampling1D/2D``). The
+reference reaches im2col/sconv2d/deconv2d ``DynamicCustomOp``s through the
+cuDNN helper seam (``ConvolutionLayer.java:76-84``); here the same math is a
+single ``lax.conv_general_dilated`` that XLA tiles onto the MXU — channels
+last, so no layout transposes.
+
+Weight layout is HWIO ([kh, kw, in, out]); DL4J's OIHW is converted by the
+checkpoint/Keras importers. ConvolutionMode parity: "same" → SAME padding,
+"truncate"/"strict" → explicit pad with floor output sizing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1] if len(v) > 1 else v[0]))
+    return (int(v), int(v))
+
+
+def conv_out_size(size: int, k: int, s: int, p: int, dilation: int, mode: str) -> int:
+    if mode == "same":
+        return -(-size // s)  # ceil
+    eff_k = k + (k - 1) * (dilation - 1)
+    return (size + 2 * p - eff_k) // s + 1
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvolutionLayer(Layer):
+    """2-D convolution (DL4J ConvolutionLayer, NHWC here)."""
+
+    n_in: int = 0   # input channels
+    n_out: int = 0  # output channels
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"  # "strict" | "truncate" | "same"
+    has_bias: bool = True
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+        self.dilation = _pair(self.dilation)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.channels
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dh, dw = self.dilation
+        mode = self.convolution_mode
+        h = conv_out_size(input_type.height, kh, sh, ph, dh, mode)
+        w = conv_out_size(input_type.width, kw, sw, pw, dw, mode)
+        return InputType.convolutional(h, w, self.n_out)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        p = {"W": self._init_w(rng, (kh, kw, self.n_in, self.n_out), fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def _padding_spec(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x = self._dropout(x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=self._padding_spec(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class Convolution1DLayer(ConvolutionLayer):
+    """1-D conv over [N, T, C] (DL4J Convolution1DLayer on rnn-format data)."""
+
+    def __post_init__(self):
+        # store geometry as (k, 1) pairs internally
+        k = self.kernel_size[0] if isinstance(self.kernel_size, (tuple, list)) else self.kernel_size
+        s = self.stride[0] if isinstance(self.stride, (tuple, list)) else self.stride
+        p = self.padding[0] if isinstance(self.padding, (tuple, list)) else self.padding
+        d = self.dilation[0] if isinstance(self.dilation, (tuple, list)) else self.dilation
+        self.kernel_size = (int(k), 1)
+        self.stride = (int(s), 1)
+        self.padding = (int(p), 0)
+        self.dilation = (int(d), 1)
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size[0], self.stride[0],
+                              self.padding[0], self.dilation[0], self.convolution_mode)
+        return InputType.recurrent(self.n_out, t)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x4 = x[:, :, None, :]  # [N,T,1,C]
+        y, st = super().forward(params, x4, state=state, train=train, rng=rng)
+        return y[:, :, 0, :], st
+
+
+@register_layer
+@dataclasses.dataclass
+class Deconvolution2DLayer(ConvolutionLayer):
+    """Transposed convolution (DL4J Deconvolution2D).
+
+    Implemented as a fractionally-strided conv: dilate the input by the
+    stride, spatially flip the kernel, pad with (k-1-p). Output size
+    ``s*(in-1) + k - 2p`` matches the reference's deconv2d op.
+    """
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        if self.convolution_mode == "same":
+            h, w = input_type.height * sh, input_type.width * sw
+        else:
+            h = sh * (input_type.height - 1) + kh - 2 * ph
+            w = sw * (input_type.width - 1) + kw - 2 * pw
+        return InputType.convolutional(h, w, self.n_out)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x = self._dropout(x, train, rng)
+        kh, kw = self.kernel_size
+        ph, pw = self.padding
+        if self.convolution_mode == "same":
+            # pad so output is exactly input*stride
+            out_h = x.shape[1] * self.stride[0]
+            out_w = x.shape[2] * self.stride[1]
+            dil_h = (x.shape[1] - 1) * self.stride[0] + 1
+            dil_w = (x.shape[2] - 1) * self.stride[1] + 1
+            tot_h = max(out_h + kh - 1 - dil_h, 0)
+            tot_w = max(out_w + kw - 1 - dil_w, 0)
+            pad = [(tot_h // 2, tot_h - tot_h // 2), (tot_w // 2, tot_w - tot_w // 2)]
+        else:
+            pad = [(kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw)]
+        y = lax.conv_general_dilated(
+            x, jnp.flip(params["W"], (0, 1)),
+            window_strides=(1, 1),
+            padding=pad,
+            lhs_dilation=self.stride,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class DepthwiseConvolution2DLayer(ConvolutionLayer):
+    """Depthwise conv (DL4J DepthwiseConvolution2D): depth_multiplier filters
+    per input channel, grouped convolution with groups = n_in."""
+
+    depth_multiplier: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        base = super().output_type(input_type)
+        return InputType.convolutional(base.height, base.width, self.n_in * self.depth_multiplier)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, 1, self.n_in * self.depth_multiplier)}
+        if self.has_bias:
+            shapes["b"] = (self.n_in * self.depth_multiplier,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        c_out = self.n_in * self.depth_multiplier
+        fan_in = kh * kw
+        fan_out = self.depth_multiplier * kh * kw
+        p = {"W": self._init_w(rng, (kh, kw, 1, c_out), fan_in, fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((c_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x = self._dropout(x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=self._padding_spec(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in,
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class SeparableConvolution2DLayer(ConvolutionLayer):
+    """Depthwise + pointwise (DL4J SeparableConvolution2D / ND4J sconv2d)."""
+
+    depth_multiplier: int = 1
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {
+            "W": (kh, kw, 1, self.n_in * self.depth_multiplier),   # depthwise
+            "pW": (1, 1, self.n_in * self.depth_multiplier, self.n_out),  # pointwise
+        }
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        k1, k2 = jax.random.split(rng)
+        cm = self.n_in * self.depth_multiplier
+        p = {
+            "W": self._init_w(k1, (kh, kw, 1, cm), kh * kw, self.depth_multiplier * kh * kw, dtype),
+            "pW": self._init_w(k2, (1, 1, cm, self.n_out), cm, self.n_out, dtype),
+        }
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x = self._dropout(x, train, rng)
+        y = lax.conv_general_dilated(
+            x, params["W"],
+            window_strides=self.stride,
+            padding=self._padding_spec(),
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.n_in,
+        )
+        y = lax.conv_general_dilated(
+            y, params["pW"], window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPaddingLayer(Layer):
+    """Zero padding (DL4J ZeroPaddingLayer). padding = (top, bottom, left, right)
+    or (h, w)."""
+
+    padding: Tuple[int, ...] = (0, 0)
+
+    def _pads(self):
+        p = self.padding
+        if len(p) == 2:
+            return (p[0], p[0], p[1], p[1])
+        return tuple(p)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self._pads()
+        return InputType.convolutional(input_type.height + t + b,
+                                       input_type.width + l + r,
+                                       input_type.channels)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        t, b, l, r = self._pads()
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class ZeroPadding1DLayer(Layer):
+    """1-D zero padding on [N,T,C] (DL4J ZeroPadding1DLayer)."""
+
+    padding: Tuple[int, int] = (0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        if t is not None:
+            t = t + self.padding[0] + self.padding[1]
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        l, r = self.padding
+        return jnp.pad(x, ((0, 0), (l, r), (0, 0))), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class CropLayer(Layer):
+    """Cropping2D equivalent: crop = (top, bottom, left, right)."""
+
+    crop: Tuple[int, ...] = (0, 0, 0, 0)
+
+    def _crops(self):
+        c = self.crop
+        if len(c) == 2:
+            return (c[0], c[0], c[1], c[1])
+        return tuple(c)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t, b, l, r = self._crops()
+        return InputType.convolutional(input_type.height - t - b,
+                                       input_type.width - l - r,
+                                       input_type.channels)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        t, b, l, r = self._crops()
+        h, w = x.shape[1], x.shape[2]
+        return x[:, t:h - b or None, l:w - r or None, :], state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToDepthLayer(Layer):
+    """NHWC space-to-depth (DL4J SpaceToDepthLayer / ND4J space_to_depth)."""
+
+    block_size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b, input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        n, h, w, c = x.shape
+        b = self.block_size
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // b, w // b, c * b * b)
+        return y, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class SpaceToBatchLayer(Layer):
+    """NHWC space-to-batch (DL4J SpaceToBatchLayer)."""
+
+    blocks: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, ...] = (0, 0, 0, 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        bh, bw = self.blocks
+        p = self.padding if len(self.padding) == 4 else (*self.padding, *self.padding)
+        h = (input_type.height + p[0] + p[1]) // bh
+        w = (input_type.width + p[2] + p[3]) // bw
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        bh, bw = self.blocks
+        p = self.padding if len(self.padding) == 4 else (*self.padding, *self.padding)
+        x = jnp.pad(x, ((0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)))
+        n, h, w, c = x.shape
+        y = x.reshape(n, h // bh, bh, w // bw, bw, c)
+        y = y.transpose(2, 4, 0, 1, 3, 5).reshape(n * bh * bw, h // bh, w // bw, c)
+        return y, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class UpsamplingLayer(Layer):
+    """2-D nearest-neighbour upsampling (DL4J Upsampling2D)."""
+
+    size: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        self.size = _pair(self.size)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class Upsampling1DLayer(Layer):
+    """1-D upsampling over [N,T,C] (DL4J Upsampling1D)."""
+
+    size: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        return InputType.recurrent(input_type.size, None if t is None else t * self.size)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return jnp.repeat(x, self.size, axis=1), state or {}

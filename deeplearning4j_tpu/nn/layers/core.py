@@ -1,0 +1,229 @@
+"""Core feed-forward layers: Dense, Activation, Dropout, Embedding, …
+
+Reference configs: ``nn/conf/layers/DenseLayer.java``, ``ActivationLayer``,
+``DropoutLayer``, ``EmbeddingLayer``/``EmbeddingSequenceLayer``,
+``ElementWiseMultiplicationLayer``, ``PReLULayer``. Param names match DL4J's
+(``DefaultParamInitializer``: W, b) for checkpoint migration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class DenseLayer(Layer):
+    """Fully connected layer: y = act(x @ W + b); W is [n_in, n_out]."""
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def input_preprocessor(self, input_type: InputType):
+        if input_type.kind in ("cnn", "cnn_flat", "cnn3d"):
+            flat = input_type.flat_size()
+            return (lambda x: x.reshape(x.shape[0], -1), InputType.feed_forward(flat))
+        if input_type.kind == "rnn":
+            # RnnToFeedForward: fold time into batch [N,T,C] -> [N*T,C]
+            return None  # dense applies position-wise below instead
+        return None
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = {"W": self._init_w(rng, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x = self._dropout(x, train, rng)
+        y = x @ params["W"]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    """Applies an activation only (``nn/conf/layers/ActivationLayer.java``)."""
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self.act_fn()(x), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout layer; ``dropout`` is the KEEP probability, DL4J-style.
+    If unset here and on the network, defaults to 0.5 at apply time (so the
+    network-level dropout default can still flow in via apply_global_defaults).
+    """
+
+    def apply_global_defaults(self, g):
+        super().apply_global_defaults(g)
+        if self.dropout is None:
+            self.dropout = 0.5
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self._dropout(x, train, rng), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingLayer(Layer):
+    """Index → embedding row (``nn/conf/layers/EmbeddingLayer.java``).
+
+    Input: [N] or [N,1] integer indices; output [N, n_out]. Backprop is a
+    scatter-add on the embedding table, which XLA handles natively — no
+    hogwild needed.
+    """
+
+    n_in: int = 0      # vocab size
+    n_out: int = 0
+    has_bias: bool = False
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = {"W": self._init_w(rng, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingSequenceLayer(Layer):
+    """Sequence of indices → sequence of embeddings
+    (``nn/conf/layers/EmbeddingSequenceLayer.java``). Input [N,T] ints →
+    output [N,T,n_out] (rnn layout)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    input_length: Optional[int] = None
+    has_bias: bool = False
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.input_length or input_type.timesteps)
+
+    def param_shapes(self):
+        shapes = {"W": (self.n_in, self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        p = {"W": self._init_w(rng, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = self._init_b((self.n_out,), dtype)
+        return p
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 3 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+        y = jnp.take(params["W"], idx, axis=0)  # [N,T,n_out]
+        if self.has_bias:
+            y = y + params["b"]
+        return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class ElementWiseMultiplicationLayer(Layer):
+    """out = act(x * w + b) elementwise — requires n_in == n_out
+    (``nn/conf/layers/misc/ElementWiseMultiplicationLayer.java``)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out or self.n_in)
+
+    def param_shapes(self):
+        return {"W": (self.n_in,), "b": (self.n_in,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"W": jnp.ones((self.n_in,), dtype), "b": self._init_b((self.n_in,), dtype)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self.act_fn()(x * params["W"] + params["b"]), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class PReLULayer(Layer):
+    """Parametric ReLU with learned per-feature alpha
+    (``nn/conf/layers/PReLULayer.java``)."""
+
+    input_shape: Optional[Tuple[int, ...]] = None  # feature shape sans batch
+    shared_axes: Optional[Tuple[int, ...]] = None
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if self.input_shape is None:
+            self.input_shape = tuple(input_type.batch_shape(1)[1:])
+
+    def param_shapes(self):
+        shape = list(self.input_shape or ())
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        return {"W": tuple(shape)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"W": jnp.zeros(self.param_shapes()["W"], dtype)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        alpha = params["W"]
+        return jnp.where(x >= 0, x, alpha * x), state or {}

@@ -1,0 +1,114 @@
+"""Normalization layers: BatchNormalization and LocalResponseNormalization.
+
+Reference: ``nn/conf/layers/BatchNormalization.java`` +
+``nn/layers/normalization/BatchNormalization.java`` (running mean/var with
+``decay``, gamma/beta optionally locked), ``LocalResponseNormalization.java``.
+The cuDNN helper seam (``BatchNormalizationHelper.java:29``) is unnecessary —
+XLA fuses the normalize+scale+shift chain into neighbouring ops.
+
+Running statistics are framework "state" (not params): ``forward`` in train
+mode returns updated running stats, mirroring DL4J's global-mean/var params
+updated during fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class BatchNormalizationLayer(Layer):
+    """Batch norm over the channel/feature axis (DL4J BatchNormalization).
+
+    DL4J semantics kept: ``decay`` is the running-average momentum
+    (running = decay*running + (1-decay)*batch), ``eps`` inside the sqrt,
+    optional ``lock_gamma_beta`` trains without scale/shift.
+    """
+
+    n_in: int = 0  # feature/channel count
+    decay: float = 0.9
+    eps: float = 1e-5
+    is_minibatch: bool = True
+    lock_gamma_beta: bool = False
+    gamma_init: float = 1.0
+    beta_init: float = 0.0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            if input_type.kind == "cnn":
+                self.n_in = input_type.channels
+            else:
+                self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def param_shapes(self):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": (self.n_in,), "beta": (self.n_in,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma": jnp.full((self.n_in,), self.gamma_init, dtype),
+                "beta": jnp.full((self.n_in,), self.beta_init, dtype)}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_in,), jnp.float32),
+                "var": jnp.ones((self.n_in,), jnp.float32)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        state = state or self.init_state()
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis (last)
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        if not self.lock_gamma_beta:
+            xhat = xhat * params["gamma"] + params["beta"]
+        elif self.gamma_init != 1.0 or self.beta_init != 0.0:
+            xhat = xhat * self.gamma_init + self.beta_init
+        return self.act_fn()(xhat), new_state
+
+
+@register_layer
+@dataclasses.dataclass
+class LocalResponseNormalizationLayer(Layer):
+    """LRN across channels (DL4J LocalResponseNormalization; AlexNet-era).
+
+    y = x / (k + alpha * sum_{j in window} x_j^2)^beta over the channel axis.
+    """
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        # x: NHWC; windowed sum of squares over C via padded cumulative trick
+        sq = x * x
+        half = self.n // 2
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        # windowed sum via convolution-free slicing (n is tiny, unrolled)
+        win = sum(padded[..., i:i + x.shape[-1]] for i in range(self.n))
+        denom = (self.k + self.alpha * win) ** self.beta
+        return x / denom, state or {}

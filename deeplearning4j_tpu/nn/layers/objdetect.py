@@ -1,0 +1,113 @@
+"""YOLOv2 output layer for object detection.
+
+Reference: ``nn/conf/layers/objdetect/Yolo2OutputLayer.java`` and its impl
+``nn/layers/objdetect/Yolo2OutputLayer.java:71`` (loss of Redmon et al. 2016).
+Input is NHWC [N, H, W, B*(5+C)] (grid of B anchor boxes, each with
+tx,ty,tw,th,conf + C class scores); labels [N, H, W, B*(5)+...] use the same
+packed layout the reference uses: a grid-cell object mask plus target boxes.
+
+Label format here (TPU-simplified but information-equivalent): labels is
+[N, H, W, 4 + 1 + C] — normalized (cx, cy, w, h) in grid units, objectness
+(1 if an object's center falls in the cell), one-hot class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    """YOLOv2 detection loss (lambda-weighted coord/conf/class terms)."""
+
+    boxes: Tuple[Tuple[float, float], ...] = ((1.0, 1.0),)  # anchor (w,h) priors, grid units
+    lambda_coord: float = 5.0
+    lambda_no_obj: float = 0.5
+    n_classes: int = 0
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "identity"
+        if isinstance(self.boxes, list):
+            self.boxes = tuple(tuple(b) for b in self.boxes)
+
+    def has_loss(self) -> bool:
+        return True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _split_predictions(self, x):
+        """x: [N,H,W,B*(5+C)] → sigmoid/exp-decoded boxes, conf, class logits."""
+        n, h, w, _ = x.shape
+        b = len(self.boxes)
+        c = self.n_classes
+        x = x.reshape(n, h, w, b, 5 + c)
+        txy = jax.nn.sigmoid(x[..., 0:2])            # offset in cell
+        twh = x[..., 2:4]                            # log-space size
+        conf = jax.nn.sigmoid(x[..., 4])
+        cls_logits = x[..., 5:]
+        anchors = jnp.asarray(self.boxes)            # [B,2]
+        wh = anchors * jnp.exp(twh)                  # grid units
+        grid_x = jnp.arange(w)[None, None, :, None]
+        grid_y = jnp.arange(h)[None, :, None, None]
+        cx = txy[..., 0] + grid_x
+        cy = txy[..., 1] + grid_y
+        return cx, cy, wh, conf, cls_logits
+
+    @staticmethod
+    def _iou(cx1, cy1, wh1, cx2, cy2, wh2):
+        x1min, x1max = cx1 - wh1[..., 0] / 2, cx1 + wh1[..., 0] / 2
+        y1min, y1max = cy1 - wh1[..., 1] / 2, cy1 + wh1[..., 1] / 2
+        x2min, x2max = cx2 - wh2[..., 0] / 2, cx2 + wh2[..., 0] / 2
+        y2min, y2max = cy2 - wh2[..., 1] / 2, cy2 + wh2[..., 1] / 2
+        iw = jnp.maximum(jnp.minimum(x1max, x2max) - jnp.maximum(x1min, x2min), 0.0)
+        ih = jnp.maximum(jnp.minimum(y1max, y2max) - jnp.maximum(y1min, y2min), 0.0)
+        inter = iw * ih
+        union = wh1[..., 0] * wh1[..., 1] + wh2[..., 0] * wh2[..., 1] - inter
+        return inter / jnp.maximum(union, 1e-8)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return x, state or {}
+
+    def compute_loss(self, params, x, labels, mask=None):
+        cx, cy, wh, conf, cls_logits = self._split_predictions(x)
+        # labels: [N,H,W,5+C]
+        lab_cxy = labels[..., 0:2]
+        lab_wh = labels[..., 2:4]
+        obj = labels[..., 4]                         # [N,H,W]
+        lab_cls = labels[..., 5:]
+
+        # responsible box = best IoU with the ground-truth box in each cell
+        iou = self._iou(cx, cy, wh,
+                        lab_cxy[..., 0:1] * 0 + lab_cxy[..., None, 0],
+                        lab_cxy[..., None, 1], lab_wh[..., None, :])  # [N,H,W,B]
+        best = jnp.argmax(iou, axis=-1)              # [N,H,W]
+        resp = jax.nn.one_hot(best, len(self.boxes)) * obj[..., None]  # [N,H,W,B]
+
+        # coordinate loss (sqrt on w,h as in the paper/reference)
+        err_xy = (cx - lab_cxy[..., None, 0]) ** 2 + (cy - lab_cxy[..., None, 1]) ** 2
+        err_wh = ((jnp.sqrt(jnp.maximum(wh[..., 0], 1e-8)) -
+                   jnp.sqrt(jnp.maximum(lab_wh[..., None, 0], 1e-8))) ** 2 +
+                  (jnp.sqrt(jnp.maximum(wh[..., 1], 1e-8)) -
+                   jnp.sqrt(jnp.maximum(lab_wh[..., None, 1], 1e-8))) ** 2)
+        coord_loss = self.lambda_coord * jnp.sum(resp * (err_xy + err_wh))
+
+        # confidence loss: responsible boxes target IoU; others target 0
+        conf_obj = jnp.sum(resp * (conf - jax.lax.stop_gradient(iou)) ** 2)
+        conf_noobj = self.lambda_no_obj * jnp.sum((1 - resp) * conf ** 2)
+
+        # classification loss (softmax CE in cells with objects)
+        logp = jax.nn.log_softmax(cls_logits, axis=-1)
+        cls_loss = -jnp.sum(resp[..., None] * lab_cls[..., None, :] * logp)
+
+        n = x.shape[0]
+        return (coord_loss + conf_obj + conf_noobj + cls_loss) / n

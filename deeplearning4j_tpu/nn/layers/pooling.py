@@ -1,0 +1,161 @@
+"""Pooling layers: Subsampling (2D/1D) and GlobalPooling.
+
+Reference configs: ``nn/conf/layers/SubsamplingLayer.java`` (MAX/AVG/SUM/PNORM),
+``Subsampling1DLayer``, ``GlobalPoolingLayer`` (pools over spatial or time
+dims, mask-aware for variable-length sequences — cf. ``MaskedReductionUtil``).
+Implemented with ``lax.reduce_window`` which XLA maps to the TPU vector unit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.conv import _pair, conv_out_size
+
+
+@register_layer
+@dataclasses.dataclass
+class SubsamplingLayer(Layer):
+    """2-D pooling over NHWC (DL4J SubsamplingLayer)."""
+
+    pooling_type: str = "max"  # "max" | "avg" | "sum" | "pnorm"
+    kernel_size: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    padding: Tuple[int, int] = (0, 0)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel_size = _pair(self.kernel_size)
+        self.stride = _pair(self.stride)
+        self.padding = _pair(self.padding)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        h = conv_out_size(input_type.height, kh, sh, ph, 1, self.convolution_mode)
+        w = conv_out_size(input_type.width, kw, sw, pw, 1, self.convolution_mode)
+        return InputType.convolutional(h, w, input_type.channels)
+
+    def _window(self, x):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode == "same":
+            pad = "SAME"
+        else:
+            ph, pw = self.padding
+            pad = [(0, 0), (ph, ph), (pw, pw), (0, 0)]
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        return dims, strides, pad
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        dims, strides, pad = self._window(x)
+        pt = self.pooling_type.lower()
+        if pt == "max":
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif pt == "sum":
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+        elif pt == "avg":
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            y = y / (dims[1] * dims[2])
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad)
+            y = y ** (1.0 / p)
+        else:
+            raise ValueError(f"Unknown pooling type {self.pooling_type!r}")
+        return y, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class Subsampling1DLayer(SubsamplingLayer):
+    """1-D pooling over [N,T,C] (DL4J Subsampling1DLayer)."""
+
+    def __post_init__(self):
+        k = self.kernel_size[0] if isinstance(self.kernel_size, (tuple, list)) else self.kernel_size
+        s = self.stride[0] if isinstance(self.stride, (tuple, list)) else self.stride
+        p = self.padding[0] if isinstance(self.padding, (tuple, list)) else self.padding
+        self.kernel_size = (int(k), 1)
+        self.stride = (int(s), 1)
+        self.padding = (int(p), 0)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        t = input_type.timesteps
+        if t is not None:
+            t = conv_out_size(t, self.kernel_size[0], self.stride[0], self.padding[0],
+                              1, self.convolution_mode)
+        return InputType.recurrent(input_type.size, t)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        x4 = x[:, :, None, :]
+        y, st = super().forward(params, x4, state=state, train=train, rng=rng)
+        return y[:, :, 0, :], st
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPoolingLayer(Layer):
+    """Global pooling over time (rnn) or space (cnn) — DL4J GlobalPoolingLayer.
+
+    Mask-aware: for rnn input with a [N,T] mask, masked steps are excluded
+    exactly as ``MaskedReductionUtil`` does.
+    """
+
+    pooling_type: str = "max"
+    pooling_dimensions: Optional[Tuple[int, ...]] = None
+    collapse_dimensions: bool = True
+    pnorm: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        pt = self.pooling_type.lower()
+        if x.ndim == 3:  # [N,T,C] over time
+            axes = (1,)
+        elif x.ndim == 4:  # NHWC over H,W
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling expects 3-D or 4-D input, got {x.shape}")
+
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[:, :, None]
+            if pt == "max":
+                y = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+            elif pt == "sum":
+                y = jnp.sum(x * m, axis=1)
+            elif pt == "avg":
+                y = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+            elif pt == "pnorm":
+                p = float(self.pnorm)
+                y = jnp.sum((jnp.abs(x) * m) ** p, axis=1) ** (1.0 / p)
+            else:
+                raise ValueError(pt)
+            return y, state or {}
+
+        if pt == "max":
+            y = jnp.max(x, axis=axes)
+        elif pt == "sum":
+            y = jnp.sum(x, axis=axes)
+        elif pt == "avg":
+            y = jnp.mean(x, axis=axes)
+        elif pt == "pnorm":
+            p = float(self.pnorm)
+            y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+        else:
+            raise ValueError(pt)
+        return y, state or {}

@@ -1,0 +1,377 @@
+"""Recurrent layers: LSTM / GravesLSTM (peepholes) / SimpleRnn / wrappers.
+
+Reference: ``nn/conf/layers/LSTM.java``, ``GravesLSTM.java``,
+``GravesBidirectionalLSTM.java``, ``SimpleRnn``, shared math in
+``nn/layers/recurrent/LSTMHelpers.java:58`` (``activateHelper:68``), wrappers
+``Bidirectional``, ``LastTimeStep``, ``MaskZeroLayer``. The reference
+hand-writes forward+backward per timestep in Java loops; here the recurrence
+is one ``lax.scan`` — XLA compiles the whole unrolled graph, and the big
+[x,h] @ [W;RW] matmul per step rides the MXU.
+
+Layout: [batch, time, features]; scan runs time-major internally. Gate order
+is DL4J's IFOG (input, forget, output, cell-gate). Param names match
+``LSTMParamInitializer``: W [n_in, 4H], RW [n_out, 4H] (+3H peephole columns
+appended for Graves), b [4H] with forget-gate bias init.
+
+Masking: a [N,T] mask freezes the carried state and zeroes the output at
+masked steps (matches DL4J variable-length semantics). TBPTT/stateful
+inference use ``forward_seq(params, x, carry)`` which returns the final carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn import activations as act_mod
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+class BaseRecurrentLayer(Layer):
+    """Mixin API for layers that carry recurrent state."""
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        """[N,T,C] → ([N,T,H], final_carry)."""
+        raise NotImplementedError
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y, _ = self.forward_seq(params, x, carry=None, mask=mask, train=train, rng=rng)
+        return y, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class LSTMLayer(BaseRecurrentLayer, Layer):
+    """Standard LSTM (DL4J ``LSTM`` — no peepholes)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+
+    peephole = False
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def param_shapes(self):
+        h = self.n_out
+        # Graves peepholes live in 3 extra RW *columns* (each [H]), matching
+        # DL4J's LSTMParamInitializer layout [nOut, 4*nOut+3]
+        rw_cols = 4 * h + (3 if self.peephole else 0)
+        return {"W": (self.n_in, 4 * h), "RW": (h, rw_cols), "b": (4 * h,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        h = self.n_out
+        k1, k2, k3 = jax.random.split(rng, 3)
+        w = self._init_w(k1, (self.n_in, 4 * h), self.n_in, 4 * h, dtype)
+        rw_cols = 4 * h + (3 if self.peephole else 0)
+        rw = self._init_w(k2, (h, rw_cols), h, rw_cols, dtype)
+        b = jnp.zeros((4 * h,), dtype)
+        # forget gate block is [h:2h] in IFOG order
+        b = b.at[h:2 * h].set(self.forget_gate_bias_init)
+        return {"W": w, "RW": rw, "b": b}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        h = self.n_out
+        return (jnp.zeros((batch, h), dtype), jnp.zeros((batch, h), dtype))
+
+    def _cell(self, params, x_t, carry):
+        h_prev, c_prev = carry
+        H = self.n_out
+        gate_act = act_mod.resolve(self.gate_activation)
+        cell_act = self.act_fn()
+        rw = params["RW"][:, :4 * H]
+        z = x_t @ params["W"] + h_prev @ rw + params["b"]
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        if self.peephole:
+            # per-unit (diagonal) peephole vectors: RW columns 4H, 4H+1, 4H+2
+            pi = params["RW"][:, 4 * H]
+            pf = params["RW"][:, 4 * H + 1]
+            po = params["RW"][:, 4 * H + 2]
+            zi = zi + c_prev * pi
+            zf = zf + c_prev * pf
+        i = gate_act(zi)
+        f = gate_act(zf)
+        g = cell_act(zg)
+        c = f * c_prev + i * g
+        if self.peephole:
+            zo = zo + c * po
+        o = gate_act(zo)
+        h = o * cell_act(c)
+        return h, (h, c)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        n, t, _ = x.shape
+        if carry is None:
+            carry = self.init_carry(n, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # [T,N,C]
+        ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)  # [T,N]
+
+        def step(c, inp):
+            if ms is None:
+                x_t = inp
+                h, new_c = self._cell(params, x_t, c)
+                return new_c, h
+            x_t, m_t = inp
+            h, new_c = self._cell(params, x_t, c)
+            m = m_t[:, None]
+            keep = lambda new, old: m * new + (1 - m) * old
+            new_c = (keep(new_c[0], c[0]), keep(new_c[1], c[1]))
+            return new_c, h * m
+
+        inputs = xs if ms is None else (xs, ms)
+        final_carry, ys = lax.scan(step, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), final_carry
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesLSTMLayer(LSTMLayer):
+    """LSTM with peephole connections (DL4J GravesLSTM)."""
+
+    peephole = True
+
+
+@register_layer
+@dataclasses.dataclass
+class SimpleRnnLayer(BaseRecurrentLayer, Layer):
+    """Vanilla RNN: h_t = act(x W + h_{t-1} RW + b) (DL4J SimpleRnn)."""
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def param_shapes(self):
+        return {"W": (self.n_in, self.n_out), "RW": (self.n_out, self.n_out),
+                "b": (self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        return {
+            "W": self._init_w(k1, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "RW": self._init_w(k2, (self.n_out, self.n_out), self.n_out, self.n_out, dtype),
+            "b": self._init_b((self.n_out,), dtype),
+        }
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        n, t, _ = x.shape
+        if carry is None:
+            carry = self.init_carry(n, x.dtype)
+        act = self.act_fn()
+        xs = jnp.swapaxes(x, 0, 1)
+        ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+
+        def step(c, inp):
+            (h_prev,) = c
+            if ms is None:
+                x_t = inp
+                h = act(x_t @ params["W"] + h_prev @ params["RW"] + params["b"])
+                return (h,), h
+            x_t, m_t = inp
+            h = act(x_t @ params["W"] + h_prev @ params["RW"] + params["b"])
+            m = m_t[:, None]
+            h_keep = m * h + (1 - m) * h_prev
+            return (h_keep,), h * m
+
+        inputs = xs if ms is None else (xs, ms)
+        final_carry, ys = lax.scan(step, carry, inputs)
+        return jnp.swapaxes(ys, 0, 1), final_carry
+
+
+@register_layer
+@dataclasses.dataclass
+class BidirectionalWrapper(BaseRecurrentLayer, Layer):
+    """Bidirectional RNN wrapper (DL4J ``Bidirectional``): runs the wrapped
+    recurrent layer forward and on the time-reversed sequence, then combines
+    (CONCAT/ADD/MUL/AVERAGE)."""
+
+    layer: Optional[Layer] = None
+    mode: str = "concat"  # "concat" | "add" | "mul" | "average"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.layer.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.layer.output_type(input_type)
+        size = inner.size * 2 if self.mode == "concat" else inner.size
+        return InputType.recurrent(size, inner.timesteps)
+
+    def apply_global_defaults(self, g):
+        super().apply_global_defaults(g)
+        if self.layer is not None:
+            self.layer.apply_global_defaults(g)
+
+    def param_shapes(self):
+        inner = self.layer.param_shapes()
+        return {f"f_{k}": v for k, v in inner.items()} | {f"b_{k}": v for k, v in inner.items()}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        fwd = self.layer.init_params(k1, dtype)
+        bwd = self.layer.init_params(k2, dtype)
+        return {f"f_{k}": v for k, v in fwd.items()} | {f"b_{k}": v for k, v in bwd.items()}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (self.layer.init_carry(batch, dtype), self.layer.init_carry(batch, dtype))
+
+    @staticmethod
+    def _reverse_masked(x, mask):
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        # reverse only the valid prefix per example (DL4J ReverseOp w/ mask):
+        lengths = jnp.sum(mask.astype(jnp.int32), axis=1)  # [N]
+        t = x.shape[1]
+        idx = jnp.arange(t)[None, :]
+        rev_idx = jnp.where(idx < lengths[:, None], lengths[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(x, rev_idx[:, :, None], axis=1)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        fwd_p = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
+        bwd_p = {k[2:]: v for k, v in params.items() if k.startswith("b_")}
+        c_f, c_b = carry if carry is not None else (None, None)
+        y_f, cf = self.layer.forward_seq(fwd_p, x, carry=c_f, mask=mask, train=train, rng=rng)
+        x_rev = self._reverse_masked(x, mask)
+        y_b, cb = self.layer.forward_seq(bwd_p, x_rev, carry=c_b, mask=mask, train=train, rng=rng)
+        y_b = self._reverse_masked(y_b, mask)
+        m = self.mode.lower()
+        if m == "concat":
+            y = jnp.concatenate([y_f, y_b], axis=-1)
+        elif m == "add":
+            y = y_f + y_b
+        elif m == "mul":
+            y = y_f * y_b
+        elif m == "average":
+            y = 0.5 * (y_f + y_b)
+        else:
+            raise ValueError(self.mode)
+        return y, (cf, cb)
+
+
+@register_layer
+@dataclasses.dataclass
+class GravesBidirectionalLSTMLayer(BidirectionalWrapper):
+    """DL4J GravesBidirectionalLSTM = Bidirectional(GravesLSTM, CONCAT) with
+    ADD combining in the original; reference default combines via CONCAT in
+    new API. We expose n_in/n_out directly for config parity."""
+
+    n_in: int = 0
+    n_out: int = 0
+    forget_gate_bias_init: float = 1.0
+
+    def __post_init__(self):
+        if self.layer is None:
+            self.layer = GravesLSTMLayer(n_in=self.n_in, n_out=self.n_out,
+                                         forget_gate_bias_init=self.forget_gate_bias_init,
+                                         activation=self.activation)
+        if self.mode == "concat":
+            self.mode = "add"  # DL4J GravesBidirectionalLSTM sums directions
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+        self.layer.n_in = self.n_in
+        self.layer.n_out = self.n_out
+
+
+@register_layer
+@dataclasses.dataclass
+class LastTimeStepWrapper(Layer):
+    """Wraps a recurrent layer, emitting only the last (unmasked) step
+    (DL4J ``LastTimeStep``). Not itself a recurrent layer: output is 2-D, so
+    it cannot sit inside a TBPTT chunk chain."""
+
+    layer: Optional[Layer] = None
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.layer.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.layer.output_type(input_type)
+        return InputType.feed_forward(inner.size)
+
+    def apply_global_defaults(self, g):
+        super().apply_global_defaults(g)
+        if self.layer is not None:
+            self.layer.apply_global_defaults(g)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        y, _ = self.layer.forward_seq(params, x, mask=mask, train=train, rng=rng)
+        if mask is None:
+            out = y[:, -1, :]
+        else:
+            lengths = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1), 1)
+            out = jnp.take_along_axis(y, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+        return out, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class MaskZeroLayer(BaseRecurrentLayer, Layer):
+    """Sets time steps equal to ``mask_value`` in the input to zero activations
+    by constructing a mask (DL4J MaskZeroLayer wrapper)."""
+
+    layer: Optional[Layer] = None
+    mask_value: float = 0.0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.layer.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.layer.output_type(input_type)
+
+    def apply_global_defaults(self, g):
+        super().apply_global_defaults(g)
+        if self.layer is not None:
+            self.layer.apply_global_defaults(g)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return self.layer.init_params(rng, dtype)
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return self.layer.init_carry(batch, dtype)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        derived = jnp.any(x != self.mask_value, axis=-1).astype(x.dtype)  # [N,T]
+        if mask is not None:
+            derived = derived * mask.astype(x.dtype)
+        return self.layer.forward_seq(params, x, carry=carry, mask=derived,
+                                      train=train, rng=rng)

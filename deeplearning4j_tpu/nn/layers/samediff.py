@@ -1,0 +1,101 @@
+"""SameDiff-style custom layer SPI.
+
+Reference: ``nn/conf/layers/samediff/AbstractSameDiffLayer.java`` /
+``BaseSameDiffLayer.java`` and impl ``nn/layers/samediff/SameDiffLayer.java:19``
+(``defineLayer:209``) — users declare params and define the forward graph in
+SameDiff ops; DL4J autodiffs it. The JAX analog is direct: subclass, declare
+``define_parameters``, write ``define_layer`` in jnp — ``jax.grad`` supplies
+the backward pass, jit the whole network as usual.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class SameDiffLayer(Layer):
+    """Subclass and override ``define_parameters`` + ``define_layer``.
+
+    Example::
+
+        @register_layer
+        @dataclasses.dataclass
+        class MyLayer(SameDiffLayer):
+            n_in: int = 0
+            n_out: int = 0
+            def define_parameters(self):
+                return {"W": (self.n_in, self.n_out), "b": (self.n_out,)}
+            def define_layer(self, params, x):
+                return jnp.tanh(x @ params["W"] + params["b"])
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.flat_size()
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if self.n_out:
+            return InputType.feed_forward(self.n_out)
+        return input_type
+
+    # -- SPI ----------------------------------------------------------------
+    def define_parameters(self) -> Dict[str, Tuple[int, ...]]:
+        return {}
+
+    def define_layer(self, params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    # -- plumbing -----------------------------------------------------------
+    def param_shapes(self):
+        return self.define_parameters()
+
+    def init_params(self, rng, dtype=jnp.float32):
+        shapes = self.define_parameters()
+        if not shapes:
+            return {}
+        keys = jax.random.split(rng, len(shapes))
+        out = {}
+        for (name, shape), k in zip(shapes.items(), keys):
+            if name == "b" or (len(shape) == 1 and name.endswith("b")):
+                out[name] = jnp.zeros(shape, dtype)
+            else:
+                fan_in = shape[0] if len(shape) >= 1 else 1
+                fan_out = shape[-1] if len(shape) >= 2 else shape[0]
+                out[name] = self._init_w(k, shape, fan_in, fan_out, dtype)
+        return out
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        return self.define_layer(params, x), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class SameDiffLambdaLayer(SameDiffLayer):
+    """Parameterless lambda layer (DL4J SameDiffLambdaLayer): wraps a pure
+    function of the input. Not JSON-serializable unless the fn is re-attached
+    after deserialization."""
+
+    fn: Optional[Callable[[jax.Array], jax.Array]] = None
+
+    def define_parameters(self):
+        return {}
+
+    def define_layer(self, params, x):
+        return self.fn(x)
+
+    def to_dict(self):
+        d = super().to_dict()
+        d.pop("fn", None)
+        return d

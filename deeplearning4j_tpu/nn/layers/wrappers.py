@@ -1,0 +1,59 @@
+"""Layer wrappers: FrozenLayer.
+
+Reference: ``nn/conf/layers/misc/FrozenLayer.java`` — wraps a layer so its
+params are excluded from training (used by TransferLearning). Implemented
+with ``lax.stop_gradient`` on the wrapped params: gradients are exactly zero,
+and the updater never moves them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    layer: Optional[Layer] = None
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.layer.set_n_in(input_type)
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return self.layer.output_type(input_type)
+
+    def input_preprocessor(self, input_type: InputType):
+        return self.layer.input_preprocessor(input_type)
+
+    def apply_global_defaults(self, g):
+        # frozen layers do NOT inherit training hyperparams; the inner layer
+        # keeps whatever it was configured with
+        if self.layer is not None:
+            self.layer.apply_global_defaults(g)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=None):
+        import jax.numpy as jnp
+        return self.layer.init_params(rng, dtype or jnp.float32)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def has_loss(self):
+        return self.layer.has_loss()
+
+    def compute_loss(self, params, x, labels, mask=None):
+        return self.layer.compute_loss(lax.stop_gradient(params), x, labels, mask)
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
+        return self.layer.forward(frozen, x, state=state, train=train, rng=rng, mask=mask)

@@ -1,0 +1,442 @@
+"""MultiLayerNetwork — sequential model with DL4J's training API, TPU-native.
+
+Reference: ``nn/multilayer/MultiLayerNetwork.java`` (3.5k LoC): ``init():549``
+(flattened param buffer), ``fit(DataSetIterator):1262``, ``output:2006``,
+``rnnTimeStep:2800``, ``evaluate:2979``, TBPTT dispatch ``:1309``.
+
+TPU design: params are a pytree (list of per-layer dicts); the whole train
+step — forward, loss, ``jax.grad`` backward, gradient normalization, l1/l2,
+updater, param update — is ONE jitted function with donated buffers, so XLA
+fuses it and params never leave HBM. There is no Solver/ConvexOptimizer object
+tree; the optimizer loop IS the compiled function (the reference's
+StochasticGradientDescent.optimize():58-98 collapses into it). TBPTT runs the
+jitted chunk step in a host loop carrying stopped-gradient RNN state.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.updaters import (
+    Sgd,
+    Updater,
+    normalize_gradients,
+    schedule_value,
+)
+
+Array = jax.Array
+Params = List[Dict[str, Array]]
+States = List[Dict[str, Array]]
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, (np.ndarray, list, tuple)) or np.isscalar(x):
+        x = jnp.asarray(x)
+    if dtype is not None and x.dtype != dtype and jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(dtype)
+    return x
+
+
+class MultiLayerNetwork:
+    """Sequential network over a MultiLayerConfiguration."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        conf.finalize()
+        self.conf = conf
+        self.layers: List[Layer] = conf.layers
+        self.params: Optional[Params] = None
+        self.states: Optional[States] = None
+        self.updater_states: Optional[List[Dict[str, Dict[str, Array]]]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self.score_: float = float("nan")
+        self._rng_key: Optional[jax.Array] = None
+        self._jit_cache: Dict[Any, Any] = {}
+        self._rnn_carries: Optional[List[Any]] = None
+        # resolve per-layer / per-param updaters once
+        self._updaters: List[Dict[str, Updater]] = []
+
+    # ------------------------------------------------------------------ init
+    def init(self, seed: Optional[int] = None) -> "MultiLayerNetwork":
+        g = self.conf.global_conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng_key = jax.random.fold_in(key, 0x5EED)
+        dtype = g.jnp_dtype()
+        keys = jax.random.split(key, len(self.layers))
+        self.params = [l.init_params(k, dtype) for l, k in zip(self.layers, keys)]
+        self.states = [l.init_state() for l in self.layers]
+        default_updater = g.updater or Sgd(0.1)
+        self._updaters = []
+        self.updater_states = []
+        for l, p in zip(self.layers, self.params):
+            layer_upd = l.updater or default_updater
+            bias_upd = l.bias_updater or g.bias_updater or layer_upd
+            umap, smap = {}, {}
+            for n, v in p.items():
+                u = bias_upd if n == "b" else layer_upd
+                umap[n] = u
+                smap[n] = u.init_state(v)
+            self._updaters.append(umap)
+            self.updater_states.append(smap)
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    def _next_rng(self) -> jax.Array:
+        self._rng_key, k = jax.random.split(self._rng_key)
+        return k
+
+    # ------------------------------------------------------------- forward
+    def _forward_all(self, params: Params, states: States, x: Array, *,
+                     train: bool, rng: Optional[jax.Array], mask: Optional[Array],
+                     carries: Optional[List[Any]] = None, upto: Optional[int] = None,
+                     ) -> Tuple[Array, States, Optional[List[Any]]]:
+        """Run layers [0, upto); returns (activation, new_states, new_carries)."""
+        n_layers = len(self.layers) if upto is None else upto
+        h = x
+        new_states: States = []
+        new_carries: List[Any] = []
+        rngs = (jax.random.split(rng, len(self.layers)) if rng is not None
+                else [None] * len(self.layers))
+        cur_mask = mask
+        for i in range(len(self.layers)):
+            if i >= n_layers:
+                new_states.append(states[i])
+                new_carries.append(None if carries is None else carries[i])
+                continue
+            layer = self.layers[i]
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i](h)
+            if carries is not None and isinstance(layer, BaseRecurrentLayer):
+                y, c = layer.forward_seq(params[i], h, carry=carries[i], mask=cur_mask,
+                                         train=train, rng=rngs[i])
+                new_states.append(states[i])
+                new_carries.append(c)
+                h = y
+            else:
+                h, st = layer.forward(params[i], h, state=states[i], train=train,
+                                      rng=rngs[i], mask=cur_mask)
+                new_states.append(st if st else states[i])
+                new_carries.append(None)
+            # feed-forward layers collapse per-timestep masks only when the
+            # time dimension disappears
+            if cur_mask is not None and h.ndim == 2 and cur_mask.ndim == 2:
+                cur_mask = None
+        return h, new_states, new_carries
+
+    def _regularization(self, params: Params) -> Array:
+        reg = jnp.asarray(0.0, jnp.float32)
+        for l, p in zip(self.layers, params):
+            for n, v in p.items():
+                is_bias = n == "b"
+                l1 = (l.l1_bias if is_bias else l.l1) or 0.0
+                l2 = (l.l2_bias if is_bias else l.l2) or 0.0
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(v))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(v * v)
+        return reg
+
+    def _loss_fn(self, params: Params, states: States, x, y, rng,
+                 mask, label_mask, train: bool,
+                 carries: Optional[List[Any]] = None):
+        out_layer = self.layers[-1]
+        if not out_layer.has_loss():
+            raise ValueError("Last layer must be an output/loss layer for fit()")
+        h, new_states, new_carries = self._forward_all(
+            params, states, x, train=train, rng=rng, mask=mask, carries=carries,
+            upto=len(self.layers) - 1)
+        if (len(self.layers) - 1) in self.conf.preprocessors:
+            h = self.conf.preprocessors[len(self.layers) - 1](h)
+        lm = label_mask if label_mask is not None else (mask if h.ndim == 3 else None)
+        loss = out_layer.compute_loss(params[-1], h, y, mask=lm)
+        loss = loss + self._regularization(params)
+        return loss, (new_states, new_carries)
+
+    # ------------------------------------------------------------ train step
+    def _apply_updates(self, params, grads, upd_states, it, ep):
+        new_params, new_upd = [], []
+        for i, l in enumerate(self.layers):
+            g_layer = grads[i]
+            if l.gradient_normalization:
+                g_layer = normalize_gradients(g_layer, l.gradient_normalization,
+                                              l.gradient_normalization_threshold)
+            p_new, s_new = {}, {}
+            for n, g in g_layer.items():
+                u = self._updaters[i][n]
+                lr = u.lr_at(it, ep)
+                t = it + 1.0  # 1-based step count for Adam-family bias correction
+                upd, s = u.update(g, upd_states[i][n], lr, t)
+                p_new[n] = params[i][n] - upd.astype(params[i][n].dtype)
+                s_new[n] = s
+            new_params.append(p_new)
+            new_upd.append(s_new)
+        return new_params, new_upd
+
+    def _build_train_step(self, tbptt: bool):
+        def step(params, states, upd_states, it, ep, x, y, mask, label_mask, rng, carries):
+            def lf(p):
+                return self._loss_fn(p, states, x, y, rng, mask, label_mask,
+                                     train=True, carries=carries if tbptt else None)
+            (loss, (new_states, new_carries)), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
+            if tbptt:
+                new_carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
+            return new_params, new_states, new_upd, loss, new_carries
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _get_train_step(self, tbptt: bool):
+        key = ("train", tbptt)
+        if key not in self._jit_cache:
+            self._jit_cache[key] = self._build_train_step(tbptt)
+        return self._jit_cache[key]
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            features_mask=None, labels_mask=None) -> "MultiLayerNetwork":
+        """Train. ``data`` is (x, y) arrays, a DataSet, or a DataSetIterator."""
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.datasets.dataset import DataSet  # local import, no cycle
+
+        if labels is not None:
+            iterator = [DataSet(data, labels, features_mask, labels_mask)]
+        elif isinstance(data, DataSet):
+            iterator = [data]
+        else:
+            iterator = data  # assume iterable of DataSet
+
+        for ep in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            epoch_iter = iterator
+            if hasattr(epoch_iter, "reset"):
+                epoch_iter.reset()
+            for ds in epoch_iter:
+                self._fit_batch(ds)
+            self.epoch += 1
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+        return self
+
+    def _fit_batch(self, ds) -> None:
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(ds.features, dtype)
+        y = _as_jnp(ds.labels, dtype)
+        mask = None if ds.features_mask is None else _as_jnp(ds.features_mask)
+        lmask = None if ds.labels_mask is None else _as_jnp(ds.labels_mask)
+
+        if self.conf.backprop_type == "truncated_bptt" and x.ndim == 3:
+            self._fit_tbptt(x, y, mask, lmask)
+            return
+
+        step = self._get_train_step(False)
+        rng = self._next_rng()
+        it = jnp.asarray(self.iteration, jnp.float32)
+        ep = jnp.asarray(self.epoch, jnp.float32)
+        self.params, self.states, self.updater_states, loss, _ = step(
+            self.params, self.states, self.updater_states, it, ep,
+            x, y, mask, lmask, rng, None)
+        self.score_ = float(loss)
+        self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "iteration_done"):
+                listener.iteration_done(self, self.iteration, self.epoch)
+
+    def _fit_tbptt(self, x, y, mask, lmask) -> None:
+        """Truncated BPTT (MultiLayerNetwork.doTruncatedBPTT:1309 parity):
+        process the sequence in chunks of tbptt_fwd_length, carrying RNN state
+        (stop-gradient) between chunks."""
+        t_total = x.shape[1]
+        length = self.conf.tbptt_fwd_length
+        n_chunks = max(1, math.ceil(t_total / length))
+        batch = x.shape[0]
+        dtype = x.dtype
+        carries = [l.init_carry(batch, dtype) if isinstance(l, BaseRecurrentLayer) else None
+                   for l in self.layers]
+        for c in range(n_chunks):
+            s, e = c * length, min((c + 1) * length, t_total)
+            xc = x[:, s:e]
+            yc = y[:, s:e] if y.ndim == 3 else y
+            mc = None if mask is None else mask[:, s:e]
+            lc = None if lmask is None else lmask[:, s:e]
+            step = self._get_train_step(True)
+            rng = self._next_rng()
+            it = jnp.asarray(self.iteration, jnp.float32)
+            ep = jnp.asarray(self.epoch, jnp.float32)
+            self.params, self.states, self.updater_states, loss, carries = step(
+                self.params, self.states, self.updater_states, it, ep,
+                xc, yc, mc, lc, rng, carries)
+            self.score_ = float(loss)
+            self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "iteration_done"):
+                listener.iteration_done(self, self.iteration, self.epoch)
+
+    # ------------------------------------------------------------- inference
+    def _output_fn(self):
+        # one jitted callable; jax.jit itself specializes per input shape
+        if "out" not in self._jit_cache:
+            def out_fn(params, states, x, mask):
+                h, _, _ = self._forward_all(params, states, x, train=False,
+                                            rng=None, mask=mask)
+                return h
+            self._jit_cache["out"] = jax.jit(out_fn)
+        return self._jit_cache["out"]
+
+    def output(self, x, mask=None) -> Array:
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(x, dtype)
+        mask = None if mask is None else _as_jnp(mask)
+        return self._output_fn()(self.params, self.states, x, mask)
+
+    def feed_forward(self, x, train: bool = False) -> List[Array]:
+        """Per-layer activations (MultiLayerNetwork.feedForward parity)."""
+        dtype = self.conf.global_conf.jnp_dtype()
+        h = _as_jnp(x, dtype)
+        acts = [h]
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i](h)
+            h, _ = layer.forward(self.params[i], h, state=self.states[i],
+                                 train=train, rng=None)
+            acts.append(h)
+        return acts
+
+    def predict(self, x) -> np.ndarray:
+        out = self.output(x)
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return self.score_
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(ds.features, dtype)
+        y = _as_jnp(ds.labels, dtype)
+        mask = None if ds.features_mask is None else _as_jnp(ds.features_mask)
+        lmask = None if ds.labels_mask is None else _as_jnp(ds.labels_mask)
+        loss, _ = self._loss_fn(self.params, self.states, x, y, None, mask, lmask,
+                                train=False)
+        return float(loss)
+
+    def compute_gradient_and_score(self, x, y, features_mask=None, labels_mask=None):
+        """Returns (gradients pytree, score) without updating params —
+        the hook used by gradient checks (GradientCheckUtil parity)."""
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(x, dtype)
+        y = _as_jnp(y, dtype)
+
+        def lf(p):
+            return self._loss_fn(p, self.states, x, y, None,
+                                 features_mask, labels_mask, train=False)
+
+        (loss, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
+        return grads, float(loss)
+
+    # ------------------------------------------------------ stateful RNN API
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
+
+    def rnn_time_step(self, x) -> Array:
+        """Stateful single/multi-step inference (rnnTimeStep:2800 parity).
+        x: [N, T, C] (or [N, C] for one step)."""
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(x, dtype)
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[:, None, :]
+        if self._rnn_carries is None:
+            batch = x.shape[0]
+            self._rnn_carries = [
+                l.init_carry(batch, dtype) if isinstance(l, BaseRecurrentLayer) else None
+                for l in self.layers]
+        h, _, self._rnn_carries = self._forward_all(
+            self.params, self.states, x, train=False, rng=None, mask=None,
+            carries=self._rnn_carries)
+        return h[:, -1, :] if squeeze and h.ndim == 3 else h
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, iterator) -> "Evaluation":
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features, mask=None if ds.features_mask is None
+                              else _as_jnp(ds.features_mask))
+            e.eval(np.asarray(ds.labels), np.asarray(out),
+                   mask=None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+        return e
+
+    def evaluate_regression(self, iterator) -> "RegressionEvaluation":
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        e = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(np.asarray(ds.labels), np.asarray(out))
+        return e
+
+    # -------------------------------------------------------------- listeners
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners) -> None:
+        self.listeners.extend(listeners)
+
+    # ------------------------------------------------------------------ misc
+    def num_params(self) -> int:
+        if self.params is None:
+            return self.conf.num_params()
+        total = 0
+        for p in self.params:
+            for v in p.values():
+                total += v.size
+        return total
+
+    def params_flat(self) -> np.ndarray:
+        """Single flattened param vector (DL4J params() parity)."""
+        leaves = []
+        for p in self.params:
+            for n in sorted(p):
+                leaves.append(np.asarray(p[n]).ravel())
+        return np.concatenate(leaves) if leaves else np.zeros(0)
+
+    def set_params_flat(self, flat: np.ndarray) -> None:
+        offset = 0
+        new_params = []
+        for p in self.params:
+            d = {}
+            for n in sorted(p):
+                size = p[n].size
+                d[n] = jnp.asarray(flat[offset:offset + size].reshape(p[n].shape),
+                                   p[n].dtype)
+                offset += size
+            new_params.append(d)
+        self.params = new_params
+
+    def clone(self) -> "MultiLayerNetwork":
+        import copy
+        other = MultiLayerNetwork(self.conf)
+        other.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        other.states = jax.tree_util.tree_map(lambda a: a, self.states)
+        other.updater_states = jax.tree_util.tree_map(lambda a: a, self.updater_states)
+        other._updaters = self._updaters
+        other.iteration = self.iteration
+        other.epoch = self.epoch
+        other._rng_key = self._rng_key
+        return other

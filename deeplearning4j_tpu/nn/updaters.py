@@ -1,0 +1,412 @@
+"""Updaters (optimizers) and learning-rate schedules with ND4J semantics.
+
+Reference: ND4J ``org.nd4j.linalg.learning.config`` (Sgd, Adam, AdaMax,
+AdaDelta, AdaGrad, Nadam, Nesterovs, NoOp, RmsProp, AMSGrad) applied by DL4J's
+``UpdaterBlock.update`` (``nn/updater/UpdaterBlock.java:105``). DL4J keeps
+updater state in one flattened view array; here state is a pytree mirroring the
+param pytree — functionally identical, and XLA fuses the elementwise update
+chain into a single kernel either way.
+
+Convention: ``apply_updater`` returns the *update to subtract* from params
+(DL4J's step function performs ``params -= update``). Each updater is a frozen
+dataclass (hashable → safe as a jit static argument); state is a dict of
+arrays. The iteration/epoch counters arrive as traced scalars so jit never
+recompiles across steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Schedules (ND4J ISchedule: Fixed/Exponential/Inverse/Poly/Sigmoid/Step/Map)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """Base class; subclasses implement value(iteration, epoch)."""
+
+    schedule_type: str = "iteration"  # "iteration" | "epoch"
+
+    def _t(self, iteration, epoch):
+        return epoch if self.schedule_type == "epoch" else iteration
+
+    def value(self, iteration, epoch):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["@schedule"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Schedule":
+        d = dict(d)
+        cls = _SCHEDULES[d.pop("@schedule")]
+        if cls is MapSchedule and "values" in d and isinstance(d["values"], dict):
+            d["values"] = tuple(sorted((int(k), float(v)) for k, v in d["values"].items()))
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedSchedule(Schedule):
+    value_: float = 0.001
+
+    def value(self, iteration, epoch):
+        return self.value_
+
+
+@dataclasses.dataclass(frozen=True)
+class ExponentialSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.99
+
+    def value(self, iteration, epoch):
+        return self.initial_value * self.gamma ** self._t(iteration, epoch)
+
+
+@dataclasses.dataclass(frozen=True)
+class InverseSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.99
+    power: float = 1.0
+
+    def value(self, iteration, epoch):
+        return self.initial_value / (1.0 + self.gamma * self._t(iteration, epoch)) ** self.power
+
+
+@dataclasses.dataclass(frozen=True)
+class PolySchedule(Schedule):
+    initial_value: float = 0.001
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def value(self, iteration, epoch):
+        frac = jnp.minimum(self._t(iteration, epoch) / self.max_iter, 1.0)
+        return self.initial_value * (1.0 - frac) ** self.power
+
+
+@dataclasses.dataclass(frozen=True)
+class SigmoidSchedule(Schedule):
+    initial_value: float = 0.001
+    gamma: float = 0.99
+    step_size: int = 100
+
+    def value(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initial_value / (1.0 + jnp.exp(-self.gamma * (t - self.step_size)))
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSchedule(Schedule):
+    initial_value: float = 0.001
+    decay_rate: float = 0.1
+    step: float = 100.0
+
+    def value(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        return self.initial_value * self.decay_rate ** jnp.floor(t / self.step)
+
+
+@dataclasses.dataclass(frozen=True)
+class MapSchedule(Schedule):
+    """Piecewise-constant schedule from {iteration_or_epoch: value}.
+
+    ``values`` is a tuple of (threshold, value) pairs sorted by threshold;
+    entry 0 must have threshold 0.
+    """
+
+    values: tuple = ((0, 0.001),)
+
+    def value(self, iteration, epoch):
+        t = self._t(iteration, epoch)
+        out = jnp.asarray(self.values[0][1], jnp.float32)
+        for thresh, val in self.values[1:]:
+            out = jnp.where(t >= thresh, jnp.asarray(val, jnp.float32), out)
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class WarmupCosineSchedule(Schedule):
+    """TPU-era extra (not in ND4J): linear warmup then cosine decay."""
+
+    peak_value: float = 0.001
+    warmup_steps: int = 100
+    total_steps: int = 10000
+    final_value: float = 0.0
+
+    def value(self, iteration, epoch):
+        t = jnp.asarray(self._t(iteration, epoch), jnp.float32)
+        warm = self.peak_value * t / max(self.warmup_steps, 1)
+        prog = jnp.clip((t - self.warmup_steps) / max(self.total_steps - self.warmup_steps, 1), 0.0, 1.0)
+        cos = self.final_value + 0.5 * (self.peak_value - self.final_value) * (1 + jnp.cos(jnp.pi * prog))
+        return jnp.where(t < self.warmup_steps, warm, cos)
+
+
+_SCHEDULES = {
+    c.__name__: c
+    for c in [FixedSchedule, ExponentialSchedule, InverseSchedule, PolySchedule,
+              SigmoidSchedule, StepSchedule, MapSchedule, WarmupCosineSchedule]
+}
+
+
+def schedule_value(lr: Union[float, Schedule], iteration, epoch):
+    if isinstance(lr, Schedule):
+        return lr.value(iteration, epoch)
+    return lr
+
+
+# ---------------------------------------------------------------------------
+# Updaters
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """Base updater config. ``learning_rate`` may be a float or a Schedule."""
+
+    learning_rate: Union[float, Schedule] = 0.001
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, param: Array) -> Dict[str, Array]:
+        return {}
+
+    # -- update (returns value to SUBTRACT from param) --------------------
+    def update(self, grad: Array, state: Dict[str, Array], lr, t):
+        raise NotImplementedError
+
+    def lr_at(self, iteration, epoch):
+        return schedule_value(self.learning_rate, iteration, epoch)
+
+    def to_dict(self) -> dict:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            d[f.name] = v.to_dict() if isinstance(v, Schedule) else v
+        d["@updater"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "Updater":
+        d = dict(d)
+        cls = _UPDATERS[d.pop("@updater")]
+        if isinstance(d.get("learning_rate"), dict):
+            d["learning_rate"] = Schedule.from_dict(d["learning_rate"])
+        return cls(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sgd(Updater):
+    learning_rate: Union[float, Schedule] = 0.1
+
+    def update(self, grad, state, lr, t):
+        return lr * grad, state
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOp(Updater):
+    learning_rate: Union[float, Schedule] = 0.0
+
+    def update(self, grad, state, lr, t):
+        return jnp.zeros_like(grad), state
+
+
+@dataclasses.dataclass(frozen=True)
+class Nesterovs(Updater):
+    """Nesterov momentum, DL4J formulation (NesterovsUpdater):
+    v' = mu*v - lr*g;  x += -mu*v + (1+mu)*v'  (we return the negation)."""
+
+    learning_rate: Union[float, Schedule] = 0.1
+    momentum: float = 0.9
+
+    def init_state(self, param):
+        return {"v": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        v_prev = state["v"]
+        v = self.momentum * v_prev - lr * grad
+        update = -(-self.momentum * v_prev + (1.0 + self.momentum) * v)
+        return update, {"v": v}
+
+
+@dataclasses.dataclass(frozen=True)
+class Adam(Updater):
+    learning_rate: Union[float, Schedule] = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        alpha = lr * jnp.sqrt(1 - self.beta2**t) / (1 - self.beta1**t)
+        return alpha * m / (jnp.sqrt(v) + self.epsilon), {"m": m, "v": v}
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaMax(Updater):
+    learning_rate: Union[float, Schedule] = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "u": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        u = jnp.maximum(self.beta2 * state["u"], jnp.abs(grad))
+        alpha = lr / (1 - self.beta1**t)
+        return alpha * m / (u + self.epsilon), {"m": m, "u": u}
+
+
+@dataclasses.dataclass(frozen=True)
+class Nadam(Updater):
+    learning_rate: Union[float, Schedule] = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        m_hat = m / (1 - self.beta1**t)
+        v_hat = v / (1 - self.beta2**t)
+        m_bar = self.beta1 * m_hat + (1 - self.beta1) * grad / (1 - self.beta1**t)
+        return lr * m_bar / (jnp.sqrt(v_hat) + self.epsilon), {"m": m, "v": v}
+
+
+@dataclasses.dataclass(frozen=True)
+class AMSGrad(Updater):
+    learning_rate: Union[float, Schedule] = 0.001
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {"m": jnp.zeros_like(param), "v": jnp.zeros_like(param),
+                "v_hat": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        m = self.beta1 * state["m"] + (1 - self.beta1) * grad
+        v = self.beta2 * state["v"] + (1 - self.beta2) * grad * grad
+        v_hat = jnp.maximum(state["v_hat"], v)
+        alpha = lr * jnp.sqrt(1 - self.beta2**t) / (1 - self.beta1**t)
+        return alpha * m / (jnp.sqrt(v_hat) + self.epsilon), {"m": m, "v": v, "v_hat": v_hat}
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaGrad(Updater):
+    learning_rate: Union[float, Schedule] = 0.01
+    epsilon: float = 1e-6
+
+    def init_state(self, param):
+        return {"h": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        h = state["h"] + grad * grad
+        return lr * grad / (jnp.sqrt(h) + self.epsilon), {"h": h}
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaDelta(Updater):
+    """No learning rate — DL4J AdaDeltaUpdater semantics."""
+
+    learning_rate: Union[float, Schedule] = 0.0  # unused
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def init_state(self, param):
+        return {"eg2": jnp.zeros_like(param), "edx2": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        eg2 = self.rho * state["eg2"] + (1 - self.rho) * grad * grad
+        dx = grad * jnp.sqrt(state["edx2"] + self.epsilon) / jnp.sqrt(eg2 + self.epsilon)
+        edx2 = self.rho * state["edx2"] + (1 - self.rho) * dx * dx
+        return dx, {"eg2": eg2, "edx2": edx2}
+
+
+@dataclasses.dataclass(frozen=True)
+class RmsProp(Updater):
+    learning_rate: Union[float, Schedule] = 0.001
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init_state(self, param):
+        return {"g2": jnp.zeros_like(param)}
+
+    def update(self, grad, state, lr, t):
+        g2 = self.rms_decay * state["g2"] + (1 - self.rms_decay) * grad * grad
+        return lr * grad / jnp.sqrt(g2 + self.epsilon), {"g2": g2}
+
+
+_UPDATERS = {
+    c.__name__: c
+    for c in [Sgd, NoOp, Nesterovs, Adam, AdaMax, Nadam, AMSGrad, AdaGrad,
+              AdaDelta, RmsProp]
+}
+
+
+def resolve_updater(spec: Union[str, Updater, dict, None]) -> Updater:
+    if spec is None:
+        return Sgd()
+    if isinstance(spec, Updater):
+        return spec
+    if isinstance(spec, dict):
+        return Updater.from_dict(spec)
+    key = spec.lower()
+    aliases = {"sgd": Sgd, "adam": Adam, "adamax": AdaMax, "nadam": Nadam,
+               "amsgrad": AMSGrad, "adagrad": AdaGrad, "adadelta": AdaDelta,
+               "rmsprop": RmsProp, "nesterovs": Nesterovs, "noop": NoOp,
+               "none": NoOp}
+    if key not in aliases:
+        raise ValueError(f"Unknown updater {spec!r}")
+    return aliases[key]()
+
+
+# ---------------------------------------------------------------------------
+# Gradient normalization (DL4J GradientNormalization enum)
+# ---------------------------------------------------------------------------
+
+def normalize_gradients(grads: Dict[str, Array], mode: Optional[str],
+                        threshold: float = 1.0) -> Dict[str, Array]:
+    """Apply DL4J GradientNormalization to one layer's gradient dict.
+
+    Modes: None | "renormalize_l2_per_layer" | "renormalize_l2_per_param_type"
+    | "clip_elementwise_absolute_value" | "clip_l2_per_layer"
+    | "clip_l2_per_param_type".
+    """
+    if not mode or mode == "none":
+        return grads
+    mode = mode.lower()
+    if mode == "renormalize_l2_per_param_type":
+        return {k: g / jnp.maximum(jnp.linalg.norm(g.ravel()), 1e-8) for k, g in grads.items()}
+    if mode == "clip_elementwise_absolute_value":
+        return {k: jnp.clip(g, -threshold, threshold) for k, g in grads.items()}
+    if mode == "clip_l2_per_param_type":
+        out = {}
+        for k, g in grads.items():
+            n = jnp.linalg.norm(g.ravel())
+            out[k] = jnp.where(n > threshold, g * (threshold / jnp.maximum(n, 1e-8)), g)
+        return out
+    # layer-wide modes need the joint norm
+    leaves = [g.ravel() for g in grads.values()]
+    norm = jnp.sqrt(sum(jnp.sum(l * l) for l in leaves))
+    if mode == "renormalize_l2_per_layer":
+        return {k: g / jnp.maximum(norm, 1e-8) for k, g in grads.items()}
+    if mode == "clip_l2_per_layer":
+        scale = jnp.where(norm > threshold, threshold / jnp.maximum(norm, 1e-8), 1.0)
+        return {k: g * scale for k, g in grads.items()}
+    raise ValueError(f"Unknown gradient normalization mode {mode!r}")

@@ -1,0 +1,167 @@
+"""Weight initialization — all 21 DL4J ``WeightInit`` schemes.
+
+Reference: ``deeplearning4j-nn/.../nn/weights/WeightInit.java:68`` and the
+variance formulas in ``WeightInitUtil.java``. Fan-in/fan-out are computed from
+the layer geometry exactly as DL4J's param initializers do (for conv layers,
+fan_in = in_channels * prod(kernel), fan_out = out_channels * prod(kernel)).
+
+Each scheme is ``init(key, shape, fan_in, fan_out, dtype) -> Array``; the
+``DISTRIBUTION`` scheme takes a ``Distribution`` spec object.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class Distribution:
+    """User-specified distribution for WeightInit.DISTRIBUTION.
+
+    kind: "normal" (mean, std) | "uniform" (lower, upper) |
+          "truncated_normal" (mean, std) | "log_normal" (mean, std) |
+          "orthogonal" (gain) | "constant" (value) | "binomial" (n, p)
+    """
+
+    kind: str = "normal"
+    mean: float = 0.0
+    std: float = 1.0
+    lower: float = -1.0
+    upper: float = 1.0
+    gain: float = 1.0
+    value: float = 0.0
+    n: int = 1
+    p: float = 0.5
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "Distribution":
+        return Distribution(**d)
+
+
+def _normal(key, shape, std, dtype):
+    return jax.random.normal(key, shape, dtype) * jnp.asarray(std, dtype)
+
+
+def _uniform(key, shape, bound, dtype):
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def _truncated_normal(key, shape, std, dtype):
+    # truncation at ±2 std, matching jax.nn.initializers.variance_scaling
+    stddev = std / 0.87962566103423978
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * jnp.asarray(stddev, dtype)
+
+
+def _identity_matrix(shape, dtype):
+    if len(shape) != 2 or shape[0] != shape[1]:
+        raise ValueError(f"IDENTITY weight init requires a square 2-D shape, got {shape}")
+    return jnp.eye(shape[0], dtype=dtype)
+
+
+def _orthogonal(key, shape, gain, dtype):
+    if len(shape) < 2:
+        raise ValueError("orthogonal init needs >=2 dims")
+    rows, cols = shape[0], int(math.prod(shape[1:]))
+    n = max(rows, cols)
+    a = jax.random.normal(key, (n, n), dtype)
+    q, r = jnp.linalg.qr(a)
+    q = q * jnp.sign(jnp.diagonal(r))
+    return (gain * q[:rows, :cols]).reshape(shape)
+
+
+def init_weight(
+    key: jax.Array,
+    shape: Sequence[int],
+    scheme: str,
+    fan_in: float,
+    fan_out: float,
+    dtype=jnp.float32,
+    distribution: Optional[Union[Distribution, dict]] = None,
+) -> Array:
+    """Initialize a weight tensor per the named DL4J scheme."""
+    shape = tuple(int(s) for s in shape)
+    s = scheme.lower()
+    if s == "zero":
+        return jnp.zeros(shape, dtype)
+    if s == "ones":
+        return jnp.ones(shape, dtype)
+    if s == "identity":
+        return _identity_matrix(shape, dtype)
+    if s == "normal":
+        # DL4J NORMAL: N(0, 1/sqrt(fanIn))
+        return _normal(key, shape, 1.0 / math.sqrt(fan_in), dtype)
+    if s == "uniform":
+        # DL4J UNIFORM: U(-a, a) with a = 1/sqrt(fanIn)
+        return _uniform(key, shape, 1.0 / math.sqrt(fan_in), dtype)
+    if s == "xavier":
+        return _normal(key, shape, math.sqrt(2.0 / (fan_in + fan_out)), dtype)
+    if s == "xavier_uniform":
+        return _uniform(key, shape, math.sqrt(6.0 / (fan_in + fan_out)), dtype)
+    if s == "xavier_fan_in":
+        return _normal(key, shape, math.sqrt(1.0 / fan_in), dtype)
+    if s == "xavier_legacy":
+        return _normal(key, shape, 1.0 / math.sqrt(shape[0] + shape[-1]), dtype)
+    if s == "sigmoid_uniform":
+        return _uniform(key, shape, 4.0 * math.sqrt(6.0 / (fan_in + fan_out)), dtype)
+    if s == "relu":
+        return _normal(key, shape, math.sqrt(2.0 / fan_in), dtype)
+    if s == "relu_uniform":
+        return _uniform(key, shape, math.sqrt(6.0 / fan_in), dtype)
+    if s == "lecun_normal":
+        return _normal(key, shape, math.sqrt(1.0 / fan_in), dtype)
+    if s == "lecun_uniform":
+        return _uniform(key, shape, math.sqrt(3.0 / fan_in), dtype)
+    if s == "var_scaling_normal_fan_in":
+        return _truncated_normal(key, shape, math.sqrt(1.0 / fan_in), dtype)
+    if s == "var_scaling_normal_fan_out":
+        return _truncated_normal(key, shape, math.sqrt(1.0 / fan_out), dtype)
+    if s == "var_scaling_normal_fan_avg":
+        return _truncated_normal(key, shape, math.sqrt(2.0 / (fan_in + fan_out)), dtype)
+    if s == "var_scaling_uniform_fan_in":
+        return _uniform(key, shape, math.sqrt(3.0 / fan_in), dtype)
+    if s == "var_scaling_uniform_fan_out":
+        return _uniform(key, shape, math.sqrt(3.0 / fan_out), dtype)
+    if s == "var_scaling_uniform_fan_avg":
+        return _uniform(key, shape, math.sqrt(6.0 / (fan_in + fan_out)), dtype)
+    if s == "distribution":
+        if distribution is None:
+            raise ValueError("WeightInit DISTRIBUTION requires a Distribution spec")
+        if isinstance(distribution, dict):
+            distribution = Distribution.from_dict(distribution)
+        d = distribution
+        if d.kind == "normal":
+            return d.mean + _normal(key, shape, d.std, dtype)
+        if d.kind == "truncated_normal":
+            return d.mean + _truncated_normal(key, shape, d.std, dtype)
+        if d.kind == "log_normal":
+            return jnp.exp(d.mean + _normal(key, shape, d.std, dtype))
+        if d.kind == "uniform":
+            return jax.random.uniform(key, shape, dtype, minval=d.lower, maxval=d.upper)
+        if d.kind == "orthogonal":
+            return _orthogonal(key, shape, d.gain, dtype)
+        if d.kind == "constant":
+            return jnp.full(shape, d.value, dtype)
+        if d.kind == "binomial":
+            return jax.random.binomial(key, d.n, d.p, shape).astype(dtype)
+        raise ValueError(f"Unknown distribution kind {d.kind!r}")
+    raise ValueError(f"Unknown weight init scheme {scheme!r}")
+
+
+ALL_SCHEMES = [
+    "distribution", "zero", "ones", "sigmoid_uniform", "normal", "lecun_normal",
+    "uniform", "xavier", "xavier_uniform", "xavier_fan_in", "xavier_legacy",
+    "relu", "relu_uniform", "identity", "lecun_uniform",
+    "var_scaling_normal_fan_in", "var_scaling_normal_fan_out",
+    "var_scaling_normal_fan_avg", "var_scaling_uniform_fan_in",
+    "var_scaling_uniform_fan_out", "var_scaling_uniform_fan_avg",
+]

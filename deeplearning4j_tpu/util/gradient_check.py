@@ -1,0 +1,103 @@
+"""Gradient check harness — the correctness backbone.
+
+Reference: ``deeplearning4j-nn/.../gradientcheck/GradientCheckUtil.java:109``
+(central finite differences ``(C(w+ε)−C(w−ε))/2ε`` vs analytic backprop, max
+relative error per parameter). Here "analytic" means ``jax.grad``; the check
+still matters because layer forwards can silently break differentiability
+assumptions (wrong masking, stop_gradients, non-smooth kinks at tested points).
+
+Runs in float64 on CPU for epsilon stability (DL4J requires double precision
+too, GradientCheckUtil doc ``:47``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients_fn(loss_fn: Callable, params, *, epsilon: float = 1e-6,
+                       max_rel_error: float = 1e-5, min_abs_error: float = 1e-8,
+                       print_results: bool = False, subset: Optional[int] = None,
+                       seed: int = 0) -> bool:
+    """Check ``jax.grad(loss_fn)`` against central finite differences.
+
+    loss_fn: params -> scalar. params: arbitrary pytree.
+    subset: if set, check only this many randomly chosen coordinates per
+    parameter (large layers would otherwise need millions of evals).
+    """
+    with jax.enable_x64(True):
+        params64 = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), params)
+        loss_fn = jax.jit(loss_fn)  # compile once; FD loop then runs compiled
+        analytic = jax.grad(loss_fn)(params64)
+
+        leaves, treedef = jax.tree_util.tree_flatten(params64)
+        grad_leaves = jax.tree_util.tree_leaves(analytic)
+        rng = np.random.default_rng(seed)
+        ok = True
+        max_err_seen = 0.0
+        for li, (leaf, g) in enumerate(zip(leaves, grad_leaves)):
+            flat = np.array(leaf, np.float64).ravel()  # writable copy
+            gflat = np.asarray(g, np.float64).ravel()
+            n = flat.size
+            idxs = (rng.choice(n, size=min(subset, n), replace=False)
+                    if subset is not None and subset < n else range(n))
+            for i in idxs:
+                orig = flat[i]
+                flat[i] = orig + epsilon
+                lp = float(loss_fn(jax.tree_util.tree_unflatten(
+                    treedef, _rebuild(leaves, li, flat))))
+                flat[i] = orig - epsilon
+                lm = float(loss_fn(jax.tree_util.tree_unflatten(
+                    treedef, _rebuild(leaves, li, flat))))
+                flat[i] = orig
+                numeric = (lp - lm) / (2 * epsilon)
+                a = gflat[i]
+                abs_err = abs(a - numeric)
+                denom = abs(a) + abs(numeric)
+                rel = abs_err / denom if denom > 0 else 0.0
+                max_err_seen = max(max_err_seen, rel if abs_err > min_abs_error else 0.0)
+                if rel > max_rel_error and abs_err > min_abs_error:
+                    ok = False
+                    if print_results:
+                        print(f"  FAIL leaf {li} idx {i}: analytic={a:.3e} "
+                              f"numeric={numeric:.3e} rel={rel:.3e}")
+        if print_results:
+            print(f"gradient check {'PASSED' if ok else 'FAILED'}; "
+                  f"max rel error (significant): {max_err_seen:.3e}")
+        return ok
+
+
+def _rebuild(leaves, li, flat):
+    new = list(leaves)
+    new[li] = jnp.asarray(flat.reshape(np.asarray(leaves[li]).shape), jnp.float64)
+    return new
+
+
+def check_model_gradients(model, x, y, *, features_mask=None, labels_mask=None,
+                          epsilon: float = 1e-6,
+                          max_rel_error: float = 1e-5, min_abs_error: float = 1e-8,
+                          subset: Optional[int] = 64, seed: int = 0,
+                          print_results: bool = False) -> bool:
+    """GradientCheckUtil.checkGradients equivalent for a MultiLayerNetwork /
+    ComputationGraph-style model exposing ``_loss_fn(params, states, ...)``."""
+    with jax.enable_x64(True):
+        x = jnp.asarray(np.asarray(x), jnp.float64)
+        y = jnp.asarray(np.asarray(y), jnp.float64)
+        fm = None if features_mask is None else jnp.asarray(np.asarray(features_mask), jnp.float64)
+        lm = None if labels_mask is None else jnp.asarray(np.asarray(labels_mask), jnp.float64)
+        states = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.asarray(a), jnp.float64), model.states)
+
+        def loss_fn(params):
+            loss, _ = model._loss_fn(params, states, x, y, None, fm, lm, train=False)
+            return loss
+
+        return check_gradients_fn(loss_fn, model.params, epsilon=epsilon,
+                                  max_rel_error=max_rel_error,
+                                  min_abs_error=min_abs_error, subset=subset,
+                                  seed=seed, print_results=print_results)

@@ -1,0 +1,27 @@
+"""Test configuration: run on CPU with 8 virtual devices.
+
+Must set env vars BEFORE jax is imported anywhere (SURVEY.md test strategy:
+distributed semantics are validated on a virtual device mesh the way the
+reference validates Spark training in local[N] mode).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+# The axon TPU plugin force-sets jax_platforms at import; override back to CPU
+# (tests must run on the virtual 8-device CPU mesh, not the tunnel'd chip).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,282 @@
+"""Gradient checks — port of the reference's gradientcheck suites
+(deeplearning4j-core/src/test/.../gradientcheck/: CNN, BN, LSTM, RNN, masking,
+global pooling, loss functions). Finite differences vs jax.grad in float64.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    AutoEncoderLayer,
+    BatchNormalizationLayer,
+    BidirectionalWrapper,
+    CnnLossLayer,
+    ConvolutionLayer,
+    Deconvolution2DLayer,
+    DenseLayer,
+    DepthwiseConvolution2DLayer,
+    ElementWiseMultiplicationLayer,
+    EmbeddingLayer,
+    GlobalPoolingLayer,
+    GravesLSTMLayer,
+    LastTimeStepWrapper,
+    LocalResponseNormalizationLayer,
+    LSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SelfAttentionLayer,
+    SeparableConvolution2DLayer,
+    SimpleRnnLayer,
+    SubsamplingLayer,
+    Upsampling1DLayer,
+    UpsamplingLayer,
+    VariationalAutoencoderLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.util.gradient_check import check_model_gradients
+
+RNG = np.random.default_rng(42)
+
+
+def build(layers, input_type):
+    b = NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1)).weight_init("xavier").list()
+    for l in layers:
+        b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def onehot(idx, n):
+    return np.eye(n, dtype=np.float64)[idx]
+
+
+class TestDenseGradients:
+    @pytest.mark.parametrize("act", ["tanh", "sigmoid", "softplus", "elu", "cube"])
+    def test_dense_activations(self, act):
+        m = build([DenseLayer(n_out=6, activation=act),
+                   OutputLayer(n_out=3)], InputType.feed_forward(4))
+        x = RNG.normal(size=(5, 4))
+        y = onehot(RNG.integers(0, 3, 5), 3)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    @pytest.mark.parametrize("loss,act", [
+        ("mse", "identity"), ("mcxent", "softmax"), ("xent", "sigmoid"),
+        ("l1", "tanh"), ("negativeloglikelihood", "softmax"),
+        ("squared_hinge", "identity"), ("poisson", "softplus"),
+    ])
+    def test_loss_functions(self, loss, act):
+        m = build([DenseLayer(n_out=5, activation="tanh"),
+                   OutputLayer(n_out=3, loss=loss, activation=act)],
+                  InputType.feed_forward(4))
+        x = RNG.normal(size=(4, 4))
+        if loss in ("mcxent", "negativeloglikelihood"):
+            y = onehot(RNG.integers(0, 3, 4), 3)
+        elif loss == "xent":
+            y = (RNG.random((4, 3)) > 0.5).astype(np.float64)
+        elif loss == "squared_hinge":
+            y = np.where(RNG.random((4, 3)) > 0.5, 1.0, -1.0)
+        elif loss == "poisson":
+            y = RNG.integers(0, 5, (4, 3)).astype(np.float64)
+        else:
+            y = RNG.normal(size=(4, 3))
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_l1_l2_regularization(self):
+        b = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.1))
+             .l1(0.01).l2(0.02).list())
+        b.layer(DenseLayer(n_out=5, activation="tanh"))
+        b.layer(OutputLayer(n_out=3))
+        m = MultiLayerNetwork(b.set_input_type(InputType.feed_forward(4)).build()).init()
+        x = RNG.normal(size=(4, 4)) + 0.1  # avoid |w|=0 kink
+        y = onehot(RNG.integers(0, 3, 4), 3)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_elementwise_mult(self):
+        m = build([ElementWiseMultiplicationLayer(activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.feed_forward(4))
+        x = RNG.normal(size=(3, 4))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_embedding(self):
+        m = build([EmbeddingLayer(n_in=10, n_out=5, activation="tanh"),
+                   OutputLayer(n_out=3)], InputType.feed_forward(10))
+        x = RNG.integers(0, 10, (6, 1)).astype(np.float64)
+        y = onehot(RNG.integers(0, 3, 6), 3)
+        assert check_model_gradients(m, x, y, subset=60, print_results=True)
+
+
+class TestCnnGradients:
+    def test_cnn_basic(self):
+        m = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.convolutional(5, 5, 2))
+        x = RNG.normal(size=(3, 5, 5, 2))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_cnn_pool_dense(self):
+        m = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+                   SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type="max"),
+                   DenseLayer(n_out=6, activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.convolutional(6, 6, 1))
+        x = RNG.normal(size=(3, 6, 6, 1))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        assert check_model_gradients(m, x, y, subset=30, print_results=True)
+
+    @pytest.mark.parametrize("pool", ["avg", "pnorm"])
+    def test_pool_types(self, pool):
+        m = build([SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2), pooling_type=pool),
+                   OutputLayer(n_out=2)], InputType.convolutional(4, 4, 2))
+        x = RNG.normal(size=(3, 4, 4, 2))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_batchnorm(self):
+        m = build([ConvolutionLayer(n_out=2, kernel_size=(2, 2), activation="identity"),
+                   BatchNormalizationLayer(),
+                   ActivationLayer(activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.convolutional(4, 4, 1))
+        x = RNG.normal(size=(4, 4, 4, 1))
+        y = onehot(RNG.integers(0, 2, 4), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_deconv(self):
+        m = build([Deconvolution2DLayer(n_out=2, kernel_size=(2, 2), stride=(2, 2),
+                                        activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.convolutional(3, 3, 2))
+        x = RNG.normal(size=(2, 3, 3, 2))
+        y = onehot(RNG.integers(0, 2, 2), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_separable_depthwise(self):
+        m = build([SeparableConvolution2DLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+                   DepthwiseConvolution2DLayer(kernel_size=(2, 2), depth_multiplier=2,
+                                               activation="tanh"),
+                   OutputLayer(n_out=2)], InputType.convolutional(5, 5, 2))
+        x = RNG.normal(size=(2, 5, 5, 2))
+        y = onehot(RNG.integers(0, 2, 2), 2)
+        assert check_model_gradients(m, x, y, subset=30, print_results=True)
+
+    def test_padding_upsampling_lrn(self):
+        m = build([ZeroPaddingLayer(padding=(1, 1)),
+                   ConvolutionLayer(n_out=2, kernel_size=(3, 3), activation="tanh"),
+                   UpsamplingLayer(size=(2, 2)),
+                   LocalResponseNormalizationLayer(),
+                   OutputLayer(n_out=2)], InputType.convolutional(4, 4, 1))
+        x = RNG.normal(size=(2, 4, 4, 1))
+        y = onehot(RNG.integers(0, 2, 2), 2)
+        assert check_model_gradients(m, x, y, subset=30, print_results=True)
+
+    def test_cnn_loss_layer(self):
+        m = build([ConvolutionLayer(n_out=2, kernel_size=(1, 1), activation="identity"),
+                   CnnLossLayer(loss="mse", activation="sigmoid")],
+                  InputType.convolutional(3, 3, 2))
+        x = RNG.normal(size=(2, 3, 3, 2))
+        y = RNG.random((2, 3, 3, 2))
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_global_pooling_cnn(self):
+        m = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), activation="tanh"),
+                   GlobalPoolingLayer(pooling_type="avg"),
+                   OutputLayer(n_out=2)], InputType.convolutional(4, 4, 1))
+        x = RNG.normal(size=(3, 4, 4, 1))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+
+class TestRnnGradients:
+    def test_lstm(self):
+        m = build([LSTMLayer(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(3, 4))
+        x = RNG.normal(size=(2, 4, 3))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_graves_lstm(self):
+        m = build([GravesLSTMLayer(n_out=4, activation="tanh"),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(3, 4))
+        x = RNG.normal(size=(2, 4, 3))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_simple_rnn(self):
+        m = build([SimpleRnnLayer(n_out=4),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(3, 5))
+        x = RNG.normal(size=(2, 5, 3))
+        y = onehot(RNG.integers(0, 2, (2, 5)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_bidirectional_concat(self):
+        m = build([BidirectionalWrapper(layer=LSTMLayer(n_out=3), mode="concat"),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(2, 4))
+        x = RNG.normal(size=(2, 4, 2))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_last_time_step(self):
+        m = build([LastTimeStepWrapper(layer=LSTMLayer(n_out=4)),
+                   OutputLayer(n_out=2)], InputType.recurrent(3, 5))
+        x = RNG.normal(size=(2, 5, 3))
+        y = onehot(RNG.integers(0, 2, 2), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_masked_rnn(self):
+        m = build([LSTMLayer(n_out=4),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(3, 5))
+        x = RNG.normal(size=(3, 5, 3))
+        y = onehot(RNG.integers(0, 2, (3, 5)), 2)
+        mask = np.ones((3, 5))
+        mask[0, 3:] = 0
+        mask[1, 2:] = 0
+        assert check_model_gradients(m, x, y, features_mask=mask, labels_mask=mask,
+                                     subset=40, print_results=True)
+
+    def test_global_pooling_masked(self):
+        m = build([LSTMLayer(n_out=4),
+                   GlobalPoolingLayer(pooling_type="avg"),
+                   OutputLayer(n_out=2)], InputType.recurrent(3, 5))
+        x = RNG.normal(size=(3, 5, 3))
+        y = onehot(RNG.integers(0, 2, 3), 2)
+        mask = np.ones((3, 5))
+        mask[1, 2:] = 0
+        assert check_model_gradients(m, x, y, features_mask=mask,
+                                     subset=40, print_results=True)
+
+    def test_attention(self):
+        m = build([SelfAttentionLayer(n_heads=2, n_out=4),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(4, 5))
+        x = RNG.normal(size=(2, 5, 4))
+        y = onehot(RNG.integers(0, 2, (2, 5)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+
+class TestPretrainGradients:
+    def test_autoencoder_loss(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+        layer = AutoEncoderLayer(n_in=5, n_out=3, corruption_level=0.0,
+                                 activation="sigmoid", weight_init="xavier")
+        with jax.enable_x64(True):
+            params = layer.init_params(jax.random.PRNGKey(0), jnp.float64)
+            x = jnp.asarray(RNG.random((4, 5)))
+            assert check_gradients_fn(lambda p: layer.pretrain_loss(p, x, None),
+                                      params, subset=40, print_results=True)
+
+    def test_vae_elbo(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+        layer = VariationalAutoencoderLayer(
+            n_in=4, n_out=2, encoder_layer_sizes=(5,), decoder_layer_sizes=(5,),
+            activation="tanh", weight_init="xavier")
+        with jax.enable_x64(True):
+            params = layer.init_params(jax.random.PRNGKey(0), jnp.float64)
+            x = jnp.asarray((RNG.random((3, 4)) > 0.5).astype(np.float64))
+            key = jax.random.PRNGKey(5)
+            assert check_gradients_fn(lambda p: layer.pretrain_loss(p, x, key),
+                                      params, subset=40, print_results=True)

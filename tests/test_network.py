@@ -1,0 +1,195 @@
+"""MultiLayerNetwork behavior + config serde round-trips (reference: config
+serde tests + MultiLayerTest patterns in deeplearning4j-core)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    GravesLSTMLayer,
+    LSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs, StepSchedule
+
+RNG = np.random.default_rng(7)
+
+
+def _class_data(n=256, d=8, c=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype=np.float32)[np.argmax(x @ w, 1)]
+    return x, y
+
+
+class TestConfigSerde:
+    def _roundtrip(self, conf):
+        j = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(j)
+        assert conf2.to_json() == j
+        return conf2
+
+    def test_dense_conf_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+                .l2(1e-4).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(DropoutLayer(dropout=0.8))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        conf2 = self._roundtrip(conf)
+        assert conf2.layers[0].n_in == 8
+        assert conf2.layers[0].n_out == 16
+        assert isinstance(conf2.global_conf.updater, Adam)
+
+    def test_cnn_conf_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().updater(Nesterovs(0.1, 0.9)).list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1),
+                                        padding=(2, 2), activation="relu"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+                .layer(BatchNormalizationLayer())
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.convolutional(28, 28, 1)).build())
+        conf2 = self._roundtrip(conf)
+        assert conf2.layers[0].kernel_size == (5, 5)
+        assert conf2.layers[0].n_in == 1
+
+    def test_rnn_conf_roundtrip_with_schedule(self):
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Adam(StepSchedule("iteration", 0.01, 0.5, 100.0))).list()
+                .layer(GravesLSTMLayer(n_out=32))
+                .layer(RnnOutputLayer(n_out=5))
+                .set_input_type(InputType.recurrent(5, 20))
+                .t_bptt_length(10).build())
+        conf2 = self._roundtrip(conf)
+        assert conf2.backprop_type == "truncated_bptt"
+        assert conf2.tbptt_fwd_length == 10
+        assert isinstance(conf2.global_conf.updater.learning_rate, StepSchedule)
+
+    def test_trained_params_survive_conf_rebuild(self):
+        x, y = _class_data()
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        m = MultiLayerNetwork(conf).init()
+        m.fit(ListDataSetIterator(DataSet(x, y), 64), epochs=3)
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        m2 = MultiLayerNetwork(conf2).init()
+        m2.params = m.params
+        m2.states = m.states
+        np.testing.assert_allclose(np.asarray(m.output(x[:8])),
+                                   np.asarray(m2.output(x[:8])), rtol=1e-6)
+
+
+class TestTraining:
+    def test_fit_reduces_loss_and_accuracy(self):
+        x, y = _class_data()
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        m = MultiLayerNetwork(conf).init()
+        it = ListDataSetIterator(DataSet(x, y), 64, shuffle=True)
+        m.fit(it, epochs=1)
+        early = m.score_
+        m.fit(it, epochs=15)
+        assert m.score_ < early
+        ev = m.evaluate(ListDataSetIterator(DataSet(x, y), 128))
+        assert ev.accuracy() > 0.9
+
+    def test_deterministic_init(self):
+        conf_json = (NeuralNetConfiguration.builder().seed(99).list()
+                     .layer(DenseLayer(n_out=4))
+                     .layer(OutputLayer(n_out=2))
+                     .set_input_type(InputType.feed_forward(3)).build().to_json())
+        m1 = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json)).init()
+        m2 = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json)).init()
+        for p1, p2 in zip(m1.params, m2.params):
+            for k in p1:
+                np.testing.assert_array_equal(np.asarray(p1[k]), np.asarray(p2[k]))
+
+    def test_params_flat_roundtrip(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(3)).build())
+        m = MultiLayerNetwork(conf).init()
+        flat = m.params_flat()
+        assert flat.shape == (m.num_params(),)
+        flat2 = flat * 2
+        m.set_params_flat(flat2)
+        np.testing.assert_allclose(m.params_flat(), flat2, rtol=1e-6)
+
+    def test_batchnorm_running_stats_update(self):
+        x, y = _class_data(64, 6, 2, seed=3)
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+                .layer(DenseLayer(n_out=8, activation="identity"))
+                .layer(BatchNormalizationLayer())
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        m = MultiLayerNetwork(conf).init()
+        before = np.asarray(m.states[1]["mean"]).copy()
+        m.fit(DataSet(x, y))
+        after = np.asarray(m.states[1]["mean"])
+        assert not np.allclose(before, after)
+
+    def test_dropout_only_in_train(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=16, activation="relu", dropout=0.5))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        m = MultiLayerNetwork(conf).init()
+        x = RNG.normal(size=(8, 4)).astype(np.float32)
+        o1 = np.asarray(m.output(x))
+        o2 = np.asarray(m.output(x))
+        np.testing.assert_array_equal(o1, o2)  # inference is deterministic
+
+    def test_rnn_time_step_matches_full_forward(self):
+        T = 6
+        conf = (NeuralNetConfiguration.builder().seed(2).list()
+                .layer(LSTMLayer(n_out=8))
+                .layer(RnnOutputLayer(n_out=3))
+                .set_input_type(InputType.recurrent(4, T)).build())
+        m = MultiLayerNetwork(conf).init()
+        x = RNG.normal(size=(2, T, 4)).astype(np.float32)
+        full = np.asarray(m.output(x))
+        m.rnn_clear_previous_state()
+        outs = []
+        for t in range(T):
+            outs.append(np.asarray(m.rnn_time_step(x[:, t, :])))
+        stepped = np.stack(outs, axis=1)
+        np.testing.assert_allclose(full, stepped, rtol=1e-4, atol=1e-5)
+
+    def test_tbptt_runs(self):
+        T = 16
+        x = RNG.normal(size=(4, T, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[RNG.integers(0, 2, (4, T))]
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01)).list()
+                .layer(LSTMLayer(n_out=8))
+                .layer(RnnOutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(3, T))
+                .t_bptt_length(4).build())
+        m = MultiLayerNetwork(conf).init()
+        m.fit(DataSet(x, y))
+        assert np.isfinite(m.score_)
+        # 16 steps / 4 per chunk = 4 iterations
+        assert m.iteration == 4
+
+    def test_memory_report(self):
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_out=100))
+                .layer(OutputLayer(n_out=10))
+                .set_input_type(InputType.feed_forward(50)).build())
+        rep = conf.memory_report(batch=32)
+        assert rep["total_param_bytes"] == (50 * 100 + 100 + 100 * 10 + 10) * 4
+        assert len(rep["layers"]) == 2

@@ -1,0 +1,241 @@
+"""P0 tests: activations, losses, weight inits, updaters, schedules, config serde."""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn import activations, losses, weights
+from deeplearning4j_tpu.nn.updaters import (
+    Adam, AdaDelta, AdaGrad, AdaMax, AMSGrad, ExponentialSchedule, FixedSchedule,
+    InverseSchedule, MapSchedule, Nadam, Nesterovs, NoOp, PolySchedule, RmsProp,
+    Schedule, Sgd, SigmoidSchedule, StepSchedule, Updater, normalize_gradients,
+    resolve_updater,
+)
+
+
+class TestActivations:
+    def test_all_registered_run(self):
+        x = jnp.linspace(-3, 3, 32).reshape(4, 8)
+        for name in activations.names():
+            y = activations.resolve(name)(x)
+            assert y.shape == x.shape, name
+            assert bool(jnp.all(jnp.isfinite(y))), name
+
+    def test_values(self):
+        x = jnp.asarray([-2.0, -0.5, 0.0, 0.5, 2.0])
+        np.testing.assert_allclose(activations.relu(x), [0, 0, 0, 0.5, 2])
+        np.testing.assert_allclose(activations.hardtanh(x), [-1, -0.5, 0, 0.5, 1])
+        np.testing.assert_allclose(activations.identity(x), x)
+        np.testing.assert_allclose(
+            activations.leakyrelu(x, 0.1), [-0.2, -0.05, 0, 0.5, 2], atol=1e-7)
+
+    def test_softmax_rows_sum_to_one(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (5, 7))
+        s = activations.softmax(x)
+        np.testing.assert_allclose(np.asarray(jnp.sum(s, -1)), np.ones(5), rtol=1e-6)
+
+    def test_parametric_tuple(self):
+        fn = activations.resolve(("leakyrelu", {"alpha": 0.2}))
+        np.testing.assert_allclose(fn(jnp.asarray([-1.0])), [-0.2], atol=1e-7)
+
+    def test_selu_fixed_point(self):
+        # selu(0)=0 and approximately preserves N(0,1) moments
+        assert float(activations.selu(jnp.asarray(0.0))) == 0.0
+
+
+class TestLosses:
+    def test_mse(self):
+        y = jnp.asarray([[1.0, 2.0]])
+        p = jnp.asarray([[2.0, 4.0]])
+        # ((1)^2 + (2)^2)/2 outputs = 2.5
+        np.testing.assert_allclose(float(losses.mse(y, p)), 2.5)
+
+    def test_mcxent_logits_matches_probs(self):
+        key = jax.random.PRNGKey(1)
+        logits = jax.random.normal(key, (6, 4))
+        labels = jax.nn.one_hot(jnp.asarray([0, 1, 2, 3, 0, 1]), 4)
+        a = losses.mcxent_logits(labels, logits)
+        b = losses.mcxent_probs(labels, jax.nn.softmax(logits, -1))
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+    def test_sparse_matches_dense(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (5, 3))
+        idx = jnp.asarray([0, 2, 1, 0, 2])
+        dense = losses.mcxent_logits(jax.nn.one_hot(idx, 3), logits)
+        sparse = losses.sparse_mcxent_logits(idx, logits)
+        np.testing.assert_allclose(float(dense), float(sparse), rtol=1e-6)
+
+    def test_xent_logits_matches_probs(self):
+        logits = jax.random.normal(jax.random.PRNGKey(3), (4, 2))
+        labels = jnp.asarray([[1., 0.], [0., 1.], [1., 1.], [0., 0.]])
+        a = losses.xent_logits(labels, logits)
+        b = losses.xent_probs(labels, jax.nn.sigmoid(logits))
+        np.testing.assert_allclose(float(a), float(b), rtol=1e-5)
+
+    def test_mask_excludes_examples(self):
+        y = jnp.asarray([[1.0], [5.0]])
+        p = jnp.asarray([[2.0], [100.0]])
+        m = jnp.asarray([1.0, 0.0])
+        np.testing.assert_allclose(float(losses.mse(y, p, mask=m)), 1.0)
+
+    def test_resolve_fused(self):
+        fn, wants_logits = losses.resolve("mcxent", "softmax")
+        assert wants_logits
+        fn, wants_logits = losses.resolve("mcxent", "sigmoid")
+        assert not wants_logits
+        fn, wants_logits = losses.resolve("mse", "identity")
+        assert not wants_logits
+
+    def test_hinge(self):
+        y = jnp.asarray([[1.0], [-1.0]])
+        p = jnp.asarray([[0.5], [2.0]])
+        # max(0,1-0.5)=0.5 ; max(0,1+2)=3 → mean 1.75
+        np.testing.assert_allclose(float(losses.hinge(y, p)), 1.75)
+
+
+class TestWeightInit:
+    def test_all_schemes_shapes_and_variance(self):
+        key = jax.random.PRNGKey(0)
+        fan_in, fan_out = 256, 128
+        shape = (fan_in, fan_out)
+        for scheme in weights.ALL_SCHEMES:
+            if scheme == "identity":
+                w = weights.init_weight(key, (64, 64), scheme, 64, 64)
+                np.testing.assert_allclose(np.asarray(w), np.eye(64))
+                continue
+            dist = weights.Distribution("normal", std=0.3) if scheme == "distribution" else None
+            w = weights.init_weight(key, shape, scheme, fan_in, fan_out,
+                                    distribution=dist)
+            assert w.shape == shape, scheme
+            assert bool(jnp.all(jnp.isfinite(w))), scheme
+
+    def test_xavier_std(self):
+        key = jax.random.PRNGKey(42)
+        w = weights.init_weight(key, (1000, 1000), "xavier", 1000, 1000)
+        expected = math.sqrt(2.0 / 2000)
+        assert abs(float(jnp.std(w)) - expected) < expected * 0.05
+
+    def test_relu_std(self):
+        key = jax.random.PRNGKey(43)
+        w = weights.init_weight(key, (1000, 500), "relu", 1000, 500)
+        expected = math.sqrt(2.0 / 1000)
+        assert abs(float(jnp.std(w)) - expected) < expected * 0.05
+
+    def test_zero_ones(self):
+        key = jax.random.PRNGKey(0)
+        assert float(jnp.sum(weights.init_weight(key, (3, 3), "zero", 3, 3))) == 0
+        assert float(jnp.sum(weights.init_weight(key, (3, 3), "ones", 3, 3))) == 9
+
+    def test_uniform_bound(self):
+        key = jax.random.PRNGKey(1)
+        w = weights.init_weight(key, (400, 10), "uniform", 400, 10)
+        bound = 1.0 / math.sqrt(400)
+        assert float(jnp.max(jnp.abs(w))) <= bound
+
+    def test_distribution_serde(self):
+        d = weights.Distribution("uniform", lower=-0.2, upper=0.2)
+        d2 = weights.Distribution.from_dict(d.to_dict())
+        assert d == d2
+
+
+class TestUpdaters:
+    def _converges(self, updater, iters=300, tol=1e-2):
+        """Minimize f(w) = ||w - 3||^2 with the updater."""
+        w = jnp.asarray([0.0, 0.0])
+        state = updater.init_state(w)
+        for t in range(1, iters + 1):
+            g = 2 * (w - 3.0)
+            lr = updater.lr_at(t, 0)
+            upd, state = updater.update(g, state, lr, float(t))
+            w = w - upd
+        return float(jnp.max(jnp.abs(w - 3.0))) < tol
+
+    @pytest.mark.parametrize("updater", [
+        Sgd(0.1), Adam(0.1), AdaMax(0.1), Nadam(0.1), AMSGrad(0.1),
+        AdaGrad(0.5), AdaDelta(rho=0.9), RmsProp(0.05), Nesterovs(0.05, 0.9),
+    ], ids=lambda u: type(u).__name__)
+    def test_convergence(self, updater):
+        assert self._converges(updater, iters=1500 if isinstance(updater, AdaDelta) else 300,
+                               tol=0.15 if isinstance(updater, AdaDelta) else 1e-2)
+
+    def test_sgd_exact(self):
+        u = Sgd(0.5)
+        upd, _ = u.update(jnp.asarray([2.0]), {}, 0.5, 1.0)
+        np.testing.assert_allclose(np.asarray(upd), [1.0])
+
+    def test_adam_first_step(self):
+        # after one step Adam's update is lr * sign-ish of gradient
+        u = Adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8)
+        g = jnp.asarray([0.5])
+        state = u.init_state(g)
+        upd, _ = u.update(g, state, 0.001, 1.0)
+        # m_hat = g, v_hat = g^2 → update ≈ lr * g/|g| = lr
+        np.testing.assert_allclose(np.asarray(upd), [0.001], rtol=1e-3)
+
+    def test_noop(self):
+        u = NoOp()
+        upd, _ = u.update(jnp.asarray([5.0]), {}, 0.0, 1.0)
+        assert float(upd[0]) == 0.0
+
+    def test_serde_roundtrip(self):
+        for u in [Sgd(0.1), Adam(0.01, 0.8, 0.95, 1e-7),
+                  Nesterovs(0.05, 0.95), RmsProp(0.002, 0.9, 1e-7)]:
+            u2 = Updater.from_dict(u.to_dict())
+            assert u == u2
+
+    def test_schedule_serde(self):
+        for s in [FixedSchedule(value_=0.1), ExponentialSchedule("epoch", 0.1, 0.9),
+                  InverseSchedule("iteration", 0.1, 0.9, 2.0),
+                  PolySchedule("iteration", 0.1, 2.0, 100),
+                  SigmoidSchedule("iteration", 0.1, 0.5, 10),
+                  StepSchedule("iteration", 0.1, 0.5, 50.0),
+                  MapSchedule("iteration", ((0, 0.1), (100, 0.01)))]:
+            s2 = Schedule.from_dict(s.to_dict())
+            assert s == s2
+
+    def test_schedule_values(self):
+        s = StepSchedule("iteration", initial_value=1.0, decay_rate=0.5, step=10.0)
+        assert float(s.value(0, 0)) == 1.0
+        assert float(s.value(10, 0)) == 0.5
+        assert float(s.value(25, 0)) == 0.25
+        m = MapSchedule("iteration", ((0, 0.1), (5, 0.01)))
+        assert float(m.value(4, 0)) == pytest.approx(0.1)
+        assert float(m.value(5, 0)) == pytest.approx(0.01)
+
+    def test_updater_with_schedule(self):
+        u = Sgd(ExponentialSchedule("iteration", 1.0, 0.5))
+        assert float(u.lr_at(0, 0)) == 1.0
+        assert float(u.lr_at(2, 0)) == 0.25
+        u2 = Updater.from_dict(u.to_dict())
+        assert u2 == u
+
+    def test_resolve_updater(self):
+        assert isinstance(resolve_updater("adam"), Adam)
+        assert isinstance(resolve_updater("nesterovs"), Nesterovs)
+        assert isinstance(resolve_updater(None), Sgd)
+
+
+class TestGradientNormalization:
+    def test_clip_elementwise(self):
+        g = {"W": jnp.asarray([3.0, -2.0, 0.5])}
+        out = normalize_gradients(g, "clip_elementwise_absolute_value", 1.0)
+        np.testing.assert_allclose(np.asarray(out["W"]), [1.0, -1.0, 0.5])
+
+    def test_clip_l2_per_layer(self):
+        g = {"W": jnp.asarray([3.0, 4.0])}  # norm 5
+        out = normalize_gradients(g, "clip_l2_per_layer", 1.0)
+        np.testing.assert_allclose(float(jnp.linalg.norm(out["W"])), 1.0, rtol=1e-6)
+
+    def test_renormalize_per_layer(self):
+        g = {"W": jnp.asarray([3.0, 0.0]), "b": jnp.asarray([4.0])}
+        out = normalize_gradients(g, "renormalize_l2_per_layer")
+        total = math.sqrt(float(jnp.sum(out["W"]**2) + jnp.sum(out["b"]**2)))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-6)
+
+    def test_noop_mode(self):
+        g = {"W": jnp.asarray([3.0])}
+        assert normalize_gradients(g, None) is g
