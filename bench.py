@@ -1,0 +1,59 @@
+"""Benchmark driver — prints ONE JSON line with the headline metric.
+
+Round-1 headline: LeNet-MNIST training throughput (images/sec) on one chip,
+measured with the PerformanceListener methodology
+(`PerformanceListener.java:87-88` samples/sec). The reference publishes no
+numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first
+value this framework recorded (stored below), or 1.0 until one exists.
+"""
+
+import json
+import time
+
+import numpy as np
+
+# First recorded value for this benchmark on the target hardware (updated as
+# the framework improves; BASELINE.md "published" is empty in the reference).
+BASELINE_IMAGES_PER_SEC = None  # set after first TPU run
+
+
+def main():
+    from __graft_entry__ import _lenet
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    import jax
+
+    batch = 512
+    steps = 30
+    warmup = 5
+
+    import jax.numpy as jnp
+
+    net = _lenet()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
+    ds = DataSet(jnp.asarray(x), jnp.asarray(y))  # place on device once
+
+    for _ in range(warmup):
+        net._fit_batch(ds)
+    jax.block_until_ready(net.params)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        net._fit_batch(ds)
+    jax.block_until_ready(net.params)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    vs = ips / BASELINE_IMAGES_PER_SEC if BASELINE_IMAGES_PER_SEC else 1.0
+    print(json.dumps({
+        "metric": "lenet_mnist_train_throughput",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -59,7 +59,7 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         self.listeners: List[Any] = []
-        self.score_: float = float("nan")
+        self._score_arr = None  # device array; float() only on read (no sync/step)
         self._rng_key: Optional[jax.Array] = None
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries: Optional[List[Any]] = None
@@ -91,6 +91,16 @@ class MultiLayerNetwork:
         self.iteration = 0
         self.epoch = 0
         return self
+
+    @property
+    def score_(self) -> float:
+        """Last minibatch loss. Reading this syncs with the device; the train
+        loop itself never blocks on it (PerformanceListener-friendly)."""
+        return float("nan") if self._score_arr is None else float(self._score_arr)
+
+    @score_.setter
+    def score_(self, v) -> None:
+        self._score_arr = v
 
     def _next_rng(self) -> jax.Array:
         self._rng_key, k = jax.random.split(self._rng_key)
@@ -250,7 +260,7 @@ class MultiLayerNetwork:
         self.params, self.states, self.updater_states, loss, _ = step(
             self.params, self.states, self.updater_states, it, ep,
             x, y, mask, lmask, rng, None)
-        self.score_ = float(loss)
+        self._score_arr = loss
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "iteration_done"):
@@ -280,7 +290,7 @@ class MultiLayerNetwork:
             self.params, self.states, self.updater_states, loss, carries = step(
                 self.params, self.states, self.updater_states, it, ep,
                 xc, yc, mc, lc, rng, carries)
-            self.score_ = float(loss)
+            self._score_arr = loss
             self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "iteration_done"):

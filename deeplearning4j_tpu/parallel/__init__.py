@@ -1,0 +1,31 @@
+"""Distributed training & inference over a TPU device mesh.
+
+TPU-native re-design of the reference's scale-out stack (SURVEY.md §2.b, §3.3,
+§3.4): `ParallelWrapper.java:58` (single-node data parallel),
+`ParameterAveragingTrainingMaster.java:308` (periodic parameter averaging),
+`EncodedGradientsAccumulator.java:33` / `EncodingHandler.java:139` (threshold-
+compressed gradient sharing), and `ParallelInference.java:32` (multi-device
+batched inference).
+
+Instead of thread replication + NCCL/Aeron messaging, everything is expressed
+as sharded jitted computations over a `jax.sharding.Mesh`: per-step gradient
+synchronization is what XLA GSPMD emits automatically when the batch is
+sharded over the 'data' axis and params are replicated (the all-reduce rides
+ICI); parameter averaging is a `shard_map` with K local steps then `pmean`;
+tensor parallelism is a `PartitionSpec` on the weight matrices.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import make_mesh, local_mesh  # noqa: F401
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    tp_param_specs,
+    shard_model,
+)
+from deeplearning4j_tpu.parallel.trainer import ParallelWrapper  # noqa: F401
+from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
+    threshold_encode,
+    threshold_decode,
+    EncodingHandler,
+)
+from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401

@@ -1,0 +1,133 @@
+"""ParallelInference — dynamic-batching inference server.
+
+Reference: ``ParallelInference.java:32`` — requests from many client threads
+are queued, a background worker coalesces them into batches
+(``InferenceMode.BATCHED``, ``:52,82``) and dispatches to per-device model
+replicas.
+
+TPU-native design: one jitted forward specialized per bucketed batch size
+(powers of two, to bound recompilation), requests coalesced by a single
+dispatcher thread; multi-device throughput comes from sharding the coalesced
+batch over the mesh 'data' axis rather than from model replicas.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel.sharding import batch_sharding
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error")
+
+    def __init__(self, x):
+        self.x = x
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+
+
+def _bucket(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ParallelInference:
+    """Batched inference front-end over a model's ``output``.
+
+    mode: 'sequential' (run each request as-is) or 'batched' (coalesce up to
+    ``max_batch_size`` inputs within ``nanos`` wait window).
+    """
+
+    def __init__(self, model, *, mode: str = "batched", max_batch_size: int = 32,
+                 queue_limit: int = 64, wait_ms: float = 2.0,
+                 mesh: Optional[Mesh] = None):
+        self.model = model
+        self.mode = mode
+        self.max_batch_size = int(max_batch_size)
+        self.wait_s = wait_ms / 1e3
+        self.mesh = mesh
+        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
+        self._shutdown = False
+        self._worker = None
+        if mode == "batched":
+            self._worker = threading.Thread(target=self._run, daemon=True)
+            self._worker.start()
+
+    # ----------------------------------------------------------- client API
+    def output(self, x) -> np.ndarray:
+        x = np.asarray(x)
+        single = False
+        if self.mode == "sequential":
+            return np.asarray(self.model.output(x))
+        req = _Request(x)
+        self._q.put(req)
+        req.event.wait()
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self._worker is not None:
+            self._worker.join(timeout=1.0)
+
+    # ------------------------------------------------------------ dispatcher
+    def _run(self) -> None:
+        while not self._shutdown:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch: List[_Request] = [first]
+            n = first.x.shape[0]
+            deadline = self.wait_s
+            import time
+            t0 = time.monotonic()
+            while n < self.max_batch_size:
+                remaining = deadline - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    r = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(r)
+                n += r.x.shape[0]
+            self._dispatch(batch, n)
+
+    def _dispatch(self, batch: List[_Request], n: int) -> None:
+        try:
+            x = np.concatenate([r.x for r in batch], axis=0)
+            # pad to bucket size → bounded set of compiled shapes
+            target = min(_bucket(n), max(self.max_batch_size, _bucket(n)))
+            if self.mesh is not None:
+                d = self.mesh.shape.get("data", 1)
+                target = -(-target // d) * d
+            if target > n:
+                pad = np.zeros((target - n,) + x.shape[1:], x.dtype)
+                x = np.concatenate([x, pad], axis=0)
+            xj = jnp.asarray(x)
+            if self.mesh is not None:
+                xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
+            out = np.asarray(self.model.output(xj))
+            off = 0
+            for r in batch:
+                k = r.x.shape[0]
+                r.result = out[off:off + k]
+                off += k
+                r.event.set()
+        except Exception as e:  # deliver errors to waiting clients
+            for r in batch:
+                r.error = e
+                r.event.set()

@@ -1,0 +1,61 @@
+"""Device mesh construction helpers.
+
+The reference pins worker threads to devices round-robin
+(`ParallelWrapper.java:125-137`, `AffinityManager.attachThreadToDevice`). The
+TPU-native equivalent is a named `jax.sharding.Mesh`: axes are logical
+parallelism dimensions (data / model / pipeline / sequence / expert) and XLA
+lays collectives onto ICI links following the mesh topology.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names used across the framework.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPELINE_AXIS = "pipe"
+SEQUENCE_AXIS = "seq"
+EXPERT_AXIS = "expert"
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh from ``{axis_name: size}``.
+
+    At most one axis size may be -1 (inferred, like a reshape). Default is a
+    pure data-parallel mesh over all addressable devices.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if axes is None:
+        axes = {DATA_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    n_infer = sum(1 for s in sizes if s == -1)
+    if n_infer > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if n_infer == 1:
+        known = int(np.prod([s for s in sizes if s != -1])) if len(sizes) > 1 else 1
+        if n % known:
+            raise ValueError(f"cannot infer axis: {n} devices not divisible by {known}")
+        sizes = [n // known if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total > n:
+        raise ValueError(f"mesh wants {total} devices, only {n} available")
+    arr = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def local_mesh(n: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
+    """1-D data-parallel mesh over the first ``n`` local devices."""
+    devices = jax.local_devices()
+    if n is not None:
+        devices = devices[:n]
+    return make_mesh({axis: len(devices)}, devices)

@@ -1,0 +1,94 @@
+"""Sharding rules: how model pytrees map onto a Mesh.
+
+This replaces the reference's model replication (`ParallelWrapper.java:78`
+clones the net per worker thread) with sharding annotations: a replicated
+param lives once per device HBM but is updated by a single SPMD program; a
+tensor-parallel param is *split* across the 'model' axis and XLA inserts the
+matching collectives (all-gather / reduce-scatter) around the matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over the data axis."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def tp_param_specs(net, axis: str = MODEL_AXIS) -> List[Dict[str, P]]:
+    """Megatron-style tensor-parallel PartitionSpecs for a sequential net.
+
+    Rule of thumb for round-1 TP: shard every weight's output-feature
+    dimension (last axis of W / pW / conv kernels, the bias vector, and
+    BN scale/shift) over the model axis. XLA GSPMD propagates the resulting
+    activation shardings and inserts collectives; this is the capability the
+    reference lacks entirely (SURVEY.md §2.b: "Model/tensor parallelism: No").
+    """
+    specs: List[Dict[str, P]] = []
+    for layer, p in zip(net.layers, net.params):
+        d: Dict[str, P] = {}
+        for n, v in p.items():
+            if v.ndim >= 2 and v.shape[-1] > 1:
+                d[n] = P(*([None] * (v.ndim - 1)), axis)
+            elif v.ndim == 1 and v.shape[0] > 1:
+                d[n] = P(axis)
+            else:
+                d[n] = P()
+        specs.append(d)
+    return specs
+
+
+def _leaf_sharding_ok(shape, spec: P, mesh: Mesh) -> bool:
+    for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if ax is None:
+            continue
+        if dim % mesh.shape[ax]:
+            return False
+    return True
+
+
+def shard_model(net, mesh: Mesh, tp_axis: Optional[str] = None) -> None:
+    """Place a model's params / states / updater states on the mesh, in-place.
+
+    ``tp_axis=None`` → fully replicated (pure data parallel).
+    ``tp_axis='model'`` → tensor-parallel specs from :func:`tp_param_specs`;
+    any leaf whose dims don't divide the axis falls back to replicated.
+    """
+    repl = replicated(mesh)
+    if tp_axis is None:
+        net.params = jax.device_put(net.params, repl)
+        net.states = jax.device_put(net.states, repl)
+        net.updater_states = jax.device_put(net.updater_states, repl)
+        return
+
+    specs = tp_param_specs(net, tp_axis)
+    new_params, new_upd = [], []
+    for li, (pd, sd) in enumerate(zip(net.params, specs)):
+        pl, ul = {}, {}
+        for n, v in pd.items():
+            spec = sd.get(n, P())
+            if not _leaf_sharding_ok(v.shape, spec, mesh):
+                spec = P()
+            sh = NamedSharding(mesh, spec)
+            pl[n] = jax.device_put(v, sh)
+            # updater state leaves (momentum etc.) share the param's shape/spec
+            ul[n] = {
+                k: jax.device_put(s, sh if s.shape == v.shape else repl)
+                for k, s in net.updater_states[li][n].items()
+            }
+        new_params.append(pl)
+        new_upd.append(ul)
+    net.params = new_params
+    net.updater_states = new_upd
+    net.states = jax.device_put(net.states, repl)
