@@ -3,3 +3,7 @@ from deeplearning4j_tpu.nn.conf.network import (  # noqa: F401
     NeuralNetConfiguration,
     MultiLayerConfiguration,
 )
+from deeplearning4j_tpu.nn.conf.graph_conf import (  # noqa: F401
+    ComputationGraphConfiguration,
+    GraphBuilder,
+)

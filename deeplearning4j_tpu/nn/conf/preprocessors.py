@@ -1,0 +1,74 @@
+"""Named input preprocessors (DL4J ``InputPreProcessor`` family).
+
+Reference: ``nn/conf/preprocessor/`` — CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor —
+plus the Keras-import TensorFlowCnnToFeedForwardPreProcessor.
+
+Each preprocessor is addressed by a spec string so graph configs stay
+JSON-serializable: ``"cnn_to_ff"`` or parameterized ``"ff_to_cnn:28,28,1"``.
+Data layout here is NHWC / [N,T,C] (channels-last), so most conversions are
+pure reshapes XLA folds away.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+
+def _parse(spec: str) -> Tuple[str, Tuple[int, ...]]:
+    if ":" in spec:
+        name, args = spec.split(":", 1)
+        return name, tuple(int(a) for a in args.split(","))
+    return spec, ()
+
+
+def apply(spec: str, x):
+    name, args = _parse(spec)
+    if name == "identity":
+        return x
+    if name == "cnn_to_ff":          # [N,H,W,C] → [N, H*W*C]
+        return x.reshape(x.shape[0], -1)
+    if name == "ff_to_cnn":          # [N, H*W*C] → [N,H,W,C]
+        h, w, c = args
+        return x.reshape(x.shape[0], h, w, c)
+    if name == "rnn_to_ff":          # [N,T,C] → [N*T, C]
+        return x.reshape(-1, x.shape[-1])
+    if name == "ff_to_rnn":          # [N*T, C] → [N,T,C]
+        (t,) = args
+        return x.reshape(-1, t, x.shape[-1])
+    if name == "cnn_to_rnn":         # [N,H,W,C] → [N, T=H*W, C]... DL4J: [N, H*W*C] per step? No:
+        # DL4J CnnToRnnPreProcessor: [N,C,H,W] per timestep flattened → here
+        # [N,H,W,C] → [N, 1, H*W*C] is not the semantic; the reference input
+        # is [N*T,...]. We treat the H axis as time: [N, H, W*C].
+        return x.reshape(x.shape[0], x.shape[1], -1)
+    if name == "rnn_to_cnn":         # [N,T,C] with C=H'*W'*C' → [N,H',W',C'] per step merged
+        h, w, c = args
+        return x.reshape(-1, h, w, c)
+    raise ValueError(f"unknown preprocessor {spec!r}")
+
+
+def output_type(spec: str, it: InputType) -> InputType:
+    name, args = _parse(spec)
+    if name == "identity":
+        return it
+    if name == "cnn_to_ff":
+        return InputType.feed_forward(it.height * it.width * it.channels)
+    if name == "ff_to_cnn":
+        h, w, c = args
+        return InputType.convolutional(h, w, c)
+    if name == "rnn_to_ff":
+        return InputType.feed_forward(it.size)
+    if name == "ff_to_rnn":
+        (t,) = args
+        return InputType.recurrent(it.size, t)
+    if name == "cnn_to_rnn":
+        return InputType.recurrent(it.width * it.channels, it.height)
+    if name == "rnn_to_cnn":
+        h, w, c = args
+        return InputType.convolutional(h, w, c)
+    raise ValueError(f"unknown preprocessor {spec!r}")

@@ -1,0 +1,425 @@
+"""ComputationGraph — DAG model with multiple inputs/outputs, TPU-native.
+
+Reference: ``nn/graph/ComputationGraph.java`` (3.9k LoC): topological
+execution (``topologicalOrder:152``), ``fit(DataSetIterator):886`` /
+``fit(MultiDataSetIterator):1010``, ``output``, ``rnnTimeStep``, evaluation.
+
+TPU design mirrors MultiLayerNetwork: params are a dict keyed by vertex name,
+the whole train step (forward over the topo order, summed output losses,
+``jax.grad``, updaters) is ONE jitted donated-buffer function. Vertices are
+pure functions, so the DAG is just function composition — XLA sees a single
+fused program, not an object graph.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.updaters import Sgd, Updater, normalize_gradients
+
+Array = jax.Array
+Params = Dict[str, Dict[str, Array]]
+States = Dict[str, Dict[str, Array]]
+
+
+def _as_jnp(x, dtype=None):
+    if isinstance(x, (np.ndarray, list, tuple)) or np.isscalar(x):
+        x = jnp.asarray(x)
+    if dtype is not None and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != dtype:
+        x = x.astype(dtype)
+    return x
+
+
+class ComputationGraph:
+    """DAG network over a ComputationGraphConfiguration."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        conf.finalize()
+        self.conf = conf
+        self.params: Optional[Params] = None
+        self.states: Optional[States] = None
+        self.updater_states: Optional[Dict[str, Dict[str, Dict[str, Array]]]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self._score_arr = None
+        self._rng_key: Optional[jax.Array] = None
+        self._jit_cache: Dict[Any, Any] = {}
+        self._updaters: Dict[str, Dict[str, Updater]] = {}
+        self._rnn_carries: Optional[Dict[str, Any]] = None
+
+    # ---------------------------------------------------------------- score
+    @property
+    def score_(self) -> float:
+        return float("nan") if self._score_arr is None else float(self._score_arr)
+
+    @score_.setter
+    def score_(self, v) -> None:
+        self._score_arr = v
+
+    # ----------------------------------------------------------------- init
+    def init(self, seed: Optional[int] = None) -> "ComputationGraph":
+        g = self.conf.global_conf
+        key = jax.random.PRNGKey(g.seed if seed is None else seed)
+        self._rng_key = jax.random.fold_in(key, 0x5EED)
+        dtype = g.jnp_dtype()
+        self.params, self.states = {}, {}
+        self._updaters, self.updater_states = {}, {}
+        default_updater = g.updater or Sgd(0.1)
+        layer_defs = self.conf.layer_vertices()
+        keys = jax.random.split(key, max(1, len(layer_defs)))
+        for vd, k in zip(layer_defs, keys):
+            layer: Layer = vd.obj  # type: ignore[assignment]
+            p = layer.init_params(k, dtype)
+            self.params[vd.name] = p
+            self.states[vd.name] = layer.init_state()
+            layer_upd = layer.updater or default_updater
+            bias_upd = layer.bias_updater or g.bias_updater or layer_upd
+            umap, smap = {}, {}
+            for n, v in p.items():
+                u = bias_upd if n == "b" else layer_upd
+                umap[n] = u
+                smap[n] = u.init_state(v)
+            self._updaters[vd.name] = umap
+            self.updater_states[vd.name] = smap
+        self.iteration = 0
+        self.epoch = 0
+        return self
+
+    def _next_rng(self) -> jax.Array:
+        self._rng_key, k = jax.random.split(self._rng_key)
+        return k
+
+    # -------------------------------------------------------------- forward
+    def _forward_all(self, params: Params, states: States,
+                     inputs: Dict[str, Array], *, train: bool,
+                     rng: Optional[jax.Array],
+                     masks: Optional[Dict[str, Optional[Array]]] = None,
+                     carries: Optional[Dict[str, Any]] = None,
+                     stop_at_loss: bool = True,
+                     ) -> Tuple[Dict[str, Array], States,
+                                Dict[str, Optional[Array]], Optional[Dict[str, Any]]]:
+        """Execute the DAG in topo order.
+
+        Returns (activations, new_states, masks, new_carries). When
+        ``stop_at_loss``, output-layer vertices store their *input* (merged)
+        activation under ``name + ':in'`` and their own activation is the
+        layer forward (useful for output()).
+        """
+        conf = self.conf
+        acts: Dict[str, Array] = dict(inputs)
+        m: Dict[str, Optional[Array]] = dict(masks or {})
+        for name in conf.inputs:
+            m.setdefault(name, None)
+        new_states: States = {}
+        new_carries: Dict[str, Any] = {}
+        n_layers = max(1, len(conf.topo_order))
+        rngs = (jax.random.split(rng, n_layers) if rng is not None else [None] * n_layers)
+        for vi, name in enumerate(conf.topo_order):
+            vd = conf.vertices[name]
+            in_acts = [acts[s] for s in vd.inputs]
+            in_masks = [m.get(s) for s in vd.inputs]
+            if vd.is_layer:
+                layer: Layer = vd.obj  # type: ignore[assignment]
+                h = in_acts[0] if len(in_acts) == 1 else jnp.concatenate(in_acts, -1)
+                if name in conf.preprocessors:
+                    h = conf.preprocessors[name](h)
+                cur_mask = in_masks[0]
+                if layer.has_loss():
+                    acts[name + ":in"] = h
+                    acts[name + ":mask"] = cur_mask
+                if carries is not None and isinstance(layer, BaseRecurrentLayer):
+                    y, c = layer.forward_seq(params[name], h, carry=carries.get(name),
+                                             mask=cur_mask, train=train, rng=rngs[vi])
+                    new_states[name] = states[name]
+                    new_carries[name] = c
+                    acts[name] = y
+                else:
+                    y, st = layer.forward(params[name], h, state=states[name],
+                                          train=train, rng=rngs[vi], mask=cur_mask)
+                    new_states[name] = st if st else states[name]
+                    acts[name] = y
+                # mask collapses when time dim disappears (MLN parity)
+                if cur_mask is not None and acts[name].ndim == 2 and cur_mask.ndim == 2:
+                    m[name] = None
+                else:
+                    m[name] = cur_mask
+            else:
+                acts[name] = vd.obj.forward(in_acts, in_masks)  # type: ignore[union-attr]
+                m[name] = vd.obj.output_mask(in_masks)  # type: ignore[union-attr]
+        return acts, new_states, m, (new_carries if carries is not None else None)
+
+    def _regularization(self, params: Params) -> Array:
+        reg = jnp.asarray(0.0, jnp.float32)
+        for vd in self.conf.layer_vertices():
+            l: Layer = vd.obj  # type: ignore[assignment]
+            for n, v in params[vd.name].items():
+                is_bias = n == "b"
+                l1 = (l.l1_bias if is_bias else l.l1) or 0.0
+                l2 = (l.l2_bias if is_bias else l.l2) or 0.0
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(v))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(v * v)
+        return reg
+
+    def _loss_fn(self, params: Params, states: States,
+                 inputs: Dict[str, Array], labels: Sequence[Array],
+                 rng, masks, label_masks, train: bool):
+        acts, new_states, out_masks, _ = self._forward_all(
+            params, states, inputs, train=train, rng=rng, masks=masks)
+        loss = jnp.asarray(0.0, jnp.float32)
+        for oi, out_name in enumerate(self.conf.outputs):
+            vd = self.conf.vertices[out_name]
+            layer = vd.obj
+            if not (vd.is_layer and layer.has_loss()):
+                raise ValueError(f"output vertex {out_name!r} is not a loss layer")
+            h = acts[out_name + ":in"]
+            lm = None
+            if label_masks is not None and label_masks[oi] is not None:
+                lm = label_masks[oi]
+            elif h.ndim == 3:
+                lm = acts.get(out_name + ":mask")
+            loss = loss + layer.compute_loss(params[out_name], h, labels[oi], mask=lm)
+        loss = loss + self._regularization(params)
+        return loss, new_states
+
+    # ------------------------------------------------------------ train step
+    def _apply_updates(self, params, grads, upd_states, it, ep):
+        new_params: Params = {}
+        new_upd = {}
+        for vd in self.conf.layer_vertices():
+            name = vd.name
+            l: Layer = vd.obj  # type: ignore[assignment]
+            g_layer = grads[name]
+            if l.gradient_normalization:
+                g_layer = normalize_gradients(g_layer, l.gradient_normalization,
+                                              l.gradient_normalization_threshold)
+            p_new, s_new = {}, {}
+            for n, g in g_layer.items():
+                u = self._updaters[name][n]
+                lr = u.lr_at(it, ep)
+                upd, s = u.update(g, upd_states[name][n], lr, it + 1.0)
+                p_new[n] = params[name][n] - upd.astype(params[name][n].dtype)
+                s_new[n] = s
+            new_params[name] = p_new
+            new_upd[name] = s_new
+        return new_params, new_upd
+
+    def _get_train_step(self):
+        if "train" not in self._jit_cache:
+            def step(params, states, upd_states, it, ep, inputs, labels,
+                     masks, label_masks, rng):
+                def lf(p):
+                    return self._loss_fn(p, states, inputs, labels, rng,
+                                         masks, label_masks, train=True)
+                (loss, new_states), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
+                return new_params, new_states, new_upd, loss
+
+            self._jit_cache["train"] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit_cache["train"]
+
+    # ------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, *, epochs: int = 1) -> "ComputationGraph":
+        if self.params is None:
+            self.init()
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+        if labels is not None:
+            iterator = [MultiDataSet(
+                data if isinstance(data, (list, tuple)) else [data],
+                labels if isinstance(labels, (list, tuple)) else [labels])]
+        elif isinstance(data, (DataSet, MultiDataSet)):
+            iterator = [data]
+        else:
+            iterator = data
+
+        for _ in range(epochs):
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_start"):
+                    listener.on_epoch_start(self)
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                self._fit_batch(ds)
+            self.epoch += 1
+            for listener in self.listeners:
+                if hasattr(listener, "on_epoch_end"):
+                    listener.on_epoch_end(self)
+        return self
+
+    def _to_mds(self, ds):
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        if isinstance(ds, DataSet):
+            return MultiDataSet(
+                [ds.features], [ds.labels],
+                None if ds.features_mask is None else [ds.features_mask],
+                None if ds.labels_mask is None else [ds.labels_mask])
+        return ds
+
+    def _fit_batch(self, ds) -> None:
+        mds = self._to_mds(ds)
+        dtype = self.conf.global_conf.jnp_dtype()
+        inputs = {n: _as_jnp(f, dtype) for n, f in zip(self.conf.inputs, mds.features)}
+        labels = [_as_jnp(l, dtype) for l in mds.labels]
+        masks = None
+        if mds.features_masks is not None:
+            masks = {n: (None if m is None else _as_jnp(m))
+                     for n, m in zip(self.conf.inputs, mds.features_masks)}
+        lmasks = None
+        if mds.labels_masks is not None:
+            lmasks = [None if m is None else _as_jnp(m) for m in mds.labels_masks]
+
+        step = self._get_train_step()
+        rng = self._next_rng()
+        it = jnp.asarray(self.iteration, jnp.float32)
+        ep = jnp.asarray(self.epoch, jnp.float32)
+        self.params, self.states, self.updater_states, loss = step(
+            self.params, self.states, self.updater_states, it, ep,
+            inputs, labels, masks, lmasks, rng)
+        self._score_arr = loss
+        self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "iteration_done"):
+                listener.iteration_done(self, self.iteration, self.epoch)
+
+    # ------------------------------------------------------------- inference
+    def _output_fn(self):
+        if "out" not in self._jit_cache:
+            def out_fn(params, states, inputs, masks):
+                acts, _, _, _ = self._forward_all(params, states, inputs,
+                                                  train=False, rng=None, masks=masks)
+                return [acts[n] for n in self.conf.outputs]
+            self._jit_cache["out"] = jax.jit(out_fn)
+        return self._jit_cache["out"]
+
+    def output(self, *xs, masks=None) -> Union[Array, List[Array]]:
+        dtype = self.conf.global_conf.jnp_dtype()
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        inputs = {n: _as_jnp(x, dtype) for n, x in zip(self.conf.inputs, xs)}
+        mask_d = None
+        if masks is not None:
+            mask_d = {n: (None if m is None else _as_jnp(m))
+                      for n, m in zip(self.conf.inputs, masks)}
+        outs = self._output_fn()(self.params, self.states, inputs, mask_d)
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *xs, train: bool = False) -> Dict[str, Array]:
+        dtype = self.conf.global_conf.jnp_dtype()
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        inputs = {n: _as_jnp(x, dtype) for n, x in zip(self.conf.inputs, xs)}
+        acts, _, _, _ = self._forward_all(self.params, self.states, inputs,
+                                          train=train, rng=None)
+        return {k: v for k, v in acts.items() if ":" not in k}
+
+    def predict(self, *xs) -> np.ndarray:
+        out = self.output(*xs)
+        if isinstance(out, list):
+            out = out[0]
+        return np.asarray(jnp.argmax(out, axis=-1))
+
+    def score(self, ds=None) -> float:
+        if ds is None:
+            return self.score_
+        mds = self._to_mds(ds)
+        dtype = self.conf.global_conf.jnp_dtype()
+        inputs = {n: _as_jnp(f, dtype) for n, f in zip(self.conf.inputs, mds.features)}
+        labels = [_as_jnp(l, dtype) for l in mds.labels]
+        loss, _ = self._loss_fn(self.params, self.states, inputs, labels,
+                                None, None, None, train=False)
+        return float(loss)
+
+    def compute_gradient_and_score(self, features, labels):
+        """Gradient-check hook (GradientCheckUtil parity for graphs)."""
+        mds = self._to_mds(self._wrap(features, labels))
+        dtype = self.conf.global_conf.jnp_dtype()
+        inputs = {n: _as_jnp(f, dtype) for n, f in zip(self.conf.inputs, mds.features)}
+        labs = [_as_jnp(l, dtype) for l in mds.labels]
+
+        def lf(p):
+            return self._loss_fn(p, self.states, inputs, labs, None, None, None,
+                                 train=False)
+
+        (loss, _), grads = jax.value_and_grad(lf, has_aux=True)(self.params)
+        return grads, float(loss)
+
+    def _wrap(self, features, labels):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        return MultiDataSet(
+            features if isinstance(features, (list, tuple)) else [features],
+            labels if isinstance(labels, (list, tuple)) else [labels])
+
+    # ------------------------------------------------------ stateful RNN API
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_carries = None
+
+    def rnn_time_step(self, *xs) -> Union[Array, List[Array]]:
+        dtype = self.conf.global_conf.jnp_dtype()
+        if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+            xs = tuple(xs[0])
+        xs = [_as_jnp(x, dtype) for x in xs]
+        squeeze = xs[0].ndim == 2
+        if squeeze:
+            xs = [x[:, None, :] for x in xs]
+        if self._rnn_carries is None:
+            batch = xs[0].shape[0]
+            self._rnn_carries = {}
+            for vd in self.conf.layer_vertices():
+                if isinstance(vd.obj, BaseRecurrentLayer):
+                    self._rnn_carries[vd.name] = vd.obj.init_carry(batch, dtype)
+        inputs = dict(zip(self.conf.inputs, xs))
+        acts, _, _, self._rnn_carries = self._forward_all(
+            self.params, self.states, inputs, train=False, rng=None,
+            carries=self._rnn_carries)
+        outs = [acts[n] for n in self.conf.outputs]
+        if squeeze:
+            outs = [o[:, -1, :] if o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------ evaluation
+    def evaluate(self, iterator) -> "Evaluation":
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[0]
+            e.eval(np.asarray(mds.labels[0]), np.asarray(out))
+        return e
+
+    # ------------------------------------------------------------------ misc
+    def num_params(self) -> int:
+        if self.params is None:
+            return self.conf.num_params()
+        return sum(v.size for p in self.params.values() for v in p.values())
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listeners(self, *listeners) -> None:
+        self.listeners.extend(listeners)
+
+    def clone(self) -> "ComputationGraph":
+        other = ComputationGraph(self.conf)
+        other.params = jax.tree_util.tree_map(lambda a: a, self.params)
+        other.states = jax.tree_util.tree_map(lambda a: a, self.states)
+        other.updater_states = jax.tree_util.tree_map(lambda a: a, self.updater_states)
+        other._updaters = self._updaters
+        other.iteration = self.iteration
+        other.epoch = self.epoch
+        other._rng_key = self._rng_key
+        return other

@@ -1,0 +1,333 @@
+"""Graph vertices — the non-layer nodes of a ComputationGraph DAG.
+
+Reference: config classes in ``nn/conf/graph/`` paired with runtime impls in
+``nn/graph/vertex/impl/`` (MergeVertex, ElementWiseVertex, StackVertex,
+UnstackVertex, SubsetVertex, ReshapeVertex, ScaleVertex, ShiftVertex,
+L2NormalizeVertex, L2Vertex, PoolHelperVertex, PreprocessorVertex, and the
+rnn vertices LastTimeStepVertex / DuplicateToTimeSeriesVertex /
+ReverseTimeSeriesVertex). Here each vertex is one dataclass with a pure
+``forward(inputs)`` — backprop is ``jax.grad`` through it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+Array = jax.Array
+
+VERTEX_REGISTRY: Dict[str, type] = {}
+
+
+def register_vertex(cls):
+    VERTEX_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class GraphVertex:
+    """Parameterless DAG node: pure function of its input activations."""
+
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        return input_types[0]
+
+    def forward(self, inputs: List[Array],
+                masks: Optional[List[Optional[Array]]] = None) -> Array:
+        raise NotImplementedError
+
+    def output_mask(self, masks: List[Optional[Array]]) -> Optional[Array]:
+        """Mask propagation; default: pass through the first input's mask."""
+        for m in masks:
+            if m is not None:
+                return m
+        return None
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+        d["@vertex"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "GraphVertex":
+        d = dict(d)
+        cls = VERTEX_REGISTRY[d.pop("@vertex")]
+        for k, v in d.items():
+            if isinstance(v, list):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+@register_vertex
+@dataclasses.dataclass
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature axis (channels for NHWC, features for
+    FF/RNN — always the last axis here). Reference: MergeVertex.java."""
+
+    def output_type(self, input_types: List[InputType]) -> InputType:
+        t0 = input_types[0]
+        if t0.kind == "convolutional":
+            return InputType.convolutional(
+                t0.height, t0.width, sum(t.channels for t in input_types))
+        if t0.kind == "recurrent":
+            return InputType.recurrent(sum(t.size for t in input_types), t0.timesteps)
+        return InputType.feed_forward(sum(t.size for t in input_types))
+
+    def forward(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=-1)
+
+
+@register_vertex
+@dataclasses.dataclass
+class ElementWiseVertex(GraphVertex):
+    """add | average | subtract | product | max (ElementWiseVertex.java)."""
+
+    op: str = "add"
+
+    def forward(self, inputs, masks=None):
+        op = self.op.lower()
+        if op in ("add", "sum"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if op in ("average", "avg"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out / len(inputs)
+        if op in ("subtract", "sub"):
+            if len(inputs) != 2:
+                raise ValueError("subtract needs exactly 2 inputs")
+            return inputs[0] - inputs[1]
+        if op in ("product", "mul"):
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if op == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        raise ValueError(f"unknown op {self.op!r}")
+
+
+@register_vertex
+@dataclasses.dataclass
+class StackVertex(GraphVertex):
+    """Concatenate along the batch (first) axis (StackVertex.java)."""
+
+    def output_type(self, input_types):
+        return input_types[0]
+
+    def forward(self, inputs, masks=None):
+        return jnp.concatenate(inputs, axis=0)
+
+
+@register_vertex
+@dataclasses.dataclass
+class UnstackVertex(GraphVertex):
+    """Slice index ``from_index`` of ``stack_size`` equal batch chunks
+    (UnstackVertex.java)."""
+
+    from_index: int = 0
+    stack_size: int = 1
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        step = x.shape[0] // self.stack_size
+        return x[self.from_index * step:(self.from_index + 1) * step]
+
+
+@register_vertex
+@dataclasses.dataclass
+class SubsetVertex(GraphVertex):
+    """Feature range [from_index, to_index] inclusive (SubsetVertex.java)."""
+
+    from_index: int = 0
+    to_index: int = 0
+
+    def output_type(self, input_types):
+        n = self.to_index - self.from_index + 1
+        t0 = input_types[0]
+        if t0.kind == "recurrent":
+            return InputType.recurrent(n, t0.timesteps)
+        return InputType.feed_forward(n)
+
+    def forward(self, inputs, masks=None):
+        return inputs[0][..., self.from_index:self.to_index + 1]
+
+
+@register_vertex
+@dataclasses.dataclass
+class ReshapeVertex(GraphVertex):
+    """Reshape to ``shape`` (batch dim preserved as -1). ReshapeVertex.java."""
+
+    shape: Tuple[int, ...] = ()
+
+    def output_type(self, input_types):
+        s = tuple(self.shape)
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        return input_types[0]
+
+    def forward(self, inputs, masks=None):
+        return inputs[0].reshape((inputs[0].shape[0],) + tuple(self.shape))
+
+
+@register_vertex
+@dataclasses.dataclass
+class ScaleVertex(GraphVertex):
+    """Multiply by a fixed scalar (ScaleVertex.java)."""
+
+    scale_factor: float = 1.0
+
+    def forward(self, inputs, masks=None):
+        return inputs[0] * self.scale_factor
+
+
+@register_vertex
+@dataclasses.dataclass
+class ShiftVertex(GraphVertex):
+    """Add a fixed scalar (ShiftVertex.java)."""
+
+    shift_factor: float = 0.0
+
+    def forward(self, inputs, masks=None):
+        return inputs[0] + self.shift_factor
+
+
+@register_vertex
+@dataclasses.dataclass
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over non-batch dims (L2NormalizeVertex.java)."""
+
+    eps: float = 1e-8
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        axes = tuple(range(1, x.ndim))
+        norm = jnp.sqrt(jnp.sum(x * x, axis=axes, keepdims=True))
+        return x / (norm + self.eps)
+
+
+@register_vertex
+@dataclasses.dataclass
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance of two inputs → [N, 1] (L2Vertex.java)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(1)
+
+    def forward(self, inputs, masks=None):
+        a, b = inputs
+        d = (a - b).reshape(a.shape[0], -1)
+        return jnp.sqrt(jnp.sum(d * d, axis=1, keepdims=True) + self.eps)
+
+
+@register_vertex
+@dataclasses.dataclass
+class PoolHelperVertex(GraphVertex):
+    """Strip the first row+column of an NHWC map — compatibility shim for
+    imported GoogLeNet-style models (PoolHelperVertex.java)."""
+
+    def output_type(self, input_types):
+        t = input_types[0]
+        return InputType.convolutional(t.height - 1, t.width - 1, t.channels)
+
+    def forward(self, inputs, masks=None):
+        return inputs[0][:, 1:, 1:, :]
+
+
+@register_vertex
+@dataclasses.dataclass
+class LastTimeStepVertex(GraphVertex):
+    """[N,T,C] → [N,C] at the last unmasked step (rnn/LastTimeStepVertex.java).
+    ``mask_input`` names the network input whose mask applies."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, input_types):
+        return InputType.feed_forward(input_types[0].size)
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return x[:, -1, :]
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return x[jnp.arange(x.shape[0]), idx]
+
+    def output_mask(self, masks):
+        return None  # time dimension collapsed
+
+
+@register_vertex
+@dataclasses.dataclass
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[N,C] → [N,T,C], T taken from a reference time-series input
+    (rnn/DuplicateToTimeSeriesVertex.java)."""
+
+    ts_input: Optional[str] = None
+
+    def output_type(self, input_types):
+        # second input (or the named ts input) provides T
+        t = input_types[1].timesteps if len(input_types) > 1 else None
+        return InputType.recurrent(input_types[0].size, t)
+
+    def forward(self, inputs, masks=None):
+        x, ref = inputs[0], inputs[1]
+        return jnp.broadcast_to(x[:, None, :], (x.shape[0], ref.shape[1], x.shape[-1]))
+
+    def output_mask(self, masks):
+        return masks[1] if len(masks) > 1 else None
+
+
+@register_vertex
+@dataclasses.dataclass
+class ReverseTimeSeriesVertex(GraphVertex):
+    """Reverse along time, respecting masks (rnn/ReverseTimeSeriesVertex.java)."""
+
+    mask_input: Optional[str] = None
+
+    def forward(self, inputs, masks=None):
+        x = inputs[0]
+        mask = masks[0] if masks else None
+        if mask is None:
+            return jnp.flip(x, axis=1)
+        # reverse only the valid prefix of each sequence
+        lengths = jnp.sum(mask, axis=1).astype(jnp.int32)  # [N]
+        t = x.shape[1]
+        pos = jnp.arange(t)[None, :]
+        src = jnp.where(pos < lengths[:, None], lengths[:, None] - 1 - pos, pos)
+        return jnp.take_along_axis(x, src[:, :, None], axis=1)
+
+
+@register_vertex
+@dataclasses.dataclass
+class PreprocessorVertex(GraphVertex):
+    """Wraps a named input preprocessor (PreprocessorVertex.java). The
+    preprocessor is identified by name for serializability; see
+    ``deeplearning4j_tpu.nn.conf.preprocessors``."""
+
+    preprocessor: str = "identity"
+
+    def output_type(self, input_types):
+        from deeplearning4j_tpu.nn.conf.preprocessors import output_type as pp_out
+        return pp_out(self.preprocessor, input_types[0])
+
+    def forward(self, inputs, masks=None):
+        from deeplearning4j_tpu.nn.conf.preprocessors import apply as pp_apply
+        return pp_apply(self.preprocessor, inputs[0])

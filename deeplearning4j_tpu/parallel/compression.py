@@ -33,10 +33,11 @@ class Encoded(NamedTuple):
     threshold: jax.Array  # [] float32 — the step magnitude
 
 
-def optimal_capacity(size: int, sparsity: float = 1e-3, floor: int = 16) -> int:
-    """Worst-case fixed buffer size (EncodedGradientsAccumulator
-    getOptimalBufferSize:127-134 sizes for paramsLength/16 + overhead)."""
-    return max(floor, int(size * max(sparsity, 1.0 / 16.0)))
+def optimal_capacity(size: int, sparsity: float = 1.0 / 16.0, floor: int = 16) -> int:
+    """Fixed buffer size for a given worst-case sparsity (EncodedGradientsAccumulator
+    getOptimalBufferSize:127-134 sizes for paramsLength/16 + overhead, hence
+    the 1/16 default)."""
+    return max(floor, int(size * sparsity))
 
 
 from functools import partial
@@ -108,7 +109,7 @@ class EncodingHandler:
         n = int(msg.count)
         if n >= cap:  # saturated → raise threshold next round
             self.threshold *= self.boost
-        elif n < cap // 8:  # sparse → lower threshold (decay)
+        elif n < max(1, cap // 8):  # sparse → lower threshold (decay)
             self.threshold = max(self.min_threshold, self.threshold * self.decay)
         return msg
 

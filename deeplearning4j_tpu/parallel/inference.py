@@ -52,6 +52,8 @@ class ParallelInference:
     def __init__(self, model, *, mode: str = "batched", max_batch_size: int = 32,
                  queue_limit: int = 64, wait_ms: float = 2.0,
                  mesh: Optional[Mesh] = None):
+        if mode not in ("sequential", "batched"):
+            raise ValueError(f"unknown mode {mode!r} (sequential|batched)")
         self.model = model
         self.mode = mode
         self.max_batch_size = int(max_batch_size)
@@ -67,9 +69,10 @@ class ParallelInference:
     # ----------------------------------------------------------- client API
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
-        single = False
         if self.mode == "sequential":
             return np.asarray(self.model.output(x))
+        if self._shutdown:
+            raise RuntimeError("ParallelInference is shut down")
         req = _Request(x)
         self._q.put(req)
         req.event.wait()
@@ -81,6 +84,14 @@ class ParallelInference:
         self._shutdown = True
         if self._worker is not None:
             self._worker.join(timeout=1.0)
+        # fail any requests still queued so no client blocks forever
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            r.error = RuntimeError("ParallelInference shut down")
+            r.event.set()
 
     # ------------------------------------------------------------ dispatcher
     def _run(self) -> None:
@@ -110,7 +121,7 @@ class ParallelInference:
         try:
             x = np.concatenate([r.x for r in batch], axis=0)
             # pad to bucket size → bounded set of compiled shapes
-            target = min(_bucket(n), max(self.max_batch_size, _bucket(n)))
+            target = _bucket(n)
             if self.mesh is not None:
                 d = self.mesh.shape.get("data", 1)
                 target = -(-target // d) * d

@@ -26,13 +26,12 @@ TPU-native design — no thread replication, no message passing:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 try:  # jax >= 0.4.35 exposes shard_map at top level
     from jax import shard_map
@@ -40,7 +39,7 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh
-from deeplearning4j_tpu.parallel.sharding import batch_sharding, replicated, shard_model
+from deeplearning4j_tpu.parallel.sharding import batch_sharding, shard_model
 
 
 def make_pure_step(net, train: bool = True):
@@ -76,6 +75,9 @@ class ParallelWrapper:
                  data_axis: str = DATA_AXIS):
         if mode not in ("shared_gradients", "averaging"):
             raise ValueError(f"unknown mode {mode!r}")
+        if mode == "averaging" and tp_axis is not None:
+            raise ValueError("averaging mode runs workers on replicated params; "
+                             "tensor parallelism requires mode='shared_gradients'")
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.mode = mode
@@ -119,11 +121,18 @@ class ParallelWrapper:
     # ------------------------------------------- shared-gradients (per step)
     def _fit_batch_sync(self, ds) -> None:
         """One globally-synchronous step: batch sharded over 'data', params
-        replicated → XLA all-reduces gradients over ICI inside the step."""
+        replicated → XLA all-reduces gradients over ICI inside the step.
+
+        A final ragged batch (size not divisible by the data-axis size) runs
+        unsharded — same math, no DP speedup for that one step (the reference
+        ParallelWrapper likewise handles arbitrary tail batches)."""
         net = self.model
-        dtype = net.conf.global_conf.jnp_dtype()
+        n = int(np.asarray(ds.features).shape[0])
+        if n % self.n_workers:
+            net._fit_batch(ds)
+            return
         put = lambda a: jax.device_put(
-            jnp.asarray(a, dtype if np.issubdtype(np.asarray(a).dtype, np.floating) else None),
+            jnp.asarray(a),
             batch_sharding(self.mesh, np.asarray(a).ndim, self.data_axis))
         from deeplearning4j_tpu.datasets.dataset import DataSet
         sharded = DataSet(
@@ -133,25 +142,25 @@ class ParallelWrapper:
         net._fit_batch(sharded)
 
     # ----------------------------------------------------- averaging mode
-    def _build_avg_step(self, k: int, x_sds, y_sds):
+    def _build_avg_step(self, k: int, x_sds, y_sds, has_fm, has_lm, fm_nd, lm_nd):
         net = self.model
         step = make_pure_step(net)
         daxis = self.data_axis
 
-        def worker(params, states, upd, it0, ep, xs, ys, rng):
+        def worker(params, states, upd, it0, ep, xs, ys, fms, lms, rng):
             # params/states/upd arrive replicated; xs/ys are this worker's
             # [k, local_batch, ...] shard. Each worker gets a distinct rng.
             rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
 
             def body(carry, inp):
                 p, s, u, it = carry
-                xi, yi, ri = inp
-                p, s, u, loss = step(p, s, u, it, ep, xi, yi, None, None, ri)
+                xi, yi, fmi, lmi, ri = inp
+                p, s, u, loss = step(p, s, u, it, ep, xi, yi, fmi, lmi, ri)
                 return (p, s, u, it + 1.0), loss
 
             rngs = jax.random.split(rng, k)
             (params, states, upd, _), losses = jax.lax.scan(
-                body, (params, states, upd, it0), (xs, ys, rngs))
+                body, (params, states, upd, it0), (xs, ys, fms, lms, rngs))
             # ParameterAveragingTrainingMaster parity: average params AND
             # updater state (averageUpdatersState, ParallelWrapper.java:338);
             # BN running stats averaged likewise.
@@ -161,23 +170,38 @@ class ParallelWrapper:
                 jnp.mean(losses), daxis)
 
         rep = P()
-        shard1 = P(None, daxis)  # [k, batch, ...] → batch dim sharded
-        xspec = P(None, daxis, *([None] * (x_sds - 2)))
-        yspec = P(None, daxis, *([None] * (y_sds - 2)))
+        spec = lambda nd: P(None, daxis, *([None] * (nd - 2)))
         mapped = shard_map(
             worker, mesh=self.mesh,
-            in_specs=(rep, rep, rep, rep, rep, xspec, yspec, rep),
+            in_specs=(rep, rep, rep, rep, rep, spec(x_sds), spec(y_sds),
+                      spec(fm_nd) if has_fm else rep,
+                      spec(lm_nd) if has_lm else rep, rep),
             out_specs=(rep, rep, rep, rep),
             check_vma=False)
         return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def _fit_averaging(self, iterator) -> None:
         """Accumulate averaging_frequency batches, then run K local steps per
-        worker + param averaging as one compiled program."""
+        worker + param averaging as one compiled program. Batches whose size
+        doesn't divide the worker count run unsharded via the model's own
+        step (same tail-batch policy as shared_gradients)."""
         net = self.model
         k = self.averaging_frequency
         dtype = net.conf.global_conf.jnp_dtype()
         pending: List[Any] = []
+
+        def stack_masks(masks, arrays):
+            """None-mixed masks → all-ones of [batch, T] (DataSet.merge policy)."""
+            if all(m is None for m in masks):
+                return None
+            out = []
+            for m, a in zip(masks, arrays):
+                if m is None:
+                    a = np.asarray(a)
+                    m = np.ones(a.shape[:2] if a.ndim >= 3 else a.shape[:1],
+                                np.float32)
+                out.append(jnp.asarray(np.asarray(m)))
+            return jnp.stack(out)
 
         def flush():
             if not pending:
@@ -185,15 +209,25 @@ class ParallelWrapper:
             kk = len(pending)
             xs = jnp.stack([jnp.asarray(d.features, dtype) for d in pending])
             ys = jnp.stack([jnp.asarray(d.labels, dtype) for d in pending])
-            key = ("avg", kk, xs.shape, ys.shape)
+            fms = stack_masks([d.features_mask for d in pending],
+                              [d.features for d in pending])
+            lms = stack_masks([d.labels_mask for d in pending],
+                              [d.labels for d in pending])
+            key = ("avg", kk, xs.shape, ys.shape,
+                   None if fms is None else fms.shape,
+                   None if lms is None else lms.shape)
             if self._avg_step is None or self._avg_step[0] != key:
-                self._avg_step = (key, self._build_avg_step(kk, xs.ndim, ys.ndim))
+                self._avg_step = (key, self._build_avg_step(
+                    kk, xs.ndim, ys.ndim, fms is not None, lms is not None,
+                    0 if fms is None else fms.ndim,
+                    0 if lms is None else lms.ndim))
             fn = self._avg_step[1]
             it = jnp.asarray(net.iteration, jnp.float32)
             ep = jnp.asarray(net.epoch, jnp.float32)
             rng = net._next_rng()
             net.params, net.states, net.updater_states, loss = fn(
-                net.params, net.states, net.updater_states, it, ep, xs, ys, rng)
+                net.params, net.states, net.updater_states, it, ep,
+                xs, ys, fms, lms, rng)
             net.score_ = loss
             net.iteration += kk
             for listener in net.listeners:
@@ -202,6 +236,10 @@ class ParallelWrapper:
             pending.clear()
 
         for ds in iterator:
+            if int(np.asarray(ds.features).shape[0]) % self.n_workers:
+                flush()
+                net._fit_batch(ds)  # ragged tail batch: unsharded
+                continue
             pending.append(ds)
             if len(pending) == k:
                 flush()

@@ -101,3 +101,32 @@ def check_model_gradients(model, x, y, *, features_mask=None, labels_mask=None,
                                   max_rel_error=max_rel_error,
                                   min_abs_error=min_abs_error, subset=subset,
                                   seed=seed, print_results=print_results)
+
+
+def check_graph_gradients(graph, features, labels, *, epsilon: float = 1e-6,
+                          max_rel_error: float = 1e-5, min_abs_error: float = 1e-8,
+                          subset: Optional[int] = 64, seed: int = 0,
+                          print_results: bool = False) -> bool:
+    """Gradient check for a ComputationGraph (multi-input/multi-output).
+
+    Reference: ``GradientCheckUtil.checkGradients`` ComputationGraph overload.
+    """
+    if not isinstance(features, (list, tuple)):
+        features = [features]
+    if not isinstance(labels, (list, tuple)):
+        labels = [labels]
+    with jax.enable_x64(True):
+        to64 = lambda a: jnp.asarray(np.asarray(a), jnp.float64)
+        inputs = {n: to64(f) for n, f in zip(graph.conf.inputs, features)}
+        labs = [to64(l) for l in labels]
+        states = jax.tree_util.tree_map(to64, graph.states)
+
+        def loss_fn(params):
+            loss, _ = graph._loss_fn(params, states, inputs, labs, None, None,
+                                     None, train=False)
+            return loss
+
+        return check_gradients_fn(loss_fn, graph.params, epsilon=epsilon,
+                                  max_rel_error=max_rel_error,
+                                  min_abs_error=min_abs_error, subset=subset,
+                                  seed=seed, print_results=print_results)

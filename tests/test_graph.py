@@ -1,0 +1,261 @@
+"""ComputationGraph tests — DAG execution, vertices, serde, gradient checks.
+
+Models the reference's graph test tier: vertex behavior tests
+(`nn/graph/ComputationGraphTestRNN.java`, `TestComputationGraphNetwork.java`)
+and the comp-graph gradient-check suite
+(`gradientcheck/GradientCheckTestsComputationGraph.java`).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator, MultiDataSet
+from deeplearning4j_tpu.nn.conf import (
+    ComputationGraphConfiguration,
+    InputType,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer,
+    LSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.nn.vertices import (
+    ElementWiseVertex,
+    L2NormalizeVertex,
+    L2Vertex,
+    LastTimeStepVertex,
+    MergeVertex,
+    ReshapeVertex,
+    ScaleVertex,
+    ShiftVertex,
+    StackVertex,
+    SubsetVertex,
+    UnstackVertex,
+)
+
+
+def residual_graph(seed=3):
+    """x -> dense -> (+x skip) -> out : exercises ElementWiseVertex."""
+    return (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_in=10, n_out=10, activation="tanh"), "in")
+            .add_vertex("res", ElementWiseVertex("add"), "d1", "in")
+            .add_layer("out", OutputLayer(n_in=10, n_out=3, activation="softmax",
+                                          loss="mcxent"), "res")
+            .set_outputs("out")
+            .build())
+
+
+class TestGraphBasics:
+    def test_topo_order_and_params(self):
+        conf = residual_graph()
+        assert conf.topo_order.index("d1") < conf.topo_order.index("res")
+        assert conf.topo_order.index("res") < conf.topo_order.index("out")
+        g = ComputationGraph(conf).init()
+        assert g.num_params() == (10 * 10 + 10) + (10 * 3 + 3)
+
+    def test_cycle_detection(self):
+        b = (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_in=4, n_out=4), "b")
+             .add_layer("b", DenseLayer(n_in=4, n_out=4), "a")
+             .set_outputs("b"))
+        with pytest.raises(ValueError, match="cycle"):
+            b.build()
+
+    def test_unknown_input_rejected(self):
+        b = (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in")
+             .add_layer("a", DenseLayer(n_in=4, n_out=4), "nope")
+             .set_outputs("a"))
+        with pytest.raises(ValueError, match="unknown input"):
+            b.build()
+
+    def test_fit_learns(self, rng):
+        n = 256
+        x = rng.normal(size=(n, 10)).astype(np.float32)
+        w = rng.normal(size=(10, 3)).astype(np.float32)
+        y_idx = np.argmax(x @ w, axis=1)
+        y = np.eye(3, dtype=np.float32)[y_idx]
+        g = ComputationGraph(residual_graph()).init()
+        it = ListDataSetIterator(DataSet(x, y), 64, shuffle=True)
+        g.fit(it, epochs=30)
+        acc = g.evaluate(ListDataSetIterator(DataSet(x, y), 128)).accuracy()
+        assert acc > 0.9
+
+    def test_output_and_predict(self, rng):
+        g = ComputationGraph(residual_graph()).init()
+        x = rng.normal(size=(5, 10)).astype(np.float32)
+        out = g.output(x)
+        assert out.shape == (5, 3)
+        np.testing.assert_allclose(np.asarray(jnp.sum(out, -1)), 1.0, rtol=1e-5)
+        assert g.predict(x).shape == (5,)
+
+
+class TestMultiInputOutput:
+    def graph(self):
+        return (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_in=6, n_out=8, activation="relu"), "a")
+                .add_layer("db", DenseLayer(n_in=4, n_out=8, activation="relu"), "b")
+                .add_vertex("merge", MergeVertex(), "da", "db")
+                .add_layer("out1", OutputLayer(n_in=16, n_out=3, activation="softmax",
+                                               loss="mcxent"), "merge")
+                .add_layer("out2", OutputLayer(n_in=16, n_out=1, activation="identity",
+                                               loss="mse"), "merge")
+                .set_outputs("out1", "out2")
+                .build())
+
+    def test_two_in_two_out(self, rng):
+        g = ComputationGraph(self.graph()).init()
+        xa = rng.normal(size=(12, 6)).astype(np.float32)
+        xb = rng.normal(size=(12, 4)).astype(np.float32)
+        y1 = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 12)]
+        y2 = rng.normal(size=(12, 1)).astype(np.float32)
+        mds = MultiDataSet([xa, xb], [y1, y2])
+        g.fit([mds], epochs=3)
+        o1, o2 = g.output(xa, xb)
+        assert o1.shape == (12, 3) and o2.shape == (12, 1)
+        assert np.isfinite(g.score_)
+
+
+class TestVertices:
+    def test_elementwise_ops(self):
+        a = jnp.asarray([[1.0, 2.0]])
+        b = jnp.asarray([[3.0, 5.0]])
+        assert ElementWiseVertex("add").forward([a, b]).tolist() == [[4.0, 7.0]]
+        assert ElementWiseVertex("subtract").forward([a, b]).tolist() == [[-2.0, -3.0]]
+        assert ElementWiseVertex("product").forward([a, b]).tolist() == [[3.0, 10.0]]
+        assert ElementWiseVertex("max").forward([a, b]).tolist() == [[3.0, 5.0]]
+        assert ElementWiseVertex("average").forward([a, b]).tolist() == [[2.0, 3.5]]
+
+    def test_stack_unstack_subset(self):
+        a = jnp.ones((2, 4))
+        b = jnp.zeros((2, 4))
+        s = StackVertex().forward([a, b])
+        assert s.shape == (4, 4)
+        u = UnstackVertex(from_index=1, stack_size=2).forward([s])
+        assert float(jnp.sum(u)) == 0.0
+        sub = SubsetVertex(from_index=1, to_index=2).forward([s])
+        assert sub.shape == (4, 2)
+
+    def test_scale_shift_reshape_l2(self):
+        x = jnp.asarray([[3.0, 4.0]])
+        assert ScaleVertex(2.0).forward([x]).tolist() == [[6.0, 8.0]]
+        assert ShiftVertex(1.0).forward([x]).tolist() == [[4.0, 5.0]]
+        r = ReshapeVertex(shape=(2, 1)).forward([x])
+        assert r.shape == (1, 2, 1)
+        n = L2NormalizeVertex().forward([x])
+        np.testing.assert_allclose(np.asarray(n), [[0.6, 0.8]], rtol=1e-5)
+        d = L2Vertex().forward([x, jnp.zeros_like(x)])
+        np.testing.assert_allclose(np.asarray(d), [[5.0]], rtol=1e-4)
+
+    def test_last_time_step_with_mask(self):
+        x = jnp.arange(24, dtype=jnp.float32).reshape(2, 4, 3)
+        mask = jnp.asarray([[1, 1, 0, 0], [1, 1, 1, 1]], jnp.float32)
+        out = LastTimeStepVertex().forward([x], [mask])
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(x[0, 1]))
+        np.testing.assert_allclose(np.asarray(out[1]), np.asarray(x[1, 3]))
+
+
+class TestRnnGraph:
+    def test_lstm_graph_with_last_step(self, rng):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTMLayer(n_in=5, n_out=8), "in")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                              loss="mcxent"), "last")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = rng.normal(size=(4, 7, 5)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        g.fit(DataSet(x, y), epochs=2)
+        assert g.output(x).shape == (4, 2)
+
+    def test_rnn_time_step_stateful(self, rng):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTMLayer(n_in=3, n_out=6), "in")
+                .add_layer("out", RnnOutputLayer(n_in=6, n_out=3, activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = rng.normal(size=(2, 6, 3)).astype(np.float32)
+        full = np.asarray(g.output(x))
+        g.rnn_clear_previous_state()
+        step_outs = []
+        for t in range(6):
+            step_outs.append(np.asarray(g.rnn_time_step(x[:, t, :])))
+        np.testing.assert_allclose(np.stack(step_outs, 1), full, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestGraphSerde:
+    def test_json_roundtrip(self):
+        conf = residual_graph()
+        j = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(j)
+        assert conf2.topo_order == conf.topo_order
+        assert conf2.to_json() == j
+
+    def test_roundtrip_same_outputs(self, rng):
+        conf = residual_graph()
+        g = ComputationGraph(conf).init()
+        conf2 = ComputationGraphConfiguration.from_json(conf.to_json())
+        g2 = ComputationGraph(conf2).init()
+        x = rng.normal(size=(3, 10)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(g.output(x)), np.asarray(g2.output(x)),
+                                   rtol=1e-6)
+
+
+class TestGraphGradients:
+    def test_residual_graph_gradients(self, rng):
+        """Finite differences vs jax.grad through the DAG (comp-graph
+        gradient-check suite parity)."""
+        from deeplearning4j_tpu.util.gradient_check import check_graph_gradients
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_in=4, n_out=4, activation="tanh"), "in")
+                .add_vertex("res", ElementWiseVertex("add"), "d1", "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                              loss="mcxent"), "res")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf).init()
+        x = rng.normal(size=(3, 4))
+        y = np.eye(2)[rng.integers(0, 2, 3)]
+        assert check_graph_gradients(g, x, y, print_results=True)
+
+    def test_multi_output_gradients(self, rng):
+        from deeplearning4j_tpu.util.gradient_check import check_graph_gradients
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                .graph_builder()
+                .add_inputs("a", "b")
+                .add_layer("da", DenseLayer(n_in=3, n_out=4, activation="tanh"), "a")
+                .add_layer("db", DenseLayer(n_in=3, n_out=4, activation="sigmoid"), "b")
+                .add_vertex("m", MergeVertex(), "da", "db")
+                .add_layer("o1", OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                             loss="mcxent"), "m")
+                .add_layer("o2", OutputLayer(n_in=8, n_out=1, activation="identity",
+                                             loss="mse"), "m")
+                .set_outputs("o1", "o2")
+                .build())
+        g = ComputationGraph(conf).init()
+        xa = rng.normal(size=(3, 3))
+        xb = rng.normal(size=(3, 3))
+        y1 = np.eye(2)[rng.integers(0, 2, 3)]
+        y2 = rng.normal(size=(3, 1))
+        assert check_graph_gradients(g, [xa, xb], [y1, y2], print_results=True)

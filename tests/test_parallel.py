@@ -119,6 +119,29 @@ class TestAveraging:
         assert net.score_ < s0
 
 
+class TestRaggedBatches:
+    def test_tail_batch_not_divisible(self, rng):
+        """Dataset size not divisible by workers: tail batch must still train
+        (unsharded fallback), matching single-machine results."""
+        x, y = make_data(rng, n=100)  # batches of 16 → tail of 4 on 8 workers
+        ref = small_net()
+        dist = small_net()
+        data = [DataSet(x[s:s + 16], y[s:s + 16]) for s in range(0, 100, 16)]
+        ref.fit(data)
+        ParallelWrapper(dist, make_mesh({"data": 8}),
+                        mode="shared_gradients").fit(data)
+        for pr, pd in zip(ref.params, dist.params):
+            for n in pr:
+                np.testing.assert_allclose(np.asarray(pr[n]), np.asarray(pd[n]),
+                                           rtol=1e-4, atol=1e-5)
+
+    def test_averaging_rejects_tp(self):
+        net = small_net()
+        with pytest.raises(ValueError, match="tensor parallelism"):
+            ParallelWrapper(net, make_mesh({"data": 4, "model": 2}),
+                            mode="averaging", tp_axis="model")
+
+
 class TestTensorParallel:
     def test_tp_sharded_step(self, rng):
         """Dense weights sharded over a 'model' axis still produce the same
