@@ -1,1 +1,34 @@
-from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator  # noqa: F401
+from deeplearning4j_tpu.datasets.dataset import (  # noqa: F401
+    DataSet,
+    DataSetIterator,
+    ListDataSetIterator,
+    MultiDataSet,
+)
+from deeplearning4j_tpu.datasets.iterators import (  # noqa: F401
+    AsyncDataSetIterator,
+    AsyncMultiDataSetIterator,
+    DataSetIteratorSplitter,
+    EarlyTerminationDataSetIterator,
+    INDArrayDataSetIterator,
+    IteratorDataSetIterator,
+    MultipleEpochsIterator,
+    SamplingDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
+    CifarDataSetIterator,
+    EmnistDataSetIterator,
+    IrisDataSetIterator,
+    MnistDataFetcher,
+    MnistDataSetIterator,
+    SvhnDataSetIterator,
+    TinyImageNetDataSetIterator,
+    UciSequenceDataSetIterator,
+)
+from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
+    ImagePreProcessingScaler,
+    Normalizer,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    NormalizingIterator,
+    VGG16ImagePreProcessor,
+)

@@ -1,0 +1,214 @@
+"""Iterator utilities — async prefetch and composition.
+
+Reference: ``deeplearning4j-nn/.../datasets/iterator/`` (27 files):
+``AsyncDataSetIterator.java:30`` (background prefetch thread feeding the fit
+loop at ``MultiLayerNetwork.java:1267``), ``MultipleEpochsIterator``,
+``EarlyTerminationDataSetIterator``, ``SamplingDataSetIterator``,
+``DataSetIteratorSplitter``, ``IteratorDataSetIterator``,
+``AsyncMultiDataSetIterator``.
+
+The async iterator is the ETL/compute overlap mechanism: the host thread
+prepares (and optionally device-puts) batch N+1 while the device runs batch N.
+With jit dispatch being async already, one prefetch slot mainly hides numpy
+preprocessing cost.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch wrapper (AsyncDataSetIterator.java:30)."""
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2,
+                 device_put: Optional[Callable] = None):
+        self.base = base
+        self.queue_size = max(1, queue_size)
+        self.device_put = device_put
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
+        err: List[BaseException] = []
+
+        def producer():
+            try:
+                for ds in self.base:
+                    if self.device_put is not None:
+                        ds = self.device_put(ds)
+                    q.put(ds)
+            except BaseException as e:  # surface in consumer
+                err.append(e)
+            finally:
+                q.put(self._END)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is self._END:
+                break
+            yield item
+        t.join()
+        if err:
+            raise err[0]
+
+
+class AsyncMultiDataSetIterator(AsyncDataSetIterator):
+    """Same prefetch for MultiDataSet streams (AsyncMultiDataSetIterator)."""
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays the base iterator N times as one pass (MultipleEpochsIterator)."""
+
+    def __init__(self, base: DataSetIterator, n_epochs: int):
+        self.base = base
+        self.n_epochs = n_epochs
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for e in range(self.n_epochs):
+            if e > 0:
+                self.base.reset()
+            yield from self.base
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches per pass (EarlyTerminationDataSetIterator)."""
+
+    def __init__(self, base: DataSetIterator, max_minibatches: int):
+        if max_minibatches <= 0:
+            raise ValueError("max_minibatches must be > 0")
+        self.base = base
+        self.max_minibatches = max_minibatches
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for i, ds in enumerate(self.base):
+            if i >= self.max_minibatches:
+                break
+            yield ds
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Random with-replacement sampling of a DataSet (SamplingDataSetIterator)."""
+
+    def __init__(self, data: DataSet, batch_size: int, total_batches: int,
+                 seed: int = 0):
+        self.data = data
+        self.batch_size = batch_size
+        self.total_batches = total_batches
+        self.seed = seed
+        self._epoch = 0
+
+    def reset(self) -> None:
+        self._epoch += 1
+
+    def __iter__(self) -> Iterator[DataSet]:
+        rng = np.random.default_rng(self.seed + self._epoch)
+        f = np.asarray(self.data.features)
+        l = np.asarray(self.data.labels)
+        n = f.shape[0]
+        for _ in range(self.total_batches):
+            idx = rng.integers(0, n, size=self.batch_size)
+            yield DataSet(f[idx], l[idx])
+
+
+class DataSetIteratorSplitter:
+    """Split one iterator stream into train/test partitions
+    (DataSetIteratorSplitter.java): first ``ratio`` of each ``total_batches``
+    window goes to train, rest to test."""
+
+    def __init__(self, base: DataSetIterator, total_batches: int, ratio: float):
+        self.base = base
+        self.total_batches = total_batches
+        self.n_train = int(total_batches * ratio)
+
+    @property
+    def train(self) -> DataSetIterator:
+        return _SplitPart(self.base, 0, self.n_train, self.total_batches)
+
+    @property
+    def test(self) -> DataSetIterator:
+        return _SplitPart(self.base, self.n_train, self.total_batches,
+                          self.total_batches)
+
+
+class _SplitPart(DataSetIterator):
+    def __init__(self, base, start, end, total):
+        self.base = base
+        self.start, self.end, self.total = start, end, total
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for i, ds in enumerate(self.base):
+            if i >= self.total:
+                break
+            if self.start <= i < self.end:
+                yield ds
+
+
+class IteratorDataSetIterator(DataSetIterator):
+    """Re-batches a stream of small DataSets into ``batch_size`` examples
+    (IteratorDataSetIterator.java)."""
+
+    def __init__(self, base: DataSetIterator, batch_size: int):
+        self.base = base
+        self.batch_size = batch_size
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        buf: List[DataSet] = []
+        count = 0
+        for ds in self.base:
+            buf.append(ds)
+            count += ds.num_examples()
+            if count >= self.batch_size:
+                yield DataSet.merge(buf)
+                buf, count = [], 0
+        if buf:
+            yield DataSet.merge(buf)
+
+
+class INDArrayDataSetIterator(DataSetIterator):
+    """Iterate (features, labels) array pairs (INDArrayDataSetIterator.java)."""
+
+    def __init__(self, pairs: Sequence, batch_size: int):
+        self.pairs = list(pairs)
+        self.batch_size = batch_size
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        buf_f, buf_l, count = [], [], 0
+        for f, l in self.pairs:
+            f = np.atleast_2d(np.asarray(f))
+            l = np.atleast_2d(np.asarray(l))
+            buf_f.append(f)
+            buf_l.append(l)
+            count += f.shape[0]
+            if count >= self.batch_size:
+                yield DataSet(np.concatenate(buf_f), np.concatenate(buf_l))
+                buf_f, buf_l, count = [], [], 0
+        if buf_f:
+            yield DataSet(np.concatenate(buf_f), np.concatenate(buf_l))

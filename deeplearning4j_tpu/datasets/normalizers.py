@@ -1,0 +1,240 @@
+"""Data normalizers — fit/transform/revert preprocessing.
+
+Reference: ND4J's ``DataNormalization`` family used throughout DL4J examples
+and serialized into model zips (``ModelSerializer.addNormalizerToModel:654``):
+NormalizerStandardize (zero mean / unit variance), NormalizerMinMaxScaler,
+ImagePreProcessingScaler (pixel [0,255] → [0,1]), and the zoo's
+VGG16ImagePreProcessor (mean-RGB subtraction).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+
+NORMALIZER_REGISTRY = {}
+
+
+def register_normalizer(cls):
+    NORMALIZER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+class Normalizer:
+    """fit(iterator|DataSet) → transform/revert in place (DataNormalization)."""
+
+    fit_label: bool = False
+
+    def fit(self, data) -> "Normalizer":
+        raise NotImplementedError
+
+    def transform(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def revert(self, ds: DataSet) -> DataSet:
+        raise NotImplementedError
+
+    def pre_process(self, ds: DataSet) -> DataSet:  # DL4J alias
+        return self.transform(ds)
+
+    # -- serde ---------------------------------------------------------------
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    @staticmethod
+    def from_dict(d: dict) -> "Normalizer":
+        cls = NORMALIZER_REGISTRY[d["@normalizer"]]
+        return cls._from_dict(d)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def from_json(s: str) -> "Normalizer":
+        return Normalizer.from_dict(json.loads(s))
+
+
+def _iter_datasets(data):
+    if isinstance(data, DataSet):
+        yield data
+    else:
+        if hasattr(data, "reset"):
+            data.reset()
+        yield from data
+
+
+@register_normalizer
+class NormalizerStandardize(Normalizer):
+    """Per-feature zero-mean/unit-std (NormalizerStandardize)."""
+
+    def __init__(self):
+        self.mean: Optional[np.ndarray] = None
+        self.std: Optional[np.ndarray] = None
+
+    def fit(self, data) -> "NormalizerStandardize":
+        count, s, s2 = 0, None, None
+        for ds in _iter_datasets(data):
+            f = np.asarray(ds.features, np.float64)
+            f2 = f.reshape(-1, f.shape[-1]) if f.ndim > 2 else f
+            if s is None:
+                s = f2.sum(0)
+                s2 = (f2 ** 2).sum(0)
+            else:
+                s += f2.sum(0)
+                s2 += (f2 ** 2).sum(0)
+            count += f2.shape[0]
+        self.mean = (s / count).astype(np.float32)
+        var = s2 / count - (s / count) ** 2
+        self.std = np.sqrt(np.maximum(var, 1e-12)).astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = (np.asarray(ds.features) - self.mean) / self.std
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features) * self.std + self.mean
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def to_dict(self) -> dict:
+        return {"@normalizer": "NormalizerStandardize",
+                "mean": self.mean.tolist(), "std": self.std.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        n = cls()
+        n.mean = np.asarray(d["mean"], np.float32)
+        n.std = np.asarray(d["std"], np.float32)
+        return n
+
+
+@register_normalizer
+class NormalizerMinMaxScaler(Normalizer):
+    """Scale features to [min_range, max_range] (NormalizerMinMaxScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.data_min: Optional[np.ndarray] = None
+        self.data_max: Optional[np.ndarray] = None
+
+    def fit(self, data) -> "NormalizerMinMaxScaler":
+        lo, hi = None, None
+        for ds in _iter_datasets(data):
+            f = np.asarray(ds.features)
+            f2 = f.reshape(-1, f.shape[-1]) if f.ndim > 2 else f
+            mn, mx = f2.min(0), f2.max(0)
+            lo = mn if lo is None else np.minimum(lo, mn)
+            hi = mx if hi is None else np.maximum(hi, mx)
+        self.data_min, self.data_max = lo.astype(np.float32), hi.astype(np.float32)
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        rng = np.maximum(self.data_max - self.data_min, 1e-12)
+        f = (np.asarray(ds.features) - self.data_min) / rng
+        f = f * (self.max_range - self.min_range) + self.min_range
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        rng = np.maximum(self.data_max - self.data_min, 1e-12)
+        f = (np.asarray(ds.features) - self.min_range) / (self.max_range - self.min_range)
+        f = f * rng + self.data_min
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def to_dict(self) -> dict:
+        return {"@normalizer": "NormalizerMinMaxScaler",
+                "min_range": self.min_range, "max_range": self.max_range,
+                "data_min": self.data_min.tolist(),
+                "data_max": self.data_max.tolist()}
+
+    @classmethod
+    def _from_dict(cls, d):
+        n = cls(d["min_range"], d["max_range"])
+        n.data_min = np.asarray(d["data_min"], np.float32)
+        n.data_max = np.asarray(d["data_max"], np.float32)
+        return n
+
+
+@register_normalizer
+class ImagePreProcessingScaler(Normalizer):
+    """Pixels [0, max_pixel] → [min, max] (ImagePreProcessingScaler)."""
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        self.min_range = min_range
+        self.max_range = max_range
+        self.max_pixel = max_pixel
+
+    def fit(self, data) -> "ImagePreProcessingScaler":
+        return self  # stateless
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features) / self.max_pixel
+        f = f * (self.max_range - self.min_range) + self.min_range
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = (np.asarray(ds.features) - self.min_range) / (self.max_range - self.min_range)
+        return DataSet((f * self.max_pixel).astype(np.float32), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def to_dict(self) -> dict:
+        return {"@normalizer": "ImagePreProcessingScaler",
+                "min_range": self.min_range, "max_range": self.max_range,
+                "max_pixel": self.max_pixel}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls(d["min_range"], d["max_range"], d["max_pixel"])
+
+
+@register_normalizer
+class VGG16ImagePreProcessor(Normalizer):
+    """Subtract ImageNet mean RGB (zoo VGG16ImagePreProcessor), NHWC."""
+
+    MEAN_RGB = np.asarray([123.68, 116.779, 103.939], np.float32)
+
+    def fit(self, data) -> "VGG16ImagePreProcessor":
+        return self
+
+    def transform(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features) - self.MEAN_RGB
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def revert(self, ds: DataSet) -> DataSet:
+        f = np.asarray(ds.features) + self.MEAN_RGB
+        return DataSet(f.astype(np.float32), ds.labels, ds.features_mask,
+                       ds.labels_mask)
+
+    def to_dict(self) -> dict:
+        return {"@normalizer": "VGG16ImagePreProcessor"}
+
+    @classmethod
+    def _from_dict(cls, d):
+        return cls()
+
+
+class NormalizingIterator:
+    """Applies a fitted normalizer to every batch of a base iterator."""
+
+    def __init__(self, base, normalizer: Normalizer):
+        self.base = base
+        self.normalizer = normalizer
+
+    def reset(self):
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __iter__(self):
+        for ds in self.base:
+            yield self.normalizer.transform(ds)

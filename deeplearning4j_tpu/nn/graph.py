@@ -287,6 +287,7 @@ class ComputationGraph:
             self.params, self.states, self.updater_states, it, ep,
             inputs, labels, masks, lmasks, rng)
         self._score_arr = loss
+        self.last_batch_size = int(next(iter(inputs.values())).shape[0])
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "iteration_done"):

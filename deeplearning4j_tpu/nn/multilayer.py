@@ -261,6 +261,7 @@ class MultiLayerNetwork:
             self.params, self.states, self.updater_states, it, ep,
             x, y, mask, lmask, rng, None)
         self._score_arr = loss
+        self.last_batch_size = int(x.shape[0])
         self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "iteration_done"):

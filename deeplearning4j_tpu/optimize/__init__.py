@@ -1,0 +1,14 @@
+"""Training-loop observers and checkpoint rotation.
+
+Reference: ``optimize/listeners/`` + ``optimize/api/TrainingListener``.
+"""
+
+from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
+    CheckpointListener,
+    CollectScoresIterationListener,
+    EvaluativeListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    SleepyTrainingListener,
+    TimeIterationListener,
+)

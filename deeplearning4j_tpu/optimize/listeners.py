@@ -1,0 +1,222 @@
+"""Training listeners — the observer SPI every fit loop invokes.
+
+Reference: ``optimize/listeners/``: ``ScoreIterationListener``,
+``PerformanceListener.java:22`` (samples/sec, batches/sec ``:87-88``),
+``EvaluativeListener.java:34``, ``CollectScoresIterationListener``,
+``TimeIterationListener``, ``SleepyTrainingListener.java:28`` (latency
+injection), ``CheckpointListener.java:72`` (rotation: keepLast /
+saveEveryNIterations).
+
+Listener protocol (duck-typed, matching MultiLayerNetwork/ComputationGraph
+fit loops): ``iteration_done(model, iteration, epoch)``,
+``on_epoch_start(model)``, ``on_epoch_end(model)``.
+
+Reading ``model.score_`` forces a device sync, so throughput-oriented
+listeners (PerformanceListener) only do it when they're about to print.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+from typing import Callable, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class TrainingListener:
+    """Base (TrainingListener/IterationListener)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        pass
+
+    def on_epoch_start(self, model) -> None:
+        pass
+
+    def on_epoch_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations (ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10, printer: Callable = None):
+        self.print_iterations = max(1, print_iterations)
+        self.printer = printer or (lambda s: log.info(s))
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.print_iterations == 0:
+            self.printer(f"Score at iteration {iteration} is {model.score_}")
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput reporting (PerformanceListener.java:87-88)."""
+
+    def __init__(self, frequency: int = 10, report_score: bool = False,
+                 printer: Callable = None):
+        self.frequency = max(1, frequency)
+        self.report_score = report_score
+        self.printer = printer or (lambda s: log.info(s))
+        self._last_time: Optional[float] = None
+        self._last_iter = 0
+        self.last_samples_per_sec: Optional[float] = None
+        self.last_batches_per_sec: Optional[float] = None
+
+    def iteration_done(self, model, iteration, epoch):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time, self._last_iter = now, iteration
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            batches = iteration - self._last_iter
+            self.last_batches_per_sec = batches / dt
+            batch_size = getattr(model, "last_batch_size", None)
+            msg = (f"iteration {iteration}; {self.last_batches_per_sec:.1f} "
+                   f"batches/sec")
+            if batch_size:
+                self.last_samples_per_sec = self.last_batches_per_sec * batch_size
+                msg += f"; {self.last_samples_per_sec:.1f} samples/sec"
+            if self.report_score:
+                msg += f"; score {model.score_}"
+            self.printer(msg)
+            self._last_time, self._last_iter = now, iteration
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Collect (iteration, score) pairs (CollectScoresIterationListener)."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score_))
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging over a planned iteration count (TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 10,
+                 printer: Callable = None):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self.printer = printer or (lambda s: log.info(s))
+        self.start = time.time()
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.time() - self.start
+            remaining = elapsed / iteration * max(self.total - iteration, 0)
+            self.printer(f"iteration {iteration}/{self.total}; "
+                         f"ETA {remaining:.0f}s")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (EvaluativeListener.java:34)."""
+
+    def __init__(self, iterator, frequency: int = 1, unit: str = "epoch",
+                 printer: Callable = None):
+        if unit not in ("epoch", "iteration"):
+            raise ValueError("unit must be 'epoch' or 'iteration'")
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.unit = unit
+        self.printer = printer or (lambda s: log.info(s))
+        self.evaluations: List = []
+
+    def _evaluate(self, model):
+        e = model.evaluate(self.iterator)
+        self.evaluations.append(e)
+        self.printer(f"Evaluation: accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.unit == "iteration" and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model):
+        if self.unit == "epoch" and (model.epoch + 1) % self.frequency == 0:
+            self._evaluate(model)
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Latency injection for debugging/fault testing
+    (SleepyTrainingListener.java:28, wired via debugLongerIterations in
+    SharedTrainingWrapper:250-253)."""
+
+    def __init__(self, timer_iteration_ms: float = 0.0, timer_epoch_ms: float = 0.0):
+        self.timer_iteration_ms = timer_iteration_ms
+        self.timer_epoch_ms = timer_epoch_ms
+
+    def iteration_done(self, model, iteration, epoch):
+        if self.timer_iteration_ms > 0:
+            time.sleep(self.timer_iteration_ms / 1e3)
+
+    def on_epoch_end(self, model):
+        if self.timer_epoch_ms > 0:
+            time.sleep(self.timer_epoch_ms / 1e3)
+
+
+class CheckpointListener(TrainingListener):
+    """Periodic checkpointing with rotation (CheckpointListener.java:72-144).
+
+    ``keep_last=n`` keeps the newest n checkpoints; ``keep_every_n`` also
+    retains every n-th (keepLastAndEvery). Save cadence:
+    ``save_every_n_iterations`` or ``save_every_n_epochs``.
+    """
+
+    def __init__(self, model_dir, *, save_every_n_iterations: Optional[int] = None,
+                 save_every_n_epochs: Optional[int] = None,
+                 keep_last: Optional[int] = None,
+                 keep_every_n: Optional[int] = None,
+                 save_updater: bool = True):
+        if save_every_n_iterations is None and save_every_n_epochs is None:
+            raise ValueError("set save_every_n_iterations or save_every_n_epochs")
+        self.dir = Path(model_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.save_every_n_iterations = save_every_n_iterations
+        self.save_every_n_epochs = save_every_n_epochs
+        self.keep_last = keep_last
+        self.keep_every_n = keep_every_n
+        self.save_updater = save_updater
+        self._counter = 0
+
+    # -- persistence ---------------------------------------------------------
+    def _save(self, model, iteration, epoch):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        self._counter += 1
+        name = f"checkpoint_{self._counter}_iter_{iteration}_epoch_{epoch}.zip"
+        write_model(model, self.dir / name, save_updater=self.save_updater)
+        self._rotate()
+
+    def _checkpoints(self) -> List[Path]:
+        return sorted(self.dir.glob("checkpoint_*.zip"),
+                      key=lambda p: int(p.name.split("_")[1]))
+
+    def _rotate(self):
+        if self.keep_last is None:
+            return
+        cps = self._checkpoints()
+        excess = cps[:-self.keep_last] if self.keep_last else cps
+        for p in excess:
+            num = int(p.name.split("_")[1])
+            if self.keep_every_n and num % self.keep_every_n == 0:
+                continue
+            p.unlink()
+
+    def last_checkpoint(self) -> Optional[Path]:
+        cps = self._checkpoints()
+        return cps[-1] if cps else None
+
+    # -- hooks ---------------------------------------------------------------
+    def iteration_done(self, model, iteration, epoch):
+        if (self.save_every_n_iterations and
+                iteration % self.save_every_n_iterations == 0):
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model):
+        ep = model.epoch + 1
+        if self.save_every_n_epochs and ep % self.save_every_n_epochs == 0:
+            self._save(model, model.iteration, ep)
