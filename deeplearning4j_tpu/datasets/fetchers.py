@@ -133,7 +133,8 @@ class EmnistDataSetIterator(ListDataSetIterator):
         if img_p is not None and lbl_p is not None:
             x = _read_idx(img_p).astype(np.float32)[..., None] / 255.0
             lab = _read_idx(lbl_p).astype(np.int64)
-            lab = lab - lab.min()  # letters set is 1-indexed
+            if dataset == "letters":  # the letters set alone is 1-indexed
+                lab = lab - 1
             self.synthetic = False
         else:
             x, lab = _synthetic_images(2048 if train else 512, 28, 28, 1,
@@ -170,50 +171,49 @@ class CifarDataSetIterator(ListDataSetIterator):
         super().__init__(DataSet(x.astype(np.float32), y), batch_size, shuffle, seed)
 
 
-class TinyImageNetDataSetIterator(ListDataSetIterator):
-    """TinyImageNet (TinyImageNetFetcher): 64x64x3, 200 classes; synthetic
-    stand-in unless cached numpy arrays exist."""
+class _CachedNpyIterator(ListDataSetIterator):
+    """Shared cache-or-synthetic loader: ``<dir>/<split>_{x,y}.npy`` if
+    present, else deterministic synthetic stand-ins (the reference's
+    ``CacheableExtractableDataSetFetcher`` downloads instead; this image has
+    no egress)."""
 
-    def __init__(self, batch_size: int, train: bool = True, *, shuffle=True,
-                 seed: int = 123, n_classes: int = 200):
-        base = data_dir() / "tinyimagenet"
-        split = "train" if train else "val"
+    def __init__(self, batch_size: int, *, dir_name: str, split: str,
+                 n_synth: int, hw: int, n_classes: int,
+                 shuffle=True, seed: int = 123):
+        base = data_dir() / dir_name
         xp, yp = base / f"{split}_x.npy", base / f"{split}_y.npy"
         if xp.exists() and yp.exists():
             x = np.load(xp).astype(np.float32) / 255.0
             lab = np.load(yp).astype(np.int64)
             self.synthetic = False
         else:
-            x, lab = _synthetic_images(1024 if train else 256, 64, 64, 3,
-                                       n_classes, seed)
+            x, lab = _synthetic_images(n_synth, hw, hw, 3, n_classes, seed)
             x = x / 255.0
             self.synthetic = True
         y = np.eye(n_classes, dtype=np.float32)[lab]
         super().__init__(DataSet(x.astype(np.float32), y), batch_size, shuffle, seed)
 
 
-class SvhnDataSetIterator(TinyImageNetDataSetIterator):
+class TinyImageNetDataSetIterator(_CachedNpyIterator):
+    """TinyImageNet (TinyImageNetFetcher): 64x64x3, 200 classes."""
+
+    def __init__(self, batch_size: int, train: bool = True, *, shuffle=True,
+                 seed: int = 123, n_classes: int = 200):
+        super().__init__(batch_size, dir_name="tinyimagenet",
+                         split="train" if train else "val",
+                         n_synth=1024 if train else 256, hw=64,
+                         n_classes=n_classes, shuffle=shuffle, seed=seed)
+
+
+class SvhnDataSetIterator(_CachedNpyIterator):
     """SVHN (SvhnDataFetcher): 32x32x3 digits, same cache-or-synthetic policy."""
 
-    def __init__(self, batch_size: int, train: bool = True, **kw):
-        kw.setdefault("n_classes", 10)
-        base = data_dir() / "svhn"
-        split = "train" if train else "test"
-        xp, yp = base / f"{split}_x.npy", base / f"{split}_y.npy"
-        if xp.exists() and yp.exists():
-            x = np.load(xp).astype(np.float32) / 255.0
-            lab = np.load(yp).astype(np.int64)
-            self.synthetic = False
-            y = np.eye(10, dtype=np.float32)[lab]
-            ListDataSetIterator.__init__(self, DataSet(x, y), batch_size,
-                                         kw.get("shuffle", True), kw.get("seed", 123))
-        else:
-            x, lab = _synthetic_images(1024 if train else 256, 32, 32, 3, 10,
-                                       kw.get("seed", 123))
-            self.synthetic = True
-            y = np.eye(10, dtype=np.float32)[lab]
-            ListDataSetIterator.__init__(self, DataSet(x / 255.0, y), batch_size,
-                                         kw.get("shuffle", True), kw.get("seed", 123))
+    def __init__(self, batch_size: int, train: bool = True, *, shuffle=True,
+                 seed: int = 123):
+        super().__init__(batch_size, dir_name="svhn",
+                         split="train" if train else "test",
+                         n_synth=1024 if train else 256, hw=32,
+                         n_classes=10, shuffle=shuffle, seed=seed)
 
 
 class IrisDataSetIterator(ListDataSetIterator):

@@ -41,26 +41,42 @@ class AsyncDataSetIterator(DataSetIterator):
     def __iter__(self) -> Iterator[DataSet]:
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
         err: List[BaseException] = []
+        stop = threading.Event()
+
+        def put_unless_stopped(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 for ds in self.base:
                     if self.device_put is not None:
                         ds = self.device_put(ds)
-                    q.put(ds)
+                    if not put_unless_stopped(ds):
+                        return
             except BaseException as e:  # surface in consumer
                 err.append(e)
             finally:
-                q.put(self._END)
+                put_unless_stopped(self._END)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is self._END:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            # Consumer may stop early (EarlyTermination*, break in fit loop):
+            # unblock and retire the producer instead of leaking it.
+            stop.set()
+            t.join()
         if err:
             raise err[0]
 
@@ -131,38 +147,50 @@ class SamplingDataSetIterator(DataSetIterator):
 
 class DataSetIteratorSplitter:
     """Split one iterator stream into train/test partitions
-    (DataSetIteratorSplitter.java): first ``ratio`` of each ``total_batches``
-    window goes to train, rest to test."""
+    (DataSetIteratorSplitter.java): first ``ratio`` of ``total_batches``
+    goes to train, rest to test.
+
+    The window of ``total_batches`` is materialized from ONE pass over the
+    base iterator and shared by both parts, so a shuffling base cannot leak
+    test batches into train across resets (re-iterating the base per part
+    would re-shuffle the example→batch assignment each pass).
+    """
 
     def __init__(self, base: DataSetIterator, total_batches: int, ratio: float):
         self.base = base
         self.total_batches = total_batches
         self.n_train = int(total_batches * ratio)
+        self._window: Optional[List[DataSet]] = None
+
+    def _batches(self) -> List[DataSet]:
+        if self._window is None:
+            w: List[DataSet] = []
+            for i, ds in enumerate(self.base):
+                if i >= self.total_batches:
+                    break
+                w.append(ds)
+            self._window = w
+        return self._window
 
     @property
     def train(self) -> DataSetIterator:
-        return _SplitPart(self.base, 0, self.n_train, self.total_batches)
+        return _SplitPart(self, 0, self.n_train)
 
     @property
     def test(self) -> DataSetIterator:
-        return _SplitPart(self.base, self.n_train, self.total_batches,
-                          self.total_batches)
+        return _SplitPart(self, self.n_train, self.total_batches)
 
 
 class _SplitPart(DataSetIterator):
-    def __init__(self, base, start, end, total):
-        self.base = base
-        self.start, self.end, self.total = start, end, total
+    def __init__(self, splitter: DataSetIteratorSplitter, start: int, end: int):
+        self.splitter = splitter
+        self.start, self.end = start, end
 
     def reset(self) -> None:
-        self.base.reset()
+        pass  # replays the shared materialized window
 
     def __iter__(self) -> Iterator[DataSet]:
-        for i, ds in enumerate(self.base):
-            if i >= self.total:
-                break
-            if self.start <= i < self.end:
-                yield ds
+        yield from self.splitter._batches()[self.start:self.end]
 
 
 class IteratorDataSetIterator(DataSetIterator):

@@ -275,6 +275,7 @@ class MultiLayerNetwork:
         length = self.conf.tbptt_fwd_length
         n_chunks = max(1, math.ceil(t_total / length))
         batch = x.shape[0]
+        self.last_batch_size = int(batch)
         dtype = x.dtype
         carries = [l.init_carry(batch, dtype) if isinstance(l, BaseRecurrentLayer) else None
                    for l in self.layers]

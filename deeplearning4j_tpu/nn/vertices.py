@@ -71,10 +71,10 @@ class MergeVertex(GraphVertex):
 
     def output_type(self, input_types: List[InputType]) -> InputType:
         t0 = input_types[0]
-        if t0.kind == "convolutional":
+        if t0.kind == "cnn":
             return InputType.convolutional(
                 t0.height, t0.width, sum(t.channels for t in input_types))
-        if t0.kind == "recurrent":
+        if t0.kind == "rnn":
             return InputType.recurrent(sum(t.size for t in input_types), t0.timesteps)
         return InputType.feed_forward(sum(t.size for t in input_types))
 
@@ -156,7 +156,7 @@ class SubsetVertex(GraphVertex):
     def output_type(self, input_types):
         n = self.to_index - self.from_index + 1
         t0 = input_types[0]
-        if t0.kind == "recurrent":
+        if t0.kind == "rnn":
             return InputType.recurrent(n, t0.timesteps)
         return InputType.feed_forward(n)
 

@@ -137,7 +137,9 @@ class EvaluativeListener(TrainingListener):
             self._evaluate(model)
 
     def on_epoch_end(self, model):
-        if self.unit == "epoch" and (model.epoch + 1) % self.frequency == 0:
+        # model.epoch is already the completed-epoch count here (the fit loop
+        # increments it before firing on_epoch_end).
+        if self.unit == "epoch" and model.epoch % self.frequency == 0:
             self._evaluate(model)
 
 
@@ -174,6 +176,8 @@ class CheckpointListener(TrainingListener):
                  save_updater: bool = True):
         if save_every_n_iterations is None and save_every_n_epochs is None:
             raise ValueError("set save_every_n_iterations or save_every_n_epochs")
+        if keep_last is not None and keep_last < 1:
+            raise ValueError("keep_last must be >= 1 (or None to keep all)")
         self.dir = Path(model_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.save_every_n_iterations = save_every_n_iterations
@@ -217,6 +221,7 @@ class CheckpointListener(TrainingListener):
             self._save(model, iteration, epoch)
 
     def on_epoch_end(self, model):
-        ep = model.epoch + 1
+        # model.epoch is already the completed-epoch count here.
+        ep = model.epoch
         if self.save_every_n_epochs and ep % self.save_every_n_epochs == 0:
             self._save(model, model.iteration, ep)

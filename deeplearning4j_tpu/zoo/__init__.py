@@ -1,0 +1,36 @@
+"""Model zoo: 13 instantiable architectures + selector + pretrained loading.
+
+Reference: ``deeplearning4j-zoo/`` (``ZooModel.java``, ``ModelSelector.java``,
+13 models under ``zoo/model/``).
+"""
+
+from deeplearning4j_tpu.zoo.zoo_model import (
+    ModelMetaData,
+    ModelSelector,
+    PretrainedType,
+    ZooModel,
+    register_zoo_model,
+)
+from deeplearning4j_tpu.zoo.models import (
+    AlexNet,
+    Darknet19,
+    FaceNetNN4Small2,
+    GoogLeNet,
+    InceptionResNetV1,
+    LeNet,
+    ResNet50,
+    SimpleCNN,
+    TextGenerationLSTM,
+    TinyYOLO,
+    VGG16,
+    VGG19,
+    YOLO2,
+)
+
+__all__ = [
+    "ModelMetaData", "ModelSelector", "PretrainedType", "ZooModel",
+    "register_zoo_model",
+    "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
+    "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
+    "TextGenerationLSTM", "TinyYOLO", "VGG16", "VGG19", "YOLO2",
+]

@@ -1,0 +1,599 @@
+"""The 13 zoo architectures.
+
+Reference: ``deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/``
+(AlexNet, Darknet19, FaceNetNN4Small2, GoogLeNet, InceptionResNetV1, LeNet,
+ResNet50, SimpleCNN, TextGenerationLSTM, TinyYOLO, VGG16, VGG19, YOLO2).
+Configs are built on the TPU-native builder DSL; data layout is NHWC (the
+TPU-friendly layout) rather than the reference's NCHW, and convs fold their
+batch-norms' scale at inference via XLA fusion rather than cuDNN algo modes.
+
+``ModelMetaData.input_shape`` keeps DL4J's CHW ordering for documentation
+parity; actual arrays are NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalizationLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    DropoutLayer,
+    GlobalPoolingLayer,
+    GravesLSTMLayer,
+    LocalResponseNormalizationLayer,
+    LossLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer
+from deeplearning4j_tpu.nn.layers.output import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.updaters import Adam, AdaDelta, Nesterovs
+from deeplearning4j_tpu.nn.vertices import L2NormalizeVertex, MergeVertex
+from deeplearning4j_tpu.zoo.helpers import (
+    conv_bn_act,
+    darknet_block,
+    inception_module,
+    inception_resnet_block_a,
+    inception_resnet_block_b,
+    inception_resnet_block_c,
+    resnet_conv_block,
+    resnet_identity_block,
+)
+from deeplearning4j_tpu.zoo.zoo_model import ModelMetaData, ZooModel, register_zoo_model
+
+
+@register_zoo_model
+class LeNet(ZooModel):
+    """LeNet-5-style CNN (``zoo/model/LeNet.java``: 20/50 conv, 500 dense)."""
+
+    def __init__(self, num_labels: int = 10, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (1, 28, 28)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        return (NeuralNetConfiguration.builder().seed(self.seed)
+                .activation("identity").weight_init("xavier")
+                .updater(AdaDelta()).list()
+                .layer(ConvolutionLayer(n_out=20, kernel_size=(5, 5), stride=(1, 1),
+                                        convolution_mode="same", activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=50, kernel_size=(5, 5), stride=(1, 1),
+                                        convolution_mode="same", activation="identity"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(n_out=500, activation="relu"))
+                .layer(OutputLayer(n_out=self.num_labels, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(h, w, c)).build())
+
+
+@register_zoo_model
+class SimpleCNN(ZooModel):
+    """Conv/BN/avg-pool stack ending in a fully convolutional softmax head
+    (``zoo/model/SimpleCNN.java:77-125``)."""
+
+    def __init__(self, num_labels: int = 10, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 48, 48)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        b = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").weight_init("relu").updater(AdaDelta()).list())
+        # block 1: two 7x7 convs @16
+        b.layer(ConvolutionLayer(n_out=16, kernel_size=(7, 7), convolution_mode="same"))
+        b.layer(BatchNormalizationLayer())
+        b.layer(ConvolutionLayer(n_out=16, kernel_size=(7, 7), convolution_mode="same"))
+        b.layer(BatchNormalizationLayer())
+        b.layer(ActivationLayer(activation="relu"))
+        b.layer(SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2)))
+        b.layer(DropoutLayer(dropout=0.5))
+        for n in (32, 64, 128):
+            k = 5 if n == 32 else 3
+            b.layer(ConvolutionLayer(n_out=n, kernel_size=(k, k), convolution_mode="same"))
+            b.layer(BatchNormalizationLayer())
+            b.layer(ConvolutionLayer(n_out=n, kernel_size=(k, k), convolution_mode="same"))
+            b.layer(BatchNormalizationLayer())
+            b.layer(ActivationLayer(activation="relu"))
+            b.layer(SubsamplingLayer(pooling_type="avg", kernel_size=(2, 2), stride=(2, 2)))
+            b.layer(DropoutLayer(dropout=0.5))
+        b.layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), convolution_mode="same"))
+        b.layer(BatchNormalizationLayer())
+        b.layer(ConvolutionLayer(n_out=self.num_labels, kernel_size=(3, 3),
+                                 convolution_mode="same", activation="identity"))
+        b.layer(GlobalPoolingLayer(pooling_type="avg"))
+        b.layer(LossLayer(loss="mcxent", activation="softmax"))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+@register_zoo_model
+class AlexNet(ZooModel):
+    """AlexNet (one-tower variant, ``zoo/model/AlexNet.java``)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        from deeplearning4j_tpu.nn.weights import Distribution
+        return (NeuralNetConfiguration.builder().seed(self.seed)
+                .activation("relu")
+                .weight_init("distribution", Distribution("normal", 0.0, 0.005))
+                .updater(Nesterovs(1e-2, 0.9)).l2(5e-4).list()
+                .layer(ConvolutionLayer(n_out=64, kernel_size=(11, 11), stride=(4, 4),
+                                        padding=(3, 3)))
+                .layer(LocalResponseNormalizationLayer())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=192, kernel_size=(5, 5), convolution_mode="same"))
+                .layer(LocalResponseNormalizationLayer())
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(n_out=384, kernel_size=(3, 3), convolution_mode="same"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), convolution_mode="same"))
+                .layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3), convolution_mode="same"))
+                .layer(SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(DenseLayer(n_out=4096, dropout=0.5))
+                .layer(OutputLayer(n_out=self.num_labels, loss="mcxent", activation="softmax"))
+                .set_input_type(InputType.convolutional(h, w, c)).build())
+
+
+def _vgg_conf(blocks, num_labels, seed, input_shape):
+    c, h, w = input_shape
+    b = (NeuralNetConfiguration.builder().seed(seed)
+         .activation("relu").weight_init("xavier").updater(Nesterovs(1e-2, 0.9)).list())
+    for n_convs, n_out in blocks:
+        for _ in range(n_convs):
+            b.layer(ConvolutionLayer(n_out=n_out, kernel_size=(3, 3), convolution_mode="same"))
+        b.layer(SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)))
+    b.layer(DenseLayer(n_out=4096, dropout=0.5))
+    b.layer(DenseLayer(n_out=4096, dropout=0.5))
+    b.layer(OutputLayer(n_out=num_labels, loss="mcxent", activation="softmax"))
+    return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+
+@register_zoo_model
+class VGG16(ZooModel):
+    """VGG-16 (``zoo/model/VGG16.java``; Simonyan & Zisserman 2014)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        return _vgg_conf([(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)],
+                         self.num_labels, self.seed, self.input_shape)
+
+
+@register_zoo_model
+class VGG19(ZooModel):
+    """VGG-19 (``zoo/model/VGG19.java``)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        return _vgg_conf([(2, 64), (2, 128), (4, 256), (4, 512), (4, 512)],
+                         self.num_labels, self.seed, self.input_shape)
+
+
+@register_zoo_model
+class Darknet19(ZooModel):
+    """Darknet-19 classifier (``zoo/model/Darknet19.java`` via DarknetHelper)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Nesterovs(1e-3, 0.9)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        x = darknet_block(g, 1, "input", 32, pool=2)
+        x = darknet_block(g, 2, x, 64, pool=2)
+        x = darknet_block(g, 3, x, 128)
+        x = darknet_block(g, 4, x, 64, filter_size=1)
+        x = darknet_block(g, 5, x, 128, pool=2)
+        x = darknet_block(g, 6, x, 256)
+        x = darknet_block(g, 7, x, 128, filter_size=1)
+        x = darknet_block(g, 8, x, 256, pool=2)
+        x = darknet_block(g, 9, x, 512)
+        x = darknet_block(g, 10, x, 256, filter_size=1)
+        x = darknet_block(g, 11, x, 512)
+        x = darknet_block(g, 12, x, 256, filter_size=1)
+        x = darknet_block(g, 13, x, 512, pool=2)
+        x = darknet_block(g, 14, x, 1024)
+        x = darknet_block(g, 15, x, 512, filter_size=1)
+        x = darknet_block(g, 16, x, 1024)
+        x = darknet_block(g, 17, x, 512, filter_size=1)
+        x = darknet_block(g, 18, x, 1024)
+        g.add_layer("convolution2d_19",
+                    ConvolutionLayer(n_out=self.num_labels, kernel_size=(1, 1),
+                                     convolution_mode="same", activation="identity"), x)
+        g.add_layer("globalpooling", GlobalPoolingLayer(pooling_type="avg"),
+                    "convolution2d_19")
+        g.add_layer("loss", LossLayer(loss="mcxent", activation="softmax"),
+                    "globalpooling")
+        return g.set_outputs("loss").build()
+
+
+# Anchor priors from the reference (TinyYOLO.java / YOLO2.java), grid units.
+TINY_YOLO_ANCHORS = ((1.08, 1.19), (3.42, 4.41), (6.63, 11.38), (9.42, 5.11), (16.62, 10.52))
+YOLO2_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
+                 (7.88282, 3.52778), (9.77052, 9.16828))
+
+
+@register_zoo_model
+class TinyYOLO(ZooModel):
+    """Tiny YOLOv2 detector (``zoo/model/TinyYOLO.java``)."""
+
+    def __init__(self, num_labels: int = 20, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 416, 416)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        nb = len(TINY_YOLO_ANCHORS)
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Adam(1e-3)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        x = darknet_block(g, 1, "input", 16, pool=2)
+        x = darknet_block(g, 2, x, 32, pool=2)
+        x = darknet_block(g, 3, x, 64, pool=2)
+        x = darknet_block(g, 4, x, 128, pool=2)
+        x = darknet_block(g, 5, x, 256, pool=2)
+        x = darknet_block(g, 6, x, 512, pool=2, pool_stride=1)
+        x = darknet_block(g, 7, x, 1024)
+        x = darknet_block(g, 8, x, 1024)
+        g.add_layer("convolution2d_9",
+                    ConvolutionLayer(n_out=nb * (5 + self.num_labels), kernel_size=(1, 1),
+                                     convolution_mode="same", activation="identity"), x)
+        g.add_layer("outputs", Yolo2OutputLayer(boxes=TINY_YOLO_ANCHORS,
+                                                n_classes=self.num_labels),
+                    "convolution2d_9")
+        return g.set_outputs("outputs").build()
+
+
+@register_zoo_model
+class YOLO2(ZooModel):
+    """YOLOv2 with Darknet-19 backbone + passthrough reorg
+    (``zoo/model/YOLO2.java``: SpaceToDepth passthrough merged before head)."""
+
+    def __init__(self, num_labels: int = 80, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 608, 608)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.layers.conv import SpaceToDepthLayer
+        c, h, w = self.input_shape
+        nb = len(YOLO2_ANCHORS)
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Adam(1e-3)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        x = darknet_block(g, 1, "input", 32, pool=2)
+        x = darknet_block(g, 2, x, 64, pool=2)
+        x = darknet_block(g, 3, x, 128)
+        x = darknet_block(g, 4, x, 64, filter_size=1)
+        x = darknet_block(g, 5, x, 128, pool=2)
+        x = darknet_block(g, 6, x, 256)
+        x = darknet_block(g, 7, x, 128, filter_size=1)
+        x = darknet_block(g, 8, x, 256, pool=2)
+        x = darknet_block(g, 9, x, 512)
+        x = darknet_block(g, 10, x, 256, filter_size=1)
+        x = darknet_block(g, 11, x, 512)
+        x = darknet_block(g, 12, x, 256, filter_size=1)
+        passthrough = darknet_block(g, 13, x, 512)  # 1/16 resolution feature map
+        g.add_layer("maxpooling2d_13",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(2, 2), stride=(2, 2)),
+                    passthrough)
+        x = darknet_block(g, 14, "maxpooling2d_13", 1024)
+        x = darknet_block(g, 15, x, 512, filter_size=1)
+        x = darknet_block(g, 16, x, 1024)
+        x = darknet_block(g, 17, x, 512, filter_size=1)
+        x = darknet_block(g, 18, x, 1024)
+        x = darknet_block(g, 19, x, 1024)
+        x = darknet_block(g, 20, x, 1024)
+        # passthrough: reorg 1/16 map to 1/32 and concat with the deep map
+        g.add_layer("reorg", SpaceToDepthLayer(block_size=2), passthrough)
+        g.add_vertex("concat", MergeVertex(), "reorg", x)
+        x = darknet_block(g, 21, "concat", 1024)
+        g.add_layer("convolution2d_22",
+                    ConvolutionLayer(n_out=nb * (5 + self.num_labels), kernel_size=(1, 1),
+                                     convolution_mode="same", activation="identity"), x)
+        g.add_layer("outputs", Yolo2OutputLayer(boxes=YOLO2_ANCHORS,
+                                                n_classes=self.num_labels),
+                    "convolution2d_22")
+        return g.set_outputs("outputs").build()
+
+
+@register_zoo_model
+class ResNet50(ZooModel):
+    """ResNet-50 (``zoo/model/ResNet50.java:89-216``): 7x7 stem then
+    [3,4,6,3] bottleneck stages."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("identity").weight_init("xavier")
+             .updater(Nesterovs(1e-2, 0.9)).l1(1e-7).l2(5e-5).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        g.add_layer("stem-zero", ZeroPaddingLayer(padding=(3, 3)), "input")
+        g.add_layer("stem-cnn1",
+                    ConvolutionLayer(n_out=64, kernel_size=(7, 7), stride=(2, 2),
+                                     activation="identity"), "stem-zero")
+        g.add_layer("stem-batch1", BatchNormalizationLayer(activation="identity"), "stem-cnn1")
+        g.add_layer("stem-act1", ActivationLayer(activation="relu"), "stem-batch1")
+        g.add_layer("stem-maxpool1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)),
+                    "stem-act1")
+        x = resnet_conv_block(g, (3, 3), (64, 64, 256), "2", "a", "stem-maxpool1",
+                              stride=(2, 2))
+        x = resnet_identity_block(g, (3, 3), (64, 64, 256), "2", "b", x)
+        x = resnet_identity_block(g, (3, 3), (64, 64, 256), "2", "c", x)
+        x = resnet_conv_block(g, (3, 3), (128, 128, 512), "3", "a", x)
+        for blk in "bcd":
+            x = resnet_identity_block(g, (3, 3), (128, 128, 512), "3", blk, x)
+        x = resnet_conv_block(g, (3, 3), (256, 256, 1024), "4", "a", x)
+        for blk in "bcdef":
+            x = resnet_identity_block(g, (3, 3), (256, 256, 1024), "4", blk, x)
+        x = resnet_conv_block(g, (3, 3), (512, 512, 2048), "5", "a", x)
+        for blk in "bc":
+            x = resnet_identity_block(g, (3, 3), (512, 512, 2048), "5", blk, x)
+        g.add_layer("avgpool",
+                    SubsamplingLayer(pooling_type="avg", kernel_size=(3, 3), stride=(1, 1),
+                                     convolution_mode="same"), x)
+        g.add_layer("globalpool", GlobalPoolingLayer(pooling_type="avg"), "avgpool")
+        g.add_layer("fc1000", OutputLayer(n_out=self.num_labels, loss="mcxent",
+                                          activation="softmax"), "globalpool")
+        return g.set_outputs("fc1000").build()
+
+
+@register_zoo_model
+class GoogLeNet(ZooModel):
+    """GoogLeNet / Inception-v1 (``zoo/model/GoogLeNet.java``)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 224, 224)):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").weight_init("xavier")
+             .updater(Nesterovs(1e-2, 0.9)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        g.add_layer("cnn1", ConvolutionLayer(n_out=64, kernel_size=(7, 7), stride=(2, 2),
+                                             convolution_mode="same"), "input")
+        g.add_layer("max1", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                             stride=(2, 2), convolution_mode="same"), "cnn1")
+        g.add_layer("lrn1", LocalResponseNormalizationLayer(), "max1")
+        g.add_layer("cnn2", ConvolutionLayer(n_out=64, kernel_size=(1, 1)), "lrn1")
+        g.add_layer("cnn3", ConvolutionLayer(n_out=192, kernel_size=(3, 3),
+                                             convolution_mode="same"), "cnn2")
+        g.add_layer("lrn2", LocalResponseNormalizationLayer(), "cnn3")
+        g.add_layer("max2", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                             stride=(2, 2), convolution_mode="same"), "lrn2")
+        x = inception_module(g, "3a", "max2", 64, 96, 128, 16, 32, 32)
+        x = inception_module(g, "3b", x, 128, 128, 192, 32, 96, 64)
+        g.add_layer("max3", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                             stride=(2, 2), convolution_mode="same"), x)
+        x = inception_module(g, "4a", "max3", 192, 96, 208, 16, 48, 64)
+        x = inception_module(g, "4b", x, 160, 112, 224, 24, 64, 64)
+        x = inception_module(g, "4c", x, 128, 128, 256, 24, 64, 64)
+        x = inception_module(g, "4d", x, 112, 144, 288, 32, 64, 64)
+        x = inception_module(g, "4e", x, 256, 160, 320, 32, 128, 128)
+        g.add_layer("max4", SubsamplingLayer(pooling_type="max", kernel_size=(3, 3),
+                                             stride=(2, 2), convolution_mode="same"), x)
+        x = inception_module(g, "5a", "max4", 256, 160, 320, 32, 128, 128)
+        x = inception_module(g, "5b", x, 384, 192, 384, 48, 128, 128)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("dropout", DropoutLayer(dropout=0.4), "avgpool")
+        g.add_layer("output", OutputLayer(n_out=self.num_labels, loss="mcxent",
+                                          activation="softmax"), "dropout")
+        return g.set_outputs("output").build()
+
+
+@register_zoo_model
+class InceptionResNetV1(ZooModel):
+    """Inception-ResNet-v1 with center-loss embedding head
+    (``zoo/model/InceptionResNetV1.java``: stem → 5×A → reduction →
+    10×B → reduction → 5×C → bottleneck → center-loss output)."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 160, 160),
+                 embedding_size: int = 128):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+        self.embedding_size = embedding_size
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def _stem(self, g, inp):
+        x = conv_bn_act(g, "stem-1", inp, 32, (3, 3), (2, 2))
+        x = conv_bn_act(g, "stem-2", x, 32, (3, 3))
+        x = conv_bn_act(g, "stem-3", x, 64, (3, 3))
+        g.add_layer("stem-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        x = conv_bn_act(g, "stem-4", "stem-pool", 80, (1, 1))
+        x = conv_bn_act(g, "stem-5", x, 192, (3, 3))
+        x = conv_bn_act(g, "stem-6", x, 256, (3, 3), (2, 2))
+        return x
+
+    def conf(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").weight_init("relu")
+             .updater(Adam(1e-3)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        x = self._stem(g, "input")
+        for i in range(5):
+            x = inception_resnet_block_a(g, f"block35-{i}", x, 0.17)
+        # reduction A: 256 → 896 channels, spatial /2
+        ra_b1 = conv_bn_act(g, "redA-b1", x, 384, (3, 3), (2, 2))
+        ra_b2a = conv_bn_act(g, "redA-b2a", x, 192, (1, 1))
+        ra_b2b = conv_bn_act(g, "redA-b2b", ra_b2a, 192, (3, 3))
+        ra_b2 = conv_bn_act(g, "redA-b2c", ra_b2b, 256, (3, 3), (2, 2))
+        g.add_layer("redA-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        g.add_vertex("redA", MergeVertex(), ra_b1, ra_b2, "redA-pool")
+        x = "redA"
+        for i in range(10):
+            x = inception_resnet_block_b(g, f"block17-{i}", x, 0.10)
+        # reduction B: 896 → 1792, spatial /2
+        rb_b1a = conv_bn_act(g, "redB-b1a", x, 256, (1, 1))
+        rb_b1 = conv_bn_act(g, "redB-b1b", rb_b1a, 384, (3, 3), (2, 2))
+        rb_b2a = conv_bn_act(g, "redB-b2a", x, 256, (1, 1))
+        rb_b2 = conv_bn_act(g, "redB-b2b", rb_b2a, 256, (3, 3), (2, 2))
+        rb_b3a = conv_bn_act(g, "redB-b3a", x, 256, (1, 1))
+        rb_b3b = conv_bn_act(g, "redB-b3b", rb_b3a, 256, (3, 3))
+        rb_b3 = conv_bn_act(g, "redB-b3c", rb_b3b, 256, (3, 3), (2, 2))
+        g.add_layer("redB-pool",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        g.add_vertex("redB", MergeVertex(), rb_b1, rb_b2, rb_b3, "redB-pool")
+        x = "redB"
+        for i in range(5):
+            x = inception_resnet_block_c(g, f"block8-{i}", x, 0.20)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("dropout", DropoutLayer(dropout=0.8), "avgpool")
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"), "dropout")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("lossLayer",
+                    CenterLossOutputLayer(n_out=self.num_labels, loss="mcxent",
+                                          activation="softmax", alpha=0.9, lambda_=1e-4),
+                    "embeddings")
+        return g.set_outputs("lossLayer").build()
+
+
+@register_zoo_model
+class FaceNetNN4Small2(ZooModel):
+    """FaceNet NN4.small2 embedding net (``zoo/model/FaceNetNN4Small2.java``):
+    inception-style trunk → 128-d L2-normalized embedding → center loss."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape: Tuple[int, int, int] = (3, 96, 96),
+                 embedding_size: int = 128):
+        super().__init__(num_labels, seed)
+        self.input_shape = input_shape
+        self.embedding_size = embedding_size
+
+    def meta_data(self):
+        return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def conf(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .activation("relu").weight_init("relu")
+             .updater(Adam(0.1)).graph_builder()
+             .add_inputs("input").set_input_types(InputType.convolutional(h, w, c)))
+        x = conv_bn_act(g, "stem-cnn1", "input", 64, (7, 7), (2, 2))
+        g.add_layer("stem-pool1",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        x = conv_bn_act(g, "inception-2", "stem-pool1", 64, (1, 1))
+        x = conv_bn_act(g, "inception-3", x, 192, (3, 3))
+        g.add_layer("stem-pool2",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        x = inception_module(g, "3a", "stem-pool2", 64, 96, 128, 16, 32, 32)
+        x = inception_module(g, "3b", x, 64, 96, 128, 32, 64, 64)
+        g.add_layer("pool3",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        x = inception_module(g, "4a", "pool3", 256, 96, 192, 32, 64, 128)
+        x = inception_module(g, "4e", x, 160, 128, 256, 32, 64, 128)
+        g.add_layer("pool4",
+                    SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2),
+                                     convolution_mode="same"), x)
+        x = inception_module(g, "5a", "pool4", 256, 96, 384, 24, 64, 96)
+        x = inception_module(g, "5b", x, 256, 96, 384, 24, 64, 96)
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling_type="avg"), x)
+        g.add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                             activation="identity"), "avgpool")
+        g.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+        g.add_layer("lossLayer",
+                    CenterLossOutputLayer(n_out=self.num_labels, loss="mcxent",
+                                          activation="softmax", alpha=0.9, lambda_=1e-4),
+                    "embeddings")
+        return g.set_outputs("lossLayer").build()
+
+
+@register_zoo_model
+class TextGenerationLSTM(ZooModel):
+    """Char-level text generation LSTM (``zoo/model/TextGenerationLSTM.java:81-86``:
+    2× GravesLSTM(256) → RnnOutputLayer MCXENT)."""
+
+    def __init__(self, num_labels: int = 26, seed: int = 123, max_length: int = 40):
+        super().__init__(num_labels, seed)
+        self.max_length = max_length
+
+    def meta_data(self):
+        return ModelMetaData(((self.max_length, self.num_labels),), 1, "rnn")
+
+    def conf(self):
+        return (NeuralNetConfiguration.builder().seed(self.seed)
+                .weight_init("xavier").updater("rmsprop")
+                .l2(0.001)
+                .gradient_normalization("clip_elementwise_absolute_value", 10.0).list()
+                .layer(GravesLSTMLayer(n_in=self.num_labels, n_out=256, activation="tanh"))
+                .layer(GravesLSTMLayer(n_out=256, activation="tanh"))
+                .layer(RnnOutputLayer(n_out=self.num_labels, loss="mcxent",
+                                      activation="softmax"))
+                .set_input_type(InputType.recurrent(self.num_labels, self.max_length))
+                .build())

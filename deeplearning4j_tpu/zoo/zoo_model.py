@@ -1,0 +1,109 @@
+"""Model zoo base machinery.
+
+Reference: ``deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/ZooModel.java:51-69``
+(pretrained download + checksum + init), ``ModelMetaData.java``, ``ZooType.java``,
+``ModelSelector.java``. TPU-native differences: models build straight onto the
+functional `MultiLayerNetwork`/`ComputationGraph` configs; pretrained weights
+load from a local checkpoint path instead of an HTTP blob store (this image has
+no egress), via :mod:`deeplearning4j_tpu.util.model_serializer`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Sequence, Tuple, Type
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelMetaData:
+    """Shape metadata (reference ``ZooModel.metaData()``)."""
+
+    input_shape: Tuple[Tuple[int, ...], ...]  # per graph input, CHW order like DL4J
+    n_outputs: int = 1
+    network_type: str = "cnn"  # "cnn" | "rnn"
+
+    @property
+    def use_mds(self) -> bool:
+        return len(self.input_shape) > 1 or self.n_outputs > 1
+
+
+class PretrainedType:
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+class ZooModel:
+    """Base class for zoo architectures (``ZooModel.java``).
+
+    Subclasses implement ``conf()`` (a MultiLayerConfiguration or
+    ComputationGraphConfiguration) and ``meta_data()``; ``init()`` builds and
+    initializes the runtime network.
+    """
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123):
+        self.num_labels = num_labels
+        self.seed = seed
+
+    # -- to implement ------------------------------------------------------
+    def conf(self):
+        raise NotImplementedError
+
+    def meta_data(self) -> ModelMetaData:
+        raise NotImplementedError
+
+    # -- common ------------------------------------------------------------
+    def init(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        c = self.conf()
+        if isinstance(c, ComputationGraphConfiguration):
+            return ComputationGraph(c).init(seed=self.seed)
+        return MultiLayerNetwork(c).init(seed=self.seed)
+
+    def pretrained_checkpoint(self, pretrained_type: str = PretrainedType.IMAGENET) -> Optional[str]:
+        """Local path to pretrained weights, or None if unavailable.
+
+        The reference downloads from ``blob.deeplearning4j.org`` with an MD5
+        check (``ZooModel.java:51-69``); here weights are looked up under
+        ``$DL4J_TPU_ZOO_DIR/<model>_<type>.zip``.
+        """
+        root = os.environ.get("DL4J_TPU_ZOO_DIR", os.path.expanduser("~/.deeplearning4j_tpu/zoo"))
+        p = os.path.join(root, f"{type(self).__name__.lower()}_{pretrained_type}.zip")
+        return p if os.path.exists(p) else None
+
+    def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET):
+        path = self.pretrained_checkpoint(pretrained_type)
+        if path is None:
+            raise FileNotFoundError(
+                f"No pretrained weights for {type(self).__name__} ({pretrained_type}); "
+                f"place a checkpoint under $DL4J_TPU_ZOO_DIR to enable.")
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        return restore_model(path)
+
+
+_ZOO_REGISTRY: Dict[str, Type[ZooModel]] = {}
+
+
+def register_zoo_model(cls: Type[ZooModel]) -> Type[ZooModel]:
+    _ZOO_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+class ModelSelector:
+    """Instantiate zoo models by name (reference ``ModelSelector.java``)."""
+
+    @staticmethod
+    def available() -> Sequence[str]:
+        return sorted(_ZOO_REGISTRY)
+
+    @staticmethod
+    def select(name: str, num_labels: int = 1000, seed: int = 123) -> ZooModel:
+        key = name.lower()
+        if key not in _ZOO_REGISTRY:
+            raise KeyError(f"Unknown zoo model {name!r}; available: {ModelSelector.available()}")
+        return _ZOO_REGISTRY[key](num_labels=num_labels, seed=seed)

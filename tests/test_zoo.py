@@ -1,0 +1,121 @@
+"""Zoo model construction + forward/fit smoke tests.
+
+Mirrors the reference's ``deeplearning4j-zoo/src/test/.../TestInstantiation.java``
+(build every zoo model, forward a batch, fit a batch) at CPU-friendly sizes.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.zoo import (
+    AlexNet, Darknet19, FaceNetNN4Small2, GoogLeNet, InceptionResNetV1,
+    LeNet, ModelSelector, ResNet50, SimpleCNN, TextGenerationLSTM, TinyYOLO,
+    VGG16, VGG19, YOLO2,
+)
+
+
+def _nhwc(shape_chw, batch=2):
+    c, h, w = shape_chw
+    return np.random.RandomState(0).rand(batch, h, w, c).astype(np.float32)
+
+
+def _onehot(n, k, rng=0):
+    r = np.random.RandomState(rng)
+    y = np.zeros((n, k), np.float32)
+    y[np.arange(n), r.randint(0, k, n)] = 1
+    return y
+
+
+def _fit_and_forward(model, n_labels, batch=2):
+    net = model.init()
+    x = _nhwc(model.input_shape, batch)
+    y = _onehot(batch, n_labels)
+    out = net.output(x)
+    out = out[0] if isinstance(out, list) else out
+    assert out.shape == (batch, n_labels)
+    assert np.allclose(np.asarray(out).sum(axis=-1), 1.0, atol=1e-4)
+    net.fit(x, y, epochs=1)
+    return net
+
+
+class TestZooInstantiation:
+    def test_lenet(self):
+        _fit_and_forward(LeNet(num_labels=10, input_shape=(1, 28, 28)), 10)
+
+    def test_simplecnn(self):
+        _fit_and_forward(SimpleCNN(num_labels=5, input_shape=(3, 48, 48)), 5)
+
+    def test_alexnet(self):
+        _fit_and_forward(AlexNet(num_labels=7, input_shape=(3, 112, 112)), 7)
+
+    def test_vgg16_small(self):
+        _fit_and_forward(VGG16(num_labels=4, input_shape=(3, 64, 64)), 4)
+
+    def test_vgg19_builds(self):
+        conf = VGG19(num_labels=4, input_shape=(3, 64, 64)).conf()
+        assert conf.num_params() > 0
+
+    def test_darknet19(self):
+        _fit_and_forward(Darknet19(num_labels=6, input_shape=(3, 64, 64)), 6)
+
+    def test_resnet50(self):
+        net = ResNet50(num_labels=4, input_shape=(3, 64, 64)).init()
+        x = _nhwc((3, 64, 64))
+        out = net.output(x)
+        out = out[0] if isinstance(out, list) else out
+        assert out.shape == (2, 4)
+        net.fit(x, _onehot(2, 4), epochs=1)
+
+    def test_googlenet(self):
+        net = GoogLeNet(num_labels=4, input_shape=(3, 64, 64)).init()
+        out = net.output(_nhwc((3, 64, 64)))
+        out = out[0] if isinstance(out, list) else out
+        assert out.shape == (2, 4)
+
+    def test_inception_resnet_v1_builds(self):
+        conf = InceptionResNetV1(num_labels=8, input_shape=(3, 96, 96)).conf()
+        assert conf.num_params() > 1_000_000
+
+    def test_facenet(self):
+        net = FaceNetNN4Small2(num_labels=4, input_shape=(3, 64, 64)).init()
+        x = _nhwc((3, 64, 64))
+        out = net.output(x)
+        out = out[0] if isinstance(out, list) else out
+        assert out.shape == (2, 4)
+        net.fit(x, _onehot(2, 4), epochs=1)
+
+    def test_tiny_yolo(self):
+        m = TinyYOLO(num_labels=3, input_shape=(3, 64, 64))
+        net = m.init()
+        x = _nhwc((3, 64, 64))
+        out = net.output(x)
+        out = out[0] if isinstance(out, list) else out
+        # 64/32 = 2x2 grid, 5 anchors * (5+3) channels
+        assert out.shape[1:3] == (2, 2)
+
+    def test_yolo2_builds(self):
+        conf = YOLO2(num_labels=3, input_shape=(3, 64, 64)).conf()
+        assert conf.num_params() > 1_000_000
+
+    def test_text_generation_lstm(self):
+        m = TextGenerationLSTM(num_labels=12, max_length=10)
+        net = m.init()
+        x = np.random.RandomState(0).rand(2, 10, 12).astype(np.float32)
+        y = np.zeros((2, 10, 12), np.float32)
+        y[..., 0] = 1
+        out = net.output(x)
+        assert out.shape == (2, 10, 12)
+        net.fit(x, y, epochs=1)
+
+    def test_model_selector(self):
+        names = ModelSelector.available()
+        assert len(names) == 13
+        m = ModelSelector.select("lenet", num_labels=10)
+        assert isinstance(m, LeNet)
+        with pytest.raises(KeyError):
+            ModelSelector.select("nope")
+
+    def test_meta_data(self):
+        md = ResNet50(num_labels=1000).meta_data()
+        assert md.input_shape == ((3, 224, 224),)
+        assert not md.use_mds
