@@ -3,6 +3,21 @@
 Reference: ``optimize/listeners/`` + ``optimize/api/TrainingListener``.
 """
 
+from deeplearning4j_tpu.optimize.earlystopping import (  # noqa: F401
+    BestScoreEpochTerminationCondition,
+    ClassificationScoreCalculator,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CheckpointListener,
     CollectScoresIterationListener,

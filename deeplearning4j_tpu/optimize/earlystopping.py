@@ -1,0 +1,279 @@
+"""Early stopping: termination conditions, score calculators, savers, trainer.
+
+Reference: ``deeplearning4j-nn/.../earlystopping/``:
+``EarlyStoppingConfiguration.java`` (builder), ``termination/`` (Max*Epochs,
+MaxTime, ScoreImprovement, BestScore, InvalidScore), ``scorecalc/``
+(DataSetLossCalculator, ClassificationScoreCalculator), ``saver/``
+(InMemoryModelSaver, LocalFileModelSaver), and
+``BaseEarlyStoppingTrainer.java:46`` (``fit():76``).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from pathlib import Path
+from typing import Callable, List, Optional
+
+
+# ---------------------------------------------------------------- calculators
+class ScoreCalculator:
+    """Score to MINIMIZE on held-out data (``scorecalc/ScoreCalculator.java``)."""
+
+    def calculate_score(self, model) -> float:
+        raise NotImplementedError
+
+
+class DataSetLossCalculator(ScoreCalculator):
+    """Average loss over an iterator (``DataSetLossCalculator.java``)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for ds in self.iterator:
+            total += model.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        if n == 0:
+            return float("nan")
+        return total / n if self.average else total
+
+
+class ClassificationScoreCalculator(ScoreCalculator):
+    """1 - metric, so that better classification minimizes the score
+    (``ClassificationScoreCalculator.java``). metric: accuracy | f1 |
+    precision | recall."""
+
+    def __init__(self, iterator, metric: str = "accuracy"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculate_score(self, model) -> float:
+        e = model.evaluate(self.iterator)
+        return 1.0 - getattr(e, self.metric)()
+
+
+# ---------------------------------------------------------------- termination
+class EpochTerminationCondition:
+    def initialize(self) -> None:
+        """Reset state; called at the start of every fit() (the reference's
+        ``TerminationCondition.initialize()``)."""
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self) -> None:
+        """Reset state; called at the start of every fit()."""
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop when no improvement for ``max_epochs_without_improvement`` epochs
+    (with optional ``min_improvement`` delta)."""
+
+    def __init__(self, max_epochs_without_improvement: int,
+                 min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self._best: Optional[float] = None
+        self._stale = 0
+
+    def initialize(self) -> None:
+        self._best = None
+        self._stale = 0
+
+    def terminate(self, epoch, score):
+        if self._best is None or (self._best - score) > self.min_improvement:
+            self._best = score
+            self._stale = 0
+            return False
+        self._stale += 1
+        return self._stale >= self.patience
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop as soon as the score is at/below a target."""
+
+    def __init__(self, best_expected_score: float):
+        self.target = best_expected_score
+
+    def terminate(self, epoch, score):
+        return score <= self.target
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start = time.monotonic()
+
+    def initialize(self) -> None:
+        self._start = time.monotonic()
+
+    def terminate(self, last_score):
+        return (time.monotonic() - self._start) >= self.max_seconds
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Stop on NaN/Inf score (``InvalidScoreIterationTerminationCondition``)."""
+
+    def terminate(self, last_score):
+        return math.isnan(last_score) or math.isinf(last_score)
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate(self, last_score):
+        return last_score > self.max_score
+
+
+# ---------------------------------------------------------------------- saver
+class InMemoryModelSaver:
+    """Keeps the best/latest model in memory (``saver/InMemoryModelSaver.java``).
+    jax params are immutable, so 'saving' is sharing the param containers."""
+
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, model, score):
+        self._best = (model.clone() if hasattr(model, "clone") else model, score)
+
+    def save_latest_model(self, model, score):
+        self._latest = (model.clone() if hasattr(model, "clone") else model, score)
+
+    def get_best_model(self):
+        return self._best[0] if self._best else None
+
+    def get_latest_model(self):
+        return self._latest[0] if self._latest else None
+
+
+class LocalFileModelSaver:
+    """Writes bestModel.zip / latestModel.zip (``saver/LocalFileModelSaver.java``)."""
+
+    def __init__(self, directory):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def save_best_model(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self.dir / "bestModel.zip")
+
+    def save_latest_model(self, model, score):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        write_model(model, self.dir / "latestModel.zip")
+
+    def get_best_model(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        p = self.dir / "bestModel.zip"
+        return restore_model(p) if p.exists() else None
+
+    def get_latest_model(self):
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        p = self.dir / "latestModel.zip"
+        return restore_model(p) if p.exists() else None
+
+
+# ------------------------------------------------------------------ config
+class EarlyStoppingConfiguration:
+    """Builder-style config (``EarlyStoppingConfiguration.java``)."""
+
+    def __init__(self, *, score_calculator: ScoreCalculator,
+                 epoch_termination_conditions: Optional[List[EpochTerminationCondition]] = None,
+                 iteration_termination_conditions: Optional[List[IterationTerminationCondition]] = None,
+                 model_saver=None,
+                 evaluate_every_n_epochs: int = 1,
+                 save_last_model: bool = False):
+        self.score_calculator = score_calculator
+        self.epoch_conditions = epoch_termination_conditions or []
+        self.iteration_conditions = iteration_termination_conditions or []
+        self.saver = model_saver or InMemoryModelSaver()
+        self.evaluate_every_n_epochs = max(1, evaluate_every_n_epochs)
+        self.save_last_model = save_last_model
+
+
+class EarlyStoppingResult:
+    """Outcome record (``EarlyStoppingResult.java``)."""
+
+    def __init__(self, termination_reason: str, termination_details: str,
+                 score_vs_epoch: dict, best_model_epoch: int, best_model_score: float,
+                 total_epochs: int, best_model):
+        self.termination_reason = termination_reason  # "EpochTerminationCondition" | "IterationTerminationCondition" | "Error"
+        self.termination_details = termination_details
+        self.score_vs_epoch = score_vs_epoch
+        self.best_model_epoch = best_model_epoch
+        self.best_model_score = best_model_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+
+
+class EarlyStoppingTrainer:
+    """Epoch loop with held-out scoring and best-model tracking
+    (``BaseEarlyStoppingTrainer.java:46``, ``fit():76``). Works for both
+    MultiLayerNetwork and ComputationGraph."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_iterator):
+        self.config = config
+        self.model = model
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_conditions:
+            c.initialize()
+        for c in cfg.iteration_conditions:
+            c.initialize()
+        scores: dict = {}
+        best_score, best_epoch = float("inf"), -1
+        epoch = 0
+        last_eval = float("nan")
+        reason, details = "EpochTerminationCondition", "max epochs"
+        while True:
+            self.model.fit(self.iterator, epochs=1)
+            last = self.model.score_
+            stop_iter = next((c for c in cfg.iteration_conditions if c.terminate(last)), None)
+            if stop_iter is not None:
+                reason = "IterationTerminationCondition"
+                details = type(stop_iter).__name__
+                epoch += 1
+                break
+            if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
+                last_eval = cfg.score_calculator.calculate_score(self.model)
+                scores[epoch] = last_eval
+                if last_eval < best_score:
+                    best_score, best_epoch = last_eval, epoch
+                    cfg.saver.save_best_model(self.model, last_eval)
+                if cfg.save_last_model:
+                    cfg.saver.save_latest_model(self.model, last_eval)
+            # epoch termination is checked EVERY epoch (with the most recent
+            # eval score), so MaxEpochs cannot overshoot when
+            # evaluate_every_n_epochs > 1 (BaseEarlyStoppingTrainer.fit parity)
+            stop_epoch = next(
+                (c for c in cfg.epoch_conditions if c.terminate(epoch, last_eval)), None)
+            if stop_epoch is not None:
+                reason = "EpochTerminationCondition"
+                details = type(stop_epoch).__name__
+                epoch += 1
+                break
+            epoch += 1
+        best = cfg.saver.get_best_model() or self.model
+        return EarlyStoppingResult(reason, details, scores, best_epoch,
+                                   best_score, epoch, best)

@@ -18,9 +18,8 @@ from deeplearning4j_tpu.nn.layers import (
     BatchNormalizationLayer,
     ConvolutionLayer,
     SubsamplingLayer,
-    ZeroPaddingLayer,
 )
-from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex, ScaleVertex
 
 
 def conv_bn_act(g: GraphBuilder, name: str, inp: str, n_out: int,
@@ -154,7 +153,6 @@ def inception_module(g: GraphBuilder, name: str, inp: str,
 def inception_resnet_block_a(g: GraphBuilder, name: str, inp: str, scale: float) -> str:
     """Inception-ResNet-v1 block35 (``InceptionResNetHelper.inceptionV1ResA``):
     three merged branches → 1x1 projection, scaled residual add, relu."""
-    from deeplearning4j_tpu.nn.vertices import ScaleVertex
     b1 = conv_bn_act(g, f"{name}-b1", inp, 32, (1, 1))
     b2a = conv_bn_act(g, f"{name}-b2a", inp, 32, (1, 1))
     b2 = conv_bn_act(g, f"{name}-b2b", b2a, 32, (3, 3))
@@ -173,7 +171,6 @@ def inception_resnet_block_a(g: GraphBuilder, name: str, inp: str, scale: float)
 
 def inception_resnet_block_b(g: GraphBuilder, name: str, inp: str, scale: float) -> str:
     """Inception-ResNet-v1 block17 (1x7/7x1 factorized branch)."""
-    from deeplearning4j_tpu.nn.vertices import ScaleVertex
     b1 = conv_bn_act(g, f"{name}-b1", inp, 128, (1, 1))
     b2a = conv_bn_act(g, f"{name}-b2a", inp, 128, (1, 1))
     b2b = conv_bn_act(g, f"{name}-b2b", b2a, 128, (1, 7))
@@ -190,7 +187,6 @@ def inception_resnet_block_b(g: GraphBuilder, name: str, inp: str, scale: float)
 
 def inception_resnet_block_c(g: GraphBuilder, name: str, inp: str, scale: float) -> str:
     """Inception-ResNet-v1 block8 (1x3/3x1 factorized branch)."""
-    from deeplearning4j_tpu.nn.vertices import ScaleVertex
     b1 = conv_bn_act(g, f"{name}-b1", inp, 192, (1, 1))
     b2a = conv_bn_act(g, f"{name}-b2a", inp, 192, (1, 1))
     b2b = conv_bn_act(g, f"{name}-b2b", b2a, 192, (1, 3))

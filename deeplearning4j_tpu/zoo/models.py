@@ -374,8 +374,11 @@ class ResNet50(ZooModel):
         g.add_layer("stem-maxpool1",
                     SubsamplingLayer(pooling_type="max", kernel_size=(3, 3), stride=(2, 2)),
                     "stem-act1")
+        # canonical ResNet-50 stride-1 projection at stage 2 (the stem maxpool
+        # already downsampled); the reference's ResNet50.java:194 passes {2,2}
+        # here, a known deviation that breaks pretrained-weight compatibility
         x = resnet_conv_block(g, (3, 3), (64, 64, 256), "2", "a", "stem-maxpool1",
-                              stride=(2, 2))
+                              stride=(1, 1))
         x = resnet_identity_block(g, (3, 3), (64, 64, 256), "2", "b", x)
         x = resnet_identity_block(g, (3, 3), (64, 64, 256), "2", "c", x)
         x = resnet_conv_block(g, (3, 3), (128, 128, 512), "3", "a", x)

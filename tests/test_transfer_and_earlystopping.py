@@ -1,0 +1,241 @@
+"""Transfer learning + early stopping behavior tests.
+
+Reference patterns: ``deeplearning4j-core/src/test/.../nn/transferlearning/``
+(TransferLearningMLNTest, TransferLearningCompGraphTest) and
+``.../earlystopping/TestEarlyStopping.java``.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.optimize.earlystopping import (
+    BestScoreEpochTerminationCondition,
+    ClassificationScoreCalculator,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    InvalidScoreIterationTerminationCondition,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+
+
+def _mlp(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(DenseLayer(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(8)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=64, d=8, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, d).astype(np.float32)
+    yi = rng.randint(0, k, n)
+    x[np.arange(n), yi] += 2.0
+    y = np.eye(k, dtype=np.float32)[yi]
+    return x, y
+
+
+class TestTransferLearningMLN:
+    def test_freeze_keeps_params_fixed(self):
+        net = _mlp()
+        x, y = _data()
+        new = (TransferLearning.Builder(net)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.5)))
+               .set_feature_extractor(0)
+               .build())
+        assert isinstance(new.layers[0], FrozenLayer)
+        w0_before = np.asarray(new.params[0]["W"])
+        w1_before = np.asarray(new.params[1]["W"])
+        new.fit(x, y, epochs=2)
+        assert np.array_equal(np.asarray(new.params[0]["W"]), w0_before)
+        assert not np.array_equal(np.asarray(new.params[1]["W"]), w1_before)
+
+    def test_params_copied_from_source(self):
+        net = _mlp()
+        new = TransferLearning.Builder(net).set_feature_extractor(0).build()
+        for i in range(3):
+            assert np.array_equal(np.asarray(new.params[i]["W"]),
+                                  np.asarray(net.params[i]["W"]))
+
+    def test_nout_replace_reinitializes_consumer(self):
+        net = _mlp()
+        new = (TransferLearning.Builder(net)
+               .n_out_replace(1, 20, weight_init="xavier")
+               .build())
+        assert new.layers[1].n_out == 20
+        assert new.params[1]["W"].shape == (16, 20)
+        assert new.params[2]["W"].shape == (20, 3)
+        # untouched layer 0 keeps its params
+        assert np.array_equal(np.asarray(new.params[0]["W"]),
+                              np.asarray(net.params[0]["W"]))
+
+    def test_remove_and_add_output_layer(self):
+        net = _mlp()
+        new = (TransferLearning.Builder(net)
+               .remove_output_layer()
+               .add_layer(OutputLayer(n_out=5, activation="softmax"))
+               .build())
+        assert new.layers[-1].n_out == 5
+        x, _ = _data()
+        out = new.output(x)
+        assert out.shape == (64, 5)
+
+    def test_fine_tune_updater_override(self):
+        net = _mlp()
+        new = (TransferLearning.Builder(net)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.123)))
+               .build())
+        assert type(new.conf.global_conf.updater).__name__ == "Sgd"
+
+    def test_helper_featurize(self):
+        net = _mlp()
+        x, y = _data()
+        helper = TransferLearningHelper(net, frozen_till=0)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.shape == (64, 16)
+        before = np.asarray(net.output(x))
+        helper.fit_featurized(feat, epochs=2)
+        out = helper.output_from_featurized(feat.features)
+        assert out.shape == (64, 3)
+        # original trunk untouched
+        assert np.array_equal(before, np.asarray(net.output(x)))
+
+    def test_helper_featurize_cnn_flatten(self):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3), activation="relu"))
+                .layer(SubsamplingLayer())
+                .layer(DenseLayer(n_out=10, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional(12, 12, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).rand(8, 12, 12, 1).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.random.RandomState(1).randint(0, 3, 8)]
+        helper = TransferLearningHelper(net, frozen_till=1)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.ndim == 2  # flattened for the dense head
+        helper.fit_featurized(feat, epochs=1)
+
+
+class TestTransferLearningGraph:
+    def _graph(self):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d0", DenseLayer(n_out=16, activation="relu"), "in")
+                .add_layer("d1", DenseLayer(n_out=12, activation="relu"), "d0")
+                .add_layer("out", OutputLayer(n_out=3), "d1")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(8))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_freeze_ancestors(self):
+        g = self._graph()
+        x, y = _data()
+        new = (TransferLearning.GraphBuilder(g)
+               .set_feature_extractor("d1")
+               .build())
+        assert isinstance(new.conf.vertices["d0"].obj, FrozenLayer)
+        assert isinstance(new.conf.vertices["d1"].obj, FrozenLayer)
+        assert not isinstance(new.conf.vertices["out"].obj, FrozenLayer)
+        w_before = np.asarray(new.params["d0"]["W"])
+        new.fit(x, y, epochs=2)
+        assert np.array_equal(np.asarray(new.params["d0"]["W"]), w_before)
+
+    def test_replace_output(self):
+        g = self._graph()
+        new = (TransferLearning.GraphBuilder(g)
+               .remove_vertex("out")
+               .add_layer("newout", OutputLayer(n_out=7), "d1")
+               .set_outputs("newout")
+               .build())
+        x, _ = _data()
+        out = new.output(x)
+        out = out[0] if isinstance(out, list) else out
+        assert out.shape == (64, 7)
+        assert np.array_equal(np.asarray(new.params["d0"]["W"]),
+                              np.asarray(g.params["d0"]["W"]))
+
+    def test_nout_replace_graph(self):
+        g = self._graph()
+        new = (TransferLearning.GraphBuilder(g)
+               .n_out_replace("d1", 24)
+               .build())
+        assert new.params["d1"]["W"].shape == (16, 24)
+        assert new.params["out"]["W"].shape == (24, 3)
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        net = _mlp()
+        x, y = _data()
+        it = ListDataSetIterator(DataSet(x, y), 16)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ListDataSetIterator(DataSet(x, y), 32)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)])
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.total_epochs == 3
+        assert result.termination_reason == "EpochTerminationCondition"
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 3
+
+    def test_score_improvement_patience(self):
+        net = _mlp()
+        x, y = _data()
+        it = ListDataSetIterator(DataSet(x, y), 16)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ListDataSetIterator(DataSet(x, y), 32)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(2, min_improvement=10.0)])
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        # 10.0 improvement never reached -> patience of 2 fires at epoch 3
+        assert result.total_epochs == 3
+        assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+
+    def test_best_score_condition_and_best_model(self):
+        net = _mlp()
+        x, y = _data(n=128)
+        it = ListDataSetIterator(DataSet(x, y), 32, shuffle=True)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=ClassificationScoreCalculator(
+                ListDataSetIterator(DataSet(x, y), 64), "accuracy"),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(30),
+                BestScoreEpochTerminationCondition(0.02)])
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.best_model_score <= 0.05
+        ev = result.best_model.evaluate(ListDataSetIterator(DataSet(x, y), 64))
+        assert ev.accuracy() >= 0.95
+
+    def test_invalid_score_stops(self):
+        net = _mlp()
+
+        class Boom(InvalidScoreIterationTerminationCondition):
+            pass
+
+        x, y = _data()
+        y_bad = y * np.nan
+        it = ListDataSetIterator(DataSet(x, y_bad), 16)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ListDataSetIterator(DataSet(x, y), 32)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)],
+            iteration_termination_conditions=[Boom()])
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == "IterationTerminationCondition"
