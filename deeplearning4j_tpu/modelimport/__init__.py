@@ -1,0 +1,1 @@
+"""Model import from other frameworks (reference: deeplearning4j-modelimport)."""

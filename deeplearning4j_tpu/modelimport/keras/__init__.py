@@ -1,0 +1,24 @@
+"""Keras HDF5/JSON model import.
+
+Reference: ``deeplearning4j-modelimport/`` (``KerasModelImport.java:41``,
+``Hdf5Archive.java:46``, per-layer mappers under ``layers/``). The HDF5
+native binding is replaced by h5py; layouts stay channels-last (NHWC).
+"""
+
+from deeplearning4j_tpu.modelimport.keras.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+from deeplearning4j_tpu.modelimport.keras.layers import (
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+)
+from deeplearning4j_tpu.modelimport.keras.model import (
+    KerasModel,
+    KerasModelConfig,
+    KerasSequentialModel,
+)
+
+__all__ = [
+    "Hdf5Archive", "KerasModelImport", "KerasModel", "KerasModelConfig",
+    "KerasSequentialModel", "InvalidKerasConfigurationException",
+    "UnsupportedKerasConfigurationException",
+]

@@ -1,0 +1,452 @@
+"""Keras layer-config → framework layer mapping (Keras 1 and Keras 2 dialects).
+
+Reference: ``deeplearning4j-modelimport/.../layers/`` (per-family mappers) and
+``config/Keras1LayerConfiguration.java`` / ``Keras2LayerConfiguration.java``
+(the two field-name dialects: ``output_dim``/``nb_filter``/``border_mode``/
+``subsample`` vs ``units``/``filters``/``padding``/``strides``).
+
+Each mapper returns ``(layer, weight_fn)`` where ``weight_fn(raw)`` converts
+the layer's Keras weight dict to ``(params, states)`` for our layer. Arrays
+stay in Keras file order (kernels are HWIO, matching our NHWC convs) — no
+transposes needed except the LSTM gate reorder (Keras IFCO → ours IFOG).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
+    BatchNormalizationLayer,
+    Convolution1DLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    DepthwiseConvolution2DLayer,
+    DropoutLayer,
+    EmbeddingSequenceLayer,
+    GlobalPoolingLayer,
+    LSTMLayer,
+    SeparableConvolution2DLayer,
+    SimpleRnnLayer,
+    Subsampling1DLayer,
+    SubsamplingLayer,
+    Upsampling1DLayer,
+    UpsamplingLayer,
+    ZeroPadding1DLayer,
+    ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.recurrent import BidirectionalWrapper, LastTimeStepWrapper
+
+WeightFn = Callable[[Dict[str, np.ndarray]], Tuple[dict, dict]]
+
+# Keras activation name → ours
+ACTIVATIONS = {
+    "linear": "identity", "relu": "relu", "relu6": "relu6",
+    "sigmoid": "sigmoid", "hard_sigmoid": "hardsigmoid", "tanh": "tanh",
+    "softmax": "softmax", "softplus": "softplus", "softsign": "softsign",
+    "elu": "elu", "selu": "selu", "swish": "swish", "silu": "swish",
+    "gelu": "gelu", "exponential": "exp", "leaky_relu": "leakyrelu",
+}
+
+# Keras loss name → ours
+LOSSES = {
+    "categorical_crossentropy": "mcxent",
+    "sparse_categorical_crossentropy": "mcxent",
+    "binary_crossentropy": "xent",
+    "mean_squared_error": "mse", "mse": "mse",
+    "mean_absolute_error": "l1", "mae": "l1",
+    "kullback_leibler_divergence": "kld", "kld": "kld",
+    "poisson": "poisson",
+    "cosine_proximity": "cosine_proximity",
+    "hinge": "hinge", "squared_hinge": "squared_hinge",
+}
+
+
+def map_activation(name: Optional[str]) -> str:
+    if not name:
+        return "identity"
+    key = str(name).lower()
+    if key not in ACTIVATIONS:
+        raise UnsupportedKerasConfigurationException(
+            f"Unsupported Keras activation {name!r}")
+    return ACTIVATIONS[key]
+
+
+class InvalidKerasConfigurationException(ValueError):
+    """Reference: ``exceptions/InvalidKerasConfigurationException.java``."""
+
+
+class UnsupportedKerasConfigurationException(ValueError):
+    """Reference: ``exceptions/UnsupportedKerasConfigurationException.java``."""
+
+
+def _pair(v, default=(1, 1)):
+    if v is None:
+        return default
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(int(x) for x in v)
+
+
+def _no_weights(raw):
+    return {}, {}
+
+
+def _dense_weights(raw):
+    p = {}
+    if "kernel" in raw:
+        p["W"] = raw["kernel"]
+    elif "W" in raw:
+        p["W"] = raw["W"]
+    else:  # Keras1 flat names like "dense_1_W"
+        for k, v in raw.items():
+            if k.endswith("_W") or v.ndim >= 2:
+                p["W"] = v
+            elif k.endswith("_b") or v.ndim == 1:
+                p["b"] = v
+    if "bias" in raw:
+        p["b"] = raw["bias"]
+    elif "b" in raw:
+        p["b"] = raw["b"]
+    return p, {}
+
+
+def _bn_weights(raw):
+    get = lambda *names: next((raw[n] for n in names if n in raw), None)
+    p, s = {}, {}
+    gamma = get("gamma")
+    beta = get("beta")
+    mean = get("moving_mean", "running_mean")
+    var = get("moving_variance", "running_std", "running_var")
+    if gamma is None or beta is None or mean is None or var is None:
+        # Keras1 flat names: <layer>_gamma etc.
+        for k, v in raw.items():
+            if k.endswith("_gamma"):
+                gamma = v
+            elif k.endswith("_beta"):
+                beta = v
+            elif k.endswith("_running_mean"):
+                mean = v
+            elif k.endswith(("_running_std", "_running_var")):
+                var = v
+    if gamma is not None:
+        p["gamma"] = gamma
+    if beta is not None:
+        p["beta"] = beta
+    if mean is not None:
+        s["mean"] = mean
+    if var is not None:
+        s["var"] = var
+    return p, s
+
+
+def _lstm_reorder(k: np.ndarray, units: int) -> np.ndarray:
+    """Keras gate blocks [i|f|c|o] → our [i|f|o|g] along the last axis."""
+    i, f, c, o = (k[..., j * units:(j + 1) * units] for j in range(4))
+    return np.concatenate([i, f, o, c], axis=-1)
+
+
+def _lstm_weights_fn(units: int) -> WeightFn:
+    def fn(raw):
+        get = lambda *names: next((raw[n] for n in names if n in raw), None)
+        k = get("kernel", "W")
+        rk = get("recurrent_kernel", "U")
+        b = get("bias", "b")
+        if k is None:
+            # Keras1 per-gate names: W_i, W_f, W_c, W_o / U_* / b_*
+            def cat(prefix):
+                gates = [raw.get(f"{prefix}_{g}") for g in ("i", "f", "o", "c")]
+                if any(g is None for g in gates):
+                    # also try flat <layer>_W_i style
+                    gates = [next((v for n, v in raw.items()
+                                   if n.endswith(f"{prefix}_{g}")), None)
+                             for g in ("i", "f", "o", "c")]
+                if any(g is None for g in gates):
+                    return None
+                return np.concatenate(gates, axis=-1)
+            k_ifog, rk_ifog, b_ifog = cat("W"), cat("U"), cat("b")
+            if k_ifog is None:
+                raise InvalidKerasConfigurationException(
+                    f"cannot locate LSTM weights among {sorted(raw)}")
+            return {"W": k_ifog, "RW": rk_ifog, "b": b_ifog}, {}
+        p = {"W": _lstm_reorder(k, units), "RW": _lstm_reorder(rk, units)}
+        if b is not None:
+            if b.ndim == 2:  # CuDNN-style split bias rows
+                b = b.sum(axis=0)
+            p["b"] = _lstm_reorder(b, units)
+        return p, {}
+    return fn
+
+
+def _rnn_weights(raw):
+    get = lambda *names: next((raw[n] for n in names if n in raw), None)
+    p = {}
+    k = get("kernel", "W")
+    rk = get("recurrent_kernel", "U")
+    b = get("bias", "b")
+    if k is None:
+        for n, v in raw.items():
+            if n.endswith("_W"):
+                k = v
+            elif n.endswith("_U"):
+                rk = v
+            elif n.endswith("_b"):
+                b = v
+    if k is not None:
+        p["W"] = k
+    if rk is not None:
+        p["RW"] = rk
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+def _embedding_weights(raw):
+    get = lambda *names: next((raw[n] for n in names if n in raw), None)
+    w = get("embeddings", "W")
+    if w is None:
+        w = next((v for n, v in raw.items() if v.ndim == 2), None)
+    return ({"W": w} if w is not None else {}), {}
+
+
+def _conv1d_weights(raw):
+    p, s = _dense_weights(raw)
+    if "W" in p and p["W"].ndim == 3:  # Keras [k,in,out] -> ours [k,1,in,out]
+        p["W"] = p["W"][:, None, :, :]
+    return p, s
+
+
+def _sepconv_weights(raw):
+    get = lambda *names: next((raw[n] for n in names if n in raw), None)
+    p = {}
+    dk = get("depthwise_kernel")
+    pk = get("pointwise_kernel")
+    b = get("bias", "b")
+    if dk is not None:
+        p["W"] = dk
+    if pk is not None:
+        p["pW"] = pk
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+def _depthwise_weights(raw):
+    get = lambda *names: next((raw[n] for n in names if n in raw), None)
+    p = {}
+    dk = get("depthwise_kernel")
+    b = get("bias", "b")
+    if dk is not None:
+        p["W"] = dk
+    if b is not None:
+        p["b"] = b
+    return p, {}
+
+
+def _bidirectional_weights(inner_fn: WeightFn) -> WeightFn:
+    def fn(raw):
+        fwd = {k[len("forward_"):] if k.startswith("forward_") else k: v
+               for k, v in raw.items() if not k.startswith("backward_")}
+        bwd = {k[len("backward_"):]: v for k, v in raw.items()
+               if k.startswith("backward_")}
+        fp, _ = inner_fn(fwd)
+        bp, _ = inner_fn(bwd)
+        return ({f"f_{k}": v for k, v in fp.items()} |
+                {f"b_{k}": v for k, v in bp.items()}), {}
+    return fn
+
+
+def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], WeightFn]:
+    """One Keras layer config → (our layer or None if structural, weight_fn).
+
+    Returns ``(None, _no_weights)`` for layers that vanish in our model
+    (Flatten — handled by dense auto-preprocessors; InputLayer).
+    """
+    name = cfg.get("name")
+    act = map_activation(cfg.get("activation")) if "activation" in cfg else None
+
+    if class_name in ("InputLayer", "Flatten", "Masking"):
+        return None, _no_weights
+
+    if class_name == "Dense":
+        units = cfg.get("units", cfg.get("output_dim"))
+        return DenseLayer(name=name, n_out=int(units), activation=act or "identity",
+                          has_bias=cfg.get("use_bias", cfg.get("bias", True))), _dense_weights
+
+    if class_name in ("Conv2D", "Convolution2D"):
+        filters = cfg.get("filters", cfg.get("nb_filter"))
+        if "kernel_size" in cfg:
+            ks = _pair(cfg["kernel_size"])
+        else:
+            ks = (int(cfg["nb_row"]), int(cfg["nb_col"]))
+        strides = _pair(cfg.get("strides", cfg.get("subsample")), (1, 1))
+        pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+        mode = "same" if pad == "same" else "truncate"
+        return (ConvolutionLayer(name=name, n_out=int(filters), kernel_size=ks,
+                                 stride=strides, convolution_mode=mode,
+                                 dilation=_pair(cfg.get("dilation_rate"), (1, 1)),
+                                 activation=act or "identity",
+                                 has_bias=cfg.get("use_bias", cfg.get("bias", True))),
+                _dense_weights)
+
+    if class_name in ("Conv1D", "Convolution1D"):
+        filters = cfg.get("filters", cfg.get("nb_filter"))
+        k = cfg.get("kernel_size", cfg.get("filter_length"))
+        k = int(k[0]) if isinstance(k, (list, tuple)) else int(k)
+        s = cfg.get("strides", cfg.get("subsample_length", 1))
+        s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
+        pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+        mode = "same" if pad in ("same", "causal") else "truncate"
+        return (Convolution1DLayer(name=name, n_out=int(filters),
+                                   kernel_size=k, stride=s,
+                                   convolution_mode=mode,
+                                   activation=act or "identity"),
+                _conv1d_weights)
+
+    if class_name == "SeparableConv2D":
+        return (SeparableConvolution2DLayer(
+            name=name, n_out=int(cfg.get("filters")),
+            kernel_size=_pair(cfg.get("kernel_size")),
+            stride=_pair(cfg.get("strides"), (1, 1)),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=act or "identity"), _sepconv_weights)
+
+    if class_name == "DepthwiseConv2D":
+        return (DepthwiseConvolution2DLayer(
+            name=name,
+            kernel_size=_pair(cfg.get("kernel_size")),
+            stride=_pair(cfg.get("strides"), (1, 1)),
+            depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+            convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
+            activation=act or "identity"), _depthwise_weights)
+
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        pt = "max" if class_name.startswith("Max") else "avg"
+        ks = _pair(cfg.get("pool_size"), (2, 2))
+        strides = _pair(cfg.get("strides"), ks)
+        pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+        return (SubsamplingLayer(name=name, pooling_type=pt, kernel_size=ks,
+                                 stride=strides,
+                                 convolution_mode="same" if pad == "same" else "truncate"),
+                _no_weights)
+
+    if class_name in ("MaxPooling1D", "AveragePooling1D"):
+        pt = "max" if class_name.startswith("Max") else "avg"
+        k = cfg.get("pool_size", cfg.get("pool_length", 2))
+        k = int(k[0]) if isinstance(k, (list, tuple)) else int(k)
+        s = cfg.get("strides", cfg.get("stride")) or k
+        s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
+        return (Subsampling1DLayer(name=name, pooling_type=pt,
+                                   kernel_size=(k, 1), stride=(s, 1)),
+                _no_weights)
+
+    if class_name in ("GlobalMaxPooling1D", "GlobalAveragePooling1D",
+                      "GlobalMaxPooling2D", "GlobalAveragePooling2D"):
+        pt = "max" if "Max" in class_name else "avg"
+        return GlobalPoolingLayer(name=name, pooling_type=pt), _no_weights
+
+    if class_name == "Dropout":
+        rate = cfg.get("rate", cfg.get("p", 0.5))
+        return DropoutLayer(name=name, dropout=1.0 - float(rate)), _no_weights
+
+    if class_name in ("SpatialDropout2D", "SpatialDropout1D", "GaussianDropout",
+                      "GaussianNoise", "AlphaDropout"):
+        # noise layers: approximated by plain dropout (inference-identical)
+        rate = cfg.get("rate", cfg.get("p", 0.5))
+        return DropoutLayer(name=name, dropout=1.0 - float(rate)), _no_weights
+
+    if class_name == "Activation":
+        return ActivationLayer(name=name, activation=act or "identity"), _no_weights
+
+    if class_name == "LeakyReLU":
+        alpha = float(cfg.get("alpha", cfg.get("negative_slope", 0.3)))
+        return ActivationLayer(name=name, activation=("leakyrelu", {"alpha": alpha})), _no_weights
+
+    if class_name == "ELU":
+        return ActivationLayer(name=name, activation="elu"), _no_weights
+
+    if class_name == "ThresholdedReLU":
+        return ActivationLayer(name=name, activation="relu"), _no_weights
+
+    if class_name == "BatchNormalization":
+        eps = float(cfg.get("epsilon", 1e-3))
+        momentum = float(cfg.get("momentum", 0.99))
+        return (BatchNormalizationLayer(name=name, eps=eps, decay=momentum,
+                                        activation="identity"), _bn_weights)
+
+    if class_name == "Embedding":
+        return (EmbeddingSequenceLayer(name=name,
+                                       n_in=int(cfg.get("input_dim")),
+                                       n_out=int(cfg.get("output_dim")),
+                                       activation="identity", has_bias=False),
+                _embedding_weights)
+
+    if class_name == "LSTM":
+        units = int(cfg.get("units", cfg.get("output_dim")))
+        layer = LSTMLayer(
+            name=name, n_out=units,
+            activation=map_activation(cfg.get("activation", "tanh")),
+            gate_activation=map_activation(
+                cfg.get("recurrent_activation", cfg.get("inner_activation", "sigmoid"))),
+            forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0)
+        wf = _lstm_weights_fn(units)
+        if not cfg.get("return_sequences", False):
+            # LastTimeStepWrapper stores the inner layer's params unprefixed,
+            # so the same weight fn applies
+            return LastTimeStepWrapper(name=name, layer=layer), wf
+        return layer, wf
+
+    if class_name == "SimpleRNN":
+        units = int(cfg.get("units", cfg.get("output_dim")))
+        layer = SimpleRnnLayer(name=name, n_out=units,
+                               activation=map_activation(cfg.get("activation", "tanh")))
+        if not cfg.get("return_sequences", False):
+            return LastTimeStepWrapper(name=name, layer=layer), _rnn_weights
+        return layer, _rnn_weights
+
+    if class_name == "Bidirectional":
+        inner_cfg = cfg["layer"]
+        inner, inner_fn = map_keras_layer(inner_cfg["class_name"],
+                                          dict(inner_cfg["config"]))
+        merge = cfg.get("merge_mode", "concat")
+        if merge is None:
+            raise UnsupportedKerasConfigurationException(
+                "Bidirectional merge_mode=None (two output tensors) is not supported")
+        merge = {"sum": "add", "ave": "average"}.get(merge, merge)
+        if merge not in ("concat", "add", "mul", "average"):
+            raise UnsupportedKerasConfigurationException(
+                f"Unsupported Bidirectional merge_mode {merge!r}")
+        if isinstance(inner, LastTimeStepWrapper):
+            wrapped = BidirectionalWrapper(name=name, layer=inner.layer, mode=merge)
+            return (LastTimeStepWrapper(name=name, layer=wrapped),
+                    _bidirectional_weights(inner_fn))
+        return (BidirectionalWrapper(name=name, layer=inner, mode=merge),
+                _bidirectional_weights(inner_fn))
+
+    if class_name == "ZeroPadding2D":
+        pad = cfg.get("padding", (1, 1))
+        if isinstance(pad, (list, tuple)) and pad and isinstance(pad[0], (list, tuple)):
+            (t, b), (l, r) = pad
+            return ZeroPaddingLayer(name=name, padding=(t, b, l, r)), _no_weights
+        return ZeroPaddingLayer(name=name, padding=_pair(pad)), _no_weights
+
+    if class_name == "ZeroPadding1D":
+        pad = cfg.get("padding", 1)
+        pad = _pair(pad, (1, 1)) if not isinstance(pad, int) else (pad, pad)
+        return ZeroPadding1DLayer(name=name, padding=pad), _no_weights
+
+    if class_name == "UpSampling2D":
+        return (UpsamplingLayer(name=name, size=_pair(cfg.get("size"), (2, 2))),
+                _no_weights)
+
+    if class_name == "UpSampling1D":
+        s = cfg.get("size", cfg.get("length", 2))
+        s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
+        return Upsampling1DLayer(name=name, size=s), _no_weights
+
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported Keras layer type {class_name!r}")

@@ -1,0 +1,280 @@
+"""Keras model-level import: Sequential → MultiLayerNetwork, functional
+Model → ComputationGraph.
+
+Reference: ``deeplearning4j-modelimport/.../KerasModel.java`` /
+``KerasSequentialModel.java`` (config parsing, topology build, weight
+copy-in) and ``utils/KerasModelBuilder.java``. The reference reads configs
+either from a standalone JSON or from the ``model_config`` attribute of a
+full-model HDF5; weights live under ``model_weights`` (full save) or at the
+file root (save_weights).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.keras.hdf5 import Hdf5Archive, read_weights_for_layer
+from deeplearning4j_tpu.modelimport.keras.layers import (
+    LOSSES,
+    InvalidKerasConfigurationException,
+    UnsupportedKerasConfigurationException,
+    map_keras_layer,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.nn.layers import DenseLayer, LossLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex, PreprocessorVertex
+
+
+def _input_type_from_shape(shape) -> InputType:
+    """Keras input_shape/batch_input_shape (batch dim stripped) → InputType.
+    Layout is channels_last (NHWC), the TPU-native layout."""
+    shape = tuple(shape)
+    if len(shape) == 1:
+        return InputType.feed_forward(shape[0])
+    if len(shape) == 2:
+        t, features = shape  # (timesteps-or-None, features)
+        return InputType.recurrent(features, t)
+    if len(shape) == 3:
+        h, w, c = shape
+        return InputType.convolutional(h, w, c)
+    raise UnsupportedKerasConfigurationException(f"Unsupported input shape {shape}")
+
+
+def _to_loss(loss_name: Optional[str]) -> Optional[str]:
+    if not loss_name:
+        return None
+    return LOSSES.get(str(loss_name).lower())
+
+
+class KerasModelConfig:
+    """Parsed top-level Keras config."""
+
+    def __init__(self, config_json: dict, training_json: Optional[dict] = None):
+        self.class_name = config_json.get("class_name")
+        self.config = config_json.get("config")
+        self.training = training_json or {}
+
+    @property
+    def loss(self) -> Optional[str]:
+        return _to_loss(self.training.get("loss"))
+
+    @property
+    def layer_configs(self) -> List[dict]:
+        if isinstance(self.config, list):  # Keras 1 Sequential
+            return self.config
+        return self.config.get("layers", [])
+
+
+class KerasSequentialModel:
+    """Sequential import (``KerasSequentialModel.java``)."""
+
+    def __init__(self, model_config: KerasModelConfig):
+        self.cfg = model_config
+        self.layer_names: List[str] = []
+        self.weight_fns: Dict[str, object] = {}
+        self._build()
+
+    def _build(self):
+        input_type: Optional[InputType] = None
+        layers = []
+        for lc in self.cfg.layer_configs:
+            cls = lc["class_name"]
+            conf = dict(lc.get("config", {}))
+            if input_type is None:
+                shape = conf.get("batch_input_shape") or conf.get("batch_shape")
+                if shape is not None:
+                    input_type = _input_type_from_shape(shape[1:])
+                elif "input_shape" in conf:
+                    input_type = _input_type_from_shape(conf["input_shape"])
+                elif "input_dim" in conf and cls in ("Dense", "Embedding"):
+                    if cls == "Embedding":
+                        input_type = InputType.recurrent(
+                            1, conf.get("input_length"))
+                    else:
+                        input_type = InputType.feed_forward(int(conf["input_dim"]))
+            layer, wf = map_keras_layer(cls, conf)
+            if layer is None:
+                continue
+            lname = conf.get("name") or f"layer_{len(layers)}"
+            layer.name = lname
+            self.layer_names.append(lname)
+            self.weight_fns[lname] = wf
+            layers.append(layer)
+        if input_type is None:
+            raise InvalidKerasConfigurationException(
+                "Sequential model config declares no input shape")
+        if not layers:
+            raise InvalidKerasConfigurationException("model has no layers")
+
+        # attach the training loss: final Dense becomes an OutputLayer,
+        # otherwise a LossLayer caps the stack (KerasLoss.java behavior)
+        loss = self.cfg.loss
+        if loss is not None:
+            last = layers[-1]
+            if type(last) is DenseLayer:
+                out = OutputLayer(name=last.name, n_in=last.n_in, n_out=last.n_out,
+                                  activation=last.activation, has_bias=last.has_bias,
+                                  loss=loss)
+                layers[-1] = out
+            elif not last.has_loss():
+                layers.append(LossLayer(name="keras_loss", loss=loss,
+                                        activation="identity"))
+
+        b = NeuralNetConfiguration.builder().list()
+        for l in layers:
+            b.layer(l)
+        self.conf = b.set_input_type(input_type).build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf).init()
+
+    def copy_weights(self, net: MultiLayerNetwork, archive: Hdf5Archive,
+                     *root: str) -> None:
+        by_name = {l.name: i for i, l in enumerate(net.layers)}
+        import jax.numpy as jnp
+        for lname in self.layer_names:
+            if lname not in by_name:
+                continue
+            raw = read_weights_for_layer(archive, lname, *root)
+            if not raw:
+                continue
+            params, states = self.weight_fns[lname](raw)
+            i = by_name[lname]
+            self._check_and_set(net.params[i], params, lname)
+            for k, v in states.items():
+                net.states[i][k] = jnp.asarray(np.asarray(v))
+
+    @staticmethod
+    def _check_and_set(target: dict, src: dict, lname: str) -> None:
+        import jax.numpy as jnp
+        for k, v in src.items():
+            if k not in target:
+                raise InvalidKerasConfigurationException(
+                    f"layer {lname!r}: imported param {k!r} not in model params "
+                    f"{sorted(target)}")
+            if tuple(target[k].shape) != tuple(np.shape(v)):
+                raise InvalidKerasConfigurationException(
+                    f"layer {lname!r} param {k!r}: shape {np.shape(v)} does not "
+                    f"match model {tuple(target[k].shape)}")
+            target[k] = jnp.asarray(np.asarray(v))
+
+
+class KerasModel:
+    """Functional-API import (``KerasModel.java``) → ComputationGraph."""
+
+    MERGE_LAYERS = {"Concatenate", "Merge"}
+    ELEMENTWISE = {"Add": "add", "Average": "average", "Subtract": "subtract",
+                   "Multiply": "product", "Maximum": "max"}
+
+    def __init__(self, model_config: KerasModelConfig):
+        self.cfg = model_config
+        self.layer_names: List[str] = []
+        self.weight_fns: Dict[str, object] = {}
+        self._build()
+
+    @staticmethod
+    def _inbound(lc: dict) -> List[str]:
+        nodes = lc.get("inbound_nodes") or []
+        if not nodes:
+            return []
+        node = nodes[0]
+        names = []
+        if isinstance(node, dict):  # Keras 3 style {"args": [...]}
+            def walk(o):
+                if isinstance(o, dict):
+                    if o.get("class_name") == "__keras_tensor__":
+                        names.append(o["config"]["keras_history"][0])
+                    else:
+                        for v in o.values():
+                            walk(v)
+                elif isinstance(o, (list, tuple)):
+                    for v in o:
+                        walk(v)
+            walk(node)
+        else:
+            for entry in node:
+                names.append(entry[0])
+        return names
+
+    def _build(self):
+        conf = self.cfg.config
+        layer_confs = conf["layers"]
+
+        def names_of(specs) -> List[str]:
+            # Keras 2: [["name", 0, 0], ...]; Keras 3 single output: ["name", 0, 0]
+            if (isinstance(specs, (list, tuple)) and len(specs) == 3
+                    and isinstance(specs[0], str) and isinstance(specs[1], int)):
+                return [specs[0]]
+            return [s[0] if isinstance(s, (list, tuple)) else s for s in specs]
+
+        input_names = names_of(conf.get("input_layers", []))
+        output_names = names_of(conf.get("output_layers", []))
+
+        g = NeuralNetConfiguration.builder().graph_builder()
+        input_types: List[InputType] = []
+        for lc in layer_confs:
+            cls = lc["class_name"]
+            c = dict(lc.get("config", {}))
+            lname = lc.get("name") or c.get("name")
+            inputs = self._inbound(lc)
+            if cls == "InputLayer":
+                shape = c.get("batch_input_shape") or c.get("batch_shape")
+                input_types.append(_input_type_from_shape(shape[1:]))
+                g.add_inputs(lname)
+                continue
+            if cls in self.MERGE_LAYERS:
+                g.add_vertex(lname, MergeVertex(), *inputs)
+                continue
+            if cls in self.ELEMENTWISE:
+                g.add_vertex(lname, ElementWiseVertex(op=self.ELEMENTWISE[cls]),
+                             *inputs)
+                continue
+            if cls == "Flatten":
+                g.add_vertex(lname, PreprocessorVertex(preprocessor="cnn_to_ff"),
+                             *inputs)
+                continue
+            layer, wf = map_keras_layer(cls, c)
+            if layer is None:
+                # structural no-op (Masking): pass-through vertex
+                g.add_vertex(lname, PreprocessorVertex(preprocessor="identity"),
+                             *inputs)
+                continue
+            layer.name = lname
+            self.layer_names.append(lname)
+            self.weight_fns[lname] = wf
+            g.add_layer(lname, layer, *inputs)
+
+        loss = self.cfg.loss
+        if loss is not None:
+            final_outputs = []
+            for on in output_names:
+                loss_name = f"{on}_loss"
+                g.add_layer(loss_name, LossLayer(loss=loss, activation="identity"), on)
+                final_outputs.append(loss_name)
+            output_names = final_outputs
+        g.set_outputs(*output_names)
+        g.set_input_types(*input_types)
+        self.conf = g.build()
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf).init()
+
+    def copy_weights(self, net: ComputationGraph, archive: Hdf5Archive,
+                     *root: str) -> None:
+        import jax.numpy as jnp
+        for lname in self.layer_names:
+            if lname not in net.params:
+                continue
+            raw = read_weights_for_layer(archive, lname, *root)
+            if not raw:
+                continue
+            params, states = self.weight_fns[lname](raw)
+            KerasSequentialModel._check_and_set(net.params[lname], params, lname)
+            for k, v in states.items():
+                net.states[lname][k] = jnp.asarray(np.asarray(v))

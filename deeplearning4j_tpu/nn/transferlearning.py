@@ -81,11 +81,17 @@ class FineTuneConfiguration:
     def apply_to_layer(self, layer: Layer) -> None:
         """Clear per-layer values that a fine-tune override should replace, so
         ``apply_global_defaults`` re-inherits them from the new global conf
-        (per-layer overrides beat globals in DL4J; fine-tuning resets them)."""
-        for f in ("updater", "bias_updater", "l1", "l2", "l1_bias", "l2_bias",
-                  "gradient_normalization"):
-            if getattr(self, f) is not None and not isinstance(layer, FrozenLayer):
+        (per-layer overrides beat globals in DL4J; fine-tuning resets them on
+        every non-frozen layer, ``FineTuneConfiguration.applyToLayer``)."""
+        if isinstance(layer, FrozenLayer):
+            return
+        for f in ("updater", "bias_updater", "activation", "weight_init",
+                  "distribution", "bias_init", "dropout", "l1", "l2",
+                  "l1_bias", "l2_bias", "gradient_normalization"):
+            if getattr(self, f) is not None:
                 setattr(layer, f, None)
+        if self.gradient_normalization_threshold is not None:
+            layer.gradient_normalization_threshold = self.gradient_normalization_threshold
 
 
 class TransferLearning:

@@ -99,6 +99,8 @@ class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
         self._stale = 0
 
     def terminate(self, epoch, score):
+        if math.isnan(score):
+            return False  # no fresh evaluation this epoch; don't advance staleness
         if self._best is None or (self._best - score) > self.min_improvement:
             self._best = score
             self._stale = 0
@@ -255,14 +257,15 @@ class EarlyStoppingTrainer:
                 details = type(stop_iter).__name__
                 epoch += 1
                 break
+            if cfg.save_last_model:
+                # latest is saved every epoch, independent of eval cadence
+                cfg.saver.save_latest_model(self.model, last_eval)
             if (epoch + 1) % cfg.evaluate_every_n_epochs == 0:
                 last_eval = cfg.score_calculator.calculate_score(self.model)
                 scores[epoch] = last_eval
                 if last_eval < best_score:
                     best_score, best_epoch = last_eval, epoch
                     cfg.saver.save_best_model(self.model, last_eval)
-                if cfg.save_last_model:
-                    cfg.saver.save_latest_model(self.model, last_eval)
             # epoch termination is checked EVERY epoch (with the most recent
             # eval score), so MaxEpochs cannot overshoot when
             # evaluate_every_n_epochs > 1 (BaseEarlyStoppingTrainer.fit parity)
