@@ -15,6 +15,24 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map_impl
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs):
+    """Version-tolerant shard_map with replication checking disabled
+    (the kwarg is ``check_vma`` on recent jax, ``check_rep`` before)."""
+    for kw in ("check_vma", "check_rep"):
+        try:
+            return _shard_map_impl(fn, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **{kw: False})
+        except TypeError:  # pragma: no cover - depends on jax version
+            continue
+    return _shard_map_impl(fn, mesh=mesh, in_specs=in_specs,  # pragma: no cover
+                           out_specs=out_specs)
+
 # Canonical axis names used across the framework.
 DATA_AXIS = "data"
 MODEL_AXIS = "model"

@@ -1,0 +1,205 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no attention and no sequence parallelism — its longest
+sequence mechanism is truncated BPTT (`MultiLayerNetwork.java:1309`,
+SURVEY.md §5 "Long-context"). This module is the TPU-native long-context
+design the survey calls for: sequences are sharded over a Mesh axis
+(``mesh.SEQUENCE_AXIS``) and attention runs without ever materialising the
+full [T, T] score matrix on one chip.
+
+Two strategies, both jit/shard_map-compatible:
+
+- :func:`ring_attention` — blockwise attention with a flash-style streaming
+  softmax (running max + normaliser). K/V blocks rotate around the ring via
+  ``jax.lax.ppermute`` so each hop rides a single ICI link; compute on block
+  i overlaps the transfer of block i+1 (XLA schedules the ppermute + einsum
+  concurrently since they have no data dependence).
+- :func:`ulysses_attention` — all-to-all switch: resharding [N, T/s, H, Dh]
+  (sequence-sharded) → [N, T, H/s, Dh] (head-sharded), plain attention per
+  head group, then all-to-all back. Fewer collective steps but requires
+  n_heads % shards == 0.
+
+Both compute the same attention as
+``nn.layers.attention.dot_product_attention`` up to float32 round-off (the
+streaming softmax reassociates the sum), asserted vs the single-device
+reference on an 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import SEQUENCE_AXIS, shard_map
+
+_NEG_INF = -1e30  # large finite negative: avoids nan from (-inf) - (-inf)
+
+
+def _ring_attention_sharded(q, k, v, mask_kv, *, axis_name, causal, scale):
+    """Per-shard body (runs under shard_map).
+
+    q, k, v: [N, H, Tq_local, Dh] / [N, H, Tk_local, Dh] local shards.
+    mask_kv: [N, Tk_local] validity of local keys (1=valid) or None.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    tq = q.shape[2]
+    tk = k.shape[2]
+    dtype = q.dtype
+
+    q32 = (q * scale).astype(jnp.float32)
+    out = jnp.zeros(q.shape[:2] + (tq, v.shape[-1]), jnp.float32)
+    row_max = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
+    row_sum = jnp.zeros(q.shape[:3], jnp.float32)
+
+    if mask_kv is None:
+        mask_kv = jnp.ones((q.shape[0], tk), jnp.float32)
+
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def accumulate(step, k_blk, v_blk, m_blk, out, row_max, row_sum):
+        # block that arrived after `step` hops originated at my_idx - step
+        src = (my_idx - step) % n_shards
+
+        def do(acc):
+            out, row_max, row_sum = acc
+            scores = jnp.einsum("nhqd,nhkd->nhqk", q32,
+                                k_blk.astype(jnp.float32))
+            valid = m_blk[:, None, None, :] > 0                # [N,1,1,Tk]
+            if causal:
+                q_pos = my_idx * tq + jnp.arange(tq)
+                k_pos = src * tk + jnp.arange(tk)
+                valid = jnp.logical_and(
+                    valid,
+                    q_pos[None, None, :, None] >= k_pos[None, None, None, :])
+            scores = jnp.where(valid, scores, _NEG_INF)
+            blk_max = jnp.max(scores, axis=-1)
+            new_max = jnp.maximum(row_max, blk_max)
+            correction = jnp.exp(row_max - new_max)
+            # zero invalid entries so fully-masked rows keep row_sum == 0
+            p = jnp.where(valid, jnp.exp(scores - new_max[..., None]), 0.0)
+            new_sum = row_sum * correction + jnp.sum(p, axis=-1)
+            new_out = out * correction[..., None] + jnp.einsum(
+                "...qk,...kd->...qd", p, v_blk.astype(jnp.float32))
+            return new_out, new_max, new_sum
+
+        if causal and tq == tk:
+            # blocks strictly in the future are fully masked — skip the matmul
+            return jax.lax.cond(src > my_idx, lambda acc: acc, do,
+                                (out, row_max, row_sum))
+        return do((out, row_max, row_sum))
+
+    def body(step, carry):
+        out, row_max, row_sum, k_blk, v_blk, m_blk = carry
+        out, row_max, row_sum = accumulate(step, k_blk, v_blk, m_blk,
+                                           out, row_max, row_sum)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        m_blk = jax.lax.ppermute(m_blk, axis_name, perm)
+        return out, row_max, row_sum, k_blk, v_blk, m_blk
+
+    # n_shards-1 rotate-and-accumulate hops, then the last block in place
+    # (no trailing ppermute whose result would be discarded).
+    carry = (out, row_max, row_sum, k, v, mask_kv)
+    out, row_max, row_sum, k_blk, v_blk, m_blk = jax.lax.fori_loop(
+        0, n_shards - 1, body, carry)
+    out, row_max, row_sum = accumulate(n_shards - 1, k_blk, v_blk, m_blk,
+                                       out, row_max, row_sum)
+    # rows with no valid key (fully masked) emit zeros, not nan
+    denom = jnp.where(row_sum > 0, row_sum, 1.0)
+    return (out / denom[..., None]).astype(dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = SEQUENCE_AXIS,
+                   mask: Optional[jax.Array] = None, causal: bool = False):
+    """Ring attention over sequence shards. Call under shard_map/pjit.
+
+    q, k, v: [N, H, T_local, Dh] — the local sequence shard of each device
+    on mesh axis ``axis_name``. ``mask``: [N, T_local] key validity (1=valid).
+    Returns [N, H, T_local, Dh]; matches full attention to float32 round-off.
+    Fully-masked query rows return zeros.
+    """
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    return _ring_attention_sharded(q, k, v, mask, axis_name=axis_name,
+                                   causal=causal, scale=scale)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *,
+                        axis_name: str = SEQUENCE_AXIS,
+                        mask: Optional[jax.Array] = None,
+                        causal: bool = False):
+    """Convenience wrapper: full [N, H, T, Dh] arrays in, shard_map inside.
+
+    Shards T over ``axis_name`` (batch/head replicated) and runs
+    :func:`ring_attention`. For production nets compose the per-shard
+    function into your own pjit'd step instead.
+    """
+    spec_qkv = P(None, None, axis_name, None)
+    spec_mask = P(None, axis_name)
+    in_specs = (spec_qkv, spec_qkv, spec_qkv,
+                spec_mask if mask is not None else None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=spec_qkv)
+    def run(q, k, v, m):
+        return ring_attention(q, k, v, axis_name=axis_name, mask=m,
+                              causal=causal)
+
+    return run(q, k, v, mask)
+
+
+def _ulysses_sharded(q, k, v, mask, *, axis_name, causal):
+    """Per-shard Ulysses body: [N, H, T/s, Dh] in → all-to-all →
+    [N, H/s, T, Dh] → plain attention → all-to-all back."""
+    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+
+    def seq_to_head(x):
+        # split heads (axis 1) across shards, gather sequence (axis 2)
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def head_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_head(q), seq_to_head(k), seq_to_head(v)
+    full_mask = None
+    if mask is not None:
+        full_mask = jax.lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    if causal:
+        t = qh.shape[2]
+        tri = jnp.tril(jnp.ones((t, t), jnp.float32))[None, None]
+        full_mask = tri if full_mask is None else (
+            full_mask[:, None, None, :] * tri)
+    out = dot_product_attention(qh, kh, vh, mask=full_mask)
+    return head_to_seq(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *,
+                      axis_name: str = SEQUENCE_AXIS,
+                      mask: Optional[jax.Array] = None,
+                      causal: bool = False):
+    """Ulysses (all-to-all) sequence parallelism on full [N, H, T, Dh] arrays.
+
+    Requires H % mesh.shape[axis_name] == 0. Two all-to-alls per call; the
+    attention itself is the stock fused XLA path.
+    """
+    n_shards = mesh.shape[axis_name]
+    if q.shape[1] % n_shards:
+        raise ValueError(
+            f"ulysses needs n_heads divisible by shards ({q.shape[1]} % {n_shards})")
+    spec_qkv = P(None, None, axis_name, None)
+    spec_mask = P(None, axis_name)
+    in_specs = (spec_qkv, spec_qkv, spec_qkv,
+                spec_mask if mask is not None else None)
+
+    @functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                       out_specs=spec_qkv)
+    def run(q, k, v, m):
+        return _ulysses_sharded(q, k, v, m, axis_name=axis_name, causal=causal)
+
+    return run(q, k, v, mask)
