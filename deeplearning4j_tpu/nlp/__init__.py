@@ -1,0 +1,41 @@
+"""NLP: embedding trainers, tokenization, vocab, vectorizers.
+
+TPU-native re-design of the reference's `deeplearning4j-nlp` module
+(SURVEY.md §2 "NLP: SequenceVectors family", 26.5k LoC):
+`SequenceVectors.java` / `Word2Vec.java` / `ParagraphVectors.java` /
+`Glove.java`. The reference trains embeddings hogwild-style — N JVM threads
+racing on `InMemoryLookupTable` rows with no locks. Hogwild has no jit
+analog; here training is deterministic minibatched scatter-add under a
+single jit step (SURVEY.md §7 "Hard parts"), which keeps the MXU busy with
+one big gather→dot→scatter per batch instead of millions of tiny row ops.
+"""
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.sentence import (
+    BasicLineIterator,
+    CollectionSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, VocabWord
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.vectorizer import (
+    BagOfWordsVectorizer,
+    TfidfVectorizer,
+)
+
+__all__ = [
+    "BagOfWordsVectorizer", "BasicLineIterator", "CollectionSentenceIterator",
+    "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
+    "Glove", "InMemoryLookupTable", "NGramTokenizerFactory",
+    "ParagraphVectors", "SequenceVectors", "TfidfVectorizer", "VocabCache",
+    "VocabConstructor", "VocabWord", "Word2Vec", "WordVectorSerializer",
+]

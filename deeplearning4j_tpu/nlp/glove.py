@@ -1,0 +1,141 @@
+"""GloVe embeddings.
+
+Parity with `models/glove/Glove.java` (429 LoC): builds a co-occurrence
+table from windowed corpus scans, then fits with the GloVe weighted
+least-squares objective under AdaGrad. The reference loops nonzero cells in
+shuffled order across threads; here the nonzeros are flat arrays and each
+epoch is a sequence of fixed-size jitted AdaGrad steps.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, hw, hwc, hb, hbc,
+                rows, cols, logx, weight, valid, lr):
+    """One AdaGrad minibatch on nonzero co-occurrence cells.
+
+    w/wc: center/context embeddings; b/bc their biases; h*: AdaGrad
+    accumulators. diff = w_i·wc_j + b_i + bc_j − log X_ij;
+    loss = f(X_ij)·diff²."""
+    wi, wj = w[rows], wc[cols]
+    diff = jnp.sum(wi * wj, axis=1) + b[rows] + bc[cols] - logx
+    fdiff = weight * diff * valid
+    gw = fdiff[:, None] * wj
+    gwc = fdiff[:, None] * wi
+    # AdaGrad
+    new_hw = hw.at[rows].add(gw * gw, mode="drop")
+    new_hwc = hwc.at[cols].add(gwc * gwc, mode="drop")
+    new_hb = hb.at[rows].add(fdiff * fdiff, mode="drop")
+    new_hbc = hbc.at[cols].add(fdiff * fdiff, mode="drop")
+    eps = 1e-8
+    w = w.at[rows].add(-lr * gw / jnp.sqrt(new_hw[rows] + eps), mode="drop")
+    wc = wc.at[cols].add(-lr * gwc / jnp.sqrt(new_hwc[cols] + eps), mode="drop")
+    b = b.at[rows].add(-lr * fdiff / jnp.sqrt(new_hb[rows] + eps), mode="drop")
+    bc = bc.at[cols].add(-lr * fdiff / jnp.sqrt(new_hbc[cols] + eps), mode="drop")
+    return w, wc, b, bc, new_hw, new_hwc, new_hb, new_hbc
+
+
+class Glove(SequenceVectors):
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 learning_rate: float = 0.05, epochs: int = 5,
+                 min_word_frequency: int = 1, x_max: float = 100.0,
+                 alpha: float = 0.75, batch_size: int = 8192,
+                 symmetric: bool = True, seed: int = 12345,
+                 tokenizer_factory=None):
+        super().__init__(layer_size=layer_size, window=window,
+                         learning_rate=learning_rate, epochs=epochs,
+                         min_word_frequency=min_word_frequency, seed=seed)
+        self.x_max = x_max
+        self.alpha = alpha
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _tokenize(self, sentences) -> List[List[str]]:
+        out = []
+        for s in sentences:
+            if isinstance(s, str):
+                out.append(self.tokenizer_factory.create(s).get_tokens())
+            else:
+                out.append(list(s))
+        return out
+
+    def build_cooccurrence(self, corpus: List[List[str]]
+                           ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Windowed counts weighted 1/distance (AbstractCoOccurrences.java)."""
+        counts: Dict[Tuple[int, int], float] = defaultdict(float)
+        for tokens in corpus:
+            idx = [self.vocab.index_of(t) for t in tokens]
+            idx = [i for i in idx if i >= 0]
+            for i, wi in enumerate(idx):
+                for off in range(1, self.window + 1):
+                    j = i + off
+                    if j >= len(idx):
+                        break
+                    inc = 1.0 / off
+                    counts[(wi, idx[j])] += inc
+                    if self.symmetric:
+                        counts[(idx[j], wi)] += inc
+        rows = np.fromiter((k[0] for k in counts), np.int32, len(counts))
+        cols = np.fromiter((k[1] for k in counts), np.int32, len(counts))
+        vals = np.fromiter(counts.values(), np.float32, len(counts))
+        return rows, cols, vals
+
+    def fit(self, sentences: Iterable) -> "Glove":
+        corpus = self._tokenize(sentences)
+        if self.vocab is None:
+            constructor = VocabConstructor(
+                min_word_frequency=self.min_word_frequency)
+            self.vocab = constructor.build_vocab(corpus)
+        rows, cols, vals = self.build_cooccurrence(corpus)
+        n, d = self.vocab.num_words(), self.layer_size
+        rng = np.random.default_rng(self.seed)
+        w = jnp.asarray((rng.random((n, d)) - 0.5) / d, jnp.float32)
+        wc = jnp.asarray((rng.random((n, d)) - 0.5) / d, jnp.float32)
+        b = jnp.zeros((n,), jnp.float32)
+        bc = jnp.zeros((n,), jnp.float32)
+        hw = jnp.zeros((n, d), jnp.float32)
+        hwc = jnp.zeros((n, d), jnp.float32)
+        hb = jnp.zeros((n,), jnp.float32)
+        hbc = jnp.zeros((n,), jnp.float32)
+
+        logx = np.log(vals)
+        weight = np.minimum((vals / self.x_max) ** self.alpha, 1.0).astype(np.float32)
+        m = len(rows)
+        bs = self.batch_size
+        for _epoch in range(self.epochs):
+            order = rng.permutation(m)
+            for start in range(0, m, bs):
+                sel = order[start:start + bs]
+                pad = bs - len(sel)
+                r = np.concatenate([rows[sel], np.zeros(pad, np.int32)])
+                c = np.concatenate([cols[sel], np.zeros(pad, np.int32)])
+                lx = np.concatenate([logx[sel], np.zeros(pad, np.float32)])
+                wt = np.concatenate([weight[sel], np.zeros(pad, np.float32)])
+                vl = np.concatenate([np.ones(len(sel), np.float32),
+                                     np.zeros(pad, np.float32)])
+                (w, wc, b, bc, hw, hwc, hb, hbc) = _glove_step(
+                    w, wc, b, bc, hw, hwc, hb, hbc,
+                    r, c, lx.astype(np.float32), wt, vl,
+                    jnp.float32(self.learning_rate))
+
+        # final embedding = w + wc (standard GloVe)
+        from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+        self.lookup_table = InMemoryLookupTable(self.vocab, d, seed=self.seed,
+                                                negative=0, use_hs=False,
+                                                init_syn0=False)
+        self.lookup_table.syn0 = w + wc
+        return self

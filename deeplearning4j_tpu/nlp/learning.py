@@ -1,0 +1,307 @@
+"""Embedding learning algorithms: SkipGram / CBOW (+ DM / DBOW on top).
+
+Parity with `models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java`
+and `.../sequence/{DM,DBOW}.java`. The reference updates syn0/syn1 rows one
+(word, context) pair at a time from racing threads; here pairs are generated
+on host (vectorised numpy), packed into fixed-size batches (static shapes →
+one XLA program), and applied as a single gather→dot→scatter-add jit step.
+Negative sampling and hierarchical softmax both supported, matching word2vec
+gradient math: g = (label − σ(h·v)) · lr.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+
+
+# ---------------------------------------------------------------- jit steps
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ns_step(syn0, syn1neg, centers, targets, labels, valid, lr):
+    """Negative-sampling update for a batch of center→target rows.
+
+    centers: [B] rows of syn0 (context word for SG; mean handled by _cbow).
+    targets: [B, K] rows of syn1neg (1 positive + K-1 negatives).
+    labels:  [B, K] 1.0 for the positive column, else 0.0.
+    valid:   [B, K] 0.0 masks padding and self-collision negatives.
+    """
+    h = syn0[centers]                                        # [B, D]
+    vt = syn1neg[targets]                                    # [B, K, D]
+    logits = jnp.einsum("bd,bkd->bk", h, vt)
+    g = (labels - jax.nn.sigmoid(logits)) * valid * lr       # [B, K]
+    dh = jnp.einsum("bk,bkd->bd", g, vt)
+    dvt = g[..., None] * h[:, None, :]
+    syn0 = syn0.at[centers].add(dh, mode="drop")
+    syn1neg = syn1neg.at[targets].add(dvt, mode="drop")
+    return syn0, syn1neg
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _hs_step(syn0, syn1, centers, points, codes, valid, lr):
+    """Hierarchical-softmax update: points are inner-node rows of syn1,
+    label for node j is (1 - code_j)."""
+    h = syn0[centers]
+    vt = syn1[points]
+    logits = jnp.einsum("bd,bkd->bk", h, vt)
+    g = ((1.0 - codes) - jax.nn.sigmoid(logits)) * valid * lr
+    dh = jnp.einsum("bk,bkd->bd", g, vt)
+    dvt = g[..., None] * h[:, None, :]
+    syn0 = syn0.at[centers].add(dh, mode="drop")
+    syn1 = syn1.at[points].add(dvt, mode="drop")
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_hs_step(syn0, syn1, contexts, ctx_valid, points, codes, valid, lr):
+    """CBOW with hierarchical softmax: h = mean of context vectors, labels
+    from Huffman codes, input gradient spread to every context word."""
+    cv = syn0[contexts] * ctx_valid[..., None]               # [B, C, D]
+    n_ctx = jnp.maximum(jnp.sum(ctx_valid, axis=1), 1.0)
+    h = jnp.sum(cv, axis=1) / n_ctx[:, None]
+    vt = syn1[points]
+    logits = jnp.einsum("bd,bkd->bk", h, vt)
+    g = ((1.0 - codes) - jax.nn.sigmoid(logits)) * valid * lr
+    dh = jnp.einsum("bk,bkd->bd", g, vt)
+    dvt = g[..., None] * h[:, None, :]
+    dctx = jnp.broadcast_to(dh[:, None, :], cv.shape) * ctx_valid[..., None]
+    syn0 = syn0.at[contexts].add(dctx, mode="drop")
+    syn1 = syn1.at[points].add(dvt, mode="drop")
+    return syn0, syn1
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cbow_ns_step(syn0, syn1neg, contexts, ctx_valid, targets, labels,
+                  valid, lr):
+    """CBOW: h = mean of context vectors; input gradient spread equally."""
+    cv = syn0[contexts] * ctx_valid[..., None]               # [B, C, D]
+    n_ctx = jnp.maximum(jnp.sum(ctx_valid, axis=1), 1.0)     # [B]
+    h = jnp.sum(cv, axis=1) / n_ctx[:, None]                 # [B, D]
+    vt = syn1neg[targets]                                    # [B, K, D]
+    logits = jnp.einsum("bd,bkd->bk", h, vt)
+    g = (labels - jax.nn.sigmoid(logits)) * valid * lr
+    dh = jnp.einsum("bk,bkd->bd", g, vt)                     # [B, D]
+    dvt = g[..., None] * h[:, None, :]
+    # word2vec applies the full dh to every context word
+    dctx = jnp.broadcast_to(dh[:, None, :], cv.shape) * ctx_valid[..., None]
+    syn0 = syn0.at[contexts].add(dctx, mode="drop")
+    syn1neg = syn1neg.at[targets].add(dvt, mode="drop")
+    return syn0, syn1neg
+
+
+# ------------------------------------------------------- pair generation
+
+def generate_sg_pairs(seq: np.ndarray, window: int,
+                      rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
+    """(center, context) index pairs with word2vec's random reduced window."""
+    L = len(seq)
+    if L < 2:
+        return np.empty(0, np.int32), np.empty(0, np.int32)
+    b = rng.integers(1, window + 1, size=L)
+    offsets = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    idx = np.arange(L)[:, None] + offsets[None, :]            # [L, 2W]
+    ok = (idx >= 0) & (idx < L) & (np.abs(offsets)[None, :] <= b[:, None])
+    ii, jj = np.nonzero(ok)
+    return seq[ii].astype(np.int32), seq[idx[ii, jj]].astype(np.int32)
+
+
+def generate_cbow_groups(seq: np.ndarray, window: int,
+                         rng: np.random.Generator
+                         ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(target, context_matrix, context_valid): contexts padded to 2*window."""
+    L = len(seq)
+    if L < 2:
+        z = np.empty((0,), np.int32)
+        return z, np.empty((0, 2 * window), np.int32), np.empty((0, 2 * window), np.float32)
+    b = rng.integers(1, window + 1, size=L)
+    offsets = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    idx = np.arange(L)[:, None] + offsets[None, :]
+    ok = (idx >= 0) & (idx < L) & (np.abs(offsets)[None, :] <= b[:, None])
+    ctx = np.where(ok, seq[np.clip(idx, 0, L - 1)], 0).astype(np.int32)
+    return seq.astype(np.int32), ctx, ok.astype(np.float32)
+
+
+def subsample(seq: np.ndarray, keep_prob: np.ndarray,
+              rng: np.random.Generator) -> np.ndarray:
+    """Frequent-word subsampling (word2vec `sample` parameter)."""
+    if keep_prob is None:
+        return seq
+    return seq[rng.random(len(seq)) < keep_prob[seq]]
+
+
+def make_keep_prob(cache, sample: float) -> Optional[np.ndarray]:
+    if not sample or sample <= 0:
+        return None
+    freqs = np.array([vw.frequency for vw in cache.vocab_words()], np.float64)
+    total = freqs.sum()
+    ratio = freqs / (sample * total)
+    keep = (np.sqrt(ratio) + 1.0) / ratio
+    return np.minimum(keep, 1.0)
+
+
+def _pad_rows(n: int, minimum: int = 256) -> int:
+    """Round the batch up to a power of two so XLA compiles once per bucket,
+    not once per sentence length."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _pad_to(arr: np.ndarray, rows: int, fill=0) -> np.ndarray:
+    if len(arr) == rows:
+        return arr
+    pad_shape = (rows - len(arr),) + arr.shape[1:]
+    return np.concatenate([arr, np.full(pad_shape, fill, arr.dtype)], axis=0)
+
+
+# ------------------------------------------------------ learning algorithms
+
+class ElementsLearningAlgorithm:
+    """SPI mirroring `learning/ElementsLearningAlgorithm.java`."""
+
+    name: str = "base"
+
+    def configure(self, table: InMemoryLookupTable, window: int,
+                  negative: int, seed: int) -> None:
+        self.table = table
+        self.window = window
+        self.negative = negative
+        self.rng = np.random.default_rng(seed)
+        self._max_code = max(
+            (len(vw.code) for vw in table.cache.vocab_words()), default=1) or 1
+        if table.use_hs:
+            n = table.cache.num_words()
+            self._points = np.zeros((n, self._max_code), np.int32)
+            self._codes = np.zeros((n, self._max_code), np.float32)
+            self._code_valid = np.zeros((n, self._max_code), np.float32)
+            for vw in table.cache.vocab_words():
+                L = len(vw.code)
+                self._points[vw.index, :L] = vw.points
+                self._codes[vw.index, :L] = vw.code
+                self._code_valid[vw.index, :L] = 1.0
+
+    def _sample_negatives(self, positives: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """targets [B, 1+neg], labels, valid — col 0 is the positive."""
+        B = len(positives)
+        table = self.table.unigram_table()
+        negs = table[self.rng.integers(0, len(table), size=(B, self.negative))]
+        targets = np.concatenate([positives[:, None], negs], axis=1).astype(np.int32)
+        labels = np.zeros_like(targets, np.float32)
+        labels[:, 0] = 1.0
+        valid = np.ones_like(labels)
+        valid[:, 1:] = (negs != positives[:, None]).astype(np.float32)
+        return targets, labels, valid
+
+    def train_pairs(self, centers: np.ndarray, predicted: np.ndarray,
+                    lr: float) -> None:
+        """Update tables for (input-row, predicted-word) pairs."""
+        B = len(centers)
+        if B == 0:
+            return
+        rows = _pad_rows(B)
+        if self.table.use_hs:
+            pts = _pad_to(self._points[predicted], rows)
+            cds = _pad_to(self._codes[predicted], rows)
+            val = _pad_to(self._code_valid[predicted], rows)
+            self.table.syn0, self.table.syn1 = _hs_step(
+                self.table.syn0, self.table.syn1, _pad_to(centers, rows),
+                pts, cds, val, jnp.float32(lr))
+        if self.negative > 0:
+            targets, labels, valid = self._sample_negatives(predicted)
+            self.table.syn0, self.table.syn1neg = _ns_step(
+                self.table.syn0, self.table.syn1neg, _pad_to(centers, rows),
+                _pad_to(targets, rows), _pad_to(labels, rows),
+                _pad_to(valid, rows), jnp.float32(lr))
+
+
+class SkipGram(ElementsLearningAlgorithm):
+    """Predict each context word from the center word (SkipGram.java).
+
+    word2vec convention: the *context* word's syn0 row is the input and the
+    center word is predicted — equivalent by symmetry; we follow the
+    original C code (input = center of the pair list below)."""
+
+    name = "SkipGram"
+
+    def train_sequence(self, seq: np.ndarray, lr: float,
+                       keep_prob: Optional[np.ndarray] = None) -> int:
+        seq = subsample(seq, keep_prob, self.rng)
+        centers, contexts = generate_sg_pairs(seq, self.window, self.rng)
+        self.train_pairs(contexts, centers, lr)  # input=context, predict=center
+        return len(centers)
+
+
+class CBOW(ElementsLearningAlgorithm):
+    """Predict the center word from the mean of its context (CBOW.java)."""
+
+    name = "CBOW"
+
+    def train_sequence(self, seq: np.ndarray, lr: float,
+                       keep_prob: Optional[np.ndarray] = None) -> int:
+        seq = subsample(seq, keep_prob, self.rng)
+        targets, ctx, ctx_valid = generate_cbow_groups(seq, self.window, self.rng)
+        if len(targets) == 0:
+            return 0
+        if self.table.use_hs:
+            rows = _pad_rows(len(targets))
+            self.table.syn0, self.table.syn1 = _cbow_hs_step(
+                self.table.syn0, self.table.syn1, _pad_to(ctx, rows),
+                _pad_to(ctx_valid, rows), _pad_to(self._points[targets], rows),
+                _pad_to(self._codes[targets], rows),
+                _pad_to(self._code_valid[targets], rows), jnp.float32(lr))
+        if self.negative > 0:
+            t, labels, valid = self._sample_negatives(targets)
+            rows = _pad_rows(len(targets))
+            self.table.syn0, self.table.syn1neg = _cbow_ns_step(
+                self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
+                _pad_to(ctx_valid, rows), _pad_to(t, rows),
+                _pad_to(labels, rows), _pad_to(valid, rows), jnp.float32(lr))
+        return len(targets)
+
+
+class DBOW(SkipGram):
+    """Distributed bag-of-words for ParagraphVectors (DBOW.java): the
+    document/label row predicts each word in the document."""
+
+    name = "DBOW"
+
+    def train_document(self, label_idx: int, seq: np.ndarray, lr: float,
+                       keep_prob: Optional[np.ndarray] = None) -> int:
+        seq = subsample(seq, keep_prob, self.rng)
+        if len(seq) == 0:
+            return 0
+        labels = np.full(len(seq), label_idx, np.int32)
+        self.train_pairs(labels, seq.astype(np.int32), lr)
+        return len(seq)
+
+
+class DM(CBOW):
+    """Distributed memory (DM.java): label row joins the context average."""
+
+    name = "DM"
+
+    def train_document(self, label_idx: int, seq: np.ndarray, lr: float,
+                       keep_prob: Optional[np.ndarray] = None) -> int:
+        seq = subsample(seq, keep_prob, self.rng)
+        targets, ctx, ctx_valid = generate_cbow_groups(seq, self.window, self.rng)
+        if len(targets) == 0:
+            return 0
+        # append the label row as an always-valid context column
+        lab_col = np.full((len(targets), 1), label_idx, np.int32)
+        ctx = np.concatenate([ctx, lab_col], axis=1)
+        ctx_valid = np.concatenate(
+            [ctx_valid, np.ones((len(targets), 1), np.float32)], axis=1)
+        t, labels, valid = self._sample_negatives(targets)
+        rows = _pad_rows(len(targets))
+        self.table.syn0, self.table.syn1neg = _cbow_ns_step(
+            self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
+            _pad_to(ctx_valid, rows), _pad_to(t, rows),
+            _pad_to(labels, rows), _pad_to(valid, rows), jnp.float32(lr))
+        return len(targets)

@@ -1,0 +1,84 @@
+"""Weight lookup table for embedding models.
+
+Parity with `models/embeddings/inmemory/InMemoryLookupTable.java:56`:
+syn0 (input vectors), syn1 (hierarchical-softmax inner nodes), syn1neg
+(negative-sampling output vectors), and the unigram sampling table. Arrays
+are device-resident jnp arrays updated functionally by the jitted training
+steps in :mod:`learning`; the reference's lock-free row races become
+deterministic scatter-adds.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    def __init__(self, cache: VocabCache, vector_length: int,
+                 seed: int = 12345, use_hs: bool = False,
+                 negative: int = 5, dtype=jnp.float32,
+                 init_syn0: bool = True):
+        """``init_syn0=False`` skips the random init for callers about to
+        install weights (loaders, GloVe)."""
+        self.cache = cache
+        self.vector_length = vector_length
+        self.use_hs = use_hs
+        self.negative = negative
+        n = cache.num_words()
+        if init_syn0:
+            rng = np.random.default_rng(seed)
+            # word2vec init: uniform(-0.5, 0.5)/dim for syn0, zeros for outputs
+            self.syn0 = jnp.asarray(
+                (rng.random((n, vector_length)) - 0.5) / vector_length,
+                dtype=dtype)
+        else:
+            self.syn0 = None
+        self.syn1 = (jnp.zeros((max(n - 1, 1), vector_length), dtype)
+                     if use_hs else None)
+        self.syn1neg = (jnp.zeros((n, vector_length), dtype)
+                        if negative > 0 else None)
+        self._unigram: Optional[np.ndarray] = None
+        self._unigram_size = 0
+
+    def unigram_table(self, table_size: int = 100_000,
+                      power: float = 0.75) -> np.ndarray:
+        """Negative-sampling table: word i appears ∝ freq(i)^0.75."""
+        if self._unigram is None or self._unigram_size != table_size:
+            self._unigram_size = table_size
+            freqs = np.array([vw.frequency for vw in self.cache.vocab_words()],
+                             np.float64)
+            probs = freqs ** power
+            probs /= probs.sum()
+            counts = np.maximum((probs * table_size).astype(np.int64), 1)
+            self._unigram = np.repeat(np.arange(len(freqs)), counts)
+        return self._unigram
+
+    def vector(self, word: str) -> Optional[np.ndarray]:
+        idx = self.cache.index_of(word)
+        if idx < 0:
+            return None
+        return np.asarray(self.syn0[idx])
+
+    def all_vectors(self) -> np.ndarray:
+        return np.asarray(self.syn0)
+
+    def resize(self, new_rows: int, seed: int = 0) -> None:
+        """Grow syn0/syn1neg for newly added vocab rows (ParagraphVectors
+        label insertion)."""
+        n, d = self.syn0.shape
+        if new_rows <= n:
+            return
+        rng = np.random.default_rng(seed)
+        extra = jnp.asarray((rng.random((new_rows - n, d)) - 0.5) / d,
+                            self.syn0.dtype)
+        self.syn0 = jnp.concatenate([self.syn0, extra], axis=0)
+        if self.syn1neg is not None:
+            self.syn1neg = jnp.concatenate(
+                [self.syn1neg, jnp.zeros((new_rows - n, d),
+                                         self.syn1neg.dtype)], axis=0)
+        self._unigram = None

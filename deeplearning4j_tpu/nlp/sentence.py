@@ -1,0 +1,129 @@
+"""Sentence / document iterators.
+
+Parity with `text/sentenceiterator/` (BasicLineIterator, Collection-,
+File-, and the labelled document variants used by ParagraphVectors).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
+
+
+class SentenceIterator:
+    """Streams sentences; reset() restarts from the beginning."""
+
+    def next_sentence(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[str]:
+        self.reset()
+        while self.has_next():
+            s = self.next_sentence()
+            if s is not None:
+                yield s
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Sequence[str]):
+        self._sentences = list(sentences)
+        self._pos = 0
+
+    def next_sentence(self) -> Optional[str]:
+        if self._pos >= len(self._sentences):
+            return None
+        s = self._sentences[self._pos]
+        self._pos += 1
+        return s
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._sentences)
+
+    def reset(self) -> None:
+        self._pos = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a text file (BasicLineIterator.java)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._fh = None
+        self._next: Optional[str] = None
+        self.reset()
+
+    def _advance(self) -> None:
+        line = self._fh.readline()
+        self._next = line.rstrip("\n") if line else None
+
+    def next_sentence(self) -> Optional[str]:
+        s = self._next
+        self._advance()
+        return s
+
+    def has_next(self) -> bool:
+        return self._next is not None
+
+    def reset(self) -> None:
+        if self._fh:
+            self._fh.close()
+        self._fh = open(self._path, "r", encoding="utf-8")
+        self._advance()
+
+
+class FileSentenceIterator(SentenceIterator):
+    """All lines of all files under a directory."""
+
+    def __init__(self, root: str):
+        self._root = root
+        self.reset()
+
+    def _paths(self) -> List[str]:
+        if os.path.isfile(self._root):
+            return [self._root]
+        out = []
+        for base, _, files in os.walk(self._root):
+            for f in sorted(files):
+                out.append(os.path.join(base, f))
+        return out
+
+    def reset(self) -> None:
+        self._lines: List[str] = []
+        for p in self._paths():
+            with open(p, "r", encoding="utf-8") as fh:
+                self._lines.extend(l.rstrip("\n") for l in fh)
+        self._pos = 0
+
+    def next_sentence(self) -> Optional[str]:
+        if self._pos >= len(self._lines):
+            return None
+        s = self._lines[self._pos]
+        self._pos += 1
+        return s
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._lines)
+
+
+class LabelledDocument:
+    """A document with labels (ParagraphVectors input unit)."""
+
+    def __init__(self, content: str, labels: Sequence[str]):
+        self.content = content
+        self.labels = list(labels)
+
+
+class LabelAwareIterator:
+    """Streams LabelledDocuments (LabelAwareSentenceIterator parity)."""
+
+    def __init__(self, docs: Iterable[Tuple[str, Sequence[str]]]):
+        self._docs = [LabelledDocument(c, l) for c, l in docs]
+
+    def __iter__(self) -> Iterator[LabelledDocument]:
+        return iter(self._docs)

@@ -1,0 +1,152 @@
+"""Word-vector serialization.
+
+Parity with `models/embeddings/loader/WordVectorSerializer.java:92`: the
+word2vec C text format (`writeWordVectors` / `loadTxtVectors`) and a full
+zip model format (vocab with frequencies + Huffman structure + syn0/syn1)
+mirroring `writeWord2VecModel`/`readWord2Vec`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import zipfile
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
+from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+def _escape(word: str) -> str:
+    """Words with whitespace/colons get DL4J's "B64:" wrapping
+    (WordVectorSerializer's ReadHelper convention) so the text format stays
+    space-delimited and lossless."""
+    if any(c in word for c in " \t:"):
+        return "B64:" + base64.b64encode(word.encode("utf-8")).decode("ascii")
+    return word
+
+
+def _unescape(word: str) -> str:
+    if word.startswith("B64:"):
+        return base64.b64decode(word[4:]).decode("utf-8")
+    return word
+
+
+class WordVectorSerializer:
+    # ------------------------------------------------------- text format
+
+    @staticmethod
+    def write_word_vectors(model: SequenceVectors, path: str) -> None:
+        """word2vec C text format: header 'vocab dim', then 'word v1 ...'."""
+        mat = model.lookup_table.all_vectors()
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"{mat.shape[0]} {mat.shape[1]}\n")
+            for i in range(mat.shape[0]):
+                word = _escape(model.vocab.word_at_index(i))
+                vec = " ".join(f"{v:.6f}" for v in mat[i])
+                fh.write(f"{word} {vec}\n")
+
+    @staticmethod
+    def load_txt_vectors(path: str) -> SequenceVectors:
+        with open(path, "r", encoding="utf-8") as fh:
+            first = fh.readline().split()
+            has_header = len(first) == 2 and all(t.isdigit() for t in first)
+            rows = []
+            words = []
+            if not has_header:
+                words.append(_unescape(first[0]))
+                rows.append([float(v) for v in first[1:]])
+            for line in fh:
+                parts = line.rstrip("\n").split(" ")
+                if len(parts) < 2:
+                    continue
+                words.append(_unescape(parts[0]))
+                rows.append([float(v) for v in parts[1:]])
+        mat = np.asarray(rows, np.float32)
+        cache = VocabCache()
+        for w in words:
+            cache.add_token(VocabWord(w))
+        # preserve file order, not frequency order
+        cache._by_index = [cache.word_for(w) for w in words]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        model = SequenceVectors(layer_size=mat.shape[1])
+        model.vocab = cache
+        model.lookup_table = InMemoryLookupTable(cache, mat.shape[1],
+                                                 init_syn0=False)
+        model.lookup_table.syn0 = jnp.asarray(mat)
+        return model
+
+    # -------------------------------------------------------- zip format
+
+    @staticmethod
+    def write_word2vec_model(model: SequenceVectors, path: str) -> None:
+        """Zip with config + vocab (freq/huffman) + syn0/syn1neg npy."""
+        meta = {
+            "layer_size": model.layer_size,
+            "window": model.window,
+            "negative": model.negative,
+            "use_hs": model.use_hs,
+            "learning_rate": model.learning_rate,
+            "min_word_frequency": model.min_word_frequency,
+        }
+        vocab = [{
+            "word": vw.word, "frequency": vw.frequency,
+            "code": vw.code, "points": vw.points, "is_label": vw.is_label,
+        } for vw in model.vocab.vocab_words()]
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("config.json", json.dumps(meta))
+            zf.writestr("vocab.json", json.dumps(vocab))
+            import io
+            for name, arr in [("syn0", model.lookup_table.syn0),
+                              ("syn1", model.lookup_table.syn1),
+                              ("syn1neg", model.lookup_table.syn1neg)]:
+                if arr is None:
+                    continue
+                buf = io.BytesIO()
+                np.save(buf, np.asarray(arr))
+                zf.writestr(f"{name}.npy", buf.getvalue())
+
+    @staticmethod
+    def read_word2vec_model(path: str) -> Word2Vec:
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("config.json"))
+            vocab_entries = json.loads(zf.read("vocab.json"))
+            arrays = {}
+            import io
+            for name in ("syn0", "syn1", "syn1neg"):
+                try:
+                    arrays[name] = np.load(io.BytesIO(zf.read(f"{name}.npy")))
+                except KeyError:
+                    arrays[name] = None
+        cache = VocabCache()
+        for e in vocab_entries:
+            vw = VocabWord(e["word"], e["frequency"], e.get("is_label", False))
+            vw.code = e["code"]
+            vw.points = e["points"]
+            cache.add_token(vw)
+        cache._by_index = [cache.word_for(e["word"]) for e in vocab_entries]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        model = Word2Vec(
+            layer_size=meta["layer_size"], window_size=meta["window"],
+            negative_sample=meta["negative"],
+            use_hierarchic_softmax=meta["use_hs"],
+            learning_rate=meta["learning_rate"],
+            min_word_frequency=meta["min_word_frequency"])
+        model.vocab = cache
+        model.lookup_table = InMemoryLookupTable(
+            cache, meta["layer_size"], use_hs=meta["use_hs"],
+            negative=meta["negative"], init_syn0=False)
+        model.lookup_table.syn0 = jnp.asarray(arrays["syn0"])
+        if arrays["syn1"] is not None:
+            model.lookup_table.syn1 = jnp.asarray(arrays["syn1"])
+        if arrays["syn1neg"] is not None:
+            model.lookup_table.syn1neg = jnp.asarray(arrays["syn1neg"])
+        return model

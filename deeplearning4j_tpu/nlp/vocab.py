@@ -1,0 +1,173 @@
+"""Vocabulary construction + Huffman coding.
+
+Parity with `models/word2vec/wordstore/VocabConstructor.java:31` (parallel
+corpus scan, frequency cutoffs) and `models/embeddings/loader/` Huffman tree
+construction: each vocab word gets a binary `code` and the list of inner-node
+`points` used by hierarchical softmax.
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Sequence
+
+
+class VocabWord:
+    """One vocabulary element (VocabWord.java): word, frequency, Huffman
+    code/points, unigram-table sampling weight."""
+
+    __slots__ = ("word", "frequency", "index", "code", "points", "is_label")
+
+    def __init__(self, word: str, frequency: float = 1.0,
+                 is_label: bool = False):
+        self.word = word
+        self.frequency = frequency
+        self.index = -1
+        self.code: List[int] = []
+        self.points: List[int] = []
+        self.is_label = is_label
+
+    def increment(self, by: float = 1.0) -> None:
+        self.frequency += by
+
+    def __repr__(self) -> str:
+        return f"VocabWord({self.word!r}, f={self.frequency})"
+
+
+class VocabCache:
+    """In-memory vocab store (AbstractCache.java parity): word↔index maps,
+    frequencies, total token count."""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._by_index: List[VocabWord] = []
+        self.total_word_occurrences = 0.0
+
+    def add_token(self, vw: VocabWord) -> None:
+        if vw.word in self._words:
+            self._words[vw.word].increment(vw.frequency)
+        else:
+            self._words[vw.word] = vw
+        self.total_word_occurrences += vw.frequency
+
+    def contains_word(self, word: str) -> bool:
+        return word in self._words
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+
+    def word_frequency(self, word: str) -> float:
+        vw = self._words.get(word)
+        return vw.frequency if vw else 0.0
+
+    def index_of(self, word: str) -> int:
+        vw = self._words.get(word)
+        return vw.index if vw else -1
+
+    def word_at_index(self, index: int) -> Optional[str]:
+        if 0 <= index < len(self._by_index):
+            return self._by_index[index].word
+        return None
+
+    def element_at_index(self, index: int) -> VocabWord:
+        return self._by_index[index]
+
+    def num_words(self) -> int:
+        return len(self._by_index)
+
+    def words(self) -> List[str]:
+        return [vw.word for vw in self._by_index]
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._by_index)
+
+    def truncate(self, min_frequency: float) -> None:
+        """Drop words below the cutoff, keeping labels."""
+        kept = {w: vw for w, vw in self._words.items()
+                if vw.frequency >= min_frequency or vw.is_label}
+        self._words = kept
+        self._by_index = []
+
+    def update_indices(self) -> None:
+        """Assign indices by descending frequency (word2vec convention)."""
+        self._by_index = sorted(self._words.values(),
+                                key=lambda vw: (-vw.frequency, vw.word))
+        for i, vw in enumerate(self._by_index):
+            vw.index = i
+
+    def __len__(self) -> int:
+        return len(self._by_index)
+
+
+def build_huffman(cache: VocabCache) -> None:
+    """Assign Huffman ``code`` / ``points`` to every word in the cache.
+
+    Mirrors word2vec's tree build (reference `Huffman.java`): leaves are
+    vocab words weighted by frequency; each word's code is its path of
+    left/right choices, points are the inner-node ids along the path
+    (usable as rows of syn1).
+    """
+    n = cache.num_words()
+    if n == 0:
+        return
+    # heap of (freq, tiebreak, node_id); leaves are 0..n-1, inner n..2n-2
+    heap = [(cache.element_at_index(i).frequency, i, i) for i in range(n)]
+    heapq.heapify(heap)
+    parent = {}
+    binary = {}
+    next_id = n
+    while len(heap) > 1:
+        f1, _, n1 = heapq.heappop(heap)
+        f2, _, n2 = heapq.heappop(heap)
+        parent[n1] = next_id
+        parent[n2] = next_id
+        binary[n1] = 0
+        binary[n2] = 1
+        heapq.heappush(heap, (f1 + f2, next_id, next_id))
+        next_id += 1
+    root = heap[0][2]
+    for i in range(n):
+        code: List[int] = []
+        points: List[int] = []
+        node = i
+        while node != root:
+            code.append(binary[node])
+            node = parent[node]
+            # inner node id relative to n (syn1 row); root included
+            points.append(node - n)
+        vw = cache.element_at_index(i)
+        vw.code = list(reversed(code))
+        vw.points = list(reversed(points))
+
+
+class VocabConstructor:
+    """Builds a VocabCache from token sequences (VocabConstructor.java:31)."""
+
+    def __init__(self, min_word_frequency: int = 1,
+                 special_tokens: Sequence[str] = ()):
+        self.min_word_frequency = min_word_frequency
+        self.special_tokens = list(special_tokens)
+
+    def build_vocab(self, sequences: Iterable[Sequence[str]],
+                    labels: Iterable[Sequence[str]] = ()) -> VocabCache:
+        counts: Counter = Counter()
+        total = 0
+        for seq in sequences:
+            counts.update(seq)
+            total += len(seq)
+        cache = VocabCache()
+        for tok in self.special_tokens:
+            cache.add_token(VocabWord(tok, frequency=max(counts.get(tok, 1), 1)))
+            counts.pop(tok, None)
+        for word, c in counts.items():
+            cache.add_token(VocabWord(word, frequency=c))
+        for label_set in labels:
+            for lab in label_set:
+                if not cache.contains_word(lab):
+                    cache.add_token(VocabWord(lab, frequency=1, is_label=True))
+        cache.truncate(self.min_word_frequency)
+        cache.update_indices()
+        cache.total_word_occurrences = float(total)
+        build_huffman(cache)
+        return cache

@@ -4,8 +4,9 @@ The reference has no attention and no sequence parallelism — its longest
 sequence mechanism is truncated BPTT (`MultiLayerNetwork.java:1309`,
 SURVEY.md §5 "Long-context"). This module is the TPU-native long-context
 design the survey calls for: sequences are sharded over a Mesh axis
-(``mesh.SEQUENCE_AXIS``) and attention runs without ever materialising the
-full [T, T] score matrix on one chip.
+(``mesh.SEQUENCE_AXIS``); the ring strategy never materialises the full
+[T, T] score matrix on one chip (Ulysses does — it trades that memory for
+fewer collective steps).
 
 Two strategies, both jit/shard_map-compatible:
 
@@ -56,8 +57,7 @@ def _ring_attention_sharded(q, k, v, mask_kv, *, axis_name, causal, scale):
     row_max = jnp.full(q.shape[:3], _NEG_INF, jnp.float32)
     row_sum = jnp.zeros(q.shape[:3], jnp.float32)
 
-    if mask_kv is None:
-        mask_kv = jnp.ones((q.shape[0], tk), jnp.float32)
+    has_mask = mask_kv is not None  # static: skips mask ops and its ppermute
 
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
@@ -69,19 +69,23 @@ def _ring_attention_sharded(q, k, v, mask_kv, *, axis_name, causal, scale):
             out, row_max, row_sum = acc
             scores = jnp.einsum("nhqd,nhkd->nhqk", q32,
                                 k_blk.astype(jnp.float32))
-            valid = m_blk[:, None, None, :] > 0                # [N,1,1,Tk]
+            valid = None
+            if has_mask:
+                valid = m_blk[:, None, None, :] > 0            # [N,1,1,Tk]
             if causal:
                 q_pos = my_idx * tq + jnp.arange(tq)
                 k_pos = src * tk + jnp.arange(tk)
-                valid = jnp.logical_and(
-                    valid,
-                    q_pos[None, None, :, None] >= k_pos[None, None, None, :])
-            scores = jnp.where(valid, scores, _NEG_INF)
+                cm = q_pos[None, None, :, None] >= k_pos[None, None, None, :]
+                valid = cm if valid is None else jnp.logical_and(valid, cm)
+            if valid is not None:
+                scores = jnp.where(valid, scores, _NEG_INF)
             blk_max = jnp.max(scores, axis=-1)
             new_max = jnp.maximum(row_max, blk_max)
             correction = jnp.exp(row_max - new_max)
-            # zero invalid entries so fully-masked rows keep row_sum == 0
-            p = jnp.where(valid, jnp.exp(scores - new_max[..., None]), 0.0)
+            p = jnp.exp(scores - new_max[..., None])
+            if valid is not None:
+                # zero invalid entries so fully-masked rows keep row_sum == 0
+                p = jnp.where(valid, p, 0.0)
             new_sum = row_sum * correction + jnp.sum(p, axis=-1)
             new_out = out * correction[..., None] + jnp.einsum(
                 "...qk,...kd->...qd", p, v_blk.astype(jnp.float32))
@@ -99,12 +103,14 @@ def _ring_attention_sharded(q, k, v, mask_kv, *, axis_name, causal, scale):
                                            out, row_max, row_sum)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
-        m_blk = jax.lax.ppermute(m_blk, axis_name, perm)
+        if has_mask:
+            m_blk = jax.lax.ppermute(m_blk, axis_name, perm)
         return out, row_max, row_sum, k_blk, v_blk, m_blk
 
     # n_shards-1 rotate-and-accumulate hops, then the last block in place
     # (no trailing ppermute whose result would be discarded).
-    carry = (out, row_max, row_sum, k, v, mask_kv)
+    carry = (out, row_max, row_sum, k, v,
+             mask_kv if has_mask else jnp.zeros((), jnp.float32))
     out, row_max, row_sum, k_blk, v_blk, m_blk = jax.lax.fori_loop(
         0, n_shards - 1, body, carry)
     out, row_max, row_sum = accumulate(n_shards - 1, k_blk, v_blk, m_blk,
@@ -138,6 +144,11 @@ def ring_self_attention(q, k, v, mesh: Mesh, *,
     :func:`ring_attention`. For production nets compose the per-shard
     function into your own pjit'd step instead.
     """
+    n_shards = mesh.shape[axis_name]
+    if q.shape[2] % n_shards:
+        raise ValueError(
+            f"ring attention needs seq len divisible by shards "
+            f"({q.shape[2]} % {n_shards})")
     spec_qkv = P(None, None, axis_name, None)
     spec_mask = P(None, axis_name)
     in_specs = (spec_qkv, spec_qkv, spec_qkv,
@@ -192,6 +203,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, *,
     if q.shape[1] % n_shards:
         raise ValueError(
             f"ulysses needs n_heads divisible by shards ({q.shape[1]} % {n_shards})")
+    if q.shape[2] % n_shards:
+        raise ValueError(
+            f"ulysses needs seq len divisible by shards ({q.shape[2]} % {n_shards})")
     spec_qkv = P(None, None, axis_name, None)
     spec_mask = P(None, axis_name)
     in_specs = (spec_qkv, spec_qkv, spec_qkv,

@@ -33,12 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map
-
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
 from deeplearning4j_tpu.parallel.sharding import batch_sharding, shard_model
 
 
@@ -176,8 +171,7 @@ class ParallelWrapper:
             in_specs=(rep, rep, rep, rep, rep, spec(x_sds), spec(y_sds),
                       spec(fm_nd) if has_fm else rep,
                       spec(lm_nd) if has_lm else rep, rep),
-            out_specs=(rep, rep, rep, rep),
-            check_vma=False)
+            out_specs=(rep, rep, rep, rep))
         return jax.jit(mapped, donate_argnums=(0, 1, 2))
 
     def _fit_averaging(self, iterator) -> None:

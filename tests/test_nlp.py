@@ -1,0 +1,252 @@
+"""NLP package tests: tokenization, vocab/Huffman, Word2Vec/SequenceVectors
+learning behavior, ParagraphVectors, GloVe, serialization, vectorizers.
+
+Corpus-learning tests follow the reference pattern (Word2VecTests.java):
+train on a small corpus where some words share contexts and assert the
+geometry (similar words closer than dissimilar ones).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    TfidfVectorizer,
+    VocabConstructor,
+    Word2Vec,
+    WordVectorSerializer,
+)
+from deeplearning4j_tpu.nlp.learning import CBOW, SkipGram
+from deeplearning4j_tpu.nlp.vocab import build_huffman
+
+
+# ------------------------------------------------------------- tokenization
+
+def test_default_tokenizer_with_preprocessor():
+    tf = DefaultTokenizerFactory(CommonPreprocessor())
+    toks = tf.create("Hello, World! 123 foo-bar").get_tokens()
+    assert toks == ["hello", "world", "foo-bar"]
+
+
+def test_ngram_tokenizer():
+    tf = NGramTokenizerFactory(1, 2)
+    toks = tf.create("a b c").get_tokens()
+    assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+# ------------------------------------------------------------------- vocab
+
+def _toy_corpus():
+    # cats/dogs share contexts; "quantum" does not
+    sents = []
+    for animal in ("cat", "dog"):
+        for verb in ("runs", "sleeps", "eats", "plays"):
+            sents.extend([f"the {animal} {verb} today",
+                          f"a {animal} {verb} often",
+                          f"my {animal} {verb} here"])
+    sents.extend(["quantum physics is hard", "quantum theory is strange"] * 4)
+    return sents * 3
+
+
+def test_vocab_constructor_and_huffman():
+    corpus = [s.split() for s in _toy_corpus()]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(corpus)
+    assert cache.contains_word("cat")
+    assert cache.index_of("the") >= 0
+    # indices sorted by descending frequency
+    freqs = [cache.element_at_index(i).frequency
+             for i in range(cache.num_words())]
+    assert freqs == sorted(freqs, reverse=True)
+    # Huffman property: codes are prefix-free and frequent words get
+    # shorter-or-equal codes
+    codes = {vw.word: "".join(map(str, vw.code))
+             for vw in cache.vocab_words()}
+    vals = list(codes.values())
+    for i, c1 in enumerate(vals):
+        for c2 in vals[i + 1:]:
+            assert not c1.startswith(c2) and not c2.startswith(c1)
+    most = cache.element_at_index(0)
+    least = cache.element_at_index(cache.num_words() - 1)
+    assert len(most.code) <= len(least.code)
+
+
+def test_vocab_min_frequency_cutoff():
+    corpus = [["a", "a", "a", "rare"], ["a", "b", "b"]]
+    cache = VocabConstructor(min_word_frequency=2).build_vocab(corpus)
+    assert cache.contains_word("a") and cache.contains_word("b")
+    assert not cache.contains_word("rare")
+
+
+# ---------------------------------------------------------------- word2vec
+
+@pytest.mark.parametrize("algo", ["skipgram", "cbow"])
+def test_word2vec_learns_similarity(algo):
+    w2v = (Word2Vec.builder()
+           .layer_size(32).window_size(3).negative_sample(5)
+           .min_word_frequency(2).learning_rate(0.05).epochs(8)
+           .seed(42).elements_learning_algorithm(algo).build())
+    w2v.fit(_toy_corpus())
+    assert w2v.has_word("cat") and w2v.has_word("dog")
+    sim_animals = w2v.similarity("cat", "dog")
+    sim_cross = w2v.similarity("cat", "quantum")
+    assert sim_animals > sim_cross, (sim_animals, sim_cross)
+    vec = w2v.get_word_vector("cat")
+    assert vec.shape == (32,)
+    assert np.isfinite(vec).all()
+
+
+@pytest.mark.parametrize("algo", ["skipgram", "cbow"])
+def test_word2vec_hierarchic_softmax(algo):
+    w2v = Word2Vec(layer_size=24, window_size=3, negative_sample=0,
+                   use_hierarchic_softmax=True, min_word_frequency=2,
+                   learning_rate=0.05, epochs=8, seed=7, algorithm=algo)
+    w2v.fit(_toy_corpus())
+    # HS-only training must actually move the embeddings off their init
+    init = (np.random.default_rng(12345)
+            .random((w2v.vocab.num_words(), 24)) - 0.5) / 24
+    moved = np.abs(w2v.lookup_table.all_vectors() - init).max()
+    assert moved > 1e-3
+    assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "quantum")
+
+
+def test_word2vec_words_nearest():
+    w2v = Word2Vec(layer_size=32, window_size=3, min_word_frequency=2,
+                   epochs=8, seed=3, learning_rate=0.05)
+    w2v.fit(_toy_corpus())
+    nearest = w2v.words_nearest("cat", top_n=5)
+    assert "cat" not in nearest
+    assert "dog" in nearest
+
+
+def test_word2vec_sentence_iterator_path():
+    it = CollectionSentenceIterator(_toy_corpus())
+    w2v = Word2Vec(layer_size=16, window_size=2, min_word_frequency=2,
+                   epochs=2, sentence_iterator=it)
+    w2v.fit()
+    assert w2v.has_word("cat")
+
+
+def test_word2vec_determinism():
+    a = Word2Vec(layer_size=16, window_size=2, min_word_frequency=2,
+                 epochs=2, seed=11).fit(_toy_corpus())
+    b = Word2Vec(layer_size=16, window_size=2, min_word_frequency=2,
+                 epochs=2, seed=11).fit(_toy_corpus())
+    np.testing.assert_allclose(a.get_word_vector("cat"),
+                               b.get_word_vector("cat"), rtol=1e-6)
+
+
+# ------------------------------------------------------------- serialization
+
+def test_txt_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=16, window_size=2, min_word_frequency=2,
+                   epochs=2, seed=5).fit(_toy_corpus())
+    path = str(tmp_path / "vectors.txt")
+    WordVectorSerializer.write_word_vectors(w2v, path)
+    loaded = WordVectorSerializer.load_txt_vectors(path)
+    np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                               w2v.get_word_vector("cat"), atol=1e-5)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+
+
+def test_txt_roundtrip_multiword_tokens(tmp_path):
+    """N-gram tokens containing spaces must survive the text format (B64
+    wrapping, WordVectorSerializer ReadHelper convention)."""
+    from deeplearning4j_tpu.nlp.sequencevectors import SequenceVectors
+
+    sv = SequenceVectors(layer_size=8, min_word_frequency=1, epochs=1)
+    sv.fit([["new york", "city"], ["new york", "state"]])
+    path = str(tmp_path / "ngrams.txt")
+    WordVectorSerializer.write_word_vectors(sv, path)
+    loaded = WordVectorSerializer.load_txt_vectors(path)
+    vec = loaded.get_word_vector("new york")
+    assert vec is not None
+    np.testing.assert_allclose(vec, sv.get_word_vector("new york"), atol=1e-5)
+
+
+def test_zip_model_roundtrip(tmp_path):
+    w2v = Word2Vec(layer_size=16, window_size=2, min_word_frequency=2,
+                   use_hierarchic_softmax=True, epochs=2, seed=5)
+    w2v.fit(_toy_corpus())
+    path = str(tmp_path / "model.zip")
+    WordVectorSerializer.write_word2vec_model(w2v, path)
+    loaded = WordVectorSerializer.read_word2vec_model(path)
+    np.testing.assert_allclose(loaded.get_word_vector("dog"),
+                               w2v.get_word_vector("dog"), rtol=1e-6)
+    vw_orig = w2v.vocab.word_for("dog")
+    vw_new = loaded.vocab.word_for("dog")
+    assert vw_orig.code == vw_new.code and vw_orig.points == vw_new.points
+    assert vw_orig.frequency == vw_new.frequency
+
+
+# ------------------------------------------------------- paragraph vectors
+
+def _labelled_docs():
+    docs = []
+    for i in range(6):
+        docs.append((f"the cat sleeps on the mat number {i}", [f"pet_{i % 2}"]))
+        docs.append((f"quantum theory lecture notes part {i}", [f"sci_{i % 2}"]))
+    return docs
+
+
+def test_paragraph_vectors_dm_and_labels():
+    pv = ParagraphVectors(layer_size=24, window_size=3, epochs=10,
+                          min_word_frequency=1, seed=9,
+                          sequence_algorithm="dm")
+    pv.fit(_labelled_docs())
+    assert set(pv.labels) == {"pet_0", "pet_1", "sci_0", "sci_1"}
+    v = pv.get_label_vector("pet_0")
+    assert v is not None and np.isfinite(v).all()
+
+
+def test_paragraph_vectors_dbow_infer():
+    pv = ParagraphVectors(layer_size=24, window_size=3, epochs=10,
+                          min_word_frequency=1, seed=9,
+                          sequence_algorithm="dbow")
+    pv.fit(_labelled_docs())
+    n_before = pv.vocab.num_words()
+    vec = pv.infer_vector("the cat sleeps quietly")
+    assert vec.shape == (24,)
+    assert np.isfinite(vec).all()
+    # inference must not mutate the model
+    assert pv.vocab.num_words() == n_before
+    assert pv.lookup_table.syn0.shape[0] == n_before
+
+
+# ------------------------------------------------------------------- glove
+
+def test_glove_trains_and_geometry():
+    g = Glove(layer_size=24, window=4, epochs=25, learning_rate=0.05,
+              min_word_frequency=2, seed=13, batch_size=1024)
+    g.fit(_toy_corpus())
+    assert g.similarity("cat", "dog") > g.similarity("cat", "quantum")
+
+
+# -------------------------------------------------------------- vectorizers
+
+def test_bag_of_words():
+    docs = ["a b a", "b c"]
+    v = BagOfWordsVectorizer(min_word_frequency=1)
+    mat = v.fit_transform(docs)
+    assert mat.shape == (2, 3)
+    ia, ib, ic = (v.vocab.index_of(w) for w in "abc")
+    assert mat[0, ia] == 2 and mat[0, ib] == 1 and mat[0, ic] == 0
+    assert mat[1, ib] == 1 and mat[1, ic] == 1
+
+
+def test_tfidf():
+    docs = ["a b", "a c", "a d"]
+    v = TfidfVectorizer(min_word_frequency=1)
+    mat = v.fit_transform(docs)
+    ia = v.vocab.index_of("a")
+    ib = v.vocab.index_of("b")
+    # 'a' appears in every doc -> idf 0; 'b' only in doc0
+    assert np.allclose(mat[:, ia], 0.0)
+    assert mat[0, ib] > 0 and np.allclose(mat[1:, ib], 0.0)
+    assert v.tfidf_word("b", ["a", "b"]) == pytest.approx(
+        0.5 * np.log(3.0), rel=1e-6)
