@@ -1,0 +1,111 @@
+"""KMeans clustering, Lloyd iterations jitted on device.
+
+Parity: ``clustering/kmeans/KMeansClustering.java`` +
+``clustering/algorithm/BaseClusteringAlgorithm.java`` (iteration loop with
+ClusteringStrategy / termination conditions). The reference distributes
+point-to-center assignment over JVM threads (``util/MultiThreadUtils.java``);
+here one Lloyd step is a single jitted assignment matmul + segment-sum, so
+the whole sweep runs on the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .bruteforce import pairwise_distance
+from .cluster import ClusterSet, Point
+
+
+@partial(jax.jit, static_argnames=("k", "distance"))
+def _lloyd_step(points: jax.Array, centers: jax.Array, k: int,
+                distance: str):
+    """One assignment + recenter step. Returns (new_centers, assignment,
+    total within-cluster distance)."""
+    d = pairwise_distance(points, centers, distance)        # (N, k)
+    assign = jnp.argmin(d, axis=1)                          # (N,)
+    cost = jnp.sum(jnp.min(d, axis=1))
+    one_hot = jax.nn.one_hot(assign, k, dtype=points.dtype)  # (N, k)
+    counts = one_hot.sum(axis=0)                            # (k,)
+    sums = one_hot.T @ points                               # (k, D) — MXU
+    new_centers = sums / jnp.maximum(counts, 1.0)[:, None]
+    # keep empty clusters where they were (reference re-seeds via strategy)
+    new_centers = jnp.where((counts > 0)[:, None], new_centers, centers)
+    return new_centers, assign, cost
+
+
+class KMeansClustering:
+    """``KMeansClustering.setup(k, maxIter, distance)`` parity surface.
+
+    ``setup(k, max_iter, distance)`` → instance; ``apply_to(points)`` →
+    :class:`ClusterSet`. Convergence: relative cost improvement below
+    ``min_distribution_variation`` (reference ``VarianceVariationCondition``)
+    or ``max_iter`` sweeps (``FixedIterationCountCondition``).
+    """
+
+    def __init__(self, k: int, max_iter: int = 100,
+                 distance: str = "euclidean",
+                 min_distribution_variation: float = 1e-4,
+                 seed: int = 0):
+        self.k = int(k)
+        self.max_iter = int(max_iter)
+        self.distance = distance
+        self.min_distribution_variation = float(min_distribution_variation)
+        self.seed = seed
+        self.iteration_costs: List[float] = []
+
+    @classmethod
+    def setup(cls, cluster_count: int, max_iteration_count: int = 100,
+              distance: str = "euclidean", **kw) -> "KMeansClustering":
+        return cls(cluster_count, max_iteration_count, distance, **kw)
+
+    # -- core ---------------------------------------------------------------
+    def fit(self, matrix) -> np.ndarray:
+        """Run Lloyd's algorithm; returns the (k, D) centers."""
+        pts = jnp.asarray(matrix, jnp.float32)
+        n = pts.shape[0]
+        if n < self.k:
+            raise ValueError(f"need >= k={self.k} points, got {n}")
+        # k-means++ style seeding: random first center, then farthest-point
+        rng = np.random.default_rng(self.seed)
+        first = int(rng.integers(0, n))
+        centers = [np.asarray(pts[first])]
+        d_min = None
+        for _ in range(1, self.k):
+            d = np.asarray(pairwise_distance(
+                pts, jnp.asarray(centers[-1])[None, :], self.distance))[:, 0]
+            d_min = d if d_min is None else np.minimum(d_min, d)
+            probs = d_min / max(d_min.sum(), 1e-12)
+            centers.append(np.asarray(pts[int(rng.choice(n, p=probs))]))
+        c = jnp.asarray(np.stack(centers))
+
+        self.iteration_costs = []
+        prev_cost = None
+        for _ in range(self.max_iter):
+            c, assign, cost = _lloyd_step(pts, c, self.k, self.distance)
+            cost = float(cost)
+            self.iteration_costs.append(cost)
+            if prev_cost is not None:
+                denom = max(abs(prev_cost), 1e-12)
+                if abs(prev_cost - cost) / denom < self.min_distribution_variation:
+                    break
+            prev_cost = cost
+        self._assign = np.asarray(assign)
+        return np.asarray(c)
+
+    def apply_to(self, points: List[Point]) -> ClusterSet:
+        """Reference entry point: points → ClusterSet with members placed."""
+        matrix = np.stack([p.array for p in points])
+        centers = self.fit(matrix)
+        cs = ClusterSet(self.distance)
+        clusters = [cs.add_new_cluster_with_center(c) for c in centers]
+        for p, a in zip(points, self._assign):
+            clusters[int(a)].add_point(p)
+        cs.remove_empty_clusters()
+        return cs
+
+    applyTo = apply_to  # reference-style alias

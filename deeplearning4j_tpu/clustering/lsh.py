@@ -1,0 +1,96 @@
+"""Locality-sensitive hashing (parity: ``clustering/lsh/LSH.java`` +
+``RandomProjectionLSH.java``).
+
+Sign-of-random-projection hashing; the hash of the whole corpus is one
+``(N, D) @ (D, hash_length)`` matmul on device, then bucket lookup +
+exact re-ranking on the candidates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .bruteforce import knn, pairwise_distance
+
+
+class RandomProjectionLSH:
+    """``RandomProjectionLSH(hashLength, numTables, intDimensions, radius)``.
+
+    Multi-table sign-LSH: each table hashes with its own random projection;
+    search unions the query's buckets across tables and re-ranks exactly.
+    """
+
+    def __init__(self, hash_length: int = 16, num_tables: int = 4,
+                 in_dimensions: int = None, radius: float = 1.0, seed: int = 0):
+        self.hash_length = int(hash_length)
+        self.num_tables = int(num_tables)
+        self.in_dimensions = in_dimensions
+        self.radius = float(radius)
+        self.seed = seed
+        self.data: Optional[np.ndarray] = None
+        self._proj: Optional[np.ndarray] = None      # (T, D, H)
+        self._tables: List[Dict[int, List[int]]] = []
+
+    def _hash_bits(self, x: np.ndarray) -> np.ndarray:
+        """(N, D) -> (T, N) packed integer hashes (one matmul per table)."""
+        codes = []
+        for t in range(self.num_tables):
+            bits = np.asarray(jnp.asarray(x) @ jnp.asarray(self._proj[t])) > 0
+            weights = (1 << np.arange(self.hash_length)).astype(np.int64)
+            codes.append(bits.astype(np.int64) @ weights)
+        return np.stack(codes)
+
+    def make_index(self, data) -> None:
+        """Hash + bucket the corpus (``LSH.makeIndex``)."""
+        self.data = np.asarray(data, np.float32)
+        n, d = self.data.shape
+        self.in_dimensions = d
+        rng = np.random.default_rng(self.seed)
+        self._proj = rng.standard_normal(
+            (self.num_tables, d, self.hash_length)).astype(np.float32)
+        codes = self._hash_bits(self.data)           # (T, N)
+        self._tables = []
+        for t in range(self.num_tables):
+            buckets: Dict[int, List[int]] = {}
+            for i, c in enumerate(codes[t]):
+                buckets.setdefault(int(c), []).append(i)
+            self._tables.append(buckets)
+
+    def bucket(self, query) -> np.ndarray:
+        """Candidate indices sharing a bucket with the query in any table
+        (``LSH.bucket``)."""
+        q = np.asarray(query, np.float32).reshape(1, -1)
+        codes = self._hash_bits(q)[:, 0]
+        cand: List[int] = []
+        for t in range(self.num_tables):
+            cand.extend(self._tables[t].get(int(codes[t]), []))
+        return np.unique(np.array(cand, np.int64))
+
+    def search(self, query, max_range: Optional[float] = None
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact-ranked candidates within ``max_range`` (``LSH.search``).
+        Returns (distances, indices) sorted ascending."""
+        max_range = self.radius if max_range is None else float(max_range)
+        cand = self.bucket(query)
+        if cand.size == 0:
+            return np.empty(0, np.float32), np.empty(0, np.int64)
+        q = jnp.asarray(np.asarray(query, np.float32).reshape(1, -1))
+        d = np.asarray(pairwise_distance(q, jnp.asarray(self.data[cand])))[0]
+        keep = d <= max_range
+        order = np.argsort(d[keep])
+        return d[keep][order].astype(np.float32), cand[keep][order]
+
+    def get_all_nearest_neighbors(self, query, k: int
+                                  ) -> Tuple[np.ndarray, np.ndarray]:
+        """k-NN among bucket candidates, exact-fallback when the buckets
+        under-fill (mirrors VPTreeFillSearch's guarantee)."""
+        cand = self.bucket(query)
+        q = jnp.asarray(np.asarray(query, np.float32).reshape(1, -1))
+        if cand.size < k:
+            d, i = knn(q, jnp.asarray(self.data), min(k, len(self.data)))
+            return np.asarray(d)[0], np.asarray(i)[0]
+        d, i = knn(q, jnp.asarray(self.data[cand]), min(k, cand.size))
+        return np.asarray(d)[0], cand[np.asarray(i)[0]]

@@ -1,0 +1,6 @@
+"""Dimensionality-reduction / visualization (parity:
+``deeplearning4j-core/.../plot/`` — ``BarnesHutTsne.java:65``)."""
+
+from .tsne import BarnesHutTsne, Tsne
+
+__all__ = ["BarnesHutTsne", "Tsne"]

@@ -1,0 +1,250 @@
+"""Nearest-neighbors / clustering / t-SNE tests.
+
+Mirrors the reference test approach (nearestneighbor-core src/test): exact
+small-case assertions plus cross-implementation equivalence (tree search must
+match brute force — the cuDNN-vs-builtin validation pattern)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BruteForceNearestNeighbors, HyperRect, KDTree, KMeansClustering, Point,
+    QuadTree, RandomProjectionLSH, SpTree, VPTree, VPTreeFillSearch, knn,
+    pairwise_distance,
+)
+
+
+def _blobs(n_per=30, centers=((0, 0), (10, 10), (-10, 10)), d=2, seed=0):
+    rng = np.random.default_rng(seed)
+    pts = []
+    for c in centers:
+        base = np.zeros(d)
+        base[: len(c)] = c
+        pts.append(base + rng.standard_normal((n_per, d)))
+    return np.concatenate(pts).astype(np.float32)
+
+
+class TestBruteForce:
+    def test_euclidean_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        q = rng.standard_normal((5, 8)).astype(np.float32)
+        c = rng.standard_normal((20, 8)).astype(np.float32)
+        d = np.asarray(pairwise_distance(q, c))
+        expected = np.sqrt(((q[:, None, :] - c[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(d, expected, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("metric", ["euclidean", "cosine", "manhattan",
+                                        "chebyshev", "dot"])
+    def test_knn_orders_by_metric(self, metric):
+        rng = np.random.default_rng(2)
+        corpus = rng.standard_normal((50, 4)).astype(np.float32)
+        q = corpus[7:8] + 0.01
+        d, i = knn(q, corpus, 3, metric)
+        assert int(np.asarray(i)[0, 0]) == 7
+        d = np.asarray(d)[0]
+        assert np.all(np.diff(d) >= -1e-6)
+
+    def test_search_excluding_self(self):
+        pts = _blobs()
+        index = BruteForceNearestNeighbors(pts)
+        d, i = index.search_excluding_self(5)
+        assert i.shape == (len(pts), 5)
+        for r in range(len(pts)):
+            assert r not in i[r]
+
+
+class TestVPTree:
+    def test_matches_brute_force(self):
+        pts = _blobs(n_per=25, d=6)
+        tree = VPTree(pts)
+        bf = BruteForceNearestNeighbors(pts)
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            q = rng.standard_normal(6).astype(np.float32)
+            td, ti = tree.search(q, 7)
+            bd, bi = bf.search(q, 7)
+            np.testing.assert_allclose(np.sort(td), np.sort(bd[0]),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_exact_self_query(self):
+        pts = _blobs(n_per=10)
+        tree = VPTree(pts)
+        d, i = tree.search(pts[4], 1)
+        assert i[0] == 4 and d[0] < 1e-5
+
+    def test_cosine_metric(self):
+        pts = _blobs(n_per=10, d=4)
+        tree = VPTree(pts, distance="cosine")
+        d, i = tree.search(pts[0] * 3.0, 1)  # scaled → same direction
+        assert d[0] < 1e-5
+
+    def test_fill_search_returns_k(self):
+        pts = _blobs(n_per=5)
+        tree = VPTree(pts)
+        fs = VPTreeFillSearch(tree, 9, pts[0])
+        fs.run()
+        assert len(fs.results) == 9
+        assert len(np.unique(fs.results)) == 9
+
+
+class TestKDTree:
+    def test_insert_nn(self):
+        tree = KDTree(2)
+        for p in [(0, 0), (1, 1), (5, 5), (2, 2)]:
+            tree.insert(p)
+        d, p = tree.nn((1.1, 1.1))
+        np.testing.assert_allclose(p, [1, 1])
+        assert tree.size == 4
+
+    def test_knn_matches_brute(self):
+        pts = _blobs(n_per=20, d=3)
+        tree = KDTree(3)
+        for p in pts:
+            tree.insert(p)
+        bf = BruteForceNearestNeighbors(pts)
+        q = np.array([0.5, 0.5, 0.5], np.float32)
+        dists, _ = tree.knn(q, 5)
+        bd, _ = bf.search(q, 5)
+        np.testing.assert_allclose(dists, bd[0], rtol=1e-4, atol=1e-4)
+
+    def test_delete(self):
+        tree = KDTree(2)
+        pts = [(0, 0), (1, 1), (5, 5)]
+        for p in pts:
+            tree.insert(p)
+        assert tree.delete((1, 1))
+        assert tree.size == 2
+        d, p = tree.nn((1, 1))
+        assert not np.array_equal(p, [1, 1])
+        assert not tree.delete((9, 9))
+
+    def test_range(self):
+        tree = KDTree(2)
+        for p in [(0, 0), (1, 1), (5, 5), (2, 2)]:
+            tree.insert(p)
+        inside = tree.range((0.5, 0.5), (3, 3))
+        got = {tuple(p) for p in inside}
+        assert got == {(1.0, 1.0), (2.0, 2.0)}
+
+    def test_hyperrect(self):
+        r = HyperRect((0, 0), (2, 2))
+        assert r.contains(np.array([1, 1]))
+        assert not r.contains(np.array([3, 1]))
+        assert r.min_distance(np.array([3, 1])) == pytest.approx(1.0)
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts = _blobs(n_per=40)
+        km = KMeansClustering.setup(3, 100)
+        centers = km.fit(pts)
+        assert centers.shape == (3, 2)
+        expected = {(0, 0), (10, 10), (-10, 10)}
+        for e in expected:
+            d = np.linalg.norm(centers - np.array(e), axis=1)
+            assert d.min() < 1.5
+
+    def test_apply_to_cluster_set(self):
+        pts = _blobs(n_per=15)
+        points = Point.to_points(pts)
+        cs = KMeansClustering.setup(3, 50).apply_to(points)
+        assert cs.cluster_count == 3
+        assert sum(len(c.points) for c in cs.clusters) == len(points)
+        pc = cs.classify_point(points[0])
+        assert pc.cluster is not None and not pc.new_location
+
+    def test_cost_decreases(self):
+        pts = _blobs(n_per=30, seed=5)
+        km = KMeansClustering.setup(3, 50, seed=1)
+        km.fit(pts)
+        costs = km.iteration_costs
+        assert costs[-1] <= costs[0] + 1e-6
+
+
+class TestLSH:
+    def test_bucket_and_search(self):
+        pts = _blobs(n_per=50, d=8, centers=((0,) * 8, (20,) * 8))
+        lsh = RandomProjectionLSH(hash_length=8, num_tables=6, radius=10.0)
+        lsh.make_index(pts)
+        cand = lsh.bucket(pts[3])
+        assert 3 in cand
+        d, i = lsh.search(pts[3], 10.0)
+        assert 3 in i
+        assert np.all(d <= 10.0)
+
+    def test_knn_recall(self):
+        pts = _blobs(n_per=60, d=8, centers=((0,) * 8, (20,) * 8))
+        lsh = RandomProjectionLSH(hash_length=6, num_tables=8)
+        lsh.make_index(pts)
+        bf = BruteForceNearestNeighbors(pts)
+        bd, bi = bf.search(pts[10], 5)
+        d, i = lsh.get_all_nearest_neighbors(pts[10], 5)
+        # candidates come from matching buckets: recall over true 5-NN >= 3/5
+        assert len(set(i[:5]) & set(bi[0])) >= 3
+
+
+class TestSpTree:
+    def test_center_of_mass_and_count(self):
+        pts = np.array([[0, 0], [1, 0], [0, 1], [1, 1]], np.float64)
+        tree = SpTree(pts)
+        assert tree.cum_size == 4
+        np.testing.assert_allclose(tree.center_of_mass, [0.5, 0.5])
+
+    def test_duplicates_do_not_blow_up(self):
+        pts = np.zeros((10, 2))
+        tree = SpTree(pts)
+        assert tree.cum_size == 10
+        assert tree.depth() < 5
+
+    def test_non_edge_forces_match_exact(self):
+        rng = np.random.default_rng(7)
+        pts = rng.standard_normal((40, 2))
+        tree = SpTree(pts)
+        # theta=0 → always recurse to leaves → exact
+        neg = np.zeros(2)
+        sum_q = tree.compute_non_edge_forces(0, 0.0, neg)
+        diff = pts[0] - pts[1:]
+        q = 1.0 / (1.0 + (diff ** 2).sum(1))
+        np.testing.assert_allclose(sum_q, q.sum(), rtol=1e-8)
+        np.testing.assert_allclose(neg, ((q ** 2)[:, None] * diff).sum(0),
+                                   rtol=1e-8)
+
+    def test_quadtree_is_2d(self):
+        with pytest.raises(ValueError):
+            QuadTree(np.zeros((3, 3)))
+        qt = QuadTree(np.array([[0, 0], [1, 1], [0.2, 0.8]]))
+        assert qt.cum_size == 3
+
+
+class TestTsne:
+    def test_exact_separates_blobs(self):
+        from deeplearning4j_tpu.plot import Tsne
+        pts = _blobs(n_per=20, d=10,
+                     centers=((0,) * 10, (25,) * 10))
+        ts = Tsne(perplexity=10.0, n_iter=300, seed=0)
+        y = ts.fit_transform(pts)
+        assert y.shape == (40, 2)
+        a, b = y[:20], y[20:]
+        intra = max(np.linalg.norm(a - a.mean(0), axis=1).mean(),
+                    np.linalg.norm(b - b.mean(0), axis=1).mean())
+        inter = np.linalg.norm(a.mean(0) - b.mean(0))
+        assert inter > 2 * intra
+
+    def test_barnes_hut_separates_blobs(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        pts = _blobs(n_per=15, d=8, centers=((0,) * 8, (25,) * 8), seed=2)
+        ts = BarnesHutTsne(theta=0.5, perplexity=5.0, n_iter=150, seed=0)
+        y = ts.fit_transform(pts)
+        assert y.shape == (30, 2)
+        a, b = y[:15], y[15:]
+        inter = np.linalg.norm(a.mean(0) - b.mean(0))
+        intra = max(np.linalg.norm(a - a.mean(0), axis=1).mean(),
+                    np.linalg.norm(b - b.mean(0), axis=1).mean())
+        assert inter > 2 * intra
+
+    def test_theta_zero_routes_to_exact(self):
+        from deeplearning4j_tpu.plot import BarnesHutTsne
+        pts = _blobs(n_per=10, d=4, seed=3)
+        ts = BarnesHutTsne(theta=0.0, perplexity=5.0, n_iter=50)
+        y = ts.fit_transform(pts)
+        assert y.shape == (30, 2)
