@@ -97,16 +97,25 @@ def _cbow_ns_step(syn0, syn1neg, contexts, ctx_valid, targets, labels,
 
 # ------------------------------------------------------- pair generation
 
+def _reduced_window(L: int, window: int, rng: np.random.Generator
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+    """word2vec's random reduced-window machinery, shared by SG and CBOW:
+    (idx, ok) where idx[L, 2W] are neighbor positions and ok masks
+    out-of-range positions and those beyond the per-center random width."""
+    b = rng.integers(1, window + 1, size=L)
+    offsets = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
+    idx = np.arange(L)[:, None] + offsets[None, :]            # [L, 2W]
+    ok = (idx >= 0) & (idx < L) & (np.abs(offsets)[None, :] <= b[:, None])
+    return idx, ok
+
+
 def generate_sg_pairs(seq: np.ndarray, window: int,
                       rng: np.random.Generator) -> Tuple[np.ndarray, np.ndarray]:
     """(center, context) index pairs with word2vec's random reduced window."""
     L = len(seq)
     if L < 2:
         return np.empty(0, np.int32), np.empty(0, np.int32)
-    b = rng.integers(1, window + 1, size=L)
-    offsets = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
-    idx = np.arange(L)[:, None] + offsets[None, :]            # [L, 2W]
-    ok = (idx >= 0) & (idx < L) & (np.abs(offsets)[None, :] <= b[:, None])
+    idx, ok = _reduced_window(L, window, rng)
     ii, jj = np.nonzero(ok)
     return seq[ii].astype(np.int32), seq[idx[ii, jj]].astype(np.int32)
 
@@ -119,10 +128,7 @@ def generate_cbow_groups(seq: np.ndarray, window: int,
     if L < 2:
         z = np.empty((0,), np.int32)
         return z, np.empty((0, 2 * window), np.int32), np.empty((0, 2 * window), np.float32)
-    b = rng.integers(1, window + 1, size=L)
-    offsets = np.concatenate([np.arange(-window, 0), np.arange(1, window + 1)])
-    idx = np.arange(L)[:, None] + offsets[None, :]
-    ok = (idx >= 0) & (idx < L) & (np.abs(offsets)[None, :] <= b[:, None])
+    idx, ok = _reduced_window(L, window, rng)
     ctx = np.where(ok, seq[np.clip(idx, 0, L - 1)], 0).astype(np.int32)
     return seq.astype(np.int32), ctx, ok.astype(np.float32)
 
@@ -298,10 +304,17 @@ class DM(CBOW):
         ctx = np.concatenate([ctx, lab_col], axis=1)
         ctx_valid = np.concatenate(
             [ctx_valid, np.ones((len(targets), 1), np.float32)], axis=1)
-        t, labels, valid = self._sample_negatives(targets)
         rows = _pad_rows(len(targets))
-        self.table.syn0, self.table.syn1neg = _cbow_ns_step(
-            self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
-            _pad_to(ctx_valid, rows), _pad_to(t, rows),
-            _pad_to(labels, rows), _pad_to(valid, rows), jnp.float32(lr))
+        if self.table.use_hs:
+            self.table.syn0, self.table.syn1 = _cbow_hs_step(
+                self.table.syn0, self.table.syn1, _pad_to(ctx, rows),
+                _pad_to(ctx_valid, rows), _pad_to(self._points[targets], rows),
+                _pad_to(self._codes[targets], rows),
+                _pad_to(self._code_valid[targets], rows), jnp.float32(lr))
+        if self.negative > 0:
+            t, labels, valid = self._sample_negatives(targets)
+            self.table.syn0, self.table.syn1neg = _cbow_ns_step(
+                self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
+                _pad_to(ctx_valid, rows), _pad_to(t, rows),
+                _pad_to(labels, rows), _pad_to(valid, rows), jnp.float32(lr))
         return len(targets)

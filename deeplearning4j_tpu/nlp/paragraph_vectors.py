@@ -28,6 +28,7 @@ class ParagraphVectors(SequenceVectors):
                  min_word_frequency: int = 1, sampling: float = 0.0,
                  epochs: int = 1, iterations: int = 1, seed: int = 12345,
                  sequence_algorithm: str = "dm",
+                 use_hierarchic_softmax: bool = False,
                  tokenizer_factory=None):
         algo = DBOW() if sequence_algorithm.lower() == "dbow" else DM()
         super().__init__(
@@ -36,6 +37,7 @@ class ParagraphVectors(SequenceVectors):
             min_learning_rate=min_learning_rate,
             min_word_frequency=min_word_frequency, sample=sampling,
             epochs=epochs, iterations=iterations, seed=seed,
+            use_hierarchic_softmax=use_hierarchic_softmax,
             elements_algorithm=algo)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self.labels: List[str] = []

@@ -94,21 +94,34 @@ class FileSentenceIterator(SentenceIterator):
         return out
 
     def reset(self) -> None:
-        self._lines: List[str] = []
-        for p in self._paths():
-            with open(p, "r", encoding="utf-8") as fh:
-                self._lines.extend(l.rstrip("\n") for l in fh)
-        self._pos = 0
+        # stream file-by-file, line-by-line — never materialize the corpus
+        self._file_queue: List[str] = self._paths()
+        self._fh = None
+        self._next: Optional[str] = None
+        self._advance()
+
+    def _advance(self) -> None:
+        while True:
+            if self._fh is not None:
+                line = self._fh.readline()
+                if line:
+                    self._next = line.rstrip("\n")
+                    return
+                self._fh.close()
+                self._fh = None
+            if not self._file_queue:
+                self._next = None
+                return
+            self._fh = open(self._file_queue.pop(0), "r", encoding="utf-8")
 
     def next_sentence(self) -> Optional[str]:
-        if self._pos >= len(self._lines):
-            return None
-        s = self._lines[self._pos]
-        self._pos += 1
+        s = self._next
+        if s is not None:
+            self._advance()
         return s
 
     def has_next(self) -> bool:
-        return self._pos < len(self._lines)
+        return self._next is not None
 
 
 class LabelledDocument:

@@ -44,7 +44,9 @@ class SequenceVectors:
         self.layer_size = layer_size
         self.window = window
         self.negative = negative
-        self.use_hs = use_hierarchic_softmax
+        # word2vec convention: with no negative sampling, hierarchical
+        # softmax is the only objective left — force it on
+        self.use_hs = use_hierarchic_softmax or negative <= 0
         self.learning_rate = learning_rate
         self.min_learning_rate = min_learning_rate
         self.min_word_frequency = min_word_frequency

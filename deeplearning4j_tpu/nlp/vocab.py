@@ -17,16 +17,18 @@ class VocabWord:
     """One vocabulary element (VocabWord.java): word, frequency, Huffman
     code/points, unigram-table sampling weight."""
 
-    __slots__ = ("word", "frequency", "index", "code", "points", "is_label")
+    __slots__ = ("word", "frequency", "index", "code", "points", "is_label",
+                 "is_special")
 
     def __init__(self, word: str, frequency: float = 1.0,
-                 is_label: bool = False):
+                 is_label: bool = False, is_special: bool = False):
         self.word = word
         self.frequency = frequency
         self.index = -1
         self.code: List[int] = []
         self.points: List[int] = []
         self.is_label = is_label
+        self.is_special = is_special
 
     def increment(self, by: float = 1.0) -> None:
         self.frequency += by
@@ -83,9 +85,11 @@ class VocabCache:
         return list(self._by_index)
 
     def truncate(self, min_frequency: float) -> None:
-        """Drop words below the cutoff, keeping labels."""
+        """Drop words below the cutoff, keeping labels and special tokens
+        (VocabConstructor pins special tokens through the cutoff)."""
         kept = {w: vw for w, vw in self._words.items()
-                if vw.frequency >= min_frequency or vw.is_label}
+                if vw.frequency >= min_frequency or vw.is_label
+                or vw.is_special}
         self._words = kept
         self._by_index = []
 
@@ -158,14 +162,20 @@ class VocabConstructor:
             total += len(seq)
         cache = VocabCache()
         for tok in self.special_tokens:
-            cache.add_token(VocabWord(tok, frequency=max(counts.get(tok, 1), 1)))
+            cache.add_token(VocabWord(tok, frequency=max(counts.get(tok, 1), 1),
+                                      is_special=True))
             counts.pop(tok, None)
         for word, c in counts.items():
             cache.add_token(VocabWord(word, frequency=c))
         for label_set in labels:
             for lab in label_set:
-                if not cache.contains_word(lab):
+                existing = cache.word_for(lab)
+                if existing is None:
                     cache.add_token(VocabWord(lab, frequency=1, is_label=True))
+                else:
+                    # label collides with a corpus word: pin it so the
+                    # document keeps a trainable label row past the cutoff
+                    existing.is_label = True
         cache.truncate(self.min_word_frequency)
         cache.update_indices()
         cache.total_word_occurrences = float(total)

@@ -250,3 +250,47 @@ def test_tfidf():
     assert mat[0, ib] > 0 and np.allclose(mat[1:, ib], 0.0)
     assert v.tfidf_word("b", ["a", "b"]) == pytest.approx(
         0.5 * np.log(3.0), rel=1e-6)
+
+
+def test_paragraph_vectors_dm_no_negative_uses_hs():
+    # regression: negative_sample=0 used to crash DM (syn1neg None)
+    pv = ParagraphVectors(layer_size=16, window_size=3, epochs=3,
+                          min_word_frequency=1, seed=9, negative_sample=0,
+                          sequence_algorithm="dm")
+    pv.fit(_labelled_docs())
+    v = pv.get_label_vector("pet_0")
+    assert v is not None and np.isfinite(v).all()
+
+
+def test_label_colliding_with_rare_word_survives_cutoff():
+    # regression: a label equal to a below-cutoff corpus word was dropped
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    vc = VocabConstructor(min_word_frequency=2)
+    cache = vc.build_vocab([["a", "a", "dog"]], labels=[["dog"]])
+    assert cache.contains_word("dog")
+    assert cache.index_of("dog") >= 0
+    assert cache.word_for("dog").is_label
+
+
+def test_special_tokens_survive_cutoff():
+    # regression: special tokens used to be truncated below min frequency
+    from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+    vc = VocabConstructor(min_word_frequency=3, special_tokens=("UNK",))
+    cache = vc.build_vocab([["a", "a", "a", "UNK"]])
+    assert cache.contains_word("UNK")
+    assert cache.index_of("UNK") >= 0
+
+
+def test_file_sentence_iterator_streams(tmp_path):
+    d = tmp_path / "corpus"
+    d.mkdir()
+    (d / "a.txt").write_text("one\ntwo\n")
+    (d / "b.txt").write_text("three\n")
+    from deeplearning4j_tpu.nlp.sentence import FileSentenceIterator
+    it = FileSentenceIterator(str(d))
+    got = []
+    while it.has_next():
+        got.append(it.next_sentence())
+    assert got == ["one", "two", "three"]
+    it.reset()
+    assert it.next_sentence() == "one"
