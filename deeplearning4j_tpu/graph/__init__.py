@@ -1,0 +1,32 @@
+"""Graph embeddings — DeepWalk on random walks with hierarchical softmax.
+
+TPU-native re-design of the reference's ``deeplearning4j-graph`` module
+(`deeplearning4j-graph/src/main/java/org/deeplearning4j/graph/`): the graph
+structure is CSR-backed so walk generation is vectorised over all start
+vertices, and DeepWalk training runs as batched, jitted hierarchical-softmax
+updates (scatter-add on device) instead of the reference's per-pair hogwild
+loop (`models/deepwalk/DeepWalk.java`, `models/embeddings/InMemoryGraphLookupTable.java`).
+"""
+
+from deeplearning4j_tpu.graph.api import (  # noqa: F401
+    Edge,
+    NoEdgeHandling,
+    NoEdgesException,
+    ParseException,
+    Vertex,
+)
+from deeplearning4j_tpu.graph.graph import Graph, VertexSequence  # noqa: F401
+from deeplearning4j_tpu.graph.huffman import GraphHuffman  # noqa: F401
+from deeplearning4j_tpu.graph.iterator import (  # noqa: F401
+    RandomWalkGraphIteratorProvider,
+    RandomWalkIterator,
+    WeightedRandomWalkGraphIteratorProvider,
+    WeightedRandomWalkIterator,
+)
+from deeplearning4j_tpu.graph.deepwalk import (  # noqa: F401
+    DeepWalk,
+    GraphVectors,
+    InMemoryGraphLookupTable,
+)
+from deeplearning4j_tpu.graph.loader import GraphLoader  # noqa: F401
+from deeplearning4j_tpu.graph.serializer import GraphVectorSerializer  # noqa: F401

@@ -1,0 +1,39 @@
+"""Tab-delimited text serialisation of learned graph vectors.
+
+Format parity with ``graph/models/loader/GraphVectorSerializer.java``:
+one line per vertex — ``index<TAB>v0<TAB>v1...``; loading reconstructs a
+:class:`GraphVectors` whose lookup table has no tree (inference only), exactly
+like the reference's ``loadTxtVectors``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.graph.deepwalk import GraphVectors, InMemoryGraphLookupTable
+
+_DELIM = "\t"
+
+
+class GraphVectorSerializer:
+    @staticmethod
+    def write_graph_vectors(model: GraphVectors, path: str) -> None:
+        n = model.num_vertices()
+        with open(path, "w", encoding="utf-8") as f:
+            for i in range(n):
+                vec = model.get_vertex_vector(i)
+                f.write(str(i) + _DELIM
+                        + _DELIM.join(repr(float(x)) for x in vec) + "\n")
+
+    @staticmethod
+    def load_txt_vectors(path: str) -> GraphVectors:
+        rows = []
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                parts = line.rstrip("\n").split(_DELIM)
+                if len(parts) > 1:
+                    rows.append([float(x) for x in parts[1:]])
+        arr = np.asarray(rows, dtype=np.float32)
+        table = InMemoryGraphLookupTable(arr.shape[0], arr.shape[1], None, 0.01)
+        table.set_vertex_vectors(arr)
+        return GraphVectors(table)

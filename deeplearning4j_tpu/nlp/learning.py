@@ -253,10 +253,16 @@ class CBOW(ElementsLearningAlgorithm):
                        keep_prob: Optional[np.ndarray] = None) -> int:
         seq = subsample(seq, keep_prob, self.rng)
         targets, ctx, ctx_valid = generate_cbow_groups(seq, self.window, self.rng)
+        return self._train_groups(targets, ctx, ctx_valid, lr)
+
+    def _train_groups(self, targets: np.ndarray, ctx: np.ndarray,
+                      ctx_valid: np.ndarray, lr: float) -> int:
+        """HS and/or negative-sampling update for (context-group → target)
+        batches; shared by CBOW and DM."""
         if len(targets) == 0:
             return 0
+        rows = _pad_rows(len(targets))
         if self.table.use_hs:
-            rows = _pad_rows(len(targets))
             self.table.syn0, self.table.syn1 = _cbow_hs_step(
                 self.table.syn0, self.table.syn1, _pad_to(ctx, rows),
                 _pad_to(ctx_valid, rows), _pad_to(self._points[targets], rows),
@@ -264,7 +270,6 @@ class CBOW(ElementsLearningAlgorithm):
                 _pad_to(self._code_valid[targets], rows), jnp.float32(lr))
         if self.negative > 0:
             t, labels, valid = self._sample_negatives(targets)
-            rows = _pad_rows(len(targets))
             self.table.syn0, self.table.syn1neg = _cbow_ns_step(
                 self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
                 _pad_to(ctx_valid, rows), _pad_to(t, rows),
@@ -304,17 +309,4 @@ class DM(CBOW):
         ctx = np.concatenate([ctx, lab_col], axis=1)
         ctx_valid = np.concatenate(
             [ctx_valid, np.ones((len(targets), 1), np.float32)], axis=1)
-        rows = _pad_rows(len(targets))
-        if self.table.use_hs:
-            self.table.syn0, self.table.syn1 = _cbow_hs_step(
-                self.table.syn0, self.table.syn1, _pad_to(ctx, rows),
-                _pad_to(ctx_valid, rows), _pad_to(self._points[targets], rows),
-                _pad_to(self._codes[targets], rows),
-                _pad_to(self._code_valid[targets], rows), jnp.float32(lr))
-        if self.negative > 0:
-            t, labels, valid = self._sample_negatives(targets)
-            self.table.syn0, self.table.syn1neg = _cbow_ns_step(
-                self.table.syn0, self.table.syn1neg, _pad_to(ctx, rows),
-                _pad_to(ctx_valid, rows), _pad_to(t, rows),
-                _pad_to(labels, rows), _pad_to(valid, rows), jnp.float32(lr))
-        return len(targets)
+        return self._train_groups(targets, ctx, ctx_valid, lr)

@@ -115,13 +115,21 @@ class ParagraphVectors(SequenceVectors):
         table = self.lookup_table
         n = table.cache.num_words()
         saved_syn0, saved_syn1neg = table.syn0, table.syn1neg
+        saved_syn1 = table.syn1
         content_seed = zlib.crc32(" ".join(tokens).encode("utf-8"))
         table.resize(n + 1, seed=content_seed)
+        # resize() reallocates syn0/syn1neg but not syn1: the HS path (DM with
+        # negative=0) would otherwise donate-and-train the frozen inner-node
+        # weights during inference — copy so the model table stays untouched
+        if table.syn1 is not None:
+            import jax.numpy as jnp
+            table.syn1 = jnp.array(table.syn1)
         algo = self._make_algorithm()
         for step in range(steps):
             step_lr = max(lr * (1.0 - step / steps), self.min_learning_rate)
             algo.train_document(n, seq, step_lr)
         vec = np.asarray(table.syn0[n])
         table.syn0, table.syn1neg = saved_syn0, saved_syn1neg
+        table.syn1 = saved_syn1
         table._unigram = None
         return vec

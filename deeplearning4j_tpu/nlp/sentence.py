@@ -95,6 +95,8 @@ class FileSentenceIterator(SentenceIterator):
 
     def reset(self) -> None:
         # stream file-by-file, line-by-line — never materialize the corpus
+        if getattr(self, "_fh", None) is not None:
+            self._fh.close()
         self._file_queue: List[str] = self._paths()
         self._fh = None
         self._next: Optional[str] = None

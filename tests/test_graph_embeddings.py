@@ -1,0 +1,236 @@
+"""Graph embeddings tests — mirrors the reference's deeplearning4j-graph test
+suite (TestGraphLoading, TestGraphHuffman, DeepWalkGradientCheck, TestDeepWalk)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk,
+    Edge,
+    Graph,
+    GraphHuffman,
+    GraphLoader,
+    GraphVectorSerializer,
+    NoEdgeHandling,
+    NoEdgesException,
+    RandomWalkIterator,
+    WeightedRandomWalkIterator,
+)
+
+
+def _ring_graph(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+class TestGraphStructure:
+    def test_add_edge_undirected_both_sides(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 1
+        assert list(g.get_connected_vertex_indices(1)) == [0]
+
+    def test_directed_edge_one_side(self):
+        g = Graph(3)
+        g.add_edge(0, 1, directed=True)
+        assert g.get_vertex_degree(0) == 1
+        assert g.get_vertex_degree(1) == 0
+
+    def test_no_multiple_edges(self):
+        g = Graph(3, allow_multiple_edges=False)
+        g.add_edge(0, 1)
+        g.add_edge(0, 1)
+        assert g.get_vertex_degree(0) == 1
+
+    def test_loader_edge_list(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("0,1\n1,2\n2,3\n3,0\n")
+        g = GraphLoader.load_undirected_graph_edge_list_file(str(p), 4)
+        assert g.num_vertices() == 4
+        for v in range(4):
+            assert g.get_vertex_degree(v) == 2
+
+    def test_loader_weighted(self, tmp_path):
+        p = tmp_path / "edges.txt"
+        p.write_text("# comment\n0,1,1.5\n1,2,2.5\n")
+        g = GraphLoader.load_weighted_edge_list_file(str(p), 3, directed=True)
+        edges = g.get_edges_out(0)
+        assert len(edges) == 1 and edges[0].weight() == 1.5
+        assert g.get_vertex_degree(2) == 0
+
+    def test_vertex_and_edge_files(self, tmp_path):
+        vp, ep = tmp_path / "v.txt", tmp_path / "e.txt"
+        vp.write_text("0:alpha\n1:beta\n2:gamma\n")
+        ep.write_text("0,1\n1,2\n")
+        g = GraphLoader.load_graph_from_vertex_and_edge_files(str(vp), str(ep))
+        assert g.num_vertices() == 3
+        assert g.get_vertex(1).get_value() == "beta"
+
+
+class TestRandomWalks:
+    def test_walk_length_and_edges(self):
+        g = _ring_graph(12)
+        it = RandomWalkIterator(g, walk_length=5, seed=7)
+        count = 0
+        starts = set()
+        for seq in it:
+            idx = seq.indices()
+            assert len(idx) == 6
+            starts.add(idx[0])
+            for a, b in zip(idx, idx[1:]):
+                assert b in set(g.get_connected_vertex_indices(a))
+            count += 1
+        # one walk starting at each vertex exactly once
+        assert count == 12 and starts == set(range(12))
+
+    def test_disconnected_exception(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        it_args = dict(walk_length=3, seed=1)
+        with pytest.raises(NoEdgesException):
+            RandomWalkIterator(g, mode=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED, **it_args)
+
+    def test_disconnected_self_loop(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        it = RandomWalkIterator(g, walk_length=3, seed=1,
+                                mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+        for seq in it:
+            idx = seq.indices()
+            if idx[0] == 2:  # isolated vertex self-loops
+                assert idx == [2, 2, 2, 2]
+
+    def test_weighted_walk_avoids_zero_weight(self):
+        # vertex 0 connects to 1 (weight 0) and 2 (weight 5): never walk to 1
+        g = Graph(3)
+        g.add_edge(0, 1, value=0.0, directed=True)
+        g.add_edge(0, 2, value=5.0, directed=True)
+        g.add_edge(2, 0, value=1.0, directed=True)
+        it = WeightedRandomWalkIterator(g, walk_length=20, seed=3,
+                                        mode=NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED)
+        for seq in it:
+            assert 1 not in seq.indices()[1:] or seq.indices()[0] == 1
+
+
+class TestGraphHuffman:
+    def test_prefix_free_and_degree_ordering(self):
+        degrees = [1, 50, 3, 2, 1, 100, 2, 1]
+        gh = GraphHuffman(len(degrees)).build_tree(degrees)
+        codes = [gh.get_code_string(i) for i in range(len(degrees))]
+        # prefix-free
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+        # highest-degree vertex gets the shortest code
+        lens = [gh.get_code_length(i) for i in range(len(degrees))]
+        assert lens[5] == min(lens)
+        assert lens[1] <= lens[0]
+
+    def test_path_inner_nodes_consistent(self):
+        degrees = [4, 2, 7, 1, 9, 3]
+        gh = GraphHuffman(len(degrees)).build_tree(degrees)
+        for v in range(len(degrees)):
+            path = gh.get_path_inner_nodes(v)
+            assert len(path) == gh.get_code_length(v)
+            assert path[0] == 0  # root is inner node 0
+            assert all(0 <= p < len(degrees) - 1 for p in path)
+
+    def test_path_arrays_match_scalar_api(self):
+        degrees = [4, 2, 7, 1, 9, 3]
+        gh = GraphHuffman(len(degrees)).build_tree(degrees)
+        nodes, bits, mask = gh.path_arrays()
+        for v in range(len(degrees)):
+            cl = gh.get_code_length(v)
+            assert mask[v].sum() == cl
+            assert list(nodes[v][:cl]) == gh.get_path_inner_nodes(v)
+            for i in range(cl):
+                assert bits[v, i] == ((gh.get_code(v) >> i) & 1)
+
+
+class TestDeepWalk:
+    def test_probabilities_sum_to_one(self):
+        g = _ring_graph(8)
+        dw = DeepWalk(vector_size=6, window_size=1, learning_rate=0.05, seed=1)
+        dw.initialize(g)
+        total = sum(dw.lookup_table.calculate_prob(2, j) for j in range(8))
+        assert abs(total - 1.0) < 1e-6
+
+    def test_gradient_check(self):
+        """vectorsAndGradients vs central finite differences of
+        score = -log P(second|first) — DeepWalkGradientCheck parity."""
+        g = _ring_graph(7)
+        dw = DeepWalk(vector_size=5, window_size=1, seed=3)
+        dw.initialize(g)
+        table = dw.lookup_table
+        first, second = 1, 4
+        vectors, grads = table.vectors_and_gradients(first, second)
+        eps = 1e-5
+        base_vec = np.array(table.get_vector(first))
+        for d in range(5):
+            vv = np.asarray(table.get_vertex_vectors()).copy()
+            vv[first, d] = base_vec[d] + eps
+            table.set_vertex_vectors(vv)
+            s_plus = table.calculate_score(first, second)
+            vv[first, d] = base_vec[d] - eps
+            table.set_vertex_vectors(vv)
+            s_minus = table.calculate_score(first, second)
+            vv[first, d] = base_vec[d]
+            table.set_vertex_vectors(vv)
+            numeric = (s_plus - s_minus) / (2 * eps)
+            assert abs(numeric - grads[0][d]) < 1e-4, f"dim {d}"
+
+    def test_fit_improves_neighbor_probability(self):
+        g = _ring_graph(10)
+        dw = DeepWalk(vector_size=8, window_size=1, learning_rate=0.1, seed=5)
+        dw.initialize(g)
+        before = np.mean([dw.lookup_table.calculate_prob(i, (i + 1) % 10)
+                          for i in range(10)])
+        dw.fit(g, walk_length=8, epochs=30)
+        after = np.mean([dw.lookup_table.calculate_prob(i, (i + 1) % 10)
+                         for i in range(10)])
+        assert after > before
+
+    def test_two_cluster_similarity(self):
+        # two dense clusters joined by one edge: intra-cluster similarity must
+        # exceed inter-cluster after training (TestDeepWalk pattern)
+        g = Graph(10)
+        for c in (0, 5):
+            for i in range(c, c + 5):
+                for j in range(i + 1, c + 5):
+                    g.add_edge(i, j)
+        g.add_edge(4, 5)
+        dw = DeepWalk(vector_size=16, window_size=2, learning_rate=0.05, seed=11)
+        dw.fit(g, walk_length=10, epochs=40)
+        intra = np.mean([dw.similarity(0, j) for j in range(1, 5)])
+        inter = np.mean([dw.similarity(0, j) for j in range(5, 10)])
+        assert intra > inter
+
+    def test_vertices_nearest(self):
+        g = _ring_graph(6)
+        dw = DeepWalk(vector_size=4, seed=2)
+        dw.initialize(g)
+        near = dw.vertices_nearest(0, 3)
+        assert len(near) == 3 and 0 not in near
+
+    def test_builder(self):
+        dw = (DeepWalk.Builder().vector_size(32).window_size(3)
+              .learning_rate(0.2).seed(9).build())
+        assert dw.get_vector_size() == 32
+        assert dw.get_window_size() == 3
+        assert dw.get_learning_rate() == 0.2
+
+    def test_serializer_round_trip(self, tmp_path):
+        g = _ring_graph(5)
+        dw = DeepWalk(vector_size=4, seed=8)
+        dw.initialize(g)
+        path = str(tmp_path / "vecs.txt")
+        GraphVectorSerializer.write_graph_vectors(dw, path)
+        loaded = GraphVectorSerializer.load_txt_vectors(path)
+        assert loaded.num_vertices() == 5
+        assert loaded.get_vector_size() == 4
+        np.testing.assert_allclose(loaded.get_vertex_vector(3),
+                                   dw.get_vertex_vector(3), rtol=1e-6)
