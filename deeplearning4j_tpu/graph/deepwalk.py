@@ -30,9 +30,9 @@ from deeplearning4j_tpu.graph.graph import Graph
 from deeplearning4j_tpu.graph.huffman import GraphHuffman
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(7,))
+@functools.partial(jax.jit, donate_argnums=(0, 1))
 def _hs_batch_update(vertex_vectors, out_weights, firsts, nodes, bits, mask,
-                     lr, accumulate: bool = True):
+                     lr):
     """One batched hierarchical-softmax SGD step.
 
     firsts: (B,) input vertex ids; nodes/bits/mask: (B, L) padded Huffman path
@@ -278,10 +278,14 @@ class DeepWalk(GraphVectors):
         """Fit on random walks (one walk per vertex per epoch, shuffled start
         order — ``RandomWalkIterator`` semantics), or on pre-generated
         ``walks`` of shape (n_walks, walk_len+1)."""
+        if graph is None:
+            graph = self.graph
         if graph is not None and not self._init_called:
             self.initialize(graph)
         if not self._init_called:
             raise RuntimeError("DeepWalk not initialized (call initialize before fit)")
+        if graph is None and walks is None:
+            raise ValueError("fit() needs a graph or pre-generated walks")
         rng = np.random.default_rng(self.seed)
         for _ in range(epochs):
             if walks is None:

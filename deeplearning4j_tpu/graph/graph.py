@@ -209,4 +209,5 @@ class Graph:
                     for lst in other._edges_out])
 
     def __repr__(self):
-        return f"Graph(numVertices={self.num_vertices()}, numEdgeSlots={int(self.csr()[0][-1])})"
+        n_slots = sum(len(e) for e in self._edges_out)
+        return f"Graph(numVertices={self.num_vertices()}, numEdgeSlots={n_slots})"

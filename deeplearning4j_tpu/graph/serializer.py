@@ -33,6 +33,8 @@ class GraphVectorSerializer:
                 parts = line.rstrip("\n").split(_DELIM)
                 if len(parts) > 1:
                     rows.append([float(x) for x in parts[1:]])
+        if not rows:
+            raise ValueError(f"No vectors found in {path!r}")
         arr = np.asarray(rows, dtype=np.float32)
         table = InMemoryGraphLookupTable(arr.shape[0], arr.shape[1], None, 0.01)
         table.set_vertex_vectors(arr)

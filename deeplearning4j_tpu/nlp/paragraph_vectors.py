@@ -122,7 +122,6 @@ class ParagraphVectors(SequenceVectors):
         # negative=0) would otherwise donate-and-train the frozen inner-node
         # weights during inference — copy so the model table stays untouched
         if table.syn1 is not None:
-            import jax.numpy as jnp
             table.syn1 = jnp.array(table.syn1)
         algo = self._make_algorithm()
         for step in range(steps):

@@ -7,3 +7,11 @@ from deeplearning4j_tpu.nn.conf.graph_conf import (  # noqa: F401
     ComputationGraphConfiguration,
     GraphBuilder,
 )
+from deeplearning4j_tpu.nn.conf.memory import (  # noqa: F401
+    LayerMemoryReport,
+    MemoryType,
+    MemoryUseMode,
+    NetworkMemoryReport,
+    compiled_memory_analysis,
+    network_memory_report,
+)

@@ -18,6 +18,18 @@ from deeplearning4j_tpu.optimize.earlystopping import (  # noqa: F401
     MaxTimeIterationTerminationCondition,
     ScoreImprovementEpochTerminationCondition,
 )
+from deeplearning4j_tpu.optimize.solvers import (  # noqa: F401
+    BackTrackLineSearch,
+    ConjugateGradient,
+    ConvexOptimizer,
+    EpsTermination,
+    LBFGS,
+    LineGradientDescent,
+    Norm2Termination,
+    Solver,
+    StochasticGradientDescent,
+    ZeroDirection,
+)
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CheckpointListener,
     CollectScoresIterationListener,

@@ -1,0 +1,73 @@
+"""Memory report tests (nn/conf/memory parity + XLA compiled analysis)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf import (
+    InputType,
+    MemoryType,
+    MemoryUseMode,
+    NetworkMemoryReport,
+    NeuralNetConfiguration,
+    compiled_memory_analysis,
+    network_memory_report,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+
+
+def _conf(updater):
+    return (NeuralNetConfiguration.builder().seed(1).updater(updater).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.feed_forward(20))
+            .build())
+
+
+class TestAnalyticReport:
+    def test_parameter_counts(self):
+        rep = network_memory_report(_conf(Sgd(0.1)))
+        # dense: 20*32+32 params; output: 32*10+10
+        assert rep.layer_reports[0].parameters == 20 * 32 + 32
+        assert rep.layer_reports[1].parameters == 32 * 10 + 10
+        assert (rep.get_memory_bytes(MemoryType.PARAMETERS, 1)
+                == (20 * 32 + 32 + 32 * 10 + 10) * 4)
+
+    def test_updater_state_scaling(self):
+        sgd = network_memory_report(_conf(Sgd(0.1)))
+        adam = network_memory_report(_conf(Adam(1e-3)))
+        assert sgd.get_memory_bytes(MemoryType.UPDATER_STATE, 1) == 0
+        n_params = sum(r.parameters for r in adam.layer_reports)
+        assert adam.get_memory_bytes(MemoryType.UPDATER_STATE, 1) == 2 * n_params * 4
+
+    def test_inference_drops_training_memory(self):
+        rep = network_memory_report(_conf(Adam(1e-3)))
+        train = rep.get_total_memory_bytes(64, MemoryUseMode.TRAINING)
+        infer = rep.get_total_memory_bytes(64, MemoryUseMode.INFERENCE)
+        assert infer < train
+        assert rep.get_memory_bytes(MemoryType.PARAMETER_GRADIENTS, 64,
+                                    MemoryUseMode.INFERENCE) == 0
+
+    def test_activations_scale_with_minibatch(self):
+        rep = network_memory_report(_conf(Sgd(0.1)))
+        a1 = rep.get_memory_bytes(MemoryType.ACTIVATIONS, 1)
+        a8 = rep.get_memory_bytes(MemoryType.ACTIVATIONS, 8)
+        assert a8 == 8 * a1 > 0
+
+    def test_json_round_trip(self):
+        rep = network_memory_report(_conf(Adam(1e-3)))
+        rt = NetworkMemoryReport.from_json(rep.to_json())
+        assert rt.get_total_memory_bytes(16) == rep.get_total_memory_bytes(16)
+        assert "total training memory" in str(rt)
+
+
+class TestCompiledAnalysis:
+    def test_xla_memory_analysis(self):
+        net = MultiLayerNetwork(_conf(Adam(1e-3))).init()
+        ma = compiled_memory_analysis(net, batch=16)
+        if not ma:  # backend may not support memory analysis
+            return
+        # arguments include params + updater state + x + y: must be > raw params
+        n_params = net.conf.num_params()
+        assert ma["argument_size"] >= n_params * 4
+        assert ma["total"] > 0

@@ -1,0 +1,159 @@
+"""Solver family tests: line search, CG, LBFGS convergence and the model-level
+Solver front end (reference `optimize/solvers/` behavior)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.optimize.solvers import (
+    BackTrackLineSearch,
+    ConjugateGradient,
+    LBFGS,
+    LineGradientDescent,
+    Solver,
+    StochasticGradientDescent,
+)
+
+
+def _quadratic(scales):
+    """f(x) = 0.5 * sum(scales * x^2) — condition number = max/min scale."""
+    s = jnp.asarray(scales, jnp.float32)
+
+    @jax.jit
+    def vag(x):
+        def f(x):
+            return 0.5 * jnp.sum(s * x * x)
+        return jax.value_and_grad(f)(x)
+
+    return vag
+
+
+def _rosenbrock():
+    @jax.jit
+    def vag(x):
+        def f(x):
+            return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                           + (1.0 - x[:-1]) ** 2)
+        return jax.value_and_grad(f)(x)
+    return vag
+
+
+class TestBackTrackLineSearch:
+    def test_full_step_when_sufficient(self):
+        vag = _quadratic([1.0, 1.0])
+        x = jnp.array([1.0, -2.0])
+        score, grad = vag(x)
+        ls = BackTrackLineSearch(lambda p: vag(p)[0], max_iterations=5)
+        step = ls.optimize(x, float(score), np.asarray(grad), np.asarray(grad))
+        assert step > 0
+        new_score = float(vag(x - step * grad)[0])
+        assert new_score < float(score)
+
+    def test_backtracks_on_overshoot(self):
+        # steep quadratic: full step along raw gradient overshoots
+        vag = _quadratic([100.0])
+        x = jnp.array([1.0])
+        score, grad = vag(x)
+        ls = BackTrackLineSearch(lambda p: vag(p)[0], max_iterations=10)
+        step = ls.optimize(x, float(score), np.asarray(grad), np.asarray(grad))
+        assert 0 < step < 1.0
+        assert float(vag(x - step * grad)[0]) < float(score)
+
+    def test_zero_for_ascent_direction(self):
+        vag = _quadratic([1.0])
+        x = jnp.array([1.0])
+        score, grad = vag(x)
+        ls = BackTrackLineSearch(lambda p: vag(p)[0])
+        step = ls.optimize(x, float(score), np.asarray(grad), -np.asarray(grad))
+        assert step == 0.0
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls", [LineGradientDescent, ConjugateGradient, LBFGS])
+    def test_quadratic_convergence(self, cls):
+        vag = _quadratic([1.0, 10.0, 100.0])
+        x0 = jnp.array([5.0, -3.0, 2.0])
+        opt = cls(max_iterations=200, line_search_iterations=10)
+        x = opt.optimize(vag, x0)
+        assert float(vag(x)[0]) < 1e-4
+        # scores non-increasing up to float32 evaluation noise
+        hist = opt.score_history
+        assert all(b <= a + 1e-6 + 1e-6 * abs(a) for a, b in zip(hist, hist[1:]))
+
+    def test_lbfgs_beats_gd_on_rosenbrock(self):
+        # curved valley: curvature information must beat steepest descent
+        x0 = jnp.zeros(6)
+
+        def final(cls):
+            opt = cls(max_iterations=80, line_search_iterations=15,
+                      termination_conditions=[])
+            x = opt.optimize(_rosenbrock(), x0)
+            return float(_rosenbrock()(x)[0])
+
+        assert final(LBFGS) < final(LineGradientDescent) * 0.5
+
+    def test_lbfgs_rosenbrock(self):
+        x0 = jnp.zeros(4)
+        opt = LBFGS(m=6, max_iterations=400, line_search_iterations=20,
+                    termination_conditions=[])
+        x = opt.optimize(_rosenbrock(), x0)
+        assert float(_rosenbrock()(x)[0]) < 1e-3
+        np.testing.assert_allclose(np.asarray(x), np.ones(4), atol=0.05)
+
+    def test_sgd_descends(self):
+        vag = _quadratic([1.0, 2.0])
+        opt = StochasticGradientDescent(learning_rate=0.1, max_iterations=50)
+        x = opt.optimize(vag, jnp.array([4.0, 4.0]))
+        assert float(vag(x)[0]) < 0.1
+
+
+class TestModelSolver:
+    def _net_and_data(self, algo):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        rng = np.random.default_rng(0)
+        n = 256
+        y_idx = rng.integers(0, 3, n)
+        x = rng.normal(size=(n, 8)).astype(np.float32)
+        x[np.arange(n), y_idx] += 3.0  # separable signal
+        y = np.eye(3, dtype=np.float32)[y_idx]
+        conf = (NeuralNetConfiguration.builder().seed(7)
+                .optimization_algo(algo)
+                .list()
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="negativeloglikelihood"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        return net, DataSet(x, y)
+
+    @pytest.mark.parametrize("algo", ["lbfgs", "conjugate_gradient",
+                                      "line_gradient_descent"])
+    def test_solver_trains_classifier(self, algo):
+        net, ds = self._net_and_data(algo)
+        solver = Solver(net, max_iterations=60)
+        assert solver.algo == algo
+        score0 = solver.score_history[0] if hasattr(solver, "score_history") else None
+        final = solver.optimize(ds)
+        assert final < solver.score_history[0]
+        from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+        ev = net.evaluate(ListDataSetIterator(ds, 128))
+        assert ev.accuracy() > 0.9
+
+    def test_builder(self):
+        net, ds = self._net_and_data("lbfgs")
+        s = (Solver.Builder().model(net).configure(net.conf.global_conf)
+             .max_iterations(5).build())
+        assert s.algo == "lbfgs"
+        s.optimize(ds)
+
+    def test_unknown_algo_raises(self):
+        net, ds = self._net_and_data("lbfgs")
+        with pytest.raises(ValueError):
+            Solver(net, algo="newton").optimize(ds)
