@@ -29,3 +29,11 @@ from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
     EncodingHandler,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.master import (  # noqa: F401
+    DistributedMultiLayerNetwork,
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+    TrainingMaster,
+    TrainingStats,
+    init_distributed,
+)

@@ -1,0 +1,384 @@
+"""TrainingMaster layer: cluster-style training drivers over the mesh.
+
+Capability parity with the reference's Spark scale-out layer
+(`dl4j-spark/.../api/TrainingMaster.java:28`,
+`ParameterAveragingTrainingMaster.java` — split sizing ``:287-298``, training
+``:308``, tree aggregation / ``aggregationDepth``;
+`dl4j-spark-parameterserver/.../SharedTrainingMaster.java:493` — threshold-
+compressed gradient sharing over Aeron; export-based iteration
+`impl/paramavg/util/ExportSupport.java`; per-phase timing
+`api/stats/CommonSparkTrainingStats.java`) — redesigned for the TPU stack:
+
+- Spark executors → mesh axis shards. The "cluster" is a ``jax.sharding.Mesh``;
+  multi-host runs enter through ``jax.distributed`` (`init_distributed`) with
+  per-host input pipelines, exactly the single-controller JAX model.
+- broadcast + treeAggregate → XLA collectives over ICI/DCN. ``aggregationDepth``
+  is accepted but XLA's all-reduce already uses optimal reduction topology.
+- Aeron threshold messages → in-step quantization: each worker applies the
+  Strom-style threshold sign-quantization to its update, keeps the residual,
+  and a ``psum`` shares the quantized updates (`EncodingHandler.java`
+  semantics; the wire-format sparse codec lives in
+  ``deeplearning4j_tpu.parallel.compression``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
+from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Multi-host entry: join the JAX coordination service (replaces the
+    reference's Aeron introduction/shard protocol,
+    `SharedTrainingWrapper.java:214-244`). No-op when single-process."""
+    if num_processes is None or num_processes <= 1:
+        return
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+class TrainingStats:
+    """Per-phase wall-clock timings (`CommonSparkTrainingStats.java`)."""
+
+    def __init__(self):
+        self.phase_times: dict = {}
+
+    def add(self, phase: str, seconds: float) -> None:
+        self.phase_times.setdefault(phase, []).append(seconds)
+
+    def total(self, phase: str) -> float:
+        return sum(self.phase_times.get(phase, []))
+
+    def as_dict(self) -> dict:
+        return {k: {"count": len(v), "total_s": sum(v)}
+                for k, v in self.phase_times.items()}
+
+
+class TrainingMaster:
+    """SPI: how distributed fitting is orchestrated
+    (`api/TrainingMaster.java:28`)."""
+
+    def execute_training(self, network, data_iterator: Iterable) -> None:
+        raise NotImplementedError
+
+    def get_training_stats(self) -> TrainingStats:
+        return getattr(self, "stats", TrainingStats())
+
+
+class ParameterAveragingTrainingMaster(TrainingMaster):
+    """Synchronous periodic parameter averaging
+    (`ParameterAveragingTrainingMaster.java`).
+
+    Splits the incoming stream into chunks of
+    ``num_workers * batch_size_per_worker * averaging_frequency`` examples
+    (split sizing ``:287-298``); each split runs ``averaging_frequency``
+    local steps per worker followed by parameter + updater-state averaging —
+    executed as ONE compiled shard_map program per split
+    (:class:`ParallelWrapper` averaging mode) instead of Spark map + tree
+    aggregation.
+    """
+
+    def __init__(self, batch_size_per_worker: int = 16,
+                 averaging_frequency: int = 5,
+                 num_workers: Optional[int] = None,
+                 aggregation_depth: int = 2,
+                 repartition: str = "always",
+                 export_directory: Optional[str] = None,
+                 mesh: Optional[Mesh] = None,
+                 data_axis: str = DATA_AXIS):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.averaging_frequency = max(1, averaging_frequency)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.data_axis = data_axis
+        self.num_workers = num_workers or int(self.mesh.shape[data_axis])
+        # accepted for parity; XLA's all-reduce already picks the reduction
+        # topology, so depth is advisory only
+        self.aggregation_depth = aggregation_depth
+        self.repartition = repartition
+        self.export_directory = export_directory
+        self.stats = TrainingStats()
+        self._pw: Optional[ParallelWrapper] = None
+
+    class Builder:
+        def __init__(self, batch_size_per_worker: int = 16):
+            self._kw = {"batch_size_per_worker": batch_size_per_worker}
+
+        def averaging_frequency(self, f):
+            self._kw["averaging_frequency"] = f
+            return self
+
+        def aggregation_depth(self, d):
+            self._kw["aggregation_depth"] = d
+            return self
+
+        def workers(self, n):
+            self._kw["num_workers"] = n
+            return self
+
+        def export_directory(self, d):
+            self._kw["export_directory"] = d
+            return self
+
+        def build(self):
+            return ParameterAveragingTrainingMaster(**self._kw)
+
+    # -- data staging ------------------------------------------------------
+    def _repartition(self, data_iterator) -> List:
+        """Regroup the stream into worker-divisible batches of
+        batch_size_per_worker * num_workers examples (the reference
+        repartitions the RDD so every executor sees equal counts)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        t0 = time.perf_counter()
+        per_round = self.batch_size_per_worker * self.num_workers
+        feats, labs, n_buf = [], [], 0
+        out: List[DataSet] = []
+        for ds in data_iterator:
+            if ds.features_mask is not None or ds.labels_mask is not None:
+                # masked sequence data is not re-chunked; pass through
+                out.append(ds)
+                continue
+            feats.append(np.asarray(ds.features))
+            labs.append(np.asarray(ds.labels))
+            n_buf += feats[-1].shape[0]
+            while n_buf >= per_round:
+                f = np.concatenate(feats) if len(feats) > 1 else feats[0]
+                l = np.concatenate(labs) if len(labs) > 1 else labs[0]
+                out.append(DataSet(f[:per_round], l[:per_round]))
+                feats, labs = [f[per_round:]], [l[per_round:]]
+                n_buf = feats[0].shape[0]
+        if n_buf:
+            out.append(DataSet(np.concatenate(feats) if len(feats) > 1 else feats[0],
+                               np.concatenate(labs) if len(labs) > 1 else labs[0]))
+        if self.export_directory:
+            os.makedirs(self.export_directory, exist_ok=True)
+            for i, ds in enumerate(out):
+                np.savez(os.path.join(self.export_directory, f"split{i}.npz"),
+                         features=ds.features, labels=ds.labels)
+        self.stats.add("split", time.perf_counter() - t0)
+        return out
+
+    @staticmethod
+    def load_exported(directory: str) -> List:
+        """Replay a staged export directory (`ExportSupport.java` parity)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        out = []
+        for f in sorted(os.listdir(directory)):
+            if f.endswith(".npz"):
+                z = np.load(os.path.join(directory, f))
+                out.append(DataSet(z["features"], z["labels"]))
+        return out
+
+    # -- training ----------------------------------------------------------
+    def execute_training(self, network, data_iterator: Iterable) -> None:
+        batches = self._repartition(data_iterator)
+        # cache the wrapper so the compiled shard_map step survives epochs
+        pw = self._pw
+        if pw is None or pw.model is not network:
+            pw = self._pw = ParallelWrapper(
+                network, self.mesh, mode="averaging",
+                averaging_frequency=self.averaging_frequency,
+                data_axis=self.data_axis)
+        t0 = time.perf_counter()
+        pw.fit(batches)
+        network.epoch -= 1  # pw.fit counts an epoch; the master's caller owns epochs
+        self.stats.add("fit", time.perf_counter() - t0)
+
+
+class SharedTrainingMaster(TrainingMaster):
+    """Per-step threshold-compressed gradient sharing
+    (`SharedTrainingMaster.java` + `EncodedGradientsAccumulator.java:33`).
+
+    Each worker: local gradients → local updater → update + residual →
+    Strom threshold sign-quantization (magnitudes below ``threshold`` stay in
+    the residual; survivors are quantized to ±threshold) → ``psum`` over the
+    mesh → everyone applies the same summed quantized update. The adaptive
+    threshold decay/boost of `EncodingHandler.java:69-94` is applied between
+    steps from the on-device sparsity measurement.
+    """
+
+    def __init__(self, batch_size_per_worker: int = 16,
+                 threshold: float = 1e-3, min_threshold: float = 1e-5,
+                 threshold_step: float = 1e-5, step_trigger: float = 0.05,
+                 step_delay: int = 50, shake_frequency: int = 0,
+                 mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS):
+        self.batch_size_per_worker = batch_size_per_worker
+        self.threshold = float(threshold)
+        self.min_threshold = float(min_threshold)
+        self.threshold_step = float(threshold_step)
+        self.step_trigger = float(step_trigger)  # target sparsity ratio
+        self.step_delay = step_delay
+        self.shake_frequency = shake_frequency
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.data_axis = data_axis
+        self.num_workers = int(self.mesh.shape[data_axis])
+        self.stats = TrainingStats()
+        self._step_fn = None
+        self._residual = None
+        self._steps_done = 0
+        self._shake_restore: Optional[float] = None
+
+    class Builder:
+        def __init__(self, batch_size_per_worker: int = 16):
+            self._kw = {"batch_size_per_worker": batch_size_per_worker}
+
+        def update_threshold(self, t):
+            self._kw["threshold"] = t
+            return self
+
+        def min_update_threshold(self, t):
+            self._kw["min_threshold"] = t
+            return self
+
+        def workers_per_node(self, n):
+            return self  # mesh decides worker count; accepted for parity
+
+        def build(self):
+            return SharedTrainingMaster(**self._kw)
+
+    def _build_step(self, net):
+        daxis = self.data_axis
+
+        def worker(params, states, upd, residual, it, ep, x, y, rng, thr):
+            # Workers compute local grads/updates on their batch shard; the
+            # quantized updates are summed across the mesh (the Aeron
+            # broadcast path, now one ICI collective). ``residual`` leaves
+            # arrive as this worker's [1, *param_shape] slice of the stacked
+            # per-worker residual state.
+            rng = jax.random.fold_in(rng, jax.lax.axis_index(daxis))
+
+            def lf(p):
+                return net._loss_fn(p, states, x, y, rng, None, None, train=True)
+
+            (loss, (new_states, _)), grads = jax.value_and_grad(
+                lf, has_aux=True)(params)
+            # local updater: update magnitudes, not raw grads, are shared
+            # (StochasticGradientDescent.java:66-73 stores the UPDATE)
+            stepped, new_upd = net._apply_updates(params, grads, upd, it, ep)
+            update = jax.tree_util.tree_map(lambda a, b: a - b, params, stepped)
+            acc = jax.tree_util.tree_map(lambda r, u: r + u[None], residual, update)
+            quant = jax.tree_util.tree_map(
+                lambda a: jnp.where(jnp.abs(a) >= thr,
+                                    jnp.sign(a) * thr, 0.0).astype(a.dtype), acc)
+            new_residual = jax.tree_util.tree_map(lambda a, q: a - q, acc, quant)
+            # every node applies the SUM of all workers' quantized updates
+            # (EncodedGradientsAccumulator applies each received message)
+            shared = jax.tree_util.tree_map(
+                lambda q: jax.lax.psum(q, daxis), quant)
+            new_params = jax.tree_util.tree_map(
+                lambda p, s: p - s[0], params, shared)
+            # sparsity: fraction of elements encoded (EncodingHandler feedback)
+            counts = jax.tree_util.tree_map(
+                lambda q: (jnp.sum(q != 0), q.size), quant,
+                is_leaf=lambda a: hasattr(a, "shape"))
+            leaves = jax.tree_util.tree_leaves(counts)
+            nz = sum(leaves[0::2])
+            total = sum(leaves[1::2])
+            avg = lambda t: jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, daxis), t)
+            sparsity = jax.lax.pmean(nz / total, daxis)
+            return (new_params, avg(new_states), avg(new_upd), new_residual,
+                    jax.lax.pmean(loss, daxis), sparsity)
+
+        rep = P()
+        shard0 = P(daxis)
+
+        mapped = shard_map(
+            worker, mesh=self.mesh,
+            in_specs=(rep, rep, rep, shard0, rep, rep, shard0, shard0,
+                      rep, rep),
+            out_specs=(rep, rep, rep, shard0, rep, rep))
+        return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+
+    def _adapt_threshold(self, sparsity: float) -> None:
+        """EncodingHandler.java:69-94: decay threshold toward min when too few
+        elements pass (residual starving), raise it when too many pass."""
+        self._steps_done += 1
+        if self._shake_restore is not None:
+            # previous step was a shake: restore the working threshold
+            self.threshold = self._shake_restore
+            self._shake_restore = None
+        if self._steps_done < self.step_delay:
+            return
+        if sparsity < 1e-4:  # almost nothing transmitted → lower threshold
+            self.threshold = max(self.min_threshold,
+                                 self.threshold - self.threshold_step)
+        elif sparsity > self.step_trigger:  # too dense → raise threshold
+            self.threshold = self.threshold + self.threshold_step
+        if self.shake_frequency and self._steps_done % self.shake_frequency == 0:
+            # periodic "shake": lower for ONE step to flush residuals, then
+            # restore (EncodingHandler's temporary shake semantics)
+            self._shake_restore = self.threshold
+            self.threshold = max(self.min_threshold, self.threshold * 0.5)
+
+    def execute_training(self, network, data_iterator: Iterable) -> None:
+        if network.params is None:
+            network.init()
+        dtype = network.conf.global_conf.jnp_dtype()
+        if self._step_fn is None:
+            self._step_fn = self._build_step(network)
+            # stacked per-worker residuals, sharded over the data axis
+            self._residual = jax.tree_util.tree_map(
+                lambda p: jnp.zeros((self.num_workers,) + p.shape, p.dtype),
+                network.params)
+        t0 = time.perf_counter()
+        for ds in data_iterator:
+            x = np.asarray(ds.features)
+            y = np.asarray(ds.labels)
+            if x.shape[0] % self.num_workers:
+                network._fit_batch(ds)  # ragged tail: unsharded fallback
+                continue
+            it = jnp.asarray(network.iteration, jnp.float32)
+            ep = jnp.asarray(network.epoch, jnp.float32)
+            rng = network._next_rng()
+            (network.params, network.states, network.updater_states,
+             self._residual, loss, sparsity) = self._step_fn(
+                network.params, network.states, network.updater_states,
+                self._residual, it, ep, jnp.asarray(x, dtype),
+                jnp.asarray(y, dtype), rng, jnp.float32(self.threshold))
+            network.score_ = loss
+            network.iteration += 1
+            self._adapt_threshold(float(sparsity))
+            for listener in network.listeners:
+                if hasattr(listener, "iteration_done"):
+                    listener.iteration_done(network, network.iteration,
+                                            network.epoch)
+        self.stats.add("fit", time.perf_counter() - t0)
+
+
+class DistributedMultiLayerNetwork:
+    """Front end pairing a network with a TrainingMaster
+    (`SparkDl4jMultiLayer.java:71` role: ``fit(RDD)`` → master)."""
+
+    def __init__(self, network, training_master: TrainingMaster):
+        self.network = network
+        self.master = training_master
+
+    def fit(self, data_iterator, epochs: int = 1):
+        if self.network.params is None:
+            self.network.init()
+        for _ in range(epochs):
+            if hasattr(data_iterator, "reset"):
+                data_iterator.reset()
+            self.master.execute_training(self.network, data_iterator)
+            self.network.epoch += 1
+        return self.network
+
+    def evaluate(self, iterator):
+        return self.network.evaluate(iterator)
+
+    def get_training_stats(self) -> TrainingStats:
+        return self.master.get_training_stats()

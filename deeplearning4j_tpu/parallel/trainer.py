@@ -234,6 +234,9 @@ class ParallelWrapper:
                 flush()
                 net._fit_batch(ds)  # ragged tail batch: unsharded
                 continue
+            if pending and np.asarray(ds.features).shape != np.asarray(
+                    pending[-1].features).shape:
+                flush()  # shape change (e.g. smaller tail): can't stack
             pending.append(ds)
             if len(pending) == k:
                 flush()

@@ -1,0 +1,28 @@
+"""Observability / UI layer (SURVEY.md L6).
+
+Re-design of the reference's stats pipeline — ``BaseStatsListener`` →
+SBE-encoded ``Persistable`` → ``StatsStorageRouter`` → storage →
+Play web modules (`deeplearning4j-ui-model/.../stats/BaseStatsListener.java`,
+`deeplearning4j-core/.../api/storage/StatsStorage.java`,
+`deeplearning4j-play/.../PlayUIServer.java:53`) — as plain JSON reports over
+a storage SPI served by a dependency-free stdlib HTTP dashboard. Per-layer
+parameter/gradient statistics are computed on device in one jitted call and
+transferred as a handful of scalars, not whole tensors.
+"""
+
+from deeplearning4j_tpu.ui.storage import (  # noqa: F401
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    Persistable,
+    StatsStorage,
+    StatsStorageEvent,
+    StatsStorageListener,
+    StatsStorageRouter,
+)
+from deeplearning4j_tpu.ui.stats import (  # noqa: F401
+    StatsListener,
+    StatsReport,
+    StatsUpdateConfiguration,
+)
+from deeplearning4j_tpu.ui.server import RemoteReceiverModule, UIServer  # noqa: F401
+from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter, WebReporter  # noqa: F401

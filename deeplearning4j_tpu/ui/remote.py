@@ -1,0 +1,81 @@
+"""Remote stats posting: client side of the remote receiver.
+
+Parity with ``RemoteUIStatsStorageRouter`` and
+``deeplearning4j-ui-remote-iterationlisteners/.../WebReporter.java``: a
+StatsStorageRouter that POSTs each record to a UIServer's ``/remote``
+endpoint over HTTP (urllib, retry with backoff), so a training process can
+report to a dashboard running elsewhere.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from deeplearning4j_tpu.ui.storage import Persistable, StatsStorageRouter
+
+log = logging.getLogger(__name__)
+
+
+class WebReporter:
+    """POST a JSON payload to a URL with retries (``WebReporter.java``)."""
+
+    @staticmethod
+    def report_to_url(url: str, payload: dict, retries: int = 3,
+                      timeout: float = 5.0, backoff: float = 0.2) -> bool:
+        body = json.dumps(payload).encode("utf-8")
+        last_err: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                req = urllib.request.Request(
+                    url, data=body, headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    return 200 <= resp.status < 300
+            except (urllib.error.URLError, OSError) as e:
+                last_err = e
+                time.sleep(backoff * (2 ** attempt))
+        raise ConnectionError(f"Failed to POST to {url}: {last_err}")
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """Router that ships records to a remote UIServer ``/remote`` endpoint.
+
+    By default a dashboard outage logs a warning and DROPS the record — a
+    stats reporter must never kill training (the reference router behaves the
+    same). Set ``raise_on_error=True`` to surface failures instead.
+    """
+
+    def __init__(self, url: str, retries: int = 3, timeout: float = 5.0,
+                 raise_on_error: bool = False):
+        if not url.endswith("/remote"):
+            url = url.rstrip("/") + "/remote"
+        self.url = url
+        self.retries = retries
+        self.timeout = timeout
+        self.raise_on_error = raise_on_error
+        self._warned = False
+
+    def _send(self, p: Persistable, static: bool) -> None:
+        payload = {"session_id": p.session_id, "type_id": p.type_id,
+                   "worker_id": p.worker_id, "timestamp": p.timestamp,
+                   "static": static, "data": p.data}
+        try:
+            WebReporter.report_to_url(self.url, payload, self.retries,
+                                      self.timeout)
+        except ConnectionError:
+            if self.raise_on_error:
+                raise
+            if not self._warned:
+                self._warned = True
+                log.warning("Dropping stats record: cannot reach %s "
+                            "(further drops are silent)", self.url)
+
+    def put_static_info(self, p: Persistable) -> None:
+        self._send(p, static=True)
+
+    def put_update(self, p: Persistable) -> None:
+        self._send(p, static=False)

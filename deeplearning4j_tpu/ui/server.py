@@ -1,0 +1,212 @@
+"""UI server: training dashboard + remote stats receiver.
+
+Replaces the reference's Play-framework server
+(`deeplearning4j-play/.../PlayUIServer.java:53`) and its remote receiver
+module (`ui/module/remote/RemoteReceiverModule.java`) with a dependency-free
+stdlib ``http.server``: JSON endpoints backed by a :class:`StatsStorage`, a
+single-page HTML dashboard with inline SVG charts, and a POST endpoint that
+ingests remote :class:`Persistable` records.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+from urllib.parse import urlparse
+
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+from deeplearning4j_tpu.ui.storage import Persistable, StatsStorage
+
+_DASHBOARD_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu training UI</title>
+<style>
+ body{font-family:sans-serif;margin:20px;background:#fafafa}
+ h1{font-size:20px} h2{font-size:16px;margin-top:24px}
+ .chart{border:1px solid #ccc;background:#fff;margin:4px}
+ table{border-collapse:collapse;font-size:13px}
+ td,th{border:1px solid #ddd;padding:4px 8px}
+</style></head>
+<body>
+<h1>deeplearning4j_tpu training UI</h1>
+<div id="sessions"></div>
+<h2>Score vs iteration</h2><svg id="score" class="chart" width="720" height="260"></svg>
+<h2>Parameter mean magnitudes</h2><svg id="params" class="chart" width="720" height="260"></svg>
+<h2>Latest stats</h2><div id="latest"></div>
+<script>
+const SVGNS = "http://www.w3.org/2000/svg";
+function polyline(svg, xs, ys, color){
+  if (xs.length < 2) return;
+  const w = svg.width.baseVal.value, h = svg.height.baseVal.value, pad = 30;
+  const xmin=Math.min(...xs), xmax=Math.max(...xs), ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const sx = x => pad + (w-2*pad) * (x - xmin) / Math.max(xmax - xmin, 1e-9);
+  const sy = y => h - pad - (h-2*pad) * (y - ymin) / Math.max(ymax - ymin, 1e-9);
+  const pl = document.createElementNS(SVGNS, "polyline");
+  pl.setAttribute("points", xs.map((x,i)=>sx(x)+","+sy(ys[i])).join(" "));
+  pl.setAttribute("fill","none"); pl.setAttribute("stroke",color); pl.setAttribute("stroke-width","1.5");
+  svg.appendChild(pl);
+}
+async function refresh(){
+  const sessions = await (await fetch("/train/sessions")).json();
+  document.getElementById("sessions").textContent = "Sessions: " + sessions.join(", ");
+  if (!sessions.length) return;
+  const sid = sessions[sessions.length-1];
+  const data = await (await fetch("/train/overview/" + sid)).json();
+  const svg = document.getElementById("score"); svg.innerHTML = "";
+  polyline(svg, data.iterations, data.scores, "#1565c0");
+  const psvg = document.getElementById("params"); psvg.innerHTML = "";
+  const colors = ["#1565c0","#c62828","#2e7d32","#f9a825","#6a1b9a","#00838f"];
+  let ci = 0;
+  for (const [name, series] of Object.entries(data.param_mean_magnitudes)){
+    polyline(psvg, data.iterations.slice(-series.length), series, colors[ci++ % colors.length]);
+  }
+  const latest = data.latest || {};
+  document.getElementById("latest").innerHTML =
+    "<table><tr><th>iteration</th><td>"+latest.iteration+"</td></tr>" +
+    "<tr><th>score</th><td>"+latest.score+"</td></tr>" +
+    "<tr><th>minibatch</th><td>"+latest.minibatch_size+"</td></tr></table>";
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>
+"""
+
+
+class RemoteReceiverModule:
+    """Accepts POSTed Persistable JSON into a storage router
+    (``RemoteReceiverModule.java``). Enable/disable mirrors the reference."""
+
+    def __init__(self, router=None, enabled: bool = True):
+        self.router = router
+        self.enabled = enabled
+
+    def receive(self, body: bytes) -> bool:
+        if not self.enabled or self.router is None:
+            return False
+        rec = json.loads(body.decode("utf-8"))
+        p = Persistable(rec["session_id"], rec["type_id"], rec["worker_id"],
+                        rec["timestamp"], rec["data"])
+        if rec.get("static"):
+            self.router.put_static_info(p)
+        else:
+            self.router.put_update(p)
+        return True
+
+
+class UIServer:
+    """Serves the dashboard + JSON API for one or more attached
+    StatsStorage instances (``UIServer.getInstance().attach(ss)`` pattern)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self.port = port
+        self._storages: List[StatsStorage] = []
+        self.remote = RemoteReceiverModule(router=None, enabled=False)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = UIServer(port)
+        return cls._instance
+
+    # -- storage attachment ---------------------------------------------
+    def attach(self, storage: StatsStorage) -> None:
+        if storage not in self._storages:
+            self._storages.append(storage)
+
+    def detach(self, storage: StatsStorage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    def enable_remote_listener(self, router=None) -> None:
+        """Route POST /remote into the given router (default: first attached
+        storage)."""
+        self.remote.router = router or (self._storages[0] if self._storages else None)
+        self.remote.enabled = self.remote.router is not None
+
+    # -- data assembly ---------------------------------------------------
+    def _sessions(self) -> List[str]:
+        out = []
+        for s in self._storages:
+            out.extend(s.list_session_ids())
+        return sorted(set(out))
+
+    def _overview(self, sid: str) -> dict:
+        updates: List[Persistable] = []
+        for s in self._storages:
+            for wid in s.list_worker_ids_for_session(sid, TYPE_ID):
+                updates.extend(s.get_all_updates_after(sid, TYPE_ID, -1.0, wid))
+        updates.sort(key=lambda p: (p.data.get("iteration", 0), p.timestamp))
+        iterations = [p.data.get("iteration", 0) for p in updates]
+        scores = [p.data.get("score", 0.0) for p in updates]
+        pmm: dict = {}
+        for p in updates:
+            for name, st in (p.data.get("param_stats") or {}).items():
+                pmm.setdefault(name, []).append(st.get("mean_magnitude", 0.0))
+        return {
+            "session": sid,
+            "iterations": iterations,
+            "scores": scores,
+            "param_mean_magnitudes": pmm,
+            "latest": updates[-1].data if updates else None,
+        }
+
+    # -- http -------------------------------------------------------------
+    def start(self) -> int:
+        """Start serving on self.port (0 → ephemeral); returns the bound port."""
+        ui = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path in ("/", "/train", "/train/overview"):
+                    body = _DASHBOARD_HTML.encode("utf-8")
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif path == "/train/sessions":
+                    self._json(ui._sessions())
+                elif path.startswith("/train/overview/"):
+                    sid = path.rsplit("/", 1)[-1]
+                    self._json(ui._overview(sid))
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                path = urlparse(self.path).path
+                if path == "/remote":
+                    n = int(self.headers.get("Content-Length", "0"))
+                    ok = ui.remote.receive(self.rfile.read(n))
+                    self._json({"status": "ok" if ok else "disabled"},
+                               200 if ok else 403)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,146 @@
+"""TrainingMaster tests on the virtual 8-device CPU mesh.
+
+Mirrors the reference's local-mode Spark equivalence strategy
+(TestCompareParameterAveragingSparkVsSingleMachine: distributed result must
+match single-machine SGD)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel import (
+    DistributedMultiLayerNetwork,
+    ParameterAveragingTrainingMaster,
+    SharedTrainingMaster,
+)
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+def _net(seed=7, lr=0.1):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    y_idx = rng.integers(0, 3, n)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    x[np.arange(n), y_idx] += 2.5
+    y = np.eye(3, dtype=np.float32)[y_idx]
+    return DataSet(x, y)
+
+
+class TestParameterAveragingMaster:
+    def test_matches_single_machine_sgd(self):
+        """averaging_frequency=1 + plain SGD: averaging params after one step
+        per worker == one step on the averaged gradient == single-machine
+        step on the full batch (the reference's Spark-vs-local lock)."""
+        ds = _data(64)
+        mesh = make_mesh({"data": 8})
+
+        local = _net(seed=3)
+        local.fit(ds)  # one full-batch step
+
+        dist_net = _net(seed=3)
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, averaging_frequency=1, mesh=mesh)
+        DistributedMultiLayerNetwork(dist_net, master).fit([ds])
+
+        for pl, pd in zip(local.params, dist_net.params):
+            for k in pl:
+                np.testing.assert_allclose(np.asarray(pl[k]), np.asarray(pd[k]),
+                                           rtol=2e-4, atol=2e-5)
+
+    def test_split_sizing_and_training(self):
+        ds = _data(300)
+        mesh = make_mesh({"data": 8})
+        net = _net()
+        master = (ParameterAveragingTrainingMaster.Builder(8)
+                  .averaging_frequency(3).build())
+        master.mesh = mesh
+        master.num_workers = 8
+        front = DistributedMultiLayerNetwork(net, master)
+        front.fit([ds], epochs=10)
+        ev = net.evaluate(ListDataSetIterator(ds, 128))
+        assert ev.accuracy() > 0.85
+        stats = front.get_training_stats().as_dict()
+        assert "fit" in stats and "split" in stats
+
+    def test_worker_divisible_tail_split(self):
+        """96 examples with per_round=64 leaves a 32-example tail that divides
+        the worker count: must train, not crash on stacking mixed shapes."""
+        ds = _data(96)
+        mesh = make_mesh({"data": 8})
+        net = _net()
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, averaging_frequency=5, mesh=mesh)
+        DistributedMultiLayerNetwork(net, master).fit([ds], epochs=2)
+        assert net.iteration > 0
+
+    def test_export_and_replay(self, tmp_path):
+        ds = _data(64)
+        master = ParameterAveragingTrainingMaster(
+            batch_size_per_worker=8, export_directory=str(tmp_path),
+            mesh=make_mesh({"data": 8}))
+        master._repartition([ds])
+        loaded = ParameterAveragingTrainingMaster.load_exported(str(tmp_path))
+        assert loaded and loaded[0].features.shape == (64, 6)
+
+
+class TestSharedTrainingMaster:
+    def test_trains_with_threshold_compression(self):
+        ds = _data(512)
+        mesh = make_mesh({"data": 8})
+        net = _net(lr=0.05)
+        master = SharedTrainingMaster(batch_size_per_worker=16,
+                                      threshold=1e-3, mesh=mesh)
+        front = DistributedMultiLayerNetwork(net, master)
+        it = ListDataSetIterator(ds, 128, shuffle=True, seed=1)
+        front.fit(it, epochs=15)
+        ev = net.evaluate(ListDataSetIterator(ds, 256))
+        assert ev.accuracy() > 0.85
+
+    def test_residual_preserved_between_steps(self):
+        """Gradient mass below the threshold must accumulate in the residual,
+        not vanish (EncodedGradientsAccumulator residual semantics)."""
+        ds = _data(64)
+        mesh = make_mesh({"data": 8})
+        net = _net(lr=0.05)
+        master = SharedTrainingMaster(batch_size_per_worker=8, threshold=1e6,
+                                      mesh=mesh)  # nothing passes threshold
+        p0 = [{k: np.asarray(v).copy() for k, v in layer.items()}
+              for layer in net.params]
+        DistributedMultiLayerNetwork(net, master).fit([ds])
+        # params unchanged (no update passed the threshold)...
+        for pl, pd in zip(p0, net.params):
+            for k in pl:
+                np.testing.assert_allclose(pl[k], np.asarray(pd[k]))
+        # ...but the residual holds the pending update mass
+        total = sum(float(np.abs(np.asarray(r)).sum())
+                    for layer in master._residual for r in layer.values())
+        assert total > 0
+
+    def test_threshold_adapts(self):
+        master = SharedTrainingMaster(batch_size_per_worker=8, threshold=1e-3,
+                                      threshold_step=1e-4, step_delay=0,
+                                      mesh=make_mesh({"data": 8}))
+        t0 = master.threshold
+        master._adapt_threshold(0.0)  # nothing transmitted → decay
+        assert master.threshold < t0
+        t1 = master.threshold
+        master._adapt_threshold(0.5)  # too dense → raise
+        assert master.threshold > t1
+
+    def test_builder(self):
+        m = (SharedTrainingMaster.Builder(32).update_threshold(5e-4)
+             .min_update_threshold(1e-6).build())
+        assert m.batch_size_per_worker == 32
+        assert m.threshold == 5e-4
+        assert m.min_threshold == 1e-6

@@ -1,0 +1,166 @@
+"""Stats/UI subsystem tests: listener → storage → server → remote round trip
+(BaseStatsListener / StatsStorage / PlayUIServer / RemoteReceiverModule
+parity, without a browser)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    Persistable,
+    RemoteUIStatsStorageRouter,
+    StatsListener,
+    StatsStorageEvent,
+    StatsStorageListener,
+    StatsUpdateConfiguration,
+    UIServer,
+)
+from deeplearning4j_tpu.ui.stats import TYPE_ID
+
+
+def _train_with_listener(storage, cfg=None, iters=6):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(5)).build())
+    net = MultiLayerNetwork(conf).init()
+    listener = StatsListener(storage, cfg, session_id="sess1")
+    net.listeners.append(listener)
+    for _ in range(iters):
+        net.fit(DataSet(x, y))
+    return net, listener
+
+
+class TestStorage:
+    def test_in_memory_round_trip(self):
+        ss = InMemoryStatsStorage()
+        p = Persistable("s1", "T", "w0", 1.0, {"score": 0.5})
+        ss.put_update(p)
+        assert ss.list_session_ids() == ["s1"]
+        assert ss.list_type_ids_for_session("s1") == ["T"]
+        assert ss.list_worker_ids_for_session("s1") == ["w0"]
+        assert ss.get_latest_update("s1", "T", "w0").data["score"] == 0.5
+        assert ss.get_num_update_records_for("s1") == 1
+
+    def test_updates_after_and_times(self):
+        ss = InMemoryStatsStorage()
+        for t in (1.0, 2.0, 3.0):
+            ss.put_update(Persistable("s", "T", "w", t, {"t": t}))
+        after = ss.get_all_updates_after("s", "T", 1.5)
+        assert [p.timestamp for p in after] == [2.0, 3.0]
+        assert ss.get_all_update_times("s", "T", "w") == [1.0, 2.0, 3.0]
+
+    def test_listener_events(self):
+        events = []
+
+        class L(StatsStorageListener):
+            def notify(self, e):
+                events.append(e.kind)
+
+        ss = InMemoryStatsStorage()
+        ss.register_stats_storage_listener(L())
+        ss.put_update(Persistable("s", "T", "w", 1.0, {}))
+        assert StatsStorageEvent.NEW_SESSION in events
+        assert StatsStorageEvent.POST_UPDATE in events
+
+    def test_file_storage_reload(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        ss = FileStatsStorage(path)
+        ss.put_static_info(Persistable("s", "T", "w", 1.0, {"info": 1}))
+        ss.put_update(Persistable("s", "T", "w", 2.0, {"score": 0.1}))
+        ss.close()
+        re = FileStatsStorage(path)
+        assert re.get_static_info("s", "T", "w").data == {"info": 1}
+        assert re.get_latest_update("s", "T", "w").data["score"] == 0.1
+        re.close()
+
+
+class TestStatsListener:
+    def test_collects_score_params_lr(self):
+        ss = InMemoryStatsStorage()
+        _train_with_listener(ss)
+        latest = ss.get_latest_update_all_workers("sess1", TYPE_ID)
+        assert latest
+        data = latest[0].data
+        assert data["score"] > 0
+        assert "0_W" in data["param_stats"]
+        stats = data["param_stats"]["0_W"]
+        assert {"mean", "stdev", "mean_magnitude", "norm2"} <= set(stats)
+        assert data["learning_rates"]
+        # static info posted once
+        infos = ss.get_all_static_infos("sess1", TYPE_ID)
+        assert len(infos) == 1 and infos[0].data["n_layers"] == 2
+
+    def test_histograms(self):
+        ss = InMemoryStatsStorage()
+        cfg = StatsUpdateConfiguration(collect_histograms=True,
+                                       histogram_bin_count=10)
+        _train_with_listener(ss, cfg, iters=2)
+        data = ss.get_latest_update_all_workers("sess1", TYPE_ID)[0].data
+        hist = data["param_stats"]["0_W"]["histogram"]
+        assert len(hist["counts"]) == 10
+        assert len(hist["edges"]) == 11
+
+    def test_report_frequency(self):
+        ss = InMemoryStatsStorage()
+        cfg = StatsUpdateConfiguration(report_iterations=3)
+        _train_with_listener(ss, cfg, iters=6)
+        assert ss.get_num_update_records_for("sess1") == 2
+
+
+class TestServer:
+    def test_endpoints_and_remote(self):
+        ss = InMemoryStatsStorage()
+        _train_with_listener(ss, iters=3)
+        server = UIServer(port=0)
+        server.attach(ss)
+        server.enable_remote_listener()
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            html = urllib.request.urlopen(f"{base}/").read().decode()
+            assert "training UI" in html
+            sessions = json.loads(urllib.request.urlopen(
+                f"{base}/train/sessions").read())
+            assert "sess1" in sessions
+            ov = json.loads(urllib.request.urlopen(
+                f"{base}/train/overview/sess1").read())
+            assert len(ov["iterations"]) == 3
+            assert len(ov["scores"]) == 3
+            assert ov["param_mean_magnitudes"]
+            # remote router posts into the same storage
+            router = RemoteUIStatsStorageRouter(base)
+            router.put_update(Persistable("remote-sess", TYPE_ID, "w9", 5.0,
+                                          {"iteration": 1, "score": 0.7}))
+            sessions = json.loads(urllib.request.urlopen(
+                f"{base}/train/sessions").read())
+            assert "remote-sess" in sessions
+        finally:
+            server.stop()
+
+    def test_remote_disabled_403(self):
+        server = UIServer(port=0)
+        port = server.start()
+        try:
+            router = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}",
+                                                retries=1, raise_on_error=True)
+            with pytest.raises(Exception):
+                router.put_update(Persistable("s", "T", "w", 1.0, {}))
+            # default mode drops silently instead of killing the caller
+            quiet = RemoteUIStatsStorageRouter(f"http://127.0.0.1:{port}",
+                                               retries=1)
+            quiet.put_update(Persistable("s", "T", "w", 1.0, {}))
+        finally:
+            server.stop()
