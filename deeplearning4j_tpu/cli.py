@@ -1,0 +1,95 @@
+"""Command-line entry points.
+
+The reference exposes exactly two ``main()``s (SURVEY.md §1): training via
+``ParallelWrapperMain`` (`deeplearning4j-scaleout/.../parallelism/main/ParallelWrapperMain.java`,
+JCommander flags: modelPath, workers, averagingFrequency, prefetchSize,
+modelOutputPath, uiUrl) and serving via ``NearestNeighborsServer``
+(`NearestNeighborsServer.java:3-10`). This module provides both:
+
+- ``python -m deeplearning4j_tpu.cli train ...`` — load a serialized model,
+  train it data-parallel over the mesh, save the result.
+- ``python -m deeplearning4j_tpu.cli nn-server ...`` — serve k-NN queries
+  (delegates to :meth:`NearestNeighborsServer.main`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def parallel_wrapper_main(argv: Optional[List[str]] = None):
+    """ParallelWrapperMain parity: train a saved model over the mesh."""
+    ap = argparse.ArgumentParser("parallel-wrapper-train")
+    ap.add_argument("--modelPath", required=True,
+                    help="model zip written by ModelSerializer")
+    ap.add_argument("--dataPath", required=True,
+                    help=".npz with 'features' and 'labels' arrays")
+    ap.add_argument("--modelOutputPath", required=True)
+    ap.add_argument("--workers", type=int, default=None,
+                    help="mesh data-axis size (default: all devices)")
+    ap.add_argument("--mode", choices=("shared_gradients", "averaging"),
+                    default="shared_gradients")
+    ap.add_argument("--averagingFrequency", type=int, default=5)
+    ap.add_argument("--batchSize", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--prefetchSize", type=int, default=2,
+                    help="async prefetch buffer (AsyncDataSetIterator)")
+    ap.add_argument("--uiUrl", default=None,
+                    help="remote UI /remote endpoint to report stats to")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.util import model_serializer
+
+    net = model_serializer.restore_model(args.modelPath)
+    z = np.load(args.dataPath)
+    ds = DataSet(z["features"], z["labels"])
+    it = ListDataSetIterator(ds, args.batchSize, shuffle=True)
+    if args.prefetchSize > 0:
+        it = AsyncDataSetIterator(it, queue_size=args.prefetchSize)
+    if args.uiUrl:
+        from deeplearning4j_tpu.ui import StatsListener
+        from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
+        net.listeners.append(
+            StatsListener(RemoteUIStatsStorageRouter(args.uiUrl)))
+    mesh = None
+    if args.workers:
+        mesh = make_mesh({"data": args.workers})
+    pw = ParallelWrapper(net, mesh, mode=args.mode,
+                         averaging_frequency=args.averagingFrequency)
+    pw.fit(it, epochs=args.epochs)
+    model_serializer.write_model(net, args.modelOutputPath)
+    return net
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m deeplearning4j_tpu.cli {train,nn-server} ...")
+        return 0 if argv else 2
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "train":
+        parallel_wrapper_main(rest)
+        return 0
+    if cmd == "nn-server":
+        from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
+        server = NearestNeighborsServer.main(rest)
+        print(f"nearest-neighbors server listening on port {server.port}")
+        try:
+            server._thread.join()
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+    print(f"unknown command {cmd!r}; expected 'train' or 'nn-server'")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

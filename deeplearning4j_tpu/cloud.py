@@ -1,0 +1,125 @@
+"""Cloud provisioning & storage: the reference's AWS module, TPU-native.
+
+The reference ships `deeplearning4j-aws` (EC2 provisioning
+`aws/ec2/provision/HostProvisioner.java`, S3 up/download, EMR). The
+TPU-native equivalents are GCP: TPU-VM provisioning through ``gcloud`` and
+object storage through GCS — with S3 kept for capability parity. Everything
+is gated: command builders always work (and are unit-testable); execution
+requires the respective CLI/SDK which this image does not bundle, and a
+``file://`` scheme provides a local emulation path for tests.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+from urllib.parse import urlparse
+
+
+class TpuProvisioner:
+    """Builds (and optionally runs) ``gcloud compute tpus tpu-vm`` commands —
+    the HostProvisioner role for TPU slices."""
+
+    def __init__(self, project: str, zone: str, runner=None):
+        self.project = project
+        self.zone = zone
+        self._runner = runner or self._run
+
+    @staticmethod
+    def _run(cmd: List[str]) -> str:
+        if shutil.which(cmd[0]) is None:
+            raise RuntimeError(
+                f"{cmd[0]!r} CLI not available in this environment; use the "
+                "returned command on a workstation with gcloud installed")
+        return subprocess.run(cmd, check=True, capture_output=True,
+                              text=True).stdout
+
+    def create_command(self, name: str, accelerator_type: str = "v5p-8",
+                       version: str = "tpu-ubuntu2204-base") -> List[str]:
+        return ["gcloud", "compute", "tpus", "tpu-vm", "create", name,
+                f"--project={self.project}", f"--zone={self.zone}",
+                f"--accelerator-type={accelerator_type}",
+                f"--version={version}"]
+
+    def delete_command(self, name: str) -> List[str]:
+        return ["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+                f"--project={self.project}", f"--zone={self.zone}", "--quiet"]
+
+    def ssh_command(self, name: str, command: str,
+                    worker: str = "all") -> List[str]:
+        return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
+                f"--project={self.project}", f"--zone={self.zone}",
+                f"--worker={worker}", f"--command={command}"]
+
+    def create(self, name: str, **kw) -> str:
+        return self._runner(self.create_command(name, **kw))
+
+    def delete(self, name: str) -> str:
+        return self._runner(self.delete_command(name))
+
+    def run_on(self, name: str, command: str, **kw) -> str:
+        return self._runner(self.ssh_command(name, command, **kw))
+
+
+class ObjectStorage:
+    """Upload/download against gs:// (google-cloud-storage), s3://  (boto3),
+    or file:// (always available — the test/emulation path). The reference's
+    S3Uploader/S3Downloader role."""
+
+    def upload(self, local_path: str, uri: str) -> None:
+        scheme, bucket, key = self._parse(uri)
+        if scheme == "file":
+            dest = os.path.join(bucket, key.lstrip("/"))
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            shutil.copyfile(local_path, dest)
+        elif scheme == "gs":
+            client = self._gcs()
+            client.bucket(bucket).blob(key.lstrip("/")).upload_from_filename(
+                local_path)
+        elif scheme == "s3":
+            self._s3().upload_file(local_path, bucket, key.lstrip("/"))
+        else:
+            raise ValueError(f"unsupported scheme {scheme!r}")
+
+    def download(self, uri: str, local_path: str) -> None:
+        scheme, bucket, key = self._parse(uri)
+        if scheme == "file":
+            shutil.copyfile(os.path.join(bucket, key.lstrip("/")), local_path)
+        elif scheme == "gs":
+            client = self._gcs()
+            client.bucket(bucket).blob(key.lstrip("/")).download_to_filename(
+                local_path)
+        elif scheme == "s3":
+            self._s3().download_file(bucket, key.lstrip("/"), local_path)
+        else:
+            raise ValueError(f"unsupported scheme {scheme!r}")
+
+    @staticmethod
+    def _parse(uri: str):
+        p = urlparse(uri)
+        if p.scheme == "file":
+            # file:///tmp/bucket/key → bucket=/tmp/bucket-part? keep it simple:
+            # everything up to the last component is the "bucket" directory
+            full = p.path
+            return "file", os.path.dirname(full), os.path.basename(full)
+        return p.scheme, p.netloc, p.path
+
+    @staticmethod
+    def _gcs():
+        try:
+            from google.cloud import storage
+        except ImportError as e:
+            raise ImportError("google-cloud-storage is not installed; "
+                              "use file:// URIs for local staging") from e
+        return storage.Client()
+
+    @staticmethod
+    def _s3():
+        try:
+            import boto3
+        except ImportError as e:
+            raise ImportError("boto3 is not installed; "
+                              "use file:// URIs for local staging") from e
+        return boto3.client("s3")

@@ -65,17 +65,18 @@ class CollectionRecordReader(RecordReader):
 
 
 class LineRecordReader(RecordReader):
-    """One record per line: ``[line]``."""
+    """One record per line: ``[line]``. Files are read once at construction;
+    ``reset()`` only rewinds."""
 
     def __init__(self, path: Union[str, Sequence[str]]):
         self._paths = _expand_paths(path)
-        self.reset()
-
-    def reset(self):
         self._lines: List[str] = []
         for p in self._paths:
             with open(p, "r", encoding="utf-8") as f:
                 self._lines.extend(ln.rstrip("\n") for ln in f)
+        self.reset()
+
+    def reset(self):
         self._pos = 0
 
     def has_next(self):
@@ -95,9 +96,8 @@ class CSVRecordReader(RecordReader):
         self._paths = _expand_paths(path)
         self.skip_lines = skip_lines
         self.delimiter = delimiter
-        self.reset()
-
-    def reset(self):
+        # parse once; reset() only rewinds (multi-epoch training would
+        # otherwise re-read + re-parse the whole corpus every epoch)
         self._records: List[Record] = []
         for p in self._paths:
             with open(p, "r", encoding="utf-8") as f:
@@ -108,6 +108,9 @@ class CSVRecordReader(RecordReader):
                     if line:
                         self._records.append(
                             [_parse_field(v) for v in line.split(self.delimiter)])
+        self.reset()
+
+    def reset(self):
         self._pos = 0
 
     def has_next(self):

@@ -165,21 +165,34 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         if self.export_directory:
             os.makedirs(self.export_directory, exist_ok=True)
             for i, ds in enumerate(out):
-                np.savez(os.path.join(self.export_directory, f"split{i}.npz"),
-                         features=ds.features, labels=ds.labels)
+                arrays = {"features": np.asarray(ds.features),
+                          "labels": np.asarray(ds.labels)}
+                if ds.features_mask is not None:
+                    arrays["features_mask"] = np.asarray(ds.features_mask)
+                if ds.labels_mask is not None:
+                    arrays["labels_mask"] = np.asarray(ds.labels_mask)
+                # zero-padded index: lexicographic == numeric replay order
+                np.savez(os.path.join(self.export_directory,
+                                      f"split{i:06d}.npz"), **arrays)
         self.stats.add("split", time.perf_counter() - t0)
         return out
 
     @staticmethod
     def load_exported(directory: str) -> List:
-        """Replay a staged export directory (`ExportSupport.java` parity)."""
+        """Replay a staged export directory (`ExportSupport.java` parity) in
+        the original split order."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
 
+        names = [f for f in os.listdir(directory) if f.endswith(".npz")]
+        # numeric sort handles legacy unpadded names too
+        names.sort(key=lambda f: (len(f), f))
         out = []
-        for f in sorted(os.listdir(directory)):
-            if f.endswith(".npz"):
-                z = np.load(os.path.join(directory, f))
-                out.append(DataSet(z["features"], z["labels"]))
+        for f in names:
+            z = np.load(os.path.join(directory, f))
+            out.append(DataSet(
+                z["features"], z["labels"],
+                z["features_mask"] if "features_mask" in z else None,
+                z["labels_mask"] if "labels_mask" in z else None))
         return out
 
     # -- training ----------------------------------------------------------
@@ -227,6 +240,7 @@ class SharedTrainingMaster(TrainingMaster):
         self.num_workers = int(self.mesh.shape[data_axis])
         self.stats = TrainingStats()
         self._step_fn = None
+        self._net_ref = None
         self._residual = None
         self._steps_done = 0
         self._shake_restore: Optional[float] = None
@@ -328,7 +342,9 @@ class SharedTrainingMaster(TrainingMaster):
         if network.params is None:
             network.init()
         dtype = network.conf.global_conf.jnp_dtype()
-        if self._step_fn is None:
+        if self._step_fn is None or self._net_ref is not network:
+            # the compiled worker closes over the network: rebuild on switch
+            self._net_ref = network
             self._step_fn = self._build_step(network)
             # stacked per-worker residuals, sharded over the data axis
             self._residual = jax.tree_util.tree_map(
@@ -338,8 +354,12 @@ class SharedTrainingMaster(TrainingMaster):
         for ds in data_iterator:
             x = np.asarray(ds.features)
             y = np.asarray(ds.labels)
-            if x.shape[0] % self.num_workers:
-                network._fit_batch(ds)  # ragged tail: unsharded fallback
+            if (x.shape[0] % self.num_workers
+                    or ds.features_mask is not None
+                    or ds.labels_mask is not None):
+                # ragged tail or masked sequence data: the sharded step
+                # doesn't carry masks — run unsharded (same math, no DP)
+                network._fit_batch(ds)
                 continue
             it = jnp.asarray(network.iteration, jnp.float32)
             ep = jnp.asarray(network.epoch, jnp.float32)

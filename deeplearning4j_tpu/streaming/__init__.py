@@ -1,0 +1,26 @@
+"""Streaming ingest: pub/sub of arrays and DataSets into training loops.
+
+Re-design of ``deeplearning4j-scaleout/dl4j-streaming`` (Kafka pub/sub of
+NDArrays via `streaming/kafka/NDArrayKafkaClient.java`, Camel route glue, and
+the embedded Kafka/ZooKeeper test cluster
+`streaming/embedded/EmbeddedKafkaCluster.java`): an in-process broker with
+identical topic semantics for tests and single-host pipelines, a TCP
+publisher/consumer pair for cross-process streams, a kafka-python client
+used automatically when the library is installed, and a
+``StreamingDataSetIterator`` that feeds a fit loop from a topic.
+"""
+
+from deeplearning4j_tpu.streaming.codec import (  # noqa: F401
+    deserialize_array,
+    deserialize_dataset,
+    serialize_array,
+    serialize_dataset,
+)
+from deeplearning4j_tpu.streaming.broker import (  # noqa: F401
+    EmbeddedBroker,
+    SocketConsumer,
+    SocketPublisher,
+    StreamingDataSetIterator,
+)
+from deeplearning4j_tpu.streaming.kafka import NDArrayKafkaClient  # noqa: F401
+from deeplearning4j_tpu.streaming.route import Route  # noqa: F401

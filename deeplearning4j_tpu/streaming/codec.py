@@ -1,0 +1,54 @@
+"""Wire format for arrays and DataSets.
+
+Role of the reference's NDArray↔record conversion inside the Kafka/Camel
+routes (`dl4j-streaming/.../streaming/conversion/`): a self-describing binary
+frame — 4-byte big-endian JSON-header length, JSON header (dtype, shape,
+fields), raw C-order array bytes concatenated.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional
+
+import numpy as np
+
+
+def serialize_array(arr) -> bytes:
+    a = np.ascontiguousarray(np.asarray(arr))
+    header = json.dumps({"dtype": str(a.dtype), "shape": list(a.shape)}).encode()
+    return struct.pack(">I", len(header)) + header + a.tobytes()
+
+
+def deserialize_array(data: bytes) -> np.ndarray:
+    hlen = struct.unpack(">I", data[:4])[0]
+    header = json.loads(data[4:4 + hlen].decode())
+    a = np.frombuffer(data[4 + hlen:], dtype=np.dtype(header["dtype"]))
+    return a.reshape(header["shape"]).copy()
+
+
+def serialize_dataset(ds) -> bytes:
+    parts = {"features": np.asarray(ds.features), "labels": np.asarray(ds.labels)}
+    if ds.features_mask is not None:
+        parts["features_mask"] = np.asarray(ds.features_mask)
+    if ds.labels_mask is not None:
+        parts["labels_mask"] = np.asarray(ds.labels_mask)
+    blobs = {k: serialize_array(v) for k, v in parts.items()}
+    header = json.dumps({k: len(v) for k, v in blobs.items()}).encode()
+    return (struct.pack(">I", len(header)) + header
+            + b"".join(blobs[k] for k in sorted(blobs)))
+
+
+def deserialize_dataset(data: bytes):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    hlen = struct.unpack(">I", data[:4])[0]
+    sizes = json.loads(data[4:4 + hlen].decode())
+    arrays = {}
+    off = 4 + hlen
+    for k in sorted(sizes):
+        arrays[k] = deserialize_array(data[off:off + sizes[k]])
+        off += sizes[k]
+    return DataSet(arrays["features"], arrays["labels"],
+                   arrays.get("features_mask"), arrays.get("labels_mask"))

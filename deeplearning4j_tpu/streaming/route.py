@@ -1,0 +1,78 @@
+"""Route builder: source → transforms → sink pipelines.
+
+The role of the reference's Camel routes (`dl4j-streaming/.../routes/`,
+e.g. CSV → NDArray → Kafka): a small fluent pipeline that pulls from a
+source, applies transforms, and pushes into a broker topic / socket / list,
+optionally on a background thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+
+class Route:
+    """``Route().from_source(it).transform(f).to_topic(broker, "t").start()``"""
+
+    def __init__(self):
+        self._source: Optional[Iterable] = None
+        self._transforms: List[Callable[[Any], Any]] = []
+        self._sink: Optional[Callable[[Any], None]] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def from_source(self, iterable: Iterable) -> "Route":
+        self._source = iterable
+        return self
+
+    def transform(self, fn: Callable[[Any], Any]) -> "Route":
+        self._transforms.append(("map", fn))
+        return self
+
+    def filter(self, predicate: Callable[[Any], bool]) -> "Route":
+        self._transforms.append(("filter", predicate))
+        return self
+
+    def to_topic(self, broker, topic: str,
+                 serializer: Optional[Callable[[Any], bytes]] = None) -> "Route":
+        def sink(item):
+            broker.publish(topic, serializer(item) if serializer else item)
+        self._sink = sink
+        return self
+
+    def to_callable(self, fn: Callable[[Any], None]) -> "Route":
+        self._sink = fn
+        return self
+
+    def to_list(self, out: List[Any]) -> "Route":
+        self._sink = out.append
+        return self
+
+    def run(self) -> int:
+        """Drain the source synchronously; returns items delivered."""
+        if self._source is None or self._sink is None:
+            raise ValueError("route needs from_source(...) and a to_*(...) sink")
+        n = 0
+        for item in self._source:
+            dropped = False
+            for kind, fn in self._transforms:
+                if kind == "map":
+                    item = fn(item)
+                elif not fn(item):  # filter
+                    dropped = True
+                    break
+            if dropped:
+                continue
+            self._sink(item)
+            n += 1
+        return n
+
+    def start(self) -> "Route":
+        """Run on a background thread (Camel's async route start)."""
+        self._thread = threading.Thread(target=self.run, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -192,7 +192,11 @@ class UIServer:
                 path = urlparse(self.path).path
                 if path == "/remote":
                     n = int(self.headers.get("Content-Length", "0"))
-                    ok = ui.remote.receive(self.rfile.read(n))
+                    try:
+                        ok = ui.remote.receive(self.rfile.read(n))
+                    except (KeyError, ValueError, UnicodeDecodeError) as e:
+                        self._json({"error": str(e)}, 400)
+                        return
                     self._json({"status": "ok" if ok else "disabled"},
                                200 if ok else 403)
                 else:

@@ -1,0 +1,270 @@
+"""Tests for the NN REST server + CLI, language-pack tokenizers, streaming,
+and cloud tooling (reference modules: nearestneighbor-server/-client,
+ParallelWrapperMain, nlp-chinese/-japanese/-korean/-uima, dl4j-streaming,
+deeplearning4j-aws)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering.server import (
+    NearestNeighborsClient,
+    NearestNeighborsServer,
+)
+
+
+class TestNearestNeighborsServer:
+    @pytest.fixture
+    def corpus(self, rng):
+        return rng.normal(size=(50, 8)).astype(np.float32)
+
+    def test_knn_by_index_and_vector(self, corpus):
+        server = NearestNeighborsServer(corpus, port=0)
+        port = server.start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{port}")
+            res = client.knn(3, 5)
+            assert len(res["results"]) == 5
+            assert all(r["index"] != 3 for r in res["results"])  # self excluded
+            res2 = client.knn_new(corpus[3].tolist(), 1)
+            assert res2["results"][0]["index"] == 3  # itself is nearest
+            assert res2["results"][0]["distance"] < 1e-4
+        finally:
+            server.stop()
+
+    def test_labels_and_errors(self, corpus):
+        labels = [f"item{i}" for i in range(50)]
+        server = NearestNeighborsServer(corpus, labels=labels, port=0)
+        port = server.start()
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{port}")
+            res = client.knn(0, 2)
+            assert len(res["labels"]) == 2
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError):
+                client.knn(999, 2)  # out of range → 400
+        finally:
+            server.stop()
+
+    def test_invert_returns_farthest(self, corpus):
+        server = NearestNeighborsServer(corpus, invert=True, port=0)
+        q = corpus[0]
+        far = server.query(q, 3)
+        near = NearestNeighborsServer(corpus, port=0).query(q, 3)
+        assert far[0].distance > near[0].distance
+
+    def test_cli_main(self, tmp_path, corpus):
+        npy = tmp_path / "points.npy"
+        np.save(npy, corpus)
+        labels_file = tmp_path / "labels.txt"
+        labels_file.write_text("\n".join(f"l{i}" for i in range(50)))
+        server = NearestNeighborsServer.main(
+            ["--ndarrayPath", str(npy), "--labelsPath", str(labels_file),
+             "--nearestNeighborsPort", "0"])
+        try:
+            client = NearestNeighborsClient(f"http://127.0.0.1:{server.port}")
+            assert len(client.knn(1, 3)["results"]) == 3
+        finally:
+            server.stop()
+
+
+class TestTrainCli:
+    def test_train_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.cli import parallel_wrapper_main
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util import model_serializer
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        model_in = str(tmp_path / "model.zip")
+        model_out = str(tmp_path / "trained.zip")
+        model_serializer.write_model(net, model_in)
+        rng = np.random.default_rng(0)
+        y_idx = rng.integers(0, 2, 128)
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        x[np.arange(128), y_idx] += 2.0
+        np.savez(tmp_path / "data.npz", features=x,
+                 labels=np.eye(2, dtype=np.float32)[y_idx])
+        trained = parallel_wrapper_main([
+            "--modelPath", model_in, "--dataPath", str(tmp_path / "data.npz"),
+            "--modelOutputPath", model_out, "--epochs", "5",
+            "--batchSize", "32", "--workers", "8"])
+        assert os.path.exists(model_out)
+        assert trained.iteration > 0
+
+
+class TestLanguagePacks:
+    def test_chinese_char_fallback(self):
+        from deeplearning4j_tpu.nlp.language_packs import ChineseTokenizerFactory
+        toks = ChineseTokenizerFactory().create("我爱北京天安门").get_tokens()
+        assert toks == ["我", "爱", "北", "京", "天", "安", "门"]
+
+    def test_chinese_dictionary_matching(self):
+        from deeplearning4j_tpu.nlp.language_packs import ChineseTokenizerFactory
+        f = ChineseTokenizerFactory(dictionary=["北京", "天安门"])
+        assert f.create("我爱北京天安门").get_tokens() == \
+            ["我", "爱", "北京", "天安门"]
+
+    def test_chinese_mixed_scripts(self):
+        from deeplearning4j_tpu.nlp.language_packs import ChineseTokenizerFactory
+        toks = ChineseTokenizerFactory().create("我用GPU训练 123").get_tokens()
+        assert "GPU" in toks and "123" in toks
+
+    def test_japanese_script_transitions(self):
+        from deeplearning4j_tpu.nlp.language_packs import JapaneseTokenizerFactory
+        toks = JapaneseTokenizerFactory().create("私はラーメンが好き").get_tokens()
+        # kanji / hiragana / katakana runs separated
+        assert "ラーメン" in toks
+        assert "私" in toks
+
+    def test_japanese_dictionary(self):
+        from deeplearning4j_tpu.nlp.language_packs import JapaneseTokenizerFactory
+        f = JapaneseTokenizerFactory(dictionary=["東京", "大学"])
+        assert "東京" in f.create("東京大学").get_tokens()
+
+    def test_korean_josa_stripping(self):
+        from deeplearning4j_tpu.nlp.language_packs import KoreanTokenizerFactory
+        plain = KoreanTokenizerFactory().create("나는 학교에 간다").get_tokens()
+        assert plain == ["나는", "학교에", "간다"]
+        stripped = KoreanTokenizerFactory(strip_josa=True).create(
+            "나는 학교에 간다").get_tokens()
+        assert "나" in stripped and "학교" in stripped
+
+    def test_uima_sentence_pipeline(self):
+        from deeplearning4j_tpu.nlp.language_packs import UimaTokenizerFactory
+        f = UimaTokenizerFactory()
+        sents = f.segment_sentences("First one. Second here! Third?")
+        assert len(sents) == 3
+        toks = f.create("Hello world. Bye now.").get_tokens()
+        assert toks == ["Hello", "world.", "Bye", "now."]
+
+    def test_works_with_word2vec(self):
+        from deeplearning4j_tpu.nlp.language_packs import ChineseTokenizerFactory
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        sentences = ["我爱学习", "学习很好"] * 20
+        w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1,
+                       tokenizer_factory=ChineseTokenizerFactory())
+        w2v.fit(sentences)
+        assert w2v.has_word("学") or w2v.has_word("学习")
+
+
+class TestStreaming:
+    def test_array_codec_round_trip(self, rng):
+        from deeplearning4j_tpu.streaming import deserialize_array, serialize_array
+        a = rng.normal(size=(3, 4, 5)).astype(np.float32)
+        np.testing.assert_array_equal(deserialize_array(serialize_array(a)), a)
+
+    def test_dataset_codec_with_masks(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.streaming import (
+            deserialize_dataset, serialize_dataset)
+        ds = DataSet(rng.normal(size=(4, 3, 2)).astype(np.float32),
+                     rng.normal(size=(4, 3, 2)).astype(np.float32),
+                     np.ones((4, 3), np.float32), None)
+        rt = deserialize_dataset(serialize_dataset(ds))
+        np.testing.assert_array_equal(rt.features, ds.features)
+        np.testing.assert_array_equal(rt.features_mask, ds.features_mask)
+        assert rt.labels_mask is None
+
+    def test_embedded_broker_groups(self):
+        from deeplearning4j_tpu.streaming import EmbeddedBroker
+        b = EmbeddedBroker()
+        b.subscribe("t", "g1")
+        b.subscribe("t", "g2")
+        b.publish("t", b"msg")
+        assert b.poll("t", "g1", timeout=1) == b"msg"
+        assert b.poll("t", "g2", timeout=1) == b"msg"
+        assert b.poll("t", "g1", timeout=0.01) is None
+
+    def test_socket_transport(self):
+        from deeplearning4j_tpu.streaming import SocketConsumer, SocketPublisher
+        consumer = SocketConsumer()
+        pub = SocketPublisher("127.0.0.1", consumer.port)
+        try:
+            pub.publish(b"hello")
+            pub.publish(b"world")
+            assert consumer.poll(timeout=5) == b"hello"
+            assert consumer.poll(timeout=5) == b"world"
+        finally:
+            pub.close()
+            consumer.close()
+
+    def test_kafka_client_embedded_fallback(self, rng):
+        from deeplearning4j_tpu.streaming import NDArrayKafkaClient
+        client = NDArrayKafkaClient()
+        a = rng.normal(size=(2, 2)).astype(np.float32)
+        client.publish(a)
+        np.testing.assert_array_equal(client.poll(timeout=1), a)
+
+    def test_route_and_streaming_iterator_training(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.streaming import (
+            EmbeddedBroker, Route, StreamingDataSetIterator, serialize_dataset)
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        broker = EmbeddedBroker()
+        broker.subscribe("train")
+        batches = []
+        for _ in range(4):
+            x = rng.normal(size=(16, 4)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+            batches.append(DataSet(x, y))
+        n = (Route().from_source(batches)
+             .transform(serialize_dataset)
+             .to_topic(broker, "train").run())
+        assert n == 4
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        it = StreamingDataSetIterator(broker, "train", num_batches=4,
+                                      poll_timeout=0.5)
+        net.fit(it)
+        assert net.iteration == 4
+
+    def test_route_filter(self):
+        from deeplearning4j_tpu.streaming import Route
+        out = []
+        n = (Route().from_source(range(10)).filter(lambda x: x % 2 == 0)
+             .transform(lambda x: x * 10).to_list(out).run())
+        assert n == 5 and out == [0, 20, 40, 60, 80]
+
+
+class TestCloud:
+    def test_gcloud_command_builders(self):
+        from deeplearning4j_tpu.cloud import TpuProvisioner
+        p = TpuProvisioner("my-project", "us-central2-b")
+        cmd = p.create_command("pod1", accelerator_type="v5p-32")
+        assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+        assert "--accelerator-type=v5p-32" in cmd
+        assert "--zone=us-central2-b" in p.delete_command("pod1")
+        ssh = p.ssh_command("pod1", "hostname")
+        assert "--command=hostname" in ssh
+
+    def test_provisioner_runner_injection(self):
+        from deeplearning4j_tpu.cloud import TpuProvisioner
+        calls = []
+        p = TpuProvisioner("p", "z", runner=lambda cmd: calls.append(cmd) or "ok")
+        assert p.create("n") == "ok"
+        assert calls and calls[0][4] == "create"
+
+    def test_file_storage_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.cloud import ObjectStorage
+        src = tmp_path / "in.txt"
+        src.write_text("payload")
+        store = ObjectStorage()
+        uri = f"file://{tmp_path}/staged/out.txt"
+        store.upload(str(src), uri)
+        dest = tmp_path / "back.txt"
+        store.download(uri, str(dest))
+        assert dest.read_text() == "payload"
