@@ -205,6 +205,20 @@ class TinyImageNetDataSetIterator(_CachedNpyIterator):
                          n_classes=n_classes, shuffle=shuffle, seed=seed)
 
 
+class LFWDataSetIterator(_CachedNpyIterator):
+    """LFW faces (LFWDataSetIterator / LFWDataFetcher): cache-or-synthetic.
+    The reference serves 250x250x3 faces over 5749 identities with a
+    configurable subset; here image side and label count are parameters and
+    the cache layout is ``lfw/{train,test}_{x,y}.npy``."""
+
+    def __init__(self, batch_size: int, train: bool = True, *, shuffle=True,
+                 seed: int = 123, n_classes: int = 10, image_size: int = 64):
+        super().__init__(batch_size, dir_name="lfw",
+                         split="train" if train else "test",
+                         n_synth=512 if train else 128, hw=image_size,
+                         n_classes=n_classes, shuffle=shuffle, seed=seed)
+
+
 class SvhnDataSetIterator(_CachedNpyIterator):
     """SVHN (SvhnDataFetcher): 32x32x3 digits, same cache-or-synthetic policy."""
 

@@ -240,3 +240,151 @@ class INDArrayDataSetIterator(DataSetIterator):
                 buf_f, buf_l, count = [], [], 0
         if buf_f:
             yield DataSet(np.concatenate(buf_f), np.concatenate(buf_l))
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wrap an existing iterable of DataSets (ExistingDataSetIterator.java):
+    exposes the DataSetIterator surface over a plain list/generator factory."""
+
+    def __init__(self, iterable, total: Optional[int] = None):
+        self._factory = iterable if callable(iterable) else None
+        self._items = None if callable(iterable) else list(iterable)
+        self.total = total
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        source = self._factory() if self._factory is not None else self._items
+        for i, ds in enumerate(source):
+            if self.total is not None and i >= self.total:
+                return
+            yield ds
+
+
+class ViewIterator(DataSetIterator):
+    """Batched view over one DataSet without copying the whole array up
+    front (ViewIterator.java)."""
+
+    def __init__(self, data: DataSet, batch_size: int):
+        self.data = data
+        self.batch_size = batch_size
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        n = self.data.num_examples()
+        f = np.asarray(self.data.features)
+        l = np.asarray(self.data.labels)
+        fm = None if self.data.features_mask is None else np.asarray(self.data.features_mask)
+        lm = None if self.data.labels_mask is None else np.asarray(self.data.labels_mask)
+        for s in range(0, n, self.batch_size):
+            e = s + self.batch_size
+            yield DataSet(f[s:e], l[s:e],
+                          None if fm is None else fm[s:e],
+                          None if lm is None else lm[s:e])
+
+
+class FileSplitDataSetIterator(DataSetIterator):
+    """Stream serialized DataSets from files in a directory
+    (FileSplitDataSetIterator.java). Files are ``.npz`` archives with
+    features/labels(/masks) — what ParameterAveragingTrainingMaster's export
+    staging writes; an optional callback runs per loaded DataSet."""
+
+    def __init__(self, directory: str, pattern: str = "*.npz",
+                 callback=None):
+        import glob as _glob
+        import os as _os
+        self.files = sorted(_glob.glob(_os.path.join(directory, pattern)))
+        self.callback = callback
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for path in self.files:
+            z = np.load(path)
+            ds = DataSet(z["features"], z["labels"],
+                         z["features_mask"] if "features_mask" in z else None,
+                         z["labels_mask"] if "labels_mask" in z else None)
+            if self.callback is not None:
+                self.callback.call(ds)
+            yield ds
+
+
+class DataSetCallback:
+    """Per-DataSet hook (datasets/iterator/callbacks/DataSetCallback.java)."""
+
+    def call(self, ds: DataSet) -> None:  # pragma: no cover - interface
+        pass
+
+
+class DefaultCallback(DataSetCallback):
+    """Moves each DataSet's arrays onto the accelerator ahead of the compute
+    thread (the reference's DefaultCallback touches arrays so device-side
+    prefetch happens off the training thread; here that's a device_put)."""
+
+    def call(self, ds: DataSet) -> None:
+        import jax
+        ds.features = jax.device_put(np.asarray(ds.features))
+        ds.labels = jax.device_put(np.asarray(ds.labels))
+
+
+class AsyncShieldDataSetIterator(DataSetIterator):
+    """Pass-through wrapper that blocks async prefetch wrapping
+    (AsyncShieldDataSetIterator.java). In the reference this guards ND4J
+    workspace-scoped arrays from being detached by the async thread; the
+    jax runtime has no workspace scoping, so the semantic content is simply
+    "do not wrap me in AsyncDataSetIterator" — honored via
+    ``async_supported``."""
+
+    async_supported = False
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def reset(self) -> None:
+        self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        return iter(self.base)
+
+
+class AsyncShieldMultiDataSetIterator(AsyncShieldDataSetIterator):
+    """MultiDataSet variant (AsyncShieldMultiDataSetIterator.java)."""
+
+
+class EarlyTerminationMultiDataSetIterator(EarlyTerminationDataSetIterator):
+    """MultiDataSet variant (EarlyTerminationMultiDataSetIterator.java) —
+    identical truncation logic over MultiDataSet-yielding iterators."""
+
+
+class JointParallelDataSetIterator(DataSetIterator):
+    """Interleave several source iterators round-robin
+    (datasets/iterator/parallel/JointParallelDataSetIterator.java with
+    InequalityHandling.STOP_EVERYONE / PASS_NULL → here stop-on-first-
+    exhausted or drain-remaining)."""
+
+    def __init__(self, *iterators: DataSetIterator,
+                 stop_on_first_exhausted: bool = True):
+        self.iterators = list(iterators)
+        self.stop_on_first_exhausted = stop_on_first_exhausted
+
+    def reset(self) -> None:
+        for it in self.iterators:
+            it.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        its = [iter(i) for i in self.iterators]
+        active = [True] * len(its)
+        while any(active):
+            for k, it in enumerate(its):
+                if not active[k]:
+                    continue
+                try:
+                    yield next(it)
+                except StopIteration:
+                    active[k] = False
+                    if self.stop_on_first_exhausted:
+                        return

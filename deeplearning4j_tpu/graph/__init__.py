@@ -29,4 +29,5 @@ from deeplearning4j_tpu.graph.deepwalk import (  # noqa: F401
     InMemoryGraphLookupTable,
 )
 from deeplearning4j_tpu.graph.loader import GraphLoader  # noqa: F401
+from deeplearning4j_tpu.graph.node2vec import Node2Vec  # noqa: F401
 from deeplearning4j_tpu.graph.serializer import GraphVectorSerializer  # noqa: F401

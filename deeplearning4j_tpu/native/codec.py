@@ -1,0 +1,65 @@
+"""Python face of the native threshold codec, with numpy fallback.
+
+Same selection/sign semantics as the on-device codec in
+:mod:`deeplearning4j_tpu.parallel.compression`, packed as signed 1-based
+indices (one int32 per element) — the reference's
+``thresholdEncode/thresholdDecode`` message layout for the host/DCN wire.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _as_f32(a) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(a, dtype=np.float32).reshape(-1))
+
+
+def encode_threshold(residual, threshold: float,
+                     capacity: Optional[int] = None) -> Optional[np.ndarray]:
+    """Encode: returns int32 signed-index message, or None if more than
+    ``capacity`` elements pass the threshold (caller sends dense)."""
+    from deeplearning4j_tpu import native as _n
+
+    flat = _as_f32(residual)
+    cap = len(flat) if capacity is None else int(capacity)
+    lib = _n._load()
+    if lib is not None:
+        out = np.empty(cap, dtype=np.int32)
+        count = lib.threshold_encode(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), len(flat),
+            ctypes.c_float(threshold),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), cap)
+        if count < 0:
+            return None
+        return out[:count].copy()
+    # numpy fallback
+    idx = np.nonzero(np.abs(flat) >= threshold)[0]
+    if len(idx) > cap:
+        return None
+    return ((idx + 1) * np.sign(flat[idx])).astype(np.int32)
+
+
+def decode_threshold(message: np.ndarray, threshold: float, size: int,
+                     out: Optional[np.ndarray] = None) -> np.ndarray:
+    """Apply a message additively onto a dense float32 vector of ``size``."""
+    from deeplearning4j_tpu import native as _n
+
+    if out is None:
+        out = np.zeros(size, dtype=np.float32)
+    else:
+        out = np.ascontiguousarray(out, dtype=np.float32)
+    msg = np.ascontiguousarray(message, dtype=np.int32)
+    lib = _n._load()
+    if lib is not None:
+        lib.threshold_decode(
+            msg.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(msg),
+            ctypes.c_float(threshold),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), size)
+        return out
+    idx = np.abs(msg) - 1
+    np.add.at(out, idx, np.sign(msg).astype(np.float32) * threshold)
+    return out

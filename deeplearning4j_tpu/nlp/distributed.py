@@ -1,0 +1,216 @@
+"""Distributed NLP: text pipeline + mesh-sharded Word2Vec.
+
+Capability parity with ``dl4j-spark-nlp`` (`TextPipeline.java` — tokenize,
+count words with accumulators, build vocab/Huffman on the driver;
+`Word2VecPerformer.java` — per-partition skip-gram updates): the corpus is
+processed in shards (counting composes by dict-merge, exactly the Spark
+accumulator pattern), and the skip-gram negative-sampling update for each
+global batch of pairs runs sharded over the mesh 'data' axis — every device
+computes dense gradient contributions for its pair shard and one ``psum``
+combines them (replacing the reference's parameter-averaged per-partition
+training with an *exactly* synchronous update).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.nlp.learning import generate_sg_pairs
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    TokenizerFactory,
+)
+from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
+
+
+class TextPipeline:
+    """Sharded tokenize-and-count (`TextPipeline.java` role)."""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 num_shards: int = 4):
+        self.tf = tokenizer_factory or DefaultTokenizerFactory(CommonPreprocessor())
+        self.num_shards = max(1, num_shards)
+
+    def tokenize(self, sentences: Sequence[str]) -> List[List[str]]:
+        return [self.tf.create(s).get_tokens() for s in sentences]
+
+    @staticmethod
+    def _count_shard(token_lists: Sequence[List[str]]) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for toks in token_lists:
+            for t in toks:
+                counts[t] = counts.get(t, 0) + 1
+        return counts
+
+    def word_counts(self, sentences: Sequence[str]) -> Dict[str, int]:
+        """Shard → count → merge (the Spark accumulator pattern; shard counts
+        are independent so this parallelises across processes/hosts)."""
+        tokened = self.tokenize(sentences)
+        shards = [tokened[i::self.num_shards] for i in range(self.num_shards)]
+        total: Dict[str, int] = {}
+        for shard in shards:
+            for w, c in self._count_shard(shard).items():
+                total[w] = total.get(w, 0) + c
+        return total
+
+
+class DistributedWord2Vec:
+    """Skip-gram negative-sampling Word2Vec whose per-batch update is sharded
+    over the mesh data axis.
+
+    Each device gets a shard of the (center, context) pairs, computes the
+    dense syn0/syn1neg gradient contribution by scatter-add into zeros, and a
+    ``psum`` merges them — numerically identical to single-device training on
+    the whole batch, scaled across ICI. (A table-sharded variant partitions
+    rows instead when the vocab outgrows HBM replication.)
+    """
+
+    def __init__(self, layer_size: int = 100, window: int = 5,
+                 negative: int = 5, learning_rate: float = 0.025,
+                 min_word_frequency: int = 1, seed: int = 12345,
+                 mesh: Optional[Mesh] = None, data_axis: str = DATA_AXIS,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.layer_size = layer_size
+        self.window = window
+        self.negative = negative
+        self.learning_rate = learning_rate
+        self.min_word_frequency = min_word_frequency
+        self.seed = seed
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.data_axis = data_axis
+        self.n_workers = int(self.mesh.shape[data_axis])
+        self.pipeline = TextPipeline(tokenizer_factory,
+                                     num_shards=self.n_workers)
+        self.vocab: Dict[str, int] = {}
+        self.index2word: List[str] = []
+        self.syn0 = None
+        self.syn1neg = None
+        self._step = None
+        self._unigram = None
+
+    # -- vocab ------------------------------------------------------------
+    def build_vocab(self, sentences: Sequence[str]) -> None:
+        counts = self.pipeline.word_counts(sentences)
+        vocab = sorted(
+            ((w, c) for w, c in counts.items() if c >= self.min_word_frequency),
+            key=lambda wc: (-wc[1], wc[0]))
+        self.index2word = [w for w, _ in vocab]
+        self.vocab = {w: i for i, w in enumerate(self.index2word)}
+        self._counts = np.array([c for _, c in vocab], np.float64)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(self.seed))
+        n, d = len(self.index2word), self.layer_size
+        self.syn0 = (jax.random.uniform(k1, (max(n, 1), d)) - 0.5) / d
+        self.syn1neg = jnp.zeros((max(n, 1), d))
+        # unigram^0.75 negative-sampling table (word2vec convention)
+        probs = self._counts ** 0.75
+        probs /= probs.sum() if probs.sum() > 0 else 1.0
+        self._unigram = probs
+
+    # -- sharded step ------------------------------------------------------
+    def _build_step(self):
+        daxis = self.data_axis
+        nw = self.n_workers
+
+        def worker(syn0, syn1neg, centers, targets, labels, valid, lr):
+            # centers [B/nw], targets/labels/valid [B/nw, 1+neg]
+            h = syn0[centers]                             # [b, D]
+            ctx = syn1neg[targets]                        # [b, K, D]
+            dots = jnp.einsum("bkd,bd->bk", ctx, h)
+            g = (jax.nn.sigmoid(dots) - labels) * valid   # [b, K]
+            gh = jnp.einsum("bk,bkd->bd", g, ctx)         # d/dh
+            gctx = g[..., None] * h[:, None, :]           # d/dctx
+            d_syn0 = jnp.zeros_like(syn0).at[centers].add(-lr * gh)
+            d_syn1 = jnp.zeros_like(syn1neg).at[targets].add(-lr * gctx)
+            d_syn0 = jax.lax.psum(d_syn0, daxis)
+            d_syn1 = jax.lax.psum(d_syn1, daxis)
+            return syn0 + d_syn0, syn1neg + d_syn1
+
+        rep = P()
+        shard0 = P(self.data_axis)
+        mapped = shard_map(worker, mesh=self.mesh,
+                           in_specs=(rep, rep, shard0, shard0, shard0, shard0,
+                                     rep),
+                           out_specs=(rep, rep))
+        return jax.jit(mapped, donate_argnums=(0, 1))
+
+    def fit(self, sentences: Sequence[str], epochs: int = 1,
+            batch_pairs: int = 8192) -> "DistributedWord2Vec":
+        if not self.vocab:
+            self.build_vocab(sentences)
+        if self._step is None:
+            self._step = self._build_step()
+        rng = np.random.default_rng(self.seed)
+        tokened = self.pipeline.tokenize(sentences)
+        encoded = [np.array([self.vocab[t] for t in toks if t in self.vocab],
+                            np.int32) for toks in tokened]
+        n_vocab = len(self.index2word)
+        cum = np.cumsum(self._unigram)
+        for _ in range(epochs):
+            centers_all, ctx_all = [], []
+            for seq in encoded:
+                if len(seq) < 2:
+                    continue
+                c, x = generate_sg_pairs(seq, self.window, rng)
+                centers_all.append(c)
+                ctx_all.append(x)
+            if not centers_all:
+                return self
+            centers = np.concatenate(centers_all).astype(np.int32)
+            contexts = np.concatenate(ctx_all).astype(np.int32)
+            perm = rng.permutation(len(centers))
+            centers, contexts = centers[perm], contexts[perm]
+            # fixed-size chunks: the tail is padded with valid=0 rows so the
+            # update math and the RNG stream are identical for ANY worker
+            # count (distributed == single-device, bit-for-bit modulo psum
+            # reduction order)
+            step_rows = max(self.n_workers,
+                            batch_pairs - batch_pairs % self.n_workers)
+            for s in range(0, len(centers), step_rows):
+                c = centers[s:s + step_rows]
+                x = contexts[s:s + step_rows]
+                real = len(c)
+                if real < step_rows:
+                    pad = step_rows - real
+                    c = np.concatenate([c, np.zeros(pad, np.int32)])
+                    x = np.concatenate([x, np.zeros(pad, np.int32)])
+                negs = np.searchsorted(
+                    cum, rng.random((len(c), self.negative))).astype(np.int32)
+                negs = np.minimum(negs, n_vocab - 1)
+                targets = np.concatenate([x[:, None], negs], axis=1)
+                labels = np.zeros_like(targets, np.float32)
+                labels[:, 0] = 1.0
+                valid = np.ones_like(labels)
+                valid[:, 1:] = (negs != x[:, None]).astype(np.float32)
+                valid[real:] = 0.0
+                self.syn0, self.syn1neg = self._step(
+                    self.syn0, self.syn1neg, jnp.asarray(c),
+                    jnp.asarray(targets), jnp.asarray(labels),
+                    jnp.asarray(valid), jnp.float32(self.learning_rate))
+        return self
+
+    # -- queries -----------------------------------------------------------
+    def has_word(self, w: str) -> bool:
+        return w in self.vocab
+
+    def get_word_vector(self, w: str) -> np.ndarray:
+        return np.asarray(self.syn0[self.vocab[w]])
+
+    def similarity(self, a: str, b: str) -> float:
+        va = self.syn0[self.vocab[a]]
+        vb = self.syn0[self.vocab[b]]
+        return float(jnp.dot(va, vb)
+                     / (jnp.linalg.norm(va) * jnp.linalg.norm(vb) + 1e-12))
+
+    def words_nearest(self, w: str, top: int = 10) -> List[str]:
+        v = self.syn0[self.vocab[w]]
+        norms = jnp.linalg.norm(self.syn0, axis=1) * (jnp.linalg.norm(v) + 1e-12)
+        sims = (self.syn0 @ v) / jnp.maximum(norms, 1e-12)
+        sims = sims.at[self.vocab[w]].set(-jnp.inf)
+        _, idx = jax.lax.top_k(sims, min(top, len(self.index2word) - 1))
+        return [self.index2word[int(i)] for i in np.asarray(idx)]

@@ -1,0 +1,166 @@
+"""Tests for iterator completions, LFW fetcher, node2vec, and the
+mesh-sharded distributed Word2Vec (dl4j-spark-nlp role)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.datasets.iterators import (
+    AsyncShieldDataSetIterator,
+    DefaultCallback,
+    ExistingDataSetIterator,
+    FileSplitDataSetIterator,
+    JointParallelDataSetIterator,
+    ViewIterator,
+)
+
+
+def _ds(n=10, f=3, seed=0):
+    rng = np.random.default_rng(seed)
+    return DataSet(rng.normal(size=(n, f)).astype(np.float32),
+                   rng.normal(size=(n, 2)).astype(np.float32))
+
+
+class TestIteratorCompletions:
+    def test_existing_iterator(self):
+        batches = [_ds(4), _ds(4), _ds(4)]
+        it = ExistingDataSetIterator(batches, total=2)
+        assert len(list(it)) == 2
+        assert len(list(it)) == 2  # re-iterable
+
+    def test_view_iterator_masks(self):
+        ds = DataSet(np.zeros((10, 4, 3), np.float32),
+                     np.zeros((10, 4, 2), np.float32),
+                     np.ones((10, 4), np.float32), None)
+        parts = list(ViewIterator(ds, 4))
+        assert [p.features.shape[0] for p in parts] == [4, 4, 2]
+        assert parts[0].features_mask.shape == (4, 4)
+
+    def test_file_split_iterator_with_callback(self, tmp_path):
+        for i in range(3):
+            d = _ds(6, seed=i)
+            np.savez(tmp_path / f"part{i}.npz", features=d.features,
+                     labels=d.labels)
+        seen = []
+
+        class Cb:
+            def call(self, ds):
+                seen.append(ds.features.shape)
+
+        out = list(FileSplitDataSetIterator(str(tmp_path), callback=Cb()))
+        assert len(out) == 3 and len(seen) == 3
+
+    def test_default_callback_moves_to_device(self):
+        import jax
+        ds = _ds(4)
+        DefaultCallback().call(ds)
+        assert isinstance(ds.features, jax.Array)
+
+    def test_async_shield_passthrough(self):
+        base = ListDataSetIterator(_ds(8), 4)
+        shield = AsyncShieldDataSetIterator(base)
+        assert shield.async_supported is False
+        assert len(list(shield)) == 2
+
+    def test_joint_parallel_round_robin(self):
+        a = ListDataSetIterator(_ds(8, seed=1), 4)
+        b = ListDataSetIterator(_ds(4, seed=2), 4)
+        out = list(JointParallelDataSetIterator(a, b,
+                                                stop_on_first_exhausted=False))
+        assert len(out) == 3  # a,b,a
+        out2 = list(JointParallelDataSetIterator(a, b))
+        assert len(out2) == 3  # a,b,a then b exhausted → stop
+
+    def test_lfw_fetcher(self):
+        from deeplearning4j_tpu.datasets.fetchers import LFWDataSetIterator
+        it = LFWDataSetIterator(16, n_classes=5, image_size=32)
+        b = next(iter(it))
+        assert b.features.shape == (16, 32, 32, 3)
+        assert b.labels.shape == (16, 5)
+
+
+class TestNode2Vec:
+    def _barbell(self):
+        from deeplearning4j_tpu.graph import Graph
+        g = Graph(10)
+        for c in (0, 5):
+            for i in range(c, c + 5):
+                for j in range(i + 1, c + 5):
+                    g.add_edge(i, j)
+        g.add_edge(4, 5)
+        return g
+
+    def test_biased_walks_valid(self):
+        from deeplearning4j_tpu.graph import Node2Vec
+        g = self._barbell()
+        nv = Node2Vec(vector_size=8, p=0.5, q=2.0, walks_per_vertex=3, seed=4)
+        walks = nv.generate_walks(g, 8, np.random.default_rng(0))
+        assert walks.shape == (30, 9)
+        for w in walks[:10]:
+            for a, b in zip(w, w[1:]):
+                assert b in set(g.get_connected_vertex_indices(a)) or a == b
+
+    def test_p_bias_controls_backtracking(self):
+        from deeplearning4j_tpu.graph import Graph, Node2Vec
+        g = self._barbell()
+
+        def backtrack_rate(p):
+            nv = Node2Vec(p=p, q=1.0, walks_per_vertex=20, seed=7)
+            walks = nv.generate_walks(g, 10, np.random.default_rng(1))
+            back = total = 0
+            for w in walks:
+                for t in range(2, len(w)):
+                    total += 1
+                    back += int(w[t] == w[t - 2])
+            return back / total
+
+        assert backtrack_rate(0.05) > backtrack_rate(20.0)
+
+    def test_clusters_embed_separately(self):
+        from deeplearning4j_tpu.graph import Node2Vec
+        g = self._barbell()
+        nv = Node2Vec(vector_size=16, window_size=2, learning_rate=0.05,
+                      seed=11, walks_per_vertex=8)
+        nv.fit(g, walk_length=10, epochs=15)
+        intra = np.mean([nv.similarity(0, j) for j in range(1, 5)])
+        inter = np.mean([nv.similarity(0, j) for j in range(5, 10)])
+        assert intra > inter
+
+
+class TestDistributedWord2Vec:
+    CORPUS = (["the quick brown fox jumps over the lazy dog",
+               "the dog sleeps while the fox runs",
+               "quick brown animals jump high",
+               "lazy dogs sleep all day"] * 10)
+
+    def test_text_pipeline_counts(self):
+        from deeplearning4j_tpu.nlp.distributed import TextPipeline
+        counts = TextPipeline(num_shards=3).word_counts(self.CORPUS)
+        assert counts["the"] == 40
+        assert counts["fox"] == 20
+
+    def test_mesh_training_learns(self):
+        from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+        w2v = DistributedWord2Vec(layer_size=16, window=3, negative=4,
+                                  learning_rate=0.05, seed=5,
+                                  mesh=make_mesh({"data": 8}))
+        w2v.fit(self.CORPUS, epochs=10)
+        assert w2v.has_word("fox") and w2v.has_word("dog")
+        assert isinstance(w2v.words_nearest("fox", 3), list)
+        # co-occurring words more similar than non-co-occurring rare pair
+        assert w2v.similarity("quick", "brown") > w2v.similarity("quick", "day")
+
+    def test_matches_single_worker(self):
+        """Sharded psum update == single-device update (same seed/batches)."""
+        from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        def train(n_dev):
+            w2v = DistributedWord2Vec(layer_size=8, window=2, negative=2,
+                                      seed=3, mesh=make_mesh({"data": n_dev}))
+            w2v.fit(self.CORPUS[:20], epochs=2, batch_pairs=64)
+            return np.asarray(w2v.syn0)
+
+        a, b = train(1), train(8)
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-5)

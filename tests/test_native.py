@@ -1,0 +1,154 @@
+"""Native C++ runtime tests: threshold codec and prefetching data loader
+(libnd4j thresholdEncode/Decode + native ETL roles, SURVEY.md §2.a)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.native import (
+    NativeDataSetIterator,
+    decode_threshold,
+    encode_threshold,
+    native_available,
+)
+
+
+class TestBuild:
+    def test_native_library_builds(self):
+        # g++ is part of the baked toolchain: the native path must be live
+        assert native_available()
+
+
+class TestThresholdCodec:
+    def test_round_trip(self, rng):
+        r = rng.normal(0, 1e-3, size=2048).astype(np.float32)
+        thr = 1e-3
+        msg = encode_threshold(r, thr)
+        assert msg is not None
+        dense = decode_threshold(msg, thr, len(r))
+        expect = np.where(np.abs(r) >= thr, np.sign(r) * thr, 0.0).astype(np.float32)
+        np.testing.assert_allclose(dense, expect, atol=1e-7)
+
+    def test_capacity_exceeded_returns_none(self, rng):
+        r = np.ones(100, np.float32)
+        assert encode_threshold(r, 0.5, capacity=10) is None
+
+    def test_matches_numpy_fallback(self, rng):
+        from deeplearning4j_tpu import native as n
+        r = rng.normal(0, 2e-3, size=4096).astype(np.float32)
+        thr = 1.5e-3
+        native_msg = encode_threshold(r, thr)
+        lib, n._lib = n._lib, None
+        failed, n._build_failed = n._build_failed, True
+        try:
+            py_msg = encode_threshold(r, thr)
+        finally:
+            n._lib, n._build_failed = lib, failed
+        np.testing.assert_array_equal(native_msg, py_msg)
+
+    def test_decode_additive(self):
+        msg = np.array([1, -3], np.int32)  # +thr at 0, -thr at 2
+        base = np.array([1.0, 1.0, 1.0], np.float32)
+        out = decode_threshold(msg, 0.5, 3, out=base)
+        np.testing.assert_allclose(out, [1.5, 1.0, 0.5])
+
+    def test_agrees_with_jax_compression_module(self, rng):
+        """Native codec and the on-device codec must select the same elements
+        with the same signs."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.parallel.compression import threshold_encode
+        r = rng.normal(0, 2e-3, size=512).astype(np.float32)
+        thr = 2e-3
+        native_msg = encode_threshold(r, thr, capacity=512)
+        enc, _ = threshold_encode(jnp.asarray(r), thr, capacity=512)
+        cnt = int(enc.count)
+        jax_signed = ((np.asarray(enc.indices)[:cnt] + 1)
+                      * np.asarray(enc.signs)[:cnt].astype(np.int32))
+        np.testing.assert_array_equal(np.sort(native_msg), np.sort(jax_signed))
+
+
+class TestNativeLoader:
+    def test_mem_loader_covers_all_examples(self, rng):
+        x = rng.normal(size=(100, 7)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 100)]
+        it = NativeDataSetIterator(x, y, batch_size=16, shuffle=False)
+        rows = [ds.features for ds in it]
+        assert [r.shape[0] for r in rows] == [16, 16, 16, 16, 16, 16, 4]
+        np.testing.assert_allclose(np.concatenate(rows), x, rtol=1e-6)
+
+    def test_shuffle_and_reset_reshuffles(self, rng):
+        x = np.arange(64, dtype=np.float32).reshape(64, 1)
+        y = np.zeros((64, 1), np.float32)
+        it = NativeDataSetIterator(x, y, batch_size=64, shuffle=True, seed=9)
+        first = next(iter(it)).features.ravel().copy()
+        it.reset()
+        second = next(iter(it)).features.ravel().copy()
+        assert sorted(first) == sorted(second) == list(range(64))
+        assert not np.array_equal(first, second)  # new epoch, new order
+        assert not np.array_equal(first, np.arange(64))
+
+    def test_drop_last(self, rng):
+        x = rng.normal(size=(50, 3)).astype(np.float32)
+        y = rng.normal(size=(50, 2)).astype(np.float32)
+        it = NativeDataSetIterator(x, y, batch_size=16, drop_last=True)
+        assert [ds.features.shape[0] for ds in it] == [16, 16, 16]
+
+    def test_multiple_epochs(self, rng):
+        x = rng.normal(size=(40, 3)).astype(np.float32)
+        y = rng.normal(size=(40, 2)).astype(np.float32)
+        it = NativeDataSetIterator(x, y, batch_size=10, shuffle=True, seed=1)
+        for _ in range(3):
+            assert sum(ds.features.shape[0] for ds in it) == 40
+            it.reset()
+
+    @pytest.fixture
+    def idx_files(self, tmp_path, rng):
+        n, rows, cols = 30, 4, 4
+        images = rng.integers(0, 256, size=(n, rows, cols), dtype=np.uint8)
+        labels = rng.integers(0, 3, size=n, dtype=np.uint8)
+        ip = tmp_path / "images.idx"
+        with open(ip, "wb") as f:
+            f.write(np.array([0x803, n, rows, cols], ">u4").tobytes())
+            f.write(images.tobytes())
+        lp = tmp_path / "labels.idx"
+        with open(lp, "wb") as f:
+            f.write(np.array([0x801, n], ">u4").tobytes())
+            f.write(labels.tobytes())
+        return str(ip), str(lp), images, labels
+
+    def test_idx_loader(self, idx_files):
+        ip, lp, images, labels = idx_files
+        it = NativeDataSetIterator(images_path=ip, labels_path=lp,
+                                   n_classes=3, batch_size=10)
+        batches = list(it)
+        assert sum(b.features.shape[0] for b in batches) == 30
+        b0 = batches[0]
+        assert b0.features.shape == (10, 4, 4, 1)  # inferred square shape
+        np.testing.assert_allclose(
+            b0.features[0].ravel(), images[0].ravel() / 255.0, atol=1e-6)
+        assert np.argmax(b0.labels[0]) == labels[0]
+
+    def test_idx_bad_file_raises(self, tmp_path):
+        bad = tmp_path / "bad.idx"
+        bad.write_bytes(b"\x00" * 20)
+        with pytest.raises(ValueError):
+            NativeDataSetIterator(images_path=str(bad), labels_path=str(bad),
+                                  n_classes=3)
+
+    def test_trains_network(self, rng):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        y_idx = rng.integers(0, 2, 256)
+        x = rng.normal(size=(256, 5)).astype(np.float32)
+        x[np.arange(256), y_idx] += 2.0
+        y = np.eye(2, dtype=np.float32)[y_idx]
+        it = NativeDataSetIterator(x, y, batch_size=64, shuffle=True, seed=3)
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(it, epochs=10)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.9
