@@ -1,10 +1,14 @@
 """Benchmark driver — prints ONE JSON line with the headline metric.
 
-Round-1 headline: LeNet-MNIST training throughput (images/sec) on one chip,
-measured with the PerformanceListener methodology
-(`PerformanceListener.java:87-88` samples/sec). The reference publishes no
-numbers (BASELINE.md), so ``vs_baseline`` is the ratio against the first
-value this framework recorded (stored below), or 1.0 until one exists.
+Headline: **ResNet50 ImageNet-shape training throughput (images/sec) on one
+chip** — the tracked metric in BASELINE.json ("zoo ResNet50 images/sec/chip").
+Training step = full forward/backward/update on 224x224x3 synthetic batches
+via the zoo ResNet50 graph, mixed precision (f32 master weights, bfloat16
+compute — the TPU-idiomatic configuration; the reference has no published
+number to compare against, BASELINE.md "published: {}").
+
+``vs_baseline`` is the ratio against the first value this framework recorded
+on the target hardware (below), or 1.0 until one exists.
 """
 
 import json
@@ -12,46 +16,51 @@ import time
 
 import numpy as np
 
-# First recorded value for this benchmark on the target hardware (updated as
-# the framework improves; BASELINE.md "published" is empty in the reference).
-BASELINE_IMAGES_PER_SEC = None  # set after first TPU run
+# First recorded value on the round-1 bench hardware (TPU v5e lite, batch 256,
+# mixed bf16/f32; matches BASELINE.md). Update when the framework improves.
+BASELINE_IMAGES_PER_SEC = 2035.4
 
 
 def main():
-    from __graft_entry__ import _lenet
-    from deeplearning4j_tpu.datasets.dataset import DataSet
-
     import jax
-
-    batch = 512
-    steps = 30
-    warmup = 5
-
     import jax.numpy as jnp
 
-    net = _lenet()
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.models import ResNet50
+
+    batch = 256
+    steps = 10
+    warmup = 3
+
+    conf = ResNet50(num_labels=1000, seed=1).conf()
+    conf.global_conf.compute_dtype = "bfloat16"
+    net = ComputationGraph(conf)
+    net.init()
+
     rng = np.random.default_rng(0)
-    x = rng.normal(size=(batch, 28, 28, 1)).astype(np.float32)
-    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)]
-    ds = DataSet(jnp.asarray(x), jnp.asarray(y))  # place on device once
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)).astype(np.float32))
+    y = jnp.asarray(
+        np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, size=batch)])
+    ds = DataSet(x, y)  # resident on device for the whole run
 
     for _ in range(warmup):
         net._fit_batch(ds)
-    jax.block_until_ready(net.params)
+    jax.block_until_ready(jax.tree_util.tree_leaves(net.params)[0])
 
     t0 = time.perf_counter()
     for _ in range(steps):
         net._fit_batch(ds)
-    jax.block_until_ready(net.params)
+    jax.block_until_ready(jax.tree_util.tree_leaves(net.params)[0])
     dt = time.perf_counter() - t0
 
     ips = batch * steps / dt
     vs = ips / BASELINE_IMAGES_PER_SEC if BASELINE_IMAGES_PER_SEC else 1.0
     print(json.dumps({
-        "metric": "lenet_mnist_train_throughput",
+        "metric": "resnet50_train_throughput_per_chip",
         "value": round(ips, 1),
         "unit": "images/sec",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(vs, 4),
     }))
 
 

@@ -98,6 +98,8 @@ def _load() -> Optional[ctypes.CDLL]:
             getattr(lib, name).argtypes = [c_void]
         lib.loader_reset.restype = None
         lib.loader_reset.argtypes = [c_void]
+        lib.loader_rewind.restype = None
+        lib.loader_rewind.argtypes = [c_void]
         lib.loader_destroy.restype = None
         lib.loader_destroy.argtypes = [c_void]
         _lib = lib
@@ -109,7 +111,9 @@ def native_available() -> bool:
 
 
 from deeplearning4j_tpu.native.codec import (  # noqa: E402,F401
-    encode_threshold,
+    count_threshold,
     decode_threshold,
+    encode_threshold,
+    extract_threshold,
 )
 from deeplearning4j_tpu.native.loader import NativeDataSetIterator  # noqa: E402,F401

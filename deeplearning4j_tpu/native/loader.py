@@ -97,7 +97,10 @@ class NativeDataSetIterator(DataSetIterator):
                     xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                     ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
                 if got == 0:
-                    self._lib.loader_reset(self._handle)
+                    # re-arm the SAME epoch so re-iterating without reset()
+                    # yields the same order (Python-fallback semantics);
+                    # reset() is what advances the shuffle epoch
+                    self._lib.loader_rewind(self._handle)
                     return
                 yield self._emit(xbuf[:got].copy(), ybuf[:got].copy())
         else:
@@ -123,18 +126,18 @@ class NativeDataSetIterator(DataSetIterator):
 
 
 def _parse_idx(images_path: str, labels_path: str, n_classes: int):
-    with open(images_path, "rb") as f:
-        header = np.frombuffer(f.read(16), dtype=">u4")
-        if header[0] != 0x803:
-            raise ValueError(f"Bad IDX image magic in {images_path}")
-        n, rows, cols = int(header[1]), int(header[2]), int(header[3])
-        x = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
-        x = x.reshape(n, rows * cols).astype(np.float32) / 255.0
-    with open(labels_path, "rb") as f:
-        header = np.frombuffer(f.read(8), dtype=">u4")
-        if header[0] != 0x801:
-            raise ValueError(f"Bad IDX label magic in {labels_path}")
-        lab = np.frombuffer(f.read(int(header[1])), dtype=np.uint8)
+    # shares the general IDX parser with the dataset fetchers
+    from pathlib import Path
+
+    from deeplearning4j_tpu.datasets.fetchers import _read_idx
+
+    imgs = _read_idx(Path(images_path))
+    if imgs.ndim != 3:
+        raise ValueError(f"Expected rank-3 IDX image file, got {images_path}")
+    lab = _read_idx(Path(labels_path))
+    if lab.ndim != 1 or len(lab) != len(imgs):
+        raise ValueError(f"Bad IDX label file {labels_path}")
+    x = imgs.reshape(len(imgs), -1).astype(np.float32) / 255.0
     y = np.zeros((len(lab), n_classes), np.float32)
     y[np.arange(len(lab)), lab] = 1.0
     return x, y

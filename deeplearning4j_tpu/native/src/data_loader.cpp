@@ -16,9 +16,9 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <map>
 #include <memory>
 #include <mutex>
-#include <queue>
 #include <random>
 #include <thread>
 #include <vector>
@@ -47,8 +47,9 @@ struct Loader {
     std::shared_ptr<const std::vector<long>> order;
     long epoch = 0;
 
-    // ring of ready batches
-    std::queue<Batch> ready;
+    // ready batches keyed by batch index: the consumer pops strictly in
+    // claim order so multi-threaded assembly cannot reorder delivery
+    std::map<long, Batch> ready;
     size_t prefetch = 2;
     std::mutex mu;
     std::condition_variable cv_ready, cv_space;
@@ -108,9 +109,9 @@ struct Loader {
             {
                 std::unique_lock<std::mutex> lk(mu);
                 if (my_epoch == epoch)  // drop stale batches after reset()
-                    ready.push(std::move(out));
+                    ready.emplace(b, std::move(out));
             }
-            cv_ready.notify_one();
+            cv_ready.notify_all();
         }
     }
 
@@ -118,10 +119,13 @@ struct Loader {
     long next(float* x_out, float* y_out) {
         std::unique_lock<std::mutex> lk(mu);
         if (consumed >= total_batches) return 0;
-        cv_ready.wait(lk, [this] { return stopping.load() || !ready.empty(); });
+        cv_ready.wait(lk, [this] {
+            return stopping.load() || ready.count(consumed) != 0;
+        });
         if (stopping.load()) return 0;
-        Batch b = std::move(ready.front());
-        ready.pop();
+        auto it = ready.find(consumed);
+        Batch b = std::move(it->second);
+        ready.erase(it);
         ++consumed;
         lk.unlock();
         cv_space.notify_all();
@@ -130,11 +134,11 @@ struct Loader {
         return b.count;
     }
 
-    void reset() {
+    void reset(bool bump_epoch) {
         std::unique_lock<std::mutex> lk(mu);
-        // drain whatever the workers queued for the old epoch
-        while (!ready.empty()) ready.pop();
-        ++epoch;
+        // drop whatever the workers queued for the old epoch
+        ready.clear();
+        if (bump_epoch) ++epoch;
         reset_epoch();
         lk.unlock();
         cv_space.notify_all();
@@ -245,7 +249,12 @@ long loader_next(void* h, float* x_out, float* y_out) {
     return static_cast<Loader*>(h)->next(x_out, y_out);
 }
 
-void loader_reset(void* h) { static_cast<Loader*>(h)->reset(); }
+// advance to the next epoch (fresh shuffle order)
+void loader_reset(void* h) { static_cast<Loader*>(h)->reset(true); }
+
+// re-arm the SAME epoch (identical order) — used when iteration restarts
+// without an explicit reset, matching the Python fallback's semantics
+void loader_rewind(void* h) { static_cast<Loader*>(h)->reset(false); }
 
 long loader_num_examples(void* h) { return static_cast<Loader*>(h)->n; }
 

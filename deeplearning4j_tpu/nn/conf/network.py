@@ -42,12 +42,22 @@ class GlobalConf:
     gradient_normalization_threshold: float = 1.0
     mini_batch: bool = True
     dtype: str = "float32"
+    # mixed precision: params/updater state stay in `dtype`; forward/backward
+    # compute is cast to this (e.g. "bfloat16" → MXU fast path, f32 master
+    # weights). None = single-precision throughout.
+    compute_dtype: Optional[str] = None
     optimization_algo: str = "stochastic_gradient_descent"
     max_num_line_search_iterations: int = 5
 
     def jnp_dtype(self):
         return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                 "float16": jnp.float16, "float64": jnp.float64}[self.dtype]
+
+    def jnp_compute_dtype(self):
+        if self.compute_dtype is None:
+            return None
+        return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                "float16": jnp.float16}[self.compute_dtype]
 
 
 class NeuralNetConfiguration:
@@ -122,6 +132,12 @@ class Builder:
 
     def dtype(self, dt: str) -> "Builder":
         self._g.dtype = dt
+        return self
+
+    def compute_dtype(self, dt: Optional[str]) -> "Builder":
+        """Mixed precision: cast forward/backward compute to ``dt`` while
+        params and updater state stay in ``dtype`` (master weights)."""
+        self._g.compute_dtype = dt
         return self
 
     def mini_batch(self, b: bool) -> "Builder":

@@ -115,6 +115,14 @@ class ComputationGraph:
         layer forward (useful for output()).
         """
         conf = self.conf
+        cd = conf.global_conf.jnp_compute_dtype()
+        if cd is not None:
+            # mixed precision: f32 master params, compute-dtype forward
+            cast = lambda a: (a.astype(cd)
+                              if hasattr(a, "dtype")
+                              and jnp.issubdtype(a.dtype, jnp.floating) else a)
+            params = jax.tree_util.tree_map(cast, params)
+            inputs = {k: cast(v) for k, v in inputs.items()}
         acts: Dict[str, Array] = dict(inputs)
         m: Dict[str, Optional[Array]] = dict(masks or {})
         for name in conf.inputs:
@@ -183,6 +191,9 @@ class ComputationGraph:
             if not (vd.is_layer and layer.has_loss()):
                 raise ValueError(f"output vertex {out_name!r} is not a loss layer")
             h = acts[out_name + ":in"]
+            if self.conf.global_conf.compute_dtype is not None:
+                # loss head in f32 for stable softmax/log under mixed precision
+                h = h.astype(jnp.float32)
             lm = None
             if label_masks is not None and label_masks[oi] is not None:
                 lm = label_masks[oi]

@@ -113,6 +113,15 @@ class MultiLayerNetwork:
                      ) -> Tuple[Array, States, Optional[List[Any]]]:
         """Run layers [0, upto); returns (activation, new_states, new_carries)."""
         n_layers = len(self.layers) if upto is None else upto
+        cd = self.conf.global_conf.jnp_compute_dtype()
+        if cd is not None:
+            # mixed precision: cast f32 master params + input to the compute
+            # dtype; jax.grad through the cast yields master-dtype gradients
+            cast = lambda a: (a.astype(cd)
+                              if hasattr(a, "dtype")
+                              and jnp.issubdtype(a.dtype, jnp.floating) else a)
+            params = jax.tree_util.tree_map(cast, params)
+            x = cast(x)
         h = x
         new_states: States = []
         new_carries: List[Any] = []
@@ -168,6 +177,9 @@ class MultiLayerNetwork:
             upto=len(self.layers) - 1)
         if (len(self.layers) - 1) in self.conf.preprocessors:
             h = self.conf.preprocessors[len(self.layers) - 1](h)
+        if self.conf.global_conf.compute_dtype is not None:
+            # loss head in f32 for stable softmax/log under mixed precision
+            h = h.astype(jnp.float32)
         lm = label_mask if label_mask is not None else (mask if h.ndim == 3 else None)
         loss = out_layer.compute_loss(params[-1], h, y, mask=lm)
         loss = loss + self._regularization(params)

@@ -1,0 +1,74 @@
+"""Mixed-precision (f32 master weights, bf16 compute) tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def _data(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    y_idx = rng.integers(0, 3, n)
+    x = rng.normal(size=(n, 10)).astype(np.float32)
+    x[np.arange(n), y_idx] += 2.5
+    return DataSet(x, np.eye(3, dtype=np.float32)[y_idx])
+
+
+def _conf(compute_dtype):
+    return (NeuralNetConfiguration.builder().seed(1)
+            .compute_dtype(compute_dtype).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(10)).build())
+
+
+class TestMixedPrecision:
+    def test_params_stay_f32_and_training_works(self):
+        net = MultiLayerNetwork(_conf("bfloat16")).init()
+        ds = _data()
+        net.fit(ListDataSetIterator(ds, 128, shuffle=True), epochs=8)
+        # master weights keep the storage dtype
+        assert net.params[0]["W"].dtype == jnp.float32
+        ev = net.evaluate(ListDataSetIterator(ds, 256))
+        assert ev.accuracy() > 0.9
+
+    def test_forward_activation_is_compute_dtype(self):
+        net = MultiLayerNetwork(_conf("bfloat16")).init()
+        x = jnp.zeros((4, 10), jnp.float32)
+        h, _, _ = net._forward_all(net.params, net.states, x, train=False,
+                                   rng=None, mask=None)
+        assert h.dtype == jnp.bfloat16
+
+    def test_matches_f32_training_approximately(self):
+        ds = _data(256, seed=3)
+
+        def train(cd):
+            net = MultiLayerNetwork(_conf(cd)).init()
+            net.fit(ListDataSetIterator(ds, 128, shuffle=True, seed=5), epochs=5)
+            return net
+
+        f32 = train(None)
+        mixed = train("bfloat16")
+        # same data/seed: losses land in the same regime
+        assert abs(float(f32.score_) - float(mixed.score_)) < 0.15
+
+    def test_graph_mixed_precision(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        g = (NeuralNetConfiguration.builder().seed(1)
+             .compute_dtype("bfloat16").graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.feed_forward(10)))
+        g.add_layer("d", DenseLayer(n_out=16, activation="relu"), "in")
+        g.add_layer("out", OutputLayer(n_out=3), "d")
+        conf = g.set_outputs("out").build()
+        net = ComputationGraph(conf)
+        net.init()
+        ds = _data(256)
+        net.fit(ListDataSetIterator(ds, 128), epochs=5)
+        first = next(iter(net.params.values()))
+        assert first["W"].dtype == jnp.float32
+        assert float(net.score_) < 1.2

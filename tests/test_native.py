@@ -50,6 +50,31 @@ class TestThresholdCodec:
         base = np.array([1.0, 1.0, 1.0], np.float32)
         out = decode_threshold(msg, 0.5, 3, out=base)
         np.testing.assert_allclose(out, [1.5, 1.0, 0.5])
+        assert out is base  # true in-place application
+
+    def test_decode_rejects_noncontiguous_out(self):
+        msg = np.array([1], np.int32)
+        with pytest.raises(ValueError):
+            decode_threshold(msg, 0.5, 2,
+                             out=np.zeros(4, np.float32)[::2])
+        with pytest.raises(ValueError):
+            decode_threshold(msg, 0.5, 2, out=np.zeros(2, np.float64))
+
+    def test_extract_and_count(self, rng):
+        from deeplearning4j_tpu.native import count_threshold, extract_threshold
+        r = rng.normal(0, 2e-3, size=1024).astype(np.float32)
+        thr = 2e-3
+        expected = int(np.sum(np.abs(r) >= thr))
+        assert count_threshold(r, thr) == expected
+        msg = encode_threshold(r, thr)
+        before = r.copy()
+        extract_threshold(r, thr, msg)
+        # extracted residual has the quantized mass removed
+        np.testing.assert_allclose(
+            r, before - decode_threshold(msg, thr, len(r)), atol=1e-7)
+        # every encoded element lost exactly one ±threshold quantum
+        idx = np.abs(msg) - 1
+        np.testing.assert_allclose(np.abs(before[idx] - r[idx]), thr, atol=1e-7)
 
     def test_agrees_with_jax_compression_module(self, rng):
         """Native codec and the on-device codec must select the same elements
