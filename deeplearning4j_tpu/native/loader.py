@@ -91,18 +91,21 @@ class NativeDataSetIterator(DataSetIterator):
         if self._handle is not None:
             xbuf = np.empty((self.batch_size, self._x_elems), np.float32)
             ybuf = np.empty((self.batch_size, self._y_elems), np.float32)
-            while True:
-                got = self._lib.loader_next(
-                    self._handle,
-                    xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                    ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-                if got == 0:
-                    # re-arm the SAME epoch so re-iterating without reset()
-                    # yields the same order (Python-fallback semantics);
-                    # reset() is what advances the shuffle epoch
-                    self._lib.loader_rewind(self._handle)
-                    return
-                yield self._emit(xbuf[:got].copy(), ybuf[:got].copy())
+            try:
+                while True:
+                    got = self._lib.loader_next(
+                        self._handle,
+                        xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                        ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                    if got == 0:
+                        return
+                    yield self._emit(xbuf[:got].copy(), ybuf[:got].copy())
+            finally:
+                # runs on exhaustion AND on abandoned generators: re-arm the
+                # SAME epoch so every fresh iter() starts from batch 0 with
+                # the same order (Python-fallback semantics); reset() is what
+                # advances the shuffle epoch
+                self._lib.loader_rewind(self._handle)
         else:
             order = np.arange(self._n)
             if self._shuffle:

@@ -43,11 +43,14 @@ class ParagraphVectors(SequenceVectors):
         self.labels: List[str] = []
 
     def _to_docs(self, documents) -> List[Tuple[List[str], List[str]]]:
-        """→ [(tokens, labels)]"""
+        """→ [(tokens, labels)]. Plain strings are auto-labelled DOC_i (the
+        reference's behaviour for unlabelled sentence iterators)."""
         out = []
-        for d in documents:
+        for i, d in enumerate(documents):
             if isinstance(d, LabelledDocument):
                 content, labels = d.content, d.labels
+            elif isinstance(d, str):
+                content, labels = d, [f"DOC_{i}"]
             else:
                 content, labels = d
             if isinstance(content, str):

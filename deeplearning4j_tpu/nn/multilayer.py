@@ -454,11 +454,13 @@ class MultiLayerNetwork:
         self.params = new_params
 
     def clone(self) -> "MultiLayerNetwork":
-        import copy
+        # jnp.array COPIES the buffers: the original's donating train step
+        # must not be able to invalidate the clone's arrays
+        copy_arr = lambda a: jnp.array(a) if hasattr(a, "dtype") else a
         other = MultiLayerNetwork(self.conf)
-        other.params = jax.tree_util.tree_map(lambda a: a, self.params)
-        other.states = jax.tree_util.tree_map(lambda a: a, self.states)
-        other.updater_states = jax.tree_util.tree_map(lambda a: a, self.updater_states)
+        other.params = jax.tree_util.tree_map(copy_arr, self.params)
+        other.states = jax.tree_util.tree_map(copy_arr, self.states)
+        other.updater_states = jax.tree_util.tree_map(copy_arr, self.updater_states)
         other._updaters = self._updaters
         other.iteration = self.iteration
         other.epoch = self.epoch

@@ -1,0 +1,56 @@
+"""Logistic regression + autoencoder anomaly detection.
+
+Mirrors tutorials "03. Logistic Regression" and "05. Basic Autoencoder —
+anomaly detection using reconstruction error".
+
+Run: python examples/03_logistic_regression_and_autoencoder.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import AutoEncoderLayer, DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def logistic_regression():
+    rng = np.random.default_rng(0)
+    y = rng.integers(0, 2, 400)
+    x = rng.normal(size=(400, 4)).astype(np.float32) + y[:, None] * 1.5
+    ds = DataSet(x, np.eye(2, dtype=np.float32)[y])
+    # logistic regression == a single softmax output layer
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05)).list()
+            .layer(OutputLayer(n_out=2, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ListDataSetIterator(ds, 64, shuffle=True), epochs=15)
+    print("logistic regression accuracy:",
+          net.evaluate(ListDataSetIterator(ds, 256)).accuracy())
+
+
+def autoencoder_anomaly():
+    rng = np.random.default_rng(1)
+    normal = rng.normal(0, 0.5, size=(500, 16)).astype(np.float32)
+    anomalies = rng.uniform(-4, 4, size=(25, 16)).astype(np.float32)
+    ds = DataSet(normal, normal)  # reconstruct the input
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3)).list()
+            .layer(AutoEncoderLayer(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=16, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(ListDataSetIterator(ds, 64, shuffle=True), epochs=30)
+
+    def recon_error(batch):
+        out = np.asarray(net.output(batch))
+        return np.mean((out - batch) ** 2, axis=1)
+
+    print("mean reconstruction error — normal: %.4f, anomalies: %.4f"
+          % (recon_error(normal).mean(), recon_error(anomalies).mean()))
+
+
+if __name__ == "__main__":
+    logistic_regression()
+    autoencoder_anomaly()

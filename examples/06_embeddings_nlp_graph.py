@@ -1,0 +1,64 @@
+"""Embeddings: Word2Vec, ParagraphVectors, DeepWalk/node2vec, t-SNE.
+
+Mirrors the NLP and graph tutorials: train word vectors, infer a document
+vector, embed a graph's vertices, project with t-SNE.
+
+Run: python examples/06_embeddings_nlp_graph.py
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.graph import DeepWalk, Graph, Node2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+CORPUS = ["the quick brown fox jumps over the lazy dog",
+          "the dog sleeps while the quick fox runs",
+          "foxes and dogs are animals",
+          "cats chase the lazy dog sometimes",
+          "the brown fox likes the brown dog"] * 8
+
+
+def word2vec():
+    w2v = Word2Vec(layer_size=24, window_size=3, min_word_frequency=2,
+                   epochs=5, seed=1)
+    w2v.fit(CORPUS)
+    print("w2v nearest('fox'):", w2v.words_nearest("fox", 3))
+
+
+def paragraph_vectors():
+    pv = ParagraphVectors(layer_size=16, window_size=3, epochs=5, seed=2,
+                          min_word_frequency=1)
+    pv.fit(CORPUS)
+    vec = pv.infer_vector("the quick fox")
+    print("inferred doc vector:", vec.shape, "norm %.3f" % np.linalg.norm(vec))
+
+
+def graph_embeddings():
+    g = Graph(10)
+    for c in (0, 5):
+        for i in range(c, c + 5):
+            for j in range(i + 1, c + 5):
+                g.add_edge(i, j)
+    g.add_edge(4, 5)  # bridge between the two cliques
+    dw = DeepWalk(vector_size=16, window_size=2, learning_rate=0.05, seed=3)
+    dw.fit(g, walk_length=10, epochs=30)
+    print("DeepWalk: sim(0, 1)=%.3f (same clique)  sim(0, 9)=%.3f (other)"
+          % (dw.similarity(0, 1), dw.similarity(0, 9)))
+
+    nv = Node2Vec(vector_size=16, p=0.25, q=4.0, walks_per_vertex=8, seed=4)
+    nv.fit(g, walk_length=10, epochs=15)
+    print("node2vec nearest to 0:", list(nv.vertices_nearest(0, 3)))
+
+    # t-SNE projection of the learned vectors
+    from deeplearning4j_tpu.plot.tsne import Tsne
+    proj = Tsne(n_components=2, perplexity=3.0, n_iter=120, seed=5).fit_transform(
+        np.stack([dw.get_vertex_vector(i) for i in range(10)]))
+    print("t-SNE projection shape:", proj.shape)
+
+
+if __name__ == "__main__":
+    word2vec()
+    paragraph_vectors()
+    graph_embeddings()

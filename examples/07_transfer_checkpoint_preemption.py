@@ -1,0 +1,87 @@
+"""Transfer learning, checkpointing, preemption recovery, sklearn pipeline.
+
+Mirrors the transfer-learning / model-persistence tutorials plus two
+TPU-specific additions: the preemption checkpoint handler and the
+scikit-learn estimator adapter (the Spark ML pipeline role).
+
+Run: python examples/07_transfer_checkpoint_preemption.py
+"""
+
+import os
+import signal
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning
+from deeplearning4j_tpu.sklearn_adapter import SklearnDl4jClassifier
+from deeplearning4j_tpu.util import model_serializer
+from deeplearning4j_tpu.util.preemption import PreemptionHandler
+
+
+def make_data(n=256, n_classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    y = rng.integers(0, n_classes, n)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    x[np.arange(n), y] += 2.5
+    return x, y, DataSet(x, np.eye(n_classes, dtype=np.float32)[y])
+
+
+def main():
+    _, _, ds = make_data()
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_out=32, activation="relu"))
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(8)).build())
+    base = MultiLayerNetwork(conf).init()
+    base.fit(ListDataSetIterator(ds, 64, shuffle=True), epochs=10)
+
+    # --- checkpoint round trip -----------------------------------------
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "model.zip")
+        model_serializer.write_model(base, path)
+        restored = model_serializer.restore_multi_layer_network(path)
+        print("restored accuracy:",
+              restored.evaluate(ListDataSetIterator(ds, 256)).accuracy())
+
+        # --- preemption: SIGTERM mid-training saves + resumes ----------
+        ckpt = os.path.join(d, "preempt.zip")
+        handler = PreemptionHandler(base, ckpt).arm()
+        os.kill(os.getpid(), signal.SIGTERM)  # simulate a maintenance event
+        handler.disarm()
+        resumed, state = PreemptionHandler.resume(ckpt)
+        print("resumed at iteration", state["iteration"],
+              "epoch", state["epoch"])
+
+    # --- transfer learning: freeze features, new 2-class head -----------
+    _, _, ds2 = make_data(n_classes=2, seed=5)
+    transferred = (TransferLearning.Builder(base)
+                   .set_feature_extractor(1)  # freeze layers 0..1
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=2))
+                   .build())
+    transferred.fit(ListDataSetIterator(ds2, 64, shuffle=True), epochs=10)
+    print("transferred (2-class) accuracy:",
+          transferred.evaluate(ListDataSetIterator(ds2, 256)).accuracy())
+
+    # --- sklearn estimator (Spark-ML-glue role) ------------------------
+    x, y, _ = make_data(seed=9)
+
+    def conf_factory(n_in, n_out):
+        return (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=n_out))
+                .set_input_type(InputType.feed_forward(n_in)).build())
+
+    clf = SklearnDl4jClassifier(conf_factory, epochs=10, batch_size=64)
+    clf.fit(x, y)
+    print("sklearn-style classifier score:", clf.score(x, y))
+
+
+if __name__ == "__main__":
+    main()

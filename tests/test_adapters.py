@@ -1,0 +1,148 @@
+"""sklearn adapter + preemption handler tests."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.sklearn_adapter import (
+    SklearnDl4jClassifier,
+    SklearnDl4jRegressor,
+)
+from deeplearning4j_tpu.util.preemption import PreemptionHandler
+
+
+def _clf_factory(n_in, n_out):
+    return (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=n_out))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+def _reg_factory(n_in, n_out):
+    return (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=n_out, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+
+
+class TestSklearnAdapter:
+    def test_classifier_protocol(self, rng):
+        y = rng.integers(0, 3, 256)
+        x = rng.normal(size=(256, 6)).astype(np.float32)
+        x[np.arange(256), y] += 2.5
+        clf = SklearnDl4jClassifier(_clf_factory, epochs=10, batch_size=64)
+        clf.fit(x, y)
+        assert clf.score(x, y) > 0.9
+        proba = clf.predict_proba(x[:5])
+        assert proba.shape == (5, 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-3)
+        # string labels work (classes_ mapping)
+        ys = np.array(["a", "b", "c"])[y]
+        clf2 = SklearnDl4jClassifier(_clf_factory, epochs=5, batch_size=64)
+        clf2.fit(x, ys)
+        assert set(clf2.predict(x[:10])) <= {"a", "b", "c"}
+
+    def test_get_set_params(self):
+        clf = SklearnDl4jClassifier(_clf_factory, epochs=3)
+        assert clf.get_params()["epochs"] == 3
+        clf.set_params(epochs=7)
+        assert clf.epochs == 7
+        with pytest.raises(ValueError):
+            clf.set_params(nonsense=1)
+
+    def test_regressor_r2(self, rng):
+        x = rng.normal(size=(256, 4)).astype(np.float32)
+        y = (x @ np.array([1.0, -2.0, 0.5, 3.0])).astype(np.float32)
+        reg = SklearnDl4jRegressor(_reg_factory, epochs=40, batch_size=64)
+        reg.fit(x, y)
+        assert reg.predict(x).shape == (256,)
+        r2 = reg.score(x, y)
+        assert r2 > 0.9
+        # column-vector y must give the same score, not an (n,n) broadcast
+        assert abs(reg.score(x, y[:, None]) - r2) < 1e-6
+
+    def test_works_in_sklearn_pipeline(self, rng):
+        sklearn = pytest.importorskip("sklearn")
+        from sklearn.pipeline import Pipeline
+        from sklearn.preprocessing import StandardScaler
+
+        y = rng.integers(0, 2, 128)
+        x = (rng.normal(size=(128, 4)) * 10 + 5).astype(np.float32)
+        x[np.arange(128), y] += 30
+        pipe = Pipeline([
+            ("scale", StandardScaler()),
+            ("net", SklearnDl4jClassifier(_clf_factory, epochs=10,
+                                          batch_size=32)),
+        ])
+        pipe.fit(x, y)
+        assert pipe.score(x, y) > 0.85
+
+
+class TestPreemption:
+    def _net(self, rng):
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        net = MultiLayerNetwork(_clf_factory(4, 2)).init()
+        y = rng.integers(0, 2, 64)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        net.fit(ListDataSetIterator(
+            DataSet(x, np.eye(2, dtype=np.float32)[y]), 32), epochs=2)
+        return net
+
+    def test_sigterm_checkpoints_and_resumes(self, tmp_path, rng):
+        net = self._net(rng)
+        ckpt = str(tmp_path / "pre.zip")
+        handler = PreemptionHandler(net, ckpt).arm()
+        try:
+            os.kill(os.getpid(), signal.SIGTERM)
+        finally:
+            handler.disarm()
+        assert handler.preempted.is_set()
+        assert os.path.exists(ckpt)
+        resumed, state = PreemptionHandler.resume(ckpt)
+        assert state["iteration"] == net.iteration
+        assert resumed.iteration == net.iteration
+        for pl, pr in zip(net.params, resumed.params):
+            for k in pl:
+                np.testing.assert_allclose(np.asarray(pl[k]),
+                                           np.asarray(pr[k]), rtol=1e-6)
+
+    def test_context_manager_and_restore_handler(self, tmp_path, rng):
+        net = self._net(rng)
+        prev = signal.getsignal(signal.SIGTERM)
+        with PreemptionHandler(net, str(tmp_path / "c.zip")):
+            assert signal.getsignal(signal.SIGTERM) != prev
+        assert signal.getsignal(signal.SIGTERM) == prev
+
+    def test_atomic_save_no_partial_zip(self, tmp_path, rng):
+        net = self._net(rng)
+        h = PreemptionHandler(net, str(tmp_path / "a.zip"))
+        h.save()
+        assert not os.path.exists(str(tmp_path / "a.zip") + ".tmp")
+        # no sidecar: state travels inside the single atomic zip
+        assert not os.path.exists(str(tmp_path / "a.zip") + ".state.json")
+        m, state = PreemptionHandler.resume(str(tmp_path / "a.zip"))
+        assert m is not None and state["iteration"] == net.iteration
+
+    def test_deferred_save_at_step_boundary(self, tmp_path, rng):
+        """A save deferred from inside a donating step completes via
+        maybe_save_pending (the armed listener hook calls it)."""
+        net = self._net(rng)
+        ckpt = str(tmp_path / "d.zip")
+        h = PreemptionHandler(net, ckpt)
+        h.preempted.set()  # as if the handler deferred
+        assert h.maybe_save_pending() is True
+        assert h.saved.is_set() and os.path.exists(ckpt)
+        assert h.maybe_save_pending() is False  # idempotent
+
+    def test_arm_registers_listener_hook(self, tmp_path, rng):
+        net = self._net(rng)
+        n_before = len(net.listeners)
+        h = PreemptionHandler(net, str(tmp_path / "h.zip")).arm()
+        assert len(net.listeners) == n_before + 1
+        h.disarm()
+        assert len(net.listeners) == n_before

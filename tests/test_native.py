@@ -117,6 +117,15 @@ class TestNativeLoader:
         it = NativeDataSetIterator(x, y, batch_size=16, drop_last=True)
         assert [ds.features.shape[0] for ds in it] == [16, 16, 16]
 
+    def test_abandoned_iteration_restarts_from_zero(self, rng):
+        x = np.arange(60, dtype=np.float32).reshape(60, 1)
+        y = np.zeros((60, 1), np.float32)
+        it = NativeDataSetIterator(x, y, batch_size=10, shuffle=False)
+        first = next(iter(it))  # abandon mid-epoch
+        assert first.features[0, 0] == 0.0
+        full = np.concatenate([b.features for b in it]).ravel()
+        np.testing.assert_array_equal(full, x.ravel())  # fresh full epoch
+
     def test_multiple_epochs(self, rng):
         x = rng.normal(size=(40, 3)).astype(np.float32)
         y = rng.normal(size=(40, 2)).astype(np.float32)
