@@ -89,23 +89,22 @@ class NativeDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         if self._handle is not None:
+            # re-arm the SAME epoch up front: every fresh iter() starts from
+            # batch 0 with the same order (Python-fallback semantics) even
+            # when an earlier iteration was abandoned mid-epoch and its
+            # generator has not been finalized yet; reset() is what advances
+            # the shuffle epoch
+            self._lib.loader_rewind(self._handle)
             xbuf = np.empty((self.batch_size, self._x_elems), np.float32)
             ybuf = np.empty((self.batch_size, self._y_elems), np.float32)
-            try:
-                while True:
-                    got = self._lib.loader_next(
-                        self._handle,
-                        xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-                        ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
-                    if got == 0:
-                        return
-                    yield self._emit(xbuf[:got].copy(), ybuf[:got].copy())
-            finally:
-                # runs on exhaustion AND on abandoned generators: re-arm the
-                # SAME epoch so every fresh iter() starts from batch 0 with
-                # the same order (Python-fallback semantics); reset() is what
-                # advances the shuffle epoch
-                self._lib.loader_rewind(self._handle)
+            while True:
+                got = self._lib.loader_next(
+                    self._handle,
+                    xbuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    ybuf.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+                if got == 0:
+                    return
+                yield self._emit(xbuf[:got].copy(), ybuf[:got].copy())
         else:
             order = np.arange(self._n)
             if self._shuffle:

@@ -226,7 +226,9 @@ class ComputationGraph:
         return new_params, new_upd
 
     def _get_train_step(self):
-        if "train" not in self._jit_cache:
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("train", _helpers.version())
+        if key not in self._jit_cache:
             def step(params, states, upd_states, it, ep, inputs, labels,
                      masks, label_masks, rng):
                 def lf(p):
@@ -236,8 +238,8 @@ class ComputationGraph:
                 new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
                 return new_params, new_states, new_upd, loss
 
-            self._jit_cache["train"] = jax.jit(step, donate_argnums=(0, 1, 2))
-        return self._jit_cache["train"]
+            self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1) -> "ComputationGraph":
@@ -306,13 +308,15 @@ class ComputationGraph:
 
     # ------------------------------------------------------------- inference
     def _output_fn(self):
-        if "out" not in self._jit_cache:
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("out", _helpers.version())
+        if key not in self._jit_cache:
             def out_fn(params, states, inputs, masks):
                 acts, _, _, _ = self._forward_all(params, states, inputs,
                                                   train=False, rng=None, masks=masks)
                 return [acts[n] for n in self.conf.outputs]
-            self._jit_cache["out"] = jax.jit(out_fn)
-        return self._jit_cache["out"]
+            self._jit_cache[key] = jax.jit(out_fn)
+        return self._jit_cache[key]
 
     def output(self, *xs, masks=None) -> Union[Array, List[Array]]:
         dtype = self.conf.global_conf.jnp_dtype()

@@ -1,0 +1,65 @@
+"""Native acceleration helper seam.
+
+Parity with the reference's L1 helper layer (SURVEY.md §1): five helper
+interfaces (`ConvolutionHelper.java:35`, `SubsamplingHelper.java:31`,
+`LSTMHelper.java:34`, `BatchNormalizationHelper.java:29`,
+`LocalResponseNormalizationHelper.java:29`) loaded reflectively by the layer
+implementations (`ConvolutionLayer.java:76-84`) so cuDNN can replace the
+built-in math. Here the default math IS the compiled fast path (XLA), so
+helpers are **opt-in Pallas kernels** registered per kind; layers consult the
+registry exactly like the reference's reflective load, and un-registering
+restores stock XLA. The validation contract is the reference's too: a helper
+must produce the same numbers as the built-in path (`ValidateCudnnLSTM.java`
+pattern — see tests/test_helpers.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+_HELPERS: Dict[str, object] = {}
+_VERSION = 0  # bumped on every registry change; part of every jit cache key
+
+KINDS = ("lstm", "convolution", "subsampling", "batch_norm", "lrn")
+
+
+def version() -> int:
+    """Registry generation. Networks include this in their jit cache keys so
+    set/clear AFTER a network has compiled still takes effect on the next
+    call (the registry is consulted at trace time)."""
+    return _VERSION
+
+
+def set_helper(kind: str, helper) -> None:
+    global _VERSION
+    if kind not in KINDS:
+        raise ValueError(f"unknown helper kind {kind!r} (expected one of {KINDS})")
+    _HELPERS[kind] = helper
+    _VERSION += 1
+
+
+def get_helper(kind: str):
+    return _HELPERS.get(kind)
+
+
+def clear_helper(kind: str) -> None:
+    global _VERSION
+    if _HELPERS.pop(kind, None) is not None:
+        _VERSION += 1
+
+
+def clear_all_helpers() -> None:
+    global _VERSION
+    if _HELPERS:
+        _VERSION += 1
+    _HELPERS.clear()
+
+
+class LSTMHelper:
+    """Interface (`LSTMHelper.java:34`): accelerate the LSTM sequence pass."""
+
+    def supports(self, layer, mask) -> bool:  # pragma: no cover - interface
+        return False
+
+    def forward_seq(self, layer, params, x, carry):  # pragma: no cover
+        raise NotImplementedError

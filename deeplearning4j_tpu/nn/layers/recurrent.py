@@ -93,12 +93,21 @@ class LSTMLayer(BaseRecurrentLayer, Layer):
         return (jnp.zeros((batch, h), dtype), jnp.zeros((batch, h), dtype))
 
     def _cell(self, params, x_t, carry):
+        return self._cell_pre(params, x_t @ params["W"] + params["b"], carry)
+
+    def _cell_pre(self, params, xw_t, carry):
+        """Cell step given the precomputed input projection ``x_t @ W + b``.
+
+        The input projection for ALL timesteps is hoisted out of the scan as
+        one [N*T, C] x [C, 4H] MXU matmul (XLA cannot batch matmuls across
+        scan iterations); only the recurrent h @ RW matmul stays sequential —
+        the same split cuDNN's fused RNN uses."""
         h_prev, c_prev = carry
         H = self.n_out
         gate_act = act_mod.resolve(self.gate_activation)
         cell_act = self.act_fn()
         rw = params["RW"][:, :4 * H]
-        z = x_t @ params["W"] + h_prev @ rw + params["b"]
+        z = xw_t + h_prev @ rw
         zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
         if self.peephole:
             # per-unit (diagonal) peephole vectors: RW columns 4H, 4H+1, 4H+2
@@ -118,25 +127,34 @@ class LSTMLayer(BaseRecurrentLayer, Layer):
         return h, (h, c)
 
     def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        # helper seam (ConvolutionLayer.java:76-84 reflective-load pattern):
+        # a registered LSTM helper (e.g. the Pallas fused kernel) takes the
+        # sequence pass when it supports this configuration
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        helper = _helpers.get_helper("lstm")
+        if helper is not None and helper.supports(self, mask):
+            return helper.forward_seq(self, params, x, carry)
         n, t, _ = x.shape
         if carry is None:
             carry = self.init_carry(n, x.dtype)
-        xs = jnp.swapaxes(x, 0, 1)  # [T,N,C]
+        # hoist the input projection out of the recurrence: one big matmul
+        xw = x @ params["W"] + params["b"]           # [N,T,4H] on the MXU
+        xws = jnp.swapaxes(xw, 0, 1)                 # [T,N,4H]
         ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)  # [T,N]
 
         def step(c, inp):
             if ms is None:
-                x_t = inp
-                h, new_c = self._cell(params, x_t, c)
+                xw_t = inp
+                h, new_c = self._cell_pre(params, xw_t, c)
                 return new_c, h
-            x_t, m_t = inp
-            h, new_c = self._cell(params, x_t, c)
+            xw_t, m_t = inp
+            h, new_c = self._cell_pre(params, xw_t, c)
             m = m_t[:, None]
             keep = lambda new, old: m * new + (1 - m) * old
             new_c = (keep(new_c[0], c[0]), keep(new_c[1], c[1]))
             return new_c, h * m
 
-        inputs = xs if ms is None else (xs, ms)
+        inputs = xws if ms is None else (xws, ms)
         final_carry, ys = lax.scan(step, carry, inputs)
         return jnp.swapaxes(ys, 0, 1), final_carry
 
@@ -188,22 +206,23 @@ class SimpleRnnLayer(BaseRecurrentLayer, Layer):
         if carry is None:
             carry = self.init_carry(n, x.dtype)
         act = self.act_fn()
-        xs = jnp.swapaxes(x, 0, 1)
+        # input projection hoisted out of the recurrence (one MXU matmul)
+        xws = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # [T,N,H]
         ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
 
         def step(c, inp):
             (h_prev,) = c
             if ms is None:
-                x_t = inp
-                h = act(x_t @ params["W"] + h_prev @ params["RW"] + params["b"])
+                xw_t = inp
+                h = act(xw_t + h_prev @ params["RW"])
                 return (h,), h
-            x_t, m_t = inp
-            h = act(x_t @ params["W"] + h_prev @ params["RW"] + params["b"])
+            xw_t, m_t = inp
+            h = act(xw_t + h_prev @ params["RW"])
             m = m_t[:, None]
             h_keep = m * h + (1 - m) * h_prev
             return (h_keep,), h * m
 
-        inputs = xs if ms is None else (xs, ms)
+        inputs = xws if ms is None else (xws, ms)
         final_carry, ys = lax.scan(step, carry, inputs)
         return jnp.swapaxes(ys, 0, 1), final_carry
 

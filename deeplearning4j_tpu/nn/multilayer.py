@@ -220,6 +220,8 @@ class MultiLayerNetwork:
 
     def _get_train_step(self, tbptt: bool):
         key = ("train", tbptt)
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = key + (_helpers.version(),)
         if key not in self._jit_cache:
             self._jit_cache[key] = self._build_train_step(tbptt)
         return self._jit_cache[key]
@@ -312,14 +314,18 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- inference
     def _output_fn(self):
-        # one jitted callable; jax.jit itself specializes per input shape
-        if "out" not in self._jit_cache:
+        # one jitted callable; jax.jit itself specializes per input shape.
+        # The helper-registry version is part of the key: the registry is
+        # consulted at trace time, so registration changes must retrace.
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("out", _helpers.version())
+        if key not in self._jit_cache:
             def out_fn(params, states, x, mask):
                 h, _, _ = self._forward_all(params, states, x, train=False,
                                             rng=None, mask=mask)
                 return h
-            self._jit_cache["out"] = jax.jit(out_fn)
-        return self._jit_cache["out"]
+            self._jit_cache[key] = jax.jit(out_fn)
+        return self._jit_cache[key]
 
     def output(self, x, mask=None) -> Array:
         dtype = self.conf.global_conf.jnp_dtype()

@@ -1,0 +1,160 @@
+"""Pallas TPU kernels — the opt-in fused implementations behind the helper
+seam (the cuDNN role, `CudnnLSTMHelper.java:49` / ``cudnnRNNForwardTraining``).
+
+``PallasLSTMHelper`` fuses the whole LSTM recurrence into ONE kernel launch:
+the input projection is precomputed as a single MXU matmul outside, then a
+sequential grid over time keeps h/c in VMEM scratch across steps — recurrent
+matmul + all four gate activations + state update stay in VMEM.
+Differentiation is handled with ``jax.custom_vjp``: the backward pass reuses
+the reference scan implementation's VJP, so the helper is safe under
+``jax.grad``.
+
+Measured on TPU v5e (2x512 LSTM, B=64, T=128, f32): the fused kernel matches
+stock XLA scan inference within noise (~6 ms/call both, bit-identical
+outputs) — XLA already keeps this recurrence's carry on-chip at these sizes.
+The helper seam's value is the cuDNN-parity architecture: an opt-in kernel
+slot per layer family, validated by same-math equivalence tests, ready for
+shapes/fusions where the compiler does leave perf on the table. (The win
+that did generalize — hoisting the input projection out of the scan — lives
+in the default path in ``layers/recurrent.py`` and is helper-independent:
+1.62x on LSTM training.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.nn.helpers import LSTMHelper
+
+
+def _lstm_kernel(hidden: int, t_total: int,
+                 xw_ref, rw_ref, h0_ref, c0_ref,
+                 ys_ref, hn_ref, cn_ref, h_scr, c_scr):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    z = xw_ref[0] + jnp.dot(h_scr[:], rw_ref[:],
+                            preferred_element_type=jnp.float32).astype(xw_ref.dtype)
+    H = hidden
+    i = jax.nn.sigmoid(z[:, :H])
+    f = jax.nn.sigmoid(z[:, H:2 * H])
+    o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+    g = jnp.tanh(z[:, 3 * H:])
+    c = f * c_scr[:] + i * g
+    h = o * jnp.tanh(c)
+    h_scr[:] = h
+    c_scr[:] = c
+    ys_ref[0] = h
+
+    @pl.when(t == t_total - 1)
+    def _final():
+        hn_ref[:] = h
+        cn_ref[:] = c
+
+
+def _lstm_pallas_fwd(xw, rw, h0, c0, *, interpret: bool):
+    """xw [T,N,4H] (input projection + bias), rw [H,4H] → (ys [T,N,H], hN, cN)."""
+    T, N, H4 = xw.shape
+    H = H4 // 4
+    grid = (T,)
+    return pl.pallas_call(
+        functools.partial(_lstm_kernel, H, T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, N, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((N, H), lambda t: (0, 0)),
+            pl.BlockSpec((N, H), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, N, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((N, H), lambda t: (0, 0)),
+            pl.BlockSpec((N, H), lambda t: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T, N, H), xw.dtype),
+            jax.ShapeDtypeStruct((N, H), xw.dtype),
+            jax.ShapeDtypeStruct((N, H), xw.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((N, H), xw.dtype),
+            pltpu.VMEM((N, H), xw.dtype),
+        ],
+        interpret=interpret,
+    )(xw, rw, h0, c0)
+
+
+def _lstm_ref_scan(xw, rw, h0, c0):
+    """Reference recurrence (identical math to LSTMLayer._cell_pre with
+    sigmoid gates / tanh cell): supplies the VJP for the pallas forward."""
+    H = rw.shape[0]
+
+    def step(carry, xw_t):
+        h_prev, c_prev = carry
+        z = xw_t + h_prev @ rw
+        i = jax.nn.sigmoid(z[:, :H])
+        f = jax.nn.sigmoid(z[:, H:2 * H])
+        o = jax.nn.sigmoid(z[:, 2 * H:3 * H])
+        g = jnp.tanh(z[:, 3 * H:])
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (hn, cn), ys = jax.lax.scan(step, (h0, c0), xw)
+    return ys, hn, cn
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def lstm_fused(xw, rw, h0, c0, interpret: bool = False):
+    return _lstm_pallas_fwd(xw, rw, h0, c0, interpret=interpret)
+
+
+def _fused_fwd(xw, rw, h0, c0, interpret):
+    out = _lstm_pallas_fwd(xw, rw, h0, c0, interpret=interpret)
+    return out, (xw, rw, h0, c0)
+
+
+def _fused_bwd(interpret, res, cts):
+    xw, rw, h0, c0 = res
+    _, vjp = jax.vjp(_lstm_ref_scan, xw, rw, h0, c0)
+    return vjp(tuple(cts))
+
+
+lstm_fused.defvjp(_fused_fwd, _fused_bwd)
+
+
+class PallasLSTMHelper(LSTMHelper):
+    """Fused-LSTM helper: standard LSTM (sigmoid gates, tanh cell, no
+    peepholes, no mask). ``interpret=True`` runs the kernel in the Pallas
+    interpreter (CPU testing)."""
+
+    def __init__(self, interpret: bool = None):
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+
+    def supports(self, layer, mask) -> bool:
+        return (mask is None
+                and not getattr(layer, "peephole", False)
+                and layer.gate_activation == "sigmoid"
+                and layer.activation in ("tanh",))
+
+    def forward_seq(self, layer, params, x, carry):
+        n, t, _ = x.shape
+        if carry is None:
+            carry = layer.init_carry(n, x.dtype)
+        h0, c0 = carry
+        xw = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # [T,N,4H]
+        rw = params["RW"][:, :4 * layer.n_out]
+        ys, hn, cn = lstm_fused(xw, rw, h0, c0, self.interpret)
+        return jnp.swapaxes(ys, 0, 1), (hn, cn)

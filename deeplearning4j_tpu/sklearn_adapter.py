@@ -47,9 +47,11 @@ class _BaseAdapter(_SkBase):
                 "seed": self.seed}
 
     def set_params(self, **params) -> "_BaseAdapter":
+        valid = self.get_params()
         for k, v in params.items():
-            if not hasattr(self, k):
-                raise ValueError(f"unknown parameter {k!r}")
+            if k not in valid:  # the sklearn contract: constructor params only
+                raise ValueError(f"unknown parameter {k!r} "
+                                 f"(valid: {sorted(valid)})")
             setattr(self, k, v)
         return self
 
@@ -83,11 +85,10 @@ class SklearnDl4jClassifier(_SkClf, _BaseAdapter):
         X = np.asarray(X, np.float32)
         y = np.asarray(y)
         if y.ndim == 1:
-            self.classes_ = np.unique(y)
+            self.classes_ = np.unique(y)  # sorted
+            idx = np.searchsorted(self.classes_, y)
             onehot = np.zeros((len(y), len(self.classes_)), np.float32)
-            lookup = {c: i for i, c in enumerate(self.classes_)}
-            for i, v in enumerate(y):
-                onehot[i, lookup[v]] = 1.0
+            onehot[np.arange(len(y)), idx] = 1.0
         else:
             self.classes_ = np.arange(y.shape[1])
             onehot = np.asarray(y, np.float32)

@@ -121,10 +121,14 @@ class TestNativeLoader:
         x = np.arange(60, dtype=np.float32).reshape(60, 1)
         y = np.zeros((60, 1), np.float32)
         it = NativeDataSetIterator(x, y, batch_size=10, shuffle=False)
-        first = next(iter(it))  # abandon mid-epoch
+        peek = iter(it)          # abandoned generator, kept ALIVE
+        first = next(peek)
         assert first.features[0, 0] == 0.0
+        # a fresh iteration must restart at batch 0 even while the abandoned
+        # generator has not been finalized
         full = np.concatenate([b.features for b in it]).ravel()
-        np.testing.assert_array_equal(full, x.ravel())  # fresh full epoch
+        np.testing.assert_array_equal(full, x.ravel())
+        del peek
 
     def test_multiple_epochs(self, rng):
         x = rng.normal(size=(40, 3)).astype(np.float32)
