@@ -87,12 +87,17 @@ def read_weights_for_layer(archive: Hdf5Archive, layer_name: str,
             out[base] = archive.read_dataset(ds, *groups)
         for sub in archive.get_groups(*groups):
             # Bidirectional wrappers encode direction in the group path
-            # (forward_lstm/..., backward_lstm/...); surface it as a prefix
+            # (forward_lstm/..., backward_lstm/...); MultiHeadAttention nests
+            # its four projections (query/key/value/attention_output) — both
+            # surface as name prefixes so basenames don't collide
             sub_prefix = prefix
+            base = sub.split(":")[0]
             if sub.startswith("forward"):
                 sub_prefix = "forward_"
             elif sub.startswith("backward"):
                 sub_prefix = "backward_"
+            elif base in ("query", "key", "value", "attention_output"):
+                sub_prefix = prefix + base + "_"
             walk(list(groups) + [sub], sub_prefix)
 
     walk(list(root) + [layer_name], "")

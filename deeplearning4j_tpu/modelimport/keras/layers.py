@@ -448,5 +448,73 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         s = int(s[0]) if isinstance(s, (list, tuple)) else int(s)
         return Upsampling1DLayer(name=name, size=s), _no_weights
 
+    if class_name == "LayerNormalization":
+        from deeplearning4j_tpu.nn.layers import LayerNormalizationLayer
+
+        axis = cfg.get("axis", -1)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        if list(axes) != [-1]:
+            raise UnsupportedKerasConfigurationException(
+                f"LayerNormalization over axis {axis!r} is not supported "
+                "(only the last/feature axis)")
+
+        def ln_weights(raw):
+            out = {}
+            if "gamma" in raw:
+                out["gamma"] = raw["gamma"]
+            elif "beta" in raw:  # scale=False: identity gamma
+                out["gamma"] = np.ones_like(np.asarray(raw["beta"]))
+            if "beta" in raw:
+                out["beta"] = raw["beta"]
+            elif "gamma" in raw:  # center=False: zero beta
+                out["beta"] = np.zeros_like(np.asarray(raw["gamma"]))
+            return out, {}
+
+        return (LayerNormalizationLayer(name=name,
+                                        eps=float(cfg.get("epsilon", 1e-3))),
+                ln_weights)
+
+    if class_name == "MultiHeadAttention":
+        from deeplearning4j_tpu.nn.layers import SelfAttentionLayer
+
+        heads = int(cfg.get("num_heads", 1))
+        key_dim = int(cfg.get("key_dim", 0)) or None
+        value_dim = cfg.get("value_dim")
+        if value_dim is not None and int(value_dim) != (key_dim or 0):
+            raise UnsupportedKerasConfigurationException(
+                f"MultiHeadAttention with value_dim ({value_dim}) != key_dim "
+                f"({key_dim}) is not supported")
+        if cfg.get("output_shape") is not None:
+            raise UnsupportedKerasConfigurationException(
+                "MultiHeadAttention with an explicit output_shape is not "
+                "supported (output dim must equal the model dim)")
+
+        def mha_weights(raw):
+            # keras MHA: query/key/value kernels [d_model, H, Dh] + biases
+            # [H, Dh]; attention_output kernel [H, Dh, d_model] + bias
+            # [d_model]. Pack into the fused layout: Wqkv [d_model, 3*H*Dh]
+            # (q|k|v blocks, each H-major), Wo [H*Dh, d_model].
+            wq = np.asarray(raw["query_kernel"])
+            d_model = wq.shape[0]
+            inner = wq.shape[1] * wq.shape[2]
+            packs = [np.asarray(raw[f"{p}_kernel"]).reshape(d_model, inner)
+                     for p in ("query", "key", "value")]
+            # use_bias=False stores no bias datasets: zero bias == no bias
+            biases = [np.asarray(raw[f"{p}_bias"]).reshape(inner)
+                      if f"{p}_bias" in raw else np.zeros(inner, np.float32)
+                      for p in ("query", "key", "value")]
+            wo = np.asarray(raw["attention_output_kernel"]).reshape(inner, -1)
+            bo = (np.asarray(raw["attention_output_bias"])
+                  if "attention_output_bias" in raw
+                  else np.zeros(wo.shape[1], np.float32))
+            return ({"Wqkv": np.concatenate(packs, axis=1),
+                     "bqkv": np.concatenate(biases),
+                     "Wo": wo,
+                     "bo": bo}, {})
+
+        return (SelfAttentionLayer(name=name, n_heads=heads,
+                                   head_size=key_dim, project_input=True),
+                mha_weights)
+
     raise UnsupportedKerasConfigurationException(
         f"Unsupported Keras layer type {class_name!r}")

@@ -239,6 +239,16 @@ class KerasModel:
                 g.add_vertex(lname, PreprocessorVertex(preprocessor="cnn_to_ff"),
                              *inputs)
                 continue
+            if cls == "MultiHeadAttention":
+                # self-attention calls mha(x, x[, x]): collapse identical
+                # inbound tensors to one input; true cross-attention (distinct
+                # query/value sources) is not yet supported
+                uniq = list(dict.fromkeys(inputs))
+                if len(uniq) > 1:
+                    raise UnsupportedKerasConfigurationException(
+                        f"MultiHeadAttention {lname!r} with distinct "
+                        f"query/value inputs (cross-attention) is not supported")
+                inputs = uniq
             layer, wf = map_keras_layer(cls, c)
             if layer is None:
                 # structural no-op (Masking): pass-through vertex

@@ -53,7 +53,13 @@ def selu(x: Array) -> Array:
 
 
 def gelu(x: Array) -> Array:
-    # tanh approximation, matching the common DL4J/BERT formulation
+    # exact (erf-based) gelu — what keras/tf mean by "gelu"; the tanh
+    # approximation is registered separately as "gelu_tanh"
+    return jax.nn.gelu(x, approximate=False)
+
+
+def gelu_tanh(x: Array) -> Array:
+    # tanh approximation (the original BERT formulation)
     return 0.5 * x * (1.0 + jnp.tanh(math.sqrt(2.0 / math.pi) * (x + 0.044715 * x**3)))
 
 
@@ -125,6 +131,7 @@ _REGISTRY: dict[str, ActivationFn] = {
     "elu": elu,
     "selu": selu,
     "gelu": gelu,
+    "gelu_tanh": gelu_tanh,
     "sigmoid": sigmoid,
     "hardsigmoid": hardsigmoid,
     "tanh": tanh,

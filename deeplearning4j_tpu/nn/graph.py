@@ -225,10 +225,18 @@ class ComputationGraph:
             new_upd[name] = s_new
         return new_params, new_upd
 
+    def _evict_stale(self, current_version: int) -> None:
+        """Drop executables compiled under an older helper-registry version."""
+        for k in [k for k in self._jit_cache
+                  if isinstance(k, tuple) and k[-1] != current_version]:
+            del self._jit_cache[k]
+
     def _get_train_step(self):
         from deeplearning4j_tpu.nn import helpers as _helpers
         key = ("train", _helpers.version())
         if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
             def step(params, states, upd_states, it, ep, inputs, labels,
                      masks, label_masks, rng):
                 def lf(p):
@@ -311,6 +319,8 @@ class ComputationGraph:
         from deeplearning4j_tpu.nn import helpers as _helpers
         key = ("out", _helpers.version())
         if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
             def out_fn(params, states, inputs, masks):
                 acts, _, _, _ = self._forward_all(params, states, inputs,
                                                   train=False, rng=None, masks=masks)

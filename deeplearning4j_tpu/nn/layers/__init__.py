@@ -46,6 +46,7 @@ from deeplearning4j_tpu.nn.layers.pooling import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.layers.norm import (  # noqa: F401
     BatchNormalizationLayer,
+    LayerNormalizationLayer,
     LocalResponseNormalizationLayer,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401

@@ -112,3 +112,37 @@ class LocalResponseNormalizationLayer(Layer):
         win = sum(padded[..., i:i + x.shape[-1]] for i in range(self.n))
         denom = (self.k + self.alpha * win) ** self.beta
         return x / denom, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class LayerNormalizationLayer(Layer):
+    """Layer normalization over the last (feature) axis.
+
+    Not present in the reference snapshot (its newest layers predate
+    transformers); required here for BERT-style models and Keras
+    ``LayerNormalization`` import (BASELINE.md "Keras-import BERT-base").
+    """
+
+    n_in: int = 0
+    eps: float = 1e-3  # keras LayerNormalization default epsilon
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def param_shapes(self):
+        return {"gamma": (self.n_in,), "beta": (self.n_in,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        return {"gamma": jnp.ones((self.n_in,), dtype),
+                "beta": jnp.zeros((self.n_in,), dtype)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        return self.act_fn()(xhat * params["gamma"] + params["beta"]), state or {}

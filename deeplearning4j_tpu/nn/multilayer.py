@@ -223,8 +223,16 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.nn import helpers as _helpers
         key = key + (_helpers.version(),)
         if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
             self._jit_cache[key] = self._build_train_step(tbptt)
         return self._jit_cache[key]
+
+    def _evict_stale(self, current_version: int) -> None:
+        """Drop executables compiled under an older helper-registry version
+        (toggling helpers must not accumulate stale compilations)."""
+        for k in [k for k in self._jit_cache
+                  if isinstance(k, tuple) and k[-1] != current_version]:
+            del self._jit_cache[k]
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
@@ -320,6 +328,8 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.nn import helpers as _helpers
         key = ("out", _helpers.version())
         if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
             def out_fn(params, states, x, mask):
                 h, _, _ = self._forward_all(params, states, x, train=False,
                                             rng=None, mask=mask)

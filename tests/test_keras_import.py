@@ -244,3 +244,59 @@ class TestConfigOnlyImport:
         jp.write_text(m.to_json())
         conf = KerasModelImport.import_keras_model_configuration(str(jp))
         assert conf.num_params() == 8 * 16 + 16 + 16 * 3 + 3
+
+
+class TestTransformerImport:
+    """BERT-style encoder import (BASELINE.md config: "Keras-import
+    BERT-base — import + train via attention ops")."""
+
+    def _encoder_block(self, t=10, d=32, heads=4, ff=64):
+        kl = keras.layers
+        inp = kl.Input((t, d), name="tokens")
+        att = kl.MultiHeadAttention(num_heads=heads, key_dim=d // heads,
+                                    name="mha")(inp, inp)
+        res1 = kl.Add(name="res1")([inp, att])
+        ln1 = kl.LayerNormalization(name="ln1")(res1)
+        ffn = kl.Dense(ff, activation="gelu", name="ffn_up")(ln1)
+        ffn = kl.Dense(d, name="ffn_down")(ffn)
+        res2 = kl.Add(name="res2")([ln1, ffn])
+        out = kl.LayerNormalization(name="ln2")(res2)
+        return keras.Model(inp, out)
+
+    def test_encoder_block_output_equivalence(self, tmp_path):
+        m = self._encoder_block()
+        p = _save(m, tmp_path, "encoder.h5")
+        x = np.random.RandomState(3).rand(2, 10, 32).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        got = net.output(x)
+        got = got[0] if isinstance(got, list) else got
+        _assert_close(got, expected, tol=5e-4)
+
+    def test_imported_encoder_trains(self, tmp_path):
+        m = self._encoder_block(t=6, d=16, heads=2, ff=32)
+        p = _save(m, tmp_path, "encoder2.h5", loss="mse")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 6, 16).astype(np.float32)
+        y = rng.rand(8, 6, 16).astype(np.float32)
+        net.fit(DataSet(x, y))
+        before = float(net.score_)
+        for _ in range(10):
+            net.fit(DataSet(x, y))
+        assert float(net.score_) < before
+
+    def test_cross_attention_rejected(self, tmp_path):
+        kl = keras.layers
+        a = kl.Input((5, 16), name="a")
+        b = kl.Input((5, 16), name="b")
+        att = kl.MultiHeadAttention(num_heads=2, key_dim=8,
+                                    name="xatt")(a, b)
+        m = keras.Model([a, b], att)
+        p = _save(m, tmp_path, "cross.h5")
+        import pytest as _pytest
+        from deeplearning4j_tpu.modelimport.keras.layers import (
+            UnsupportedKerasConfigurationException)
+        with _pytest.raises(UnsupportedKerasConfigurationException):
+            KerasModelImport.import_keras_model_and_weights(p)

@@ -1,0 +1,80 @@
+"""Checkpoint-format regression tests (RegressionTest050/060/071/080 pattern):
+a model zip produced by an earlier build is committed as a fixture; restoring
+it must keep producing the exact recorded outputs, locking the serialization
+format against drift."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram,
+    ChartLine,
+    ChartScatter,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+)
+from deeplearning4j_tpu.util import model_serializer
+
+FIXTURE_DIR = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestCheckpointFormatRegression:
+    def test_restore_v1_fixture_exact_outputs(self):
+        zip_path = os.path.join(FIXTURE_DIR, "regression_model_v1.zip")
+        expected = np.load(os.path.join(FIXTURE_DIR,
+                                        "regression_model_v1_expected.npz"))
+        net = model_serializer.restore_multi_layer_network(zip_path)
+        out = np.asarray(net.output(expected["probe"]))
+        np.testing.assert_allclose(out, expected["output"], rtol=1e-6,
+                                   atol=1e-7)
+
+    def test_restored_fixture_keeps_training(self):
+        zip_path = os.path.join(FIXTURE_DIR, "regression_model_v1.zip")
+        net = model_serializer.restore_multi_layer_network(zip_path)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(16, 8, 8, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(DataSet(x, y))  # updater state restored → step must work
+        assert np.isfinite(float(net.score_))
+
+
+class TestUiComponents:
+    def test_chart_line_json_and_svg(self):
+        chart = (ChartLine("loss").add_series("train", [0, 1, 2], [3.0, 2.0, 1.5])
+                 .add_series("val", [0, 1, 2], [3.2, 2.4, 1.9]))
+        d = chart.to_dict()
+        assert d["type"] == "chart_line" and len(d["series"]) == 2
+        svg = chart.render()
+        assert svg.startswith("<svg") and "polyline" in svg and "loss" in svg
+
+    def test_scatter_and_histogram(self, rng):
+        sc = ChartScatter("pts").add_series("a", [0, 1], [1, 0])
+        assert "circle" in sc.render()
+        hist = ChartHistogram.from_values(rng.normal(size=500), n_bins=12,
+                                          title="weights")
+        assert len(hist.bins) == 12
+        assert "rect" in hist.render()
+        assert sum(b["count"] for b in hist.to_dict()["bins"]) == 500
+
+    def test_table_text_div_page(self):
+        page = ComponentDiv(
+            ComponentText("Training report"),
+            ComponentTable(["layer", "params"], [["dense", 128], ["out", 33]]),
+            ChartLine("score").add_series("s", [0, 1], [1.0, 0.5]),
+        )
+        html_page = page.render_page("report")
+        assert html_page.startswith("<!DOCTYPE html>")
+        assert "<table" in html_page and "Training report" in html_page
+        assert "<svg" in html_page
+        # json composition round-trips
+        import json
+        d = json.loads(page.to_json())
+        assert len(d["children"]) == 3
+
+    def test_mismatched_series_raises(self):
+        with pytest.raises(ValueError):
+            ChartLine().add_series("bad", [1, 2], [1.0])
