@@ -81,22 +81,30 @@ def read_weights_for_layer(archive: Hdf5Archive, layer_name: str,
     ``layer/variable:0`` nesting)."""
     out: Dict[str, np.ndarray] = {}
 
+    _MHA_PROJ = {"query", "key", "value", "attention_output"}
+
     def walk(groups, prefix):
         for ds in archive.get_data_sets(*groups):
             base = prefix + ds.split(":")[0]
             out[base] = archive.read_dataset(ds, *groups)
-        for sub in archive.get_groups(*groups):
+        subs = archive.get_groups(*groups)
+        # MultiHeadAttention nests its four projections as SIBLING groups;
+        # require at least three of them together before treating the names
+        # as MHA projections, so an ordinary layer named e.g. "value" keeps
+        # flat basenames
+        sub_bases = {s.split(":")[0] for s in subs}
+        is_mha_level = len(_MHA_PROJ & sub_bases) >= 3
+        for sub in subs:
             # Bidirectional wrappers encode direction in the group path
-            # (forward_lstm/..., backward_lstm/...); MultiHeadAttention nests
-            # its four projections (query/key/value/attention_output) — both
-            # surface as name prefixes so basenames don't collide
+            # (forward_lstm/..., backward_lstm/...); MHA projections surface
+            # as name prefixes so their basenames don't collide
             sub_prefix = prefix
             base = sub.split(":")[0]
             if sub.startswith("forward"):
                 sub_prefix = "forward_"
             elif sub.startswith("backward"):
                 sub_prefix = "backward_"
-            elif base in ("query", "key", "value", "attention_output"):
+            elif is_mha_level and base in _MHA_PROJ:
                 sub_prefix = prefix + base + "_"
             walk(list(groups) + [sub], sub_prefix)
 

@@ -513,7 +513,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
                      "bo": bo}, {})
 
         return (SelfAttentionLayer(name=name, n_heads=heads,
-                                   head_size=key_dim, project_input=True),
+                                   head_size=key_dim, project_input=True,
+                                   attn_dropout=float(cfg.get("dropout", 0.0))),
                 mha_weights)
 
     raise UnsupportedKerasConfigurationException(

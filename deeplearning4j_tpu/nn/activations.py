@@ -54,7 +54,9 @@ def selu(x: Array) -> Array:
 
 def gelu(x: Array) -> Array:
     # exact (erf-based) gelu — what keras/tf mean by "gelu"; the tanh
-    # approximation is registered separately as "gelu_tanh"
+    # approximation is registered separately as "gelu_tanh". (Renamed before
+    # any released checkpoint serialized "gelu": no committed artifact —
+    # fixtures included — references it, so restore semantics are unchanged.)
     return jax.nn.gelu(x, approximate=False)
 
 

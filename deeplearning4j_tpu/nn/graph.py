@@ -226,10 +226,8 @@ class ComputationGraph:
         return new_params, new_upd
 
     def _evict_stale(self, current_version: int) -> None:
-        """Drop executables compiled under an older helper-registry version."""
-        for k in [k for k in self._jit_cache
-                  if isinstance(k, tuple) and k[-1] != current_version]:
-            del self._jit_cache[k]
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        _helpers.evict_stale_jit_entries(self._jit_cache, current_version)
 
     def _get_train_step(self):
         from deeplearning4j_tpu.nn import helpers as _helpers

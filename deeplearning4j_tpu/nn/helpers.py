@@ -23,6 +23,15 @@ _VERSION = 0  # bumped on every registry change; part of every jit cache key
 KINDS = ("lstm", "convolution", "subsampling", "batch_norm", "lrn")
 
 
+def evict_stale_jit_entries(cache: Dict, current_version: int) -> None:
+    """Drop jit-cache entries compiled under an older registry version
+    (version-suffixed tuple keys). Shared by MultiLayerNetwork and
+    ComputationGraph so the eviction rule lives in one place."""
+    for k in [k for k in cache
+              if isinstance(k, tuple) and k[-1] != current_version]:
+        del cache[k]
+
+
 def version() -> int:
     """Registry generation. Networks include this in their jit cache keys so
     set/clear AFTER a network has compiled still takes effect on the next

@@ -228,11 +228,8 @@ class MultiLayerNetwork:
         return self._jit_cache[key]
 
     def _evict_stale(self, current_version: int) -> None:
-        """Drop executables compiled under an older helper-registry version
-        (toggling helpers must not accumulate stale compilations)."""
-        for k in [k for k in self._jit_cache
-                  if isinstance(k, tuple) and k[-1] != current_version]:
-            del self._jit_cache[k]
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        _helpers.evict_stale_jit_entries(self._jit_cache, current_version)
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,

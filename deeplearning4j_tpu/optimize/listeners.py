@@ -225,3 +225,30 @@ class CheckpointListener(TrainingListener):
         ep = model.epoch
         if self.save_every_n_epochs and ep % self.save_every_n_epochs == 0:
             self._save(model, model.iteration, ep)
+
+
+class OneTimeLogger:
+    """Deduplicating logger (``util/OneTimeLogger.java``): each distinct
+    message is emitted once per process; repeats are dropped."""
+
+    _seen = set()
+
+    @classmethod
+    def warn(cls, message: str, *args) -> None:
+        cls._log(logging.WARNING, message, args)
+
+    @classmethod
+    def info(cls, message: str, *args) -> None:
+        cls._log(logging.INFO, message, args)
+
+    @classmethod
+    def _log(cls, level, message, args) -> None:
+        key = (level, message)
+        if key in cls._seen:
+            return
+        cls._seen.add(key)
+        log.log(level, message, *args)
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._seen.clear()

@@ -117,9 +117,9 @@ class ChartLine(_Chart):
 
     def render(self) -> str:
         parts = self._svg_open()
-        if self.series:
-            xs = [v for _, x, _ in self.series for v in x]
-            ys = [v for _, _, y in self.series for v in y]
+        xs = [v for _, x, _ in self.series for v in x]
+        ys = [v for _, _, y in self.series for v in y]
+        if xs and ys:
             sx, sy = self._scales(min(xs), max(xs), min(ys), max(ys))
             parts += self._axes(sx, sy, min(xs), max(xs), min(ys), max(ys))
             for i, (name, x, y) in enumerate(self.series):

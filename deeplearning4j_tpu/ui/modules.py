@@ -1,0 +1,140 @@
+"""Extra UI modules: t-SNE view, convolutional activations, timeline export.
+
+Parity with the reference's Play UI modules beyond train/overview
+(`ui/module/tsne/TsneModule.java` — upload/serve t-SNE coordinate sets;
+`ui/module/convolutional/ConvolutionalListenerModule.java` +
+`deeplearning4j-ui-remote-iterationlisteners/.../RemoteConvolutionalIterationListener.java`
+— stream layer activations during training; `spark/stats/StatsUtils.java` —
+exportable timeline HTML). Each module plugs into :class:`UIServer` via
+``register_module`` and answers under its own path prefix.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.components import (
+    ChartLine,
+    ChartScatter,
+    ComponentDiv,
+    ComponentTable,
+    ComponentText,
+)
+
+
+class TsneModule:
+    """Holds named 2-D coordinate sets and serves them as JSON or an SVG page
+    (``TsneModule.java`` upload/list/get routes)."""
+
+    prefix = "/tsne"
+
+    def __init__(self):
+        self._sets: Dict[str, dict] = {}
+
+    def upload(self, name: str, coords, labels: Optional[Sequence[str]] = None):
+        coords = np.asarray(coords, np.float32)
+        if coords.ndim != 2 or coords.shape[1] != 2:
+            raise ValueError(f"expected [n, 2] coordinates, got {coords.shape}")
+        self._sets[name] = {
+            "coords": coords.tolist(),
+            "labels": list(labels) if labels is not None else None,
+        }
+
+    def handle(self, path: str, method: str = "GET",
+               body: Optional[bytes] = None):
+        """(status, payload) for a request under the module prefix."""
+        sub = path[len(self.prefix):].strip("/")
+        if method == "POST":
+            rec = json.loads((body or b"{}").decode())
+            self.upload(rec["name"], rec["coords"], rec.get("labels"))
+            return 200, {"status": "ok"}
+        if not sub:  # list sessions
+            return 200, sorted(self._sets)
+        if sub in self._sets:
+            return 200, self._sets[sub]
+        return 404, {"error": f"no t-SNE set {sub!r}"}
+
+    def render_svg(self, name: str) -> str:
+        data = self._sets[name]
+        coords = np.asarray(data["coords"])
+        chart = ChartScatter(title=f"t-SNE: {name}")
+        labels = data["labels"]
+        if labels:
+            for lab in sorted(set(labels)):
+                idx = [i for i, l in enumerate(labels) if l == lab]
+                chart.add_series(str(lab), coords[idx, 0].tolist(),
+                                 coords[idx, 1].tolist())
+        else:
+            chart.add_series("points", coords[:, 0].tolist(),
+                             coords[:, 1].tolist())
+        return chart.render()
+
+
+class ConvolutionalListenerModule(TrainingListener):
+    """Captures per-layer activation summaries during training and serves
+    them (``ConvolutionalListenerModule.java`` role; the reference streams
+    PNG grids — here compact per-channel statistics cross the wire, not
+    pixels). Attach to ``net.listeners`` and register with the UIServer."""
+
+    prefix = "/activations"
+
+    def __init__(self, sample_input=None, frequency: int = 10,
+                 max_channels: int = 16):
+        self.sample_input = sample_input
+        self.frequency = max(1, frequency)
+        self.max_channels = max_channels
+        self.latest: Dict[str, dict] = {}
+
+    def iteration_done(self, model, iteration: int, epoch: int) -> None:
+        if iteration % self.frequency != 0 or self.sample_input is None:
+            return
+        try:
+            acts = model.feed_forward(self.sample_input)
+        except Exception:
+            return
+        layers = getattr(model, "layers", [])
+        summary = {}
+        for i, a in enumerate(acts[1:]):
+            a = np.asarray(a)
+            name = (layers[i].name if i < len(layers) and layers[i].name
+                    else f"layer{i}")
+            entry = {"shape": list(a.shape), "mean": float(a.mean()),
+                     "std": float(a.std())}
+            if a.ndim == 4:  # [N,H,W,C]: per-channel mean magnitude
+                per_ch = np.abs(a[0]).mean(axis=(0, 1))
+                entry["channel_means"] = per_ch[:self.max_channels].tolist()
+            summary[name] = entry
+        self.latest = {"iteration": iteration, "layers": summary}
+
+    def handle(self, path: str, method: str = "GET",
+               body: Optional[bytes] = None):
+        return 200, self.latest
+
+
+def timeline_html(stats, title: str = "training timeline") -> str:
+    """Exportable timeline page from a TrainingStats (``StatsUtils.java``
+    exportTimelineHtml role): per-phase durations as charts + a table."""
+    page = ComponentDiv(ComponentText(title))
+    rows = []
+    for phase, times in stats.phase_times.items():
+        rows.append([phase, len(times), f"{sum(times):.4f}",
+                     f"{max(times):.4f}" if times else "-"])
+        chart = ChartLine(title=f"{phase} duration per call (s)")
+        chart.add_series(phase, list(range(len(times))), times)
+        page.add(chart)
+    page.children.insert(
+        1, ComponentTable(["phase", "calls", "total_s", "max_s"], rows))
+    return page.render_page(title)
+
+
+def register_module(server, module) -> None:
+    """Attach a module to a UIServer: requests under ``module.prefix`` are
+    routed to ``module.handle``."""
+    if not hasattr(server, "_modules"):
+        server._modules = {}
+    server._modules[module.prefix] = module

@@ -171,8 +171,18 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _try_modules(self, path, method, body=None) -> bool:
+                for prefix, module in getattr(ui, "_modules", {}).items():
+                    if path == prefix or path.startswith(prefix + "/"):
+                        code, payload = module.handle(path, method, body)
+                        self._json(payload, code)
+                        return True
+                return False
+
             def do_GET(self):
                 path = urlparse(self.path).path
+                if self._try_modules(path, "GET"):
+                    return
                 if path in ("/", "/train", "/train/overview"):
                     body = _DASHBOARD_HTML.encode("utf-8")
                     self.send_response(200)
@@ -190,6 +200,21 @@ class UIServer:
 
             def do_POST(self):
                 path = urlparse(self.path).path
+                n_body = int(self.headers.get("Content-Length", "0"))
+                if getattr(ui, "_modules", None):
+                    body = None
+                    for prefix in ui._modules:
+                        if path == prefix or path.startswith(prefix + "/"):
+                            body = self.rfile.read(n_body)
+                            break
+                    if body is not None:
+                        try:
+                            handled = self._try_modules(path, "POST", body)
+                        except (KeyError, ValueError) as e:
+                            self._json({"error": str(e)}, 400)
+                            return
+                        if handled:
+                            return
                 if path == "/remote":
                     n = int(self.headers.get("Content-Length", "0"))
                     try:

@@ -164,3 +164,84 @@ class TestServer:
             quiet.put_update(Persistable("s", "T", "w", 1.0, {}))
         finally:
             server.stop()
+
+
+class TestUiModules:
+    def test_tsne_module_routes(self, rng):
+        import urllib.request
+        from deeplearning4j_tpu.ui.modules import TsneModule, register_module
+        server = UIServer(port=0)
+        mod = TsneModule()
+        register_module(server, mod)
+        port = server.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            coords = rng.normal(size=(20, 2)).tolist()
+            req = urllib.request.Request(
+                f"{base}/tsne", method="POST",
+                data=json.dumps({"name": "s1", "coords": coords,
+                                 "labels": ["a"] * 10 + ["b"] * 10}).encode(),
+                headers={"Content-Type": "application/json"})
+            assert urllib.request.urlopen(req).status == 200
+            sets = json.loads(urllib.request.urlopen(f"{base}/tsne").read())
+            assert sets == ["s1"]
+            data = json.loads(urllib.request.urlopen(f"{base}/tsne/s1").read())
+            assert len(data["coords"]) == 20
+            svg = mod.render_svg("s1")
+            assert "<svg" in svg and "circle" in svg
+        finally:
+            server.stop()
+
+    def test_activations_module(self, rng):
+        from deeplearning4j_tpu.ui.modules import ConvolutionalListenerModule
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration, InputType
+        from deeplearning4j_tpu.nn.layers import (ConvolutionLayer, DenseLayer,
+                                                  OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu", name="conv"))
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+        net = MultiLayerNetwork(conf).init()
+        sample = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+        mod = ConvolutionalListenerModule(sample_input=sample, frequency=1)
+        net.listeners.append(mod)
+        x = rng.normal(size=(16, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit(DataSet(x, y))
+        assert mod.latest["layers"]["conv"]["channel_means"]
+        code, payload = mod.handle("/activations")
+        assert code == 200 and "layers" in payload
+
+    def test_timeline_html(self):
+        from deeplearning4j_tpu.parallel.master import TrainingStats
+        from deeplearning4j_tpu.ui.modules import timeline_html
+        st = TrainingStats()
+        st.add("fit", 0.5)
+        st.add("fit", 0.7)
+        st.add("split", 0.1)
+        page = timeline_html(st)
+        assert "<table" in page and "fit" in page and "<svg" in page
+
+    def test_one_time_logger(self):
+        from deeplearning4j_tpu.optimize.listeners import OneTimeLogger
+        import logging as _logging
+        records = []
+        h = _logging.Handler()
+        h.emit = lambda r: records.append(r.getMessage())
+        logger = _logging.getLogger("deeplearning4j_tpu.optimize.listeners")
+        logger.addHandler(h)
+        logger.setLevel(_logging.INFO)
+        try:
+            OneTimeLogger.reset()
+            OneTimeLogger.warn("only once %s", "x")
+            OneTimeLogger.warn("only once %s", "x")
+            OneTimeLogger.info("another")
+            assert records.count("only once x") == 1
+            assert records.count("another") == 1
+        finally:
+            logger.removeHandler(h)
