@@ -9,6 +9,7 @@ from deeplearning4j_tpu.optimize.earlystopping import (  # noqa: F401
     DataSetLossCalculator,
     EarlyStoppingConfiguration,
     EarlyStoppingResult,
+    EarlyStoppingParallelTrainer,
     EarlyStoppingTrainer,
     InMemoryModelSaver,
     InvalidScoreIterationTerminationCondition,

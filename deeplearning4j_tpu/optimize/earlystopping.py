@@ -280,3 +280,26 @@ class EarlyStoppingTrainer:
         best = cfg.saver.get_best_model() or self.model
         return EarlyStoppingResult(reason, details, scores, best_epoch,
                                    best_score, epoch, best)
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping with mesh-parallel epoch fitting
+    (``EarlyStoppingParallelTrainer.java:51``: the reference wraps the model
+    in a ParallelWrapper for each epoch; here each epoch runs the
+    data-parallel sharded step over the mesh)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model,
+                 train_iterator, mesh=None, mode: str = "shared_gradients",
+                 averaging_frequency: int = 5):
+        super().__init__(config, model, train_iterator)
+        from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
+        self._pw = ParallelWrapper(model, mesh, mode=mode,
+                                   averaging_frequency=averaging_frequency)
+
+    def fit(self) -> EarlyStoppingResult:
+        # route the base class's per-epoch model.fit through the wrapper
+        self.model.fit = lambda it, epochs=1: self._pw.fit(it, epochs=epochs)
+        try:
+            return super().fit()
+        finally:
+            del self.model.fit  # restore normal class-method lookup

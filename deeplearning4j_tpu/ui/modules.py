@@ -12,8 +12,7 @@ exportable timeline HTML). Each module plugs into :class:`UIServer` via
 from __future__ import annotations
 
 import json
-import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
@@ -95,7 +94,11 @@ class ConvolutionalListenerModule(TrainingListener):
             return
         try:
             acts = model.feed_forward(self.sample_input)
-        except Exception:
+        except Exception as e:
+            from deeplearning4j_tpu.optimize.listeners import OneTimeLogger
+            OneTimeLogger.warn(
+                "ConvolutionalListenerModule: feed_forward on the sample "
+                "input failed (%s); activations will stay empty", e)
             return
         layers = getattr(model, "layers", [])
         summary = {}

@@ -171,10 +171,18 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _try_modules(self, path, method, body=None) -> bool:
+            def _try_modules(self, path, method) -> bool:
                 for prefix, module in getattr(ui, "_modules", {}).items():
                     if path == prefix or path.startswith(prefix + "/"):
-                        code, payload = module.handle(path, method, body)
+                        body = None
+                        if method == "POST":
+                            n = int(self.headers.get("Content-Length", "0"))
+                            body = self.rfile.read(n)
+                        try:
+                            code, payload = module.handle(path, method, body)
+                        except Exception as e:  # module bugs → JSON error,
+                            self._json({"error": str(e)}, 400)  # not a dropped
+                            return True                         # connection
                         self._json(payload, code)
                         return True
                 return False
@@ -200,21 +208,8 @@ class UIServer:
 
             def do_POST(self):
                 path = urlparse(self.path).path
-                n_body = int(self.headers.get("Content-Length", "0"))
-                if getattr(ui, "_modules", None):
-                    body = None
-                    for prefix in ui._modules:
-                        if path == prefix or path.startswith(prefix + "/"):
-                            body = self.rfile.read(n_body)
-                            break
-                    if body is not None:
-                        try:
-                            handled = self._try_modules(path, "POST", body)
-                        except (KeyError, ValueError) as e:
-                            self._json({"error": str(e)}, 400)
-                            return
-                        if handled:
-                            return
+                if self._try_modules(path, "POST"):
+                    return
                 if path == "/remote":
                     n = int(self.headers.get("Content-Length", "0"))
                     try:

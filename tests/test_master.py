@@ -144,3 +144,33 @@ class TestSharedTrainingMaster:
         assert m.batch_size_per_worker == 32
         assert m.threshold == 5e-4
         assert m.min_threshold == 1e-6
+
+
+class TestEarlyStoppingParallel:
+    def test_parallel_early_stopping(self):
+        """EarlyStoppingParallelTrainer: epochs run sharded over the mesh
+        (EarlyStoppingParallelTrainer.java role)."""
+        from deeplearning4j_tpu.optimize import (
+            DataSetLossCalculator,
+            EarlyStoppingConfiguration,
+            EarlyStoppingParallelTrainer,
+            InMemoryModelSaver,
+            MaxEpochsTerminationCondition,
+        )
+        ds = _data(256)
+        valid = _data(128, seed=9)
+        net = _net()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ListDataSetIterator(valid, 64)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+            model_saver=InMemoryModelSaver())
+        trainer = EarlyStoppingParallelTrainer(
+            cfg, net, ListDataSetIterator(ds, 64, shuffle=True),
+            mesh=make_mesh({"data": 8}))
+        result = trainer.fit()
+        assert result.total_epochs <= 8
+        ev = result.best_model.evaluate(ListDataSetIterator(valid, 128))
+        assert ev.accuracy() > 0.8
+        # the original fit method is restored after training
+        assert net.fit.__name__ == "fit"
