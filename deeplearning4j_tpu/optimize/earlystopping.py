@@ -237,6 +237,11 @@ class EarlyStoppingTrainer:
         self.model = model
         self.iterator = train_iterator
 
+    def _fit_epoch(self) -> None:
+        """One training epoch — the overridable hook subclasses reroute
+        (the parallel trainer sends it through a ParallelWrapper)."""
+        self.model.fit(self.iterator, epochs=1)
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_conditions:
@@ -249,7 +254,7 @@ class EarlyStoppingTrainer:
         last_eval = float("nan")
         reason, details = "EpochTerminationCondition", "max epochs"
         while True:
-            self.model.fit(self.iterator, epochs=1)
+            self._fit_epoch()
             last = self.model.score_
             stop_iter = next((c for c in cfg.iteration_conditions if c.terminate(last)), None)
             if stop_iter is not None:
@@ -296,10 +301,6 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
         self._pw = ParallelWrapper(model, mesh, mode=mode,
                                    averaging_frequency=averaging_frequency)
 
-    def fit(self) -> EarlyStoppingResult:
-        # route the base class's per-epoch model.fit through the wrapper
-        self.model.fit = lambda it, epochs=1: self._pw.fit(it, epochs=epochs)
-        try:
-            return super().fit()
-        finally:
-            del self.model.fit  # restore normal class-method lookup
+    def _fit_epoch(self) -> None:
+        # epochs run sharded over the mesh; the user's model is not mutated
+        self._pw.fit(self.iterator, epochs=1)

@@ -180,9 +180,12 @@ class UIServer:
                             body = self.rfile.read(n)
                         try:
                             code, payload = module.handle(path, method, body)
-                        except Exception as e:  # module bugs → JSON error,
-                            self._json({"error": str(e)}, 400)  # not a dropped
-                            return True                         # connection
+                        except (KeyError, ValueError, TypeError) as e:
+                            self._json({"error": str(e)}, 400)  # bad request
+                            return True
+                        except Exception as e:  # module bug → server error,
+                            self._json({"error": str(e)}, 500)  # not a
+                            return True                         # dropped conn
                         self._json(payload, code)
                         return True
                 return False
