@@ -22,6 +22,7 @@ from deeplearning4j_tpu.nn.layers import (
     GlobalPoolingLayer,
     GravesLSTMLayer,
     LastTimeStepWrapper,
+    LayerNormalizationLayer,
     LocalResponseNormalizationLayer,
     LSTMLayer,
     OutputLayer,
@@ -280,3 +281,33 @@ class TestPretrainGradients:
             key = jax.random.PRNGKey(5)
             assert check_gradients_fn(lambda p: layer.pretrain_loss(p, x, key),
                                       params, subset=40, print_results=True)
+
+
+class TestNormAttentionGradients:
+    def test_layer_norm(self):
+        m = build([DenseLayer(n_out=6, activation="tanh"),
+                   LayerNormalizationLayer(),
+                   OutputLayer(n_out=3)],
+                  InputType.feed_forward(5))
+        x = RNG.normal(size=(4, 5))
+        y = onehot(RNG.integers(0, 3, 4), 3)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_self_attention(self):
+        m = build([SelfAttentionLayer(n_heads=2, head_size=3),
+                   RnnOutputLayer(n_out=2)],
+                  InputType.recurrent(6, 5))
+        x = RNG.normal(size=(3, 5, 6))
+        y = onehot(RNG.integers(0, 2, (3, 5)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_attention_layer_norm_stack(self):
+        """Transformer-style stack: attention + layer norm + ffn."""
+        m = build([SelfAttentionLayer(n_heads=2, head_size=2),
+                   LayerNormalizationLayer(),
+                   DenseLayer(n_out=8, activation="gelu"),
+                   RnnOutputLayer(n_out=3)],
+                  InputType.recurrent(4, 6))
+        x = RNG.normal(size=(2, 6, 4))
+        y = onehot(RNG.integers(0, 3, (2, 6)), 3)
+        assert check_model_gradients(m, x, y, subset=30, print_results=True)
