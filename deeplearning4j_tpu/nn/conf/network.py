@@ -46,6 +46,10 @@ class GlobalConf:
     # compute is cast to this (e.g. "bfloat16" → MXU fast path, f32 master
     # weights). None = single-precision throughout.
     compute_dtype: Optional[str] = None
+    # rematerialization: recompute layer activations in the backward pass
+    # instead of storing them (jax.checkpoint per layer) — trades FLOPs for
+    # HBM, the workspace/memory-strategy lever for deep nets
+    gradient_checkpointing: bool = False
     optimization_algo: str = "stochastic_gradient_descent"
     max_num_line_search_iterations: int = 5
 
@@ -138,6 +142,13 @@ class Builder:
         """Mixed precision: cast forward/backward compute to ``dt`` while
         params and updater state stay in ``dtype`` (master weights)."""
         self._g.compute_dtype = dt
+        return self
+
+    def gradient_checkpointing(self, enabled: bool = True) -> "Builder":
+        """Rematerialize layer activations in the backward pass
+        (jax.checkpoint) — less HBM for deep networks, ~1 extra forward of
+        compute."""
+        self._g.gradient_checkpointing = enabled
         return self
 
     def mini_batch(self, b: bool) -> "Builder":

@@ -151,8 +151,13 @@ class ComputationGraph:
                     new_carries[name] = c
                     acts[name] = y
                 else:
-                    y, st = layer.forward(params[name], h, state=states[name],
-                                          train=train, rng=rngs[vi], mask=cur_mask)
+                    fwd = lambda p, hh, _l=layer, _n=name, _vi=vi: _l.forward(
+                        p, hh, state=states[_n], train=train, rng=rngs[_vi],
+                        mask=cur_mask)
+                    if train and conf.global_conf.gradient_checkpointing:
+                        # rematerialize activations in the backward pass
+                        fwd = jax.checkpoint(fwd)
+                    y, st = fwd(params[name], h)
                     new_states[name] = st if st else states[name]
                     acts[name] = y
                 # mask collapses when time dim disappears (MLN parity)

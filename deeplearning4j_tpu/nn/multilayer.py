@@ -143,8 +143,14 @@ class MultiLayerNetwork:
                 new_carries.append(c)
                 h = y
             else:
-                h, st = layer.forward(params[i], h, state=states[i], train=train,
-                                      rng=rngs[i], mask=cur_mask)
+                fwd = lambda p, hh, _l=layer, _i=i: _l.forward(
+                    p, hh, state=states[_i], train=train, rng=rngs[_i],
+                    mask=cur_mask)
+                if train and self.conf.global_conf.gradient_checkpointing:
+                    # rematerialize this layer's activations in the backward
+                    # pass instead of storing them (HBM ↔ FLOPs trade)
+                    fwd = jax.checkpoint(fwd)
+                h, st = fwd(params[i], h)
                 new_states.append(st if st else states[i])
                 new_carries.append(None)
             # feed-forward layers collapse per-timestep masks only when the
