@@ -29,6 +29,7 @@ from deeplearning4j_tpu.parallel.compression import (  # noqa: F401
     EncodingHandler,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference  # noqa: F401
+from deeplearning4j_tpu.parallel.dcn import CrossSliceGradientBridge  # noqa: F401
 from deeplearning4j_tpu.parallel.master import (  # noqa: F401
     DistributedMultiLayerNetwork,
     ParameterAveragingTrainingMaster,

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Test runner (role of the reference's runtests.sh): full suite on the
+# virtual 8-device CPU mesh, then the benchmark if a device is available.
+set -euo pipefail
+cd "$(dirname "$0")"
+python -m pytest tests/ -q "$@"
