@@ -1,0 +1,147 @@
+"""Mixture-of-experts layer with expert-parallel mesh execution.
+
+Not in the reference (SURVEY.md §2.b lists expert parallelism as absent) —
+a TPU-first addition: a gated expert FFN layer usable like any other layer,
+plus :func:`ep_forward`, which shards the expert dimension over a mesh axis
+(each device holds its experts' weights, computes their weighted contribution
+for all tokens, and one ``psum`` combines — parameter memory scales 1/E_axis
+while the math stays identical to the single-device layer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+EXPERT_AXIS = "expert"
+
+
+def _route(wg, x, top_k: int):
+    """Router: dense [..., E] gate vector, top-k renormalized. Shared by the
+    single-device apply and the expert-parallel worker so the two paths can
+    never diverge."""
+    logits = x @ wg                                 # [..., E]
+    e = logits.shape[-1]
+    k = min(top_k, e)
+    top_vals, top_idx = jax.lax.top_k(logits, k)    # [..., k]
+    gates_k = jax.nn.softmax(top_vals, axis=-1)     # renormalized over top-k
+    return jnp.sum(
+        jax.nn.one_hot(top_idx, e, dtype=x.dtype) * gates_k[..., None],
+        axis=-2)                                    # [..., E]
+
+
+def _moe_apply(params, x, top_k: int, act):
+    """Dense-compute MoE: every expert runs, gates select/weight.
+
+    x: [..., d_in] → [..., d_out]. Dense all-expert compute keeps shapes
+    static (jit-friendly) and is exactly what the EP sharding distributes.
+    """
+    gates = _route(params["Wg"], x, top_k)
+    hidden = jnp.einsum("...d,edh->...eh", x, params["W"]) + params["b"]
+    hidden = act(hidden)
+    return jnp.einsum("...eh,...e->...h", hidden, gates), gates
+
+
+@register_layer
+@dataclasses.dataclass
+class MixtureOfExpertsLayer(Layer):
+    """Gated expert FFN: router picks top_k of n_experts per token."""
+
+    n_in: int = 0
+    n_out: int = 0
+    n_experts: int = 4
+    top_k: int = 2
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "relu"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "recurrent":
+            return InputType.recurrent(self.n_out, input_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def param_shapes(self):
+        return {"Wg": (self.n_in, self.n_experts),
+                "W": (self.n_experts, self.n_in, self.n_out),
+                "b": (self.n_experts, self.n_out)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        k1, k2 = jax.random.split(rng)
+        w = jnp.stack([
+            self._init_w(k, (self.n_in, self.n_out), self.n_in, self.n_out,
+                         dtype)
+            for k in jax.random.split(k2, self.n_experts)])
+        return {"Wg": self._init_w(k1, (self.n_in, self.n_experts),
+                                   self.n_in, self.n_experts, dtype),
+                "W": w,
+                "b": jnp.zeros((self.n_experts, self.n_out), dtype)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None,
+                mask=None):
+        x = self._dropout(x, train, rng)
+        out, _ = _moe_apply(params, x, self.top_k, self.act_fn())
+        return out, state or {}
+
+
+def load_balancing_loss(gates: jax.Array) -> jax.Array:
+    """Switch-style auxiliary loss: E * sum_e mean_gate_e * dispatch_frac_e,
+    where dispatch fraction counts each token toward its top expert —
+    minimized (at 1) when routing is uniform across experts."""
+    e = gates.shape[-1]
+    flat = gates.reshape(-1, e)
+    importance = jnp.mean(flat, axis=0)
+    top = jax.nn.one_hot(jnp.argmax(flat, axis=-1), e, dtype=flat.dtype)
+    dispatch = jnp.mean(top, axis=0)
+    return e * jnp.sum(importance * dispatch)
+
+
+def ep_forward(layer: MixtureOfExpertsLayer, params, x, mesh: Mesh,
+               axis_name: str = EXPERT_AXIS):
+    """Expert-parallel execution: expert tensors sharded over ``axis_name``.
+
+    Router weights stay replicated (they're tiny); each device computes its
+    expert shard's gated contribution for every token and a psum combines.
+    Numerically identical to the single-device forward.
+    """
+    from deeplearning4j_tpu.parallel.mesh import shard_map
+
+    act = layer.act_fn()
+    top_k = layer.top_k
+    n_exp = layer.n_experts
+    n_shards = int(mesh.shape[axis_name])
+    if n_exp % n_shards:
+        raise ValueError(f"n_experts ({n_exp}) must divide over the "
+                         f"{axis_name!r} axis ({n_shards})")
+    per = n_exp // n_shards
+
+    def worker(wg, w, b, xx):
+        # gating needs ALL experts' logits: router replicated
+        gates = _route(wg, xx, top_k)                # [..., E]
+        # this shard's slice of the gate vector
+        s = jax.lax.axis_index(axis_name)
+        local_gates = jax.lax.dynamic_slice_in_dim(
+            gates, s * per, per, axis=gates.ndim - 1)
+        hidden = jnp.einsum("...d,edh->...eh", xx, w) + b
+        hidden = act(hidden)
+        partial = jnp.einsum("...eh,...e->...h", hidden, local_gates)
+        return jax.lax.psum(partial, axis_name)
+
+    mapped = shard_map(
+        worker, mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name), P()),
+        out_specs=P())
+    return mapped(params["Wg"], params["W"], params["b"], jnp.asarray(x))

@@ -141,9 +141,17 @@ class CrossSliceGradientBridge:
             frame = self.consumer.poll(timeout=timeout)
             if frame is None:
                 break
-            hlen = struct.unpack(">I", frame[:4])[0]
-            meta = json.loads(frame[4:4 + hlen].decode())
-            if meta.get("slice") == self.slice_id:
+            try:
+                hlen = struct.unpack(">I", frame[:4])[0]
+                meta = json.loads(frame[4:4 + hlen].decode())
+                slice_tag = meta.get("slice")
+                thr = float(meta["threshold"])
+                sections = meta["sections"]
+            except (struct.error, json.JSONDecodeError, UnicodeDecodeError,
+                    KeyError, ValueError, TypeError) as e:
+                log.warning("Dropping unparseable frame: %s", e)
+                continue
+            if slice_tag == self.slice_id:
                 # own broadcast echoed back (broker fan-out); skip payload
                 continue
             if dense is None:
@@ -151,29 +159,37 @@ class CrossSliceGradientBridge:
                               for k, v in layer.items()}
                          for lk, layer in self._layers(params)}
             off = 4 + hlen
-            thr = float(meta["threshold"])
             decoded_any = False
-            for s in meta["sections"]:
-                is_dense = s["count"] == -1
-                n_bytes = (s["size"] if is_dense else s["count"]) * 4
-                payload = frame[off:off + n_bytes]
-                off += n_bytes
-                lk = s["layer"]
-                # validate against the LOCAL model: unknown names or size
-                # mismatches (version-skewed peer, corrupt frame) are skipped
-                # — never an out-of-bounds write into the native decoder
-                target = dense.get(lk, {}).get(s["param"]) \
-                    if isinstance(dense.get(lk), dict) else None
-                if target is None or len(target) != s["size"]:
-                    log.warning("Skipping mismatched section %r/%r from %s",
-                                lk, s["param"], meta.get("slice"))
-                    continue
-                if is_dense:
-                    target += np.frombuffer(payload, np.float32)
-                else:
-                    msg = np.frombuffer(payload, np.int32)
-                    decode_threshold(msg, thr, len(target), out=target)
-                decoded_any = decoded_any or n_bytes > 0
+            try:
+                for s in sections:
+                    is_dense = s["count"] == -1
+                    n_bytes = (s["size"] if is_dense else s["count"]) * 4
+                    if off + n_bytes > len(frame):
+                        raise ValueError("frame truncated mid-section")
+                    payload = frame[off:off + n_bytes]
+                    off += n_bytes
+                    lk = s["layer"]
+                    # validate against the LOCAL model: unknown names or size
+                    # mismatches (version-skewed peer, corrupt frame) are
+                    # skipped — never an out-of-bounds write in the decoder
+                    target = dense.get(lk, {}).get(s["param"]) \
+                        if isinstance(dense.get(lk), dict) else None
+                    if target is None or len(target) != s["size"]:
+                        log.warning("Skipping mismatched section %r/%r from %s",
+                                    lk, s["param"], meta.get("slice"))
+                        continue
+                    if is_dense:
+                        target += np.frombuffer(payload, np.float32)
+                    else:
+                        msg = np.frombuffer(payload, np.int32)
+                        decode_threshold(msg, thr, len(target), out=target)
+                    decoded_any = decoded_any or n_bytes > 0
+            except (ValueError, KeyError, TypeError) as e:
+                # a malformed frame must not kill training or discard the
+                # frames already decoded into `dense` this call
+                log.warning("Dropping malformed frame from %s: %s",
+                            meta.get("slice"), e)
+                continue
             if decoded_any:
                 applied += 1
         if dense is None or applied == 0:

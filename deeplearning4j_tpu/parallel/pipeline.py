@@ -1,0 +1,173 @@
+"""Pipeline parallelism: stage-sharded training over a 'pipe' mesh axis.
+
+The reference has NO pipeline parallelism (SURVEY.md §2.b: "optional: stage
+sharding via shard_map + collective permute") — this is a TPU-first addition
+the brief treats as first-class. Design: GPipe-style microbatching expressed
+as one compiled program.
+
+- Every stage runs the SAME computation shape (uniform inter-stage width), so
+  the whole pipeline is a single ``shard_map`` over the 'pipe' axis with
+  stage-stacked parameters ``[S, ...]`` sharded on axis 0 — stage identity is
+  ``lax.axis_index``.
+- The schedule is a ``lax.scan`` over ``n_micro + S - 1`` ticks; activations
+  hop stages with ``lax.ppermute`` each tick (fill-and-drain bubble included).
+- Backward needs no hand-written schedule: ``jax.grad`` through the scan and
+  the ppermute transposes into the reverse pipeline automatically — the
+  compiler emits the backward collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import PIPELINE_AXIS, shard_map
+
+PIPE_AXIS = PIPELINE_AXIS  # canonical axis name lives in parallel/mesh.py
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, micro_x,
+                     *, axis_name: str = PIPE_AXIS):
+    """Run microbatches through the stage pipeline (call INSIDE shard_map).
+
+    stage_fn(params_stage, x) -> y with x/y of identical shape.
+    stacked_params: this stage's slice (leading dim 1 stripped by the caller).
+    micro_x: [n_micro, B_micro, ...] — every stage receives the full
+    microbatch stack; only stage 0 actually consumes it.
+    Returns [n_micro, B_micro, ...] outputs as produced by the LAST stage
+    (zeros elsewhere), so the caller psums/selects at the loss.
+    """
+    s = jax.lax.axis_index(axis_name)
+    n_stages = jax.lax.psum(1, axis_name)
+    n_micro = micro_x.shape[0]
+    ticks = n_micro + n_stages - 1
+    buf_shape = micro_x.shape[1:]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (when in range); others take the
+        # activation handed over from the previous stage
+        idx = jnp.clip(t, 0, n_micro - 1)
+        feed = jnp.where(s == 0, 1.0, 0.0)
+        x_in = feed * micro_x[idx] + (1.0 - feed) * state
+        y = stage_fn(stacked_params, x_in)
+        # last stage writes its finished microbatch (tick t finishes
+        # microbatch t - (S-1) at the last stage)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        is_last = s == n_stages - 1
+        valid = jnp.logical_and(is_last, t >= n_stages - 1)
+        outputs = jax.lax.cond(
+            valid,
+            lambda o: o.at[out_idx].set(y),
+            lambda o: o,
+            outputs)
+        # hand activations to the next stage (ring permute; the wraparound
+        # into stage 0 is ignored because stage 0 always feeds from micro_x)
+        nxt = jax.lax.ppermute(
+            y, axis_name,
+            [(i, (i + 1) % n_stages) for i in range(n_stages)])
+        return (nxt, outputs), None
+
+    state0 = jnp.zeros(buf_shape, micro_x.dtype)
+    outputs0 = jnp.zeros_like(micro_x)
+    (_, outputs), _ = jax.lax.scan(tick, (state0, outputs0),
+                                   jnp.arange(ticks))
+    # replicate the last stage's outputs to every stage (zero elsewhere)
+    return jax.lax.psum(outputs, axis_name)
+
+
+class PipelineParallel:
+    """Stage-sharded trainer for a uniform stack of stage functions.
+
+    ``stage_init(rng) -> params`` and ``stage_fn(params, x) -> y`` define one
+    stage (x, y same shape); ``loss_fn(y, labels) -> scalar`` scores the final
+    stage's output. ``fit_step`` runs forward + backward + SGD across all
+    stages in ONE jitted shard_map program.
+    """
+
+    def __init__(self, mesh: Mesh, stage_init: Callable, stage_fn: Callable,
+                 loss_fn: Callable, n_stages: Optional[int] = None,
+                 learning_rate: float = 0.1, axis_name: str = PIPE_AXIS,
+                 seed: int = 0):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_stages = n_stages or int(mesh.shape[axis_name])
+        if self.n_stages != int(mesh.shape[axis_name]):
+            # each device holds exactly one stage (worker reads a[0]); a
+            # mismatch would silently compute with a subset of the stages
+            raise ValueError(
+                f"n_stages ({self.n_stages}) must equal the {axis_name!r} "
+                f"mesh axis size ({int(mesh.shape[axis_name])})")
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.learning_rate = learning_rate
+        keys = jax.random.split(jax.random.PRNGKey(seed), self.n_stages)
+        per_stage = [stage_init(k) for k in keys]
+        # stack stage params on a leading axis sharded over 'pipe'
+        self.params = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *per_stage)
+        self._step = None
+        self._fwd = None
+
+    def _build(self):
+        axis = self.axis_name
+        stage_fn = self.stage_fn
+        loss_fn = self.loss_fn
+        lr = self.learning_rate
+
+        def worker(stacked, micro_x, micro_y):
+            local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+            n_stages = jax.lax.psum(1, axis)
+
+            def loss_of(p):
+                outs = pipeline_forward(stage_fn, p, micro_x, axis_name=axis)
+                per_micro = jax.vmap(loss_fn)(outs, micro_y)
+                # every stage evaluates the SAME replicated loss, and the
+                # psum transpose sums the S identical cotangent streams —
+                # divide here so the differentiated quantity is the true loss
+                return jnp.mean(per_micro) / n_stages
+
+            loss_scaled, grads = jax.value_and_grad(loss_of)(local)
+            loss = loss_scaled * n_stages
+            # each stage's grads live on that stage; no all-reduce needed
+            new_local = jax.tree_util.tree_map(
+                lambda p, g: p - lr * g, local, grads)
+            new_stacked = jax.tree_util.tree_map(
+                lambda a: a[None], new_local)
+            return new_stacked, jax.lax.pmax(loss, axis)
+
+        rep = P()
+        mapped = shard_map(
+            worker, mesh=self.mesh,
+            in_specs=(P(self.axis_name), rep, rep),
+            out_specs=(P(self.axis_name), rep))
+        return jax.jit(mapped, donate_argnums=(0,))
+
+    def fit_step(self, micro_x, micro_y) -> float:
+        """One pipelined train step over [n_micro, B_micro, ...] batches."""
+        if self._step is None:
+            self._step = self._build()
+        self.params, loss = self._step(self.params,
+                                       jnp.asarray(micro_x),
+                                       jnp.asarray(micro_y))
+        return loss
+
+    def forward(self, micro_x):
+        """Pipelined inference: [n_micro, B, ...] -> outputs of the stack."""
+        if self._fwd is None:
+            axis = self.axis_name
+            stage_fn = self.stage_fn
+
+            def worker(stacked, micro_x):
+                local = jax.tree_util.tree_map(lambda a: a[0], stacked)
+                return pipeline_forward(stage_fn, local, micro_x,
+                                        axis_name=axis)
+
+            self._fwd = jax.jit(shard_map(
+                worker, mesh=self.mesh,
+                in_specs=(P(self.axis_name), P()), out_specs=P()))
+        return self._fwd(self.params, jnp.asarray(micro_x))

@@ -146,6 +146,29 @@ class TestCrossSliceBridge:
         # tensors that fit the sparse format keep sub-threshold remainder
         assert float(np.abs(bridge_a._residual[0]["W"]).sum()) < 1e-6
 
+    def test_malformed_frame_skipped(self):
+        """Truncated/corrupt frames log-and-skip instead of killing training."""
+        broker = EmbeddedBroker()
+        a = _BrokerEndpoint(broker, "m", "ga")
+        b = _BrokerEndpoint(broker, "m", "gb")
+        bridge_a = CrossSliceGradientBridge(a, a, threshold=1e-8,
+                                            capacity_fraction=0.01,
+                                            slice_id="A")
+        bridge_b = CrossSliceGradientBridge(b, b, threshold=1e-8,
+                                            slice_id="B")
+        net_a, net_b = _net(1), _net(1)
+        bridge_a.publish_update(net_a.params)
+        net_a.fit(_data(64, seed=6))
+        bridge_a.publish_update(net_a.params)
+        # corrupt the frame in flight: truncate by a few bytes
+        frame = b.broker.poll("m", "gb", timeout=0.5)
+        assert frame is not None
+        b.broker.publish("m", frame[:-5])
+        # also inject pure garbage
+        b.broker.publish("m", b"\x00\x00\x00\x02{}")
+        params, applied = bridge_b.poll_and_apply(net_b.params, timeout=0.2)
+        assert applied == 0  # nothing valid applied, nothing crashed
+
     def test_no_frame_when_nothing_passes(self):
         broker = EmbeddedBroker()
         end = _BrokerEndpoint(broker, "e", "g")

@@ -1,0 +1,105 @@
+"""Mixture-of-experts tests: layer math, training, and expert-parallel
+equivalence over the mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.moe import (
+    EXPERT_AXIS,
+    MixtureOfExpertsLayer,
+    ep_forward,
+    load_balancing_loss,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+
+class TestMoELayer:
+    def test_top1_selects_single_expert(self, rng):
+        layer = MixtureOfExpertsLayer(n_in=6, n_out=6, n_experts=4, top_k=1)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        x = jnp.asarray(rng.normal(size=(5, 6)).astype(np.float32))
+        out, _ = layer.forward(params, x)
+        assert out.shape == (5, 6)
+        # manual: the argmax expert's FFN output
+        logits = np.asarray(x @ params["Wg"])
+        for i in range(5):
+            e = int(np.argmax(logits[i]))
+            manual = np.maximum(
+                np.asarray(x[i]) @ np.asarray(params["W"][e])
+                + np.asarray(params["b"][e]), 0.0)
+            np.testing.assert_allclose(np.asarray(out[i]), manual,
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_top2_gates_renormalized(self, rng):
+        layer = MixtureOfExpertsLayer(n_in=4, n_experts=3, top_k=2)
+        layer.set_n_in(InputType.feed_forward(4))
+        params = layer.init_params(jax.random.PRNGKey(1))
+        x = jnp.asarray(rng.normal(size=(7, 4)).astype(np.float32))
+        from deeplearning4j_tpu.nn.layers.moe import _moe_apply
+        _, gates = _moe_apply(params, x, 2, layer.act_fn())
+        g = np.asarray(gates)
+        np.testing.assert_allclose(g.sum(-1), 1.0, atol=1e-5)
+        assert ((g > 1e-9).sum(-1) <= 2).all()  # at most 2 experts active
+
+    def test_trains_in_network(self, rng):
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(MixtureOfExpertsLayer(n_out=16, n_experts=4, top_k=2))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        y_idx = rng.integers(0, 3, 256)
+        x = rng.normal(size=(256, 8)).astype(np.float32)
+        x[np.arange(256), y_idx] += 2.5
+        ds = DataSet(x, np.eye(3, dtype=np.float32)[y_idx])
+        net.fit(ListDataSetIterator(ds, 64, shuffle=True), epochs=12)
+        assert net.evaluate(ListDataSetIterator(ds, 256)).accuracy() > 0.85
+
+    def test_sequence_input(self, rng):
+        layer = MixtureOfExpertsLayer(n_in=4, n_out=4, n_experts=2, top_k=1)
+        params = layer.init_params(jax.random.PRNGKey(2))
+        x = jnp.asarray(rng.normal(size=(3, 5, 4)).astype(np.float32))
+        out, _ = layer.forward(params, x)
+        assert out.shape == (3, 5, 4)
+
+    def test_load_balancing_loss_prefers_uniform(self):
+        uniform = jnp.full((10, 4), 0.25)
+        skewed = jnp.zeros((10, 4)).at[:, 0].set(1.0)
+        assert float(load_balancing_loss(skewed)) > \
+            float(load_balancing_loss(uniform))
+
+
+class TestExpertParallel:
+    def test_ep_matches_single_device(self, rng):
+        """Expert-sharded mesh execution == plain forward (the EP lock)."""
+        layer = MixtureOfExpertsLayer(n_in=8, n_out=8, n_experts=8, top_k=2)
+        params = layer.init_params(jax.random.PRNGKey(3))
+        x = jnp.asarray(rng.normal(size=(6, 8)).astype(np.float32))
+        plain, _ = layer.forward(params, x)
+        mesh = make_mesh({EXPERT_AXIS: 8})
+        sharded = ep_forward(layer, params, x, mesh)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_ep_partial_shards(self, rng):
+        """8 experts over 4 shards (2 experts per device)."""
+        layer = MixtureOfExpertsLayer(n_in=6, n_out=6, n_experts=8, top_k=1)
+        params = layer.init_params(jax.random.PRNGKey(4))
+        x = jnp.asarray(rng.normal(size=(4, 6)).astype(np.float32))
+        plain, _ = layer.forward(params, x)
+        mesh = make_mesh({EXPERT_AXIS: 4})
+        sharded = ep_forward(layer, params, x, mesh)
+        np.testing.assert_allclose(np.asarray(sharded), np.asarray(plain),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_indivisible_raises(self):
+        layer = MixtureOfExpertsLayer(n_in=4, n_out=4, n_experts=6)
+        params = layer.init_params(jax.random.PRNGKey(5))
+        mesh = make_mesh({EXPERT_AXIS: 4})
+        with pytest.raises(ValueError):
+            ep_forward(layer, params, jnp.zeros((2, 4)), mesh)
