@@ -20,7 +20,8 @@ from typing import Dict, Optional
 _HELPERS: Dict[str, object] = {}
 _VERSION = 0  # bumped on every registry change; part of every jit cache key
 
-KINDS = ("lstm", "convolution", "subsampling", "batch_norm", "lrn")
+KINDS = ("lstm", "convolution", "subsampling", "batch_norm", "lrn",
+         "attention")
 
 
 def evict_stale_jit_entries(cache: Dict, current_version: int) -> None:
@@ -71,4 +72,15 @@ class LSTMHelper:
         return False
 
     def forward_seq(self, layer, params, x, carry):  # pragma: no cover
+        raise NotImplementedError
+
+
+class AttentionHelper:
+    """Interface for fused attention kernels (no reference counterpart —
+    the snapshot predates attention; same seam pattern as the cuDNN five)."""
+
+    def supports(self, layer, q_shape, mask, dropout_active) -> bool:  # pragma: no cover
+        return False
+
+    def attend(self, q, k, v):  # pragma: no cover - interface
         raise NotImplementedError

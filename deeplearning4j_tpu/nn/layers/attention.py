@@ -22,7 +22,19 @@ from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 
 
 def dot_product_attention(q, k, v, mask=None, dropout_rate=0.0, rng=None, train=False):
-    """q,k,v: [N, H, T, Dh]; mask: [N, T] (1=valid) or [N, 1, Tq, Tk]."""
+    """q,k,v: [N, H, T, Dh]; mask: [N, T] (1=valid) or [N, 1, Tq, Tk].
+
+    Consults the "attention" helper seam first: a registered fused kernel
+    (e.g. PallasFlashAttentionHelper) takes supported shapes; otherwise the
+    einsum path below runs (and XLA fuses it).
+    """
+    from deeplearning4j_tpu.nn import helpers as _helpers
+    helper = _helpers.get_helper("attention")
+    dropout_active = bool(train and dropout_rate > 0 and rng is not None)
+    if (helper is not None
+            and helper.supports(None, q.shape, mask, dropout_active)
+            and q.shape == k.shape == v.shape):
+        return helper.attend(q, k, v)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
     if mask is not None:

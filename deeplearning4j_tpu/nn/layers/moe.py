@@ -93,8 +93,12 @@ class MixtureOfExpertsLayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None,
                 mask=None):
         x = self._dropout(x, train, rng)
-        out, _ = _moe_apply(params, x, self.top_k, self.act_fn())
-        return out, state or {}
+        out, gates = _moe_apply(params, x, self.top_k, self.act_fn())
+        # gates surface through the state so callers can add
+        # load_balancing_loss(gates) to the objective
+        new_state = dict(state or {})
+        new_state["gates"] = gates
+        return out, new_state
 
 
 def load_balancing_loss(gates: jax.Array) -> jax.Array:

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from deeplearning4j_tpu.nn.helpers import LSTMHelper
+from deeplearning4j_tpu.nn.helpers import AttentionHelper, LSTMHelper
 
 
 def _lstm_kernel(hidden: int, t_total: int,
@@ -158,3 +158,47 @@ class PallasLSTMHelper(LSTMHelper):
         rw = params["RW"][:, :4 * layer.n_out]
         ys, hn, cn = lstm_fused(xw, rw, h0, c0, self.interpret)
         return jnp.swapaxes(ys, 0, 1), (hn, cn)
+
+
+class PallasFlashAttentionHelper(AttentionHelper):
+    """Blockwise (flash) attention via the Pallas TPU kernel bundled with
+    jax (`jax.experimental.pallas.ops.tpu.flash_attention`) — O(T) memory
+    instead of materializing the [N,H,T,T] score matrix, with the module's
+    own custom VJP for the backward.
+
+    Opt-in, and specifically a MEMORY lever: measured on v5e (8 heads,
+    dh=64), the einsum path is faster at T=1024-4096 (28 vs 39 ms/step at
+    T=1024), but its score matrix is O(T^2) HBM — flash keeps memory linear
+    in T, unlocking sequence lengths the einsum path cannot hold.
+    (Combine with ``gradient_checkpointing`` for the einsum path's memory
+    relief at moderate T.)
+
+    Conservative support gate: TPU backend, no mask, no attention dropout,
+    sequence length a multiple of 128, head dim in {64, 128, 256} (the tile
+    shapes the kernel is built for); everything else falls back to the
+    built-in einsum attention.
+    """
+
+    def __init__(self, causal: bool = False):
+        self.causal = causal
+
+    def supports(self, layer, q_shape, mask, dropout_active) -> bool:
+        if jax.default_backend() != "tpu":
+            return False
+        if self.causal:
+            # the built-in einsum path expresses causality via mask (which
+            # this gate rejects): a causal helper at the seam would change
+            # semantics vs the fallback. causal=True is for direct attend()
+            # calls only.
+            return False
+        if mask is not None or dropout_active:
+            return False
+        t, dh = q_shape[-2], q_shape[-1]
+        return t % 128 == 0 and dh in (64, 128, 256)
+
+    def attend(self, q, k, v):
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+
+        scale = float(1.0 / (q.shape[-1] ** 0.5))
+        return flash_attention(q, k, v, causal=self.causal, sm_scale=scale)

@@ -162,8 +162,11 @@ class CrossSliceGradientBridge:
             decoded_any = False
             try:
                 for s in sections:
-                    is_dense = s["count"] == -1
-                    n_bytes = (s["size"] if is_dense else s["count"]) * 4
+                    count, size = int(s["count"]), int(s["size"])
+                    if count < -1 or size < 0:
+                        raise ValueError("negative section count/size")
+                    is_dense = count == -1
+                    n_bytes = (size if is_dense else count) * 4
                     if off + n_bytes > len(frame):
                         raise ValueError("frame truncated mid-section")
                     payload = frame[off:off + n_bytes]
@@ -174,7 +177,7 @@ class CrossSliceGradientBridge:
                     # skipped — never an out-of-bounds write in the decoder
                     target = dense.get(lk, {}).get(s["param"]) \
                         if isinstance(dense.get(lk), dict) else None
-                    if target is None or len(target) != s["size"]:
+                    if target is None or len(target) != size:
                         log.warning("Skipping mismatched section %r/%r from %s",
                                     lk, s["param"], meta.get("slice"))
                         continue

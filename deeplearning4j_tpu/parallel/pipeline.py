@@ -52,8 +52,9 @@ def pipeline_forward(stage_fn: Callable, stacked_params, micro_x,
         # stage 0 ingests microbatch t (when in range); others take the
         # activation handed over from the previous stage
         idx = jnp.clip(t, 0, n_micro - 1)
-        feed = jnp.where(s == 0, 1.0, 0.0)
-        x_in = feed * micro_x[idx] + (1.0 - feed) * state
+        # SELECT, not arithmetic blend: a transient inf/NaN in the ring
+        # wraparound must never reach stage 0 (0 * inf = NaN)
+        x_in = jnp.where(s == 0, micro_x[idx], state)
         y = stage_fn(stacked_params, x_in)
         # last stage writes its finished microbatch (tick t finishes
         # microbatch t - (S-1) at the last stage)

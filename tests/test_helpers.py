@@ -119,3 +119,30 @@ class TestPallasLSTMEquivalence:
         step2 = np.asarray(net.rnn_time_step(x[:, 3:]))
         fused_full = np.concatenate([step1, step2], axis=1)
         np.testing.assert_allclose(fused_full, base_full, rtol=2e-5, atol=2e-6)
+
+
+class TestFlashAttentionHelper:
+    def test_supports_gating(self):
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasFlashAttentionHelper
+        h = PallasFlashAttentionHelper()
+        on_tpu = jax.default_backend() == "tpu"
+        # shape gate holds regardless of backend (backend gate may veto)
+        assert h.supports(None, (2, 8, 256, 64), None, False) == on_tpu
+        assert not h.supports(None, (2, 8, 200, 64), None, False)  # T % 128
+        assert not h.supports(None, (2, 8, 256, 48), None, False)  # dh
+        assert not h.supports(None, (2, 8, 256, 64), np.ones((2, 256)), False)
+        assert not h.supports(None, (2, 8, 256, 64), None, True)  # dropout
+
+    def test_matches_einsum_on_tpu(self, rng):
+        if jax.default_backend() != "tpu":
+            pytest.skip("flash attention kernel requires the TPU backend")
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasFlashAttentionHelper
+        from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+        q = jnp.asarray(rng.normal(size=(2, 4, 256, 64)).astype(np.float32))
+        k = jnp.asarray(rng.normal(size=(2, 4, 256, 64)).astype(np.float32))
+        v = jnp.asarray(rng.normal(size=(2, 4, 256, 64)).astype(np.float32))
+        base = dot_product_attention(q, k, v)
+        helpers.set_helper("attention", PallasFlashAttentionHelper())
+        fused = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(base),
+                                   rtol=2e-2, atol=2e-3)
