@@ -71,6 +71,13 @@ class ROC:
         elif labels.ndim == 2 and labels.shape[1] == 1:
             labels = labels[:, 0]
             predictions = predictions[:, 0]
+        elif labels.ndim == 1 and predictions.ndim == 2:
+            # 1-D class labels with [N, 2] probabilities: score class 1
+            if predictions.shape[1] != 2:
+                raise ValueError(
+                    f"ROC is binary: got 1-D labels with [N, "
+                    f"{predictions.shape[1]}] predictions (use ROCMultiClass)")
+            predictions = predictions[:, -1]
         if mask is not None:
             m = np.asarray(mask).astype(bool).ravel()
             labels, predictions = labels[m], predictions[m]

@@ -58,6 +58,11 @@ class MixtureOfExpertsLayer(Layer):
     n_out: int = 0
     n_experts: int = 4
     top_k: int = 2
+    # opt-in: surface routing gates through the layer state (costs one extra
+    # train-step recompile when the state structure changes and serializes
+    # the last batch's gates with checkpoints — leave off unless inspecting
+    # router behaviour)
+    collect_gates: bool = False
 
     def __post_init__(self):
         if self.activation is None:
@@ -94,17 +99,23 @@ class MixtureOfExpertsLayer(Layer):
                 mask=None):
         x = self._dropout(x, train, rng)
         out, gates = _moe_apply(params, x, self.top_k, self.act_fn())
-        # gates surface through the state so callers can add
-        # load_balancing_loss(gates) to the objective
-        new_state = dict(state or {})
-        new_state["gates"] = gates
-        return out, new_state
+        if self.collect_gates:
+            new_state = dict(state or {})
+            new_state["gates"] = gates
+            return out, new_state
+        return out, state or {}
 
 
 def load_balancing_loss(gates: jax.Array) -> jax.Array:
     """Switch-style auxiliary loss: E * sum_e mean_gate_e * dispatch_frac_e,
     where dispatch fraction counts each token toward its top expert —
-    minimized (at 1) when routing is uniform across experts."""
+    minimized (at 1) when routing is uniform across experts.
+
+    To train WITH this aux term, call ``_moe_apply`` (or the layer) inside a
+    custom loss (e.g. a SameDiff-style layer/graph) where the gates are part
+    of the differentiated computation; ``collect_gates=True`` state capture
+    is for *monitoring* only (layer states are non-differentiated aux
+    outputs of the train step)."""
     e = gates.shape[-1]
     flat = gates.reshape(-1, e)
     importance = jnp.mean(flat, axis=0)

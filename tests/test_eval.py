@@ -1,0 +1,159 @@
+"""Evaluation metrics tests (eval/EvalTest.java role): confusion-matrix
+classification metrics, regression metrics, ROC family, binary multi-label
+evaluation, and calibration — validated against hand-computed values."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.binary import EvaluationBinary
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+
+
+def onehot(idx, n):
+    return np.eye(n, dtype=np.float64)[idx]
+
+
+class TestEvaluation:
+    def _eval_fixed(self):
+        # 3 classes; true: [0,0,1,1,2,2]; pred: [0,1,1,1,2,0]
+        e = Evaluation(3)
+        truth = np.array([0, 0, 1, 1, 2, 2])
+        pred_cls = np.array([0, 1, 1, 1, 2, 0])
+        e.eval(onehot(truth, 3), onehot(pred_cls, 3))
+        return e
+
+    def test_confusion_and_metrics(self):
+        e = self._eval_fixed()
+        cm = e.confusion_matrix()
+        assert cm[0, 0] == 1 and cm[0, 1] == 1
+        assert cm[1, 1] == 2
+        assert cm[2, 2] == 1 and cm[2, 0] == 1
+        assert e.accuracy() == pytest.approx(4 / 6)
+        # class 1: tp=2, fp=1, fn=0
+        assert e.precision(1) == pytest.approx(2 / 3)
+        assert e.recall(1) == pytest.approx(1.0)
+        assert e.f1(1) == pytest.approx(2 * (2 / 3) / (2 / 3 + 1.0))
+
+    def test_merge_and_json(self):
+        a = self._eval_fixed()
+        b = self._eval_fixed()
+        a.merge(b)
+        assert a.confusion_matrix().sum() == 12
+        rt = Evaluation.from_json(a.to_json())
+        assert rt.accuracy() == pytest.approx(a.accuracy())
+        assert "Accuracy" in a.stats() or "accuracy" in a.stats().lower()
+
+    def test_time_series_with_mask(self):
+        e = Evaluation(2)
+        labels = onehot(np.array([[0, 1, 0], [1, 0, 1]]).ravel(), 2).reshape(2, 3, 2)
+        preds = labels.copy()  # perfect predictions
+        mask = np.array([[1, 1, 0], [1, 0, 0]], np.float64)
+        e.eval_time_series(labels, preds, labels_mask=mask)
+        assert e.confusion_matrix().sum() == 3  # only unmasked steps counted
+        assert e.accuracy() == 1.0
+
+
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        r = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0], [4.0]])
+        preds = np.array([[1.5], [2.0], [2.5], [4.5]])
+        r.eval(labels, preds)
+        err = labels - preds
+        assert r.mean_squared_error() == pytest.approx(float(np.mean(err ** 2)))
+        assert r.mean_absolute_error() == pytest.approx(float(np.mean(np.abs(err))))
+        assert r.root_mean_squared_error() == pytest.approx(
+            float(np.sqrt(np.mean(err ** 2))))
+        # matches numpy's definition exactly
+        assert r.pearson_correlation() == pytest.approx(
+            float(np.corrcoef(labels[:, 0], preds[:, 0])[0, 1]), abs=1e-9)
+        assert r.r_squared() == pytest.approx(
+            1 - np.sum(err ** 2) / np.sum((labels - labels.mean()) ** 2),
+            abs=1e-6)
+
+    def test_multi_column(self):
+        r = RegressionEvaluation()
+        labels = np.array([[1.0, 10.0], [2.0, 20.0]])
+        preds = np.array([[1.0, 12.0], [2.0, 18.0]])
+        r.eval(labels, preds)
+        assert r.mean_squared_error(0) == pytest.approx(0.0)
+        assert r.mean_squared_error(1) == pytest.approx(4.0)
+        assert r.average_mean_squared_error() == pytest.approx(2.0)
+        assert "MSE" in r.stats() or "mse" in r.stats().lower()
+
+
+class TestROC:
+    def test_perfect_separation_auc_one(self):
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1])
+        scores = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]])
+        roc.eval(onehot(labels, 2), scores)
+        assert roc.calculate_auc() == pytest.approx(1.0)
+        assert roc.calculate_auc_pr() == pytest.approx(1.0)
+
+    def test_random_scores_auc_half(self, rng):
+        roc = ROC()
+        n = 4000
+        labels = rng.integers(0, 2, n)
+        scores = rng.random(n)
+        roc.eval(labels, np.stack([1 - scores, scores], 1))
+        assert abs(roc.calculate_auc() - 0.5) < 0.05
+
+    def test_inverted_scores_auc_zero(self):
+        roc = ROC()
+        labels = np.array([0, 0, 1, 1])
+        scores = np.array([[0.1, 0.9], [0.2, 0.8], [0.8, 0.2], [0.9, 0.1]])
+        roc.eval(onehot(labels, 2), scores)
+        assert roc.calculate_auc() == pytest.approx(0.0)
+
+    def test_roc_binary_per_column(self):
+        rb = ROCBinary()
+        labels = np.array([[1, 0], [1, 0], [0, 1], [0, 1]], np.float64)
+        # col 0 scored perfectly, col 1 inverted
+        scores = np.array([[0.9, 0.9], [0.8, 0.8], [0.1, 0.2], [0.2, 0.1]])
+        rb.eval(labels, scores)
+        assert rb.calculate_auc(0) == pytest.approx(1.0)
+        assert rb.calculate_auc(1) == pytest.approx(0.0)
+
+    def test_roc_multiclass_one_vs_all(self):
+        rm = ROCMultiClass()
+        truth = np.array([0, 1, 2, 0, 1, 2])
+        scores = onehot(truth, 3) * 0.8 + 0.1  # correct class highest
+        rm.eval(onehot(truth, 3), scores)
+        for c in range(3):
+            assert rm.calculate_auc(c) == pytest.approx(1.0)
+
+
+class TestEvaluationBinary:
+    def test_per_label_metrics(self):
+        eb = EvaluationBinary(decision_threshold=0.5)
+        labels = np.array([[1, 0], [1, 1], [0, 1], [0, 0]], np.float64)
+        preds = np.array([[0.9, 0.2], [0.8, 0.4], [0.3, 0.9], [0.1, 0.6]])
+        eb.eval(labels, preds)
+        # col 0: predictions [1,1,0,0] vs [1,1,0,0] → perfect
+        assert eb.accuracy(0) == pytest.approx(1.0)
+        assert eb.f1(0) == pytest.approx(1.0)
+        # col 1: predictions [0,0,1,1] vs [0,1,1,0] → 2/4 correct
+        assert eb.accuracy(1) == pytest.approx(0.5)
+
+
+class TestEvaluationCalibration:
+    def test_perfectly_calibrated(self, rng):
+        cal = EvaluationCalibration(reliability_bins=10)
+        n = 20000
+        p = rng.random(n)
+        labels = (rng.random(n) < p).astype(np.float64)
+        cal.eval(np.stack([1 - labels, labels], 1), np.stack([1 - p, p], 1))
+        assert cal.expected_calibration_error() < 0.03
+
+    def test_overconfident_model_has_high_ece(self, rng):
+        cal = EvaluationCalibration(reliability_bins=10)
+        n = 5000
+        labels = rng.integers(0, 2, n).astype(np.float64)  # coin flips
+        conf = np.full(n, 0.99)  # but the model claims 99% confidence
+        preds = np.stack([1 - conf, conf], 1)
+        cal.eval(np.stack([1 - labels, labels], 1), preds)
+        assert cal.expected_calibration_error() > 0.3
