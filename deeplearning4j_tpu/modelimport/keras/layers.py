@@ -400,6 +400,58 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
             return LastTimeStepWrapper(name=name, layer=layer), wf
         return layer, wf
 
+    if class_name == "GRU":
+        from deeplearning4j_tpu.nn.layers import GRULayer
+
+        units = int(cfg.get("units", cfg.get("output_dim")))
+        # Keras versions that omit the key (Keras 1 / 2.0-2.1) implement the
+        # classic reset-before GRU; reset_after=True appears with Keras 2.2+
+        # configs that always serialize the key
+        reset_after = bool(cfg.get("reset_after", False))
+        layer = GRULayer(
+            name=name, n_out=units, reset_after=reset_after,
+            activation=map_activation(cfg.get("activation", "tanh")),
+            gate_activation=map_activation(
+                cfg.get("recurrent_activation",
+                        cfg.get("inner_activation", "sigmoid"))))
+
+        def gru_weights(raw):
+            # keras GRU: kernel [C, 3H] (z|r|h), recurrent_kernel [H, 3H],
+            # bias [2, 3H] when reset_after else [3H]
+            if "kernel" not in raw or "recurrent_kernel" not in raw:
+                raise InvalidKerasConfigurationException(
+                    f"cannot locate GRU weights among {sorted(raw)} "
+                    "(per-gate Keras-1 GRU weight names are not supported)")
+            out = {"W": raw["kernel"], "RW": raw["recurrent_kernel"]}
+            if "bias" in raw:
+                b = np.asarray(raw["bias"])
+                if reset_after and b.ndim == 1:
+                    b = b.reshape(2, -1)
+                out["b"] = b
+            # use_bias=False: the layer's zero-initialized bias stands
+            return out, {}
+
+        if not cfg.get("return_sequences", False):
+            return (LastTimeStepWrapper(name=name, layer=layer), gru_weights)
+        return layer, gru_weights
+
+    if class_name == "TimeDistributed":
+        # TimeDistributed(inner): position-wise layers broadcast over leading
+        # dims here, so the wrapper is transparent for them; anything else
+        # (e.g. TimeDistributed(Conv2D) over video) would need a real
+        # rank-5 path and is rejected loudly
+        inner_cfg = cfg.get("layer", {})
+        inner_cls = inner_cfg.get("class_name")
+        if inner_cls not in ("Dense", "Activation", "Dropout"):
+            raise UnsupportedKerasConfigurationException(
+                f"TimeDistributed({inner_cls}) is not supported (only "
+                "position-wise inner layers: Dense/Activation/Dropout)")
+        inner, wf = map_keras_layer(inner_cls,
+                                    dict(inner_cfg.get("config", {})))
+        if inner is not None:
+            inner.name = name
+        return inner, wf
+
     if class_name == "SimpleRNN":
         units = int(cfg.get("units", cfg.get("output_dim")))
         layer = SimpleRnnLayer(name=name, n_out=units,

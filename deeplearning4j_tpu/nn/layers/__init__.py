@@ -50,6 +50,7 @@ from deeplearning4j_tpu.nn.layers.norm import (  # noqa: F401
     LocalResponseNormalizationLayer,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    GRULayer,
     LSTMLayer,
     GravesLSTMLayer,
     GravesBidirectionalLSTMLayer,

@@ -46,6 +46,26 @@ class BaseRecurrentLayer(Layer):
         y, _ = self.forward_seq(params, x, carry=None, mask=mask, train=train, rng=rng)
         return y, state or {}
 
+    def _scan_seq(self, params, xws, carry, ms):
+        """Shared masked scan over time-major precomputed inputs ``xws``
+        [T,N,*]; cells implement ``_cell_pre(params, xw_t, carry) ->
+        (h, new_carry)``. Masked steps freeze every carry component and zero
+        the output (DL4J variable-length semantics) — ONE implementation for
+        LSTM/GRU/SimpleRnn so the masking convention cannot drift."""
+
+        def step(c, inp):
+            if ms is None:
+                h, new_c = self._cell_pre(params, inp, c)
+                return new_c, h
+            xw_t, m_t = inp
+            h, new_c = self._cell_pre(params, xw_t, c)
+            m = m_t[:, None]
+            new_c = tuple(m * n + (1 - m) * o for n, o in zip(new_c, c))
+            return new_c, h * m
+
+        inputs = xws if ms is None else (xws, ms)
+        return lax.scan(step, carry, inputs)
+
 
 @register_layer
 @dataclasses.dataclass
@@ -141,21 +161,7 @@ class LSTMLayer(BaseRecurrentLayer, Layer):
         xw = x @ params["W"] + params["b"]           # [N,T,4H] on the MXU
         xws = jnp.swapaxes(xw, 0, 1)                 # [T,N,4H]
         ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)  # [T,N]
-
-        def step(c, inp):
-            if ms is None:
-                xw_t = inp
-                h, new_c = self._cell_pre(params, xw_t, c)
-                return new_c, h
-            xw_t, m_t = inp
-            h, new_c = self._cell_pre(params, xw_t, c)
-            m = m_t[:, None]
-            keep = lambda new, old: m * new + (1 - m) * old
-            new_c = (keep(new_c[0], c[0]), keep(new_c[1], c[1]))
-            return new_c, h * m
-
-        inputs = xws if ms is None else (xws, ms)
-        final_carry, ys = lax.scan(step, carry, inputs)
+        final_carry, ys = self._scan_seq(params, xws, carry, ms)
         return jnp.swapaxes(ys, 0, 1), final_carry
 
 
@@ -205,26 +211,16 @@ class SimpleRnnLayer(BaseRecurrentLayer, Layer):
         n, t, _ = x.shape
         if carry is None:
             carry = self.init_carry(n, x.dtype)
-        act = self.act_fn()
         # input projection hoisted out of the recurrence (one MXU matmul)
         xws = jnp.swapaxes(x @ params["W"] + params["b"], 0, 1)  # [T,N,H]
         ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
-
-        def step(c, inp):
-            (h_prev,) = c
-            if ms is None:
-                xw_t = inp
-                h = act(xw_t + h_prev @ params["RW"])
-                return (h,), h
-            xw_t, m_t = inp
-            h = act(xw_t + h_prev @ params["RW"])
-            m = m_t[:, None]
-            h_keep = m * h + (1 - m) * h_prev
-            return (h_keep,), h * m
-
-        inputs = xws if ms is None else (xws, ms)
-        final_carry, ys = lax.scan(step, carry, inputs)
+        final_carry, ys = self._scan_seq(params, xws, carry, ms)
         return jnp.swapaxes(ys, 0, 1), final_carry
+
+    def _cell_pre(self, params, xw_t, carry):
+        (h_prev,) = carry
+        h = self.act_fn()(xw_t + h_prev @ params["RW"])
+        return h, (h,)
 
 
 @register_layer
@@ -394,3 +390,82 @@ class MaskZeroLayer(BaseRecurrentLayer, Layer):
             derived = derived * mask.astype(x.dtype)
         return self.layer.forward_seq(params, x, carry=carry, mask=derived,
                                       train=train, rng=rng)
+
+
+@register_layer
+@dataclasses.dataclass
+class GRULayer(BaseRecurrentLayer, Layer):
+    """GRU with Keras semantics (needed for Keras-import completeness —
+    SURVEY.md §7 hard parts; the reference itself predates GRU).
+
+    Gate order z|r|h in the fused matrices (the Keras kernel layout).
+    ``reset_after=True`` (Keras 2+ default) applies the reset gate AFTER the
+    recurrent matmul and keeps separate input/recurrent biases (b [2, 3H]);
+    ``reset_after=False`` is the classic formulation with one bias [3H].
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    reset_after: bool = True
+    gate_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, input_type.timesteps)
+
+    def param_shapes(self):
+        h = self.n_out
+        b = (2, 3 * h) if self.reset_after else (3 * h,)
+        return {"W": (self.n_in, 3 * h), "RW": (h, 3 * h), "b": b}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        h = self.n_out
+        k1, k2 = jax.random.split(rng)
+        b_shape = (2, 3 * h) if self.reset_after else (3 * h,)
+        return {"W": self._init_w(k1, (self.n_in, 3 * h), self.n_in, 3 * h, dtype),
+                "RW": self._init_w(k2, (h, 3 * h), h, 3 * h, dtype),
+                "b": jnp.zeros(b_shape, dtype)}
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def _cell_pre(self, params, xw_t, carry):
+        (h_prev,) = carry
+        H = self.n_out
+        gate = act_mod.resolve(self.gate_activation)
+        act = self.act_fn()
+        if self.reset_after:
+            rec = h_prev @ params["RW"] + params["b"][1]
+            xz, xr, xh = jnp.split(xw_t, 3, axis=-1)
+            rz, rr, rh = jnp.split(rec, 3, axis=-1)
+            z = gate(xz + rz)
+            r = gate(xr + rr)
+            hh = act(xh + r * rh)
+        else:
+            rw = params["RW"]
+            xz, xr, xh = jnp.split(xw_t, 3, axis=-1)
+            # one fused matmul for the z|r recurrent contributions
+            zr = h_prev @ rw[:, :2 * H]
+            z = gate(xz + zr[:, :H])
+            r = gate(xr + zr[:, H:])
+            hh = act(xh + (r * h_prev) @ rw[:, 2 * H:])
+        h = z * h_prev + (1.0 - z) * hh
+        return h, (h,)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        n, t, _ = x.shape
+        if carry is None:
+            carry = self.init_carry(n, x.dtype)
+        b_in = params["b"][0] if self.reset_after else params["b"]
+        # input projection hoisted out of the recurrence (one MXU matmul)
+        xws = jnp.swapaxes(x @ params["W"] + b_in, 0, 1)  # [T,N,3H]
+        ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+        final_carry, ys = self._scan_seq(params, xws, carry, ms)
+        return jnp.swapaxes(ys, 0, 1), final_carry

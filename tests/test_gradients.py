@@ -311,3 +311,22 @@ class TestNormAttentionGradients:
         x = RNG.normal(size=(2, 6, 4))
         y = onehot(RNG.integers(0, 3, (2, 6)), 3)
         assert check_model_gradients(m, x, y, subset=30, print_results=True)
+
+
+class TestGruGradients:
+    def test_gru_reset_after(self):
+        from deeplearning4j_tpu.nn.layers import GRULayer
+        m = build([GRULayer(n_out=6), RnnOutputLayer(n_out=3)],
+                  InputType.recurrent(4, 5))
+        x = RNG.normal(size=(3, 5, 4))
+        y = onehot(RNG.integers(0, 3, (3, 5)), 3)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_gru_classic(self):
+        from deeplearning4j_tpu.nn.layers import GRULayer
+        m = build([GRULayer(n_out=5, reset_after=False),
+                   RnnOutputLayer(n_out=2)],
+                  InputType.recurrent(3, 4))
+        x = RNG.normal(size=(2, 4, 3))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)

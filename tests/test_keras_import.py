@@ -300,3 +300,51 @@ class TestTransformerImport:
             UnsupportedKerasConfigurationException)
         with _pytest.raises(UnsupportedKerasConfigurationException):
             KerasModelImport.import_keras_model_and_weights(p)
+
+
+class TestGruAndTimeDistributed:
+    def test_gru_output_equivalence(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((7, 5)),
+            kl.GRU(12, return_sequences=True, name="gru1"),
+            kl.GRU(6, return_sequences=False, name="gru2"),
+            kl.Dense(3, activation="softmax", name="out"),
+        ])
+        p = _save(m, tmp_path, "gru.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(2).rand(4, 7, 5).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_gru_trains_after_import(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((6, 4)),
+            kl.GRU(8, return_sequences=True, name="g"),
+            kl.Dense(2, activation="softmax", name="o"),
+        ])
+        p = _save(m, tmp_path, "gru2.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 6, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, (16, 6))]
+        net.fit(DataSet(x, y))
+        first = float(net.score_)
+        for _ in range(10):
+            net.fit(DataSet(x, y))
+        assert float(net.score_) < first
+
+    def test_time_distributed_dense(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((5, 6)),
+            kl.TimeDistributed(kl.Dense(4, activation="relu"), name="td"),
+            kl.GRU(3, return_sequences=True, name="g"),
+        ])
+        p = _save(m, tmp_path, "td.h5")
+        x = np.random.RandomState(1).rand(2, 5, 6).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
