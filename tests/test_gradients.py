@@ -330,3 +330,44 @@ class TestGruGradients:
         x = RNG.normal(size=(2, 4, 3))
         y = onehot(RNG.integers(0, 2, (2, 4)), 2)
         assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+
+class TestCenterLossGradients:
+    def test_center_loss_output(self):
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+        m = build([DenseLayer(n_out=6, activation="tanh"),
+                   CenterLossOutputLayer(n_out=3, alpha=0.1, lambda_=0.01)],
+                  InputType.feed_forward(5))
+        x = RNG.normal(size=(6, 5))
+        y = onehot(RNG.integers(0, 3, 6), 3)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_center_loss_tightens_clusters(self):
+        """The auxiliary term must reduce intra-class feature spread vs a
+        plain output layer (the FaceNet-center-loss tutorial property)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.nn.layers import CenterLossOutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        y_idx = rng.integers(0, 3, 384)
+        x = rng.normal(size=(384, 8)).astype(np.float32)
+        x[np.arange(384), y_idx] += 2.0
+        ds = DataSet(x, onehot(y_idx, 3).astype(np.float32))
+
+        def spread(lambda_):
+            conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+                    .list()
+                    .layer(DenseLayer(n_out=8, activation="tanh"))
+                    .layer(CenterLossOutputLayer(n_out=3, lambda_=lambda_))
+                    .set_input_type(InputType.feed_forward(8)).build())
+            net = MultiLayerNetwork(conf).init()
+            net.fit(ListDataSetIterator(ds, 128, shuffle=True), epochs=25)
+            feats = np.asarray(net.feed_forward(x)[1])  # dense activations
+            total = 0.0
+            for c in range(3):
+                f = feats[y_idx == c]
+                total += float(np.mean((f - f.mean(0)) ** 2))
+            return total
+
+        assert spread(0.5) < spread(0.0)
