@@ -7,8 +7,10 @@ tx,ty,tw,th,conf + C class scores); labels [N, H, W, B*(5)+...] use the same
 packed layout the reference uses: a grid-cell object mask plus target boxes.
 
 Label format here (TPU-simplified but information-equivalent): labels is
-[N, H, W, 4 + 1 + C] — normalized (cx, cy, w, h) in grid units, objectness
-(1 if an object's center falls in the cell), one-hot class.
+[N, H, W, 4 + 1 + C] — (cx, cy, w, h) in grid units with cx/cy ABSOLUTE
+grid coordinates (cell index + in-cell offset, matching the decoded
+predictions ``sigmoid(tx) + grid_x``), objectness (1 if an object's center
+falls in the cell), one-hot class.
 """
 
 from __future__ import annotations
@@ -78,7 +80,11 @@ class Yolo2OutputLayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         return x, state or {}
 
-    def compute_loss(self, params, x, labels, mask=None):
+    def compute_loss(self, params, x, labels, mask=None, conf_target=None):
+        """YOLO2 loss. ``conf_target`` (default: ``stop_gradient(iou)``, the
+        paper's moving target) can be fixed to a constant [N,H,W,B] array —
+        gradient checks use this, because finite differences cannot express
+        stop_gradient (they see the target move; autodiff doesn't)."""
         cx, cy, wh, conf, cls_logits = self._split_predictions(x)
         # labels: [N,H,W,5+C]
         lab_cxy = labels[..., 0:2]
@@ -87,8 +93,7 @@ class Yolo2OutputLayer(Layer):
         lab_cls = labels[..., 5:]
 
         # responsible box = best IoU with the ground-truth box in each cell
-        iou = self._iou(cx, cy, wh,
-                        lab_cxy[..., 0:1] * 0 + lab_cxy[..., None, 0],
+        iou = self._iou(cx, cy, wh, lab_cxy[..., None, 0],
                         lab_cxy[..., None, 1], lab_wh[..., None, :])  # [N,H,W,B]
         best = jnp.argmax(iou, axis=-1)              # [N,H,W]
         resp = jax.nn.one_hot(best, len(self.boxes)) * obj[..., None]  # [N,H,W,B]
@@ -102,7 +107,9 @@ class Yolo2OutputLayer(Layer):
         coord_loss = self.lambda_coord * jnp.sum(resp * (err_xy + err_wh))
 
         # confidence loss: responsible boxes target IoU; others target 0
-        conf_obj = jnp.sum(resp * (conf - jax.lax.stop_gradient(iou)) ** 2)
+        target = jax.lax.stop_gradient(
+            iou if conf_target is None else conf_target)
+        conf_obj = jnp.sum(resp * (conf - target) ** 2)
         conf_noobj = self.lambda_no_obj * jnp.sum((1 - resp) * conf ** 2)
 
         # classification loss (softmax CE in cells with objects)

@@ -371,3 +371,103 @@ class TestCenterLossGradients:
             return total
 
         assert spread(0.5) < spread(0.0)
+
+
+class TestYoloGradients:
+    def _data(self, rng, n=2, h=4, w=4, b=2, c=3):
+        x = rng.normal(size=(n, h, w, b * (5 + c)))
+        labels = np.zeros((n, h, w, 5 + c))
+        # one object per image at a random cell
+        for i in range(n):
+            gy, gx = rng.integers(0, h), rng.integers(0, w)
+            # absolute grid coords: cell index + in-cell offset
+            labels[i, gy, gx, 0:2] = [gx + rng.random(), gy + rng.random()]
+            labels[i, gy, gx, 2:4] = 0.5 + rng.random(2)    # w, h (grid units)
+            labels[i, gy, gx, 4] = 1.0                      # objectness
+            labels[i, gy, gx, 5 + int(rng.integers(0, c))] = 1.0
+        return x, labels
+
+    def test_yolo_loss_gradients(self):
+        """Full gradient check of the YOLO loss with the confidence target
+        FROZEN at the evaluation point: finite differences cannot express
+        stop_gradient (they see the moving IoU target; autodiff by design
+        does not), so the checkable object is the loss with a constant
+        target — which exercises every differentiable path (coords, class,
+        obj/no-obj confidence)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import Yolo2OutputLayer
+        from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+
+        rng = np.random.default_rng(6)
+        layer = Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 0.5)), n_classes=3)
+        layer.set_n_in(InputType.convolutional(4, 4, 16))
+        _, labels = self._data(rng)
+        # conv producing the grid from an image (params under check)
+        conv = ConvolutionLayer(n_out=16, kernel_size=(1, 1),
+                                activation="identity")
+        conv.set_n_in(InputType.convolutional(4, 4, 6))
+        params = conv.init_params(jax.random.PRNGKey(0))  # harness casts to f64
+        img = rng.normal(size=(2, 4, 4, 6))  # numpy f64: the harness
+        # casts params to f64; inputs follow via p["W"].dtype below
+
+        def preds_of(p):
+            h, _ = conv.forward(p, jnp.asarray(img, p["W"].dtype))
+            return h
+
+        # freeze the target at the check point
+        lab = jnp.asarray(labels)
+        cx, cy, wh, _, _ = layer._split_predictions(preds_of(params))
+        lab_cxy, lab_wh = lab[..., 0:2], lab[..., 2:4]
+        frozen = np.asarray(layer._iou(
+            cx, cy, wh, lab_cxy[..., None, 0], lab_cxy[..., None, 1],
+            lab_wh[..., None, :]))
+
+        def loss_fn(p):
+            dt = p["W"].dtype
+            return layer.compute_loss({}, preds_of(p),
+                                      jnp.asarray(labels, dt),
+                                      conf_target=jnp.asarray(frozen, dt))
+
+        assert check_gradients_fn(loss_fn, params, subset=60,
+                                  print_results=True)
+
+    def test_yolo_stop_gradient_semantics(self):
+        """The default loss treats the IoU target as constant: its gradient
+        equals the frozen-target gradient evaluated with target = iou(p)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import Yolo2OutputLayer
+
+        rng = np.random.default_rng(3)
+        layer = Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 0.5)), n_classes=3)
+        layer.set_n_in(InputType.convolutional(4, 4, 16))
+        x, labels = self._data(rng)
+        xj, lab = jnp.asarray(x), jnp.asarray(labels)
+        g_default = jax.grad(
+            lambda v: layer.compute_loss({}, v, lab))(xj)
+        cx, cy, wh, _, _ = layer._split_predictions(xj)
+        frozen = layer._iou(cx, cy, wh, lab[..., None, 0], lab[..., None, 1],
+                            lab[..., 2:4][..., None, :])
+        g_frozen = jax.grad(
+            lambda v: layer.compute_loss({}, v, lab,
+                                         conf_target=frozen))(xj)
+        np.testing.assert_allclose(np.asarray(g_default),
+                                   np.asarray(g_frozen), rtol=1e-6, atol=1e-8)
+
+    def test_yolo_loss_penalizes_misses(self):
+        """Loss must be higher when confidence is high in empty cells and
+        low at the object cell than for well-placed predictions."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import Yolo2OutputLayer
+        layer = Yolo2OutputLayer(boxes=((1.0, 1.0), (2.0, 0.5)), n_classes=3)
+        layer.set_n_in(InputType.convolutional(4, 4, 2 * 8))
+        x, labels = self._data(np.random.default_rng(1))
+        base = float(layer.compute_loss({}, jnp.asarray(x), jnp.asarray(labels)))
+        # push all confidences strongly positive everywhere (false alarms)
+        x_bad = x.copy()
+        for bi in range(2):
+            x_bad[..., bi * 8 + 4] = 6.0
+        bad = float(layer.compute_loss({}, jnp.asarray(x_bad), jnp.asarray(labels)))
+        assert np.isfinite(base) and np.isfinite(bad)
+        assert bad > base
