@@ -436,21 +436,65 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         return layer, gru_weights
 
     if class_name == "TimeDistributed":
-        # TimeDistributed(inner): position-wise layers broadcast over leading
-        # dims here, so the wrapper is transparent for them; anything else
-        # (e.g. TimeDistributed(Conv2D) over video) would need a real
-        # rank-5 path and is rejected loudly
+        # Position-wise inner layers (Dense/Activation/Dropout) broadcast over
+        # leading dims natively, so the wrapper is transparent for them.
+        # Anything spatial (Conv2D, pooling, …) gets the real rank-5 path:
+        # TimeDistributedWrapper folds time into batch around the inner layer.
+        # TimeDistributed(Flatten) vanishes — the cnn_seq→rnn auto-preprocessor
+        # of the following layer performs the per-step flatten.
         inner_cfg = cfg.get("layer", {})
         inner_cls = inner_cfg.get("class_name")
-        if inner_cls not in ("Dense", "Activation", "Dropout"):
+        inner, wf = map_keras_layer(inner_cls, dict(inner_cfg.get("config", {})))
+        if inner is None:
+            return None, _no_weights
+        inner.name = name
+        if inner_cls in ("Dense", "Activation", "Dropout"):
+            return inner, wf
+        from deeplearning4j_tpu.nn.layers import TimeDistributedWrapper
+
+        # the wrapper stores the inner layer's params unprefixed, so the
+        # inner weight fn applies directly
+        return TimeDistributedWrapper(name=name, layer=inner), wf
+
+    if class_name == "ConvLSTM2D":
+        filters = int(cfg.get("filters", cfg.get("nb_filter")))
+        if "kernel_size" in cfg:
+            ks = _pair(cfg["kernel_size"])
+        else:  # Keras 1 dialect
+            ks = (int(cfg["nb_row"]), int(cfg["nb_col"]))
+        strides = _pair(cfg.get("strides", cfg.get("subsample")), (1, 1))
+        pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+        if cfg.get("data_format") == "channels_first":
             raise UnsupportedKerasConfigurationException(
-                f"TimeDistributed({inner_cls}) is not supported (only "
-                "position-wise inner layers: Dense/Activation/Dropout)")
-        inner, wf = map_keras_layer(inner_cls,
-                                    dict(inner_cfg.get("config", {})))
-        if inner is not None:
-            inner.name = name
-        return inner, wf
+                "ConvLSTM2D with channels_first data_format is not supported "
+                "(convert the model to channels_last)")
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2DLayer
+
+        layer = ConvLSTM2DLayer(
+            name=name, n_out=filters, kernel_size=ks, stride=strides,
+            dilation=_pair(cfg.get("dilation_rate"), (1, 1)),
+            convolution_mode="same" if pad == "same" else "truncate",
+            has_bias=cfg.get("use_bias", True),
+            forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0,
+            activation=map_activation(cfg.get("activation", "tanh")),
+            gate_activation=map_activation(
+                cfg.get("recurrent_activation", "hard_sigmoid")))
+
+        def convlstm_weights(raw):
+            # kernel [kh,kw,C,4F], recurrent_kernel [kh,kw,F,4F], bias [4F];
+            # Keras gate blocks i|f|c|o → our i|f|o|g along the last axis
+            if "kernel" not in raw or "recurrent_kernel" not in raw:
+                raise InvalidKerasConfigurationException(
+                    f"cannot locate ConvLSTM2D weights among {sorted(raw)}")
+            p = {"W": _lstm_reorder(np.asarray(raw["kernel"]), filters),
+                 "RW": _lstm_reorder(np.asarray(raw["recurrent_kernel"]), filters)}
+            if "bias" in raw:
+                p["b"] = _lstm_reorder(np.asarray(raw["bias"]), filters)
+            return p, {}
+
+        if not cfg.get("return_sequences", False):
+            return LastTimeStepWrapper(name=name, layer=layer), convlstm_weights
+        return layer, convlstm_weights
 
     if class_name == "SimpleRNN":
         units = int(cfg.get("units", cfg.get("output_dim")))

@@ -43,6 +43,9 @@ def _input_type_from_shape(shape) -> InputType:
     if len(shape) == 3:
         h, w, c = shape
         return InputType.convolutional(h, w, c)
+    if len(shape) == 4:
+        t, h, w, c = shape  # image sequence (ConvLSTM2D / TimeDistributed conv)
+        return InputType.recurrent_convolutional(h, w, c, t)
     raise UnsupportedKerasConfigurationException(f"Unsupported input shape {shape}")
 
 

@@ -17,7 +17,7 @@ from typing import Optional, Tuple
 
 @dataclasses.dataclass(frozen=True)
 class InputType:
-    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn3d" | "cnn1d"
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn3d" | "cnn1d" | "cnn_seq"
     size: int = 0                      # ff / rnn feature size
     timesteps: Optional[int] = None    # rnn (None = variable)
     height: int = 0
@@ -49,6 +49,13 @@ class InputType:
                          width=int(width), channels=int(channels))
 
     @staticmethod
+    def recurrent_convolutional(height: int, width: int, channels: int,
+                                timesteps: Optional[int] = None) -> "InputType":
+        """A sequence of images [batch, time, H, W, C] (ConvLSTM2D data)."""
+        return InputType(kind="cnn_seq", height=int(height), width=int(width),
+                         channels=int(channels), timesteps=timesteps)
+
+    @staticmethod
     def recurrent1d(size: int, timesteps: Optional[int] = None) -> "InputType":
         # Convolution1D operates on [batch, time, channels] == rnn layout
         return InputType.recurrent(size, timesteps)
@@ -59,7 +66,7 @@ class InputType:
             return self.size
         if self.kind == "rnn":
             return self.size
-        if self.kind in ("cnn", "cnn_flat"):
+        if self.kind in ("cnn", "cnn_flat", "cnn_seq"):
             return self.height * self.width * self.channels
         if self.kind == "cnn3d":
             return self.depth * self.height * self.width * self.channels
@@ -76,10 +83,21 @@ class InputType:
             return (batch, self.height, self.width, self.channels)
         if self.kind == "cnn3d":
             return (batch, self.depth, self.height, self.width, self.channels)
+        if self.kind == "cnn_seq":
+            t = self.timesteps if self.timesteps is not None else 1
+            return (batch, t, self.height, self.width, self.channels)
         raise ValueError(self.kind)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
+
+    def cnn_seq_to_rnn(self):
+        """Per-step flatten preprocessor for image sequences: [N,T,H,W,C] →
+        [N,T,H*W*C]. Shared by every layer that consumes flat sequence input
+        after a ConvLSTM/TimeDistributed-conv stage."""
+        assert self.kind == "cnn_seq", self.kind
+        return (lambda x: x.reshape(x.shape[0], x.shape[1], -1),
+                InputType.recurrent(self.flat_size(), self.timesteps))
 
     @staticmethod
     def from_dict(d: dict) -> "InputType":

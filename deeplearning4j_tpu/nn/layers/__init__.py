@@ -50,6 +50,7 @@ from deeplearning4j_tpu.nn.layers.norm import (  # noqa: F401
     LocalResponseNormalizationLayer,
 )
 from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
+    ConvLSTM2DLayer,
     GRULayer,
     LSTMLayer,
     GravesLSTMLayer,
@@ -63,7 +64,7 @@ from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoderLayer  # noqa: F
 from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoderLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer  # noqa: F401
-from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer, TimeDistributedWrapper  # noqa: F401
 from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, SameDiffLambdaLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
     SelfAttentionLayer,

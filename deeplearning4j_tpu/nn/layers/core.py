@@ -38,6 +38,9 @@ class DenseLayer(Layer):
         if input_type.kind in ("cnn", "cnn_flat", "cnn3d"):
             flat = input_type.flat_size()
             return (lambda x: x.reshape(x.shape[0], -1), InputType.feed_forward(flat))
+        if input_type.kind == "cnn_seq":
+            # per-step flatten; dense then applies position-wise
+            return input_type.cnn_seq_to_rnn()
         if input_type.kind == "rnn":
             # RnnToFeedForward: fold time into batch [N,T,C] -> [N*T,C]
             return None  # dense applies position-wise below instead

@@ -46,6 +46,13 @@ class BaseRecurrentLayer(Layer):
         y, _ = self.forward_seq(params, x, carry=None, mask=mask, train=train, rng=rng)
         return y, state or {}
 
+    def input_preprocessor(self, input_type: InputType):
+        if input_type.kind == "cnn_seq":
+            # image sequences flatten per step for flat-input recurrent layers
+            # (ConvLSTM2D overrides this — it consumes [N,T,H,W,C] directly)
+            return input_type.cnn_seq_to_rnn()
+        return None
+
     def _scan_seq(self, params, xws, carry, ms):
         """Shared masked scan over time-major precomputed inputs ``xws``
         [T,N,*]; cells implement ``_cell_pre(params, xw_t, carry) ->
@@ -59,7 +66,7 @@ class BaseRecurrentLayer(Layer):
                 return new_c, h
             xw_t, m_t = inp
             h, new_c = self._cell_pre(params, xw_t, c)
-            m = m_t[:, None]
+            m = m_t.reshape(m_t.shape + (1,) * (h.ndim - 1))
             new_c = tuple(m * n + (1 - m) * o for n, o in zip(new_c, c))
             return new_c, h * m
 
@@ -238,6 +245,10 @@ class BidirectionalWrapper(BaseRecurrentLayer, Layer):
 
     def output_type(self, input_type: InputType) -> InputType:
         inner = self.layer.output_type(input_type)
+        if inner.kind == "cnn_seq":  # ConvLSTM2D: combine over channels
+            c = inner.channels * 2 if self.mode == "concat" else inner.channels
+            return InputType.recurrent_convolutional(inner.height, inner.width,
+                                                     c, inner.timesteps)
         size = inner.size * 2 if self.mode == "concat" else inner.size
         return InputType.recurrent(size, inner.timesteps)
 
@@ -245,6 +256,9 @@ class BidirectionalWrapper(BaseRecurrentLayer, Layer):
         super().apply_global_defaults(g)
         if self.layer is not None:
             self.layer.apply_global_defaults(g)
+
+    def input_preprocessor(self, input_type: InputType):
+        return self.layer.input_preprocessor(input_type)
 
     def param_shapes(self):
         inner = self.layer.param_shapes()
@@ -268,7 +282,8 @@ class BidirectionalWrapper(BaseRecurrentLayer, Layer):
         t = x.shape[1]
         idx = jnp.arange(t)[None, :]
         rev_idx = jnp.where(idx < lengths[:, None], lengths[:, None] - 1 - idx, idx)
-        return jnp.take_along_axis(x, rev_idx[:, :, None], axis=1)
+        rev_idx = rev_idx.reshape(rev_idx.shape + (1,) * (x.ndim - 2))
+        return jnp.take_along_axis(x, rev_idx, axis=1)
 
     def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
         fwd_p = {k[2:]: v for k, v in params.items() if k.startswith("f_")}
@@ -332,12 +347,17 @@ class LastTimeStepWrapper(Layer):
 
     def output_type(self, input_type: InputType) -> InputType:
         inner = self.layer.output_type(input_type)
+        if inner.kind == "cnn_seq":  # e.g. wrapped ConvLSTM2D → one image
+            return InputType.convolutional(inner.height, inner.width, inner.channels)
         return InputType.feed_forward(inner.size)
 
     def apply_global_defaults(self, g):
         super().apply_global_defaults(g)
         if self.layer is not None:
             self.layer.apply_global_defaults(g)
+
+    def input_preprocessor(self, input_type: InputType):
+        return self.layer.input_preprocessor(input_type)
 
     def param_shapes(self):
         return self.layer.param_shapes()
@@ -348,10 +368,11 @@ class LastTimeStepWrapper(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         y, _ = self.layer.forward_seq(params, x, mask=mask, train=train, rng=rng)
         if mask is None:
-            out = y[:, -1, :]
+            out = y[:, -1]
         else:
             lengths = jnp.maximum(jnp.sum(mask.astype(jnp.int32), axis=1), 1)
-            out = jnp.take_along_axis(y, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+            idx = (lengths - 1).reshape((-1,) + (1,) * (y.ndim - 1))
+            out = jnp.take_along_axis(y, idx, axis=1)[:, 0]
         return out, state or {}
 
 
@@ -374,6 +395,9 @@ class MaskZeroLayer(BaseRecurrentLayer, Layer):
         super().apply_global_defaults(g)
         if self.layer is not None:
             self.layer.apply_global_defaults(g)
+
+    def input_preprocessor(self, input_type: InputType):
+        return self.layer.input_preprocessor(input_type)
 
     def param_shapes(self):
         return self.layer.param_shapes()
@@ -466,6 +490,135 @@ class GRULayer(BaseRecurrentLayer, Layer):
         b_in = params["b"][0] if self.reset_after else params["b"]
         # input projection hoisted out of the recurrence (one MXU matmul)
         xws = jnp.swapaxes(x @ params["W"] + b_in, 0, 1)  # [T,N,3H]
+        ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
+        final_carry, ys = self._scan_seq(params, xws, carry, ms)
+        return jnp.swapaxes(ys, 0, 1), final_carry
+
+
+@register_layer
+@dataclasses.dataclass
+class ConvLSTM2DLayer(BaseRecurrentLayer, Layer):
+    """Convolutional LSTM over image sequences [N, T, H, W, C] (Keras
+    ``ConvLSTM2D`` semantics; needed for Keras-import completeness — the
+    reference itself has no ConvLSTM, its recurrent family stops at LSTM
+    variants, ``nn/conf/layers/``).
+
+    Gates are convolutions instead of matmuls: the input convolution for ALL
+    timesteps is hoisted out of the scan as one [N*T,H,W,C] conv (the MXU
+    sees one big batched conv); only the recurrent conv of h stays
+    sequential. Gate order is IFOG along the channel axis, matching our LSTM,
+    so the Keras importer reuses the same i|f|c|o → i|f|o|g reorder.
+
+    Weights: W [kh,kw,C,4F] (input conv, stride/padding per config),
+    RW [kh,kw,F,4F] (recurrent conv, always stride 1 / SAME), b [4F].
+    """
+
+    n_in: int = 0   # input channels
+    n_out: int = 0  # filters
+    kernel_size: Tuple[int, int] = (3, 3)
+    stride: Tuple[int, int] = (1, 1)
+    padding: Tuple[int, int] = (0, 0)
+    dilation: Tuple[int, int] = (1, 1)
+    convolution_mode: str = "truncate"  # "truncate" (valid) | "same"
+    has_bias: bool = True
+    forget_gate_bias_init: float = 1.0
+    gate_activation: str = "sigmoid"
+
+    def __post_init__(self):
+        if self.activation is None:
+            self.activation = "tanh"
+        pair = lambda v: (int(v[0]), int(v[1])) if isinstance(v, (tuple, list)) else (int(v), int(v))
+        self.kernel_size = pair(self.kernel_size)
+        self.stride = pair(self.stride)
+        self.padding = pair(self.padding)
+        self.dilation = pair(self.dilation)
+        self._out_hw = None  # set by output_type during config build
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.channels
+
+    def input_preprocessor(self, input_type: InputType):
+        return None  # consumes [N,T,H,W,C] directly
+
+    def output_type(self, input_type: InputType) -> InputType:
+        from deeplearning4j_tpu.nn.layers.conv import conv_out_size
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        ph, pw = self.padding
+        dh, dw = self.dilation
+        h = conv_out_size(input_type.height, kh, sh, ph, dh, self.convolution_mode)
+        w = conv_out_size(input_type.width, kw, sw, pw, dw, self.convolution_mode)
+        self._out_hw = (h, w)
+        return InputType.recurrent_convolutional(h, w, self.n_out,
+                                                 input_type.timesteps)
+
+    def param_shapes(self):
+        kh, kw = self.kernel_size
+        shapes = {"W": (kh, kw, self.n_in, 4 * self.n_out),
+                  "RW": (kh, kw, self.n_out, 4 * self.n_out)}
+        if self.has_bias:
+            shapes["b"] = (4 * self.n_out,)
+        return shapes
+
+    def init_params(self, rng, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        f = self.n_out
+        k1, k2 = jax.random.split(rng)
+        p = {"W": self._init_w(k1, (kh, kw, self.n_in, 4 * f),
+                               self.n_in * kh * kw, 4 * f * kh * kw, dtype),
+             "RW": self._init_w(k2, (kh, kw, f, 4 * f),
+                                f * kh * kw, 4 * f * kh * kw, dtype)}
+        if self.has_bias:
+            b = jnp.zeros((4 * f,), dtype)
+            p["b"] = b.at[f:2 * f].set(self.forget_gate_bias_init)
+        return p
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        if self._out_hw is None:
+            raise ValueError(
+                "ConvLSTM2DLayer carry shape is unknown until output_type() "
+                "has run (build the layer inside a network config)")
+        h, w = self._out_hw
+        z = jnp.zeros((batch, h, w, self.n_out), dtype)
+        return (z, z)
+
+    def _padding_spec(self):
+        if self.convolution_mode == "same":
+            return "SAME"
+        ph, pw = self.padding
+        return [(ph, ph), (pw, pw)]
+
+    def _cell_pre(self, params, xw_t, carry):
+        h_prev, c_prev = carry
+        gate = act_mod.resolve(self.gate_activation)
+        act = self.act_fn()
+        z = xw_t + lax.conv_general_dilated(
+            h_prev, params["RW"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        zi, zf, zo, zg = jnp.split(z, 4, axis=-1)
+        i = gate(zi)
+        f = gate(zf)
+        g = act(zg)
+        c = f * c_prev + i * g
+        o = gate(zo)
+        h = o * act(c)
+        return h, (h, c)
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        n, t = x.shape[:2]
+        xf = x.reshape((n * t,) + x.shape[2:])
+        z = lax.conv_general_dilated(
+            xf, params["W"], window_strides=self.stride,
+            padding=self._padding_spec(), rhs_dilation=self.dilation,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.has_bias:
+            z = z + params["b"]
+        ho, wo = z.shape[1], z.shape[2]
+        xws = jnp.swapaxes(z.reshape(n, t, ho, wo, 4 * self.n_out), 0, 1)
+        if carry is None:
+            zero = jnp.zeros((n, ho, wo, self.n_out), x.dtype)
+            carry = (zero, zero)
         ms = None if mask is None else jnp.swapaxes(mask.astype(x.dtype), 0, 1)
         final_carry, ys = self._scan_seq(params, xws, carry, ms)
         return jnp.swapaxes(ys, 0, 1), final_carry

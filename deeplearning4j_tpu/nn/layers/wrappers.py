@@ -57,3 +57,62 @@ class FrozenLayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
         return self.layer.forward(frozen, x, state=state, train=train, rng=rng, mask=mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class TimeDistributedWrapper(Layer):
+    """Applies the wrapped layer independently at every timestep by folding
+    time into batch: [N, T, ...] → [N*T, ...] → inner → [N, T, ...].
+
+    Keras ``TimeDistributed`` semantics for non-position-wise inner layers
+    (Conv2D, pooling over image sequences); position-wise layers (Dense etc.)
+    broadcast over leading dims natively and never need this wrapper. The
+    reshape is free under XLA (layout no-op), so the inner conv runs as one
+    big batched conv on the MXU.
+    """
+
+    layer: Optional[Layer] = None
+
+    @staticmethod
+    def _inner_type(input_type: InputType) -> InputType:
+        if input_type.kind == "cnn_seq":
+            return InputType.convolutional(input_type.height, input_type.width,
+                                           input_type.channels)
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        raise ValueError(
+            f"TimeDistributed expects sequence input, got {input_type.kind!r}")
+
+    def set_n_in(self, input_type: InputType) -> None:
+        self.layer.set_n_in(self._inner_type(input_type))
+
+    def output_type(self, input_type: InputType) -> InputType:
+        inner = self.layer.output_type(self._inner_type(input_type))
+        t = input_type.timesteps
+        if inner.kind == "cnn":
+            return InputType.recurrent_convolutional(inner.height, inner.width,
+                                                     inner.channels, t)
+        return InputType.recurrent(inner.flat_size(), t)
+
+    def apply_global_defaults(self, g):
+        super().apply_global_defaults(g)
+        if self.layer is not None:
+            self.layer.apply_global_defaults(g)
+
+    def param_shapes(self):
+        return self.layer.param_shapes()
+
+    def init_params(self, rng, dtype=None):
+        import jax.numpy as jnp
+        return self.layer.init_params(rng, dtype or jnp.float32)
+
+    def init_state(self):
+        return self.layer.init_state()
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        n, t = x.shape[:2]
+        flat = x.reshape((n * t,) + x.shape[2:])
+        y, new_state = self.layer.forward(params, flat, state=state,
+                                          train=train, rng=rng)
+        return y.reshape((n, t) + y.shape[1:]), new_state

@@ -471,3 +471,61 @@ class TestYoloGradients:
         bad = float(layer.compute_loss({}, jnp.asarray(x_bad), jnp.asarray(labels)))
         assert np.isfinite(base) and np.isfinite(bad)
         assert bad > base
+
+
+class TestConvLSTMGradients:
+    def test_convlstm_output(self):
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2DLayer
+
+        m = build([LastTimeStepWrapper(layer=ConvLSTM2DLayer(
+                       n_out=2, kernel_size=(2, 2), convolution_mode="same")),
+                   OutputLayer(n_out=2)],
+                  InputType.recurrent_convolutional(4, 4, 1, 3))
+        x = RNG.normal(size=(2, 3, 4, 4, 1))
+        y = onehot(RNG.integers(0, 2, 2), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_convlstm_masked(self):
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2DLayer
+
+        m = build([ConvLSTM2DLayer(n_out=2, kernel_size=(2, 2),
+                                   convolution_mode="same"),
+                   RnnOutputLayer(n_out=2)],
+                  InputType.recurrent_convolutional(3, 3, 1, 4))
+        x = RNG.normal(size=(2, 4, 3, 3, 1))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        mask = np.ones((2, 4))
+        mask[0, 2:] = 0
+        assert check_model_gradients(m, x, y, features_mask=mask,
+                                     labels_mask=mask, subset=40,
+                                     print_results=True)
+
+    def test_time_distributed_conv_gradients(self):
+        from deeplearning4j_tpu.nn.layers import TimeDistributedWrapper
+
+        m = build([TimeDistributedWrapper(layer=ConvolutionLayer(
+                       n_out=2, kernel_size=(2, 2), convolution_mode="same",
+                       activation="tanh")),
+                   LSTMLayer(n_out=3),
+                   RnnOutputLayer(n_out=2)],
+                  InputType.recurrent_convolutional(3, 3, 1, 4))
+        x = RNG.normal(size=(2, 4, 3, 3, 1))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_bidirectional_convlstm_masked(self):
+        # locks the rank-agnostic masked reverse in BidirectionalWrapper
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2DLayer
+
+        m = build([BidirectionalWrapper(layer=ConvLSTM2DLayer(
+                       n_out=2, kernel_size=(2, 2), convolution_mode="same"),
+                       mode="concat"),
+                   RnnOutputLayer(n_out=2)],
+                  InputType.recurrent_convolutional(3, 3, 1, 4))
+        x = RNG.normal(size=(2, 4, 3, 3, 1))
+        y = onehot(RNG.integers(0, 2, (2, 4)), 2)
+        mask = np.ones((2, 4))
+        mask[0, 2:] = 0
+        assert check_model_gradients(m, x, y, features_mask=mask,
+                                     labels_mask=mask, subset=40,
+                                     print_results=True)

@@ -348,3 +348,74 @@ class TestGruAndTimeDistributed:
         expected = m.predict(x, verbose=0)
         net = KerasModelImport.import_keras_model_and_weights(p)
         _assert_close(net.output(x), expected)
+
+
+class TestConvLSTMAndTimeDistributed:
+    """Rank-5 (image sequence) import paths: ConvLSTM2D and
+    TimeDistributed(Conv*). Reference scope note: DL4J's Keras importer maps
+    ConvLSTM via ``layers/convolutional/KerasConvLSTM2D.java``-era mappers;
+    here the layer is TPU-native (hoisted input conv + lax.scan)."""
+
+    def test_convlstm_return_sequences(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((4, 6, 6, 2)),
+            kl.ConvLSTM2D(3, (2, 2), padding="same", return_sequences=True,
+                          name="cl"),
+        ])
+        p = _save(m, tmp_path, "convlstm.h5")
+        x = np.random.RandomState(5).rand(2, 4, 6, 6, 2).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_convlstm_last_step_into_dense(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((3, 5, 5, 1)),
+            kl.ConvLSTM2D(4, (3, 3), padding="valid", strides=(2, 2),
+                          return_sequences=False, name="cl"),
+            kl.Flatten(name="f"),
+            kl.Dense(3, activation="softmax", name="out"),
+        ])
+        p = _save(m, tmp_path, "convlstm2.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(6).rand(2, 3, 5, 5, 1).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_convlstm_trains_after_import(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((3, 5, 5, 1)),
+            kl.ConvLSTM2D(4, (3, 3), padding="same", return_sequences=False,
+                          name="cl"),
+            kl.Flatten(name="f"),
+            kl.Dense(2, activation="softmax", name="out"),
+        ])
+        p = _save(m, tmp_path, "convlstm3.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        rng = np.random.RandomState(0)
+        x = rng.rand(16, 3, 5, 5, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        s0 = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=5)
+        assert net.score_ < s0
+
+    def test_time_distributed_conv(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((4, 8, 8, 1)),
+            kl.TimeDistributed(kl.Conv2D(3, (3, 3), activation="relu"),
+                               name="tdc"),
+            kl.TimeDistributed(kl.MaxPooling2D((2, 2)), name="tdp"),
+            kl.TimeDistributed(kl.Flatten(), name="tdf"),
+            kl.LSTM(5, return_sequences=False, name="l"),
+            kl.Dense(2, activation="softmax", name="out"),
+        ])
+        p = _save(m, tmp_path, "tdconv.h5")
+        x = np.random.RandomState(7).rand(2, 4, 8, 8, 1).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
