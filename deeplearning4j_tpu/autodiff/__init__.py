@@ -1,0 +1,5 @@
+from deeplearning4j_tpu.autodiff.samediff import (  # noqa: F401
+    SDVariable,
+    SameDiff,
+    TrainingConfig,
+)

@@ -1,0 +1,723 @@
+"""SameDiff — standalone declarative graph-builder with SDVariable algebra.
+
+Reference capability: ND4J's ``SameDiff``/``SDVariable`` API — the layer
+below the reference repo (SURVEY.md §2.12, L0) that backs its SameDiff layer
+SPI (``nn/conf/layers/samediff/AbstractSameDiffLayer.java``,
+``nn/layers/samediff/SameDiffLayer.java:209`` builds a ``SameDiff`` graph per
+layer). Users declare placeholders/variables, compose ops symbolically, and
+the engine supplies execution, autodiff, and training.
+
+TPU-first redesign: the reference engine interprets its op graph node by node
+through libnd4j kernels and hand-written backprop ops. Here the graph is pure
+metadata — a topologically ordered op tape — and ``_build_fn`` lowers it to
+ONE pure JAX function ``f(variables, placeholders) -> outputs``. Execution is
+``jax.jit(f)`` (XLA fuses the whole graph), gradients are ``jax.grad`` (no
+per-op backward definitions), and ``fit`` is a single donated-buffer jitted
+train step reusing the framework's updater transforms. Shapes are inferred
+with ``jax.eval_shape`` (no FLOPs).
+
+Example::
+
+    sd = SameDiff.create()
+    x = sd.place_holder("x", shape=(None, 4))
+    w = sd.var("w", shape=(4, 3))
+    b = sd.var("b", shape=(3,))
+    out = sd.nn.softmax(x @ w + b, name="out")
+    preds = sd.output({"x": features}, "out")["out"]
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _normalize_dims(dims, keepdims_default=False):
+    if dims is None or dims == ():
+        return None
+    if isinstance(dims, int):
+        return (dims,)
+    return tuple(int(d) for d in dims)
+
+
+# ---------------------------------------------------------------------------
+# Op registry: name -> fn(*input_arrays, **attrs) in jnp. One place, so the
+# whole op set is visible and serializable by name.
+# ---------------------------------------------------------------------------
+
+def _conv2d(x, w, stride, padding):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _pool2d(x, kind, size, stride, padding):
+    init = -jnp.inf if kind == "max" else 0.0
+    op = jax.lax.max if kind == "max" else jax.lax.add
+    y = jax.lax.reduce_window(
+        x, init, op, (1,) + tuple(size) + (1,), (1,) + tuple(stride) + (1,),
+        padding)
+    if kind == "avg":
+        ones = jnp.ones(x.shape[:3] + (1,), x.dtype)
+        counts = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, (1,) + tuple(size) + (1,),
+            (1,) + tuple(stride) + (1,), padding)
+        y = y / counts
+    return y
+
+
+OPS: Dict[str, Callable] = {
+    # arithmetic
+    "add": lambda a, b: a + b,
+    "sub": lambda a, b: a - b,
+    "mul": lambda a, b: a * b,
+    "div": lambda a, b: a / b,
+    "pow": lambda a, b: a ** b,
+    "neg": lambda a: -a,
+    "rsub": lambda a, b: b - a,
+    "rdiv": lambda a, b: b / a,
+    "matmul": lambda a, b: a @ b,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    # structure
+    "transpose": lambda a, axes=None: jnp.transpose(a, axes),
+    "reshape": lambda a, shape=None: jnp.reshape(a, shape),
+    "concat": lambda *xs, axis=0: jnp.concatenate(xs, axis=axis),
+    "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
+    "slice": lambda a, begin=None, size=None: jax.lax.dynamic_slice(a, begin, size),
+    "strided_slice": lambda a, slices=None: a[tuple(slice(*s) for s in slices)],
+    "gather": lambda a, idx, axis=0: jnp.take(a, idx.astype(jnp.int32), axis=axis),
+    "one_hot": lambda a, depth=None: jax.nn.one_hot(a.astype(jnp.int32), depth),
+    "cast": lambda a, dtype=None: a.astype(dtype),
+    "where": lambda c, a, b: jnp.where(c, a, b),
+    "tile": lambda a, reps=None: jnp.tile(a, reps),
+    "expand_dims": lambda a, axis=0: jnp.expand_dims(a, axis),
+    "squeeze": lambda a, axis=None: jnp.squeeze(a, axis),
+    # reductions
+    "sum": lambda a, dims=None, keepdims=False: jnp.sum(a, axis=dims, keepdims=keepdims),
+    "mean": lambda a, dims=None, keepdims=False: jnp.mean(a, axis=dims, keepdims=keepdims),
+    "max": lambda a, dims=None, keepdims=False: jnp.max(a, axis=dims, keepdims=keepdims),
+    "min": lambda a, dims=None, keepdims=False: jnp.min(a, axis=dims, keepdims=keepdims),
+    "prod": lambda a, dims=None, keepdims=False: jnp.prod(a, axis=dims, keepdims=keepdims),
+    "std": lambda a, dims=None, keepdims=False, bias_corrected=True:
+        jnp.std(a, axis=dims, keepdims=keepdims, ddof=1 if bias_corrected else 0),
+    "variance": lambda a, dims=None, keepdims=False, bias_corrected=True:
+        jnp.var(a, axis=dims, keepdims=keepdims, ddof=1 if bias_corrected else 0),
+    "argmax": lambda a, dims=None: jnp.argmax(a, axis=dims),
+    "argmin": lambda a, dims=None: jnp.argmin(a, axis=dims),
+    "norm2": lambda a, dims=None, keepdims=False:
+        jnp.sqrt(jnp.sum(a * a, axis=dims, keepdims=keepdims)),
+    "norm1": lambda a, dims=None, keepdims=False:
+        jnp.sum(jnp.abs(a), axis=dims, keepdims=keepdims),
+    # elementwise math
+    "abs": jnp.abs, "exp": jnp.exp, "log": jnp.log, "sqrt": jnp.sqrt,
+    "square": jnp.square, "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "floor": jnp.floor, "ceil": jnp.ceil, "round": jnp.round,
+    "sign": jnp.sign, "erf": jax.scipy.special.erf,
+    "clip_by_value": lambda a, clip_min=None, clip_max=None: jnp.clip(a, clip_min, clip_max),
+    "reciprocal": lambda a: 1.0 / a,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    # comparisons (float outputs, ND4J-style)
+    "gt": lambda a, b: (a > b).astype(jnp.float32),
+    "gte": lambda a, b: (a >= b).astype(jnp.float32),
+    "lt": lambda a, b: (a < b).astype(jnp.float32),
+    "lte": lambda a, b: (a <= b).astype(jnp.float32),
+    "eq": lambda a, b: (a == b).astype(jnp.float32),
+    "neq": lambda a, b: (a != b).astype(jnp.float32),
+    # activations / nn
+    "tanh": jnp.tanh, "sigmoid": jax.nn.sigmoid, "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6, "elu": jax.nn.elu, "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu, "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign, "swish": jax.nn.swish,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "leaky_relu": lambda a, alpha=0.01: jax.nn.leaky_relu(a, alpha),
+    "softmax": lambda a, dims=-1: jax.nn.softmax(a, axis=dims),
+    "log_softmax": lambda a, dims=-1: jax.nn.log_softmax(a, axis=dims),
+    "linear": lambda x, w, b=None: (x @ w + b) if b is not None else x @ w,
+    "layer_norm": lambda x, gain, bias=None, eps=1e-5: (
+        (x - jnp.mean(x, axis=-1, keepdims=True))
+        / jnp.sqrt(jnp.var(x, axis=-1, keepdims=True) + eps) * gain
+        + (0.0 if bias is None else bias)),
+    "dropout": lambda a, p=0.5: a,  # inference semantics; fit() handles train
+    "conv2d": _conv2d,
+    "max_pooling2d": lambda x, size=(2, 2), stride=(2, 2), padding="VALID":
+        _pool2d(x, "max", size, stride, padding),
+    "avg_pooling2d": lambda x, size=(2, 2), stride=(2, 2), padding="VALID":
+        _pool2d(x, "avg", size, stride, padding),
+    "batch_mmul": lambda a, b: jnp.einsum("...ij,...jk->...ik", a, b),
+    # losses (mean-reduced scalars, matching ND4J loss op defaults)
+    "loss_mse": lambda labels, preds: jnp.mean((preds - labels) ** 2),
+    "loss_mae": lambda labels, preds: jnp.mean(jnp.abs(preds - labels)),
+    "loss_softmax_ce": lambda labels, logits:
+        jnp.mean(-jnp.sum(labels * jax.nn.log_softmax(logits, axis=-1), axis=-1)),
+    "loss_sigmoid_ce": lambda labels, logits: jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))),
+    "loss_log": lambda labels, preds, eps=1e-7: jnp.mean(
+        -(labels * jnp.log(preds + eps) + (1 - labels) * jnp.log(1 - preds + eps))),
+    "loss_huber": lambda labels, preds, delta=1.0: jnp.mean(jnp.where(
+        jnp.abs(preds - labels) <= delta,
+        0.5 * (preds - labels) ** 2,
+        delta * jnp.abs(preds - labels) - 0.5 * delta ** 2)),
+    "loss_cosine": lambda labels, preds, dims=-1: jnp.mean(1.0 - jnp.sum(
+        labels * preds, axis=dims)
+        / (jnp.linalg.norm(labels, axis=dims) * jnp.linalg.norm(preds, axis=dims)
+           + 1e-12)),
+    "loss_hinge": lambda labels, preds: jnp.mean(
+        jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * preds)),
+}
+
+
+class SDVariable:
+    """A symbolic node: placeholder, trainable variable, constant, or op
+    result (ND4J ``SDVariable``). Supports operator algebra; every operation
+    records a new node on the owning ``SameDiff`` tape."""
+
+    def __init__(self, sd: "SameDiff", name: str, kind: str,
+                 op: Optional[str] = None, inputs: Sequence[str] = (),
+                 attrs: Optional[dict] = None,
+                 shape: Optional[Tuple] = None):
+        self.sd = sd
+        self.name = name
+        self.kind = kind  # "placeholder" | "variable" | "constant" | "op"
+        self.op = op
+        self.inputs = tuple(inputs)
+        self.attrs = attrs or {}
+        self._declared_shape = shape
+
+    # -- algebra ------------------------------------------------------------
+    def _bin(self, other, op, name=None):
+        other = self.sd._as_var(other)
+        return self.sd._op(op, [self, other], name=name)
+
+    def __add__(self, o):
+        return self._bin(o, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._bin(o, "sub")
+
+    def __rsub__(self, o):
+        return self._bin(o, "rsub")
+
+    def __mul__(self, o):
+        return self._bin(o, "mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._bin(o, "div")
+
+    def __rtruediv__(self, o):
+        return self._bin(o, "rdiv")
+
+    def __pow__(self, o):
+        return self._bin(o, "pow")
+
+    def __matmul__(self, o):
+        return self._bin(o, "matmul")
+
+    def __neg__(self):
+        return self.sd._op("neg", [self])
+
+    # named algebra (ND4J method spellings)
+    def add(self, o, name=None):
+        return self._bin(o, "add", name)
+
+    def sub(self, o, name=None):
+        return self._bin(o, "sub", name)
+
+    def mul(self, o, name=None):
+        return self._bin(o, "mul", name)
+
+    def div(self, o, name=None):
+        return self._bin(o, "div", name)
+
+    def mmul(self, o, name=None):
+        return self._bin(o, "matmul", name)
+
+    def rsub(self, o, name=None):
+        return self._bin(o, "rsub", name)
+
+    def rdiv(self, o, name=None):
+        return self._bin(o, "rdiv", name)
+
+    # reductions
+    def _reduce(self, op, dims, keepdims, name=None):
+        return self.sd._op(op, [self], name=name,
+                           attrs={"dims": _normalize_dims(dims),
+                                  "keepdims": keepdims})
+
+    def sum(self, dims=None, keepdims=False, name=None):
+        return self._reduce("sum", dims, keepdims, name)
+
+    def mean(self, dims=None, keepdims=False, name=None):
+        return self._reduce("mean", dims, keepdims, name)
+
+    def max(self, dims=None, keepdims=False, name=None):
+        return self._reduce("max", dims, keepdims, name)
+
+    def min(self, dims=None, keepdims=False, name=None):
+        return self._reduce("min", dims, keepdims, name)
+
+    def prod(self, dims=None, keepdims=False, name=None):
+        return self._reduce("prod", dims, keepdims, name)
+
+    def std(self, dims=None, bias_corrected=True, keepdims=False, name=None):
+        return self.sd._op("std", [self], name=name,
+                           attrs={"dims": _normalize_dims(dims),
+                                  "keepdims": keepdims,
+                                  "bias_corrected": bias_corrected})
+
+    def norm2(self, dims=None, keepdims=False, name=None):
+        return self._reduce("norm2", dims, keepdims, name)
+
+    def norm1(self, dims=None, keepdims=False, name=None):
+        return self._reduce("norm1", dims, keepdims, name)
+
+    def argmax(self, dims=None, name=None):
+        return self.sd._op("argmax", [self], name=name,
+                           attrs={"dims": dims})
+
+    # structure
+    def T(self, *axes, name=None):
+        return self.sd._op("transpose", [self], name=name,
+                           attrs={"axes": axes or None})
+
+    transpose = T
+
+    def reshape(self, *shape, name=None):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        return self.sd._op("reshape", [self], name=name,
+                           attrs={"shape": shape})
+
+    def get(self, *slices, name=None):
+        if len(slices) == 1 and isinstance(slices[0], tuple):
+            slices = slices[0]  # x[0:1, 2:5] arrives as one tuple
+        spec = [[s.start, s.stop, s.step if s.step else 1]
+                if isinstance(s, slice) else [s, s + 1, 1] for s in slices]
+        return self.sd._op("strided_slice", [self], name=name,
+                           attrs={"slices": spec})
+
+    __getitem__ = get
+
+    # -- execution ----------------------------------------------------------
+    @property
+    def shape(self):
+        """Inferred shape (``jax.eval_shape`` — no compute). ``None`` dims in
+        placeholder shapes are treated as 1 for inference."""
+        return self.sd.infer_shape(self.name)
+
+    def eval(self, placeholders: Optional[Dict[str, np.ndarray]] = None):
+        return self.sd.output(placeholders or {}, self.name)[self.name]
+
+    def gradient(self) -> "SDVariable":
+        return self.sd.grad(self.name)
+
+    def rename(self, name: str) -> "SDVariable":
+        return self.sd.rename(self.name, name)
+
+    def __repr__(self):
+        return f"SDVariable(name={self.name!r}, kind={self.kind!r}, op={self.op!r})"
+
+
+class _Namespace:
+    """Op namespace (``sd.math``, ``sd.nn``, ``sd.loss``) exposing registry
+    ops as methods, mirroring ND4J's ``sd.math()``/``sd.nn()``/``sd.loss()``."""
+
+    def __init__(self, sd: "SameDiff", ops: Dict[str, str], attr_names: Dict[str, tuple]):
+        self._sd = sd
+        self._ops = ops
+        self._attr_names = attr_names
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        op = self._ops.get(item)
+        if op is None:
+            raise AttributeError(f"unknown op {item!r}; available: {sorted(self._ops)}")
+
+        def call(*args, name=None, **kwargs):
+            # SDVariable args are graph inputs. A plain-scalar positional arg
+            # fills the op's declared positional attrs (e.g.
+            # nn.leaky_relu(x, 0.2)); ops without declared attrs lift scalars
+            # to constant inputs (e.g. math.maximum(x, 0.0)).
+            pos_attrs = list(self._attr_names.get(item, ()))
+            inputs, attrs, attr_i = [], dict(kwargs), 0
+            for a in args:
+                if isinstance(a, SDVariable):
+                    inputs.append(a)
+                elif attr_i < len(pos_attrs) and inputs:
+                    attrs[pos_attrs[attr_i]] = a
+                    attr_i += 1
+                else:
+                    inputs.append(self._sd._as_var(a))
+            return self._sd._op(op, inputs, name=name, attrs=attrs)
+
+        return call
+
+
+_MATH_OPS = {n: n for n in (
+    "abs exp log sqrt square sin cos tan floor ceil round sign erf "
+    "reciprocal log1p expm1 neg maximum minimum pow clip_by_value "
+    "sum mean max min prod std variance argmax argmin norm2 norm1 "
+    "gt gte lt lte eq neq where cast tanh").split()}
+_NN_OPS = {n: n for n in (
+    "relu relu6 elu selu gelu softplus softsign swish hard_sigmoid "
+    "leaky_relu softmax log_softmax sigmoid tanh linear layer_norm dropout "
+    "conv2d max_pooling2d avg_pooling2d batch_mmul").split()}
+_LOSS_OPS = {
+    "mean_squared_error": "loss_mse",
+    "mse": "loss_mse",
+    "absolute_difference": "loss_mae",
+    "softmax_cross_entropy": "loss_softmax_ce",
+    "sigmoid_cross_entropy": "loss_sigmoid_ce",
+    "log_loss": "loss_log",
+    "huber_loss": "loss_huber",
+    "cosine_distance": "loss_cosine",
+    "hinge_loss": "loss_hinge",
+}
+# positional attr spellings for namespace calls like nn.leaky_relu(x, 0.2)
+_ATTRS = {
+    "leaky_relu": ("alpha",),
+    "clip_by_value": ("clip_min", "clip_max"),
+    "dropout": ("p",),
+    "huber_loss": ("delta",),
+}
+
+
+class TrainingConfig:
+    """Training configuration (ND4J ``TrainingConfig``): updater +
+    regularization + which DataSet slots feed which placeholders."""
+
+    def __init__(self, updater=None, l1: float = 0.0, l2: float = 0.0,
+                 data_set_feature_mapping: Sequence[str] = ("input",),
+                 data_set_label_mapping: Sequence[str] = ("label",)):
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        self.updater = updater if updater is not None else Sgd(1e-2)
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        self.feature_mapping = list(data_set_feature_mapping)
+        self.label_mapping = list(data_set_label_mapping)
+
+
+class SameDiff:
+    """The graph container (ND4J ``SameDiff``).
+
+    Nodes are appended in creation order, which IS a topological order (ops
+    can only reference existing nodes), so lowering is a single pass."""
+
+    def __init__(self):
+        self._nodes: Dict[str, SDVariable] = {}
+        self._order: List[str] = []
+        self.variables_map: Dict[str, jnp.ndarray] = {}   # trainable values
+        self.constants_map: Dict[str, jnp.ndarray] = {}
+        self._loss_variables: List[str] = []
+        self._training_config: Optional[TrainingConfig] = None
+        self._updater_state = None
+        self._grads: Dict[str, np.ndarray] = {}
+        self._jit_cache: Dict[tuple, Callable] = {}
+        self._counter = 0
+        self.math = _Namespace(self, _MATH_OPS, _ATTRS)
+        self.nn = _Namespace(self, _NN_OPS, _ATTRS)
+        self.loss = _Namespace(self, _LOSS_OPS, _ATTRS)
+
+    # -- construction -------------------------------------------------------
+    @staticmethod
+    def create() -> "SameDiff":
+        return SameDiff()
+
+    def _fresh_name(self, base: str) -> str:
+        while True:
+            self._counter += 1
+            name = f"{base}_{self._counter}"
+            if name not in self._nodes:
+                return name
+
+    def _register(self, v: SDVariable) -> SDVariable:
+        if v.name in self._nodes:
+            raise ValueError(f"duplicate variable name {v.name!r}")
+        self._nodes[v.name] = v
+        self._order.append(v.name)
+        self._jit_cache.clear()
+        return v
+
+    def place_holder(self, name: str, shape: Optional[Sequence] = None,
+                     dtype=jnp.float32) -> SDVariable:
+        return self._register(SDVariable(
+            self, name, "placeholder",
+            shape=None if shape is None else tuple(shape)))
+
+    placeHolder = place_holder  # ND4J spelling
+
+    def var(self, name: str, value=None, shape: Optional[Sequence] = None,
+            weight_init: str = "xavier", seed: int = 0,
+            dtype=jnp.float32) -> SDVariable:
+        """Trainable variable: pass an initial array OR a shape (+init)."""
+        if value is None:
+            if shape is None:
+                raise ValueError("var() needs an initial value or a shape")
+            from deeplearning4j_tpu.nn.weights import init_weight
+            shape = tuple(int(s) for s in shape)
+            fan_in = shape[0] if shape else 1
+            fan_out = shape[-1] if len(shape) >= 2 else (shape[0] if shape else 1)
+            value = init_weight(jax.random.PRNGKey(seed + len(self._order)),
+                                shape, weight_init, fan_in, fan_out,
+                                dtype=dtype)
+        value = jnp.asarray(value, dtype=dtype)
+        self.variables_map[name] = value
+        return self._register(SDVariable(self, name, "variable",
+                                         shape=tuple(value.shape)))
+
+    def constant(self, name: str, value) -> SDVariable:
+        value = jnp.asarray(value)
+        self.constants_map[name] = value
+        return self._register(SDVariable(self, name, "constant",
+                                         shape=tuple(value.shape)))
+
+    def _as_var(self, v) -> SDVariable:
+        if isinstance(v, SDVariable):
+            if v.sd is not self:
+                raise ValueError("SDVariable belongs to a different SameDiff")
+            return v
+        return self.constant(self._fresh_name("const"), v)
+
+    def _op(self, op: str, inputs: Sequence[SDVariable], name: Optional[str] = None,
+            attrs: Optional[dict] = None) -> SDVariable:
+        if op not in OPS:
+            raise ValueError(f"unknown op {op!r}")
+        name = name or self._fresh_name(op)
+        return self._register(SDVariable(
+            self, name, "op", op=op,
+            inputs=[self._as_var(i).name for i in inputs],
+            attrs={k: v for k, v in (attrs or {}).items() if v is not None}))
+
+    def rename(self, old: str, new: str) -> SDVariable:
+        self._jit_cache.clear()
+        v = self._nodes.pop(old)
+        v.name = new
+        self._nodes[new] = v
+        self._order[self._order.index(old)] = new
+        for n in self._nodes.values():
+            if old in n.inputs:
+                n.inputs = tuple(new if i == old else i for i in n.inputs)
+        if old in self.variables_map:
+            self.variables_map[new] = self.variables_map.pop(old)
+        if old in self.constants_map:
+            self.constants_map[new] = self.constants_map.pop(old)
+        self._loss_variables = [new if x == old else x for x in self._loss_variables]
+        return v
+
+    def get_variable(self, name: str) -> SDVariable:
+        return self._nodes[name]
+
+    # -- lowering -----------------------------------------------------------
+    def _build_fn(self, output_names: Sequence[str]):
+        """Lower the tape to one pure function
+        ``f(variables_dict, placeholders_dict) -> [outputs]``."""
+        needed = set()
+        stack = list(output_names)
+        while stack:
+            n = stack.pop()
+            if n in needed:
+                continue
+            needed.add(n)
+            stack.extend(self._nodes[n].inputs)
+        order = [n for n in self._order if n in needed]
+
+        def fn(variables, placeholders):
+            env = {}
+            for n in order:
+                node = self._nodes[n]
+                if node.kind == "placeholder":
+                    env[n] = placeholders[n]
+                elif node.kind == "variable":
+                    env[n] = variables[n]
+                elif node.kind == "constant":
+                    env[n] = self.constants_map[n]
+                else:
+                    env[n] = OPS[node.op](*(env[i] for i in node.inputs),
+                                          **node.attrs)
+            return [env[n] for n in output_names]
+
+        return fn
+
+    # -- execution ----------------------------------------------------------
+    def output(self, placeholders: Dict[str, np.ndarray],
+               *output_names: str) -> Dict[str, np.ndarray]:
+        """Execute the graph (ND4J ``sd.output(map, names)``), jit-compiled."""
+        if not output_names:
+            raise ValueError("no output names given")
+        key = ("out",) + tuple(output_names)
+        jf = self._jit_cache.get(key)
+        if jf is None:
+            jf = self._jit_cache[key] = jax.jit(self._build_fn(output_names))
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        outs = jf(self.variables_map, ph)
+        return {n: np.asarray(o) for n, o in zip(output_names, outs)}
+
+    exec = output
+
+    def infer_shape(self, name: str):
+        node = self._nodes[name]
+        if node._declared_shape is not None and node.kind != "op":
+            return node._declared_shape
+        fn = self._build_fn([name])
+        ph = {}
+        for n in self._nodes.values():
+            if n.kind == "placeholder":
+                s = n._declared_shape or (1,)
+                ph[n.name] = jax.ShapeDtypeStruct(
+                    tuple(1 if d is None else d for d in s), jnp.float32)
+        out = jax.eval_shape(fn, self.variables_map, ph)
+        return tuple(out[0].shape)
+
+    # -- autodiff -----------------------------------------------------------
+    def set_loss_variables(self, *names: str) -> None:
+        self._loss_variables = [n if isinstance(n, str) else n.name for n in names]
+
+    def _loss_fn(self):
+        if not self._loss_variables:
+            raise ValueError("no loss variables set (set_loss_variables)")
+        inner = self._build_fn(self._loss_variables)
+
+        def loss(variables, placeholders):
+            outs = inner(variables, placeholders)
+            return sum(jnp.sum(o) for o in outs)
+
+        return loss
+
+    def calculate_gradients(self, placeholders: Dict[str, np.ndarray],
+                            *wrt: str) -> Dict[str, np.ndarray]:
+        """d(sum of loss variables)/d(wrt) (ND4J ``calculateGradients``)."""
+        wrt = [w if isinstance(w, str) else w.name for w in wrt] or \
+            list(self.variables_map)
+        key = ("grad",) + tuple(self._loss_variables)
+        jf = self._jit_cache.get(key)
+        if jf is None:
+            jf = self._jit_cache[key] = jax.jit(jax.grad(self._loss_fn()))
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        grads = jf(self.variables_map, ph)
+        self._grads = {k: np.asarray(v) for k, v in grads.items() if k in wrt}
+        return dict(self._grads)
+
+    def grad(self, name: str) -> np.ndarray:
+        if name not in self._grads:
+            raise ValueError(
+                f"no gradient for {name!r}; run calculate_gradients first")
+        return self._grads[name]
+
+    # -- training -----------------------------------------------------------
+    def set_training_config(self, cfg: TrainingConfig) -> None:
+        self._training_config = cfg
+        self._updater_state = None
+
+    def fit(self, dataset=None, epochs: int = 1, features=None, labels=None):
+        """Train on a DataSet / iterator (ND4J ``sd.fit``): jitted step with
+        donated variable buffers; loss = sum of loss variables (+l1/l2)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("set_training_config first")
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if dataset is None:
+            dataset = DataSet(np.asarray(features), np.asarray(labels))
+        batches = [dataset] if isinstance(dataset, DataSet) else list(dataset)
+
+        loss_fn = self._loss_fn()
+
+        def step_loss(variables, ph):
+            loss = loss_fn(variables, ph)
+            if cfg.l2:
+                loss = loss + cfg.l2 * sum(
+                    jnp.sum(v * v) for v in variables.values())
+            if cfg.l1:
+                loss = loss + cfg.l1 * sum(
+                    jnp.sum(jnp.abs(v)) for v in variables.values())
+            return loss
+
+        upd = cfg.updater
+
+        @jax.jit
+        def train_step(variables, opt_state, ph, lr, t):
+            loss, grads = jax.value_and_grad(step_loss)(variables, ph)
+            new_vars, new_state = {}, {}
+            for k, v in variables.items():
+                delta, s = upd.update(grads[k], opt_state[k], lr, t)
+                new_vars[k] = v - delta
+                new_state[k] = s
+            return new_vars, new_state, loss
+
+        if self._updater_state is None:
+            self._updater_state = {k: upd.init_state(v)
+                                   for k, v in self.variables_map.items()}
+        it = 0
+        last = None
+        for epoch in range(int(epochs)):
+            for ds in batches:
+                ph = {}
+                feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                    else [ds.features]
+                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                    else [ds.labels]
+                for n, a in zip(cfg.feature_mapping, feats):
+                    ph[n] = jnp.asarray(a)
+                for n, a in zip(cfg.label_mapping, labs):
+                    ph[n] = jnp.asarray(a)
+                lr = jnp.asarray(upd.lr_at(it, epoch), jnp.float32)
+                # t is 1-based: Adam-family bias correction divides by
+                # (1 - beta^t), which is 0 at t=0
+                self.variables_map, self._updater_state, last = train_step(
+                    self.variables_map, self._updater_state, ph, lr,
+                    jnp.asarray(it + 1))
+                it += 1
+        return None if last is None else float(last)
+
+    # -- serde --------------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps({
+            "nodes": [{
+                "name": n, "kind": v.kind, "op": v.op,
+                "inputs": list(v.inputs), "attrs": v.attrs,
+                "shape": None if v._declared_shape is None
+                else list(v._declared_shape),
+            } for n, v in ((n, self._nodes[n]) for n in self._order)],
+            "loss_variables": self._loss_variables,
+        })
+
+    def save(self, path: str) -> None:
+        """Graph JSON + variable/constant values in one npz (the capability of
+        ND4J's flatbuffers ``sd.save``; format is npz, TPU-host friendly)."""
+        arrays = {f"var__{k}": np.asarray(v) for k, v in self.variables_map.items()}
+        arrays |= {f"const__{k}": np.asarray(v) for k, v in self.constants_map.items()}
+        np.savez(path, __graph__=np.frombuffer(
+            self.to_json().encode(), dtype=np.uint8), **arrays)
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        data = np.load(path if str(path).endswith(".npz") else str(path) + ".npz",
+                       allow_pickle=False)
+        spec = json.loads(bytes(data["__graph__"]).decode())
+        sd = SameDiff()
+        for nd in spec["nodes"]:
+            name, kind = nd["name"], nd["kind"]
+            shape = None if nd["shape"] is None else tuple(nd["shape"])
+            if kind == "placeholder":
+                sd.place_holder(name, shape)
+            elif kind == "variable":
+                sd.var(name, value=data[f"var__{name}"])
+            elif kind == "constant":
+                sd.constant(name, data[f"const__{name}"])
+            else:
+                attrs = {k: (tuple(v) if isinstance(v, list) else v)
+                         for k, v in (nd["attrs"] or {}).items()}
+                sd._register(SDVariable(sd, name, "op", op=nd["op"],
+                                        inputs=nd["inputs"], attrs=attrs))
+        sd._loss_variables = spec.get("loss_variables", [])
+        return sd
