@@ -45,20 +45,28 @@ def _bucket(n: int) -> int:
 class ParallelInference:
     """Batched inference front-end over a model's ``output``.
 
-    mode: 'sequential' (run each request as-is) or 'batched' (coalesce up to
-    ``max_batch_size`` inputs within ``nanos`` wait window).
+    mode (``ParallelInference.java:52`` ``InferenceMode``):
+    - 'inplace' (alias 'sequential'): the request runs in the calling
+      thread against the shared model. The reference clones one model per
+      worker thread because its layers carry mutable buffers; here the
+      compiled forward is a pure function, so every thread can call the
+      SAME jitted executable concurrently — replica cloning vanishes.
+    - 'batched': requests are coalesced by a dispatcher thread up to
+      ``max_batch_size`` within a ``wait_ms`` TTL window measured from the
+      oldest queued request (the ObservablesProvider nanos-TTL semantics).
     """
 
     def __init__(self, model, *, mode: str = "batched", max_batch_size: int = 32,
                  queue_limit: int = 64, wait_ms: float = 2.0,
                  mesh: Optional[Mesh] = None):
-        if mode not in ("sequential", "batched"):
-            raise ValueError(f"unknown mode {mode!r} (sequential|batched)")
+        if mode not in ("sequential", "inplace", "batched"):
+            raise ValueError(f"unknown mode {mode!r} (inplace|sequential|batched)")
         self.model = model
         self.mode = mode
         self.max_batch_size = int(max_batch_size)
         self.wait_s = wait_ms / 1e3
         self.mesh = mesh
+        self._model_lock = threading.Lock()
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
         self._worker = None
@@ -69,8 +77,8 @@ class ParallelInference:
     # ----------------------------------------------------------- client API
     def output(self, x) -> np.ndarray:
         x = np.asarray(x)
-        if self.mode == "sequential":
-            return np.asarray(self.model.output(x))
+        if self.mode in ("sequential", "inplace"):
+            return np.asarray(self._model().output(x))
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
         req = _Request(x)
@@ -79,6 +87,17 @@ class ParallelInference:
         if req.error is not None:
             raise req.error
         return req.result
+
+    def update_model(self, model) -> None:
+        """Atomically swap the served model (``ParallelInference.updateModel``)
+        — lets a training loop publish fresh weights without stopping
+        serving. In-flight batches finish on the old model."""
+        with self._model_lock:
+            self.model = model
+
+    def _model(self):
+        with self._model_lock:
+            return self.model
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -131,7 +150,7 @@ class ParallelInference:
             xj = jnp.asarray(x)
             if self.mesh is not None:
                 xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
-            out = np.asarray(self.model.output(xj))
+            out = np.asarray(self._model().output(xj))
             off = 0
             for r in batch:
                 k = r.x.shape[0]

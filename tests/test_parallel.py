@@ -221,3 +221,41 @@ class TestParallelInference:
                 np.testing.assert_allclose(results[i], want, rtol=1e-4, atol=1e-5)
         finally:
             pi.shutdown()
+
+
+class TestParallelInferenceModes:
+    def test_inplace_mode_concurrent(self, rng):
+        import threading
+        net = small_net()
+        pi = ParallelInference(net, mode="inplace")
+        xs = [rng.normal(size=(4, 12)).astype(np.float32) for _ in range(6)]
+        results = [None] * 6
+
+        def call(i):
+            results[i] = pi.output(xs[i])
+
+        threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, x in enumerate(xs):
+            np.testing.assert_allclose(results[i], np.asarray(net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_update_model_swaps_serving(self, rng):
+        net_a = small_net(seed=1)
+        net_b = small_net(seed=2)
+        x, _ = make_data(rng, n=4)
+        pi = ParallelInference(net_a, mode="batched", max_batch_size=8)
+        try:
+            got_a = pi.output(x)
+            np.testing.assert_allclose(got_a, np.asarray(net_a.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            pi.update_model(net_b)
+            got_b = pi.output(x)
+            np.testing.assert_allclose(got_b, np.asarray(net_b.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            assert not np.allclose(got_a, got_b)
+        finally:
+            pi.shutdown()
