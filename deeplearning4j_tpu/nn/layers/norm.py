@@ -70,8 +70,11 @@ class BatchNormalizationLayer(Layer):
         state = state or self.init_state()
         axes = tuple(range(x.ndim - 1))  # all but channel/feature axis (last)
         if train:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
+            # stats accumulate in f32 even under bf16 compute (XLA fuses the
+            # cast into the reduction); running state is always f32
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
             new_state = {
                 "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
                 "var": self.decay * state["var"] + (1 - self.decay) * var,
@@ -79,7 +82,9 @@ class BatchNormalizationLayer(Layer):
         else:
             mean, var = state["mean"], state["var"]
             new_state = state
-        xhat = (x - mean) / jnp.sqrt(var + self.eps)
+        # normalize in the activation dtype: f32 stats must not promote a
+        # bf16 activation stream back to f32 mid-network
+        xhat = (x - mean.astype(x.dtype)) / jnp.sqrt(var.astype(x.dtype) + self.eps)
         if not self.lock_gamma_beta:
             xhat = xhat * params["gamma"] + params["beta"]
         elif self.gamma_init != 1.0 or self.beta_init != 0.0:

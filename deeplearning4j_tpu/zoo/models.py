@@ -368,7 +368,8 @@ class ResNet50(ZooModel):
         g.add_layer("stem-zero", ZeroPaddingLayer(padding=(3, 3)), "input")
         g.add_layer("stem-cnn1",
                     ConvolutionLayer(n_out=64, kernel_size=(7, 7), stride=(2, 2),
-                                     activation="identity"), "stem-zero")
+                                     activation="identity",
+                                     space_to_depth_stem=True), "stem-zero")
         g.add_layer("stem-batch1", BatchNormalizationLayer(activation="identity"), "stem-cnn1")
         g.add_layer("stem-act1", ActivationLayer(activation="relu"), "stem-batch1")
         g.add_layer("stem-maxpool1",

@@ -529,3 +529,39 @@ class TestConvLSTMGradients:
         assert check_model_gradients(m, x, y, features_mask=mask,
                                      labels_mask=mask, subset=40,
                                      print_results=True)
+
+
+class TestSpaceToDepthStem:
+    def test_s2d_conv_equivalence(self):
+        # space_to_depth_stem must be bit-for-bit the same math
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 30, 30, 3)).astype(np.float32))
+        base = ConvolutionLayer(n_in=3, n_out=8, kernel_size=(7, 7), stride=(2, 2))
+        p = base.init_params(jax.random.PRNGKey(0))
+        s2d = ConvolutionLayer(n_in=3, n_out=8, kernel_size=(7, 7), stride=(2, 2),
+                               space_to_depth_stem=True)
+        y_ref, _ = base.forward(p, x)
+        y_new, _ = s2d.forward(p, x)
+        np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_new),
+                                   atol=1e-5)
+        g_ref = jax.grad(lambda pp: jnp.sum(jnp.sin(base.forward(pp, x)[0])))(p)
+        g_new = jax.grad(lambda pp: jnp.sum(jnp.sin(s2d.forward(pp, x)[0])))(p)
+        for k in g_ref:
+            np.testing.assert_allclose(np.asarray(g_ref[k]), np.asarray(g_new[k]),
+                                       atol=1e-4)
+
+    def test_s2d_falls_back_when_inapplicable(self):
+        import jax
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        # odd spatial size: must silently use the plain conv path
+        x = jnp.asarray(rng.normal(size=(1, 15, 15, 3)).astype(np.float32))
+        l = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(7, 7), stride=(2, 2),
+                             space_to_depth_stem=True)
+        p = l.init_params(jax.random.PRNGKey(0))
+        base = ConvolutionLayer(n_in=3, n_out=4, kernel_size=(7, 7), stride=(2, 2))
+        y, _ = l.forward(p, x)
+        y_ref, _ = base.forward(p, x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-6)
