@@ -43,9 +43,23 @@ class KerasModelImport:
     """Static import API (``KerasModelImport.java``)."""
 
     @staticmethod
-    def import_keras_model_and_weights(h5_path: str):
-        """Full-model HDF5 (config + weights) → initialized network.
-        Returns MultiLayerNetwork for Sequential, ComputationGraph otherwise."""
+    def import_keras_model_and_weights(h5_path: str,
+                                       weights_path: Optional[str] = None):
+        """Full-model HDF5 (config + weights) → initialized network; or, with
+        ``weights_path``, a model-config JSON file + a save_weights HDF5 (the
+        two-file overload, ``KerasModelImport.java:50-194`` — exercised by
+        the reference's tfscope fixtures). Returns MultiLayerNetwork for
+        Sequential, ComputationGraph otherwise."""
+        if weights_path is not None:
+            with open(h5_path) as f:
+                model_json = json.load(f)
+            cfg = KerasModelConfig(model_json)
+            km = (KerasSequentialModel(cfg) if _is_sequential(model_json)
+                  else KerasModel(cfg))
+            net = km.init()
+            with Hdf5Archive(weights_path) as a:
+                km.copy_weights(net, a, *_weights_root(a))
+            return net
         with Hdf5Archive(h5_path) as a:
             model_json, training_json = _read_configs(a)
             cfg = KerasModelConfig(model_json, training_json)

@@ -419,3 +419,59 @@ class TestConvLSTMAndTimeDistributed:
         expected = m.predict(x, verbose=0)
         net = KerasModelImport.import_keras_model_and_weights(p)
         _assert_close(net.output(x), expected)
+
+
+class TestTensorFlowScopeImport:
+    """TF-scope weight files (the reference's ``tfscope`` fixtures,
+    ``KerasModelImportTest.java:38-56``): Keras-1 dialect configs whose layer
+    names contain scope slashes ("dense_1/xxx/yyy") and whose weight groups
+    nest extra TF scope levels ("global/policy_net/dense_2_W:0"). Fixtures
+    are synthesized in-format here so the test is self-contained."""
+
+    @staticmethod
+    def _write_fixture(tmp_path, scoped):
+        import h5py
+        rng = np.random.RandomState(3)
+        w1 = rng.rand(7, 6).astype(np.float32)
+        b1 = rng.rand(6).astype(np.float32)
+        w2 = rng.rand(6, 2).astype(np.float32)
+        b2 = rng.rand(2).astype(np.float32)
+        d1 = "dense_1/xxx/yyy" if scoped else "dense_1"
+        cfg = {"class_name": "Sequential", "config": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 7], "name": "input_1"}},
+            {"class_name": "Dense",
+             "config": {"name": d1, "input_dim": 7, "output_dim": 6,
+                        "activation": "tanh", "bias": True}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "input_dim": 6, "output_dim": 2,
+                        "activation": "linear", "bias": True}},
+        ]}
+        wpath = str(tmp_path / f"w_{scoped}.h5")
+        with h5py.File(wpath, "w") as f:
+            if scoped:
+                g1 = f.create_group(d1).create_group("global").create_group("shared")
+                g1.create_dataset("yyy_W:0", data=w1)
+                g1.create_dataset("yyy_b:0", data=b1)
+                g2 = f.create_group("dense_2/global/policy_net")
+                g2.create_dataset("dense_2_W:0", data=w2)
+                g2.create_dataset("dense_2_b:0", data=b2)
+            else:
+                g1 = f.create_group("dense_1")
+                g1.create_dataset("dense_1_W:0", data=w1)
+                g1.create_dataset("dense_1_b:0", data=b1)
+                g2 = f.create_group("dense_2")
+                g2.create_dataset("dense_2_W:0", data=w2)
+                g2.create_dataset("dense_2_b:0", data=b2)
+        jpath = str(tmp_path / f"m_{scoped}.json")
+        with open(jpath, "w") as f:
+            json.dump(cfg, f)
+        return jpath, wpath, (w1, b1, w2, b2)
+
+    @pytest.mark.parametrize("scoped", [False, True])
+    def test_json_plus_weights_two_file_import(self, tmp_path, scoped):
+        jpath, wpath, (w1, b1, w2, b2) = self._write_fixture(tmp_path, scoped)
+        net = KerasModelImport.import_keras_model_and_weights(jpath, wpath)
+        x = np.random.RandomState(0).rand(3, 7).astype(np.float32)
+        want = np.tanh(x @ w1 + b1) @ w2 + b2
+        _assert_close(net.output(x), want)
