@@ -10,6 +10,8 @@ from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
 from deeplearning4j_tpu.modelimport.keras.layers import (
     InvalidKerasConfigurationException,
     UnsupportedKerasConfigurationException,
+    clear_lambda_layers,
+    register_lambda_layer,
 )
 from deeplearning4j_tpu.modelimport.keras.model import (
     KerasModel,
@@ -20,5 +22,6 @@ from deeplearning4j_tpu.modelimport.keras.model import (
 __all__ = [
     "Hdf5Archive", "KerasModelImport", "KerasModel", "KerasModelConfig",
     "KerasSequentialModel", "InvalidKerasConfigurationException",
-    "UnsupportedKerasConfigurationException",
+    "UnsupportedKerasConfigurationException", "register_lambda_layer",
+    "clear_lambda_layers",
 ]

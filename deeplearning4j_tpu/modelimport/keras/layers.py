@@ -79,6 +79,23 @@ class InvalidKerasConfigurationException(ValueError):
     """Reference: ``exceptions/InvalidKerasConfigurationException.java``."""
 
 
+# user-registered Lambda layer implementations, keyed by Keras layer name
+# (reference: ``KerasLayer.registerLambdaLayer(name, SameDiffLambdaLayer)``)
+_LAMBDA_REGISTRY: Dict[str, object] = {}
+
+
+def register_lambda_layer(name: str, impl) -> None:
+    """Register the implementation for a Keras ``Lambda`` layer by its layer
+    name, to be picked up at import time. ``impl`` is either a framework
+    ``Layer`` or a plain ``fn(x) -> y`` (wrapped in a SameDiffLambdaLayer —
+    the same pairing the reference uses)."""
+    _LAMBDA_REGISTRY[name] = impl
+
+
+def clear_lambda_layers() -> None:
+    _LAMBDA_REGISTRY.clear()
+
+
 class UnsupportedKerasConfigurationException(ValueError):
     """Reference: ``exceptions/UnsupportedKerasConfigurationException.java``."""
 
@@ -455,6 +472,20 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         # the wrapper stores the inner layer's params unprefixed, so the
         # inner weight fn applies directly
         return TimeDistributedWrapper(name=name, layer=inner), wf
+
+    if class_name == "Lambda":
+        impl = _LAMBDA_REGISTRY.get(name)
+        if impl is None:
+            raise UnsupportedKerasConfigurationException(
+                f"Lambda layer {name!r}: arbitrary serialized Python is not "
+                "executed; register an implementation first with "
+                "modelimport.keras.register_lambda_layer(name, impl)")
+        if not isinstance(impl, Layer):
+            from deeplearning4j_tpu.nn.layers import SameDiffLambdaLayer
+
+            impl = SameDiffLambdaLayer(name=name, fn=impl)
+        impl.name = name
+        return impl, _no_weights
 
     if class_name == "ConvLSTM2D":
         filters = int(cfg.get("filters", cfg.get("nb_filter")))

@@ -475,3 +475,42 @@ class TestTensorFlowScopeImport:
         x = np.random.RandomState(0).rand(3, 7).astype(np.float32)
         want = np.tanh(x @ w1 + b1) @ w2 + b2
         _assert_close(net.output(x), want)
+
+
+class TestLambdaImport:
+    """Keras Lambda layers via the user registry
+    (``KerasLayer.registerLambdaLayer`` pattern): arbitrary serialized Python
+    is never executed; the user supplies the implementation by layer name."""
+
+    def test_registered_lambda(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import (
+            clear_lambda_layers, register_lambda_layer)
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((4,)),
+            kl.Dense(5, activation="relu", name="d"),
+            kl.Lambda(lambda t: t * 2.0 + 1.0, name="scale_shift"),
+        ])
+        p = _save(m, tmp_path, "lam.h5")
+        register_lambda_layer("scale_shift", lambda t: t * 2.0 + 1.0)
+        try:
+            x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+            expected = m.predict(x, verbose=0)
+            net = KerasModelImport.import_keras_model_and_weights(p)
+            _assert_close(net.output(x), expected)
+        finally:
+            clear_lambda_layers()
+
+    def test_unregistered_lambda_rejected(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import (
+            UnsupportedKerasConfigurationException, clear_lambda_layers)
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((4,)),
+            kl.Lambda(lambda t: t + 1.0, name="mystery"),
+        ])
+        p = _save(m, tmp_path, "lam2.h5")
+        clear_lambda_layers()
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="register_lambda_layer"):
+            KerasModelImport.import_keras_model_and_weights(p)
