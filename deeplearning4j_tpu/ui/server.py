@@ -34,6 +34,13 @@ _DASHBOARD_HTML = """<!DOCTYPE html>
 <h2>Score vs iteration</h2><svg id="score" class="chart" width="720" height="260"></svg>
 <h2>Parameter mean magnitudes</h2><svg id="params" class="chart" width="720" height="260"></svg>
 <h2>Latest stats</h2><div id="latest"></div>
+<h2 data-i18n="train.model.title">Model: per-layer detail</h2>
+<div><span data-i18n="train.model.layer">Layer</span>:
+ <select id="layersel"></select></div>
+<h3 data-i18n="train.model.paramhist">Parameter magnitudes over time</h3>
+<svg id="layerparams" class="chart" width="720" height="220"></svg>
+<h3 data-i18n="train.model.ratio">Update:parameter ratio (log10)</h3>
+<svg id="layerratio" class="chart" width="720" height="220"></svg>
 <script>
 const SVGNS = "http://www.w3.org/2000/svg";
 function polyline(svg, xs, ys, color){
@@ -66,10 +73,98 @@ async function refresh(){
     "<table><tr><th>iteration</th><td>"+latest.iteration+"</td></tr>" +
     "<tr><th>score</th><td>"+latest.score+"</td></tr>" +
     "<tr><th>minibatch</th><td>"+latest.minibatch_size+"</td></tr></table>";
+  await refreshModel(sid);
 }
+async function refreshModel(sid){
+  const model = await (await fetch("/train/model/" + sid)).json();
+  const sel = document.getElementById("layersel");
+  const current = sel.value;
+  sel.innerHTML = "";
+  for (const n of model.layer_names){
+    const o = document.createElement("option"); o.value = n; o.textContent = n;
+    sel.appendChild(o);
+  }
+  if (model.layer_names.includes(current)) sel.value = current;
+  if (!sel.value) return;
+  const det = await (await fetch("/train/model/" + sid + "/" + sel.value)).json();
+  const colors = ["#1565c0","#c62828","#2e7d32","#f9a825","#6a1b9a","#00838f"];
+  const ps = document.getElementById("layerparams"); ps.innerHTML = "";
+  let ci = 0;
+  for (const [p, s] of Object.entries(det.param_mean_magnitudes))
+    polyline(ps, det.iterations.slice(-s.length), s, colors[ci++ % colors.length]);
+  const rs = document.getElementById("layerratio"); rs.innerHTML = "";
+  ci = 0;
+  for (const [p, s] of Object.entries(det.update_param_ratio_log10))
+    polyline(rs, det.iterations.slice(-s.length), s, colors[ci++ % colors.length]);
+}
+async function applyI18n(lang){
+  const t = await (await fetch("/i18n/" + lang)).json();
+  for (const el of document.querySelectorAll("[data-i18n]")){
+    const k = el.getAttribute("data-i18n");
+    if (t[k]) el.textContent = t[k];
+  }
+}
+applyI18n((new URLSearchParams(location.search)).get("lang") || "en");
+document.getElementById("layersel").addEventListener("change", () => refresh());
 refresh(); setInterval(refresh, 3000);
 </script></body></html>
 """
+
+
+# i18n string tables (reference: deeplearning4j-play i18n resources /
+# DefaultI18N): the dashboard fetches /i18n/<lang> and re-labels headings.
+I18N = {
+    "en": {
+        "train.title": "deeplearning4j_tpu training UI",
+        "train.sessions": "Sessions",
+        "train.score.title": "Score vs iteration",
+        "train.params.title": "Parameter mean magnitudes",
+        "train.latest.title": "Latest stats",
+        "train.model.title": "Model: per-layer detail",
+        "train.model.layer": "Layer",
+        "train.model.paramhist": "Parameter magnitudes over time",
+        "train.model.ratio": "Update:parameter ratio (log10)",
+        "train.iteration": "iteration",
+        "train.score": "score",
+        "train.minibatch": "minibatch",
+    },
+    "de": {
+        "train.title": "deeplearning4j_tpu Trainings-UI",
+        "train.sessions": "Sitzungen",
+        "train.score.title": "Score pro Iteration",
+        "train.params.title": "Mittlere Parameterbeträge",
+        "train.latest.title": "Aktuelle Statistiken",
+        "train.model.title": "Modell: Schicht-Detail",
+        "train.model.layer": "Schicht",
+        "train.model.paramhist": "Parameterbeträge über die Zeit",
+        "train.model.ratio": "Update:Parameter-Verhältnis (log10)",
+        "train.iteration": "Iteration",
+        "train.score": "Score",
+        "train.minibatch": "Minibatch",
+    },
+    "ja": {
+        "train.title": "deeplearning4j_tpu トレーニングUI",
+        "train.sessions": "セッション",
+        "train.score.title": "スコア対イテレーション",
+        "train.params.title": "パラメータ平均絶対値",
+        "train.latest.title": "最新の統計",
+        "train.model.title": "モデル: レイヤー詳細",
+        "train.model.layer": "レイヤー",
+        "train.model.paramhist": "パラメータ絶対値の推移",
+        "train.model.ratio": "更新:パラメータ比 (log10)",
+        "train.iteration": "イテレーション",
+        "train.score": "スコア",
+        "train.minibatch": "ミニバッチ",
+    },
+}
+
+
+def _split_param_key(key: str):
+    """'0_W' / 'lstm1_RW' flat stat keys → (layer, param)."""
+    if "_" in key:
+        layer, param = key.rsplit("_", 1)
+        return layer, param
+    return "model", key
 
 
 class RemoteReceiverModule:
@@ -154,6 +249,73 @@ class UIServer:
             "latest": updates[-1].data if updates else None,
         }
 
+    def _updates(self, sid: str) -> List[Persistable]:
+        updates: List[Persistable] = []
+        for s in self._storages:
+            for wid in s.list_worker_ids_for_session(sid, TYPE_ID):
+                updates.extend(s.get_all_updates_after(sid, TYPE_ID, -1.0, wid))
+        updates.sort(key=lambda p: (p.data.get("iteration", 0), p.timestamp))
+        return updates
+
+    def _model(self, sid: str) -> dict:
+        """Per-layer summary (the reference TrainModule 'model' tab): layer
+        list with each parameter's latest stats and learning rate."""
+        updates = self._updates(sid)
+        layers: dict = {}
+        latest = updates[-1].data if updates else {}
+        for key, st in (latest.get("param_stats") or {}).items():
+            layer, param = _split_param_key(key)
+            layers.setdefault(layer, {"params": {}, "learning_rates": {}})
+            layers[layer]["params"][param] = st
+        for key, lr in (latest.get("learning_rates") or {}).items():
+            layer, param = _split_param_key(key)
+            layers.setdefault(layer, {"params": {}, "learning_rates": {}})
+            layers[layer]["learning_rates"][param] = lr
+        return {"session": sid, "layers": layers,
+                "layer_names": sorted(layers)}
+
+    def _layer_detail(self, sid: str, layer: str) -> dict:
+        """Drill-down time series for one layer: per-param mean-magnitude
+        series for params/gradients/updates, the update:param ratio (the
+        reference's headline training-health chart), and latest histograms
+        when the listener collects them."""
+        updates = self._updates(sid)
+        iterations, series, gseries, ratio = [], {}, {}, {}
+        hist = {}
+        for p in updates:
+            it = p.data.get("iteration", 0)
+            ps = p.data.get("param_stats") or {}
+            gs = p.data.get("gradient_stats") or {}
+            us = p.data.get("update_stats") or {}
+            touched = False
+            for key, st in ps.items():
+                lname, param = _split_param_key(key)
+                if lname != layer:
+                    continue
+                touched = True
+                series.setdefault(param, []).append(st.get("mean_magnitude", 0.0))
+                if "histogram" in st:
+                    hist[param] = st["histogram"]
+                u = us.get(key)
+                if u is not None:
+                    import math
+                    pm = st.get("mean_magnitude", 0.0)
+                    um = u.get("mean_magnitude", 0.0)
+                    ratio.setdefault(param, []).append(
+                        math.log10(max(um, 1e-12) / max(pm, 1e-12)))
+            for key, st in gs.items():
+                lname, param = _split_param_key(key)
+                if lname == layer:
+                    gseries.setdefault(param, []).append(
+                        st.get("mean_magnitude", 0.0))
+            if touched:
+                iterations.append(it)
+        return {"session": sid, "layer": layer, "iterations": iterations,
+                "param_mean_magnitudes": series,
+                "gradient_mean_magnitudes": gseries,
+                "update_param_ratio_log10": ratio,
+                "histograms": hist}
+
     # -- http -------------------------------------------------------------
     def start(self) -> int:
         """Start serving on self.port (0 → ephemeral); returns the bound port."""
@@ -206,6 +368,19 @@ class UIServer:
                 elif path.startswith("/train/overview/"):
                     sid = path.rsplit("/", 1)[-1]
                     self._json(ui._overview(sid))
+                elif path.startswith("/train/model/"):
+                    parts = [p for p in path.split("/") if p][2:]
+                    if len(parts) == 1:
+                        self._json(ui._model(parts[0]))
+                    elif len(parts) == 2:
+                        self._json(ui._layer_detail(parts[0], parts[1]))
+                    else:
+                        self._json({"error": "not found"}, 404)
+                elif path == "/i18n" or path == "/i18n/":
+                    self._json(sorted(I18N))
+                elif path.startswith("/i18n/"):
+                    lang = path.rsplit("/", 1)[-1]
+                    self._json(I18N.get(lang, I18N["en"]))
                 else:
                     self._json({"error": "not found"}, 404)
 

@@ -245,3 +245,44 @@ class TestUiModules:
             assert records.count("another") == 1
         finally:
             logger.removeHandler(h)
+
+
+class TestModelDrilldownAndI18n:
+    def test_model_and_layer_endpoints(self):
+        storage = InMemoryStatsStorage()
+        _train_with_listener(storage, iters=5)
+        server = UIServer(port=0)
+        server.attach(storage)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            model = json.loads(urllib.request.urlopen(
+                f"{base}/train/model/sess1").read())
+            assert model["layer_names"], model
+            layer = model["layer_names"][0]
+            assert "params" in model["layers"][layer]
+            assert "W" in model["layers"][layer]["params"]
+            det = json.loads(urllib.request.urlopen(
+                f"{base}/train/model/sess1/{layer}").read())
+            assert det["iterations"]
+            assert "W" in det["param_mean_magnitudes"]
+            assert len(det["param_mean_magnitudes"]["W"]) == len(det["iterations"])
+        finally:
+            server.stop()
+
+    def test_i18n_endpoints_and_dashboard_hooks(self):
+        server = UIServer(port=0)
+        port = server.start()
+        try:
+            base = f"http://127.0.0.1:{port}"
+            langs = json.loads(urllib.request.urlopen(f"{base}/i18n").read())
+            assert {"en", "de", "ja"} <= set(langs)
+            de = json.loads(urllib.request.urlopen(f"{base}/i18n/de").read())
+            assert de["train.model.layer"] == "Schicht"
+            # unknown language falls back to english
+            xx = json.loads(urllib.request.urlopen(f"{base}/i18n/xx").read())
+            assert xx["train.model.layer"] == "Layer"
+            html = urllib.request.urlopen(f"{base}/train").read().decode()
+            assert "data-i18n" in html and "/train/model/" in html
+        finally:
+            server.stop()
