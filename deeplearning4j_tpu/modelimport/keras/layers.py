@@ -646,3 +646,44 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
 
     raise UnsupportedKerasConfigurationException(
         f"Unsupported Keras layer type {class_name!r}")
+
+
+def map_keras_mha_cross(cfg: dict) -> Tuple[Layer, WeightFn]:
+    """True cross-attention ``MultiHeadAttention`` (distinct query/value
+    inbound tensors) → CrossAttentionLayer. Called by the functional-model
+    importer, which knows the inbound arity."""
+    from deeplearning4j_tpu.nn.layers import CrossAttentionLayer
+
+    name = cfg.get("name")
+    heads = int(cfg.get("num_heads", 1))
+    key_dim = int(cfg.get("key_dim", 0)) or None
+    value_dim = cfg.get("value_dim")
+    if cfg.get("output_shape") is not None:
+        raise UnsupportedKerasConfigurationException(
+            "MultiHeadAttention with an explicit output_shape is not "
+            "supported (output dim must equal the query dim)")
+
+    def weights(raw):
+        def proj(prefix):
+            kk = np.asarray(raw[f"{prefix}_kernel"])
+            d, h, dh = kk.shape
+            w = kk.reshape(d, h * dh)
+            b = (np.asarray(raw[f"{prefix}_bias"]).reshape(h * dh)
+                 if f"{prefix}_bias" in raw else np.zeros(h * dh, np.float32))
+            return w, b
+        wq, bq = proj("query")
+        wk, bk = proj("key")
+        wv, bv = proj("value")
+        wo_raw = np.asarray(raw["attention_output_kernel"])
+        wo = wo_raw.reshape(-1, wo_raw.shape[-1])
+        bo = (np.asarray(raw["attention_output_bias"])
+              if "attention_output_bias" in raw
+              else np.zeros(wo.shape[1], np.float32))
+        return ({"Wq": wq, "bq": bq, "Wk": wk, "bk": bk, "Wv": wv, "bv": bv,
+                 "Wo": wo, "bo": bo}, {})
+
+    layer = CrossAttentionLayer(
+        name=name, n_heads=heads, head_size=key_dim,
+        value_size=None if value_dim is None else int(value_dim),
+        attn_dropout=float(cfg.get("dropout", 0.0)))
+    return layer, weights

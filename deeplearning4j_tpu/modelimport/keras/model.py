@@ -244,13 +244,21 @@ class KerasModel:
                 continue
             if cls == "MultiHeadAttention":
                 # self-attention calls mha(x, x[, x]): collapse identical
-                # inbound tensors to one input; true cross-attention (distinct
-                # query/value sources) is not yet supported
+                # inbound tensors to one input. Distinct query/value sources =
+                # true cross-attention → CrossAttentionLayer, keeping the
+                # inbound tensors separate (Keras call order [query, value(,
+                # key)]) via the graph's multi-input layer protocol.
                 uniq = list(dict.fromkeys(inputs))
                 if len(uniq) > 1:
-                    raise UnsupportedKerasConfigurationException(
-                        f"MultiHeadAttention {lname!r} with distinct "
-                        f"query/value inputs (cross-attention) is not supported")
+                    from deeplearning4j_tpu.modelimport.keras.layers import (
+                        map_keras_mha_cross)
+
+                    layer, wf = map_keras_mha_cross(c)
+                    layer.name = lname
+                    self.layer_names.append(lname)
+                    self.weight_fns[lname] = wf
+                    g.add_layer(lname, layer, *inputs)
+                    continue
                 inputs = uniq
             layer, wf = map_keras_layer(cls, c)
             if layer is None:

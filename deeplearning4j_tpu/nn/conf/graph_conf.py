@@ -169,6 +169,12 @@ class ComputationGraphConfiguration:
             self.vertex_input_types[name] = in_types
             if vd.is_layer:
                 layer: Layer = vd.obj  # type: ignore[assignment]
+                if getattr(layer, "consumes_multiple_inputs", False):
+                    # multi-input layers (e.g. cross-attention) see every
+                    # input type separately — no concat, no preprocessor
+                    layer.set_n_in_multi(in_types)
+                    types[name] = layer.output_type_multi(in_types)
+                    continue
                 it = in_types[0]
                 pre = layer.input_preprocessor(it)
                 if pre is not None:

@@ -137,6 +137,14 @@ class ComputationGraph:
             in_masks = [m.get(s) for s in vd.inputs]
             if vd.is_layer:
                 layer: Layer = vd.obj  # type: ignore[assignment]
+                if getattr(layer, "consumes_multiple_inputs", False):
+                    y, st = layer.forward_multi(
+                        params[name], in_acts, state=states[name], train=train,
+                        rng=rngs[vi], masks=in_masks)
+                    new_states[name] = st if st else states[name]
+                    acts[name] = y
+                    m[name] = in_masks[0]
+                    continue
                 h = in_acts[0] if len(in_acts) == 1 else jnp.concatenate(in_acts, -1)
                 if name in conf.preprocessors:
                     h = conf.preprocessors[name](h)

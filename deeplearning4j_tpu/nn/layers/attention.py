@@ -156,3 +156,96 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
         out = out.transpose(0, 2, 1, 3).reshape(n, self.n_queries, h * dh)
         y = out @ params["Wo"] + params["bo"]
         return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class CrossAttentionLayer(Layer):
+    """Multi-head cross-attention: query from one graph input, key/value from
+    another (Keras ``MultiHeadAttention(query, value[, key])`` semantics —
+    the importer maps true cross-attention MHA here). Consumes MULTIPLE graph
+    inputs via the graph's multi-input layer protocol; inputs arrive in Keras
+    call order [query, value(, key)] (key defaults to value).
+
+    Separate projections (Wq/Wk/Wv) rather than the fused Wqkv of
+    SelfAttentionLayer, because the sources (and ``value_size``) may differ.
+    """
+
+    n_in: int = 0          # query feature dim
+    k_in: int = 0          # key source feature dim
+    v_in: int = 0          # value source feature dim
+    n_out: int = 0         # output dim (default: query dim)
+    n_heads: int = 1
+    head_size: Optional[int] = None   # Dh for q/k
+    value_size: Optional[int] = None  # Dv (defaults to head_size)
+    attn_dropout: float = 0.0
+
+    consumes_multiple_inputs = True
+
+    def _dh(self) -> int:
+        return self.head_size or max(1, self.n_in // self.n_heads)
+
+    def _dv(self) -> int:
+        return self.value_size or self._dh()
+
+    def set_n_in_multi(self, input_types) -> None:
+        if not self.n_in:
+            self.n_in = input_types[0].size
+        if len(input_types) > 1 and not self.v_in:
+            self.v_in = input_types[1].size
+        if not self.k_in:
+            self.k_in = (input_types[2].size if len(input_types) > 2
+                         else self.v_in or self.n_in)
+        if not self.v_in:
+            self.v_in = self.n_in
+        if not self.n_out:
+            self.n_out = self.n_in
+
+    def output_type_multi(self, input_types) -> InputType:
+        return InputType.recurrent(self.n_out or input_types[0].size,
+                                   input_types[0].timesteps)
+
+    def param_shapes(self):
+        h, dh, dv = self.n_heads, self._dh(), self._dv()
+        return {"Wq": (self.n_in, h * dh), "bq": (h * dh,),
+                "Wk": (self.k_in, h * dh), "bk": (h * dh,),
+                "Wv": (self.v_in, h * dv), "bv": (h * dv,),
+                "Wo": (h * dv, self.n_out), "bo": (self.n_out,)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        out = {}
+        keys = jax.random.split(rng, 4)
+        shapes = self.param_shapes()
+        for k, name in zip(keys, ("Wq", "Wk", "Wv", "Wo")):
+            s = shapes[name]
+            out[name] = self._init_w(k, s, s[0], s[1], dtype)
+            out["b" + name[1:].lower()] = jnp.zeros(shapes["b" + name[1:].lower()], dtype)
+        return out
+
+    def forward_multi(self, params, inputs, *, state=None, train=False,
+                      rng=None, masks=None):
+        xq = inputs[0]
+        xv = inputs[1] if len(inputs) > 1 else xq
+        xk = inputs[2] if len(inputs) > 2 else xv
+        n, tq, _ = xq.shape
+        tk = xk.shape[1]
+        h, dh, dv = self.n_heads, self._dh(), self._dv()
+        q = (xq @ params["Wq"] + params["bq"]).reshape(n, tq, h, dh).transpose(0, 2, 1, 3)
+        k = (xk @ params["Wk"] + params["bk"]).reshape(n, tk, h, dh).transpose(0, 2, 1, 3)
+        v = (xv @ params["Wv"] + params["bv"]).reshape(n, tk, h, dv).transpose(0, 2, 1, 3)
+        kv_mask = None
+        if masks is not None:
+            # mask over KEYS: the key source's mask (fall back to value's)
+            kv_mask = masks[2] if len(masks) > 2 and masks[2] is not None \
+                else (masks[1] if len(masks) > 1 else None)
+        out = dot_product_attention(q, k, v, mask=kv_mask,
+                                    dropout_rate=self.attn_dropout,
+                                    rng=rng, train=train)
+        out = out.transpose(0, 2, 1, 3).reshape(n, tq, h * dv)
+        y = out @ params["Wo"] + params["bo"]
+        return self.act_fn()(y), state or {}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        # single-input degenerate case == self-attention over x
+        return self.forward_multi(params, [x], state=state, train=train,
+                                  rng=rng, masks=[mask] if mask is not None else None)

@@ -259,3 +259,27 @@ class TestGraphGradients:
         y1 = np.eye(2)[rng.integers(0, 2, 3)]
         y2 = rng.normal(size=(3, 1))
         assert check_graph_gradients(g, [xa, xb], [y1, y2], print_results=True)
+
+
+class TestCrossAttentionGraph:
+    def test_cross_attention_gradients(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import (CrossAttentionLayer,
+                                                  LossLayer)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.util.gradient_check import check_graph_gradients
+
+        g = (NeuralNetConfiguration.builder().seed(3).graph_builder()
+             .add_inputs("q", "kv")
+             .set_input_types(InputType.recurrent(6, 4), InputType.recurrent(6, 5)))
+        g.add_layer("xatt", CrossAttentionLayer(n_heads=2, head_size=3), "q", "kv")
+        g.add_layer("out", LossLayer(loss="mse", activation="identity"), "xatt")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(5)
+        xq = rng.normal(size=(2, 4, 6))
+        xkv = rng.normal(size=(2, 5, 6))
+        y = rng.normal(size=(2, 4, 6))
+        assert check_graph_gradients(net, [xq, xkv], [y], subset=40,
+                                     print_results=True)

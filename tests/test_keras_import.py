@@ -287,19 +287,35 @@ class TestTransformerImport:
             net.fit(DataSet(x, y))
         assert float(net.score_) < before
 
-    def test_cross_attention_rejected(self, tmp_path):
+    def test_cross_attention_output_equivalence(self, tmp_path):
         kl = keras.layers
         a = kl.Input((5, 16), name="a")
-        b = kl.Input((5, 16), name="b")
+        b = kl.Input((7, 16), name="b")
         att = kl.MultiHeadAttention(num_heads=2, key_dim=8,
                                     name="xatt")(a, b)
         m = keras.Model([a, b], att)
         p = _save(m, tmp_path, "cross.h5")
-        import pytest as _pytest
-        from deeplearning4j_tpu.modelimport.keras.layers import (
-            UnsupportedKerasConfigurationException)
-        with _pytest.raises(UnsupportedKerasConfigurationException):
-            KerasModelImport.import_keras_model_and_weights(p)
+        rng = np.random.RandomState(9)
+        xa = rng.rand(2, 5, 16).astype(np.float32)
+        xb = rng.rand(2, 7, 16).astype(np.float32)
+        expected = m.predict([xa, xb], verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(xa, xb), expected)
+
+    def test_cross_attention_distinct_value_dim(self, tmp_path):
+        kl = keras.layers
+        a = kl.Input((4, 12), name="a")
+        b = kl.Input((6, 10), name="b")
+        att = kl.MultiHeadAttention(num_heads=2, key_dim=5, value_dim=7,
+                                    name="xatt")(a, b)
+        m = keras.Model([a, b], att)
+        p = _save(m, tmp_path, "cross2.h5")
+        rng = np.random.RandomState(10)
+        xa = rng.rand(2, 4, 12).astype(np.float32)
+        xb = rng.rand(2, 6, 10).astype(np.float32)
+        expected = m.predict([xa, xb], verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(xa, xb), expected)
 
 
 class TestGruAndTimeDistributed:
