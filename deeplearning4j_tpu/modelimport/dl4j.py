@@ -1,0 +1,397 @@
+"""DL4J configuration import — migration path from reference checkpoints.
+
+Reads the reference's ``MultiLayerConfiguration.toJson()`` format (Jackson,
+``nn/conf/MultiLayerConfiguration.java:57-63`` top-level fields; layer
+subtype names from the ``@JsonSubTypes`` registry in
+``nn/conf/layers/Layer.java:54-86``; per-layer fields from ``BaseLayer.java:
+42-54`` / ``FeedForwardLayer.java:21-22`` / ``ConvolutionLayer.java:35-37``)
+and builds the equivalent config here. Also opens ``ModelSerializer`` zips
+(``util/ModelSerializer.java:120-125``: ``configuration.json`` +
+``coefficients.bin``) for their configuration; ``coefficients.bin`` is the
+external ND4J binary (not part of this repo's sources), so parameter values
+are not ingested — the returned network is freshly initialized.
+
+The parser is deliberately tolerant about field spellings ("nin"/"nIn",
+activation as enum string or ``@class`` wrapper) — the same posture as the
+reference's own legacy deserializers (``nn/conf/serde/``), because real DL4J
+JSON varies across 0.6-1.0 versions.
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Any, Dict, Optional
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+
+
+class InvalidDl4jConfigurationException(ValueError):
+    pass
+
+
+class UnsupportedDl4jConfigurationException(ValueError):
+    pass
+
+
+def _get(d: dict, *names, default=None):
+    for n in names:
+        if n in d:
+            return d[n]
+    return default
+
+
+# -- activation / loss / updater / weight-init vocabulary -------------------
+
+_ACTIVATIONS = {
+    "relu": "relu", "relu6": "relu6", "sigmoid": "sigmoid", "tanh": "tanh",
+    "tanh.": "tanh", "softmax": "softmax", "identity": "identity",
+    "softplus": "softplus", "softsign": "softsign", "elu": "elu",
+    "selu": "selu", "cube": "cube", "hardsigmoid": "hardsigmoid",
+    "hardtanh": "hardtanh", "leakyrelu": "leakyrelu", "lrelu": "leakyrelu",
+    "rationaltanh": "rationaltanh", "swish": "swish", "gelu": "gelu",
+    "rrelu": "leakyrelu", "thresholdedrelu": "relu",
+}
+
+
+def _activation(v) -> Optional[str]:
+    """activationFn: enum string ("RELU"), {"@class": ".ActivationReLU"},
+    or WRAPPER_OBJECT {"ReLU": {...}}."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        key = v.lower()
+    elif isinstance(v, dict):
+        cls = v.get("@class")
+        if cls is None and len(v) == 1:
+            cls = next(iter(v))
+        if cls is None:
+            return None
+        key = cls.rsplit(".", 1)[-1]
+        if key.lower().startswith("activation"):
+            key = key[len("Activation"):]
+        key = key.lower()
+    else:
+        return None
+    key = key.replace("_", "")
+    if key not in _ACTIVATIONS:
+        raise UnsupportedDl4jConfigurationException(
+            f"unknown DL4J activation {v!r}")
+    return _ACTIVATIONS[key]
+
+
+_LOSSES = {
+    "mcxent": "mcxent", "negativeloglikelihood": "mcxent", "mse": "mse",
+    "l2": "mse", "binaryxent": "xent", "xent": "xent", "mae": "l1",
+    "l1": "l1", "kld": "kld", "kldivergence": "kld", "poisson": "poisson",
+    "cosineproximity": "cosine_proximity", "hinge": "hinge",
+    "squaredhinge": "squared_hinge", "meansquaredlogarithmicerror": "msle",
+}
+
+
+def _loss(v) -> Optional[str]:
+    if v is None:
+        return None
+    if isinstance(v, str):
+        key = v.lower()
+    elif isinstance(v, dict):
+        cls = v.get("@class")
+        if cls is None and len(v) == 1:
+            cls = next(iter(v))
+        key = cls.rsplit(".", 1)[-1]
+        if key.lower().startswith("loss"):
+            key = key[len("Loss"):]
+        key = key.lower()
+    else:
+        return None
+    key = key.replace("_", "")
+    if key not in _LOSSES:
+        raise UnsupportedDl4jConfigurationException(f"unknown DL4J loss {v!r}")
+    return _LOSSES[key]
+
+
+def _updater(v):
+    """iUpdater: {"@class": "org.nd4j.linalg.learning.config.Adam", ...}."""
+    from deeplearning4j_tpu.nn import updaters as U
+    if v is None or not isinstance(v, dict):
+        return None
+    cls = v.get("@class")
+    if cls is None and len(v) == 1:
+        cls, v = next(iter(v.items()))
+    if cls is None:
+        return None
+    name = cls.rsplit(".", 1)[-1].lower()
+    lr = _get(v, "learningRate", "lr", default=None)
+    kw: Dict[str, Any] = {}
+    if lr is not None:
+        kw["learning_rate"] = float(lr)
+    table = {
+        "sgd": U.Sgd, "adam": U.Adam, "adamax": U.AdaMax,
+        "adadelta": U.AdaDelta, "adagrad": U.AdaGrad, "nadam": U.Nadam,
+        "nesterovs": U.Nesterovs, "rmsprop": U.RmsProp, "noop": U.NoOp,
+    }
+    if name not in table:
+        raise UnsupportedDl4jConfigurationException(
+            f"unknown DL4J updater {cls!r}")
+    if name == "nesterovs" and "momentum" in v:
+        kw["momentum"] = float(v["momentum"])
+    if name in ("adam", "adamax", "nadam"):
+        if "beta1" in v:
+            kw["beta1"] = float(v["beta1"])
+        if "beta2" in v:
+            kw["beta2"] = float(v["beta2"])
+    if name == "rmsprop" and "rmsDecay" in v:
+        kw["decay"] = float(v["rmsDecay"])
+    try:
+        return table[name](**kw)
+    except TypeError:
+        kw.pop("learning_rate", None)
+        return table[name](**kw)
+
+
+def _weight_init(v) -> Optional[str]:
+    return None if v is None else str(v).lower()
+
+
+# -- per-layer conversion ----------------------------------------------------
+
+def _base_kwargs(cfg: dict) -> dict:
+    """Fields shared by BaseLayer subclasses."""
+    kw: Dict[str, Any] = {}
+    name = _get(cfg, "layerName", "layername")
+    if name:
+        kw["name"] = name
+    act = _activation(_get(cfg, "activationFn", "activationFunction",
+                           "activation"))
+    if act is not None:
+        kw["activation"] = act
+    wi = _weight_init(_get(cfg, "weightInit", "weightinit"))
+    if wi and wi != "distribution":
+        kw["weight_init"] = wi
+    for src, dst in (("l1", "l1"), ("l2", "l2")):
+        val = cfg.get(src)
+        if isinstance(val, (int, float)) and val == val and val != 0.0:
+            kw[dst] = float(val)
+    upd = _updater(_get(cfg, "iUpdater", "iupdater", "updater")
+                   if isinstance(_get(cfg, "iUpdater", "iupdater", "updater"),
+                                 dict) else None)
+    if upd is not None:
+        kw["updater"] = upd
+    gn = _get(cfg, "gradientNormalization")
+    if gn and gn != "None":
+        snake = "".join(("_" + c.lower() if c.isupper() else c)
+                        for c in gn).lstrip("_")
+        kw["gradient_normalization"] = snake
+        thr = _get(cfg, "gradientNormalizationThreshold")
+        if thr is not None:
+            kw["gradient_normalization_threshold"] = float(thr)
+    return kw
+
+
+def _nin_nout(cfg: dict) -> dict:
+    out = {}
+    nin = _get(cfg, "nin", "nIn", "nIn_")
+    nout = _get(cfg, "nout", "nOut")
+    if nin:
+        out["n_in"] = int(nin)
+    if nout:
+        out["n_out"] = int(nout)
+    return out
+
+
+def _pair(v, default=(1, 1)):
+    if v is None:
+        return default
+    if isinstance(v, int):
+        return (v, v)
+    return tuple(int(x) for x in v[:2]) if len(v) >= 2 else (int(v[0]),) * 2
+
+
+def _conv_mode(v) -> str:
+    return {"Same": "same", "Truncate": "truncate",
+            "Strict": "strict"}.get(v, "truncate")
+
+
+def convert_dl4j_layer(type_name: str, cfg: dict):
+    """One WRAPPER_OBJECT layer entry {type_name: cfg} → our Layer."""
+    from deeplearning4j_tpu.nn import layers as L
+
+    t = type_name
+    base = _base_kwargs(cfg)
+    ff = _nin_nout(cfg)
+
+    if t == "dense":
+        return L.DenseLayer(**base, **ff,
+                            has_bias=bool(_get(cfg, "hasBias", default=True)))
+    if t in ("output", "rnnoutput", "CenterLossOutputLayer"):
+        loss = _loss(_get(cfg, "lossFn", "lossFunction"))
+        cls = {"output": L.OutputLayer, "rnnoutput": L.RnnOutputLayer,
+               "CenterLossOutputLayer": L.CenterLossOutputLayer}[t]
+        kw = dict(base, **ff)
+        if loss:
+            kw["loss"] = loss
+        if t == "CenterLossOutputLayer":
+            if "alpha" in cfg:
+                kw["alpha"] = float(cfg["alpha"])
+            if "lambda" in cfg:
+                kw["lambda_"] = float(cfg["lambda"])
+        return cls(**kw)
+    if t in ("loss", "RnnLossLayer", "CnnLossLayer"):
+        loss = _loss(_get(cfg, "lossFn", "lossFunction")) or "mse"
+        cls = {"loss": L.LossLayer, "RnnLossLayer": L.LossLayer,
+               "CnnLossLayer": L.CnnLossLayer}[t]
+        return cls(**base, loss=loss)
+    if t in ("convolution", "convolution1d"):
+        kw = dict(base, **ff,
+                  kernel_size=_pair(_get(cfg, "kernelSize"), (3, 3)),
+                  stride=_pair(_get(cfg, "stride"), (1, 1)),
+                  padding=_pair(_get(cfg, "padding"), (0, 0)),
+                  convolution_mode=_conv_mode(_get(cfg, "convolutionMode")))
+        cls = L.Convolution1DLayer if t == "convolution1d" else L.ConvolutionLayer
+        if t == "convolution1d":
+            kw["kernel_size"] = kw["kernel_size"][0]
+            kw["stride"] = kw["stride"][0]
+        return cls(**kw)
+    if t in ("subsampling", "subsampling1d"):
+        pt = str(_get(cfg, "poolingType", default="MAX")).lower()
+        kw = dict(base,
+                  pooling_type="avg" if pt in ("avg", "average") else pt,
+                  kernel_size=_pair(_get(cfg, "kernelSize"), (2, 2)),
+                  stride=_pair(_get(cfg, "stride"), (2, 2)),
+                  convolution_mode=_conv_mode(_get(cfg, "convolutionMode")))
+        return (L.Subsampling1DLayer if t == "subsampling1d"
+                else L.SubsamplingLayer)(**kw)
+    if t == "batchNormalization":
+        kw = dict(base)
+        if "eps" in cfg:
+            kw["eps"] = float(cfg["eps"])
+        if "decay" in cfg:
+            kw["decay"] = float(cfg["decay"])
+        n = _get(cfg, "nin", "nIn", "nout", "nOut")
+        if n:
+            kw["n_in"] = int(n)
+        return L.BatchNormalizationLayer(**kw)
+    if t == "localResponseNormalization":
+        kw = dict(base)
+        for f in ("k", "n", "alpha", "beta"):
+            if f in cfg:
+                kw[f] = cfg[f]
+        return L.LocalResponseNormalizationLayer(**kw)
+    if t == "embedding":
+        return L.EmbeddingLayer(**base, **ff,
+                                has_bias=bool(_get(cfg, "hasBias",
+                                                   default=False)))
+    if t == "activation":
+        return L.ActivationLayer(**base)
+    if t == "dropout":
+        return L.DropoutLayer(**base)
+    if t == "LSTM":
+        return L.LSTMLayer(**base, **ff, forget_gate_bias_init=float(
+            _get(cfg, "forgetGateBiasInit", default=1.0)))
+    if t == "gravesLSTM":
+        return L.GravesLSTMLayer(**base, **ff, forget_gate_bias_init=float(
+            _get(cfg, "forgetGateBiasInit", default=1.0)))
+    if t == "gravesBidirectionalLSTM":
+        return L.GravesBidirectionalLSTMLayer(**base, **ff,
+                                              forget_gate_bias_init=float(
+            _get(cfg, "forgetGateBiasInit", default=1.0)))
+    if t == "SimpleRnn":
+        return L.SimpleRnnLayer(**base, **ff)
+    if t == "GlobalPooling":
+        pt = str(_get(cfg, "poolingType", default="MAX")).lower()
+        return L.GlobalPoolingLayer(
+            **base, pooling_type="avg" if pt in ("avg", "average") else pt)
+    if t == "zeroPadding":
+        return L.ZeroPaddingLayer(**base,
+                                  padding=tuple(_get(cfg, "padding", default=(1, 1, 1, 1))))
+    if t == "Upsampling2D":
+        s = _get(cfg, "size", default=2)
+        return L.UpsamplingLayer(**base, size=_pair(s, (2, 2)))
+    if t == "autoEncoder":
+        kw = dict(base, **ff)
+        if "corruptionLevel" in cfg:
+            kw["corruption_level"] = float(cfg["corruptionLevel"])
+        return L.AutoEncoderLayer(**kw)
+    if t == "ElementWiseMult":
+        return L.ElementWiseMultiplicationLayer(**base, **ff)
+    if t == "MaskZeroLayer":
+        inner_t, inner_cfg = next(iter(_get(cfg, "underlying", default={}).items()))
+        return L.MaskZeroLayer(layer=convert_dl4j_layer(inner_t, inner_cfg),
+                               mask_value=float(_get(cfg, "maskingValue",
+                                                     default=0.0)))
+    if t == "Bidirectional":
+        mode = str(_get(cfg, "mode", default="CONCAT")).lower()
+        inner = _get(cfg, "fwd", "rnnLayer", default=None)
+        if inner is None:
+            raise InvalidDl4jConfigurationException(
+                "Bidirectional layer without inner rnn config")
+        inner_t, inner_cfg = next(iter(inner.items()))
+        return L.BidirectionalWrapper(
+            layer=convert_dl4j_layer(inner_t, inner_cfg),
+            mode={"add": "add", "mul": "mul", "average": "average",
+                  "concat": "concat"}.get(mode, "concat"))
+    if t == "FrozenLayer":
+        inner = _get(cfg, "layer", default=None)
+        if isinstance(inner, dict) and len(inner) == 1:
+            inner_t, inner_cfg = next(iter(inner.items()))
+            return L.FrozenLayer(layer=convert_dl4j_layer(inner_t, inner_cfg))
+        raise InvalidDl4jConfigurationException("FrozenLayer without inner layer")
+    raise UnsupportedDl4jConfigurationException(
+        f"unsupported DL4J layer type {t!r}")
+
+
+# -- top-level ---------------------------------------------------------------
+
+def import_dl4j_configuration(source: str):
+    """DL4J ``MultiLayerConfiguration`` JSON (string or dict) → our config."""
+    d = json.loads(source) if isinstance(source, str) else source
+    confs = d.get("confs")
+    if confs is None:
+        raise InvalidDl4jConfigurationException(
+            "not a MultiLayerConfiguration JSON (no 'confs')")
+
+    b = NeuralNetConfiguration.builder()
+    first = confs[0] if confs else {}
+    if "seed" in first:
+        b.seed(int(first["seed"]))
+    lb = b.list()
+    for conf in confs:
+        layer_entry = conf.get("layer")
+        if not isinstance(layer_entry, dict) or len(layer_entry) != 1:
+            raise InvalidDl4jConfigurationException(
+                f"bad layer entry {layer_entry!r}")
+        t, cfg = next(iter(layer_entry.items()))
+        lb.layer(convert_dl4j_layer(t, cfg))
+
+    bp = d.get("backpropType")
+    if bp == "TruncatedBPTT":
+        lb.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
+    built = lb.build()
+    return built
+
+
+def import_dl4j_zip(path: str):
+    """ModelSerializer zip → (config, metadata). Parameter values
+    (``coefficients.bin``, external ND4J binary) are not ingested; the
+    caller initializes fresh params from the imported config."""
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        if "configuration.json" not in names:
+            raise InvalidDl4jConfigurationException(
+                f"{path}: no configuration.json in zip (entries: {sorted(names)})")
+        conf = import_dl4j_configuration(
+            z.read("configuration.json").decode("utf-8"))
+        meta = {"has_coefficients": "coefficients.bin" in names,
+                "has_updater_state": "updaterState.bin" in names,
+                "has_normalizer": "normalizer.bin" in names}
+    return conf, meta
+
+
+def restore_multi_layer_network_configuration(path: str):
+    """Zip → fresh MultiLayerNetwork built from the reference config
+    (the configuration half of ``ModelSerializer.restoreMultiLayerNetwork``,
+    ``util/ModelSerializer.java:182``)."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf, _ = import_dl4j_zip(path)
+    return MultiLayerNetwork(conf)

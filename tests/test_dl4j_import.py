@@ -1,0 +1,169 @@
+"""DL4J configuration import tests.
+
+Fixtures are hand-written in the reference's MultiLayerConfiguration JSON
+dialect (WRAPPER_OBJECT layer entries per ``nn/conf/layers/Layer.java:54``
+subtype names; ``@class`` activation/loss/updater wrappers of the 0.9-1.0
+era) so migration works without any Java in the loop.
+"""
+
+import json
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    InvalidDl4jConfigurationException,
+    UnsupportedDl4jConfigurationException,
+    import_dl4j_configuration,
+    import_dl4j_zip,
+    restore_multi_layer_network_configuration,
+)
+from deeplearning4j_tpu.nn.layers import (
+    BatchNormalizationLayer,
+    ConvolutionLayer,
+    DenseLayer,
+    GravesLSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
+
+
+def mlp_json():
+    return json.dumps({
+        "backprop": True,
+        "backpropType": "Standard",
+        "confs": [
+            {"seed": 42, "layer": {"dense": {
+                "layerName": "h0",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationReLU"},
+                "nin": 4, "nout": 16, "l2": 1e-4,
+                "weightInit": "XAVIER",
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01, "beta1": 0.9, "beta2": 0.999},
+            }}},
+            {"layer": {"output": {
+                "layerName": "out",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "nin": 16, "nout": 3,
+            }}},
+        ],
+    })
+
+
+class TestMlpImport:
+    def test_structure_and_fields(self):
+        conf = import_dl4j_configuration(mlp_json())
+        layers = conf.layers
+        assert isinstance(layers[0], DenseLayer)
+        assert layers[0].n_in == 4 and layers[0].n_out == 16
+        assert layers[0].activation == "relu"
+        assert layers[0].l2 == pytest.approx(1e-4)
+        assert isinstance(layers[0].updater, Adam)
+        assert layers[0].updater.learning_rate == pytest.approx(0.01)
+        assert isinstance(layers[1], OutputLayer)
+        assert layers[1].loss == "mcxent"
+        assert layers[1].activation == "softmax"
+
+    def test_imported_config_trains(self):
+        conf = import_dl4j_configuration(mlp_json())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        cls = rng.integers(0, 3, 128)
+        x = rng.normal(size=(128, 4)).astype(np.float32)
+        x[np.arange(128), cls] += 2.0
+        y = np.eye(3, dtype=np.float32)[cls]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        s0 = net.score(DataSet(x, y))
+        net.fit(x, y, epochs=30)
+        assert net.score_ < s0
+
+
+class TestCnnAndRnnImport:
+    def test_lenet_style(self):
+        conf = import_dl4j_configuration(json.dumps({
+            "confs": [
+                {"layer": {"convolution": {
+                    "activationFn": {"Identity": {}},
+                    "kernelSize": [5, 5], "stride": [1, 1], "padding": [0, 0],
+                    "convolutionMode": "Truncate", "nin": 1, "nout": 20,
+                }}},
+                {"layer": {"batchNormalization": {"eps": 1e-5, "decay": 0.9}}},
+                {"layer": {"subsampling": {
+                    "poolingType": "MAX", "kernelSize": [2, 2],
+                    "stride": [2, 2], "convolutionMode": "Truncate",
+                }}},
+                {"layer": {"output": {
+                    "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossNegativeLogLikelihood"},
+                    "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                    "nout": 10,
+                }}},
+            ],
+        }))
+        l0, l1, l2, l3 = conf.layers
+        assert isinstance(l0, ConvolutionLayer) and l0.kernel_size == (5, 5)
+        assert isinstance(l1, BatchNormalizationLayer)
+        assert isinstance(l2, SubsamplingLayer) and l2.pooling_type == "max"
+        assert l3.loss == "mcxent"  # NLL maps to mcxent
+
+    def test_graves_char_rnn_with_tbptt(self):
+        conf = import_dl4j_configuration(json.dumps({
+            "backpropType": "TruncatedBPTT",
+            "tbpttFwdLength": 50, "tbpttBackLength": 50,
+            "confs": [
+                {"layer": {"gravesLSTM": {
+                    "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"},
+                    "nin": 80, "nout": 256, "forgetGateBiasInit": 1.0,
+                    "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Nesterovs",
+                                 "learningRate": 0.1, "momentum": 0.95},
+                }}},
+                {"layer": {"rnnoutput": {
+                    "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                    "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                    "nin": 256, "nout": 80,
+                }}},
+            ],
+        }))
+        l0, l1 = conf.layers
+        assert isinstance(l0, GravesLSTMLayer) and l0.n_out == 256
+        assert isinstance(l0.updater, Nesterovs)
+        assert l0.updater.momentum == pytest.approx(0.95)
+        assert isinstance(l1, RnnOutputLayer)
+        assert conf.tbptt_fwd_length == 50
+
+
+class TestZipImport:
+    def test_model_serializer_zip(self, tmp_path):
+        p = str(tmp_path / "dl4j_model.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", mlp_json())
+            z.writestr("coefficients.bin", b"\x00" * 16)  # external ND4J blob
+            z.writestr("updaterState.bin", b"\x00" * 8)
+        conf, meta = import_dl4j_zip(p)
+        assert meta["has_coefficients"] and meta["has_updater_state"]
+        assert not meta["has_normalizer"]
+        net = restore_multi_layer_network_configuration(p).init()
+        out = net.output(np.zeros((2, 4), np.float32))
+        assert np.asarray(out).shape == (2, 3)
+
+    def test_bad_zip_rejected(self, tmp_path):
+        p = str(tmp_path / "bad.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("readme.txt", "nope")
+        with pytest.raises(InvalidDl4jConfigurationException):
+            import_dl4j_zip(p)
+
+
+class TestErrors:
+    def test_unknown_layer_type(self):
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            import_dl4j_configuration(json.dumps(
+                {"confs": [{"layer": {"quantumLayer": {}}}]}))
+
+    def test_not_multilayer_json(self):
+        with pytest.raises(InvalidDl4jConfigurationException):
+            import_dl4j_configuration(json.dumps({"vertices": {}}))
