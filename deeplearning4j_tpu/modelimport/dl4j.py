@@ -371,6 +371,88 @@ def import_dl4j_configuration(source: str):
     return built
 
 
+def _convert_dl4j_vertex(type_name: str, cfg: dict):
+    """One WRAPPER_OBJECT vertex entry {type_name: cfg} → our vertex or, for
+    LayerVertex, the converted Layer (``nn/conf/graph/GraphVertex.java:41-53``
+    subtype names)."""
+    from deeplearning4j_tpu.nn import vertices as V
+
+    t = type_name
+    if t == "LayerVertex":
+        layer_conf = _get(cfg, "layerConf", default={}) or {}
+        layer_entry = layer_conf.get("layer")
+        if not isinstance(layer_entry, dict) or len(layer_entry) != 1:
+            raise InvalidDl4jConfigurationException(
+                f"LayerVertex without layer config: {cfg!r}")
+        lt, lc = next(iter(layer_entry.items()))
+        return convert_dl4j_layer(lt, lc)
+    if t == "MergeVertex":
+        return V.MergeVertex()
+    if t == "ElementWiseVertex":
+        op = str(_get(cfg, "op", default="Add")).lower()
+        return V.ElementWiseVertex(op={"max": "max"}.get(op, op))
+    if t == "SubsetVertex":
+        return V.SubsetVertex(from_index=int(_get(cfg, "from", "from_", default=0)),
+                              to_index=int(_get(cfg, "to", default=0)))
+    if t == "StackVertex":
+        return V.StackVertex()
+    if t == "UnstackVertex":
+        return V.UnstackVertex(from_index=int(_get(cfg, "from", "from_", default=0)),
+                               stack_size=int(_get(cfg, "stackSize", default=1)))
+    if t == "ScaleVertex":
+        return V.ScaleVertex(scale_factor=float(_get(cfg, "scaleFactor", default=1.0)))
+    if t == "ShiftVertex":
+        return V.ShiftVertex(shift_factor=float(_get(cfg, "shiftFactor", default=0.0)))
+    if t == "L2Vertex":
+        return V.L2Vertex()
+    if t == "L2NormalizeVertex":
+        return V.L2NormalizeVertex()
+    if t == "LastTimeStepVertex":
+        return V.LastTimeStepVertex(mask_input=_get(cfg, "maskArrayInputName"))
+    if t == "ReverseTimeSeriesVertex":
+        return V.ReverseTimeSeriesVertex(mask_input=_get(cfg, "maskArrayInputName"))
+    if t == "DuplicateToTimeSeriesVertex":
+        return V.DuplicateToTimeSeriesVertex(
+            ts_input=_get(cfg, "inputName", "inputVertexName"))
+    if t == "PreprocessorVertex":
+        return V.PreprocessorVertex(preprocessor="identity")
+    raise UnsupportedDl4jConfigurationException(
+        f"unsupported DL4J graph vertex type {t!r}")
+
+
+def import_dl4j_graph_configuration(source: str):
+    """DL4J ``ComputationGraphConfiguration`` JSON → our graph config
+    (``nn/conf/ComputationGraphConfiguration.java:62-90``: vertices +
+    vertexInputs maps, networkInputs/networkOutputs)."""
+    from deeplearning4j_tpu.nn.layers.base import Layer
+
+    d = json.loads(source) if isinstance(source, str) else source
+    vertices = d.get("vertices")
+    if vertices is None:
+        raise InvalidDl4jConfigurationException(
+            "not a ComputationGraphConfiguration JSON (no 'vertices')")
+    vertex_inputs = d.get("vertexInputs") or {}
+    inputs = d.get("networkInputs") or []
+    outputs = d.get("networkOutputs") or []
+
+    g = NeuralNetConfiguration.builder().graph_builder()
+    g.add_inputs(*inputs)
+    for name, entry in vertices.items():
+        if not isinstance(entry, dict) or len(entry) != 1:
+            raise InvalidDl4jConfigurationException(f"bad vertex {name!r}")
+        vt, vc = next(iter(entry.items()))
+        obj = _convert_dl4j_vertex(vt, vc or {})
+        srcs = vertex_inputs.get(name, [])
+        if isinstance(obj, Layer):
+            g.add_layer(name, obj, *srcs)
+        else:
+            g.add_vertex(name, obj, *srcs)
+    g.set_outputs(*outputs)
+    if d.get("backpropType") == "TruncatedBPTT":
+        g.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
+    return g.build()
+
+
 def import_dl4j_zip(path: str):
     """ModelSerializer zip → (config, metadata). Parameter values
     (``coefficients.bin``, external ND4J binary) are not ingested; the
@@ -380,8 +462,9 @@ def import_dl4j_zip(path: str):
         if "configuration.json" not in names:
             raise InvalidDl4jConfigurationException(
                 f"{path}: no configuration.json in zip (entries: {sorted(names)})")
-        conf = import_dl4j_configuration(
-            z.read("configuration.json").decode("utf-8"))
+        raw = json.loads(z.read("configuration.json").decode("utf-8"))
+        conf = (import_dl4j_graph_configuration(raw) if "vertices" in raw
+                else import_dl4j_configuration(raw))
         meta = {"has_coefficients": "coefficients.bin" in names,
                 "has_updater_state": "updaterState.bin" in names,
                 "has_normalizer": "normalizer.bin" in names}

@@ -167,3 +167,58 @@ class TestErrors:
     def test_not_multilayer_json(self):
         with pytest.raises(InvalidDl4jConfigurationException):
             import_dl4j_configuration(json.dumps({"vertices": {}}))
+
+
+class TestGraphImport:
+    def graph_json(self):
+        dense = lambda nin, nout, act, name: {"dense": {
+            "layerName": name, "nin": nin, "nout": nout,
+            "activationFn": {"@class": f"org.nd4j.linalg.activations.impl.Activation{act}"}}}
+        return json.dumps({
+            "networkInputs": ["in"],
+            "networkOutputs": ["out"],
+            "vertices": {
+                "a": {"LayerVertex": {"layerConf": {"layer": dense(6, 8, "ReLU", "a")}}},
+                "b": {"LayerVertex": {"layerConf": {"layer": dense(6, 8, "TanH", "b")}}},
+                "ew": {"ElementWiseVertex": {"op": "Add"}},
+                "scaled": {"ScaleVertex": {"scaleFactor": 0.5}},
+                "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                    "layerName": "out", "nin": 8, "nout": 2,
+                    "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                    "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                }}}}},
+            },
+            "vertexInputs": {
+                "a": ["in"], "b": ["in"], "ew": ["a", "b"],
+                "scaled": ["ew"], "out": ["scaled"],
+            },
+        })
+
+    def test_graph_import_runs(self):
+        from deeplearning4j_tpu.modelimport.dl4j import import_dl4j_graph_configuration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        conf = import_dl4j_graph_configuration(self.graph_json())
+        net = ComputationGraph(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 2)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+
+    def test_graph_zip_dispatch(self, tmp_path):
+        p = str(tmp_path / "graph.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", self.graph_json())
+            z.writestr("coefficients.bin", b"\x00")
+        conf, meta = import_dl4j_zip(p)
+        from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+        assert isinstance(conf, ComputationGraphConfiguration)
+        assert meta["has_coefficients"]
+
+    def test_unknown_vertex_rejected(self):
+        from deeplearning4j_tpu.modelimport.dl4j import import_dl4j_graph_configuration
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            import_dl4j_graph_configuration(json.dumps({
+                "networkInputs": ["in"], "networkOutputs": ["x"],
+                "vertices": {"x": {"WarpVertex": {}}},
+                "vertexInputs": {"x": ["in"]}}))
