@@ -135,3 +135,58 @@ class TestGradientCheckpointing:
         ds = _data(128)
         net.fit(ListDataSetIterator(ds, 64), epochs=3)
         assert float(net.score_) < 1.2
+
+
+class TestBatchNormMixedPrecisionInference:
+    """Regression: f32 BN running stats must not promote the bf16 stream
+    back to f32 mid-network — inference after bf16 training used to crash
+    with a conv dtype mismatch."""
+
+    def _bn_conf(self, compute_dtype):
+        from deeplearning4j_tpu.nn.layers import BatchNormalizationLayer
+        return (NeuralNetConfiguration.builder().seed(2)
+                .compute_dtype(compute_dtype).list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(BatchNormalizationLayer(activation="relu"))
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        convolution_mode="same"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(8, 8, 1)).build())
+
+    def test_mln_train_then_infer(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net = MultiLayerNetwork(self._bn_conf("bfloat16")).init()
+        net.fit(x, y, epochs=2)
+        out = np.asarray(net.output(x))
+        assert out.shape == (8, 2)
+        assert np.isfinite(out).all()
+        # running stats stay f32 even though compute is bf16
+        assert net.states[1]["mean"].dtype == jnp.float32
+
+    def test_graph_train_then_infer(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import BatchNormalizationLayer, LossLayer
+        g = (NeuralNetConfiguration.builder().seed(3)
+             .compute_dtype("bfloat16").graph_builder()
+             .add_inputs("in")
+             .set_input_types(InputType.convolutional(8, 8, 1)))
+        g.add_layer("c1", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                           convolution_mode="same"), "in")
+        g.add_layer("bn", BatchNormalizationLayer(activation="relu"), "c1")
+        g.add_layer("c2", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                           convolution_mode="same"), "bn")
+        g.add_layer("gap", __import__("deeplearning4j_tpu.nn.layers",
+                                      fromlist=["GlobalPoolingLayer"]
+                                      ).GlobalPoolingLayer(), "c2")
+        g.add_layer("out", OutputLayer(n_out=2), "gap")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        net.fit(x, y)
+        out = np.asarray(net.output(x))
+        assert out.shape == (4, 2) and np.isfinite(out).all()
