@@ -679,6 +679,27 @@ class SameDiff:
                 it += 1
         return None if last is None else float(last)
 
+    def evaluate(self, iterator, output_name: str, evaluation=None):
+        """Evaluate an output variable against labels from a DataSet iterator
+        (ND4J ``sd.evaluate``): feeds placeholders via the TrainingConfig
+        mappings, accumulates into an Evaluation (or the given metric)."""
+        cfg = self._training_config
+        if cfg is None:
+            raise ValueError("set_training_config first (placeholder mappings)")
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = evaluation if evaluation is not None else Evaluation()
+        batches = [iterator] if isinstance(iterator, DataSet) else iterator
+        for ds in batches:
+            feats = ds.features if isinstance(ds.features, (list, tuple)) \
+                else [ds.features]
+            ph = {n: np.asarray(a) for n, a in zip(cfg.feature_mapping, feats)}
+            preds = self.output(ph, output_name)[output_name]
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                else [ds.labels]
+            ev.eval(np.asarray(labs[0]), preds)
+        return ev
+
     # -- serde --------------------------------------------------------------
     def to_json(self) -> str:
         return json.dumps({

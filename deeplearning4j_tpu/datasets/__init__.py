@@ -27,6 +27,7 @@ from deeplearning4j_tpu.datasets.fetchers import (  # noqa: F401
 from deeplearning4j_tpu.datasets.normalizers import (  # noqa: F401
     ImagePreProcessingScaler,
     Normalizer,
+    MultiNormalizer,
     NormalizerMinMaxScaler,
     NormalizerStandardize,
     NormalizingIterator,

@@ -238,3 +238,62 @@ class NormalizingIterator:
     def __iter__(self):
         for ds in self.base:
             yield self.normalizer.transform(ds)
+
+
+class MultiNormalizer:
+    """Per-input normalization of MultiDataSets (reference:
+    ``MultiNormalizerStandardize`` / ``MultiNormalizerMinMaxScaler`` in ND4J):
+    one child normalizer per features array; labels pass through (label
+    normalization is rare and explicit in the reference too).
+
+    ``kind`` selects the child type: "standardize" | "minmax".
+    """
+
+    def __init__(self, kind: str = "standardize", **kwargs):
+        if kind not in ("standardize", "minmax"):
+            raise ValueError(f"unknown MultiNormalizer kind {kind!r}")
+        self.kind = kind
+        self.kwargs = kwargs
+        self.children = []
+
+    def _new_child(self):
+        return (NormalizerStandardize() if self.kind == "standardize"
+                else NormalizerMinMaxScaler(**self.kwargs))
+
+    def fit(self, data) -> "MultiNormalizer":
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        mds_list = [data] if isinstance(data, MultiDataSet) else list(data)
+        n_inputs = len(mds_list[0].features)
+        self.children = [self._new_child() for _ in range(n_inputs)]
+        for i, child in enumerate(self.children):
+            child.fit([DataSet(m.features[i], m.labels[0]) for m in mds_list])
+        return self
+
+    def transform(self, mds):
+        if not self.children:
+            raise ValueError("fit the MultiNormalizer first")
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        feats = [np.asarray(c.transform(DataSet(f, mds.labels[0])).features)
+                 for c, f in zip(self.children, mds.features)]
+        return MultiDataSet(feats, mds.labels, mds.features_masks,
+                            mds.labels_masks)
+
+    pre_process = transform
+
+    def revert(self, mds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        feats = [np.asarray(c.revert(DataSet(f, mds.labels[0])).features)
+                 for c, f in zip(self.children, mds.features)]
+        return MultiDataSet(feats, mds.labels, mds.features_masks,
+                            mds.labels_masks)
+
+    def to_dict(self) -> dict:
+        return {"@normalizer": "MultiNormalizer", "kind": self.kind,
+                "kwargs": self.kwargs,
+                "children": [c.to_dict() for c in self.children]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiNormalizer":
+        m = MultiNormalizer(d["kind"], **d.get("kwargs", {}))
+        m.children = [Normalizer.from_dict(c) for c in d.get("children", [])]
+        return m

@@ -302,3 +302,35 @@ class TestCheckpointListener:
         nums = sorted(int(p.name.split("_")[1])
                       for p in tmp_path.glob("checkpoint_*.zip"))
         assert nums == [3, 6]
+
+
+class TestMultiNormalizer:
+    def test_per_input_standardize_and_revert(self):
+        from deeplearning4j_tpu.datasets import MultiNormalizer
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        rng = np.random.default_rng(0)
+        f1 = rng.normal(5.0, 3.0, size=(64, 4)).astype(np.float32)
+        f2 = rng.normal(-2.0, 0.5, size=(64, 6)).astype(np.float32)
+        y = rng.normal(size=(64, 2)).astype(np.float32)
+        mds = MultiDataSet([f1, f2], [y])
+        norm = MultiNormalizer("standardize").fit(mds)
+        out = norm.transform(mds)
+        for f in out.features:
+            assert abs(float(np.mean(f))) < 0.1
+            assert abs(float(np.std(f)) - 1.0) < 0.1
+        back = norm.revert(out)
+        np.testing.assert_allclose(back.features[0], f1, atol=1e-4)
+        np.testing.assert_allclose(back.features[1], f2, atol=1e-4)
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.datasets import MultiNormalizer
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        rng = np.random.default_rng(1)
+        mds = MultiDataSet([rng.normal(size=(16, 3)).astype(np.float32)],
+                           [rng.normal(size=(16, 1)).astype(np.float32)])
+        norm = MultiNormalizer("minmax").fit(mds)
+        d = norm.to_dict()
+        norm2 = MultiNormalizer.from_dict(d)
+        a = norm.transform(mds).features[0]
+        b = norm2.transform(mds).features[0]
+        np.testing.assert_allclose(a, b, atol=1e-6)

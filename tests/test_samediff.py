@@ -265,3 +265,27 @@ class TestErrors:
         b = sd2.place_holder("b", shape=(1,))
         with pytest.raises(ValueError):
             _ = a + b
+
+
+class TestEvaluate:
+    def test_evaluate_accuracy(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("input", shape=(None, 4))
+        y = sd.place_holder("label", shape=(None, 3))
+        w = sd.var("w", value=RNG.normal(size=(4, 3)))
+        sd.nn.softmax(x @ w, name="probs")
+        sd.loss.softmax_cross_entropy(y, x @ w, name="loss")
+        sd.set_loss_variables("loss")
+        sd.set_training_config(TrainingConfig(
+            updater=Adam(0.1),
+            data_set_feature_mapping=["input"],
+            data_set_label_mapping=["label"]))
+        cls = RNG.integers(0, 3, 256)
+        xv = RNG.normal(size=(256, 4)).astype(np.float32)
+        xv[np.arange(256), cls] += 3.0
+        yv = np.eye(3, dtype=np.float32)[cls]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        ds = DataSet(xv, yv)
+        sd.fit(ds, epochs=50)
+        ev = sd.evaluate(ds, "probs")
+        assert ev.accuracy() > 0.9
