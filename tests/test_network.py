@@ -193,3 +193,30 @@ class TestTraining:
         rep = conf.memory_report(batch=32)
         assert rep["total_param_bytes"] == (50 * 100 + 100 + 100 * 10 + 10) * 4
         assert len(rep["layers"]) == 2
+
+
+class TestConvLSTMStateful:
+    def test_tbptt_and_rnn_time_step(self):
+        from deeplearning4j_tpu.nn.layers import ConvLSTM2DLayer, RnnOutputLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-3)).list()
+                .layer(ConvLSTM2DLayer(n_out=3, kernel_size=(3, 3),
+                                       convolution_mode="same"))
+                .layer(RnnOutputLayer(n_out=2))
+                .t_bptt_length(4)
+                .set_input_type(InputType.recurrent_convolutional(5, 5, 1, 8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 8, 5, 5, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (2, 8))]
+        net.fit(x, y)  # TBPTT: chunked scan with carried conv state
+        assert np.isfinite(float(net.score_))
+        # stateful single-step inference over the conv carry
+        net.rnn_clear_previous_state()
+        step_outs = [np.asarray(net.rnn_time_step(x[:, t:t + 1]))
+                     for t in range(8)]
+        full = np.asarray(net.output(x))
+        np.testing.assert_allclose(np.concatenate(step_outs, axis=1), full,
+                                   rtol=1e-4, atol=1e-5)
