@@ -661,15 +661,7 @@ class SameDiff:
         last = None
         for epoch in range(int(epochs)):
             for ds in batches:
-                ph = {}
-                feats = ds.features if isinstance(ds.features, (list, tuple)) \
-                    else [ds.features]
-                labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
-                    else [ds.labels]
-                for n, a in zip(cfg.feature_mapping, feats):
-                    ph[n] = jnp.asarray(a)
-                for n, a in zip(cfg.label_mapping, labs):
-                    ph[n] = jnp.asarray(a)
+                ph = self._feed(cfg, ds, with_labels=True)
                 lr = jnp.asarray(upd.lr_at(it, epoch), jnp.float32)
                 # t is 1-based: Adam-family bias correction divides by
                 # (1 - beta^t), which is 0 at t=0
@@ -678,6 +670,20 @@ class SameDiff:
                     jnp.asarray(it + 1))
                 it += 1
         return None if last is None else float(last)
+
+    @staticmethod
+    def _feed(cfg: TrainingConfig, ds, with_labels: bool) -> dict:
+        """DataSet → placeholder dict via the TrainingConfig mappings (shared
+        by fit and evaluate so the feeding convention cannot diverge)."""
+        feats = ds.features if isinstance(ds.features, (list, tuple)) \
+            else [ds.features]
+        ph = {n: jnp.asarray(a) for n, a in zip(cfg.feature_mapping, feats)}
+        if with_labels:
+            labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
+                else [ds.labels]
+            for n, a in zip(cfg.label_mapping, labs):
+                ph[n] = jnp.asarray(a)
+        return ph
 
     def evaluate(self, iterator, output_name: str, evaluation=None):
         """Evaluate an output variable against labels from a DataSet iterator
@@ -691,10 +697,8 @@ class SameDiff:
         ev = evaluation if evaluation is not None else Evaluation()
         batches = [iterator] if isinstance(iterator, DataSet) else iterator
         for ds in batches:
-            feats = ds.features if isinstance(ds.features, (list, tuple)) \
-                else [ds.features]
-            ph = {n: np.asarray(a) for n, a in zip(cfg.feature_mapping, feats)}
-            preds = self.output(ph, output_name)[output_name]
+            preds = self.output(self._feed(cfg, ds, with_labels=False),
+                                output_name)[output_name]
             labs = ds.labels if isinstance(ds.labels, (list, tuple)) \
                 else [ds.labels]
             ev.eval(np.asarray(labs[0]), preds)

@@ -266,14 +266,17 @@ class MultiNormalizer:
         n_inputs = len(mds_list[0].features)
         self.children = [self._new_child() for _ in range(n_inputs)]
         for i, child in enumerate(self.children):
-            child.fit([DataSet(m.features[i], m.labels[0]) for m in mds_list])
+            child.fit([DataSet(m.features[i],
+                               m.labels[0] if m.labels else None)
+                       for m in mds_list])
         return self
 
     def transform(self, mds):
         if not self.children:
             raise ValueError("fit the MultiNormalizer first")
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
-        feats = [np.asarray(c.transform(DataSet(f, mds.labels[0])).features)
+        labels = mds.labels[0] if mds.labels else None
+        feats = [np.asarray(c.transform(DataSet(f, labels)).features)
                  for c, f in zip(self.children, mds.features)]
         return MultiDataSet(feats, mds.labels, mds.features_masks,
                             mds.labels_masks)
@@ -282,7 +285,8 @@ class MultiNormalizer:
 
     def revert(self, mds):
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
-        feats = [np.asarray(c.revert(DataSet(f, mds.labels[0])).features)
+        labels = mds.labels[0] if mds.labels else None
+        feats = [np.asarray(c.revert(DataSet(f, labels)).features)
                  for c, f in zip(self.children, mds.features)]
         return MultiDataSet(feats, mds.labels, mds.features_masks,
                             mds.labels_masks)

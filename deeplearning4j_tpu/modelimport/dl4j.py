@@ -99,6 +99,8 @@ def _loss(v) -> Optional[str]:
         cls = v.get("@class")
         if cls is None and len(v) == 1:
             cls = next(iter(v))
+        if cls is None:
+            return None
         key = cls.rsplit(".", 1)[-1]
         if key.lower().startswith("loss"):
             key = key[len("Loss"):]
@@ -173,9 +175,7 @@ def _base_kwargs(cfg: dict) -> dict:
         val = cfg.get(src)
         if isinstance(val, (int, float)) and val == val and val != 0.0:
             kw[dst] = float(val)
-    upd = _updater(_get(cfg, "iUpdater", "iupdater", "updater")
-                   if isinstance(_get(cfg, "iUpdater", "iupdater", "updater"),
-                                 dict) else None)
+    upd = _updater(_get(cfg, "iUpdater", "iupdater", "updater"))
     if upd is not None:
         kw["updater"] = upd
     gn = _get(cfg, "gradientNormalization")

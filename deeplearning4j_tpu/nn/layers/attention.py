@@ -235,9 +235,12 @@ class CrossAttentionLayer(Layer):
         v = (xv @ params["Wv"] + params["bv"]).reshape(n, tk, h, dv).transpose(0, 2, 1, 3)
         kv_mask = None
         if masks is not None:
-            # mask over KEYS: the key source's mask (fall back to value's)
+            # mask over KEYS: the key source's mask, falling back to the
+            # value source's; in the single-input (self-attention) case the
+            # query mask IS the key mask
             kv_mask = masks[2] if len(masks) > 2 and masks[2] is not None \
-                else (masks[1] if len(masks) > 1 else None)
+                else (masks[1] if len(masks) > 1 else
+                      (masks[0] if masks else None))
         out = dot_product_attention(q, k, v, mask=kv_mask,
                                     dropout_rate=self.attn_dropout,
                                     rng=rng, train=train)
@@ -246,6 +249,8 @@ class CrossAttentionLayer(Layer):
         return self.act_fn()(y), state or {}
 
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
-        # single-input degenerate case == self-attention over x
+        # single-input degenerate case == self-attention over x (the mask
+        # applies to the keys, which are x itself)
         return self.forward_multi(params, [x], state=state, train=train,
-                                  rng=rng, masks=[mask] if mask is not None else None)
+                                  rng=rng,
+                                  masks=None if mask is None else [mask])

@@ -94,8 +94,8 @@ async function refreshModel(sid){
     polyline(ps, det.iterations.slice(-s.length), s, colors[ci++ % colors.length]);
   const rs = document.getElementById("layerratio"); rs.innerHTML = "";
   ci = 0;
-  for (const [p, s] of Object.entries(det.update_param_ratio_log10))
-    polyline(rs, det.iterations.slice(-s.length), s, colors[ci++ % colors.length]);
+  for (const [p, pairs] of Object.entries(det.update_param_ratio_log10))
+    polyline(rs, pairs.map(x=>x[0]), pairs.map(x=>x[1]), colors[ci++ % colors.length]);
 }
 async function applyI18n(lang){
   const t = await (await fetch("/i18n/" + lang)).json();
@@ -271,8 +271,11 @@ class UIServer:
             layer, param = _split_param_key(key)
             layers.setdefault(layer, {"params": {}, "learning_rates": {}})
             layers[layer]["learning_rates"][param] = lr
-        return {"session": sid, "layers": layers,
-                "layer_names": sorted(layers)}
+        # numeric-aware ordering: MLN layer indices sort 0,1,2,...,10 — not
+        # lexicographically
+        names = sorted(layers, key=lambda n: (0, int(n)) if n.isdigit()
+                       else (1, n))
+        return {"session": sid, "layers": layers, "layer_names": names}
 
     def _layer_detail(self, sid: str, layer: str) -> dict:
         """Drill-down time series for one layer: per-param mean-magnitude
@@ -301,8 +304,10 @@ class UIServer:
                     import math
                     pm = st.get("mean_magnitude", 0.0)
                     um = u.get("mean_magnitude", 0.0)
+                    # [iteration, value] pairs: update stats may be reported
+                    # intermittently, so the ratio carries its own x-values
                     ratio.setdefault(param, []).append(
-                        math.log10(max(um, 1e-12) / max(pm, 1e-12)))
+                        [it, math.log10(max(um, 1e-12) / max(pm, 1e-12))])
             for key, st in gs.items():
                 lname, param = _split_param_key(key)
                 if lname == layer:

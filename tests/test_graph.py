@@ -283,3 +283,26 @@ class TestCrossAttentionGraph:
         y = rng.normal(size=(2, 4, 6))
         assert check_graph_gradients(net, [xq, xkv], [y], subset=40,
                                      print_results=True)
+
+    def test_cross_attention_single_input_mask_matches_self_attention(self):
+        # regression: the single-input path must apply the mask to the keys
+        import jax
+        import numpy as np
+        from deeplearning4j_tpu.nn.layers import CrossAttentionLayer
+        from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
+        import jax.numpy as jnp
+
+        layer = CrossAttentionLayer(n_in=8, k_in=8, v_in=8, n_out=8,
+                                    n_heads=2, head_size=4)
+        p = layer.init_params(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(2, 5, 8)).astype(np.float32))
+        mask = jnp.asarray(np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]],
+                                    np.float32))
+        y_masked, _ = layer.forward(p, x, mask=mask)
+        y_plain, _ = layer.forward(p, x)
+        # masked example differs from unmasked; fully-valid example matches
+        assert not np.allclose(np.asarray(y_masked)[0, :3],
+                               np.asarray(y_plain)[0, :3])
+        np.testing.assert_allclose(np.asarray(y_masked)[1],
+                                   np.asarray(y_plain)[1], rtol=1e-5, atol=1e-6)
