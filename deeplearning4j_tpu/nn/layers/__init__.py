@@ -14,6 +14,7 @@ from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
     DropoutLayer,
     EmbeddingLayer,
     EmbeddingSequenceLayer,
+    PositionalEmbeddingLayer,
     ElementWiseMultiplicationLayer,
     PReLULayer,
 )

@@ -230,3 +230,34 @@ class PReLULayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         alpha = params["W"]
         return jnp.where(x >= 0, x, alpha * x), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class PositionalEmbeddingLayer(Layer):
+    """Adds a learned position embedding to a sequence: [N,T,C] →
+    x + P[:T] with P [max_len, C] (the BERT position-embedding pattern; no
+    reference counterpart — the snapshot predates attention, SURVEY.md §5).
+    """
+
+    n_in: int = 0           # feature dim (C)
+    max_len: int = 512
+
+    def set_n_in(self, input_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = input_type.size
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def param_shapes(self):
+        return {"P": (self.max_len, self.n_in)}
+
+    def init_params(self, rng, dtype=jnp.float32):
+        # BERT-style truncated-normal-ish small init
+        return {"P": 0.02 * jax.random.normal(rng, (self.max_len, self.n_in),
+                                              dtype)}
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        t = x.shape[1]
+        return x + params["P"][:t], state or {}

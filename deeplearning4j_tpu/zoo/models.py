@@ -601,3 +601,92 @@ class TextGenerationLSTM(ZooModel):
                                       activation="softmax"))
                 .set_input_type(InputType.recurrent(self.num_labels, self.max_length))
                 .build())
+
+
+def transformer_encoder_block(g, name: str, src: str, d_model: int,
+                              n_heads: int, d_ff: int,
+                              attn_dropout: float = 0.0) -> str:
+    """One pre-activation-free (post-LN, BERT-style) encoder block as graph
+    vertices: self-attention + residual + LayerNorm, position-wise FFN +
+    residual + LayerNorm. Returns the output vertex name."""
+    from deeplearning4j_tpu.nn.layers import (
+        LayerNormalizationLayer,
+        SelfAttentionLayer,
+    )
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+
+    g.add_layer(f"{name}-att",
+                SelfAttentionLayer(n_heads=n_heads,
+                                   head_size=d_model // n_heads,
+                                   project_input=True,
+                                   attn_dropout=attn_dropout), src)
+    g.add_vertex(f"{name}-res1", ElementWiseVertex(op="add"),
+                 src, f"{name}-att")
+    g.add_layer(f"{name}-ln1", LayerNormalizationLayer(), f"{name}-res1")
+    g.add_layer(f"{name}-ff1", DenseLayer(n_in=d_model, n_out=d_ff,
+                                          activation="gelu"), f"{name}-ln1")
+    g.add_layer(f"{name}-ff2", DenseLayer(n_in=d_ff, n_out=d_model,
+                                          activation="identity"),
+                f"{name}-ff1")
+    g.add_vertex(f"{name}-res2", ElementWiseVertex(op="add"),
+                 f"{name}-ln1", f"{name}-ff2")
+    g.add_layer(f"{name}-ln2", LayerNormalizationLayer(), f"{name}-res2")
+    return f"{name}-ln2"
+
+
+@register_zoo_model
+class TransformerEncoder(ZooModel):
+    """BERT-base-shape transformer encoder for sequence classification
+    (no reference counterpart — the snapshot predates attention; this is the
+    framework-native builder behind the BASELINE "BERT-base" config, whose
+    import path lives in ``modelimport/keras``).
+
+    Defaults are BERT-base: 12 layers, d_model 768, 12 heads, d_ff 3072.
+    Token ids [N,T] → embeddings + learned positions → N encoder blocks →
+    mean-pool → classifier.
+    """
+
+    def __init__(self, num_labels: int = 2, seed: int = 123,
+                 vocab_size: int = 30522, max_length: int = 128,
+                 n_layers: int = 12, d_model: int = 768, n_heads: int = 12,
+                 d_ff: int = 3072):
+        super().__init__(num_labels, seed)
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.n_layers = n_layers
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.d_ff = d_ff
+
+    def meta_data(self):
+        return ModelMetaData(((self.max_length,),), 1, "rnn")
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingSequenceLayer,
+            GlobalPoolingLayer,
+            PositionalEmbeddingLayer,
+        )
+
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Adam(1e-4)).graph_builder()
+             .add_inputs("tokens")
+             .set_input_types(InputType.recurrent(1, self.max_length)))
+        g.add_layer("embed",
+                    EmbeddingSequenceLayer(n_in=self.vocab_size,
+                                           n_out=self.d_model), "tokens")
+        g.add_layer("pos", PositionalEmbeddingLayer(n_in=self.d_model,
+                                                    max_len=self.max_length),
+                    "embed")
+        src = "pos"
+        for i in range(self.n_layers):
+            src = transformer_encoder_block(g, f"block{i}", src,
+                                            self.d_model, self.n_heads,
+                                            self.d_ff)
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), src)
+        g.add_layer("out", OutputLayer(n_in=self.d_model,
+                                       n_out=self.num_labels,
+                                       activation="softmax", loss="mcxent"),
+                    "pool")
+        g.set_outputs("out")
+        return g.build()

@@ -119,3 +119,28 @@ class TestZooInstantiation:
         md = ResNet50(num_labels=1000).meta_data()
         assert md.input_shape == ((3, 224, 224),)
         assert not md.use_mds
+
+
+class TestTransformerEncoder:
+    def test_small_encoder_trains(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.zoo.models import TransformerEncoder
+
+        m = TransformerEncoder(num_labels=2, n_layers=2, d_model=16,
+                               n_heads=2, d_ff=32, vocab_size=50,
+                               max_length=12, seed=7)
+        net = ComputationGraph(m.conf()).init()
+        rng = np.random.default_rng(0)
+        # learnable toy task: class = does token 7 appear in the sequence
+        x = rng.integers(0, 50, size=(96, 12)).astype(np.float32)
+        cls = (x == 7).any(axis=1).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        s0 = net.score(DataSet(x, y))
+        for _ in range(60):
+            net.fit(x, y)
+        assert net.score_ < s0
+
+    def test_selector_has_transformer(self):
+        from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
+        assert "transformerencoder" in ModelSelector.available()
