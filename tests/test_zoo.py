@@ -144,3 +144,22 @@ class TestTransformerEncoder:
     def test_selector_has_transformer(self):
         from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
         assert "transformerencoder" in ModelSelector.available()
+
+    def test_encoder_variable_length_masking(self):
+        # padded batch + mask must equal the unpadded prefix batch
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.zoo.models import TransformerEncoder
+
+        m = TransformerEncoder(num_labels=2, n_layers=2, d_model=16,
+                               n_heads=2, d_ff=32, vocab_size=50,
+                               max_length=12, seed=7)
+        net = ComputationGraph(m.conf()).init()
+        rng = np.random.default_rng(0)
+        x_short = rng.integers(1, 50, size=(3, 8)).astype(np.float32)
+        x_pad = np.zeros((3, 12), np.float32)
+        x_pad[:, :8] = x_short
+        mask = np.zeros((3, 12), np.float32)
+        mask[:, :8] = 1.0
+        out_short = np.asarray(net.output(x_short))
+        out_pad = np.asarray(net.output(x_pad, masks=[mask]))
+        np.testing.assert_allclose(out_pad, out_short, atol=1e-5)
