@@ -217,3 +217,44 @@ def ulysses_attention(q, k, v, mesh: Mesh, *,
         return _ulysses_sharded(q, k, v, m, axis_name=axis_name, causal=causal)
 
     return run(q, k, v, mask)
+
+
+class SequenceParallelAttentionHelper:
+    """Attention-seam helper that runs every attention layer sequence-parallel
+    (ring or Ulysses) over a mesh axis — register it and the whole model
+    (zoo TransformerEncoder, imported BERT, any SelfAttentionLayer graph)
+    becomes long-context without model changes:
+
+        helpers.set_helper("attention",
+                           SequenceParallelAttentionHelper(mesh))
+
+    strategy: "ring" (never materializes a [T,T] tile per chip) or
+    "ulysses" (all-to-all head switch; needs n_heads % shards == 0).
+    Conservative gate: no attention mask (attention-level masks would need
+    sharding too), no attention dropout, T divisible by the shard count.
+    """
+
+    def __init__(self, mesh: Mesh, strategy: str = "ring",
+                 axis_name: str = SEQUENCE_AXIS, causal: bool = False):
+        if strategy not in ("ring", "ulysses"):
+            raise ValueError(f"unknown strategy {strategy!r} (ring|ulysses)")
+        self.mesh = mesh
+        self.strategy = strategy
+        self.axis_name = axis_name
+        self.causal = causal
+        self.n_shards = mesh.shape[axis_name]
+
+    def supports(self, layer, q_shape, mask, dropout_active) -> bool:
+        if mask is not None or dropout_active:
+            return False
+        t = q_shape[-2]
+        if t % self.n_shards:
+            return False
+        if self.strategy == "ulysses" and q_shape[1] % self.n_shards:
+            return False
+        return True
+
+    def attend(self, q, k, v):
+        fn = ring_self_attention if self.strategy == "ring" else ulysses_attention
+        return fn(q, k, v, self.mesh, axis_name=self.axis_name,
+                  causal=self.causal)
