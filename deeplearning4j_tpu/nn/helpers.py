@@ -77,9 +77,14 @@ class LSTMHelper:
 
 class AttentionHelper:
     """Interface for fused attention kernels (no reference counterpart —
-    the snapshot predates attention; same seam pattern as the cuDNN five)."""
+    the snapshot predates attention; same seam pattern as the cuDNN five).
 
-    def supports(self, layer, q_shape, mask, dropout_active) -> bool:  # pragma: no cover
+    ``causal`` describes the REQUESTED semantics: a helper must only accept
+    a request whose causality matches what its ``attend`` computes, so
+    registering any helper can never change model outputs."""
+
+    def supports(self, layer, q_shape, mask, dropout_active,
+                 causal=False) -> bool:  # pragma: no cover - interface
         return False
 
     def attend(self, q, k, v):  # pragma: no cover - interface

@@ -68,6 +68,7 @@ from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer, TimeDistributedWrapper  # noqa: F401
 from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, SameDiffLambdaLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.attention import (  # noqa: F401
+    CausalSelfAttentionLayer,
     CrossAttentionLayer,
     SelfAttentionLayer,
     LearnedSelfAttentionLayer,

@@ -19,30 +19,37 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 
 
-def dot_product_attention(q, k, v, mask=None, dropout_rate=0.0, rng=None, train=False):
-    """q,k,v: [N, H, T, Dh]; mask: [N, T] (1=valid) or [N, 1, Tq, Tk].
+def dot_product_attention(q, k, v, mask=None, dropout_rate=0.0, rng=None,
+                          train=False, causal=False):
+    """q,k,v: [N, H, T, Dh]; mask: [N, T] (1=valid) or [N, 1, Tq, Tk];
+    ``causal=True`` additionally lower-triangular-masks the scores.
 
     Consults the "attention" helper seam first: a registered fused kernel
-    (e.g. PallasFlashAttentionHelper) takes supported shapes; otherwise the
-    einsum path below runs (and XLA fuses it).
+    (e.g. PallasFlashAttentionHelper) takes supported shapes — causality is
+    part of the request, so a helper only serves requests whose semantics it
+    reproduces; otherwise the einsum path below runs (and XLA fuses it).
     """
     from deeplearning4j_tpu.nn import helpers as _helpers
     helper = _helpers.get_helper("attention")
     dropout_active = bool(train and dropout_rate > 0 and rng is not None)
     if (helper is not None
-            and helper.supports(None, q.shape, mask, dropout_active)
+            and helper.supports(None, q.shape, mask, dropout_active,
+                                causal=causal)
             and q.shape == k.shape == v.shape):
         return helper.attend(q, k, v)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    m = None
     if mask is not None:
-        if mask.ndim == 2:
-            m = mask[:, None, None, :]
-        else:
-            m = mask
-        scores = jnp.where(m > 0, scores, jnp.finfo(scores.dtype).min)
+        m = (mask[:, None, None, :] if mask.ndim == 2 else mask) > 0
+    if causal:
+        tri = jnp.tril(jnp.ones((q.shape[-2], k.shape[-2]), bool))[None, None]
+        m = tri if m is None else jnp.logical_and(m, tri)
+    if m is not None:
+        scores = jnp.where(m, scores, jnp.finfo(scores.dtype).min)
     w = jax.nn.softmax(scores, axis=-1)
     if train and dropout_rate > 0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, w.shape)
@@ -61,6 +68,7 @@ class SelfAttentionLayer(Layer):
     head_size: Optional[int] = None
     project_input: bool = True
     attn_dropout: float = 0.0
+    causal: bool = False
 
     def set_n_in(self, input_type: InputType) -> None:
         if not self.n_in:
@@ -106,12 +114,91 @@ class SelfAttentionLayer(Layer):
         qkv = x @ params["Wqkv"] + params["bqkv"]              # [N,T,3*H*Dh]
         qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)  # [3,N,H,T,Dh]
         q, k, v = qkv[0], qkv[1], qkv[2]
-        out = dot_product_attention(q, k, v, mask=mask, dropout_rate=self.attn_dropout,
+        out = dot_product_attention(q, k, v, mask=mask, causal=self.causal,
+                                    dropout_rate=self.attn_dropout,
                                     rng=rng, train=train)
         y = out.transpose(0, 2, 1, 3).reshape(n, t, h * dh)
         if self.project_input:
             y = y @ params["Wo"] + params["bo"]
         return self.act_fn()(y), state or {}
+
+
+@register_layer
+@dataclasses.dataclass
+class CausalSelfAttentionLayer(SelfAttentionLayer, BaseRecurrentLayer):
+    """Causal (autoregressive) multi-head self-attention.
+
+    No reference counterpart — the snapshot predates attention (SURVEY.md §5);
+    this is the decoder-side twin of :class:`SelfAttentionLayer`, required for
+    the text-generation transformer in the zoo. Two execution modes:
+
+    - ``forward`` (training / full-sequence): one fused QKV matmul, scores
+      masked with the lower-triangular causal mask ∧ the padding mask. XLA
+      fuses mask+softmax into the attention einsums.
+    - ``forward_seq`` with a carry (stateful decoding via ``rnn_time_step``):
+      a fixed-capacity KV cache — (k_cache, v_cache, key_validity, position),
+      all static shapes so the step jits once and new tokens are written with
+      ``lax.dynamic_update_slice``. Decoding T new tokens costs O(T·max_cache)
+      instead of re-running the full quadratic attention per step.
+
+    The carry rides the same ``BaseRecurrentLayer`` protocol the LSTMs use, so
+    ``MultiLayerNetwork.rnn_time_step`` / ``ComputationGraph.rnn_time_step``
+    (rnnTimeStep:2800 parity) and TBPTT chunking (the chunk attends over all
+    cached previous chunks, Transformer-XL style) work unchanged.
+    """
+
+    max_cache: int = 512
+    causal: bool = True  # full-sequence forward = SelfAttentionLayer's, masked
+
+    # ------------------------------------------------- stateful decode path
+    def carry_capacity(self):
+        return self.max_cache
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        h, dh, tc = self.n_heads, self._dh(), self.max_cache
+        return (jnp.zeros((batch, h, tc, dh), dtype),   # K cache
+                jnp.zeros((batch, h, tc, dh), dtype),   # V cache
+                jnp.zeros((batch, tc), dtype),          # key validity
+                jnp.zeros((), jnp.int32))               # write position
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        if carry is None:
+            y, _ = self.forward(params, x, train=train, rng=rng, mask=mask)
+            return y, None
+        n, t, _ = x.shape
+        h, dh, tc = self.n_heads, self._dh(), self.max_cache
+        kc, vc, valid, pos = carry
+        if not isinstance(pos, jax.core.Tracer) and int(pos) + t > tc:
+            raise ValueError(
+                f"KV cache overflow: writing {t} token(s) at position "
+                f"{int(pos)} exceeds max_cache={tc}; raise max_cache or "
+                f"rnn_clear_previous_state() first")
+        qkv = x @ params["Wqkv"] + params["bqkv"]
+        qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
+        block_valid = (jnp.ones((n, t)) if mask is None
+                       else (mask[:, :t] > 0)).astype(valid.dtype)
+        valid = jax.lax.dynamic_update_slice(valid, block_valid, (0, pos))
+        # query i (absolute position pos+i) may see cache slots <= pos+i that
+        # hold valid keys
+        causal = jnp.arange(tc)[None, :] <= (pos + jnp.arange(t))[:, None]
+        m = jnp.logical_and(causal[None, None], (valid > 0)[:, None, None, :])
+        scale = 1.0 / jnp.sqrt(jnp.asarray(dh, q.dtype))
+        scores = jnp.einsum("nhqd,nhkd->nhqk", q, kc.astype(q.dtype)) * scale
+        scores = jnp.where(m, scores, jnp.finfo(scores.dtype).min)
+        w = jax.nn.softmax(scores, axis=-1)
+        if train and self.attn_dropout > 0 and rng is not None:
+            # TBPTT training through the cache must regularize like the
+            # full-sequence path
+            keep = jax.random.bernoulli(rng, 1.0 - self.attn_dropout, w.shape)
+            w = jnp.where(keep, w / (1.0 - self.attn_dropout), 0.0)
+        out = jnp.einsum("nhqk,nhkd->nhqd", w, vc.astype(q.dtype))
+        y = out.transpose(0, 2, 1, 3).reshape(n, t, h * dh)
+        if self.project_input:
+            y = y @ params["Wo"] + params["bo"]
+        return self.act_fn()(y), (kc, vc, valid, pos + t)
 
 
 @register_layer

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
 
 
 @register_layer
@@ -234,10 +235,15 @@ class PReLULayer(Layer):
 
 @register_layer
 @dataclasses.dataclass
-class PositionalEmbeddingLayer(Layer):
+class PositionalEmbeddingLayer(BaseRecurrentLayer):
     """Adds a learned position embedding to a sequence: [N,T,C] →
     x + P[:T] with P [max_len, C] (the BERT position-embedding pattern; no
     reference counterpart — the snapshot predates attention, SURVEY.md §5).
+
+    Carries an absolute-position offset under the ``BaseRecurrentLayer``
+    protocol so stateful decoding (``rnn_time_step``) and TBPTT chunks add
+    the right positions: chunk k starting at absolute position p gets
+    P[p:p+T], not P[0:T].
     """
 
     n_in: int = 0           # feature dim (C)
@@ -261,3 +267,23 @@ class PositionalEmbeddingLayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         t = x.shape[1]
         return x + params["P"][:t], state or {}
+
+    def carry_capacity(self):
+        return self.max_len
+
+    def init_carry(self, batch: int, dtype=jnp.float32):
+        return jnp.zeros((), jnp.int32)  # absolute position offset
+
+    def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
+        if carry is None:
+            y, _ = self.forward(params, x, mask=mask, train=train, rng=rng)
+            return y, None
+        t = x.shape[1]
+        if not isinstance(carry, jax.core.Tracer) and int(carry) + t > self.max_len:
+            raise ValueError(
+                f"position overflow: step at offset {int(carry)}+{t} exceeds "
+                f"max_len={self.max_len}; raise max_len or "
+                f"rnn_clear_previous_state() first")
+        p = jax.lax.dynamic_slice(params["P"], (carry, 0),
+                                  (t, params["P"].shape[1]))
+        return x + p, carry + t

@@ -38,6 +38,14 @@ class BaseRecurrentLayer(Layer):
     def init_carry(self, batch: int, dtype=jnp.float32):
         raise NotImplementedError
 
+    def carry_capacity(self):
+        """Max total timesteps the carry can absorb, or None if unbounded
+        (LSTM-style state). Finite-capacity carries (KV caches, positional
+        offsets) report it so host-side loops (TBPTT, generate) can reject
+        overlong sequences BEFORE a jitted step silently clamps a
+        dynamic_update_slice write."""
+        return None
+
     def forward_seq(self, params, x, carry=None, mask=None, train=False, rng=None):
         """[N,T,C] → ([N,T,H], final_carry)."""
         raise NotImplementedError

@@ -297,6 +297,16 @@ class MultiLayerNetwork:
         process the sequence in chunks of tbptt_fwd_length, carrying RNN state
         (stop-gradient) between chunks."""
         t_total = x.shape[1]
+        # the chunk steps are jitted, where a finite carry (KV cache,
+        # positional offset) cannot raise on overflow — reject here instead
+        for i, l in enumerate(self.layers):
+            if isinstance(l, BaseRecurrentLayer):
+                cap = l.carry_capacity()
+                if cap is not None and t_total > cap:
+                    raise ValueError(
+                        f"TBPTT sequence length {t_total} exceeds layer {i} "
+                        f"({type(l).__name__}) carry capacity {cap}; raise "
+                        f"max_cache/max_len or shorten the sequence")
         length = self.conf.tbptt_fwd_length
         n_chunks = max(1, math.ceil(t_total / length))
         batch = x.shape[0]

@@ -181,14 +181,13 @@ class PallasFlashAttentionHelper(AttentionHelper):
     def __init__(self, causal: bool = False):
         self.causal = causal
 
-    def supports(self, layer, q_shape, mask, dropout_active) -> bool:
+    def supports(self, layer, q_shape, mask, dropout_active,
+                 causal=False) -> bool:
         if jax.default_backend() != "tpu":
             return False
-        if self.causal:
-            # the built-in einsum path expresses causality via mask (which
-            # this gate rejects): a causal helper at the seam would change
-            # semantics vs the fallback. causal=True is for direct attend()
-            # calls only.
+        if causal != self.causal:
+            # semantics must match the request exactly: a causal kernel must
+            # not serve a bidirectional layer and vice versa
             return False
         if mask is not None or dropout_active:
             return False

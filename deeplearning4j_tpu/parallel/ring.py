@@ -163,10 +163,23 @@ def ring_self_attention(q, k, v, mesh: Mesh, *,
     return run(q, k, v, mask)
 
 
+def _plain_attention(q, k, v, mask):
+    """Raw einsum attention, deliberately NOT the seam-consulting
+    ``dot_product_attention``: this runs inside the helper's own shard_map
+    body, where consulting the seam again would re-enter the registered
+    helper and nest a second shard_map on the same mesh."""
+    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+    scores = jnp.einsum("nhqd,nhkd->nhqk", q, k) * scale
+    if mask is not None:
+        m = mask[:, None, None, :] if mask.ndim == 2 else mask
+        scores = jnp.where(m > 0, scores, jnp.finfo(scores.dtype).min)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("nhqk,nhkd->nhqd", w, v)
+
+
 def _ulysses_sharded(q, k, v, mask, *, axis_name, causal):
     """Per-shard Ulysses body: [N, H, T/s, Dh] in → all-to-all →
     [N, H/s, T, Dh] → plain attention → all-to-all back."""
-    from deeplearning4j_tpu.nn.layers.attention import dot_product_attention
 
     def seq_to_head(x):
         # split heads (axis 1) across shards, gather sequence (axis 2)
@@ -186,7 +199,7 @@ def _ulysses_sharded(q, k, v, mask, *, axis_name, causal):
         tri = jnp.tril(jnp.ones((t, t), jnp.float32))[None, None]
         full_mask = tri if full_mask is None else (
             full_mask[:, None, None, :] * tri)
-    out = dot_product_attention(qh, kh, vh, mask=full_mask)
+    out = _plain_attention(qh, kh, vh, full_mask)
     return head_to_seq(out)
 
 
@@ -244,7 +257,12 @@ class SequenceParallelAttentionHelper:
         self.causal = causal
         self.n_shards = mesh.shape[axis_name]
 
-    def supports(self, layer, q_shape, mask, dropout_active) -> bool:
+    def supports(self, layer, q_shape, mask, dropout_active,
+                 causal=False) -> bool:
+        if causal != self.causal:
+            # causality of the sharded kernel must match the request, else
+            # registering the helper would change model outputs
+            return False
         if mask is not None or dropout_active:
             return False
         t = q_shape[-2]

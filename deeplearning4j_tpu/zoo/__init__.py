@@ -22,9 +22,13 @@ from deeplearning4j_tpu.zoo.models import (
     SimpleCNN,
     TextGenerationLSTM,
     TinyYOLO,
+    TransformerEncoder,
+    TransformerLM,
     VGG16,
     VGG19,
     YOLO2,
+    generate,
+    lm_labels,
 )
 
 __all__ = [
@@ -32,5 +36,6 @@ __all__ = [
     "register_zoo_model",
     "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
-    "TextGenerationLSTM", "TinyYOLO", "VGG16", "VGG19", "YOLO2",
+    "TextGenerationLSTM", "TinyYOLO", "TransformerEncoder", "TransformerLM",
+    "VGG16", "VGG19", "YOLO2", "generate", "lm_labels",
 ]

@@ -603,6 +603,176 @@ class TextGenerationLSTM(ZooModel):
                 .build())
 
 
+def transformer_decoder_block(g, name: str, src: str, d_model: int,
+                              n_heads: int, d_ff: int, max_len: int,
+                              attn_dropout: float = 0.0) -> str:
+    """One pre-LN causal decoder block (GPT-style): LN → causal self-attention
+    → residual, LN → position-wise FFN → residual. Pre-LN because it trains
+    stably without warmup — the modern decoder default. Returns the output
+    vertex name."""
+    from deeplearning4j_tpu.nn.layers import (
+        CausalSelfAttentionLayer,
+        LayerNormalizationLayer,
+    )
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex
+
+    g.add_layer(f"{name}-ln1", LayerNormalizationLayer(), src)
+    g.add_layer(f"{name}-att",
+                CausalSelfAttentionLayer(n_heads=n_heads,
+                                         head_size=d_model // n_heads,
+                                         project_input=True,
+                                         max_cache=max_len,
+                                         attn_dropout=attn_dropout),
+                f"{name}-ln1")
+    g.add_vertex(f"{name}-res1", ElementWiseVertex(op="add"),
+                 src, f"{name}-att")
+    g.add_layer(f"{name}-ln2", LayerNormalizationLayer(), f"{name}-res1")
+    g.add_layer(f"{name}-ff1", DenseLayer(n_in=d_model, n_out=d_ff,
+                                          activation="gelu"), f"{name}-ln2")
+    g.add_layer(f"{name}-ff2", DenseLayer(n_in=d_ff, n_out=d_model,
+                                          activation="identity"),
+                f"{name}-ff1")
+    g.add_vertex(f"{name}-res2", ElementWiseVertex(op="add"),
+                 f"{name}-res1", f"{name}-ff2")
+    return f"{name}-res2"
+
+
+@register_zoo_model
+class TransformerLM(ZooModel):
+    """GPT-style causal-decoder language model — the attention-era successor
+    of ``TextGenerationLSTM`` (``zoo/model/TextGenerationLSTM.java``): token
+    ids [N,T] → embedding + learned positions → n pre-LN causal decoder
+    blocks → final LayerNorm → per-timestep softmax over the vocabulary
+    (RnnOutputLayer, MCXENT). Labels are the inputs shifted left by one
+    (see :func:`lm_labels`).
+
+    Generation uses the network's stateful ``rnn_time_step`` path: every
+    causal attention layer carries a fixed-capacity KV cache, so sampling N
+    tokens is N jitted single-token steps, not N quadratic re-forwards.
+    Defaults are GPT-2-small shape (12L / 768 / 12H / 3072).
+    """
+
+    def __init__(self, num_labels: int = 0, seed: int = 123,
+                 vocab_size: int = 50257, max_length: int = 1024,
+                 n_layers: int = 12, d_model: int = 768, n_heads: int = 12,
+                 d_ff: int = 3072, attn_dropout: float = 0.0):
+        # for an LM the label space IS the vocabulary: num_labels, when
+        # given (e.g. via ModelSelector), overrides vocab_size — the same
+        # convention as TextGenerationLSTM(num_labels=vocab)
+        vocab_size = num_labels or vocab_size
+        super().__init__(vocab_size, seed)
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+        self.n_layers = n_layers
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.d_ff = d_ff
+        self.attn_dropout = attn_dropout
+
+    def meta_data(self):
+        return ModelMetaData(((self.max_length,),), 1, "rnn")
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.layers import (
+            EmbeddingSequenceLayer,
+            LayerNormalizationLayer,
+            PositionalEmbeddingLayer,
+        )
+
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Adam(3e-4)).graph_builder()
+             .add_inputs("tokens")
+             .set_input_types(InputType.recurrent(1, self.max_length)))
+        g.add_layer("embed",
+                    EmbeddingSequenceLayer(n_in=self.vocab_size,
+                                           n_out=self.d_model), "tokens")
+        g.add_layer("pos", PositionalEmbeddingLayer(n_in=self.d_model,
+                                                    max_len=self.max_length),
+                    "embed")
+        src = "pos"
+        for i in range(self.n_layers):
+            src = transformer_decoder_block(g, f"block{i}", src,
+                                            self.d_model, self.n_heads,
+                                            self.d_ff, self.max_length,
+                                            self.attn_dropout)
+        g.add_layer("ln_f", LayerNormalizationLayer(), src)
+        g.add_layer("out", RnnOutputLayer(n_in=self.d_model,
+                                          n_out=self.vocab_size,
+                                          activation="softmax", loss="mcxent"),
+                    "ln_f")
+        g.set_outputs("out")
+        return g.build()
+
+
+def lm_labels(tokens, vocab_size: int):
+    """Next-token one-hot targets for causal LM training: labels[t] =
+    onehot(tokens[t+1]); the last step repeats the last token (give it a
+    [N,T] label mask with 0 in the final column to drop it from the loss)."""
+    import numpy as np
+    ids = np.asarray(tokens).astype(np.int64)
+    shifted = np.concatenate([ids[:, 1:], ids[:, -1:]], axis=1)
+    out = np.zeros(shifted.shape + (vocab_size,), np.float32)
+    np.put_along_axis(out, shifted[..., None], 1.0, axis=-1)
+    return out
+
+
+def generate(net, prompt_ids, n_new_tokens: int, temperature: float = 0.0,
+             seed: int = 0):
+    """Autoregressive sampling from a trained :class:`TransformerLM` network.
+
+    Feeds the whole prompt through the stateful KV-cached path once, then
+    samples one token per jitted step (n_new_tokens - 1 incremental steps
+    total — the last sampled token is not fed back). ``temperature=0`` is
+    greedy argmax. Returns [N, n_new_tokens] generated ids.
+    """
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    ids = np.asarray(prompt_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    cap = _kv_capacity(net)
+    total = ids.shape[1] + n_new_tokens - 1  # last token is never fed back
+    if cap is not None and total > cap:
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + {n_new_tokens} new tokens needs "
+            f"{total} cache slots but the model holds {cap} "
+            f"(max_length/max_cache)")
+    net.rnn_clear_previous_state()
+    # [N,T,1] so rnn_time_step keeps the time axis (ids are "features")
+    probs = np.asarray(net.rnn_time_step(ids[:, :, None].astype(np.float32)))
+    out = []
+    for i in range(n_new_tokens):
+        p_last = probs[:, -1, :] if probs.ndim == 3 else probs
+        if temperature and temperature > 0:
+            logits = np.log(np.maximum(p_last, 1e-20)) / temperature
+            z = np.exp(logits - logits.max(axis=-1, keepdims=True))
+            p = (z / z.sum(axis=-1, keepdims=True)).astype(np.float64)
+            p /= p.sum(axis=-1, keepdims=True)  # exact for rng.choice's check
+            nxt = np.array([rng.choice(p.shape[-1], p=row) for row in p])
+        else:
+            nxt = np.argmax(p_last, axis=-1)
+        out.append(nxt)
+        if i < n_new_tokens - 1:
+            probs = np.asarray(
+                net.rnn_time_step(nxt[:, None, None].astype(np.float32)))
+    return np.stack(out, axis=1)
+
+
+def _kv_capacity(net):
+    """Smallest stateful-decode capacity across the net's layers (KV caches
+    and positional tables), or None if the net has none."""
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+
+    layer_vertices = getattr(net.conf, "layer_vertices", None)
+    layers = ([vd.obj for vd in layer_vertices()] if layer_vertices
+              else getattr(net, "layers", []))
+    caps = [obj.carry_capacity() for obj in layers
+            if isinstance(obj, BaseRecurrentLayer)
+            and obj.carry_capacity() is not None]
+    return min(caps) if caps else None
+
+
 def transformer_encoder_block(g, name: str, src: str, d_model: int,
                               n_heads: int, d_ff: int,
                               attn_dropout: float = 0.0) -> str:

@@ -156,3 +156,22 @@ class TestSequenceParallelHelper:
         finally:
             helpers.clear_helper("attention")
         assert np.isfinite(float(net.score_))
+
+
+def test_ulysses_helper_no_reentry(rng):
+    """Regression: the ulysses shard body must not consult the helper seam
+    again — with per-shard head count divisible by the shard count the
+    nested supports() used to pass and nest a second shard_map (crash)."""
+    from deeplearning4j_tpu.nn import helpers
+    from deeplearning4j_tpu.parallel.ring import SequenceParallelAttentionHelper
+
+    mesh2 = make_mesh({SEQUENCE_AXIS: 2})
+    q, k, v = _qkv(rng, n=2, h=4, t=16, dh=8)  # 4 heads % 2 shards == 0
+    ref = np.asarray(dot_product_attention(q, k, v))
+    helpers.set_helper("attention", SequenceParallelAttentionHelper(
+        mesh2, strategy="ulysses"))
+    try:
+        out = np.asarray(dot_product_attention(q, k, v))
+    finally:
+        helpers.clear_helper("attention")
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)

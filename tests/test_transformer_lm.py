@@ -1,0 +1,194 @@
+"""TransformerLM (causal decoder) — causality, KV-cache decoding, training,
+generation.
+
+Reference seam: the zoo's text-generation model
+(``deeplearning4j-zoo/.../zoo/model/TextGenerationLSTM.java``) and stateful
+inference (``MultiLayerNetwork.rnnTimeStep:2800``); the attention-era decoder
+has no reference counterpart (the snapshot predates attention, SURVEY.md §5).
+The KV-cache path must match the full quadratic forward exactly — the same
+"same-math equivalence" bar the reference applies to its cuDNN helpers
+(``deeplearning4j-cuda/src/test/.../ValidateCudnnLSTM.java``).
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.graph import ComputationGraph
+from deeplearning4j_tpu.zoo.models import TransformerLM, generate, lm_labels
+
+VOCAB = 11
+
+
+def tiny_lm(**kw):
+    args = dict(vocab_size=VOCAB, max_length=16, n_layers=2, d_model=32,
+                n_heads=4, d_ff=64, seed=7)
+    args.update(kw)
+    net = ComputationGraph(TransformerLM(**args).conf())
+    net.init()
+    return net
+
+
+def cycle_batch(rng, n, t, step=3):
+    """Sequences following a fixed successor rule: x[t+1] = (x[t]+step) % V —
+    a next-token task a 2-layer decoder learns quickly."""
+    start = rng.integers(0, VOCAB, size=(n, 1))
+    seq = (start + step * np.arange(t)[None, :]) % VOCAB
+    return seq.astype(np.float32)
+
+
+class TestCausality:
+    def test_future_tokens_do_not_change_past_outputs(self):
+        net = tiny_lm()
+        ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.float32)
+        full = np.asarray(net.output(ids))
+        ids2 = ids.copy()
+        ids2[0, -1] = 9
+        full2 = np.asarray(net.output(ids2))
+        np.testing.assert_allclose(full[:, :-1], full2[:, :-1], atol=1e-6)
+        assert np.abs(full[:, -1] - full2[:, -1]).max() > 1e-6
+
+    def test_padding_mask_matches_short_batch(self):
+        net = tiny_lm()
+        rng = np.random.default_rng(0)
+        short = rng.integers(0, VOCAB, size=(3, 5)).astype(np.float32)
+        pad = np.zeros((3, 8), np.float32)
+        pad[:, :5] = short
+        mask = np.zeros((3, 8), np.float32)
+        mask[:, :5] = 1.0
+        out_short = np.asarray(net.output(short))
+        out_pad = np.asarray(net.output(pad, masks=[mask]))
+        np.testing.assert_allclose(out_pad[:, :5], out_short, atol=1e-5)
+
+
+class TestKVCache:
+    def test_single_token_steps_equal_full_forward(self):
+        net = tiny_lm()
+        ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8],
+                        [8, 7, 6, 5, 4, 3, 2, 1]], np.float32)
+        full = np.asarray(net.output(ids))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(ids[:, t:t + 1, None]))[:, 0]
+                 for t in range(ids.shape[1])]
+        np.testing.assert_allclose(np.stack(steps, 1), full, atol=1e-5)
+
+    def test_prompt_chunk_then_single_steps(self):
+        net = tiny_lm()
+        ids = np.array([[3, 1, 4, 1, 5, 9, 2, 6]], np.float32)
+        full = np.asarray(net.output(ids))
+        net.rnn_clear_previous_state()
+        chunk = np.asarray(net.rnn_time_step(ids[:, :5, None]))
+        np.testing.assert_allclose(chunk, full[:, :5], atol=1e-5)
+        for t in range(5, 8):
+            o = np.asarray(net.rnn_time_step(ids[:, t:t + 1, None]))
+            np.testing.assert_allclose(o[:, 0], full[:, t], atol=1e-5)
+
+    def test_clear_state_resets_positions(self):
+        net = tiny_lm()
+        ids = np.array([[1, 2, 3]], np.float32)
+        net.rnn_clear_previous_state()
+        a = np.asarray(net.rnn_time_step(ids[:, :, None]))
+        net.rnn_clear_previous_state()
+        b = np.asarray(net.rnn_time_step(ids[:, :, None]))
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+class TestTraining:
+    def test_learns_successor_rule_and_generates_it(self):
+        net = tiny_lm(seed=3)
+        rng = np.random.default_rng(0)
+        x = cycle_batch(rng, 64, 16)
+        y = lm_labels(x, VOCAB)
+        lmask = np.ones(x.shape[:2], np.float32)
+        lmask[:, -1] = 0.0  # last step has no next token
+        ds = DataSet(x, y, labels_mask=lmask)
+        s0 = net.score(ds)
+        for _ in range(150):
+            net.fit(ds)
+        assert net.score_ < s0 * 0.2, (s0, net.score_)
+        # greedy generation continues the +3 cycle
+        prompt = cycle_batch(np.random.default_rng(1), 2, 6)
+        gen = generate(net, prompt, 6)
+        want = (prompt[:, -1:] + 3 * np.arange(1, 7)[None, :]) % VOCAB
+        assert (gen == want).mean() > 0.9, (gen, want)
+
+    def test_lm_labels_shift(self):
+        ids = np.array([[0, 1, 2, 3]])
+        lab = lm_labels(ids, 5)
+        assert lab.shape == (1, 4, 5)
+        assert lab[0, 0, 1] == 1.0 and lab[0, 2, 3] == 1.0
+        assert lab[0, 3, 3] == 1.0  # final step repeats last id
+
+
+class TestGuards:
+    def test_kv_cache_overflow_raises(self):
+        net = tiny_lm()  # max_length 16
+        net.rnn_clear_previous_state()
+        ids = np.ones((1, 10, 1), np.float32)
+        net.rnn_time_step(ids)
+        with np.testing.assert_raises(ValueError):
+            net.rnn_time_step(ids)  # 10 + 10 > 16
+
+    def test_generate_capacity_check(self):
+        net = tiny_lm()
+        with np.testing.assert_raises(ValueError):
+            generate(net, np.ones((1, 10)), 10)  # needs 19 > 16 slots
+        # exactly at capacity is fine: 10 + 7 - 1 == 16
+        generate(net, np.ones((1, 10)), 7)
+
+    def test_num_labels_is_vocab_size(self):
+        from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
+        m = ModelSelector.select("transformerlm", num_labels=40)
+        assert m.vocab_size == 40 and m.num_labels == 40
+
+    def test_causal_helper_flag_respected(self):
+        # a causal=True seq-parallel helper must refuse non-causal requests
+        # and take causal ones (and vice versa) — outputs never change
+        import jax
+        from deeplearning4j_tpu.parallel.ring import (
+            SequenceParallelAttentionHelper)
+        mesh = jax.sharding.Mesh(np.array(jax.devices()[:2]), ("sp",))
+        h = SequenceParallelAttentionHelper(mesh, axis_name="sp", causal=True)
+        q_shape = (2, 4, 8, 16)
+        assert h.supports(None, q_shape, None, False, causal=True)
+        assert not h.supports(None, q_shape, None, False, causal=False)
+        h2 = SequenceParallelAttentionHelper(mesh, axis_name="sp")
+        assert h2.supports(None, q_shape, None, False)
+        assert not h2.supports(None, q_shape, None, False, causal=True)
+
+
+class TestGenerate:
+    def test_temperature_sampling_in_vocab(self):
+        net = tiny_lm()
+        gen = generate(net, np.array([[1, 2, 3]]), 4, temperature=1.0, seed=5)
+        assert gen.shape == (1, 4)
+        assert ((gen >= 0) & (gen < VOCAB)).all()
+
+    def test_selector_has_transformer_lm(self):
+        from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
+        assert "transformerlm" in ModelSelector.available()
+
+
+class TestTBPTTCapacity:
+    def test_tbptt_overflow_rejected_before_jit(self):
+        # jitted TBPTT steps cannot raise on KV-cache overflow; the host
+        # loop must reject overlong sequences upfront
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import (
+            CausalSelfAttentionLayer, RnnOutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(CausalSelfAttentionLayer(n_in=8, n_out=8, n_heads=2,
+                                                max_cache=8))
+                .layer(RnnOutputLayer(n_out=4, loss="mcxent",
+                                      activation="softmax"))
+                .backprop_type("tbptt").t_bptt_length(4)
+                .set_input_type(InputType.recurrent(8, 16))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(2, 16, 8)).astype(np.float32)
+        y = np.zeros((2, 16, 4), np.float32)
+        y[..., 0] = 1
+        with np.testing.assert_raises(ValueError):
+            net.fit(x, y)
