@@ -169,6 +169,9 @@ OPS: Dict[str, Callable] = {
            + 1e-12)),
     "loss_hinge": lambda labels, preds: jnp.mean(
         jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * preds)),
+    # control-flow plumbing: a while_loop node's value is the carried tuple;
+    # tuple_get projects one element out at the top level
+    "tuple_get": lambda t, index=0: t[index],
 }
 
 
@@ -180,7 +183,8 @@ class SDVariable:
     def __init__(self, sd: "SameDiff", name: str, kind: str,
                  op: Optional[str] = None, inputs: Sequence[str] = (),
                  attrs: Optional[dict] = None,
-                 shape: Optional[Tuple] = None):
+                 shape: Optional[Tuple] = None,
+                 scope: Optional[str] = None):
         self.sd = sd
         self.name = name
         self.kind = kind  # "placeholder" | "variable" | "constant" | "op"
@@ -188,6 +192,9 @@ class SDVariable:
         self.inputs = tuple(inputs)
         self.attrs = attrs or {}
         self._declared_shape = shape
+        # non-None: node belongs to a control-flow branch/loop body and only
+        # executes inside its owning if_cond/while_loop node
+        self.scope = scope
 
     # -- algebra ------------------------------------------------------------
     def _bin(self, other, op, name=None):
@@ -424,6 +431,7 @@ class SameDiff:
         self._grads: Dict[str, np.ndarray] = {}
         self._jit_cache: Dict[tuple, Callable] = {}
         self._counter = 0
+        self._active_scope: Optional[str] = None
         self.math = _Namespace(self, _MATH_OPS, _ATTRS)
         self.nn = _Namespace(self, _NN_OPS, _ATTRS)
         self.loss = _Namespace(self, _LOSS_OPS, _ATTRS)
@@ -443,6 +451,8 @@ class SameDiff:
     def _register(self, v: SDVariable) -> SDVariable:
         if v.name in self._nodes:
             raise ValueError(f"duplicate variable name {v.name!r}")
+        if v.scope is None:
+            v.scope = self._active_scope
         self._nodes[v.name] = v
         self._order.append(v.name)
         self._jit_cache.clear()
@@ -450,6 +460,8 @@ class SameDiff:
 
     def place_holder(self, name: str, shape: Optional[Sequence] = None,
                      dtype=jnp.float32) -> SDVariable:
+        if self._active_scope is not None:
+            raise ValueError("create placeholders outside control-flow bodies")
         return self._register(SDVariable(
             self, name, "placeholder",
             shape=None if shape is None else tuple(shape)))
@@ -460,6 +472,9 @@ class SameDiff:
             weight_init: str = "xavier", seed: int = 0,
             dtype=jnp.float32) -> SDVariable:
         """Trainable variable: pass an initial array OR a shape (+init)."""
+        if self._active_scope is not None:
+            raise ValueError("create variables outside control-flow bodies "
+                             "(close over them instead)")
         if value is None:
             if shape is None:
                 raise ValueError("var() needs an initial value or a shape")
@@ -498,7 +513,111 @@ class SameDiff:
             inputs=[self._as_var(i).name for i in inputs],
             attrs={k: v for k, v in (attrs or {}).items() if v is not None}))
 
+    # -- control flow -------------------------------------------------------
+    def _scoped_build(self, scope_id: str, build: Callable) -> Tuple:
+        """Run a body-builder with ``scope_id`` active; returns (result,
+        names of the nodes it created). Scoped nodes execute only inside
+        their owning control-flow node."""
+        if self._active_scope is not None:
+            raise NotImplementedError(
+                "nested control flow (if/while inside a branch/body) is not "
+                "supported")
+        start = len(self._order)
+        self._active_scope = scope_id
+        try:
+            result = build()
+        finally:
+            self._active_scope = None
+        return result, self._order[start:]
+
+    def _outer_deps(self, scope_names: Sequence[str],
+                    outs: Sequence[str] = ()) -> List[str]:
+        """Top-level nodes a scope reads (closure captures), in tape order.
+        ``outs`` are the branch/body result names: a passthrough result
+        (an outer node returned directly, e.g. ``lambda s: c``) is a
+        capture too even though no scoped node reads it."""
+        scope_set = set(scope_names)
+        deps = {o for o in outs if o not in scope_set}
+        for m in scope_names:
+            for i in self._nodes[m].inputs:
+                if i not in scope_set:
+                    deps.add(i)
+        return [n for n in self._order if n in deps]
+
+    def if_cond(self, cond: "SDVariable", true_fn: Callable,
+                false_fn: Callable, name: Optional[str] = None) -> "SDVariable":
+        """Conditional execution (ND4J ``sd.ifCond(cond, trueBody,
+        falseBody)``): ``true_fn(sd)``/``false_fn(sd)`` each build a
+        subgraph (closing over outer variables is fine) and return one
+        SDVariable; only the taken branch executes, both must produce the
+        same shape/dtype. Lowered to ``jax.lax.cond`` — compiled once,
+        branch chosen on device, differentiable (``jax.grad`` flows through
+        the taken branch)."""
+        name = name or self._fresh_name("if")
+        t_out, t_scope = self._scoped_build(name, lambda: true_fn(self))
+        f_out, f_scope = self._scoped_build(name, lambda: false_fn(self))
+        outer = self._outer_deps(list(t_scope) + list(f_scope),
+                                 outs=(t_out.name, f_out.name))
+        return self._register(SDVariable(
+            self, name, "op", op="if_cond",
+            inputs=[cond.name] + outer,
+            attrs={"true_scope": list(t_scope), "false_scope": list(f_scope),
+                   "true_out": t_out.name, "false_out": f_out.name,
+                   "n_outer": len(outer)}))
+
+    ifCond = if_cond  # ND4J spelling
+
+    def while_loop(self, loop_vars: Sequence["SDVariable"],
+                   cond_fn: Callable, body_fn: Callable,
+                   name: Optional[str] = None) -> List["SDVariable"]:
+        """Carried loop (ND4J ``sd.whileLoop(loopVars, cond, body)``):
+        ``cond_fn(sd, *vars) -> scalar`` and ``body_fn(sd, *vars) ->
+        [vars']`` build subgraphs over symbolic loop variables (closing over
+        outer variables is fine); shapes must be loop-invariant. Lowered to
+        ``jax.lax.while_loop`` — the trip count is decided on device at run
+        time, so the loop is jittable with NO host round-trips per
+        iteration. Forward-only (XLA cannot reverse-differentiate a dynamic
+        trip count; the reference's loops are likewise not gradient-trained).
+        Returns the final loop variables."""
+        name = name or self._fresh_name("while")
+        init = [self._as_var(v) for v in loop_vars]
+
+        def build():
+            syms = [SDVariable(self, f"{name}_lv{i}", "op", op="loop_input",
+                               attrs={"index": i}, scope=name)
+                    for i in range(len(init))]
+            for s in syms:
+                self._register(s)
+            c_out = cond_fn(self, *syms)
+            b_outs = body_fn(self, *syms)
+            if not isinstance(b_outs, (list, tuple)):
+                b_outs = [b_outs]
+            if len(b_outs) != len(init):
+                raise ValueError(
+                    f"while_loop body returned {len(b_outs)} values for "
+                    f"{len(init)} loop variables")
+            return c_out, list(b_outs)
+
+        (c_out, b_outs), scope = self._scoped_build(name, build)
+        outer = self._outer_deps(
+            scope, outs=[c_out.name] + [b.name for b in b_outs])
+        self._register(SDVariable(
+            self, name, "op", op="while_loop",
+            inputs=[v.name for v in init] + outer,
+            attrs={"scope": list(scope), "cond_out": c_out.name,
+                   "body_outs": [b.name for b in b_outs],
+                   "n_loop_vars": len(init)}))
+        return [self._op("tuple_get", [self._nodes[name]],
+                         name=f"{name}_out{i}", attrs={"index": i})
+                for i in range(len(init))]
+
+    whileLoop = while_loop  # ND4J spelling
+
     def rename(self, old: str, new: str) -> SDVariable:
+        node = self._nodes[old]
+        if node.scope is not None or node.op in ("if_cond", "while_loop"):
+            # control-flow attrs reference subgraph nodes by name
+            raise ValueError("cannot rename control-flow nodes")
         self._jit_cache.clear()
         v = self._nodes.pop(old)
         v.name = new
@@ -529,7 +648,24 @@ class SameDiff:
                 continue
             needed.add(n)
             stack.extend(self._nodes[n].inputs)
-        order = [n for n in self._order if n in needed]
+        # scoped nodes run only inside their owning control-flow node
+        order = [n for n in self._order
+                 if n in needed and self._nodes[n].scope is None]
+
+        def run_scope(scope_names, operands, carry=None):
+            """Execute a control-flow subgraph: operands = captured outer
+            values; carry = loop-variable tuple (while_loop only)."""
+            env2 = dict(operands)
+            for m in scope_names:
+                nd = self._nodes[m]
+                if nd.kind == "constant":
+                    env2[m] = self.constants_map[m]
+                elif nd.op == "loop_input":
+                    env2[m] = carry[nd.attrs["index"]]
+                else:
+                    env2[m] = OPS[nd.op](*(env2[i] for i in nd.inputs),
+                                         **nd.attrs)
+            return env2
 
         def fn(variables, placeholders):
             env = {}
@@ -541,6 +677,30 @@ class SameDiff:
                     env[n] = variables[n]
                 elif node.kind == "constant":
                     env[n] = self.constants_map[n]
+                elif node.op == "if_cond":
+                    a = node.attrs
+                    pred = jnp.reshape(env[node.inputs[0]], ()) != 0
+                    operands = {d: env[d] for d in node.inputs[1:]}
+                    env[n] = jax.lax.cond(
+                        pred,
+                        lambda ops_, _a=a: run_scope(
+                            _a["true_scope"], ops_)[_a["true_out"]],
+                        lambda ops_, _a=a: run_scope(
+                            _a["false_scope"], ops_)[_a["false_out"]],
+                        operands)
+                elif node.op == "while_loop":
+                    a = node.attrs
+                    nlv = a["n_loop_vars"]
+                    init = tuple(env[i] for i in node.inputs[:nlv])
+                    operands = {d: env[d] for d in node.inputs[nlv:]}
+                    env[n] = jax.lax.while_loop(
+                        lambda carry, _a=a, _o=operands: jnp.reshape(
+                            run_scope(_a["scope"], _o, carry)[_a["cond_out"]],
+                            ()) != 0,
+                        lambda carry, _a=a, _o=operands: tuple(
+                            run_scope(_a["scope"], _o, carry)[m]
+                            for m in _a["body_outs"]),
+                        init)
                 else:
                     env[n] = OPS[node.op](*(env[i] for i in node.inputs),
                                           **node.attrs)
@@ -712,6 +872,7 @@ class SameDiff:
                 "inputs": list(v.inputs), "attrs": v.attrs,
                 "shape": None if v._declared_shape is None
                 else list(v._declared_shape),
+                "scope": v.scope,
             } for n, v in ((n, self._nodes[n]) for n in self._order)],
             "loss_variables": self._loss_variables,
         })
@@ -739,10 +900,17 @@ class SameDiff:
                 sd.var(name, value=data[f"var__{name}"])
             elif kind == "constant":
                 sd.constant(name, data[f"const__{name}"])
+                sd._nodes[name].scope = nd.get("scope")
+            elif nd["op"] in ("if_cond", "while_loop", "loop_input"):
+                # control-flow attrs hold name lists that must stay lists
+                sd._register(SDVariable(sd, name, "op", op=nd["op"],
+                                        inputs=nd["inputs"], attrs=nd["attrs"],
+                                        scope=nd.get("scope")))
             else:
                 attrs = {k: (tuple(v) if isinstance(v, list) else v)
                          for k, v in (nd["attrs"] or {}).items()}
                 sd._register(SDVariable(sd, name, "op", op=nd["op"],
-                                        inputs=nd["inputs"], attrs=attrs))
+                                        inputs=nd["inputs"], attrs=attrs,
+                                        scope=nd.get("scope")))
         sd._loss_variables = spec.get("loss_variables", [])
         return sd

@@ -55,6 +55,7 @@ class ComputationGraph:
         self._jit_cache: Dict[Any, Any] = {}
         self._updaters: Dict[str, Dict[str, Updater]] = {}
         self._rnn_carries: Optional[Dict[str, Any]] = None
+        self._rnn_pos = 0
 
     # ---------------------------------------------------------------- score
     @property
@@ -400,6 +401,25 @@ class ComputationGraph:
     # ------------------------------------------------------ stateful RNN API
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None
+        self._rnn_pos = 0
+
+    def _rnn_step_fn(self):
+        """Jitted stateful step: the whole per-chunk forward (KV-cache
+        writes included) compiles to ONE executable per input shape, so
+        autoregressive decoding is a jitted step per token, not per-op
+        Python dispatch."""
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("rnn_step", _helpers.version())
+        if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
+            def step_fn(params, states, inputs, carries):
+                acts, _, _, new_carries = self._forward_all(
+                    params, states, inputs, train=False, rng=None,
+                    carries=carries)
+                return [acts[n] for n in self.conf.outputs], new_carries
+            self._jit_cache[key] = jax.jit(step_fn)
+        return self._jit_cache[key]
 
     def rnn_time_step(self, *xs) -> Union[Array, List[Array]]:
         dtype = self.conf.global_conf.jnp_dtype()
@@ -412,14 +432,26 @@ class ComputationGraph:
         if self._rnn_carries is None:
             batch = xs[0].shape[0]
             self._rnn_carries = {}
+            self._rnn_pos = 0
             for vd in self.conf.layer_vertices():
                 if isinstance(vd.obj, BaseRecurrentLayer):
                     self._rnn_carries[vd.name] = vd.obj.init_carry(batch, dtype)
+        # finite carries (KV caches, positional offsets) cannot raise inside
+        # the jitted step — enforce capacity host-side
+        t_new = xs[0].shape[1]
+        for vd in self.conf.layer_vertices():
+            if isinstance(vd.obj, BaseRecurrentLayer):
+                cap = vd.obj.carry_capacity()
+                if cap is not None and self._rnn_pos + t_new > cap:
+                    raise ValueError(
+                        f"rnn_time_step at position {self._rnn_pos}+{t_new} "
+                        f"exceeds {vd.name} carry capacity {cap}; "
+                        f"rnn_clear_previous_state() or raise max_cache/"
+                        f"max_len")
         inputs = dict(zip(self.conf.inputs, xs))
-        acts, _, _, self._rnn_carries = self._forward_all(
-            self.params, self.states, inputs, train=False, rng=None,
-            carries=self._rnn_carries)
-        outs = [acts[n] for n in self.conf.outputs]
+        outs, self._rnn_carries = self._rnn_step_fn()(
+            self.params, self.states, inputs, self._rnn_carries)
+        self._rnn_pos += t_new
         if squeeze:
             outs = [o[:, -1, :] if o.ndim == 3 else o for o in outs]
         return outs[0] if len(outs) == 1 else outs

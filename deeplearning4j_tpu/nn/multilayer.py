@@ -63,6 +63,7 @@ class MultiLayerNetwork:
         self._rng_key: Optional[jax.Array] = None
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries: Optional[List[Any]] = None
+        self._rnn_pos = 0
         # resolve per-layer / per-param updaters once
         self._updaters: List[Dict[str, Updater]] = []
 
@@ -402,6 +403,23 @@ class MultiLayerNetwork:
     # ------------------------------------------------------ stateful RNN API
     def rnn_clear_previous_state(self) -> None:
         self._rnn_carries = None
+        self._rnn_pos = 0
+
+    def _rnn_step_fn(self):
+        """Jitted stateful step (see ComputationGraph._rnn_step_fn): one
+        executable per input shape for autoregressive decoding."""
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("rnn_step", _helpers.version())
+        if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
+            def step_fn(params, states, x, carries):
+                h, _, new_carries = self._forward_all(
+                    params, states, x, train=False, rng=None, mask=None,
+                    carries=carries)
+                return h, new_carries
+            self._jit_cache[key] = jax.jit(step_fn)
+        return self._jit_cache[key]
 
     def rnn_time_step(self, x) -> Array:
         """Stateful single/multi-step inference (rnnTimeStep:2800 parity).
@@ -413,12 +431,24 @@ class MultiLayerNetwork:
             x = x[:, None, :]
         if self._rnn_carries is None:
             batch = x.shape[0]
+            self._rnn_pos = 0
             self._rnn_carries = [
                 l.init_carry(batch, dtype) if isinstance(l, BaseRecurrentLayer) else None
                 for l in self.layers]
-        h, _, self._rnn_carries = self._forward_all(
-            self.params, self.states, x, train=False, rng=None, mask=None,
-            carries=self._rnn_carries)
+        # host-side capacity guard: finite carries cannot raise under jit
+        t_new = x.shape[1]
+        for i, l in enumerate(self.layers):
+            if isinstance(l, BaseRecurrentLayer):
+                cap = l.carry_capacity()
+                if cap is not None and self._rnn_pos + t_new > cap:
+                    raise ValueError(
+                        f"rnn_time_step at position {self._rnn_pos}+{t_new} "
+                        f"exceeds layer {i} carry capacity {cap}; "
+                        f"rnn_clear_previous_state() or raise max_cache/"
+                        f"max_len")
+        h, self._rnn_carries = self._rnn_step_fn()(
+            self.params, self.states, x, self._rnn_carries)
+        self._rnn_pos += t_new
         return h[:, -1, :] if squeeze and h.ndim == 3 else h
 
     # ------------------------------------------------------------ evaluation

@@ -731,6 +731,8 @@ def generate(net, prompt_ids, n_new_tokens: int, temperature: float = 0.0,
     ids = np.asarray(prompt_ids)
     if ids.ndim == 1:
         ids = ids[None]
+    if n_new_tokens <= 0:
+        return np.zeros((ids.shape[0], 0), np.int64)
     cap = _kv_capacity(net)
     total = ids.shape[1] + n_new_tokens - 1  # last token is never fed back
     if cap is not None and total > cap:

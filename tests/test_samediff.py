@@ -289,3 +289,107 @@ class TestEvaluate:
         sd.fit(ds, epochs=50)
         ev = sd.evaluate(ds, "probs")
         assert ev.accuracy() > 0.9
+
+
+class TestControlFlow:
+    """if_cond / while_loop (ND4J SameDiff control flow) lowered to
+    lax.cond / lax.while_loop — one compiled graph, trip count on device."""
+
+    def test_if_cond_takes_each_branch(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=(3,))
+        w = sd.var("w", value=np.array([2.0, 2.0, 2.0], np.float32))
+        out = sd.if_cond(sd.math.gt(x.sum(), 0.0),
+                         lambda s: x * w, lambda s: x - w, name="branch")
+        pos = sd.output({"x": np.array([1., 2., 3.], np.float32)}, "branch")
+        neg = sd.output({"x": np.array([-1., -2., -3.], np.float32)}, "branch")
+        np.testing.assert_allclose(pos["branch"], [2., 4., 6.])
+        np.testing.assert_allclose(neg["branch"], [-3., -4., -5.])
+        assert out.shape == (3,)
+
+    def test_if_cond_gradient_flows_through_taken_branch(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=(3,))
+        w = sd.var("w", value=np.array([2.0, 2.0, 2.0], np.float32))
+        sd.if_cond(sd.math.gt(x.sum(), 0.0),
+                   lambda s: x * w, lambda s: x - w, name="branch")
+        sd.set_loss_variables("branch")
+        xv = np.array([1., 2., 3.], np.float32)
+        g = sd.calculate_gradients({"x": xv}, "w")
+        np.testing.assert_allclose(g["w"], xv)      # d(sum(x*w))/dw = x
+        g = sd.calculate_gradients({"x": -xv}, "w")
+        np.testing.assert_allclose(g["w"], [-1., -1., -1.])  # d(sum(x-w))/dw
+
+    def test_while_loop_dynamic_trip_count(self):
+        sd = SameDiff.create()
+        n = sd.place_holder("n", shape=())
+        i0 = sd.constant("i0", np.float32(1.0))
+        a0 = sd.constant("a0", np.float32(0.0))
+        fin = sd.while_loop([i0, a0],
+                            lambda s, i, a: s.math.lte(i, n),
+                            lambda s, i, a: [i + 1.0, a + i])
+        # same compiled graph, trip count decided on device
+        assert sd.output({"n": np.float32(10)}, fin[1].name)[fin[1].name] == 55
+        assert sd.output({"n": np.float32(4)}, fin[1].name)[fin[1].name] == 10
+        assert sd.output({"n": np.float32(0)}, fin[1].name)[fin[1].name] == 0
+
+    def test_while_loop_closes_over_outer_variable(self):
+        sd = SameDiff.create()
+        r = sd.var("rate", value=np.float32(2.0))
+        x0 = sd.constant("x0", np.float32(1.0))
+        lim = sd.constant("lim", np.float32(100.0))
+        fin = sd.while_loop([x0],
+                            lambda s, x: s.math.lt(x, lim),
+                            lambda s, x: [x * r])
+        assert sd.output({}, fin[0].name)[fin[0].name] == 128.0
+
+    def test_control_flow_serde_round_trip(self, tmp_path):
+        sd = SameDiff.create()
+        n = sd.place_holder("n", shape=())
+        i0 = sd.constant("i0", np.float32(1.0))
+        a0 = sd.constant("a0", np.float32(0.0))
+        fin = sd.while_loop([i0, a0],
+                            lambda s, i, a: s.math.lte(i, n),
+                            lambda s, i, a: [i + 1.0, a + i], name="loop")
+        sd.save(str(tmp_path / "cf"))
+        sd2 = SameDiff.load(str(tmp_path / "cf"))
+        got = sd2.output({"n": np.float32(10)}, fin[1].name)[fin[1].name]
+        assert got == 55
+
+    def test_nested_control_flow_rejected(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=())
+        c = sd.constant("c", np.float32(1.0))
+        with pytest.raises(NotImplementedError):
+            sd.if_cond(sd.math.gt(x, 0.0),
+                       lambda s: s.if_cond(s.math.gt(c, 0.0),
+                                           lambda s2: c, lambda s2: c + 1),
+                       lambda s: c)
+
+    def test_no_variables_inside_bodies(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=())
+        c = sd.constant("c", np.float32(1.0))
+        with pytest.raises(ValueError):
+            sd.if_cond(sd.math.gt(x, 0.0),
+                       lambda s: s.var("w2", value=np.float32(1.0)),
+                       lambda s: c)
+
+    def test_if_cond_passthrough_branch(self):
+        # a branch may return a captured outer node directly
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=())
+        c = sd.constant("c", np.float32(7.0))
+        sd.if_cond(sd.math.gt(x, 0.0), lambda s: x * 2.0, lambda s: c,
+                   name="o")
+        assert sd.output({"x": np.float32(3.0)}, "o")["o"] == 6.0
+        assert sd.output({"x": np.float32(-3.0)}, "o")["o"] == 7.0
+
+    def test_while_loop_passthrough_body(self):
+        sd = SameDiff.create()
+        lim = sd.constant("lim", np.float32(5.0))
+        i0 = sd.constant("i0", np.float32(0.0))
+        fin = sd.while_loop([i0],
+                            lambda s, i: s.math.lt(i, lim),
+                            lambda s, i: [i + 1.0])
+        assert sd.output({}, fin[0].name)[fin[0].name] == 5.0
