@@ -626,6 +626,14 @@ class SameDiff:
         for n in self._nodes.values():
             if old in n.inputs:
                 n.inputs = tuple(new if i == old else i for i in n.inputs)
+            if n.op in ("if_cond", "while_loop"):
+                # control-flow attrs carry node NAMES (branch outputs may be
+                # passthrough references to top-level nodes) — keep them live
+                for k, val in n.attrs.items():
+                    if val == old:
+                        n.attrs[k] = new
+                    elif isinstance(val, (list, tuple)) and old in val:
+                        n.attrs[k] = [new if m == old else m for m in val]
         if old in self.variables_map:
             self.variables_map[new] = self.variables_map.pop(old)
         if old in self.constants_map:

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.zoo.models import (
     VGG19,
     YOLO2,
     generate,
+    generate_on_device,
     lm_labels,
 )
 
@@ -37,5 +38,5 @@ __all__ = [
     "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TinyYOLO", "TransformerEncoder", "TransformerLM",
-    "VGG16", "VGG19", "YOLO2", "generate", "lm_labels",
+    "VGG16", "VGG19", "YOLO2", "generate", "generate_on_device", "lm_labels",
 ]

@@ -728,18 +728,9 @@ def generate(net, prompt_ids, n_new_tokens: int, temperature: float = 0.0,
     import numpy as np
 
     rng = np.random.default_rng(seed)
-    ids = np.asarray(prompt_ids)
-    if ids.ndim == 1:
-        ids = ids[None]
-    if n_new_tokens <= 0:
-        return np.zeros((ids.shape[0], 0), np.int64)
-    cap = _kv_capacity(net)
-    total = ids.shape[1] + n_new_tokens - 1  # last token is never fed back
-    if cap is not None and total > cap:
-        raise ValueError(
-            f"prompt ({ids.shape[1]}) + {n_new_tokens} new tokens needs "
-            f"{total} cache slots but the model holds {cap} "
-            f"(max_length/max_cache)")
+    ids, empty = _prep_prompt(net, prompt_ids, n_new_tokens)
+    if empty is not None:
+        return empty
     net.rnn_clear_previous_state()
     # [N,T,1] so rnn_time_step keeps the time axis (ids are "features")
     probs = np.asarray(net.rnn_time_step(ids[:, :, None].astype(np.float32)))
@@ -759,6 +750,96 @@ def generate(net, prompt_ids, n_new_tokens: int, temperature: float = 0.0,
             probs = np.asarray(
                 net.rnn_time_step(nxt[:, None, None].astype(np.float32)))
     return np.stack(out, axis=1)
+
+
+def generate_on_device(net, prompt_ids, n_new_tokens: int,
+                       temperature: float = 0.0, seed: int = 0):
+    """Autoregressive sampling compiled to ONE device executable: prompt
+    prefill fills every KV cache, then a ``lax.scan`` decodes one token per
+    step with on-device argmax/categorical sampling. A single dispatch and a
+    single host read for the whole sequence — the TPU-idiomatic decode loop
+    (the host-loop :func:`generate` pays one device round-trip per token,
+    which dominates when the link to the chip is remote).
+
+    Greedy (``temperature=0``) matches :func:`generate` exactly; sampling
+    uses ``jax.random.categorical`` (a different RNG than the host loop's
+    numpy, so draws differ — distributions match). Returns [N, n_new_tokens].
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ids, empty = _prep_prompt(net, prompt_ids, n_new_tokens)
+    if empty is not None:
+        return empty
+
+    from deeplearning4j_tpu.nn import helpers as _helpers
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+
+    inp = net.conf.inputs[0]
+    out_name = net.conf.outputs[0]
+    greedy = not (temperature and temperature > 0)
+    key = ("generate", n_new_tokens, greedy, float(temperature),
+           _helpers.version())
+    if key not in net._jit_cache:
+        net._evict_stale(_helpers.version())
+        dtype = net.conf.global_conf.jnp_dtype()
+
+        def sample(p, k):
+            if greedy:
+                return jnp.argmax(p, axis=-1).astype(jnp.int32)
+            logits = jnp.log(jnp.maximum(p, 1e-20)) / temperature
+            return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
+
+        def fn(params, states, prompt, rng_key):
+            batch = prompt.shape[0]
+            carries = {vd.name: vd.obj.init_carry(batch, dtype)
+                       for vd in net.conf.layer_vertices()
+                       if isinstance(vd.obj, BaseRecurrentLayer)}
+            acts, _, _, carries = net._forward_all(
+                params, states, {inp: prompt}, train=False, rng=None,
+                carries=carries)
+            keys = jax.random.split(rng_key, n_new_tokens)
+            tok0 = sample(acts[out_name][:, -1], keys[0])
+
+            def step(carry, k):
+                carries, tok = carry
+                x = tok[:, None, None].astype(dtype)
+                acts, _, _, carries = net._forward_all(
+                    params, states, {inp: x}, train=False, rng=None,
+                    carries=carries)
+                nxt = sample(acts[out_name][:, -1], k)
+                return (carries, nxt), nxt
+
+            _, toks = jax.lax.scan(step, (carries, tok0), keys[1:])
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        net._jit_cache[key] = jax.jit(fn)
+    toks = net._jit_cache[key](net.params, net.states,
+                               jnp.asarray(ids, jnp.float32),
+                               jax.random.PRNGKey(seed))
+    return np.asarray(toks).astype(np.int64)
+
+
+def _prep_prompt(net, prompt_ids, n_new_tokens: int):
+    """Shared generate prologue: normalize the prompt to [N,T], early-out
+    for n_new_tokens<=0, and reject sequences the decode caches cannot hold.
+    Returns (ids, empty_result_or_None)."""
+    import numpy as np
+
+    ids = np.asarray(prompt_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    if n_new_tokens <= 0:
+        return ids, np.zeros((ids.shape[0], 0), np.int64)
+    cap = _kv_capacity(net)
+    total = ids.shape[1] + n_new_tokens - 1  # last token is never fed back
+    if cap is not None and total > cap:
+        raise ValueError(
+            f"prompt ({ids.shape[1]}) + {n_new_tokens} new tokens needs "
+            f"{total} cache slots but the model holds {cap} "
+            f"(max_length/max_cache)")
+    return ids, None
 
 
 def _kv_capacity(net):

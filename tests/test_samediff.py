@@ -393,3 +393,12 @@ class TestControlFlow:
                             lambda s, i: s.math.lt(i, lim),
                             lambda s, i: [i + 1.0])
         assert sd.output({}, fin[0].name)[fin[0].name] == 5.0
+
+    def test_rename_passthrough_capture_updates_control_attrs(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=())
+        c = sd.constant("c", np.float32(7.0))
+        sd.if_cond(sd.math.gt(x, 0.0), lambda s: x * 2.0, lambda s: c,
+                   name="o")
+        sd.rename("c", "c2")
+        assert sd.output({"x": np.float32(-1.0)}, "o")["o"] == 7.0

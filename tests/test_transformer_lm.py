@@ -163,6 +163,26 @@ class TestGenerate:
         assert gen.shape == (1, 4)
         assert ((gen >= 0) & (gen < VOCAB)).all()
 
+    def test_device_loop_matches_host_greedy(self):
+        # the single-dispatch lax.scan decode must equal the host loop
+        # token for token under greedy sampling
+        from deeplearning4j_tpu.zoo.models import generate_on_device
+        net = tiny_lm()
+        prompt = np.array([[1, 2, 3, 4], [5, 6, 7, 8]])
+        host = generate(net, prompt, 8)
+        dev = generate_on_device(net, prompt, 8)
+        assert (host == dev).all()
+
+    def test_device_loop_sampling_and_edges(self):
+        from deeplearning4j_tpu.zoo.models import generate_on_device
+        net = tiny_lm()
+        prompt = np.array([[1, 2, 3]])
+        s = generate_on_device(net, prompt, 5, temperature=1.0, seed=3)
+        assert s.shape == (1, 5) and ((s >= 0) & (s < VOCAB)).all()
+        assert generate_on_device(net, prompt, 0).shape == (1, 0)
+        with np.testing.assert_raises(ValueError):
+            generate_on_device(net, np.ones((1, 10)), 10)  # > capacity
+
     def test_selector_has_transformer_lm(self):
         from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
         assert "transformerlm" in ModelSelector.available()
@@ -192,3 +212,14 @@ class TestTBPTTCapacity:
         y[..., 0] = 1
         with np.testing.assert_raises(ValueError):
             net.fit(x, y)
+
+    def test_device_loop_temperature_not_cached_across_values(self):
+        # each temperature must compile its own sampler (the value is baked
+        # into the closure, so it must be part of the cache key)
+        from deeplearning4j_tpu.zoo.models import generate_on_device
+        net = tiny_lm()
+        prompt = np.array([[1, 2, 3]])
+        generate_on_device(net, prompt, 4, temperature=0.5, seed=1)
+        generate_on_device(net, prompt, 4, temperature=2.0, seed=1)
+        keys = [k for k in net._jit_cache if k and k[0] == "generate"]
+        assert len(set(keys)) == 2
