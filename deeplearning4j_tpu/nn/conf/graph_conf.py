@@ -14,7 +14,7 @@ import json
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
-from deeplearning4j_tpu.nn.conf.network import GlobalConf
+from deeplearning4j_tpu.nn.conf.network import GlobalConf, normalize_backprop_type
 from deeplearning4j_tpu.nn.layers.base import Layer, layer_from_dict
 from deeplearning4j_tpu.nn.updaters import Updater
 from deeplearning4j_tpu.nn.vertices import GraphVertex
@@ -73,7 +73,7 @@ class GraphBuilder:
         return self
 
     def backprop_type(self, t: str) -> "GraphBuilder":
-        self._backprop_type = t.lower()
+        self._backprop_type = normalize_backprop_type(t)
         return self
 
     def t_bptt_length(self, fwd: int, bwd: Optional[int] = None) -> "GraphBuilder":
@@ -112,6 +112,9 @@ class ComputationGraphConfiguration:
     preprocessors: Dict[str, object] = dataclasses.field(default_factory=dict)
     vertex_input_types: Dict[str, List[InputType]] = dataclasses.field(default_factory=dict)
     _finalized: bool = False
+
+    def __post_init__(self):
+        self.backprop_type = normalize_backprop_type(self.backprop_type)
 
     # ------------------------------------------------------------- finalize
     def finalize(self) -> None:

@@ -189,7 +189,7 @@ class ListBuilder:
         return self
 
     def backprop_type(self, t: str) -> "ListBuilder":
-        self._backprop_type = t.lower()
+        self._backprop_type = normalize_backprop_type(t)
         return self
 
     def t_bptt_length(self, fwd: int, bwd: Optional[int] = None) -> "ListBuilder":
@@ -211,6 +211,14 @@ class ListBuilder:
         return conf
 
 
+def normalize_backprop_type(t: str) -> str:
+    """One spelling for every entry point (builder, from_dict, direct
+    assignment): DL4J's ``BackpropType.TruncatedBPTT`` and shorthands all
+    mean the truncated dispatch."""
+    t = (t or "standard").lower()
+    return "truncated_bptt" if t in ("tbptt", "truncatedbptt") else t
+
+
 @dataclasses.dataclass
 class MultiLayerConfiguration:
     global_conf: GlobalConf
@@ -223,6 +231,9 @@ class MultiLayerConfiguration:
     preprocessors: dict = dataclasses.field(default_factory=dict)  # idx -> fn
     layer_input_types: List[InputType] = dataclasses.field(default_factory=list)
     _finalized: bool = False
+
+    def __post_init__(self):
+        self.backprop_type = normalize_backprop_type(self.backprop_type)
 
     def finalize(self) -> None:
         """Propagate global defaults and infer shapes (DL4J's config build +

@@ -22,7 +22,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
-from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer, check_carry_capacity
 from deeplearning4j_tpu.nn.updaters import Sgd, Updater, normalize_gradients
 
 Array = jax.Array
@@ -195,9 +195,10 @@ class ComputationGraph:
 
     def _loss_fn(self, params: Params, states: States,
                  inputs: Dict[str, Array], labels: Sequence[Array],
-                 rng, masks, label_masks, train: bool):
-        acts, new_states, out_masks, _ = self._forward_all(
-            params, states, inputs, train=train, rng=rng, masks=masks)
+                 rng, masks, label_masks, train: bool, carries=None):
+        acts, new_states, out_masks, new_carries = self._forward_all(
+            params, states, inputs, train=train, rng=rng, masks=masks,
+            carries=carries)
         loss = jnp.asarray(0.0, jnp.float32)
         for oi, out_name in enumerate(self.conf.outputs):
             vd = self.conf.vertices[out_name]
@@ -215,7 +216,7 @@ class ComputationGraph:
                 lm = acts.get(out_name + ":mask")
             loss = loss + layer.compute_loss(params[out_name], h, labels[oi], mask=lm)
         loss = loss + self._regularization(params)
-        return loss, new_states
+        return loss, (new_states, new_carries)
 
     # ------------------------------------------------------------ train step
     def _apply_updates(self, params, grads, upd_states, it, ep):
@@ -243,20 +244,22 @@ class ComputationGraph:
         from deeplearning4j_tpu.nn import helpers as _helpers
         _helpers.evict_stale_jit_entries(self._jit_cache, current_version)
 
-    def _get_train_step(self):
+    def _get_train_step(self, with_carries: bool = False):
         from deeplearning4j_tpu.nn import helpers as _helpers
-        key = ("train", _helpers.version())
+        key = ("train", with_carries, _helpers.version())
         if key not in self._jit_cache:
             self._evict_stale(_helpers.version())
 
             def step(params, states, upd_states, it, ep, inputs, labels,
-                     masks, label_masks, rng):
+                     masks, label_masks, rng, carries=None):
                 def lf(p):
                     return self._loss_fn(p, states, inputs, labels, rng,
-                                         masks, label_masks, train=True)
-                (loss, new_states), grads = jax.value_and_grad(lf, has_aux=True)(params)
+                                         masks, label_masks, train=True,
+                                         carries=carries)
+                (loss, (new_states, new_carries)), grads = \
+                    jax.value_and_grad(lf, has_aux=True)(params)
                 new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
-                return new_params, new_states, new_upd, loss
+                return new_params, new_states, new_upd, loss, new_carries
 
             self._jit_cache[key] = jax.jit(step, donate_argnums=(0, 1, 2))
         return self._jit_cache[key]
@@ -312,16 +315,87 @@ class ComputationGraph:
         if mds.labels_masks is not None:
             lmasks = [None if m is None else _as_jnp(m) for m in mds.labels_masks]
 
+        from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
+        if normalize_backprop_type(self.conf.backprop_type) == "truncated_bptt":
+            t_total = self._temporal_length(inputs)
+            if t_total is not None:
+                self._fit_tbptt(inputs, labels, masks, lmasks, t_total)
+                return
+
         step = self._get_train_step()
         rng = self._next_rng()
         it = jnp.asarray(self.iteration, jnp.float32)
         ep = jnp.asarray(self.epoch, jnp.float32)
-        self.params, self.states, self.updater_states, loss = step(
+        self.params, self.states, self.updater_states, loss, _ = step(
             self.params, self.states, self.updater_states, it, ep,
             inputs, labels, masks, lmasks, rng)
         self._score_arr = loss
         self.last_batch_size = int(next(iter(inputs.values())).shape[0])
         self.iteration += 1
+        for listener in self.listeners:
+            if hasattr(listener, "iteration_done"):
+                listener.iteration_done(self, self.iteration, self.epoch)
+
+    def _temporal_inputs(self, inputs) -> set:
+        """Input names carrying a time axis: decided by the declared
+        InputTypes when present (rnn / image-sequence), else by rank."""
+        kinds = ("rnn", "cnn_seq")
+        if (self.conf.input_types
+                and len(self.conf.input_types) == len(self.conf.inputs)
+                and all(t is not None for t in self.conf.input_types)):
+            return {n for n, t in zip(self.conf.inputs, self.conf.input_types)
+                    if t.kind in kinds}
+        return {n for n, a in inputs.items() if a.ndim == 3}
+
+    def _temporal_length(self, inputs):
+        ts = {inputs[n].shape[1] for n in self._temporal_inputs(inputs)}
+        if len(ts) > 1:
+            raise ValueError(f"temporal inputs disagree on sequence length: {ts}")
+        return ts.pop() if ts else None
+
+    def _fit_tbptt(self, inputs, labels, masks, lmasks, t_total) -> None:
+        """Truncated BPTT over the DAG (ComputationGraph's TBPTT dispatch in
+        the reference fit loop): slice the declared-temporal inputs (and
+        per-timestep labels/masks) into tbptt_fwd_length chunks, carrying
+        recurrent state (KV caches, positional offsets, LSTM carries)
+        between the jitted chunk steps. Per-sequence (2D) labels are fed
+        whole to every chunk, as in the sequential-network TBPTT."""
+        check_carry_capacity(
+            ((vd.name, vd.obj) for vd in self.conf.layer_vertices()),
+            t_total, "TBPTT")
+        temporal = self._temporal_inputs(inputs)
+        length = self.conf.tbptt_fwd_length
+        n_chunks = max(1, math.ceil(t_total / length))
+        batch = next(iter(inputs.values())).shape[0]
+        self.last_batch_size = int(batch)
+        dtype = self.conf.global_conf.jnp_dtype()
+        carries = {vd.name: vd.obj.init_carry(batch, dtype)
+                   for vd in self.conf.layer_vertices()
+                   if isinstance(vd.obj, BaseRecurrentLayer)}
+
+        step = self._get_train_step(True)
+        for c in range(n_chunks):
+            s, e = c * length, min((c + 1) * length, t_total)
+            ic = {n: (a[:, s:e] if n in temporal else a)
+                  for n, a in inputs.items()}
+            lc = [a[:, s:e] if a.ndim == 3 and a.shape[1] == t_total else a
+                  for a in labels]
+            mc = None if masks is None else {
+                n: (a[:, s:e] if a is not None and n in temporal
+                    and a.shape[1] == t_total else a)
+                for n, a in masks.items()}
+            lmc = None if lmasks is None else [
+                a[:, s:e] if a is not None and labels[i].ndim == 3
+                and a.shape[1] == t_total else a
+                for i, a in enumerate(lmasks)]
+            rng = self._next_rng()
+            it = jnp.asarray(self.iteration, jnp.float32)
+            ep = jnp.asarray(self.epoch, jnp.float32)
+            self.params, self.states, self.updater_states, loss, carries = \
+                step(self.params, self.states, self.updater_states, it, ep,
+                     ic, lc, mc, lmc, rng, carries)
+            self._score_arr = loss
+            self.iteration += 1
         for listener in self.listeners:
             if hasattr(listener, "iteration_done"):
                 listener.iteration_done(self, self.iteration, self.epoch)
@@ -439,15 +513,10 @@ class ComputationGraph:
         # finite carries (KV caches, positional offsets) cannot raise inside
         # the jitted step — enforce capacity host-side
         t_new = xs[0].shape[1]
-        for vd in self.conf.layer_vertices():
-            if isinstance(vd.obj, BaseRecurrentLayer):
-                cap = vd.obj.carry_capacity()
-                if cap is not None and self._rnn_pos + t_new > cap:
-                    raise ValueError(
-                        f"rnn_time_step at position {self._rnn_pos}+{t_new} "
-                        f"exceeds {vd.name} carry capacity {cap}; "
-                        f"rnn_clear_previous_state() or raise max_cache/"
-                        f"max_len")
+        check_carry_capacity(
+            ((vd.name, vd.obj) for vd in self.conf.layer_vertices()),
+            self._rnn_pos + t_new,
+            f"rnn_time_step at position {self._rnn_pos}+{t_new}")
         inputs = dict(zip(self.conf.inputs, xs))
         outs, self._rnn_carries = self._rnn_step_fn()(
             self.params, self.states, inputs, self._rnn_carries)

@@ -32,6 +32,20 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 
 
+def check_carry_capacity(named_layers, t_total: int, context: str) -> None:
+    """Reject sequences longer than any finite carry BEFORE a jitted step
+    silently clamps a dynamic_update_slice write. One implementation for all
+    host-side loops (TBPTT fit, stateful rnn_time_step, generate)."""
+    for label, layer in named_layers:
+        if isinstance(layer, BaseRecurrentLayer):
+            cap = layer.carry_capacity()
+            if cap is not None and t_total > cap:
+                raise ValueError(
+                    f"{context}: sequence length {t_total} exceeds {label} "
+                    f"carry capacity {cap}; raise max_cache/max_len, "
+                    f"shorten the sequence, or rnn_clear_previous_state()")
+
+
 class BaseRecurrentLayer(Layer):
     """Mixin API for layers that carry recurrent state."""
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers.base import Layer
-from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer, check_carry_capacity
 from deeplearning4j_tpu.nn.updaters import (
     Sgd,
     Updater,
@@ -275,7 +275,9 @@ class MultiLayerNetwork:
         mask = None if ds.features_mask is None else _as_jnp(ds.features_mask)
         lmask = None if ds.labels_mask is None else _as_jnp(ds.labels_mask)
 
-        if self.conf.backprop_type == "truncated_bptt" and x.ndim == 3:
+        from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
+        if (normalize_backprop_type(self.conf.backprop_type) == "truncated_bptt"
+                and x.ndim == 3):
             self._fit_tbptt(x, y, mask, lmask)
             return
 
@@ -300,14 +302,9 @@ class MultiLayerNetwork:
         t_total = x.shape[1]
         # the chunk steps are jitted, where a finite carry (KV cache,
         # positional offset) cannot raise on overflow — reject here instead
-        for i, l in enumerate(self.layers):
-            if isinstance(l, BaseRecurrentLayer):
-                cap = l.carry_capacity()
-                if cap is not None and t_total > cap:
-                    raise ValueError(
-                        f"TBPTT sequence length {t_total} exceeds layer {i} "
-                        f"({type(l).__name__}) carry capacity {cap}; raise "
-                        f"max_cache/max_len or shorten the sequence")
+        check_carry_capacity(
+            ((f"layer {i} ({type(l).__name__})", l)
+             for i, l in enumerate(self.layers)), t_total, "TBPTT")
         length = self.conf.tbptt_fwd_length
         n_chunks = max(1, math.ceil(t_total / length))
         batch = x.shape[0]
@@ -437,15 +434,10 @@ class MultiLayerNetwork:
                 for l in self.layers]
         # host-side capacity guard: finite carries cannot raise under jit
         t_new = x.shape[1]
-        for i, l in enumerate(self.layers):
-            if isinstance(l, BaseRecurrentLayer):
-                cap = l.carry_capacity()
-                if cap is not None and self._rnn_pos + t_new > cap:
-                    raise ValueError(
-                        f"rnn_time_step at position {self._rnn_pos}+{t_new} "
-                        f"exceeds layer {i} carry capacity {cap}; "
-                        f"rnn_clear_previous_state() or raise max_cache/"
-                        f"max_len")
+        check_carry_capacity(
+            ((f"layer {i}", l) for i, l in enumerate(self.layers)),
+            self._rnn_pos + t_new,
+            f"rnn_time_step at position {self._rnn_pos}+{t_new}")
         h, self._rnn_carries = self._rnn_step_fn()(
             self.params, self.states, x, self._rnn_carries)
         self._rnn_pos += t_new

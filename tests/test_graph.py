@@ -306,3 +306,148 @@ class TestCrossAttentionGraph:
                                np.asarray(y_plain)[0, :3])
         np.testing.assert_allclose(np.asarray(y_masked)[1],
                                    np.asarray(y_plain)[1], rtol=1e-5, atol=1e-6)
+
+
+class TestGraphTBPTT:
+    """Truncated BPTT on the DAG (the reference dispatches TBPTT inside
+    ComputationGraph.fit the same way MultiLayerNetwork does)."""
+
+    def _lstm_graph(self, tbptt=None):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        g = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+             .graph_builder().add_inputs("in")
+             .set_input_types(InputType.recurrent(3, 12)))
+        g.add_layer("lstm", LSTMLayer(n_out=8), "in")
+        g.add_layer("out", RnnOutputLayer(n_out=2, loss="mcxent",
+                                          activation="softmax"), "lstm")
+        g.set_outputs("out")
+        if tbptt:
+            g.t_bptt_length(tbptt)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        return ComputationGraph(g.build()).init()
+
+    def test_single_chunk_tbptt_equals_standard_step(self):
+        import numpy as np
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 12, 3)).astype(np.float32)
+        y = np.zeros((4, 12, 2), np.float32)
+        y[..., 0] = 1
+        a = self._lstm_graph()               # standard BPTT
+        b = self._lstm_graph(tbptt=12)       # one chunk spanning the sequence
+        a.fit(x, y)
+        b.fit(x, y)
+        for name in a.params:
+            for k in a.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(a.params[name][k]),
+                    np.asarray(b.params[name][k]), atol=1e-6,
+                    err_msg=f"{name}/{k}")
+
+    def test_chunked_tbptt_trains_and_counts_iterations(self):
+        import numpy as np
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 12, 3)).astype(np.float32)
+        cls = (x.mean(axis=2) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        net = self._lstm_graph(tbptt=4)      # 3 chunks per batch
+        s0 = net.score(DataSet(x, y))
+        for _ in range(30):
+            net.fit(x, y)
+        assert net.iteration == 30 * 3       # one iteration per chunk
+        assert float(net.score_) < s0
+
+    def test_backprop_type_aliases_normalize(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+
+        g = (NeuralNetConfiguration.builder().graph_builder()
+             .add_inputs("in").set_input_types(InputType.recurrent(3, 8))
+             .backprop_type("TBPTT"))
+        g.add_layer("l", LSTMLayer(n_out=4), "in")
+        g.add_layer("o", RnnOutputLayer(n_out=2, loss="mcxent",
+                                        activation="softmax"), "l")
+        g.set_outputs("o")
+        assert g.build().backprop_type == "truncated_bptt"
+
+        lb = (NeuralNetConfiguration.builder().list()
+              .layer(LSTMLayer(n_in=3, n_out=4))
+              .layer(RnnOutputLayer(n_out=2, loss="mcxent",
+                                    activation="softmax"))
+              .backprop_type("TruncatedBPTT")
+              .set_input_type(InputType.recurrent(3, 8)))
+        assert lb.build().backprop_type == "truncated_bptt"
+
+    def test_transformer_lm_tbptt_chunks(self):
+        # causal attention + positional offsets carry across graph TBPTT
+        # chunks (transformer-XL-style): must run and train
+        import numpy as np
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.zoo.models import TransformerLM, lm_labels
+
+        m = TransformerLM(vocab_size=11, max_length=16, n_layers=1,
+                          d_model=16, n_heads=2, d_ff=32, seed=3)
+        conf = m.conf()
+        conf.backprop_type = "truncated_bptt"
+        conf.tbptt_fwd_length = 8
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = ((rng.integers(0, 11, (8, 1)) + np.arange(16)[None, :]) % 11
+             ).astype(np.float32)
+        y = lm_labels(x, 11)
+        for _ in range(3):
+            net.fit(x, y)
+        assert np.isfinite(float(net.score_))
+        assert net.iteration == 3 * 2  # two chunks per batch
+
+    def test_tbptt_with_2d_sequence_labels(self):
+        # per-sequence (2D) labels must still dispatch TBPTT (the temporal
+        # input decides, not the label rank) and train each chunk on the
+        # same label, like the sequential network does
+        import numpy as np
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 12, 3)).astype(np.float32)
+        cls = (x.mean(axis=(1, 2)) > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[cls]          # [N, 2] — no time axis
+
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import (
+            GlobalPoolingLayer, LSTMLayer, OutputLayer)
+
+        g = (NeuralNetConfiguration.builder().seed(5).graph_builder()
+             .add_inputs("in").set_input_types(InputType.recurrent(3, 12))
+             .t_bptt_length(4))
+        g.add_layer("lstm", LSTMLayer(n_out=8), "in")
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "lstm")
+        g.add_layer("out", OutputLayer(n_out=2, loss="mcxent",
+                                       activation="softmax"), "pool")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        net.fit(x, y)
+        assert net.iteration == 3  # 12/4 chunks — TBPTT DID dispatch
+        assert np.isfinite(float(net.score_))
+
+    def test_normalization_covers_from_dict(self):
+        from deeplearning4j_tpu.nn.conf.network import (
+            MultiLayerConfiguration, normalize_backprop_type)
+        assert normalize_backprop_type("TBPTT") == "truncated_bptt"
+        assert normalize_backprop_type("TruncatedBPTT") == "truncated_bptt"
+        assert normalize_backprop_type("standard") == "standard"
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        conf = (NeuralNetConfiguration.builder().list()
+                .layer(DenseLayer(n_in=4, n_out=4))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        d = conf.to_dict()
+        d["backprop_type"] = "TruncatedBPTT"   # DL4J-dialect spelling
+        conf2 = MultiLayerConfiguration.from_dict(d)
+        assert conf2.backprop_type == "truncated_bptt"
