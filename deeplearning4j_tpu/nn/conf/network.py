@@ -214,9 +214,16 @@ class ListBuilder:
 def normalize_backprop_type(t: str) -> str:
     """One spelling for every entry point (builder, from_dict, direct
     assignment): DL4J's ``BackpropType.TruncatedBPTT`` and shorthands all
-    mean the truncated dispatch."""
+    mean the truncated dispatch. Unknown spellings raise — a silently
+    unrecognized value would train with the wrong regime."""
     t = (t or "standard").lower()
-    return "truncated_bptt" if t in ("tbptt", "truncatedbptt") else t
+    if t in ("tbptt", "truncatedbptt", "truncated_bptt"):
+        return "truncated_bptt"
+    if t != "standard":
+        raise ValueError(
+            f"unknown backprop_type {t!r}; expected 'standard' or "
+            f"'truncated_bptt' (aliases: TBPTT, TruncatedBPTT)")
+    return t
 
 
 @dataclasses.dataclass

@@ -38,3 +38,10 @@ from deeplearning4j_tpu.parallel.master import (  # noqa: F401
     TrainingStats,
     init_distributed,
 )
+from deeplearning4j_tpu.parallel.time_source import (  # noqa: F401
+    NTPTimeSource,
+    SystemClockTimeSource,
+    TimeSource,
+    get_time_source,
+    set_time_source,
+)

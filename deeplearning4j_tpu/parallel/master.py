@@ -50,13 +50,25 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
 
 class TrainingStats:
-    """Per-phase wall-clock timings (`CommonSparkTrainingStats.java`)."""
+    """Per-phase wall-clock timings (`CommonSparkTrainingStats.java`), with
+    each event also stamped by the process-wide TimeSource — plug in
+    :class:`~deeplearning4j_tpu.parallel.time_source.NTPTimeSource` and
+    events from different hosts line up on one timeline (the reference's
+    NTP-corrected `BaseEventStats` timestamps)."""
 
-    def __init__(self):
+    def __init__(self, time_source=None):
         self.phase_times: dict = {}
+        self.events: list = []  # (phase, start_millis, duration_millis)
+        self._ts = time_source  # None → resolve per add(), so a
+        # set_time_source() AFTER the master was built still takes effect
 
     def add(self, phase: str, seconds: float) -> None:
+        from deeplearning4j_tpu.parallel.time_source import get_time_source
         self.phase_times.setdefault(phase, []).append(seconds)
+        ts = self._ts if self._ts is not None else get_time_source()
+        end_ms = ts.current_time_millis()
+        self.events.append((phase, int(end_ms - seconds * 1000),
+                            int(seconds * 1000)))
 
     def total(self, phase: str) -> float:
         return sum(self.phase_times.get(phase, []))

@@ -1,0 +1,122 @@
+"""Clock sources for multi-host phase timing.
+
+Reference: ``dl4j-spark/.../time/NTPTimeSource.java`` (and the ``TimeSource``
+SPI next to it) — Spark phase timings are stamped with an NTP-corrected
+clock so events from different hosts line up on one timeline, with a
+system-clock fallback when NTP is unreachable.
+
+TPU-native framing is unchanged: multi-host jobs still need comparable
+timestamps for the exported timeline (``ui/modules.py`` timeline export,
+``parallel/master.py`` TrainingStats). The implementation speaks plain
+SNTP (RFC 4330 client mode) over UDP so it needs no dependencies, caches
+the measured offset for ``update_frequency`` seconds, and degrades to the
+system clock on any failure — the reference's fallback behavior.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Optional
+
+# seconds between the NTP epoch (1900) and the Unix epoch (1970)
+_NTP_DELTA = 2208988800
+
+
+class TimeSource:
+    """SPI: a clock returning milliseconds since the Unix epoch."""
+
+    def current_time_millis(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SystemClockTimeSource(TimeSource):
+    """The local clock (``SystemClockTimeSource`` in the reference)."""
+
+    def current_time_millis(self) -> int:
+        return int(time.time() * 1000)
+
+
+class NTPTimeSource(TimeSource):
+    """System clock corrected by an SNTP-measured offset.
+
+    One UDP round trip per ``update_frequency`` window: offset =
+    ((t1 - t0) + (t2 - t3)) / 2 from the classic four-timestamp exchange,
+    where t0/t3 are local send/receive and t1/t2 the server receive/send.
+    On any socket failure the last good offset is kept (0 before the first
+    success — i.e. plain system time, the reference's fallback).
+    """
+
+    def __init__(self, server: str = "pool.ntp.org", port: int = 123,
+                 timeout: float = 2.0, update_frequency: float = 1800.0):
+        self.server = server
+        self.port = port
+        self.timeout = timeout
+        self.update_frequency = update_frequency
+        self._offset_ms = 0.0
+        self._last_sync: Optional[float] = None
+        self.last_error: Optional[str] = None
+
+    # ------------------------------------------------------------ protocol
+    def _query_offset_ms(self) -> float:
+        """One SNTP exchange; returns offset in ms (raises on failure)."""
+        packet = bytearray(48)
+        packet[0] = 0x1B  # LI=0, VN=3, Mode=3 (client)
+        t0 = time.time()
+        struct.pack_into(">I", packet, 40, int(t0 + _NTP_DELTA))
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.settimeout(self.timeout)
+            s.sendto(bytes(packet), (self.server, self.port))
+            data, _ = s.recvfrom(48)
+        t3 = time.time()
+        if len(data) < 48:
+            raise ValueError(f"short NTP response ({len(data)} bytes)")
+
+        def ts(off):
+            sec, frac = struct.unpack(">II", data[off:off + 8])
+            return sec - _NTP_DELTA + frac / 2 ** 32
+
+        t1 = ts(32)  # server receive
+        t2 = ts(40)  # server transmit
+        return (((t1 - t0) + (t2 - t3)) / 2.0) * 1000.0
+
+    def sync(self) -> bool:
+        """Force a sync now; True on success (offset updated)."""
+        try:
+            self._offset_ms = self._query_offset_ms()
+            self._last_sync = time.time()
+            self.last_error = None
+            return True
+        except OSError as e:  # timeout, unreachable, resolution failure
+            self.last_error = f"{type(e).__name__}: {e}"
+            self._last_sync = time.time()  # back off until next window
+            return False
+        except ValueError as e:
+            self.last_error = str(e)
+            self._last_sync = time.time()
+            return False
+
+    @property
+    def offset_millis(self) -> float:
+        return self._offset_ms
+
+    def current_time_millis(self) -> int:
+        now = time.time()
+        if (self._last_sync is None
+                or now - self._last_sync > self.update_frequency):
+            self.sync()
+        return int(now * 1000 + self._offset_ms)
+
+
+_DEFAULT: TimeSource = SystemClockTimeSource()
+
+
+def get_time_source() -> TimeSource:
+    """Process-wide clock used for phase stamps (``TimeSourceProvider``)."""
+    return _DEFAULT
+
+
+def set_time_source(ts: TimeSource) -> None:
+    global _DEFAULT
+    _DEFAULT = ts

@@ -27,6 +27,7 @@ from deeplearning4j_tpu.zoo.models import (
     VGG16,
     VGG19,
     YOLO2,
+    beam_search,
     generate,
     generate_on_device,
     lm_labels,
@@ -38,5 +39,6 @@ __all__ = [
     "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TinyYOLO", "TransformerEncoder", "TransformerLM",
-    "VGG16", "VGG19", "YOLO2", "generate", "generate_on_device", "lm_labels",
+    "VGG16", "VGG19", "YOLO2", "beam_search", "generate",
+    "generate_on_device", "lm_labels",
 ]

@@ -821,6 +821,115 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
     return np.asarray(toks).astype(np.int64)
 
 
+def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
+                eos_id: int = None):
+    """Device-side beam search over a :class:`TransformerLM`-style network:
+    the beams ride the batch axis (N*beam KV caches), each `lax.scan` step
+    scores beam*vocab continuations, takes the top-k, and RE-INDEXES every
+    per-beam carry (KV caches included) with one gather — the whole search
+    is a single compiled dispatch, like :func:`generate_on_device`.
+
+    With ``eos_id``, finished beams only extend with ``eos_id`` at zero
+    cost (score frozen). Returns ``(tokens [N, n_new_tokens], scores [N])``
+    for the best beam per batch row; log-probability scores.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ids, empty = _prep_prompt(net, prompt_ids, n_new_tokens)
+    if empty is not None:
+        return empty, np.zeros((ids.shape[0],), np.float32)
+    n_batch, b = ids.shape[0], int(beam_size)
+
+    from deeplearning4j_tpu.nn import helpers as _helpers
+    from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+
+    inp = net.conf.inputs[0]
+    out_name = net.conf.outputs[0]
+    key = ("beam", n_new_tokens, b, eos_id, _helpers.version())
+    if key not in net._jit_cache:
+        net._evict_stale(_helpers.version())
+        dtype = net.conf.global_conf.jnp_dtype()
+
+        def gather_beams(carries, flat_idx, nb):
+            # reindex batch-leading carry leaves; scalars (positions) pass
+            return jax.tree_util.tree_map(
+                lambda a: a[flat_idx] if (hasattr(a, "ndim") and a.ndim >= 1
+                                          and a.shape[0] == nb) else a,
+                carries)
+
+        def select(scores, finished, logp, n, v):
+            """Top-b continuations over beam*vocab."""
+            if eos_id is not None:
+                cont = jnp.full((v,), -1e30).at[eos_id].set(0.0)
+                logp = jnp.where(finished[..., None], cont, logp)
+            total = scores[..., None] + logp            # [N, B, V]
+            new_scores, flat = jax.lax.top_k(total.reshape(n, b * v), b)
+            beam_idx = flat // v                         # [N, B]
+            tok = (flat % v).astype(jnp.int32)
+            return new_scores, beam_idx, tok
+
+        def fn(params, states, prompt):
+            n, t0 = prompt.shape
+            nb = n * b
+            # prefill ONCE per batch row; beams split only after the prompt
+            carries = {vd.name: vd.obj.init_carry(n, dtype)
+                       for vd in net.conf.layer_vertices()
+                       if isinstance(vd.obj, BaseRecurrentLayer)}
+            acts, _, _, carries = net._forward_all(
+                params, states, {inp: prompt}, train=False, rng=None,
+                carries=carries)
+            logp = jnp.log(jnp.maximum(acts[out_name][:, -1], 1e-20))
+            v = logp.shape[-1]
+            # replicate the prompt's caches across the beam axis
+            carries = jax.tree_util.tree_map(
+                lambda a: jnp.repeat(a, b, axis=0)
+                if (hasattr(a, "ndim") and a.ndim >= 1 and a.shape[0] == n)
+                else a, carries)
+            # first selection: top-b distinct tokens straight from the
+            # prompt distribution (all beams would be identical anyway)
+            scores, tok = jax.lax.top_k(logp.astype(jnp.float32), b)
+            tok = tok.astype(jnp.int32)                  # [N, B]
+            finished = (tok == eos_id) if eos_id is not None \
+                else jnp.zeros((n, b), bool)
+            row = jnp.arange(n)[:, None] * b
+            toks = jnp.zeros((n, b, n_new_tokens), jnp.int32)
+            toks = toks.at[:, :, 0].set(tok)
+
+            def step(carry, i):
+                carries, toks, scores, finished, last = carry
+                x = last.reshape(nb)[:, None, None].astype(dtype)
+                acts, _, _, carries = net._forward_all(
+                    params, states, {inp: x}, train=False, rng=None,
+                    carries=carries)
+                logp = jnp.log(jnp.maximum(acts[out_name][:, -1], 1e-20))
+                logp = logp.reshape(n, b, v).astype(jnp.float32)
+                scores, beam_idx, tok = select(scores, finished, logp, n, v)
+                flat_idx = (row + beam_idx).reshape(-1)
+                carries = gather_beams(carries, flat_idx, nb)
+                toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
+                finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+                toks = jax.lax.dynamic_update_index_in_dim(
+                    toks, tok, i, axis=2)
+                if eos_id is not None:
+                    finished = finished | (tok == eos_id)
+                return (carries, toks, scores, finished, tok), None
+
+            (carries, toks, scores, finished, _), _ = jax.lax.scan(
+                step, (carries, toks, scores, finished, tok),
+                jnp.arange(1, n_new_tokens))
+            best = jnp.argmax(scores, axis=1)
+            return (jnp.take_along_axis(
+                        toks, best[:, None, None], axis=1)[:, 0],
+                    jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
+
+        net._jit_cache[key] = jax.jit(fn)
+    toks, scores = net._jit_cache[key](net.params, net.states,
+                                       jnp.asarray(ids, jnp.float32))
+    return np.asarray(toks).astype(np.int64), np.asarray(scores)
+
+
 def _prep_prompt(net, prompt_ids, n_new_tokens: int):
     """Shared generate prologue: normalize the prompt to [N,T], early-out
     for n_new_tokens<=0, and reject sequences the decode caches cannot hold.

@@ -174,3 +174,63 @@ class TestEarlyStoppingParallel:
         assert ev.accuracy() > 0.8
         # the user's model was never mutated (no instance-attribute fit)
         assert "fit" not in net.__dict__
+
+
+class TestTimeSource:
+    """NTP-corrected clock (dl4j-spark time/NTPTimeSource.java parity):
+    SNTP protocol against a local fake server; system-clock fallback."""
+
+    def _fake_ntp_server(self, offset_s):
+        import socket, struct, threading, time
+        _DELTA = 2208988800
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+
+        def serve():
+            data, addr = sock.recvfrom(48)
+            now = time.time() + offset_s       # server clock runs ahead
+            resp = bytearray(48)
+            resp[0] = 0x1C                     # LI=0 VN=3 Mode=4 (server)
+            for off in (32, 40):               # receive + transmit stamps
+                sec = int(now + _DELTA)
+                frac = int(((now + _DELTA) % 1) * 2 ** 32)
+                struct.pack_into(">II", resp, off, sec, frac)
+            sock.sendto(bytes(resp), addr)
+            sock.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+        return port
+
+    def test_offset_measured_from_fake_server(self):
+        from deeplearning4j_tpu.parallel.time_source import NTPTimeSource
+        port = self._fake_ntp_server(offset_s=5.0)
+        ts = NTPTimeSource(server="127.0.0.1", port=port, timeout=3.0)
+        assert ts.sync()
+        assert 4000 < ts.offset_millis < 6000   # ~5 s, minus round trip
+        import time
+        assert abs(ts.current_time_millis()
+                   - (time.time() + 5.0) * 1000) < 1500
+
+    def test_unreachable_server_falls_back_to_system_clock(self):
+        import time
+        from deeplearning4j_tpu.parallel.time_source import NTPTimeSource
+        ts = NTPTimeSource(server="127.0.0.1", port=9, timeout=0.2)
+        assert not ts.sync()
+        assert ts.last_error is not None
+        assert ts.offset_millis == 0.0
+        assert abs(ts.current_time_millis() - time.time() * 1000) < 1500
+
+    def test_training_stats_events_use_time_source(self):
+        from deeplearning4j_tpu.parallel.master import TrainingStats
+        from deeplearning4j_tpu.parallel.time_source import TimeSource
+
+        class Fixed(TimeSource):
+            def current_time_millis(self):
+                return 1_000_000
+
+        st = TrainingStats(time_source=Fixed())
+        st.add("fit", 2.0)
+        phase, start, dur = st.events[0]
+        assert phase == "fit" and dur == 2000 and start == 1_000_000 - 2000
+        assert st.total("fit") == 2.0

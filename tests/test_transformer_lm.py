@@ -223,3 +223,59 @@ class TestTBPTTCapacity:
         generate_on_device(net, prompt, 4, temperature=2.0, seed=1)
         keys = [k for k in net._jit_cache if k and k[0] == "generate"]
         assert len(set(keys)) == 2
+
+
+class TestBeamSearch:
+    """Device-side beam search: beams ride the batch axis, carries are
+    re-indexed per step; one compiled dispatch for the whole search."""
+
+    def _trained(self):
+        net = tiny_lm(seed=3)
+        rng = np.random.default_rng(0)
+        x = cycle_batch(rng, 64, 16)
+        y = lm_labels(x, VOCAB)
+        lmask = np.ones(x.shape[:2], np.float32)
+        lmask[:, -1] = 0.0
+        ds = DataSet(x, y, labels_mask=lmask)
+        for _ in range(150):
+            net.fit(ds)
+        return net
+
+    def test_beam_one_equals_greedy(self):
+        from deeplearning4j_tpu.zoo.models import (beam_search,
+                                                   generate_on_device)
+        net = tiny_lm()
+        prompt = np.array([[1, 2, 3, 4], [5, 6, 7, 8]])
+        greedy = generate_on_device(net, prompt, 6)
+        toks, scores = beam_search(net, prompt, 6, beam_size=1)
+        assert (toks == greedy).all()
+        assert scores.shape == (2,) and np.isfinite(scores).all()
+
+    def test_beam_finds_the_learned_sequence(self):
+        from deeplearning4j_tpu.zoo.models import beam_search
+        net = self._trained()
+        prompt = cycle_batch(np.random.default_rng(1), 2, 6)
+        toks, scores = beam_search(net, prompt, 6, beam_size=4)
+        want = (prompt[:, -1:] + 3 * np.arange(1, 7)[None, :]) % VOCAB
+        assert (toks == want).all(), (toks, want)
+        # wider beam can only match or improve the greedy path's score
+        t1, s1 = beam_search(net, prompt, 6, beam_size=1)
+        assert (scores >= s1 - 1e-5).all()
+
+    def test_eos_freezes_finished_beams(self):
+        from deeplearning4j_tpu.zoo.models import beam_search
+        net = self._trained()
+        prompt = cycle_batch(np.random.default_rng(1), 1, 6)
+        want = (prompt[:, -1:] + 3 * np.arange(1, 7)[None, :]) % VOCAB
+        eos = int(want[0, 1])                 # hit at step 1
+        toks, _ = beam_search(net, prompt, 6, beam_size=3, eos_id=eos)
+        assert toks[0, 1] == eos
+        assert (toks[0, 2:] == eos).all()     # frozen: eos repeats at 0 cost
+
+    def test_capacity_and_empty(self):
+        from deeplearning4j_tpu.zoo.models import beam_search
+        net = tiny_lm()
+        toks, scores = beam_search(net, np.array([[1, 2]]), 0)
+        assert toks.shape == (1, 0)
+        with np.testing.assert_raises(ValueError):
+            beam_search(net, np.ones((1, 10)), 10)
