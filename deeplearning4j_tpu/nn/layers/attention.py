@@ -176,11 +176,14 @@ class CausalSelfAttentionLayer(SelfAttentionLayer, BaseRecurrentLayer):
         qkv = x @ params["Wqkv"] + params["bqkv"]
         qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]
-        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, 0, pos, 0))
-        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, 0, pos, 0))
+        zero = jnp.zeros((), pos.dtype)  # match pos dtype (x64 mode safe)
+        kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
+                                          (zero, zero, pos, zero))
+        vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (zero, zero, pos, zero))
         block_valid = (jnp.ones((n, t)) if mask is None
                        else (mask[:, :t] > 0)).astype(valid.dtype)
-        valid = jax.lax.dynamic_update_slice(valid, block_valid, (0, pos))
+        valid = jax.lax.dynamic_update_slice(valid, block_valid, (zero, pos))
         # query i (absolute position pos+i) may see cache slots <= pos+i that
         # hold valid keys
         causal = jnp.arange(tc)[None, :] <= (pos + jnp.arange(t))[:, None]

@@ -284,6 +284,7 @@ class PositionalEmbeddingLayer(BaseRecurrentLayer):
                 f"position overflow: step at offset {int(carry)}+{t} exceeds "
                 f"max_len={self.max_len}; raise max_len or "
                 f"rnn_clear_previous_state() first")
-        p = jax.lax.dynamic_slice(params["P"], (carry, 0),
+        p = jax.lax.dynamic_slice(params["P"],
+                                  (carry, jnp.zeros((), carry.dtype)),
                                   (t, params["P"].shape[1]))
         return x + p, carry + t

@@ -1,0 +1,103 @@
+"""Serving and observability: ParallelInference, StatsListener, UI server.
+
+The reference's operational tier in one script (SURVEY.md §2/§5):
+
+- train with a `StatsListener` routing per-iteration stats (score, param/
+  gradient magnitudes, histograms, memory) into a `StatsStorage`
+  (`BaseStatsListener` → `InMemoryStatsStorage`, the Play UI's data feed);
+- serve the trained model through `ParallelInference` in BATCHED mode —
+  concurrent callers' requests coalesce into device-sized batches
+  (`ParallelInference.java:32`, `InferenceMode.BATCHED`);
+- hot-swap the served model atomically with `update_model`;
+- start the dashboard (`UIServer` ≙ `PlayUIServer.java:53`) and read the
+  same JSON the browser modules consume;
+- export a phase timeline from `TrainingStats` (`StatsUtils` timeline) —
+  with `NTPTimeSource` the stamps are comparable across hosts.
+
+Run: python examples/12_serving_and_observability.py   (CPU-friendly)
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.ui.server import UIServer
+from deeplearning4j_tpu.ui.stats import StatsListener
+from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+
+def build_net(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+            .list()
+            .layer(DenseLayer(n_in=20, n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(20))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n = 512
+    x = rng.normal(size=(n, 20)).astype(np.float32)
+    w = rng.normal(size=(20, 3)).astype(np.float32)
+    cls = np.argmax(x @ w, axis=1)
+    y = np.eye(3, dtype=np.float32)[cls]
+
+    # -- train with the stats pipeline attached -----------------------------
+    storage = InMemoryStatsStorage()
+    net = build_net()
+    net.set_listeners(StatsListener(storage, session_id="serving-demo"))
+    net.fit(ListDataSetIterator(DataSet(x, y), 64, shuffle=True), epochs=10)
+    print(f"trained; stats sessions recorded: {storage.list_session_ids()}")
+
+    # -- batched parallel inference -----------------------------------------
+    pi = ParallelInference(net, mode="batched", max_batch_size=64)
+    results = {}
+
+    def client(i):
+        results[i] = pi.output(x[i * 8:(i + 1) * 8])
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    served = np.concatenate([results[i] for i in range(8)])
+    direct = np.asarray(net.output(x[:64]))
+    print(f"batched serving == direct output: "
+          f"{np.allclose(served, direct, atol=1e-5)}")
+
+    # hot-swap: retrained model replaces the served one atomically
+    net2 = build_net(seed=8)
+    net2.fit(ListDataSetIterator(DataSet(x, y), 64), epochs=10)
+    pi.update_model(net2)
+    acc = (np.asarray(pi.output(x)).argmax(-1) == cls).mean()
+    print(f"accuracy after hot-swap: {acc:.3f}")
+    pi.shutdown()
+
+    # -- dashboard: the JSON the browser modules read -----------------------
+    ui = UIServer(port=0)          # pick a free port
+    ui.attach(storage)
+    port = ui.start()
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/train/sessions") as r:
+        sessions = json.loads(r.read())
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/train/overview/serving-demo") as r:
+        overview = json.loads(r.read())
+    print(f"UI sessions: {sessions}; overview keys: {sorted(overview)[:5]}")
+    ui.stop()
+
+
+if __name__ == "__main__":
+    main()

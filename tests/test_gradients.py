@@ -565,3 +565,53 @@ class TestSpaceToDepthStem:
         y, _ = l.forward(p, x)
         y_ref, _ = base.forward(p, x)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-6)
+
+
+class TestCausalAttentionGradients:
+    """Gradient checks through the causal layer's two execution paths:
+    the full-sequence masked forward and the KV-cache forward_seq (the path
+    TBPTT trains through)."""
+
+    def test_causal_self_attention(self):
+        from deeplearning4j_tpu.nn.layers import CausalSelfAttentionLayer
+        m = build([CausalSelfAttentionLayer(n_out=4, n_heads=2, head_size=2),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(4, 5))
+        x = RNG.normal(size=(2, 5, 4))
+        y = onehot(RNG.integers(0, 2, (2, 5)), 2)
+        assert check_model_gradients(m, x, y, subset=40, print_results=True)
+
+    def test_causal_attention_with_padding_mask(self):
+        from deeplearning4j_tpu.nn.layers import CausalSelfAttentionLayer
+        m = build([CausalSelfAttentionLayer(n_out=4, n_heads=2, head_size=2),
+                   RnnOutputLayer(n_out=2)], InputType.recurrent(4, 5))
+        x = RNG.normal(size=(2, 5, 4))
+        y = onehot(RNG.integers(0, 2, (2, 5)), 2)
+        mask = np.ones((2, 5), np.float32)
+        mask[1, 3:] = 0.0
+        assert check_model_gradients(m, x, y, features_mask=mask,
+                                     labels_mask=mask, subset=40,
+                                     print_results=True)
+
+    def test_kv_cache_path_gradients(self):
+        # TBPTT trains THROUGH forward_seq with a carry: finite differences
+        # vs jax.grad on that exact path
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import CausalSelfAttentionLayer
+        from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+
+        l = CausalSelfAttentionLayer(n_in=4, n_out=4, n_heads=2, head_size=2,
+                                     max_cache=8)
+        params = l.init_params(jax.random.PRNGKey(0))
+        x_np = RNG.normal(size=(2, 3, 4))
+
+        def loss(p):
+            # f64 carry/input: the checker runs in x64 and an f32 cache
+            # would truncate the finite differences
+            x = jnp.asarray(x_np, jnp.float64)
+            carry = l.init_carry(2, jnp.float64)
+            y1, carry = l.forward_seq(p, x, carry=carry)
+            y2, _ = l.forward_seq(p, x, carry=carry)  # second chunk
+            return jnp.sum(y1 ** 2) + jnp.sum(y2 ** 2)
+
+        assert check_gradients_fn(loss, params, subset=40)

@@ -175,3 +175,29 @@ def test_ulysses_helper_no_reentry(rng):
     finally:
         helpers.clear_helper("attention")
     np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_causal_sp_helper_on_transformer_lm(mesh):
+    """One-line long-context for DECODERS: a causal=True sequence-parallel
+    helper serves every CausalSelfAttentionLayer (causality is part of the
+    helper request), outputs unchanged vs the unregistered model."""
+    import numpy as np
+    from deeplearning4j_tpu.nn import helpers
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.parallel.ring import SequenceParallelAttentionHelper
+    from deeplearning4j_tpu.zoo.models import TransformerLM
+
+    m = TransformerLM(vocab_size=50, max_length=16, n_layers=2, d_model=16,
+                      n_heads=8, d_ff=32, seed=3)
+    net = ComputationGraph(m.conf()).init()
+    x = np.random.default_rng(0).integers(0, 50, size=(2, 16)).astype(np.float32)
+    ref = np.asarray(net.output(x))
+    for strategy in ("ring", "ulysses"):
+        helpers.set_helper("attention", SequenceParallelAttentionHelper(
+            mesh, strategy=strategy, causal=True))
+        try:
+            out = np.asarray(net.output(x))
+        finally:
+            helpers.clear_helper("attention")
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5,
+                                   err_msg=strategy)
