@@ -79,8 +79,20 @@ class KMeansClustering:
             d = np.asarray(pairwise_distance(
                 pts, jnp.asarray(centers[-1])[None, :], self.distance))[:, 0]
             d_min = d if d_min is None else np.minimum(d_min, d)
-            probs = d_min / max(d_min.sum(), 1e-12)
-            centers.append(np.asarray(pts[int(rng.choice(n, p=probs))]))
+            # k-means++ weights by SQUARED distance in the chosen metric:
+            # sqeuclidean is already squared, and 'dot' is not a metric
+            # (negative = similar), so it seeds uniformly instead of
+            # inverting the preference
+            if self.distance == "sqeuclidean":
+                w = np.maximum(d_min, 0.0)
+            elif self.distance == "dot":
+                w = None
+            else:
+                w = np.maximum(d_min, 0.0) ** 2
+            if w is None or w.sum() <= 0:  # duplicates-only remainder too
+                centers.append(np.asarray(pts[int(rng.integers(0, n))]))
+            else:
+                centers.append(np.asarray(pts[int(rng.choice(n, p=w / w.sum()))]))
         c = jnp.asarray(np.stack(centers))
 
         self.iteration_costs = []

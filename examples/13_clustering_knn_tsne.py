@@ -1,0 +1,87 @@
+"""Nearest neighbors, clustering, t-SNE, and the k-NN REST server.
+
+The reference's `deeplearning4j-nearestneighbors-parent` +
+`deeplearning4j-core/plot` tier (SURVEY.md §2): VPTree exact search, the
+MXU brute-force index (the TPU-native fast path — one batched distance
+matmul instead of a pointer-chasing tree), KMeans on device, Barnes-Hut
+t-SNE, and the REST server/client pair
+(`NearestNeighborsServer.java:42` → `clustering/server.py`).
+
+Run: python examples/13_clustering_knn_tsne.py   (CPU-friendly)
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.bruteforce import BruteForceNearestNeighbors
+from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+from deeplearning4j_tpu.clustering.server import (
+    NearestNeighborsClient,
+    NearestNeighborsServer,
+)
+from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.plot.tsne import BarnesHutTsne
+
+
+def blobs(rng, n_per=80, centers=((0, 0), (8, 8), (0, 8)), dim=16):
+    """Three well-separated gaussian blobs embedded in `dim` dimensions."""
+    out, labels = [], []
+    for ci, c in enumerate(centers):
+        mu = np.zeros(dim)
+        mu[:2] = c
+        out.append(rng.normal(size=(n_per, dim)) * 0.5 + mu)
+        labels.extend([ci] * n_per)
+    return np.concatenate(out).astype(np.float32), np.array(labels)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    x, labels = blobs(rng)
+
+    # -- exact VPTree vs MXU brute-force: same neighbors --------------------
+    tree = VPTree(x, distance="euclidean")
+    bf = BruteForceNearestNeighbors(x, distance="euclidean")
+    q = x[5]
+    d_tree, i_tree = tree.search(q, k=5)
+    d_bf, i_bf = bf.search(q[None], k=5)
+    print(f"VPTree == brute-force neighbors: {set(i_tree) == set(i_bf[0])}")
+
+    # -- KMeans on device ----------------------------------------------------
+    km = KMeansClustering.setup(cluster_count=3, max_iteration_count=50, seed=1)
+    km.fit(x)                      # returns the (k, D) centers
+    assignments = km._assign       # per-point cluster ids from the last sweep
+    # cluster purity: each found cluster should map to one true blob
+    purity = np.mean([
+        np.bincount(labels[assignments == c]).max()
+        / max(1, (assignments == c).sum())
+        for c in range(3)])
+    print(f"KMeans purity over 3 blobs: {purity:.3f}")
+
+    # -- Barnes-Hut t-SNE: blobs stay separated in 2-D -----------------------
+    emb = BarnesHutTsne(n_components=2, n_iter=120, perplexity=20,
+                        seed=7).fit_transform(x)
+    centroids = np.stack([emb[labels == c].mean(0) for c in range(3)])
+    spread = np.linalg.norm(
+        centroids[:, None] - centroids[None, :], axis=-1)[np.triu_indices(3, 1)]
+    within = np.mean([np.linalg.norm(emb[labels == c]
+                                     - centroids[c], axis=1).mean()
+                      for c in range(3)])
+    print(f"t-SNE blob separation: centroid spread {spread.min():.1f} "
+          f"vs within-blob radius {within:.1f}")
+
+    # -- REST serving (NearestNeighborsServer parity) ------------------------
+    server = NearestNeighborsServer(points=x, similarity_function="euclidean",
+                                    port=0, labels=[str(l) for l in labels])
+    port = server.start()
+    client = NearestNeighborsClient(f"http://127.0.0.1:{port}")
+    got = client.knn(index=5, k=5)          # excludes the query point itself
+    got_new = client.knn_new(x[5], k=5)
+    d6, i6 = bf.search(q[None], k=6)
+    local = {int(i) for i in i6[0] if i != 5}
+    same = {r["index"] for r in got["results"]} == local
+    print(f"REST k-NN agrees with local search: {same}; "
+          f"knn_new returned {len(got_new['results'])} hits")
+    server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -248,3 +248,55 @@ class TestTsne:
         ts = BarnesHutTsne(theta=0.0, perplexity=5.0, n_iter=50)
         y = ts.fit_transform(pts)
         assert y.shape == (30, 2)
+
+
+def test_kmeanspp_seeding_quality():
+    """k-means++ D^2 seeding: across seeds, well-separated blobs should
+    almost always be recovered perfectly (linear-weighted seeding kept
+    collapsing two blobs into one center)."""
+    import numpy as np
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+
+    rng = np.random.default_rng(0)
+    out, labels = [], []
+    for ci, c in enumerate(((0, 0), (8, 8), (0, 8))):
+        mu = np.zeros(16)
+        mu[:2] = c
+        out.append(rng.normal(size=(80, 16)) * 0.5 + mu)
+        labels.extend([ci] * 80)
+    x = np.concatenate(out).astype(np.float32)
+    labels = np.array(labels)
+    purities = []
+    for seed in range(8):
+        km = KMeansClustering.setup(cluster_count=3, max_iteration_count=50,
+                                    seed=seed)
+        km.fit(x)
+        a = km._assign
+        purities.append(np.mean([
+            np.bincount(labels[a == c]).max() / max(1, (a == c).sum())
+            for c in range(3)]))
+    assert np.mean(purities) > 0.95, purities
+
+
+def test_kmeans_metric_aware_seeding():
+    import numpy as np
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+
+    rng = np.random.default_rng(0)
+    x = np.concatenate([rng.normal(size=(40, 4)) * 0.3,
+                        rng.normal(size=(40, 4)) * 0.3 + 6.0]).astype(np.float32)
+    # sqeuclidean distances are already squared — must still cluster cleanly
+    km = KMeansClustering.setup(cluster_count=2, max_iteration_count=50,
+                                distance="sqeuclidean", seed=1)
+    centers = km.fit(x)
+    assert centers.shape == (2, 4)
+    a = km._assign
+    assert (a[:40] == a[0]).all() and (a[40:] == a[40]).all() and a[0] != a[40]
+    # 'dot' is not a metric: seeding must not crash (uniform fallback)
+    km2 = KMeansClustering.setup(cluster_count=2, max_iteration_count=10,
+                                 distance="dot", seed=1)
+    km2.fit(x)
+    # all-duplicate points: seeding falls back to uniform instead of raising
+    dup = np.tile(np.ones((1, 4), np.float32), (5, 1))
+    km3 = KMeansClustering.setup(cluster_count=2, max_iteration_count=5, seed=0)
+    km3.fit(dup)
