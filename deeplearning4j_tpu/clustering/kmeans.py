@@ -57,6 +57,14 @@ class KMeansClustering:
         self.min_distribution_variation = float(min_distribution_variation)
         self.seed = seed
         self.iteration_costs: List[float] = []
+        self._assign = None
+
+    @property
+    def assignments(self) -> np.ndarray:
+        """Per-point cluster ids from the last ``fit`` sweep."""
+        if self._assign is None:
+            raise ValueError("call fit() before reading assignments")
+        return self._assign
 
     @classmethod
     def setup(cls, cluster_count: int, max_iteration_count: int = 100,
@@ -70,30 +78,30 @@ class KMeansClustering:
         n = pts.shape[0]
         if n < self.k:
             raise ValueError(f"need >= k={self.k} points, got {n}")
-        # k-means++ style seeding: random first center, then farthest-point
+        # k-means++ seeding: random first center, then sample proportional
+        # to SQUARED distance in the chosen metric (sqeuclidean is already
+        # squared). 'dot' is not a metric (negative = similar) so it seeds
+        # by uniform draws without computing distances at all. Fallback
+        # draws exclude already-chosen indices — duplicate centers freeze
+        # empty clusters in Lloyd's update.
         rng = np.random.default_rng(self.seed)
-        first = int(rng.integers(0, n))
-        centers = [np.asarray(pts[first])]
+        chosen = [int(rng.integers(0, n))]
         d_min = None
         for _ in range(1, self.k):
-            d = np.asarray(pairwise_distance(
-                pts, jnp.asarray(centers[-1])[None, :], self.distance))[:, 0]
-            d_min = d if d_min is None else np.minimum(d_min, d)
-            # k-means++ weights by SQUARED distance in the chosen metric:
-            # sqeuclidean is already squared, and 'dot' is not a metric
-            # (negative = similar), so it seeds uniformly instead of
-            # inverting the preference
-            if self.distance == "sqeuclidean":
-                w = np.maximum(d_min, 0.0)
-            elif self.distance == "dot":
-                w = None
-            else:
-                w = np.maximum(d_min, 0.0) ** 2
-            if w is None or w.sum() <= 0:  # duplicates-only remainder too
-                centers.append(np.asarray(pts[int(rng.integers(0, n))]))
-            else:
-                centers.append(np.asarray(pts[int(rng.choice(n, p=w / w.sum()))]))
-        c = jnp.asarray(np.stack(centers))
+            w = None
+            if self.distance != "dot":
+                d = np.asarray(pairwise_distance(
+                    pts, pts[chosen[-1]][None, :], self.distance))[:, 0]
+                d_min = d if d_min is None else np.minimum(d_min, d)
+                w = (np.maximum(d_min, 0.0) if self.distance == "sqeuclidean"
+                     else np.maximum(d_min, 0.0) ** 2)
+            if w is not None and w.sum() > 0:
+                chosen.append(int(rng.choice(n, p=w / w.sum())))
+            else:  # 'dot', or a duplicates-only remainder
+                free = np.setdiff1d(np.arange(n), chosen)
+                chosen.append(int(rng.choice(free)) if free.size
+                              else int(rng.integers(0, n)))
+        c = jnp.asarray(np.stack([np.asarray(pts[i]) for i in chosen]))
 
         self.iteration_costs = []
         prev_cost = None

@@ -48,7 +48,7 @@ def main():
     # -- KMeans on device ----------------------------------------------------
     km = KMeansClustering.setup(cluster_count=3, max_iteration_count=50, seed=1)
     km.fit(x)                      # returns the (k, D) centers
-    assignments = km._assign       # per-point cluster ids from the last sweep
+    assignments = km.assignments   # per-point cluster ids from the last sweep
     # cluster purity: each found cluster should map to one true blob
     purity = np.mean([
         np.bincount(labels[assignments == c]).max()

@@ -1,0 +1,103 @@
+"""Model import: Keras HDF5, DL4J config dialect, checkpoint round trips.
+
+The reference's migration tier (SURVEY.md §2 modelimport): a model trained
+in another framework keeps working here.
+
+1. Keras → build a small CNN with the installed Keras, save legacy HDF5,
+   import (`KerasModelImport.importKerasModelAndWeights:50` parity) and
+   verify output equivalence on the same input;
+2. Transfer learning on the imported net — freeze the conv trunk, replace
+   the head, fine-tune (`TransferLearning.Builder`);
+3. DL4J config dialect → a `MultiLayerConfiguration` JSON in the
+   REFERENCE's serialization format imports into a native config;
+4. ModelSerializer zip round trip (config + params + updater state).
+
+Run: python examples/14_model_import_and_transfer.py   (needs keras; CPU ok)
+"""
+
+import json
+
+import numpy as np
+
+
+def main():
+    import keras
+
+    from deeplearning4j_tpu.modelimport.keras import KerasModelImport
+
+    rng = np.random.default_rng(0)
+
+    # -- 1. Keras CNN → HDF5 → import → equivalence --------------------------
+    km = keras.Sequential([
+        keras.layers.Input((12, 12, 1)),
+        keras.layers.Conv2D(8, 3, activation="relu"),
+        keras.layers.MaxPooling2D(2),
+        keras.layers.Flatten(),
+        keras.layers.Dense(16, activation="relu"),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    km.compile(loss="categorical_crossentropy", optimizer="sgd")
+    km.save("/tmp/keras_cnn.h5")
+    net = KerasModelImport.import_keras_sequential_model_and_weights(
+        "/tmp/keras_cnn.h5")
+    x = rng.normal(size=(4, 12, 12, 1)).astype(np.float32)
+    theirs = np.asarray(km.predict(x, verbose=0))
+    ours = np.asarray(net.output(x))
+    print(f"Keras import equivalence: max|Δ| = {np.abs(ours - theirs).max():.2e}")
+
+    # -- 2. transfer learning on the imported net ----------------------------
+    from deeplearning4j_tpu.nn.layers import OutputLayer
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration,
+        TransferLearning,
+    )
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    tuned = (TransferLearning.Builder(net)
+             .fine_tune_configuration(FineTuneConfiguration(updater=Adam(1e-2)))
+             .set_feature_extractor(2)          # freeze conv trunk
+             .remove_output_layer()
+             .add_layer(OutputLayer(n_out=2, activation="softmax",
+                                    loss="mcxent"))
+             .build())
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    x2 = rng.normal(size=(64, 12, 12, 1)).astype(np.float32)
+    x2[y[:, 1] == 1] += 1.5
+    for _ in range(60):
+        tuned.fit(x2, y)
+    acc = (np.asarray(tuned.output(x2)).argmax(-1) == y.argmax(-1)).mean()
+    print(f"fine-tuned head accuracy (frozen trunk): {acc:.3f}")
+
+    # -- 3. the reference's own JSON dialect imports -------------------------
+    from deeplearning4j_tpu.modelimport.dl4j import import_dl4j_configuration
+
+    dl4j_json = json.dumps({
+        "backprop": True, "backpropType": "Standard",
+        "confs": [
+            {"layer": {"dense": {"activationFn": "relu", "nin": 8, "nout": 16,
+                                 "layerName": "layer0"}}},
+            {"layer": {"output": {"activationFn": "softmax", "nin": 16,
+                                  "nout": 3, "layerName": "layer1",
+                                  "lossFn": "MCXENT"}}},
+        ]})
+    conf = import_dl4j_configuration(dl4j_json)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    legacy = MultiLayerNetwork(conf).init()
+    print(f"DL4J dialect import: {len(conf.layers)} layers, "
+          f"output shape {np.asarray(legacy.output(np.zeros((2, 8), np.float32))).shape}")
+
+    # -- 4. checkpoint zip round trip ----------------------------------------
+    from deeplearning4j_tpu.util.model_serializer import (
+        restore_multi_layer_network,
+        write_model,
+    )
+
+    write_model(tuned, "/tmp/tuned.zip")
+    back = restore_multi_layer_network("/tmp/tuned.zip")
+    same = np.allclose(np.asarray(back.output(x2[:4])),
+                       np.asarray(tuned.output(x2[:4])), atol=1e-6)
+    print(f"ModelSerializer round trip exact: {same}")
+
+
+if __name__ == "__main__":
+    main()

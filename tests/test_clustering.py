@@ -271,7 +271,7 @@ def test_kmeanspp_seeding_quality():
         km = KMeansClustering.setup(cluster_count=3, max_iteration_count=50,
                                     seed=seed)
         km.fit(x)
-        a = km._assign
+        a = km.assignments
         purities.append(np.mean([
             np.bincount(labels[a == c]).max() / max(1, (a == c).sum())
             for c in range(3)]))
@@ -290,7 +290,7 @@ def test_kmeans_metric_aware_seeding():
                                 distance="sqeuclidean", seed=1)
     centers = km.fit(x)
     assert centers.shape == (2, 4)
-    a = km._assign
+    a = km.assignments
     assert (a[:40] == a[0]).all() and (a[40:] == a[40]).all() and a[0] != a[40]
     # 'dot' is not a metric: seeding must not crash (uniform fallback)
     km2 = KMeansClustering.setup(cluster_count=2, max_iteration_count=10,
