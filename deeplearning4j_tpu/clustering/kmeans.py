@@ -52,7 +52,8 @@ class KMeansClustering:
                  min_distribution_variation: float = 1e-4,
                  seed: int = 0):
         self.k = int(k)
-        self.max_iter = int(max_iter)
+        self.max_iter = max(1, int(max_iter))  # one Lloyd sweep minimum:
+        # fit() must always produce assignments
         self.distance = distance
         self.min_distribution_variation = float(min_distribution_variation)
         self.seed = seed
@@ -81,9 +82,9 @@ class KMeansClustering:
         # k-means++ seeding: random first center, then sample proportional
         # to SQUARED distance in the chosen metric (sqeuclidean is already
         # squared). 'dot' is not a metric (negative = similar) so it seeds
-        # by uniform draws without computing distances at all. Fallback
-        # draws exclude already-chosen indices — duplicate centers freeze
-        # empty clusters in Lloyd's update.
+        # by uniform draws over not-yet-chosen indices without computing
+        # distances at all (distinct indices; coordinate duplicates remain
+        # possible only when the data itself contains duplicates).
         rng = np.random.default_rng(self.seed)
         chosen = [int(rng.integers(0, n))]
         d_min = None
@@ -98,9 +99,9 @@ class KMeansClustering:
             if w is not None and w.sum() > 0:
                 chosen.append(int(rng.choice(n, p=w / w.sum())))
             else:  # 'dot', or a duplicates-only remainder
+                # free is never empty: len(chosen) < k <= n
                 free = np.setdiff1d(np.arange(n), chosen)
-                chosen.append(int(rng.choice(free)) if free.size
-                              else int(rng.integers(0, n)))
+                chosen.append(int(rng.choice(free)))
         c = jnp.asarray(np.stack([np.asarray(pts[i]) for i in chosen]))
 
         self.iteration_costs = []

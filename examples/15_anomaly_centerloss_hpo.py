@@ -1,0 +1,142 @@
+"""Anomaly detection, center-loss embeddings, and hyperparameter search.
+
+Three reference tutorial topics (`dl4j-examples/tutorials` 05, 07, 11) on
+the TPU-native stack:
+
+1. **Autoencoder anomaly detection** — train an `AutoEncoderLayer` on
+   "normal" data only; anomalies score much higher reconstruction error
+   (tutorial 05's MNIST ranking, on synthetic structured data);
+2. **Center loss** — `CenterLossOutputLayer` pulls same-class embeddings
+   toward learned centers (tutorial 07's FaceNet recipe): intra-class
+   spread shrinks vs a plain softmax head;
+3. **Hyperparameter search** — a small random search driven by
+   `EarlyStoppingTrainer` with held-out scoring picks width/learning-rate
+   (tutorial 11 uses Arbiter, an external dependency of the reference; the
+   search loop here is plain Python over the same config builder).
+
+Run: python examples/15_anomaly_centerloss_hpo.py   (CPU-friendly)
+"""
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    AutoEncoderLayer,
+    CenterLossOutputLayer,
+    DenseLayer,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.optimize.earlystopping import (
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+
+DIM = 24
+
+
+def structured(rng, n):
+    """'Normal' samples live on a 4-D latent plane embedded in DIM dims."""
+    basis = np.linalg.qr(np.random.default_rng(99).normal(size=(DIM, 4)))[0]
+    return (rng.normal(size=(n, 4)) @ basis.T).astype(np.float32)
+
+
+def main():
+    rng = np.random.default_rng(0)
+
+    # -- 1. anomaly detection by reconstruction error ------------------------
+    x_norm = structured(rng, 512)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+            .list()
+            .layer(AutoEncoderLayer(n_out=4, corruption_level=0.0,
+                                    activation="tanh"))
+            .layer(OutputLayer(n_out=DIM, activation="identity", loss="mse"))
+            .set_input_type(InputType.feed_forward(DIM))
+            .build())
+    ae = MultiLayerNetwork(conf).init()
+    it = ListDataSetIterator(DataSet(x_norm, x_norm), 64, shuffle=True)
+    ae.fit(it, epochs=40)
+
+    def recon_error(batch):
+        out = np.asarray(ae.output(batch))
+        return ((out - batch) ** 2).mean(axis=1)
+
+    normal_scores = recon_error(structured(rng, 128))       # held-out normal
+    anomaly_scores = recon_error(
+        rng.normal(size=(128, DIM)).astype(np.float32))     # off-manifold
+    thresh = np.quantile(normal_scores, 0.95)
+    tpr = (anomaly_scores > thresh).mean()
+    print(f"anomaly detection: 95%-normal threshold {thresh:.4f}, "
+          f"anomaly detection rate {tpr:.2f}")
+
+    # -- 2. center loss tightens the embedding space -------------------------
+    y_idx = rng.integers(0, 3, 384)
+    xc = rng.normal(size=(384, 8)).astype(np.float32)
+    xc[np.arange(384), y_idx] += 2.0
+    yc = np.eye(3, dtype=np.float32)[y_idx]
+
+    def intra_class_spread(lambda_):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+                .list()
+                .layer(DenseLayer(n_in=8, n_out=6, activation="tanh"))
+                .layer(CenterLossOutputLayer(n_out=3, lambda_=lambda_))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        for _ in range(120):
+            net.fit(xc, yc)
+        emb = np.asarray(net.feed_forward(xc)[1])  # activations after layer 0
+        return np.mean([np.linalg.norm(
+            emb[y_idx == c] - emb[y_idx == c].mean(0), axis=1).mean()
+            for c in range(3)])
+
+    plain, center = intra_class_spread(0.0), intra_class_spread(0.5)
+    print(f"intra-class embedding spread: plain {plain:.3f} "
+          f"-> center loss {center:.3f} ({plain / center:.1f}x tighter)")
+
+    # -- 3. random hyperparameter search with early stopping -----------------
+    xh = rng.normal(size=(512, 10)).astype(np.float32)
+    wh = np.random.default_rng(5).normal(size=(10, 4)).astype(np.float32)
+    yh = np.eye(4, dtype=np.float32)[np.argmax(xh @ wh, axis=1)]
+    train, val = DataSet(xh[:384], yh[:384]), DataSet(xh[384:], yh[384:])
+
+    space = {"width": [8, 32, 128], "lr": [3e-4, 3e-3, 3e-2]}
+    results = []
+    for trial in range(5):
+        width = space["width"][rng.integers(0, 3)]
+        lr = space["lr"][rng.integers(0, 3)]
+        conf = (NeuralNetConfiguration.builder().seed(trial).updater(Adam(lr))
+                .list()
+                .layer(DenseLayer(n_in=10, n_out=width, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(10))
+                .build())
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(
+                ListDataSetIterator(val, 128)),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(30),
+                ScoreImprovementEpochTerminationCondition(5)])
+        result = EarlyStoppingTrainer(
+            es, MultiLayerNetwork(conf).init(),
+            ListDataSetIterator(train, 64, shuffle=True)).fit()
+        results.append((result.best_model_score, width, lr, result))
+        print(f"  trial {trial}: width={width:<4} lr={lr:<7} "
+              f"val loss {result.best_model_score:.4f} "
+              f"(stopped at epoch {result.total_epochs}, "
+              f"best {result.best_model_epoch})")
+    best_score, width, lr, best = min(results, key=lambda r: r[0])
+    ev = best.best_model.evaluate(ListDataSetIterator(val, 128))
+    print(f"best config: width={width} lr={lr} -> "
+          f"val accuracy {ev.accuracy():.3f}")
+
+
+if __name__ == "__main__":
+    main()

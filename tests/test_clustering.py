@@ -300,3 +300,14 @@ def test_kmeans_metric_aware_seeding():
     dup = np.tile(np.ones((1, 4), np.float32), (5, 1))
     km3 = KMeansClustering.setup(cluster_count=2, max_iteration_count=5, seed=0)
     km3.fit(dup)
+
+
+def test_kmeans_zero_max_iter_still_assigns():
+    import numpy as np
+    from deeplearning4j_tpu.clustering.kmeans import KMeansClustering
+
+    x = np.random.default_rng(0).normal(size=(10, 3)).astype(np.float32)
+    km = KMeansClustering.setup(cluster_count=2, max_iteration_count=0)
+    centers = km.fit(x)   # clamped to one sweep: assignments always exist
+    assert centers.shape == (2, 3)
+    assert km.assignments.shape == (10,)
