@@ -78,3 +78,30 @@ class TestUiComponents:
     def test_mismatched_series_raises(self):
         with pytest.raises(ValueError):
             ChartLine().add_series("bad", [1, 2], [1.0])
+
+
+class TestGraphCheckpointFormatRegression:
+    """ComputationGraph zip fixture (attention + LayerNorm + vertices +
+    multi-input): the format the graph serializer writes today must keep
+    restoring bit-exact in future builds."""
+
+    def test_restore_graph_v1_fixture_exact_outputs(self):
+        zip_path = os.path.join(FIXTURE_DIR, "regression_graph_v1.zip")
+        expected = np.load(os.path.join(FIXTURE_DIR,
+                                        "regression_graph_v1_expected.npz"))
+        net = model_serializer.restore_computation_graph(zip_path)
+        out = np.asarray(net.output(expected["probe_a"], expected["probe_b"]))
+        np.testing.assert_allclose(out, expected["output"], rtol=1e-6,
+                                   atol=1e-7)
+
+    def test_restored_graph_fixture_keeps_training(self):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        zip_path = os.path.join(FIXTURE_DIR, "regression_graph_v1.zip")
+        net = model_serializer.restore_computation_graph(zip_path)
+        rng = np.random.default_rng(1)
+        xa = rng.normal(size=(2, 10, 6)).astype(np.float32)
+        xb = rng.normal(size=(2, 10, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            rng.integers(0, 3, (2, 10)).reshape(-1)].reshape(2, 10, 3)
+        net.fit([MultiDataSet([xa, xb], [y])])  # updater state restored too
+        assert np.isfinite(float(net.score_))
