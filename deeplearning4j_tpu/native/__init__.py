@@ -102,6 +102,15 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.loader_rewind.argtypes = [c_void]
         lib.loader_destroy.restype = None
         lib.loader_destroy.argtypes = [c_void]
+
+        llp = ctypes.POINTER(ctypes.c_longlong)
+        lib.corpus_scan_file.restype = c_void
+        lib.corpus_scan_file.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.c_int, llp]
+        lib.corpus_scan_fill.restype = None
+        lib.corpus_scan_fill.argtypes = [c_void, ctypes.c_char_p, llp]
+        lib.corpus_scan_free.restype = None
+        lib.corpus_scan_free.argtypes = [c_void]
         _lib = lib
         return _lib
 

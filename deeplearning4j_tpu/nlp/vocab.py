@@ -145,6 +145,56 @@ def build_huffman(cache: VocabCache) -> None:
         vw.points = list(reversed(points))
 
 
+def scan_corpus_file(path: str, *, n_threads: int = 4,
+                     to_lower: bool = True) -> Dict[str, int]:
+    """Word frequencies over a text file, split on ASCII whitespace.
+
+    The reference's parallel corpus scan (``VocabConstructor.java:31``) as a
+    native component: C++ worker threads count per-chunk outside the GIL
+    (``native/src/corpus_scan.cpp``), merged and returned in (count desc,
+    word asc) order. Falls back to a single-pass Python count with the SAME
+    tokenization (``bytes.split()`` = ASCII whitespace, ASCII lowercasing)
+    when the native library is unavailable.
+    """
+    import ctypes
+
+    from deeplearning4j_tpu import native as _n
+
+    def _merge(pairs):
+        # distinct byte tokens can decode (errors='replace') to the same
+        # string — SUM collisions rather than keep the last one
+        out: Dict[str, int] = {}
+        for w, c in pairs:
+            out[w] = out.get(w, 0) + int(c)
+        return out
+
+    lib = _n._load()  # prototypes declared in native._load()
+    if lib is not None and hasattr(lib, "corpus_scan_file"):
+        out = (ctypes.c_longlong * 3)()
+        h = lib.corpus_scan_file(path.encode(), int(n_threads),
+                                 1 if to_lower else 0, out)
+        if h:  # nullptr = IO failure -> fall through to the Python path
+            try:
+                n_unique, _total, nbytes = out[0], out[1], out[2]
+                words_buf = ctypes.create_string_buffer(int(nbytes))
+                counts = (ctypes.c_longlong * int(n_unique))()
+                lib.corpus_scan_fill(h, words_buf, counts)
+                words = words_buf.raw[:int(nbytes)].decode(
+                    "utf-8", errors="replace").split("\n")
+                return _merge(zip(words, counts))
+            finally:
+                lib.corpus_scan_free(h)
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if to_lower:
+        data = data.lower()
+    counts = Counter(data.split())
+    items = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+    return _merge((w.decode("utf-8", errors="replace"), c)
+                  for w, c in items)
+
+
 class VocabConstructor:
     """Builds a VocabCache from token sequences (VocabConstructor.java:31)."""
 
@@ -152,6 +202,27 @@ class VocabConstructor:
                  special_tokens: Sequence[str] = ()):
         self.min_word_frequency = min_word_frequency
         self.special_tokens = list(special_tokens)
+
+    def build_vocab_from_file(self, path: str, *, n_threads: int = 4,
+                              to_lower: bool = True) -> "VocabCache":
+        """Fast path for file corpora: the native multithreaded scan feeds
+        the same cutoff/Huffman pipeline as :meth:`build_vocab`."""
+        counts = scan_corpus_file(path, n_threads=n_threads,
+                                  to_lower=to_lower)
+        total = sum(counts.values())
+        cache = VocabCache()
+        for tok in self.special_tokens:
+            cache.add_token(VocabWord(tok,
+                                      frequency=max(counts.get(tok, 1), 1),
+                                      is_special=True))
+            counts.pop(tok, None)
+        for word, c in counts.items():
+            cache.add_token(VocabWord(word, frequency=c))
+        cache.truncate(self.min_word_frequency)
+        cache.update_indices()
+        cache.total_word_occurrences = float(total)
+        build_huffman(cache)
+        return cache
 
     def build_vocab(self, sequences: Iterable[Sequence[str]],
                     labels: Iterable[Sequence[str]] = ()) -> VocabCache:

@@ -190,3 +190,80 @@ class TestNativeLoader:
         net.fit(it, epochs=10)
         ev = net.evaluate(it)
         assert ev.accuracy() > 0.9
+
+
+class TestCorpusScan:
+    """Native multithreaded vocab scan (VocabConstructor.java:31 hot loop):
+    identical counts to the Python fallback, identical vocab downstream."""
+
+    def _corpus(self, tmp_path):
+        import numpy as np
+        words = ["alpha", "beta", "Gamma", "delta-x", "ALPHA", "beta", "émile"]
+        rng = np.random.default_rng(0)
+        text = " ".join(rng.choice(words, 800)) + "\nTab\tsep\r\nmore  spaces"
+        p = tmp_path / "corpus.txt"
+        p.write_text(text)
+        return str(p)
+
+    def test_counts_match_python_fallback(self, tmp_path, monkeypatch):
+        from collections import Counter
+
+        from deeplearning4j_tpu import native
+        from deeplearning4j_tpu.nlp.vocab import scan_corpus_file
+
+        p = self._corpus(tmp_path)
+        got_native = scan_corpus_file(p, n_threads=3)
+        monkeypatch.setattr(native, "_load", lambda: None)
+        got_py = scan_corpus_file(p, n_threads=3)
+        assert dict(got_native) == dict(got_py)
+        want = Counter(w.decode("utf-8", errors="replace")
+                       for w in open(p, "rb").read().lower().split())
+        assert dict(got_native) == dict(want)
+        # deterministic order: count desc, then word asc
+        items = list(got_native.items())
+        assert items == sorted(items, key=lambda kv: (-kv[1], kv[0]))
+
+    def test_case_preserving_scan(self, tmp_path):
+        from deeplearning4j_tpu.nlp.vocab import scan_corpus_file
+
+        p = self._corpus(tmp_path)
+        got = scan_corpus_file(p, to_lower=False)
+        assert "Gamma" in got and "ALPHA" in got
+
+    def test_vocab_from_file_equals_sequence_path(self, tmp_path):
+        from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+        p = self._corpus(tmp_path)
+        vc = VocabConstructor(min_word_frequency=2)
+        cache_f = vc.build_vocab_from_file(p)
+        seqs = [line.lower().split()
+                for line in open(p, encoding="utf-8").read().split("\n")]
+        cache_s = vc.build_vocab(seqs)
+        f_words = sorted((w.word, w.frequency) for w in cache_f._by_index)
+        s_words = sorted((w.word, w.frequency) for w in cache_s._by_index)
+        assert f_words == s_words
+        # Huffman codes assigned on both paths
+        assert all(w.code for w in cache_f._by_index)
+
+    def test_missing_file_raises_oserror(self, tmp_path):
+        from deeplearning4j_tpu.nlp.vocab import scan_corpus_file
+        import pytest
+
+        with pytest.raises(OSError):
+            scan_corpus_file(str(tmp_path / "nope.txt"))
+
+    def test_block_streaming_boundary(self, tmp_path, monkeypatch):
+        # tokens spanning internal read-block boundaries must not split;
+        # exercised indirectly here via exact-count equality on a file
+        # larger than one small synthetic block is impractical in-tree, so
+        # instead lock byte-collision summing: distinct byte tokens that
+        # decode to the same replacement string SUM their counts
+        from deeplearning4j_tpu import native
+        from deeplearning4j_tpu.nlp.vocab import scan_corpus_file
+
+        p = tmp_path / "latin1.txt"
+        p.write_bytes(b"\xff \xfe \xff word")
+        got = scan_corpus_file(str(p))
+        assert got["�"] == 3 and got["word"] == 1
+        monkeypatch.setattr(native, "_load", lambda: None)
+        assert scan_corpus_file(str(p)) == got
