@@ -753,7 +753,8 @@ def generate(net, prompt_ids, n_new_tokens: int, temperature: float = 0.0,
 
 
 def generate_on_device(net, prompt_ids, n_new_tokens: int,
-                       temperature: float = 0.0, seed: int = 0):
+                       temperature: float = 0.0, seed: int = 0,
+                       top_k: int = 0, top_p: float = 0.0):
     """Autoregressive sampling compiled to ONE device executable: prompt
     prefill fills every KV cache, then a ``lax.scan`` decodes one token per
     step with on-device argmax/categorical sampling. A single dispatch and a
@@ -763,7 +764,11 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
 
     Greedy (``temperature=0``) matches :func:`generate` exactly; sampling
     uses ``jax.random.categorical`` (a different RNG than the host loop's
-    numpy, so draws differ — distributions match). Returns [N, n_new_tokens].
+    numpy, so draws differ — distributions match). ``top_k`` keeps only the
+    k most likely tokens and ``top_p`` keeps the smallest nucleus whose
+    probability mass reaches p (both on-device filters over the temperature-
+    scaled distribution; combine freely — top_k applies first). Returns
+    [N, n_new_tokens].
     """
     import jax
     import jax.numpy as jnp
@@ -779,16 +784,40 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
     inp = net.conf.inputs[0]
     out_name = net.conf.outputs[0]
     greedy = not (temperature and temperature > 0)
+    if greedy:
+        # the filters never execute under argmax: identical executable,
+        # one cache entry
+        top_k, top_p = 0, 0.0
     key = ("generate", n_new_tokens, greedy, float(temperature),
-           _helpers.version())
+           int(top_k), float(top_p), _helpers.version())
     if key not in net._jit_cache:
         net._evict_stale(_helpers.version())
         dtype = net.conf.global_conf.jnp_dtype()
+
+        use_k = bool(top_k and top_k > 0)
+        use_p = bool(top_p and 0.0 < top_p < 1.0)
 
         def sample(p, k):
             if greedy:
                 return jnp.argmax(p, axis=-1).astype(jnp.int32)
             logits = jnp.log(jnp.maximum(p, 1e-20)) / temperature
+            if use_k or use_p:
+                srt = jnp.sort(logits, axis=-1)[:, ::-1]  # ONE descending sort
+                if use_k:
+                    kk = min(int(top_k), p.shape[-1])
+                    logits = jnp.where(logits >= srt[..., kk - 1][..., None],
+                                       logits, -jnp.inf)
+                    # the nucleus then applies over the top-k survivors
+                    srt = jnp.where(jnp.arange(srt.shape[-1]) < kk, srt,
+                                    -jnp.inf)
+                if use_p:
+                    # keep the smallest prefix reaching mass top_p (>= 1 tok)
+                    probs = jax.nn.softmax(srt, axis=-1)
+                    csum = jnp.cumsum(probs, axis=-1)
+                    keep = csum - probs < top_p
+                    cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                                     keepdims=True)
+                    logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
             return jax.random.categorical(k, logits, axis=-1).astype(jnp.int32)
 
         def fn(params, states, prompt, rng_key):
