@@ -63,11 +63,73 @@ class SequenceVectors:
     def build_vocab(self, sequences: Iterable[Sequence[str]]) -> VocabCache:
         """Corpus scan → VocabCache (SequenceVectors.buildVocab():108)."""
         constructor = VocabConstructor(min_word_frequency=self.min_word_frequency)
-        self.vocab = constructor.build_vocab(sequences)
+        self._set_vocab(constructor.build_vocab(sequences))
+        return self.vocab
+
+    def build_vocab_from_file(self, path: str, *, n_threads: int = 4,
+                              to_lower: bool = True) -> VocabCache:
+        """File-corpus fast path: the native multithreaded scan counts the
+        whole file outside the GIL (whitespace tokenization — matching
+        ``DefaultTokenizerFactory``), then the standard cutoff/Huffman/
+        lookup pipeline runs."""
+        constructor = VocabConstructor(min_word_frequency=self.min_word_frequency)
+        self._set_vocab(constructor.build_vocab_from_file(
+            path, n_threads=n_threads, to_lower=to_lower))
+        return self.vocab
+
+    def _set_vocab(self, vocab: VocabCache) -> None:
+        self.vocab = vocab
         self.lookup_table = InMemoryLookupTable(
             self.vocab, self.layer_size, seed=self.seed,
             use_hs=self.use_hs, negative=self.negative)
-        return self.vocab
+
+    def _plain_whitespace_tokenization(self) -> bool:
+        """The native scan's byte-level whitespace tokenization only matches
+        an unconfigured DefaultTokenizerFactory (no pre-processor)."""
+        from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+        tf = getattr(self, "tokenizer_factory", None)
+        return tf is None or (type(tf) is DefaultTokenizerFactory
+                              and tf._pre is None)
+
+    def fit_file(self, path: str, *, n_threads: int = 4,
+                 to_lower: bool = True) -> "SequenceVectors":
+        """Train from a text file (one sentence per line).
+
+        With plain whitespace tokenization, vocabulary counting uses the
+        native multithreaded scan and the training pass tokenizes the SAME
+        way (byte-level ASCII whitespace/lowercasing), so every vocab word
+        is trainable. A configured tokenizer_factory/pre-processor instead
+        routes every line through that tokenizer for both vocab and
+        training — identical results to the in-memory path, without the
+        native counting fast path. Note the training pass materializes the
+        encoded sequences in memory (as fit() always does — epochs iterate
+        over them); the native scan only removes the counting pass.
+        """
+        if not self._plain_whitespace_tokenization():
+            tf = self.tokenizer_factory  # type: ignore[attr-defined]
+            with open(path, encoding="utf-8", errors="replace") as f:
+                seqs = [toks for line in f
+                        if (toks := tf.create(line).get_tokens())]
+            return self.fit(seqs)
+
+        if self.vocab is None:
+            self.build_vocab_from_file(path, n_threads=n_threads,
+                                       to_lower=to_lower)
+
+        def lines():
+            # byte-level split/lower: EXACTLY the scan's tokenization, so
+            # vocab keys and training tokens can never diverge (Unicode
+            # case/whitespace handled identically)
+            with open(path, "rb") as f:
+                for raw in f:
+                    if to_lower:
+                        raw = raw.lower()
+                    toks = [t.decode("utf-8", errors="replace")
+                            for t in raw.split()]
+                    if toks:
+                        yield toks
+
+        return self.fit(lines())
 
     # ------------------------------------------------------------ training
 

@@ -784,10 +784,11 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
     inp = net.conf.inputs[0]
     out_name = net.conf.outputs[0]
     greedy = not (temperature and temperature > 0)
-    if greedy:
-        # the filters never execute under argmax: identical executable,
-        # one cache entry
-        top_k, top_p = 0, 0.0
+    vocab_n = getattr(net.conf.vertices[out_name].obj, "n_out", 0)
+    if greedy or top_k < 0 or (vocab_n and top_k >= vocab_n):
+        top_k = 0  # no-op filter: don't let it fragment the compile cache
+    if greedy or not (top_p and 0.0 < top_p < 1.0):
+        top_p = 0.0
     key = ("generate", n_new_tokens, greedy, float(temperature),
            int(top_k), float(top_p), _helpers.version())
     if key not in net._jit_cache:

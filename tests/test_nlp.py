@@ -294,3 +294,75 @@ def test_file_sentence_iterator_streams(tmp_path):
     assert got == ["one", "two", "three"]
     it.reset()
     assert it.next_sentence() == "one"
+
+
+class TestFileCorpusFastPath:
+    """fit_file: native vocab scan + line-streamed training must reach the
+    same quality as the in-memory sequence path."""
+
+    def test_fit_file_learns_cooccurrence(self, tmp_path):
+        import numpy as np
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        rng = np.random.default_rng(7)
+        # two topic clusters: words within a topic co-occur
+        topics = [["cat", "dog", "pet", "fur"], ["car", "road", "wheel", "gas"]]
+        lines = []
+        for _ in range(400):
+            t = topics[rng.integers(0, 2)]
+            lines.append(" ".join(rng.choice(t, 6)))
+        p = tmp_path / "corpus.txt"
+        p.write_text("\n".join(lines))
+
+        w2v = Word2Vec(layer_size=16, window_size=3, min_word_frequency=1,
+                       epochs=3, seed=1)
+        w2v.fit_file(str(p))
+        assert w2v.similarity("cat", "dog") > w2v.similarity("cat", "road")
+        assert w2v.similarity("car", "wheel") > w2v.similarity("car", "pet")
+
+    def test_vocab_from_file_matches_sequences(self, tmp_path):
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        p = tmp_path / "c.txt"
+        p.write_text("a b b c c c\nd d a\n")
+        a = Word2Vec(layer_size=4, min_word_frequency=2, seed=1)
+        a.build_vocab_from_file(str(p))
+        b = Word2Vec(layer_size=4, min_word_frequency=2, seed=1)
+        b.build_vocab([l.split() for l in p.read_text().splitlines()])
+        wa = sorted((w.word, w.frequency) for w in a.vocab._by_index)
+        wb = sorted((w.word, w.frequency) for w in b.vocab._by_index)
+        assert wa == wb
+
+    def test_fit_file_nonascii_tokens_trainable(self, tmp_path):
+        # byte-level tokenization in BOTH scan and training: non-ASCII
+        # uppercase must not silently drop words from training
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        p = tmp_path / "uni.txt"
+        p.write_text("Éclair tarte Éclair\n" * 50 + "car road car\n" * 50)
+        w2v = Word2Vec(layer_size=8, window_size=2, min_word_frequency=1,
+                       epochs=2, seed=1)
+        w2v.fit_file(str(p))
+        # the scan's ASCII lowercasing leaves 'Éclair' intact — and so does
+        # the training tokenizer, so its vector is trained, not random
+        assert w2v.has_word("Éclair")
+        assert w2v.similarity("Éclair", "tarte") > w2v.similarity("Éclair",
+                                                                  "road")
+
+    def test_fit_file_respects_configured_tokenizer(self, tmp_path):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor,
+            DefaultTokenizerFactory,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        p = tmp_path / "punct.txt"
+        p.write_text("Word. word, WORD!\n" * 30)
+        w2v = Word2Vec(layer_size=4, min_word_frequency=1, seed=1,
+                       tokenizer_factory=DefaultTokenizerFactory(
+                           CommonPreprocessor()))
+        w2v.fit_file(str(p))
+        # the pre-processor strips punctuation and lowercases: ONE vocab
+        # entry, not 'word.'/'word,' variants
+        assert w2v.has_word("word")
+        assert not w2v.has_word("word.")
