@@ -67,7 +67,7 @@ class SequenceVectors:
         return self.vocab
 
     def build_vocab_from_file(self, path: str, *, n_threads: int = 4,
-                              to_lower: bool = True) -> VocabCache:
+                              to_lower: bool = False) -> VocabCache:
         """File-corpus fast path: the native multithreaded scan counts the
         whole file outside the GIL (whitespace tokenization — matching
         ``DefaultTokenizerFactory``), then the standard cutoff/Huffman/
@@ -92,8 +92,13 @@ class SequenceVectors:
                               and tf._pre is None)
 
     def fit_file(self, path: str, *, n_threads: int = 4,
-                 to_lower: bool = True) -> "SequenceVectors":
+                 to_lower: bool = False) -> "SequenceVectors":
         """Train from a text file (one sentence per line).
+
+        ``to_lower`` defaults to False — the plain DefaultTokenizerFactory
+        that fit() would apply does NOT lowercase, and the two entry points
+        must build the same vocabulary from the same text. Opt into
+        lowercasing explicitly (ASCII-only, matching the native scan).
 
         With plain whitespace tokenization, vocabulary counting uses the
         native multithreaded scan and the training pass tokenizes the SAME

@@ -204,7 +204,7 @@ class VocabConstructor:
         self.special_tokens = list(special_tokens)
 
     def build_vocab_from_file(self, path: str, *, n_threads: int = 4,
-                              to_lower: bool = True) -> "VocabCache":
+                              to_lower: bool = False) -> "VocabCache":
         """Fast path for file corpora: the native multithreaded scan feeds
         the same cutoff/Huffman pipeline as :meth:`build_vocab`."""
         counts = scan_corpus_file(path, n_threads=n_threads,

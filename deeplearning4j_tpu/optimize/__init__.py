@@ -36,6 +36,7 @@ from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CollectScoresIterationListener,
     EvaluativeListener,
     PerformanceListener,
+    ProfilerListener,
     ScoreIterationListener,
     SleepyTrainingListener,
     TimeIterationListener,

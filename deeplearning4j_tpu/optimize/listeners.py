@@ -252,3 +252,71 @@ class OneTimeLogger:
     @classmethod
     def reset(cls) -> None:
         cls._seen.clear()
+
+
+class ProfilerListener(TrainingListener):
+    """Captures a jax profiler trace over a window of training iterations
+    (the SURVEY §5 plan: "jax profiler + per-step timing listener"; the
+    reference's nearest analog is ND4J's OpExecutioner profiling modes
+    toggled around runs).
+
+    Starts tracing at iteration ``start_iteration`` and stops after
+    ``n_iterations`` more, writing a TensorBoard-loadable trace directory —
+    XLA op timelines, fusion boundaries, and host/device activity for the
+    jitted train step. One-shot by default: re-arm with ``reset()``.
+    """
+
+    def __init__(self, log_dir: str, start_iteration: int = 3,
+                 n_iterations: int = 5):
+        self.log_dir = str(log_dir)
+        self.start_iteration = int(start_iteration)
+        self.n_iterations = max(1, int(n_iterations))
+        self._active = False
+        self._done = False
+        self._stop_at = None
+        self.last_error = None
+
+    def reset(self) -> None:
+        self._done = False
+
+    def _start(self):
+        import jax
+        try:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        except Exception as e:  # backend may not support tracing (tunnels)
+            self.last_error = f"{type(e).__name__}: {e}"
+            self._done = True
+
+    def _stop(self):
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+        self._active = False
+        self._done = True
+
+    def iteration_done(self, model, iteration, epoch):
+        # the iteration counter is cumulative across fit calls and epochs,
+        # so the window spans them; epoch boundaries deliberately do NOT
+        # close the trace (single-batch fit loops fire one epoch per step)
+        if self._done:
+            return
+        if not self._active and iteration >= self.start_iteration:
+            self._start()
+            self._stop_at = iteration + self.n_iterations
+        elif self._active and iteration >= self._stop_at:
+            # block so the traced window contains real device work, not
+            # just async dispatches
+            try:
+                model.score_
+            except Exception:
+                pass
+            self._stop()
+
+    def close(self) -> None:
+        """Stop tracing now if the window is still open (training ended
+        before ``n_iterations`` more steps ran)."""
+        if self._active:
+            self._stop()

@@ -164,3 +164,55 @@ class TestDistributedWord2Vec:
 
         a, b = train(1), train(8)
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-5)
+
+
+class TestProfilerListener:
+    def _net(self):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_traces_a_window_of_iterations(self, tmp_path):
+        import os
+
+        import numpy as np
+
+        from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+        net = self._net()
+        pl = ProfilerListener(str(tmp_path / "trace"), start_iteration=2,
+                              n_iterations=3)
+        net.set_listeners(pl)
+        x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 16)]
+        for _ in range(10):
+            net.fit(x, y)
+        assert pl._done
+        if pl.last_error is None:  # backend supports tracing
+            assert os.path.isdir(tmp_path / "trace")
+            found = [f for _, _, fs in os.walk(tmp_path / "trace") for f in fs]
+            assert found, "trace directory is empty"
+
+    def test_one_shot_and_rearm(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.optimize.listeners import ProfilerListener
+
+        net = self._net()
+        pl = ProfilerListener(str(tmp_path / "t2"), start_iteration=1,
+                              n_iterations=1)
+        net.set_listeners(pl)
+        x = np.zeros((4, 4), np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        for _ in range(5):
+            net.fit(x, y)
+        assert pl._done and not pl._active
+        pl.reset()
+        assert not pl._done

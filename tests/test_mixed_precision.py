@@ -190,3 +190,26 @@ class TestBatchNormMixedPrecisionInference:
         net.fit(x, y)
         out = np.asarray(net.output(x))
         assert out.shape == (4, 2) and np.isfinite(out).all()
+
+
+def test_batchnorm_f32_large_mean_stable():
+    """Full-precision BN must keep the two-pass variance: E[x^2]-E[x]^2 at
+    f32 cancels catastrophically for large-mean features (the fused
+    formulation is bf16/f16-only, where the f32 accumulator is wide)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers import BatchNormalizationLayer
+
+    l = BatchNormalizationLayer(n_in=4)
+    p = l.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = (rng.normal(size=(64, 4)) + 1e4).astype(np.float32)  # mean 1e4, std 1
+    y, st = l.forward(p, jnp.asarray(x), state=l.init_state(), train=True)
+    y = np.asarray(y)
+    assert np.isfinite(y).all()
+    # normalized output: per-feature std ~1 (variance was not clamped to 0)
+    assert 0.5 < y.std() < 2.0, y.std()
+    var = np.asarray(st["var"]) * 10  # decay 0.9: blended 0.1 * batch var
+    assert (var > 0.3).all(), var

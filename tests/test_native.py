@@ -235,7 +235,7 @@ class TestCorpusScan:
 
         p = self._corpus(tmp_path)
         vc = VocabConstructor(min_word_frequency=2)
-        cache_f = vc.build_vocab_from_file(p)
+        cache_f = vc.build_vocab_from_file(p, to_lower=True)
         seqs = [line.lower().split()
                 for line in open(p, encoding="utf-8").read().split("\n")]
         cache_s = vc.build_vocab(seqs)
