@@ -213,3 +213,41 @@ def test_batchnorm_f32_large_mean_stable():
     assert 0.5 < y.std() < 2.0, y.std()
     var = np.asarray(st["var"]) * 10  # decay 0.9: blended 0.1 * batch var
     assert (var > 0.3).all(), var
+
+
+def test_layernorm_bf16_accumulates_in_f32():
+    """bf16 LayerNorm moments must accumulate in f32: the normalized output
+    should track the f32 reference much closer than bf16 resolution."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers import LayerNormalizationLayer
+
+    l = LayerNormalizationLayer(n_in=768)
+    p = l.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x32 = (rng.normal(size=(4, 768)) + 5.0).astype(np.float32)  # nonzero mean
+    ref, _ = l.forward(p, jnp.asarray(x32))
+    out16, _ = l.forward(jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16), p), jnp.asarray(x32, jnp.bfloat16))
+    err = np.abs(np.asarray(out16, np.float32) - np.asarray(ref)).max()
+    assert err < 0.05, err  # bf16-rounded inputs, f32-accumulated moments
+
+
+def test_lowp_moments_f16_no_overflow():
+    """f16 streams square in f32 inside the moment reduction — |x| > 256
+    must not overflow to inf variance (bf16 shares f32's exponent range and
+    squares in-stream)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers.norm import _lowp_moments
+
+    x = jnp.asarray(np.full((4, 8), 1000.0), jnp.float16)
+    mean, var = _lowp_moments(x, -1, keepdims=True)
+    assert np.isfinite(np.asarray(mean)).all()
+    assert np.isfinite(np.asarray(var)).all()
+    xb = jnp.asarray(np.full((4, 8), 1e10), jnp.bfloat16)
+    mean, var = _lowp_moments(xb, -1, keepdims=True)
+    assert np.isfinite(np.asarray(mean)).all()
