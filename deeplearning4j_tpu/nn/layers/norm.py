@@ -30,18 +30,19 @@ def _lowp_moments(x, axes, keepdims=False):
     Each reduce has its own convert as a single-consumer producer, so XLA
     fuses it into the reduction (profiled on ResNet50: a shared
     ``x.astype(f32)`` feeding BOTH reductions materialized and cost ~14% of
-    the step). bf16 squares in the stream dtype (its exponent range equals
-    f32 — no overflow; measured ~4% faster); f16 squares in f32 because it
-    overflows at |x| > ~256. E[x^2]-E[x]^2 is safe here: the f32
-    accumulator carries far more precision than the stream it sums.
+    the step). The SQUARE always happens in f32: E[x^2]-E[x]^2 subtracts
+    two large numbers, so the x^2 terms need f32 resolution — a bf16-
+    rounded square carries error ~2^-9*mean^2, which swamps the true
+    variance once |mean| >> std (and f16 outright overflows at |x|>~256).
+    Cost measured ~4% of the LN op, invisible at model level; the f32
+    accumulator then keeps the summation exact enough.
     """
     cnt = 1
     for a in (axes if isinstance(axes, tuple) else (axes,)):
         cnt *= x.shape[a]
-    sq_src = x.astype(jnp.float32) if x.dtype == jnp.float16 else x
     mean = jnp.sum(x, axis=axes, keepdims=keepdims, dtype=jnp.float32) / cnt
     var = jnp.maximum(
-        jnp.sum(jnp.square(sq_src), axis=axes,
+        jnp.sum(jnp.square(x.astype(jnp.float32)), axis=axes,
                 keepdims=keepdims, dtype=jnp.float32) / cnt
         - jnp.square(mean), 0.0)
     return mean, var

@@ -251,3 +251,24 @@ def test_lowp_moments_f16_no_overflow():
     xb = jnp.asarray(np.full((4, 8), 1e10), jnp.bfloat16)
     mean, var = _lowp_moments(xb, -1, keepdims=True)
     assert np.isfinite(np.asarray(mean)).all()
+
+
+def test_lowp_moments_large_mean_accuracy():
+    """bf16 rows with mean >> std: the f32 square keeps the variance
+    estimate meaningful (a bf16 square's rounding error ~2^-9*mean^2 would
+    swamp it)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deeplearning4j_tpu.nn.layers.norm import _lowp_moments
+
+    rng = np.random.default_rng(0)
+    x32 = (rng.normal(size=(8, 768)) + 100.0).astype(np.float32)
+    mean, var = _lowp_moments(jnp.asarray(x32, jnp.bfloat16), -1,
+                              keepdims=True)
+    true_var = x32.var(axis=-1, keepdims=True)
+    # the bf16 INPUT quantization itself adds ~(100*2^-9)^2/12 ≈ 0.003
+    # variance noise; the estimate must stay within ~25% of truth, not
+    # collapse toward the zero clamp
+    rel = np.abs(np.asarray(var) - true_var) / true_var
+    assert rel.max() < 0.25, (rel.max(), np.asarray(var).min())
