@@ -146,8 +146,12 @@ def build_huffman(cache: VocabCache) -> None:
 
 
 def scan_corpus_file(path: str, *, n_threads: int = 4,
-                     to_lower: bool = True) -> Dict[str, int]:
+                     to_lower: bool = False) -> Dict[str, int]:
     """Word frequencies over a text file, split on ASCII whitespace.
+
+    ``to_lower`` defaults to False, matching ``build_vocab_from_file`` /
+    ``fit_file`` (the plain DefaultTokenizerFactory behavior) so counting
+    directly and training with defaults key the vocabulary identically.
 
     The reference's parallel corpus scan (``VocabConstructor.java:31``) as a
     native component: C++ worker threads count per-chunk outside the GIL

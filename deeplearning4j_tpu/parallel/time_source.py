@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import threading
 import time
 from typing import Optional
 
@@ -46,10 +47,16 @@ class NTPTimeSource(TimeSource):
     where t0/t3 are local send/receive and t1/t2 the server receive/send.
     On any socket failure the last good offset is kept (0 before the first
     success — i.e. plain system time, the reference's fallback).
+
+    ``current_time_millis`` never blocks: when a window expires it kicks a
+    background daemon thread to refresh the offset and returns immediately
+    with the last good one. Call ``sync()`` explicitly (e.g. at master
+    startup) to block for the first measurement.
     """
 
     def __init__(self, server: str = "pool.ntp.org", port: int = 123,
-                 timeout: float = 2.0, update_frequency: float = 1800.0):
+                 timeout: float = 2.0, update_frequency: float = 1800.0,
+                 eager: bool = True):
         self.server = server
         self.port = port
         self.timeout = timeout
@@ -57,6 +64,14 @@ class NTPTimeSource(TimeSource):
         self._offset_ms = 0.0
         self._last_sync: Optional[float] = None
         self.last_error: Optional[str] = None
+        self._sync_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._last_success: Optional[float] = None
+        self._sync_thread: Optional[threading.Thread] = None
+        if eager:
+            # start measuring at construction so the first stamps are already
+            # corrected; eager=False keeps the socket quiet until first use
+            self._sync_in_background()
 
     # ------------------------------------------------------------ protocol
     def _query_offset_ms(self) -> float:
@@ -82,30 +97,47 @@ class NTPTimeSource(TimeSource):
         return (((t1 - t0) + (t2 - t3)) / 2.0) * 1000.0
 
     def sync(self) -> bool:
-        """Force a sync now; True on success (offset updated)."""
+        """Force a sync now; True on success (offset updated).
+
+        Safe to call concurrently with the background refresh: state writes
+        are serialized, and a failing exchange never clobbers the result of
+        a success that completed after it started.
+        """
+        started = time.time()
         try:
-            self._offset_ms = self._query_offset_ms()
-            self._last_sync = time.time()
+            offset = self._query_offset_ms()
+        except (OSError, ValueError) as e:  # timeout/unreachable/short resp.
+            with self._state_lock:
+                if self._last_success is None or self._last_success < started:
+                    self.last_error = (f"{type(e).__name__}: {e}"
+                                       if isinstance(e, OSError) else str(e))
+                    self._last_sync = time.time()  # back off until next window
+            return False
+        with self._state_lock:
+            self._offset_ms = offset
+            self._last_sync = self._last_success = time.time()
             self.last_error = None
-            return True
-        except OSError as e:  # timeout, unreachable, resolution failure
-            self.last_error = f"{type(e).__name__}: {e}"
-            self._last_sync = time.time()  # back off until next window
-            return False
-        except ValueError as e:
-            self.last_error = str(e)
-            self._last_sync = time.time()
-            return False
+        return True
 
     @property
     def offset_millis(self) -> float:
         return self._offset_ms
 
+    def _sync_in_background(self) -> None:
+        """Start one refresh thread if none is running (non-blocking)."""
+        with self._sync_lock:
+            if self._sync_thread is not None and self._sync_thread.is_alive():
+                return
+            t = threading.Thread(target=self.sync, daemon=True,
+                                 name="ntp-time-source-sync")
+            self._sync_thread = t
+            t.start()
+
     def current_time_millis(self) -> int:
         now = time.time()
         if (self._last_sync is None
                 or now - self._last_sync > self.update_frequency):
-            self.sync()
+            self._sync_in_background()
         return int(now * 1000 + self._offset_ms)
 
 

@@ -774,6 +774,7 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
     import jax.numpy as jnp
     import numpy as np
 
+    _require_graph(net, "generate_on_device")
     ids, empty = _prep_prompt(net, prompt_ids, n_new_tokens)
     if empty is not None:
         return empty
@@ -852,7 +853,7 @@ def generate_on_device(net, prompt_ids, n_new_tokens: int,
 
 
 def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
-                eos_id: int = None):
+                eos_id: int = None, length_penalty: float = 0.0):
     """Device-side beam search over a :class:`TransformerLM`-style network:
     the beams ride the batch axis (N*beam KV caches), each `lax.scan` step
     scores beam*vocab continuations, takes the top-k, and RE-INDEXES every
@@ -860,13 +861,24 @@ def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
     is a single compiled dispatch, like :func:`generate_on_device`.
 
     With ``eos_id``, finished beams only extend with ``eos_id`` at zero
-    cost (score frozen). Returns ``(tokens [N, n_new_tokens], scores [N])``
-    for the best beam per batch row; log-probability scores.
+    cost (score frozen). Raw scores are unnormalized log-prob sums, which
+    favor beams that hit EOS early (shorter sums are less negative);
+    ``length_penalty`` > 0 corrects that early-termination bias by ranking
+    beams on ``score / length**length_penalty`` (GNMT-style; 1.0 = mean
+    log-prob per token, 0.0 = raw sums, the biased legacy behavior).
+    Returns ``(tokens [N, n_new_tokens], scores [N])`` for the best beam
+    per batch row; scores are the ranking values (normalized when
+    ``length_penalty`` > 0).
     """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    _require_graph(net, "beam_search")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0 (got {length_penalty}); 0 disables "
+            "normalization, larger values favor longer beams")
     ids, empty = _prep_prompt(net, prompt_ids, n_new_tokens)
     if empty is not None:
         return empty, np.zeros((ids.shape[0],), np.float32)
@@ -877,7 +889,8 @@ def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
 
     inp = net.conf.inputs[0]
     out_name = net.conf.outputs[0]
-    key = ("beam", n_new_tokens, b, eos_id, _helpers.version())
+    key = ("beam", n_new_tokens, b, eos_id, float(length_penalty),
+           _helpers.version())
     if key not in net._jit_cache:
         net._evict_stale(_helpers.version())
         dtype = net.conf.global_conf.jnp_dtype()
@@ -926,9 +939,14 @@ def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
             row = jnp.arange(n)[:, None] * b
             toks = jnp.zeros((n, b, n_new_tokens), jnp.int32)
             toks = toks.at[:, :, 0].set(tok)
+            use_len = bool(length_penalty > 0)
+            # tokens before/incl. EOS; scalar placeholder keeps the carry
+            # structure stable when normalization is off (no dead gathers)
+            length = (jnp.ones((n, b), jnp.float32) if use_len
+                      else jnp.zeros(()))
 
             def step(carry, i):
-                carries, toks, scores, finished, last = carry
+                carries, toks, scores, finished, length, last = carry
                 x = last.reshape(nb)[:, None, None].astype(dtype)
                 acts, _, _, carries = net._forward_all(
                     params, states, {inp: x}, train=False, rng=None,
@@ -940,15 +958,20 @@ def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
                 carries = gather_beams(carries, flat_idx, nb)
                 toks = jnp.take_along_axis(toks, beam_idx[:, :, None], axis=1)
                 finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+                if use_len:
+                    length = jnp.take_along_axis(length, beam_idx, axis=1)
+                    length = jnp.where(finished, length, length + 1.0)
                 toks = jax.lax.dynamic_update_index_in_dim(
                     toks, tok, i, axis=2)
                 if eos_id is not None:
                     finished = finished | (tok == eos_id)
-                return (carries, toks, scores, finished, tok), None
+                return (carries, toks, scores, finished, length, tok), None
 
-            (carries, toks, scores, finished, _), _ = jax.lax.scan(
-                step, (carries, toks, scores, finished, tok),
+            (carries, toks, scores, finished, length, _), _ = jax.lax.scan(
+                step, (carries, toks, scores, finished, length, tok),
                 jnp.arange(1, n_new_tokens))
+            if use_len:
+                scores = scores / jnp.maximum(length, 1.0) ** length_penalty
             best = jnp.argmax(scores, axis=1)
             return (jnp.take_along_axis(
                         toks, best[:, None, None], axis=1)[:, 0],
@@ -958,6 +981,19 @@ def beam_search(net, prompt_ids, n_new_tokens: int, beam_size: int = 4,
     toks, scores = net._jit_cache[key](net.params, net.states,
                                        jnp.asarray(ids, jnp.float32))
     return np.asarray(toks).astype(np.int64), np.asarray(scores)
+
+
+def _require_graph(net, fn_name: str) -> None:
+    """The compiled decode paths drive ComputationGraph internals
+    (conf.vertices / conf.layer_vertices / conf.inputs); fail with a clear
+    message instead of an AttributeError deep inside for other net types
+    (the host-loop :func:`generate` handles MultiLayerNetwork)."""
+    conf = getattr(net, "conf", None)
+    if not (hasattr(conf, "vertices") and hasattr(conf, "inputs")):
+        raise TypeError(
+            f"{fn_name} requires a ComputationGraph-based network "
+            f"(e.g. TransformerLM.build()); got {type(net).__name__}. "
+            "Use generate() for MultiLayerNetwork models.")
 
 
 def _prep_prompt(net, prompt_ids, n_new_tokens: int):

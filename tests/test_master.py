@@ -205,7 +205,8 @@ class TestTimeSource:
     def test_offset_measured_from_fake_server(self):
         from deeplearning4j_tpu.parallel.time_source import NTPTimeSource
         port = self._fake_ntp_server(offset_s=5.0)
-        ts = NTPTimeSource(server="127.0.0.1", port=port, timeout=3.0)
+        ts = NTPTimeSource(server="127.0.0.1", port=port, timeout=3.0,
+                           eager=False)
         assert ts.sync()
         assert 4000 < ts.offset_millis < 6000   # ~5 s, minus round trip
         import time
@@ -215,11 +216,29 @@ class TestTimeSource:
     def test_unreachable_server_falls_back_to_system_clock(self):
         import time
         from deeplearning4j_tpu.parallel.time_source import NTPTimeSource
-        ts = NTPTimeSource(server="127.0.0.1", port=9, timeout=0.2)
+        ts = NTPTimeSource(server="127.0.0.1", port=9, timeout=0.2,
+                           eager=False)
         assert not ts.sync()
         assert ts.last_error is not None
         assert ts.offset_millis == 0.0
         assert abs(ts.current_time_millis() - time.time() * 1000) < 1500
+
+    def test_current_time_millis_never_blocks(self):
+        # an expired window must NOT pay the SNTP round trip on the stamp
+        # path (ADVICE r1): the refresh happens on a background thread
+        import time
+        from deeplearning4j_tpu.parallel.time_source import NTPTimeSource
+        ts = NTPTimeSource(server="127.0.0.1", port=9, timeout=1.5,
+                           update_frequency=0.0,   # every call is "expired"
+                           eager=False)
+        t0 = time.time()
+        ts.current_time_millis()
+        assert time.time() - t0 < 0.5              # returned before timeout
+        # the background refresh does run and records its failure
+        deadline = time.time() + 5.0
+        while ts.last_error is None and time.time() < deadline:
+            time.sleep(0.05)
+        assert ts.last_error is not None
 
     def test_training_stats_events_use_time_source(self):
         from deeplearning4j_tpu.parallel.master import TrainingStats

@@ -212,9 +212,9 @@ class TestCorpusScan:
         from deeplearning4j_tpu.nlp.vocab import scan_corpus_file
 
         p = self._corpus(tmp_path)
-        got_native = scan_corpus_file(p, n_threads=3)
+        got_native = scan_corpus_file(p, n_threads=3, to_lower=True)
         monkeypatch.setattr(native, "_load", lambda: None)
-        got_py = scan_corpus_file(p, n_threads=3)
+        got_py = scan_corpus_file(p, n_threads=3, to_lower=True)
         assert dict(got_native) == dict(got_py)
         want = Counter(w.decode("utf-8", errors="replace")
                        for w in open(p, "rb").read().lower().split())

@@ -279,6 +279,51 @@ class TestBeamSearch:
         assert toks.shape == (1, 0)
         with np.testing.assert_raises(ValueError):
             beam_search(net, np.ones((1, 10)), 10)
+        with np.testing.assert_raises_regex(ValueError, "length_penalty"):
+            beam_search(net, np.array([[1, 2]]), 3, length_penalty=-0.5)
+
+    def test_length_penalty_normalizes_scores(self):
+        # with no EOS every beam has full length L, so alpha=1.0 must
+        # return exactly rawscore/L for the same winning beam
+        from deeplearning4j_tpu.zoo.models import beam_search
+        net = self._trained()
+        prompt = cycle_batch(np.random.default_rng(1), 2, 6)
+        toks_raw, s_raw = beam_search(net, prompt, 6, beam_size=3)
+        toks_n, s_n = beam_search(net, prompt, 6, beam_size=3,
+                                  length_penalty=1.0)
+        assert (toks_raw == toks_n).all()
+        np.testing.assert_allclose(s_n, s_raw / 6.0, rtol=1e-5)
+
+    def test_length_penalty_counts_tokens_to_eos(self):
+        # an early-EOS beam's frozen raw sum is divided by its true short
+        # length, not the full horizon
+        from deeplearning4j_tpu.zoo.models import beam_search
+        net = self._trained()
+        prompt = cycle_batch(np.random.default_rng(1), 1, 6)
+        want = (prompt[:, -1:] + 3 * np.arange(1, 7)[None, :]) % VOCAB
+        eos = int(want[0, 1])                 # hit at step 1 → length 2
+        toks, s_n = beam_search(net, prompt, 6, beam_size=1, eos_id=eos,
+                                length_penalty=1.0)
+        _, s_raw = beam_search(net, prompt, 6, beam_size=1, eos_id=eos)
+        assert toks[0, 1] == eos
+        np.testing.assert_allclose(s_n, s_raw / 2.0, rtol=1e-5)
+
+    def test_graph_only_paths_reject_mln_clearly(self):
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.zoo.models import (beam_search,
+                                                   generate_on_device)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8))
+                .layer(OutputLayer(n_in=8, n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with np.testing.assert_raises_regex(TypeError, "ComputationGraph"):
+            generate_on_device(net, np.array([[1, 2]]), 3)
+        with np.testing.assert_raises_regex(TypeError, "ComputationGraph"):
+            beam_search(net, np.array([[1, 2]]), 3)
 
 
 class TestTopKTopP:
