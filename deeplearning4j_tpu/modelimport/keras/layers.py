@@ -276,6 +276,70 @@ def _bidirectional_weights(inner_fn: WeightFn) -> WeightFn:
     return fn
 
 
+def _one_constraint(spec, scope: str):
+    """One serialized Keras constraint → LayerConstraint (keras.constraints:
+    MaxNorm/NonNeg/UnitNorm/MinMaxNorm). Keras ``axis`` is the norm's
+    reduction axis — the same meaning as our ``dimensions``, and both
+    frameworks share the kernel layouts (Dense [in,out], conv HWIO), so it
+    maps through unchanged."""
+    from deeplearning4j_tpu.nn.constraints import (
+        MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+        UnitNormConstraint)
+    if spec is None:
+        return None
+    cls = spec.get("class_name", "")
+    c = spec.get("config", {})
+    ax = c.get("axis")
+    dims = None if ax is None else tuple(ax) if isinstance(ax, (list, tuple)) \
+        else (int(ax),)
+    if cls in ("MaxNorm", "max_norm", "maxnorm"):
+        return MaxNormConstraint(max_norm=float(c.get("max_value", 2.0)),
+                                 dimensions=dims, scope=scope)
+    if cls in ("MinMaxNorm", "min_max_norm"):
+        return MinMaxNormConstraint(min_norm=float(c.get("min_value", 0.0)),
+                                    max_norm=float(c.get("max_value", 1.0)),
+                                    rate=float(c.get("rate", 1.0)),
+                                    dimensions=dims, scope=scope)
+    if cls in ("NonNeg", "non_neg", "nonneg"):
+        return NonNegativeConstraint(scope=scope)
+    if cls in ("UnitNorm", "unit_norm", "unitnorm"):
+        return UnitNormConstraint(dimensions=dims, scope=scope)
+    raise UnsupportedKerasConfigurationException(
+        f"Unsupported Keras constraint: {cls!r} (supported: MaxNorm, "
+        "MinMaxNorm, NonNeg, UnitNorm)")
+
+
+def recurrent_constraints_from_keras_cfg(cfg: dict):
+    """Recurrent layers name their targets: kernel→W, recurrent_kernel→RW,
+    bias→b (explicit param names rather than scopes)."""
+    out = []
+    for key, pnames in (("kernel_constraint", ("W",)),
+                        ("W_constraint", ("W",)),
+                        ("recurrent_constraint", ("RW",)),
+                        ("U_constraint", ("RW",)),
+                        ("bias_constraint", ("b",)),
+                        ("b_constraint", ("b",))):
+        c = _one_constraint(cfg.get(key), "weights")
+        if c is not None:
+            import dataclasses as _dc
+            out.append(_dc.replace(c, param_names=pnames))
+    return out or None
+
+
+def constraints_from_keras_cfg(cfg: dict):
+    """Map ``kernel_constraint`` / ``bias_constraint`` (and the Keras-1
+    ``W_constraint`` / ``b_constraint`` spellings) to our constraint list."""
+    out = []
+    for key, scope in (("kernel_constraint", "weights"),
+                       ("W_constraint", "weights"),
+                       ("bias_constraint", "bias"),
+                       ("b_constraint", "bias")):
+        c = _one_constraint(cfg.get(key), scope)
+        if c is not None:
+            out.append(c)
+    return out or None
+
+
 def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], WeightFn]:
     """One Keras layer config → (our layer or None if structural, weight_fn).
 
@@ -291,7 +355,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
     if class_name == "Dense":
         units = cfg.get("units", cfg.get("output_dim"))
         return DenseLayer(name=name, n_out=int(units), activation=act or "identity",
-                          has_bias=cfg.get("use_bias", cfg.get("bias", True))), _dense_weights
+                          has_bias=cfg.get("use_bias", cfg.get("bias", True)),
+                          constraints=constraints_from_keras_cfg(cfg)), _dense_weights
 
     if class_name in ("Conv2D", "Convolution2D"):
         filters = cfg.get("filters", cfg.get("nb_filter"))
@@ -306,7 +371,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
                                  stride=strides, convolution_mode=mode,
                                  dilation=_pair(cfg.get("dilation_rate"), (1, 1)),
                                  activation=act or "identity",
-                                 has_bias=cfg.get("use_bias", cfg.get("bias", True))),
+                                 has_bias=cfg.get("use_bias", cfg.get("bias", True)),
+                                 constraints=constraints_from_keras_cfg(cfg)),
                 _dense_weights)
 
     if class_name in ("Conv1D", "Convolution1D"):
@@ -320,7 +386,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         return (Convolution1DLayer(name=name, n_out=int(filters),
                                    kernel_size=k, stride=s,
                                    convolution_mode=mode,
-                                   activation=act or "identity"),
+                                   activation=act or "identity",
+                                   constraints=constraints_from_keras_cfg(cfg)),
                 _conv1d_weights)
 
     if class_name == "SeparableConv2D":
@@ -330,7 +397,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
             stride=_pair(cfg.get("strides"), (1, 1)),
             depth_multiplier=int(cfg.get("depth_multiplier", 1)),
             convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
-            activation=act or "identity"), _sepconv_weights)
+            activation=act or "identity",
+            constraints=constraints_from_keras_cfg(cfg)), _sepconv_weights)
 
     if class_name == "DepthwiseConv2D":
         return (DepthwiseConvolution2DLayer(
@@ -339,7 +407,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
             stride=_pair(cfg.get("strides"), (1, 1)),
             depth_multiplier=int(cfg.get("depth_multiplier", 1)),
             convolution_mode="same" if cfg.get("padding") == "same" else "truncate",
-            activation=act or "identity"), _depthwise_weights)
+            activation=act or "identity",
+            constraints=constraints_from_keras_cfg(cfg)), _depthwise_weights)
 
     if class_name in ("MaxPooling2D", "AveragePooling2D"):
         pt = "max" if class_name.startswith("Max") else "avg"
@@ -370,11 +439,33 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         rate = cfg.get("rate", cfg.get("p", 0.5))
         return DropoutLayer(name=name, dropout=1.0 - float(rate)), _no_weights
 
-    if class_name in ("SpatialDropout2D", "SpatialDropout1D", "GaussianDropout",
-                      "GaussianNoise", "AlphaDropout"):
-        # noise layers: approximated by plain dropout (inference-identical)
-        rate = cfg.get("rate", cfg.get("p", 0.5))
-        return DropoutLayer(name=name, dropout=1.0 - float(rate)), _no_weights
+    if class_name in ("SpatialDropout1D", "SpatialDropout2D",
+                      "SpatialDropout3D"):
+        # real channel dropout (keras SpatialDropoutND → nn/dropout.py)
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        rate = float(cfg.get("rate", cfg.get("p", 0.5)))
+        return (DropoutLayer(name=name, dropout=SpatialDropout(p=1.0 - rate)),
+                _no_weights)
+
+    if class_name == "GaussianDropout":
+        # keras rate IS the reference's rate: noise std = sqrt(rate/(1-rate))
+        from deeplearning4j_tpu.nn.dropout import GaussianDropout
+        rate = float(cfg.get("rate", cfg.get("p", 0.5)))
+        return (DropoutLayer(name=name, dropout=GaussianDropout(rate=rate)),
+                _no_weights)
+
+    if class_name == "GaussianNoise":
+        from deeplearning4j_tpu.nn.dropout import GaussianNoise
+        stddev = float(cfg.get("stddev", cfg.get("sigma", 0.1)))
+        return (DropoutLayer(name=name, dropout=GaussianNoise(stddev=stddev)),
+                _no_weights)
+
+    if class_name == "AlphaDropout":
+        # real SNN dropout (AlphaDropout.java:38), not a plain-dropout stand-in
+        from deeplearning4j_tpu.nn.dropout import AlphaDropout
+        rate = float(cfg.get("rate", cfg.get("p", 0.5)))
+        return (DropoutLayer(name=name, dropout=AlphaDropout(p=1.0 - rate)),
+                _no_weights)
 
     if class_name == "Activation":
         return ActivationLayer(name=name, activation=act or "identity"), _no_weights
@@ -387,7 +478,11 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         return ActivationLayer(name=name, activation="elu"), _no_weights
 
     if class_name == "ThresholdedReLU":
-        return ActivationLayer(name=name, activation="relu"), _no_weights
+        theta = float(cfg.get("theta", 1.0))
+        return (ActivationLayer(name=name,
+                                activation=("thresholdedrelu",
+                                            {"theta": theta})),
+                _no_weights)
 
     if class_name == "BatchNormalization":
         eps = float(cfg.get("epsilon", 1e-3))
@@ -396,10 +491,16 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
                                         activation="identity"), _bn_weights)
 
     if class_name == "Embedding":
+        emb_cs = None
+        if cfg.get("embeddings_constraint") is not None:
+            import dataclasses as _dc
+            c = _one_constraint(cfg["embeddings_constraint"], "weights")
+            emb_cs = [_dc.replace(c, param_names=("W",))]
         return (EmbeddingSequenceLayer(name=name,
                                        n_in=int(cfg.get("input_dim")),
                                        n_out=int(cfg.get("output_dim")),
-                                       activation="identity", has_bias=False),
+                                       activation="identity", has_bias=False,
+                                       constraints=emb_cs),
                 _embedding_weights)
 
     if class_name == "LSTM":
@@ -409,7 +510,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
             activation=map_activation(cfg.get("activation", "tanh")),
             gate_activation=map_activation(
                 cfg.get("recurrent_activation", cfg.get("inner_activation", "sigmoid"))),
-            forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0)
+            forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0,
+            constraints=recurrent_constraints_from_keras_cfg(cfg))
         wf = _lstm_weights_fn(units)
         if not cfg.get("return_sequences", False):
             # LastTimeStepWrapper stores the inner layer's params unprefixed,
@@ -430,7 +532,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
             activation=map_activation(cfg.get("activation", "tanh")),
             gate_activation=map_activation(
                 cfg.get("recurrent_activation",
-                        cfg.get("inner_activation", "sigmoid"))))
+                        cfg.get("inner_activation", "sigmoid"))),
+            constraints=recurrent_constraints_from_keras_cfg(cfg))
 
         def gru_weights(raw):
             # keras GRU: kernel [C, 3H] (z|r|h), recurrent_kernel [H, 3H],
@@ -530,7 +633,8 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
     if class_name == "SimpleRNN":
         units = int(cfg.get("units", cfg.get("output_dim")))
         layer = SimpleRnnLayer(name=name, n_out=units,
-                               activation=map_activation(cfg.get("activation", "tanh")))
+                               activation=map_activation(cfg.get("activation", "tanh")),
+                               constraints=recurrent_constraints_from_keras_cfg(cfg))
         if not cfg.get("return_sequences", False):
             return LastTimeStepWrapper(name=name, layer=layer), _rnn_weights
         return layer, _rnn_weights

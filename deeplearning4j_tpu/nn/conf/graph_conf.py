@@ -197,13 +197,8 @@ class ComputationGraphConfiguration:
 
     # ---------------------------------------------------------------- serde
     def to_dict(self) -> dict:
-        g = dataclasses.asdict(self.global_conf)
-        if self.global_conf.updater is not None:
-            g["updater"] = self.global_conf.updater.to_dict()
-        if self.global_conf.bias_updater is not None:
-            g["bias_updater"] = self.global_conf.bias_updater.to_dict()
-        if self.global_conf.distribution is not None:
-            g["distribution"] = self.global_conf.distribution.to_dict()
+        from deeplearning4j_tpu.nn.conf.network import global_conf_to_dict
+        g = global_conf_to_dict(self.global_conf)
         return {
             "format": "deeplearning4j_tpu.ComputationGraphConfiguration",
             "version": 1,
@@ -226,13 +221,7 @@ class ComputationGraphConfiguration:
 
     @staticmethod
     def from_dict(d: dict) -> "ComputationGraphConfiguration":
-        g = dict(d["global"])
-        if isinstance(g.get("updater"), dict):
-            g["updater"] = Updater.from_dict(g["updater"])
-        if isinstance(g.get("bias_updater"), dict):
-            g["bias_updater"] = Updater.from_dict(g["bias_updater"])
-        if isinstance(g.get("distribution"), dict):
-            g["distribution"] = Distribution.from_dict(g["distribution"])
+        from deeplearning4j_tpu.nn.conf.network import global_conf_from_dict
         vertices: Dict[str, VertexDef] = {}
         for vd in d["vertices"]:
             obj_d = vd["def"]
@@ -240,7 +229,7 @@ class ComputationGraphConfiguration:
                    else GraphVertex.from_dict(obj_d))
             vertices[vd["name"]] = VertexDef(vd["name"], obj, list(vd["inputs"]))
         conf = ComputationGraphConfiguration(
-            global_conf=GlobalConf(**g),
+            global_conf=global_conf_from_dict(d["global"]),
             inputs=list(d["inputs"]),
             outputs=list(d["outputs"]),
             vertices=vertices,

@@ -37,7 +37,13 @@ class GlobalConf:
     l2: Optional[float] = None
     l1_bias: Optional[float] = None
     l2_bias: Optional[float] = None
-    dropout: Optional[float] = None
+    dropout: Optional[Any] = None  # float keep-prob or IDropout
+    weight_noise: Optional[Any] = None  # IWeightNoise (WeightNoise/DropConnect)
+    # builder-level constraints, attached to every layer at finalize()
+    # (NeuralNetConfiguration.java:1031-1060)
+    all_constraints: Optional[List[Any]] = None
+    weight_constraints: Optional[List[Any]] = None
+    bias_constraints: Optional[List[Any]] = None
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
     mini_batch: bool = True
@@ -62,6 +68,49 @@ class GlobalConf:
             return None
         return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
                 "float16": jnp.float16}[self.compute_dtype]
+
+
+def global_conf_to_dict(gc: GlobalConf) -> dict:
+    """Serialize a GlobalConf, tagging the nested spec objects."""
+    from deeplearning4j_tpu.nn.dropout import IDropout
+    g = dataclasses.asdict(gc)
+    if gc.updater is not None:
+        g["updater"] = gc.updater.to_dict()
+    if gc.bias_updater is not None:
+        g["bias_updater"] = gc.bias_updater.to_dict()
+    if gc.distribution is not None:
+        g["distribution"] = gc.distribution.to_dict()
+    if isinstance(gc.dropout, IDropout):
+        g["dropout"] = gc.dropout.to_dict()
+    if gc.weight_noise is not None:
+        g["weight_noise"] = gc.weight_noise.to_dict()
+    for key in ("all_constraints", "weight_constraints", "bias_constraints"):
+        v = getattr(gc, key)
+        if v:
+            g[key] = [c.to_dict() for c in v]
+    return g
+
+
+def global_conf_from_dict(d: dict) -> GlobalConf:
+    from deeplearning4j_tpu.nn.constraints import LayerConstraint
+    from deeplearning4j_tpu.nn.dropout import IDropout
+    from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
+    g = dict(d)
+    if isinstance(g.get("updater"), dict):
+        g["updater"] = Updater.from_dict(g["updater"])
+    if isinstance(g.get("bias_updater"), dict):
+        g["bias_updater"] = Updater.from_dict(g["bias_updater"])
+    if isinstance(g.get("distribution"), dict):
+        g["distribution"] = Distribution.from_dict(g["distribution"])
+    if isinstance(g.get("dropout"), dict):
+        g["dropout"] = IDropout.from_dict(g["dropout"])
+    if isinstance(g.get("weight_noise"), dict):
+        g["weight_noise"] = IWeightNoise.from_dict(g["weight_noise"])
+    for key in ("all_constraints", "weight_constraints", "bias_constraints"):
+        if g.get(key):
+            g[key] = [LayerConstraint.from_dict(c) if isinstance(c, dict)
+                      else c for c in g[key]]
+    return GlobalConf(**g)
 
 
 class NeuralNetConfiguration:
@@ -125,8 +174,33 @@ class Builder:
         self._g.l2_bias = v
         return self
 
-    def dropout(self, keep_prob: float) -> "Builder":
+    def dropout(self, keep_prob) -> "Builder":
+        """Float keep probability (DL4J shorthand) or an IDropout instance
+        (AlphaDropout, GaussianDropout, GaussianNoise, SpatialDropout)."""
         self._g.dropout = keep_prob
+        return self
+
+    def weight_noise(self, wn) -> "Builder":
+        """IWeightNoise applied to every layer's weights at train forward
+        time (``NeuralNetConfiguration.Builder.weightNoise:945``) — e.g.
+        ``DropConnect(0.5)`` or ``WeightNoise(Distribution(...))``."""
+        self._g.weight_noise = wn
+        return self
+
+    def constrain_all_parameters(self, *constraints) -> "Builder":
+        """Apply constraints to ALL parameters of every layer after each
+        update (``NeuralNetConfiguration.java:1031``)."""
+        self._g.all_constraints = (self._g.all_constraints or []) + list(constraints)
+        return self
+
+    def constrain_bias(self, *constraints) -> "Builder":
+        """Post-update constraints on bias parameters only (``:1043``)."""
+        self._g.bias_constraints = (self._g.bias_constraints or []) + list(constraints)
+        return self
+
+    def constrain_weights(self, *constraints) -> "Builder":
+        """Post-update constraints on weight parameters only (``:1055``)."""
+        self._g.weight_constraints = (self._g.weight_constraints or []) + list(constraints)
         return self
 
     def gradient_normalization(self, mode: str, threshold: float = 1.0) -> "Builder":
@@ -303,13 +377,7 @@ class MultiLayerConfiguration:
 
     # -- serde ---------------------------------------------------------------
     def to_dict(self) -> dict:
-        g = dataclasses.asdict(self.global_conf)
-        if self.global_conf.updater is not None:
-            g["updater"] = self.global_conf.updater.to_dict()
-        if self.global_conf.bias_updater is not None:
-            g["bias_updater"] = self.global_conf.bias_updater.to_dict()
-        if self.global_conf.distribution is not None:
-            g["distribution"] = self.global_conf.distribution.to_dict()
+        g = global_conf_to_dict(self.global_conf)
         return {
             "format": "deeplearning4j_tpu.MultiLayerConfiguration",
             "version": 1,
@@ -326,15 +394,8 @@ class MultiLayerConfiguration:
 
     @staticmethod
     def from_dict(d: dict) -> "MultiLayerConfiguration":
-        g = dict(d["global"])
-        if isinstance(g.get("updater"), dict):
-            g["updater"] = Updater.from_dict(g["updater"])
-        if isinstance(g.get("bias_updater"), dict):
-            g["bias_updater"] = Updater.from_dict(g["bias_updater"])
-        if isinstance(g.get("distribution"), dict):
-            g["distribution"] = Distribution.from_dict(g["distribution"])
         conf = MultiLayerConfiguration(
-            global_conf=GlobalConf(**g),
+            global_conf=global_conf_from_dict(d["global"]),
             layers=[layer_from_dict(ld) for ld in d["layers"]],
             input_type=None if d.get("input_type") is None else InputType.from_dict(d["input_type"]),
             backprop_type=d.get("backprop_type", "standard"),

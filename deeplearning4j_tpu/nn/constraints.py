@@ -1,0 +1,198 @@
+"""Parameter constraints applied AFTER each update, inside the jitted step.
+
+Reference: ``nn/conf/constraint/`` — ``BaseConstraint.java`` (LayerConstraint
+SPI, per-param-name application), ``MaxNormConstraint.java:21``,
+``MinMaxNormConstraint.java``, ``NonNegativeConstraint.java``,
+``UnitNormConstraint.java``. Set per-layer (``constraints=[...]``) or via the
+network builder (``constrain_weights`` / ``constrain_bias`` /
+``constrain_all_parameters``), exactly like the DL4J builder hooks
+(``NeuralNetConfiguration.java:1031-1060``).
+
+TPU-first framing: a constraint is a pure array→array projection composed
+onto the parameter after the updater's delta, so it fuses into the one
+donated-buffer train step — no post-step host round trip.
+
+Norm ``dimensions`` are the REDUCTION axes of the L2 norm. ``None`` (the
+default) reduces over all axes except the last, which for this framework's
+layouts (Dense ``[n_in, n_out]``, conv ``[kh, kw, in, out]``) is the norm of
+the incoming weights of each output unit — the same quantity DL4J's
+"dimension 1 on [nIn, nOut]" and Keras's default ``axis=0`` compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+CONSTRAINT_REGISTRY: Dict[str, type] = {}
+
+DEFAULT_EPSILON = 1e-6  # BaseConstraint.DEFAULT_EPSILON
+
+
+def register_constraint(cls):
+    CONSTRAINT_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class LayerConstraint:
+    """SPI (``nn/api/layers/LayerConstraint.java`` role).
+
+    ``param_names``: explicit parameter names to constrain; when ``None``
+    the ``scope`` picks them from the layer ("weights" | "bias" | "all" —
+    the three DL4J builder hooks).
+    """
+
+    param_names: Optional[Tuple[str, ...]] = None
+    scope: str = "weights"
+    dimensions: Optional[Tuple[int, ...]] = None
+
+    # -- application -------------------------------------------------------
+    def apply(self, param: Array) -> Array:
+        raise NotImplementedError
+
+    def apply_to(self, layer, params: Dict[str, Array]) -> Dict[str, Array]:
+        """Constrain the selected entries of one layer's param dict."""
+        if self.param_names is not None:
+            names = set(self.param_names)
+        elif self.scope == "all":
+            names = set(params)
+        elif self.scope == "bias":
+            names = set(layer.bias_param_names())
+        else:
+            names = set(layer.weight_param_names())
+        return {n: (self.apply(v) if n in names else v)
+                for n, v in params.items()}
+
+    def scoped(self, scope: str) -> "LayerConstraint":
+        return dataclasses.replace(self, scope=scope)
+
+    # -- norm helper -------------------------------------------------------
+    def _axes(self, param: Array) -> Tuple[int, ...]:
+        if self.dimensions is not None:
+            return tuple(int(d) for d in self.dimensions)
+        return tuple(range(max(param.ndim - 1, 1)))  # all but last (≥1 axis)
+
+    def _norm2(self, param: Array) -> Array:
+        axes = self._axes(param)
+        if param.ndim == 1:
+            axes = (0,)
+        return jnp.sqrt(jnp.sum(jnp.square(param), axis=axes, keepdims=True))
+
+    # -- serde -------------------------------------------------------------
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        for k in ("param_names", "dimensions"):
+            if k in d:
+                d[k] = list(d[k])
+        d["@constraint"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "LayerConstraint":
+        d = dict(d)
+        cls = CONSTRAINT_REGISTRY[d.pop("@constraint")]
+        for k in ("param_names", "dimensions"):
+            if isinstance(d.get(k), list):
+                d[k] = tuple(d[k])
+        return cls(**d)
+
+
+@register_constraint
+@dataclasses.dataclass
+class MaxNormConstraint(LayerConstraint):
+    """Scale down any unit whose incoming-weight L2 norm exceeds ``max_norm``
+    (``MaxNormConstraint.java:21``: norm2 over dims, clip, rescale)."""
+
+    max_norm: float = 1.0
+
+    def apply(self, param: Array) -> Array:
+        norm = self._norm2(param)
+        clipped = jnp.minimum(norm, self.max_norm)
+        return param * (clipped / (norm + DEFAULT_EPSILON))
+
+
+@register_constraint
+@dataclasses.dataclass
+class MinMaxNormConstraint(LayerConstraint):
+    """Constrain incoming-weight norms into ``[min_norm, max_norm]``
+    (``MinMaxNormConstraint.java``). ``rate`` blends toward the projection,
+    Keras ``min_max_norm`` style: scale = rate·clip(n)/(n+ε) + (1−rate)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 1.0
+    rate: float = 1.0
+
+    def __post_init__(self):
+        if not (0.0 < self.rate <= 1.0):
+            raise ValueError(f"Invalid rate: must be in (0, 1]: got {self.rate}")
+
+    def apply(self, param: Array) -> Array:
+        norm = self._norm2(param)
+        clipped = jnp.clip(norm, self.min_norm, self.max_norm)
+        scale = clipped / (norm + DEFAULT_EPSILON)
+        if self.rate != 1.0:
+            scale = self.rate * scale + (1.0 - self.rate)
+        return param * scale
+
+
+@register_constraint
+@dataclasses.dataclass
+class UnitNormConstraint(LayerConstraint):
+    """Force incoming-weight norms to exactly 1 (``UnitNormConstraint.java``:
+    divide by norm2)."""
+
+    def apply(self, param: Array) -> Array:
+        return param / (self._norm2(param) + DEFAULT_EPSILON)
+
+
+@register_constraint
+@dataclasses.dataclass
+class NonNegativeConstraint(LayerConstraint):
+    """Clamp negatives to zero (``NonNegativeConstraint.java``)."""
+
+    def apply(self, param: Array) -> Array:
+        return jnp.maximum(param, 0.0)
+
+
+def apply_constraints(layer, params: Dict[str, Array]) -> Dict[str, Array]:
+    """Run a layer's configured constraint chain over its updated params
+    (the post-update hook ``BaseConstraint.applyConstraint`` runs at
+    ``MultiLayerNetwork``/``ComputationGraph`` iteration end).
+
+    Wrapper layers (LastTimeStep/TimeDistributed/Bidirectional/Frozen) carry
+    no constraints of their own — the chain configured on their INNER layer
+    applies to the wrapper's param dict (Bidirectional stores two ``f_``/
+    ``b_``-prefixed copies of the inner params; both halves are constrained).
+    """
+    cs = getattr(layer, "constraints", None)
+    if not cs:
+        inner = getattr(layer, "layer", None)
+        if (inner is not None and getattr(inner, "constraints", None)
+                and params):
+            if all(k.startswith(("f_", "b_")) for k in params):
+                halves = {}
+                for pre in ("f_", "b_"):
+                    sub = {k[len(pre):]: v for k, v in params.items()
+                           if k.startswith(pre)}
+                    sub = apply_constraints(inner, sub)
+                    halves.update({pre + k: v for k, v in sub.items()})
+                return halves
+            return apply_constraints(inner, params)
+        return params
+    for c in cs:
+        params = c.apply_to(layer, params)
+    return params
+
+
+def constraints_from_config(v):
+    """Deserialize a layer's ``constraints`` field (list of tagged dicts)."""
+    if v is None:
+        return None
+    return [c if isinstance(c, LayerConstraint) else LayerConstraint.from_dict(c)
+            for c in v]

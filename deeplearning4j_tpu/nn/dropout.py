@@ -1,0 +1,206 @@
+"""Dropout variants — the ``IDropout`` SPI and its four reference impls.
+
+Reference: ``nn/conf/dropout/`` — ``IDropout.java`` (SPI),
+``Dropout.java`` (inverted dropout via ``DropOutInverted``),
+``AlphaDropout.java:38`` (SNN dropout, Klambauer et al. 2017),
+``GaussianDropout.java`` (multiplicative N(1, sqrt(rate/(1-rate)))),
+``GaussianNoise.java`` (additive N(0, stddev)). ``SpatialDropout`` is the
+Keras noise layer the importer needs (drops whole channels).
+
+A layer's ``dropout`` field accepts a plain float (keep probability,
+DL4J-style shorthand for :class:`Dropout`) or any :class:`IDropout`
+instance. All impls are pure jnp functions of (x, rng) so they trace into
+the jitted train step; at inference they are identity, matching the
+reference's train-only application.
+
+Dropout SCHEDULES (``pSchedule``) are not supported: the iteration counter
+is not threaded into layer forward calls by design (it would fragment the
+compiled step). Passing a Schedule raises.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+DROPOUT_REGISTRY: Dict[str, type] = {}
+
+
+def register_dropout(cls):
+    DROPOUT_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _check_no_schedule(value, what: str):
+    from deeplearning4j_tpu.nn.updaters import Schedule
+    if isinstance(value, Schedule):
+        raise ValueError(
+            f"{what} schedules are not supported (the iteration counter is "
+            "not threaded into layer forwards); use a fixed value")
+    return float(value)
+
+
+@dataclasses.dataclass
+class IDropout:
+    """SPI (``conf/dropout/IDropout.java``): train-time activation noise."""
+
+    def apply(self, x: Array, rng: jax.Array, train: bool) -> Array:
+        raise NotImplementedError
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        d["@dropout"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "IDropout":
+        d = dict(d)
+        cls = DROPOUT_REGISTRY[d.pop("@dropout")]
+        return cls(**d)
+
+
+@register_dropout
+@dataclasses.dataclass
+class Dropout(IDropout):
+    """Inverted dropout (``Dropout.java``, via ``DropOutInverted``):
+    keep with probability ``p``, scale kept values by ``1/p``."""
+
+    p: float = 0.5
+
+    def __post_init__(self):
+        self.p = _check_no_schedule(self.p, "Dropout")
+        if not (0.0 < self.p <= 1.0):
+            raise ValueError(
+                f"Activation retain probability must be in (0, 1]: got {self.p}")
+
+    def apply(self, x, rng, train):
+        if not train or self.p >= 1.0 or rng is None:
+            return x
+        keep = jax.random.bernoulli(rng, self.p, x.shape)
+        return jnp.where(keep, x / self.p, jnp.zeros((), x.dtype))
+
+
+@register_dropout
+@dataclasses.dataclass
+class AlphaDropout(IDropout):
+    """Self-normalizing-network dropout (``AlphaDropout.java:38``,
+    https://arxiv.org/abs/1706.02515 pg6): a·(x·d + α'·(1−d)) + b with
+    d ~ Bernoulli(p), α' = −λα, and a, b chosen so mean AND variance of the
+    activations are preserved. Pair with SELU activation + NORMAL init."""
+
+    p: float = 0.5
+    alpha: float = 1.6732632423543772   # DEFAULT_ALPHA
+    lambda_: float = 1.0507009873554804  # DEFAULT_LAMBDA
+
+    def __post_init__(self):
+        self.p = _check_no_schedule(self.p, "AlphaDropout")
+        if not (0.0 < self.p <= 1.0):
+            raise ValueError(
+                f"Activation retain probability must be in (0, 1]: got {self.p}")
+
+    @property
+    def alpha_prime(self) -> float:
+        return -self.lambda_ * self.alpha
+
+    def a(self, p: float) -> float:
+        """``AlphaDropout.java:123``: 1/sqrt(p + α'²·p·(1−p))."""
+        ap = self.alpha_prime
+        return 1.0 / math.sqrt(p + ap * ap * p * (1.0 - p))
+
+    def b(self, p: float) -> float:
+        """``AlphaDropout.java:127``: −a(p)·(1−p)·α'."""
+        return -self.a(p) * (1.0 - p) * self.alpha_prime
+
+    def apply(self, x, rng, train):
+        if not train or self.p >= 1.0 or rng is None:
+            return x
+        d = jax.random.bernoulli(rng, self.p, x.shape)
+        a = jnp.asarray(self.a(self.p), x.dtype)
+        b = jnp.asarray(self.b(self.p), x.dtype)
+        ap = jnp.asarray(self.alpha_prime, x.dtype)
+        return a * jnp.where(d, x, ap) + b
+
+
+@register_dropout
+@dataclasses.dataclass
+class GaussianDropout(IDropout):
+    """Multiplicative Gaussian noise (``GaussianDropout.java``, Srivastava
+    et al. 2014 §10): x · N(1, sqrt(rate/(1−rate)))."""
+
+    rate: float = 0.5
+
+    def __post_init__(self):
+        self.rate = _check_no_schedule(self.rate, "GaussianDropout")
+        if not (0.0 <= self.rate < 1.0):
+            raise ValueError(f"rate must be in [0, 1): got {self.rate}")
+
+    def apply(self, x, rng, train):
+        if not train or self.rate == 0.0 or rng is None:
+            return x
+        stdev = math.sqrt(self.rate / (1.0 - self.rate))
+        noise = 1.0 + stdev * jax.random.normal(rng, x.shape, x.dtype)
+        return x * noise
+
+
+@register_dropout
+@dataclasses.dataclass
+class GaussianNoise(IDropout):
+    """Additive zero-mean Gaussian noise (``GaussianNoise.java``):
+    x + N(0, stddev)."""
+
+    stddev: float = 0.1
+
+    def __post_init__(self):
+        self.stddev = _check_no_schedule(self.stddev, "GaussianNoise")
+
+    def apply(self, x, rng, train):
+        if not train or self.stddev == 0.0 or rng is None:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+
+
+@register_dropout
+@dataclasses.dataclass
+class SpatialDropout(IDropout):
+    """Channel dropout (Keras SpatialDropout1D/2D/3D; Tompson et al. 2015):
+    drops entire feature maps — the Bernoulli mask covers only (batch,
+    channels) and broadcasts over the spatial/time axes (channels-last).
+    ``p`` is the KEEP probability with inverted scaling, like
+    :class:`Dropout`."""
+
+    p: float = 0.5
+
+    def __post_init__(self):
+        self.p = _check_no_schedule(self.p, "SpatialDropout")
+        if not (0.0 < self.p <= 1.0):
+            raise ValueError(
+                f"Activation retain probability must be in (0, 1]: got {self.p}")
+
+    def apply(self, x, rng, train):
+        if not train or self.p >= 1.0 or rng is None:
+            return x
+        if x.ndim < 3:
+            raise ValueError(
+                f"SpatialDropout expects [N, ..., C] rank>=3 input, got shape "
+                f"{x.shape}; use Dropout for 2d activations")
+        mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        keep = jax.random.bernoulli(rng, self.p, mask_shape)
+        return jnp.where(keep, x / self.p, jnp.zeros((), x.dtype))
+
+
+def resolve_dropout(v) -> Optional[IDropout]:
+    """Normalize a layer's ``dropout`` config value: float keep-prob →
+    :class:`Dropout`; IDropout instances pass through; None stays None.
+    Keep-prob <= 0 or >= 1 floats mean "off" (DL4J treats them as no-op)."""
+    if v is None or isinstance(v, IDropout):
+        return v
+    p = float(v)
+    if p <= 0.0 or p >= 1.0:
+        return None
+    return Dropout(p)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.constraints import apply_constraints
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer, check_carry_capacity
 from deeplearning4j_tpu.nn.updaters import Sgd, Updater, normalize_gradients
@@ -138,10 +139,18 @@ class ComputationGraph:
             in_masks = [m.get(s) for s in vd.inputs]
             if vd.is_layer:
                 layer: Layer = vd.obj  # type: ignore[assignment]
+                p_v, rng_v = params[name], rngs[vi]
+                if (getattr(layer, "weight_noise", None) is not None and train
+                        and rng_v is not None):
+                    # train-time weight noise (DropConnect.java:19, MLN
+                    # parity) — applied before BOTH the single- and
+                    # multi-input forward paths
+                    rng_wn, rng_v = jax.random.split(rng_v)
+                    p_v = layer.weight_noise.apply(layer, p_v, rng_wn, train)
                 if getattr(layer, "consumes_multiple_inputs", False):
                     y, st = layer.forward_multi(
-                        params[name], in_acts, state=states[name], train=train,
-                        rng=rngs[vi], masks=in_masks)
+                        p_v, in_acts, state=states[name], train=train,
+                        rng=rng_v, masks=in_masks)
                     new_states[name] = st if st else states[name]
                     acts[name] = y
                     m[name] = in_masks[0]
@@ -154,23 +163,25 @@ class ComputationGraph:
                     acts[name + ":in"] = h
                     acts[name + ":mask"] = cur_mask
                 if carries is not None and isinstance(layer, BaseRecurrentLayer):
-                    y, c = layer.forward_seq(params[name], h, carry=carries.get(name),
-                                             mask=cur_mask, train=train, rng=rngs[vi])
+                    y, c = layer.forward_seq(p_v, h, carry=carries.get(name),
+                                             mask=cur_mask, train=train, rng=rng_v)
                     new_states[name] = states[name]
                     new_carries[name] = c
                     acts[name] = y
                 else:
-                    fwd = lambda p, hh, _l=layer, _n=name, _vi=vi: _l.forward(
-                        p, hh, state=states[_n], train=train, rng=rngs[_vi],
+                    fwd = lambda p, hh, _l=layer, _n=name, _r=rng_v: _l.forward(
+                        p, hh, state=states[_n], train=train, rng=_r,
                         mask=cur_mask)
                     if train and conf.global_conf.gradient_checkpointing:
                         # rematerialize activations in the backward pass
                         fwd = jax.checkpoint(fwd)
-                    y, st = fwd(params[name], h)
+                    y, st = fwd(p_v, h)
                     new_states[name] = st if st else states[name]
                     acts[name] = y
-                # mask collapses when time dim disappears (MLN parity)
-                if cur_mask is not None and acts[name].ndim == 2 and cur_mask.ndim == 2:
+                # per-timestep mask collapses when the time dim disappears;
+                # per-example [N]/[N,1] masks survive (MLN parity)
+                if (cur_mask is not None and acts[name].ndim == 2
+                        and cur_mask.ndim == 2 and cur_mask.shape[1] > 1):
                     m[name] = None
                 else:
                     m[name] = cur_mask
@@ -214,7 +225,22 @@ class ComputationGraph:
                 lm = label_masks[oi]
             elif h.ndim == 3:
                 lm = acts.get(out_name + ":mask")
-            loss = loss + layer.compute_loss(params[out_name], h, labels[oi], mask=lm)
+            else:
+                fm = acts.get(out_name + ":mask")
+                if fm is not None and (fm.ndim == 1 or
+                                       (fm.ndim == 2 and fm.shape[-1] == 1)):
+                    # per-example feature mask masks the score (MLN parity)
+                    lm = fm.reshape(fm.shape[0])
+            p_out = params[out_name]
+            if (getattr(layer, "weight_noise", None) is not None and train
+                    and rng is not None):
+                # output layers get weight noise too (MLN parity); fold_in on
+                # a large offset + output index keeps keys distinct from
+                # forward's splits (fold_in data must be non-negative uint32)
+                p_out = layer.weight_noise.apply(
+                    layer, p_out, jax.random.fold_in(rng, 1_000_003 + oi),
+                    train)
+            loss = loss + layer.compute_loss(p_out, h, labels[oi], mask=lm)
         loss = loss + self._regularization(params)
         return loss, (new_states, new_carries)
 
@@ -236,6 +262,8 @@ class ComputationGraph:
                 upd, s = u.update(g, upd_states[name][n], lr, it + 1.0)
                 p_new[n] = params[name][n] - upd.astype(params[name][n].dtype)
                 s_new[n] = s
+            # post-update constraints (BaseConstraint.applyConstraint parity)
+            p_new = apply_constraints(l, p_new)
             new_params[name] = p_new
             new_upd[name] = s_new
         return new_params, new_upd

@@ -12,6 +12,7 @@ from deeplearning4j_tpu.nn.layers.core import (  # noqa: F401
     DenseLayer,
     ActivationLayer,
     DropoutLayer,
+    MaskLayer,
     EmbeddingLayer,
     EmbeddingSequenceLayer,
     PositionalEmbeddingLayer,
@@ -63,6 +64,14 @@ from deeplearning4j_tpu.nn.layers.recurrent import (  # noqa: F401
 )
 from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoderLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoderLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.vae_distributions import (  # noqa: F401
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    ReconstructionDistribution,
+)
 from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer, TimeDistributedWrapper  # noqa: F401

@@ -55,7 +55,9 @@ class Layer:
     l2: Optional[float] = None
     l1_bias: Optional[float] = None
     l2_bias: Optional[float] = None
-    dropout: Optional[float] = None
+    dropout: Optional[Any] = None  # float keep-prob or IDropout instance
+    weight_noise: Optional[Any] = None  # IWeightNoise (DropConnect etc.)
+    constraints: Optional[list] = None  # list of LayerConstraint
     gradient_normalization: Optional[str] = None
     gradient_normalization_threshold: float = 1.0
     dtype: Optional[Any] = None
@@ -65,12 +67,20 @@ class Layer:
         """Inherit unset hyperparams from the global NeuralNetConfiguration."""
         for f in ("activation", "weight_init", "distribution", "bias_init",
                   "updater", "bias_updater", "l1", "l2", "l1_bias", "l2_bias",
-                  "dropout", "gradient_normalization", "dtype"):
+                  "dropout", "weight_noise", "gradient_normalization", "dtype"):
             if getattr(self, f) is None and getattr(g, f, None) is not None:
                 setattr(self, f, getattr(g, f))
         if self.gradient_normalization_threshold == 1.0 and \
                 getattr(g, "gradient_normalization_threshold", 1.0) != 1.0:
             self.gradient_normalization_threshold = g.gradient_normalization_threshold
+        if self.constraints is None:
+            # builder-level constrain_all/constrain_weights/constrain_bias
+            # (NeuralNetConfiguration.java:1031-1060): attach scoped copies
+            cs = ([c.scoped("all") for c in getattr(g, "all_constraints", None) or ()]
+                  + [c.scoped("weights") for c in getattr(g, "weight_constraints", None) or ()]
+                  + [c.scoped("bias") for c in getattr(g, "bias_constraints", None) or ()])
+            if cs:
+                self.constraints = cs
 
     # ---- shape inference --------------------------------------------------
     def set_n_in(self, input_type: InputType) -> None:
@@ -110,12 +120,14 @@ class Layer:
         return act_mod.resolve(self.activation)
 
     def _dropout(self, x: Array, train: bool, rng: Optional[jax.Array]) -> Array:
-        """DL4J-style *input* dropout (Dropout(p) keeps with prob p)."""
-        p = self.dropout
-        if not train or p is None or p >= 1.0 or p <= 0.0 or rng is None:
+        """DL4J-style *input* dropout: a float is the keep probability
+        (inverted dropout); any IDropout instance (AlphaDropout,
+        GaussianDropout, GaussianNoise, SpatialDropout) applies itself."""
+        if not train or self.dropout is None or rng is None:
             return x
-        keep = jax.random.bernoulli(rng, p, x.shape)
-        return jnp.where(keep, x / p, 0.0)
+        from deeplearning4j_tpu.nn.dropout import resolve_dropout
+        d = resolve_dropout(self.dropout)
+        return x if d is None else d.apply(x, rng, train)
 
     def _init_w(self, key, shape, fan_in, fan_out, dtype):
         scheme = self.weight_init or "xavier"
@@ -141,6 +153,11 @@ class Layer:
 
     # ---- serde --------------------------------------------------------------
     def to_dict(self) -> dict:
+        from deeplearning4j_tpu.nn.constraints import LayerConstraint
+        from deeplearning4j_tpu.nn.dropout import IDropout
+        from deeplearning4j_tpu.nn.layers.vae_distributions import (
+            ReconstructionDistribution)
+        from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
         d = {}
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
@@ -152,10 +169,16 @@ class Layer:
                 v = v.to_dict()
             elif isinstance(v, Distribution):
                 v = v.to_dict()
+            elif isinstance(v, (IDropout, IWeightNoise,
+                                ReconstructionDistribution)):
+                v = v.to_dict()
             elif isinstance(v, Layer):
                 v = v.to_dict()
             elif isinstance(v, InputType):
                 v = {"@input_type": True, **v.to_dict()}
+            elif (isinstance(v, list) and v
+                  and all(isinstance(c, LayerConstraint) for c in v)):
+                v = [c.to_dict() for c in v]
             d[f.name] = v
         d["@layer"] = type(self).__name__
         return d
@@ -166,6 +189,8 @@ class Layer:
 
 
 def layer_from_dict(d: dict) -> Layer:
+    from deeplearning4j_tpu.nn.dropout import IDropout
+    from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
     d = dict(d)
     cls = LAYER_REGISTRY[d.pop("@layer")]
     kw = {}
@@ -174,6 +199,14 @@ def layer_from_dict(d: dict) -> Layer:
             v = Updater.from_dict(v)
         elif isinstance(v, dict) and "@schedule" in v:
             v = Schedule.from_dict(v)
+        elif isinstance(v, dict) and "@dropout" in v:
+            v = IDropout.from_dict(v)
+        elif isinstance(v, dict) and "@weight_noise" in v:
+            v = IWeightNoise.from_dict(v)
+        elif isinstance(v, dict) and "@recon" in v:
+            from deeplearning4j_tpu.nn.layers.vae_distributions import (
+                ReconstructionDistribution)
+            v = ReconstructionDistribution.from_dict(v)
         elif isinstance(v, dict) and "@layer" in v:
             v = layer_from_dict(v)
         elif isinstance(v, dict) and "@input_type" in v:
@@ -182,6 +215,10 @@ def layer_from_dict(d: dict) -> Layer:
             v = InputType.from_dict(v)
         elif k == "distribution" and isinstance(v, dict):
             v = Distribution.from_dict(v)
+        elif (isinstance(v, list) and v
+              and all(isinstance(c, dict) and "@constraint" in c for c in v)):
+            from deeplearning4j_tpu.nn.constraints import constraints_from_config
+            v = constraints_from_config(v)
         kw[k] = v
     # tuples serialize as lists; normalize common geometry fields
     for k in ("kernel_size", "stride", "padding", "dilation", "block_size",

@@ -95,6 +95,34 @@ class DropoutLayer(Layer):
 
 @register_layer
 @dataclasses.dataclass
+class MaskLayer(Layer):
+    """Applies the current mask to the activations, otherwise a pass-through
+    (``nn/conf/layers/util/MaskLayer.java:24``). Supports 2d feed-forward
+    ``[N,F]`` and 4d CNN ``[N,H,W,C]`` activations with a per-example mask
+    (``[N]`` / ``[N,1]``), and 3d time series ``[N,T,F]`` with a ``[N,T]``
+    step mask. Backward-pass gradients are masked identically for free:
+    ``d(m*x)/dx = m`` under autodiff."""
+
+    def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if mask is None:
+            return x, state or {}
+        m = jnp.asarray(mask, x.dtype)
+        if m.shape == x.shape:  # full elementwise mask: multiply directly
+            return x * m, state or {}
+        if (x.ndim == 3 and m.ndim == 2 and m.shape == x.shape[:2]):
+            m = m[:, :, None]  # [N,T] step mask → [N,T,1]
+        else:  # per-example mask broadcast over all trailing dims
+            if m.shape[0] != x.shape[0] or m.size != x.shape[0]:
+                raise ValueError(
+                    f"MaskLayer: mask shape {m.shape} does not broadcast over "
+                    f"input shape {x.shape} (want [N]/[N,1] per-example, or "
+                    "[N,T] for 3d time series)")
+            m = m.reshape((m.shape[0],) + (1,) * (x.ndim - 1))
+        return x * m, state or {}
+
+
+@register_layer
+@dataclasses.dataclass
 class EmbeddingLayer(Layer):
     """Index → embedding row (``nn/conf/layers/EmbeddingLayer.java``).
 

@@ -2,10 +2,14 @@
 
 Reference: ``nn/conf/layers/variational/VariationalAutoencoder.java`` + its
 own Layer impl (``nn/layers/variational/VariationalAutoencoder.java:51``) with
-pluggable reconstruction distributions (Bernoulli / Gaussian / Exponential).
+the full pluggable reconstruction-distribution family (Bernoulli / Gaussian /
+Exponential / Composite / LossFunctionWrapper — see ``vae_distributions.py``).
 Forward in a network = encoder mean (matching DL4J's ``activate`` =
 ``preOutput`` of the mean); ``pretrain_loss`` is the negative ELBO with the
 reparameterization trick (``jax.grad`` replaces the hand-derived gradients).
+``reconstruction_log_probability`` implements the reference's Monte-Carlo
+estimator (``VariationalAutoencoder.java:998``); ``reconstruction_error`` the
+LossFunctionWrapper path (``:1146``).
 """
 
 from __future__ import annotations
@@ -19,6 +23,10 @@ import jax.numpy as jnp
 from deeplearning4j_tpu.nn import activations as act_mod
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.nn.layers.vae_distributions import (
+    ReconstructionDistribution,
+    resolve_reconstruction,
+)
 
 
 @register_layer
@@ -28,7 +36,9 @@ class VariationalAutoencoderLayer(Layer):
     n_out: int = 0  # latent size
     encoder_layer_sizes: Tuple[int, ...] = (100,)
     decoder_layer_sizes: Tuple[int, ...] = (100,)
-    reconstruction_distribution: str = "bernoulli"  # "bernoulli" | "gaussian"
+    # "bernoulli" | "gaussian" | "exponential" shorthand, or any
+    # ReconstructionDistribution instance (Composite, LossFunctionWrapper, …)
+    reconstruction_distribution: object = "bernoulli"
     pzx_activation: str = "identity"
     num_samples: int = 1
 
@@ -39,6 +49,15 @@ class VariationalAutoencoderLayer(Layer):
             self.encoder_layer_sizes = tuple(self.encoder_layer_sizes)
         if isinstance(self.decoder_layer_sizes, list):
             self.decoder_layer_sizes = tuple(self.decoder_layer_sizes)
+
+    @property
+    def recon(self) -> ReconstructionDistribution:
+        return resolve_reconstruction(self.reconstruction_distribution)
+
+    def has_loss_function(self) -> bool:
+        """True when reconstruction uses a plain loss (LossFunctionWrapper /
+        all-loss Composite) instead of a probability distribution."""
+        return self.recon.has_loss_function()
 
     def is_pretrain_layer(self) -> bool:
         return True
@@ -51,8 +70,7 @@ class VariationalAutoencoderLayer(Layer):
         return InputType.feed_forward(self.n_out)
 
     def _recon_out_size(self):
-        # gaussian reconstruction emits mean+logvar per input dim
-        return self.n_in * 2 if self.reconstruction_distribution == "gaussian" else self.n_in
+        return self.recon.distribution_input_size(self.n_in)
 
     def param_shapes(self):
         shapes = {}
@@ -92,7 +110,7 @@ class VariationalAutoencoderLayer(Layer):
             h = act(h @ params[f"eW{i}"] + params[f"eb{i}"])
         pzx_act = act_mod.resolve(self.pzx_activation)
         mean = pzx_act(h @ params["pZXMeanW"] + params["pZXMeanb"])
-        log_var = h @ params["pZXLogStd2W"] + params["pZXLogStd2b"]
+        log_var = pzx_act(h @ params["pZXLogStd2W"] + params["pZXLogStd2b"])
         return mean, log_var
 
     def _decode(self, params, z):
@@ -107,21 +125,55 @@ class VariationalAutoencoderLayer(Layer):
         return mean, state or {}
 
     def generate(self, params, z):
-        """Decode latent samples to reconstruction-distribution means."""
-        logits = self._decode(params, z)
-        if self.reconstruction_distribution == "bernoulli":
-            return jax.nn.sigmoid(logits)
-        mean, _ = jnp.split(logits, 2, axis=-1)
-        return mean
+        """Decode latent values to E[P(x|z)] (generateAtMeanGivenZ)."""
+        return self.recon.generate_at_mean(self._decode(params, z))
+
+    def generate_random(self, params, z, rng):
+        """Decode latent values and SAMPLE P(x|z) (generateRandomGivenZ)."""
+        return self.recon.generate_random(rng, self._decode(params, z))
 
     def reconstruction_log_prob(self, params, x, z):
-        logits = self._decode(params, z)
-        if self.reconstruction_distribution == "bernoulli":
-            lp = -(jnp.maximum(logits, 0) - logits * x + jnp.log1p(jnp.exp(-jnp.abs(logits))))
-            return jnp.sum(lp, axis=-1)
-        mean, log_var = jnp.split(logits, 2, axis=-1)
-        lp = -0.5 * (jnp.log(2 * jnp.pi) + log_var + (x - mean) ** 2 / jnp.exp(log_var))
-        return jnp.sum(lp, axis=-1)
+        """Per-example log p(x|z) (negated distribution cost)."""
+        return -self.recon.example_neg_log_prob(x, self._decode(params, z))
+
+    def reconstruction_log_probability(self, params, x, rng,
+                                       num_samples: int = None):
+        """Monte-Carlo estimate of per-example log p(x): the mean over
+        ``num_samples`` posterior draws of log p(x|z), z ~ q(z|x)
+        (``VariationalAutoencoder.java:998``). Returns shape [N]."""
+        if self.has_loss_function():
+            raise ValueError(
+                "Cannot calculate reconstruction log probability when using "
+                "a LossFunctionWrapper: loss functions are not probabilistic. "
+                "Use reconstruction_error instead")
+        k = num_samples if num_samples is not None else self.num_samples
+        if k <= 0:
+            raise ValueError(f"num_samples must be > 0, got {k}")
+        mean, log_var = self._encode(params, x)
+        sigma = jnp.exp(0.5 * log_var)
+        total = 0.0
+        for key in jax.random.split(rng, k):
+            z = mean + sigma * jax.random.normal(key, mean.shape, mean.dtype)
+            total = total + self.reconstruction_log_prob(params, x, z)
+        return total / k
+
+    def reconstruction_probability(self, params, x, rng,
+                                   num_samples: int = None):
+        """exp of :meth:`reconstruction_log_probability` (``:985``)."""
+        return jnp.exp(self.reconstruction_log_probability(
+            params, x, rng, num_samples))
+
+    def reconstruction_error(self, params, x):
+        """Per-example deterministic reconstruction error — only for
+        loss-function reconstruction configs (``:1146``)."""
+        if not self.has_loss_function():
+            raise ValueError(
+                "reconstruction_error requires a loss-function configuration "
+                "(LossFunctionWrapper / all-loss Composite); probabilistic "
+                "distributions use reconstruction_log_probability")
+        mean, _ = self._encode(params, x)
+        reconstruction = self.generate(params, mean)
+        return self.recon.score_array(x, reconstruction)
 
     def pretrain_loss(self, params, x, rng):
         """Negative ELBO (mean over batch)."""

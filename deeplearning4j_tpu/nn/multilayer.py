@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.constraints import apply_constraints
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer, check_carry_capacity
 from deeplearning4j_tpu.nn.updaters import (
@@ -137,26 +138,34 @@ class MultiLayerNetwork:
             layer = self.layers[i]
             if i in self.conf.preprocessors:
                 h = self.conf.preprocessors[i](h)
+            p_i, rng_i = params[i], rngs[i]
+            if (getattr(layer, "weight_noise", None) is not None and train
+                    and rng_i is not None):
+                # IWeightNoise (DropConnect/WeightNoise): noise the WEIGHTS
+                # at forward time, train only (weightnoise/DropConnect.java:19)
+                rng_wn, rng_i = jax.random.split(rng_i)
+                p_i = layer.weight_noise.apply(layer, p_i, rng_wn, train)
             if carries is not None and isinstance(layer, BaseRecurrentLayer):
-                y, c = layer.forward_seq(params[i], h, carry=carries[i], mask=cur_mask,
-                                         train=train, rng=rngs[i])
+                y, c = layer.forward_seq(p_i, h, carry=carries[i], mask=cur_mask,
+                                         train=train, rng=rng_i)
                 new_states.append(states[i])
                 new_carries.append(c)
                 h = y
             else:
-                fwd = lambda p, hh, _l=layer, _i=i: _l.forward(
-                    p, hh, state=states[_i], train=train, rng=rngs[_i],
+                fwd = lambda p, hh, _l=layer, _i=i, _r=rng_i: _l.forward(
+                    p, hh, state=states[_i], train=train, rng=_r,
                     mask=cur_mask)
                 if train and self.conf.global_conf.gradient_checkpointing:
                     # rematerialize this layer's activations in the backward
                     # pass instead of storing them (HBM ↔ FLOPs trade)
                     fwd = jax.checkpoint(fwd)
-                h, st = fwd(params[i], h)
+                h, st = fwd(p_i, h)
                 new_states.append(st if st else states[i])
                 new_carries.append(None)
-            # feed-forward layers collapse per-timestep masks only when the
-            # time dimension disappears
-            if cur_mask is not None and h.ndim == 2 and cur_mask.ndim == 2:
+            # per-TIMESTEP masks collapse when the time dimension disappears;
+            # a per-example [N]/[N,1] mask stays valid on 2d activations
+            if (cur_mask is not None and h.ndim == 2 and cur_mask.ndim == 2
+                    and cur_mask.shape[1] > 1):
                 cur_mask = None
         return h, new_states, new_carries
 
@@ -187,8 +196,26 @@ class MultiLayerNetwork:
         if self.conf.global_conf.compute_dtype is not None:
             # loss head in f32 for stable softmax/log under mixed precision
             h = h.astype(jnp.float32)
-        lm = label_mask if label_mask is not None else (mask if h.ndim == 3 else None)
-        loss = out_layer.compute_loss(params[-1], h, y, mask=lm)
+        if label_mask is not None:
+            lm = label_mask
+        elif mask is None:
+            lm = None
+        elif h.ndim == 3:
+            lm = mask
+        elif mask.ndim == 1 or (mask.ndim == 2 and mask.shape[-1] == 1):
+            # per-example feature mask masks the score too (DL4J ScoreUtil)
+            lm = mask.reshape(mask.shape[0])
+        else:
+            lm = None
+        p_out = params[-1]
+        if (getattr(out_layer, "weight_noise", None) is not None and train
+                and rng is not None):
+            # output layers get weight noise too (DL4J noises every layer's
+            # preOutput); fold_in keeps the key distinct from _forward_all's
+            p_out = out_layer.weight_noise.apply(
+                out_layer, p_out, jax.random.fold_in(rng, len(self.layers)),
+                train)
+        loss = out_layer.compute_loss(p_out, h, y, mask=lm)
         loss = loss + self._regularization(params)
         return loss, (new_states, new_carries)
 
@@ -208,6 +235,10 @@ class MultiLayerNetwork:
                 upd, s = u.update(g, upd_states[i][n], lr, t)
                 p_new[n] = params[i][n] - upd.astype(params[i][n].dtype)
                 s_new[n] = s
+            # post-update parameter constraints (BaseConstraint.applyConstraint
+            # runs after each iteration in the reference) — fused into the
+            # jitted step, not a separate host pass
+            p_new = apply_constraints(l, p_new)
             new_params.append(p_new)
             new_upd.append(s_new)
         return new_params, new_upd

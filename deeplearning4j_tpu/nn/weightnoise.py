@@ -1,0 +1,129 @@
+"""Weight noise — the ``IWeightNoise`` SPI: transform WEIGHTS (not
+activations) at forward time during training.
+
+Reference: ``nn/conf/weightnoise/`` — ``IWeightNoise.java`` (SPI:
+``getParameter(layer, paramKey, iteration, epoch, train)``),
+``WeightNoise.java`` (additive or multiplicative noise from a configured
+Distribution), ``DropConnect.java:19`` (zero each weight with probability
+``1 − p``; uses ND4J's plain ``DropOut`` op, i.e. NO inverted rescale —
+deliberately matched here).
+
+TPU-first framing: instead of materializing a noised copy of the parameter
+table per layer call, the noise is a pure function applied to the param
+pytree inside the traced forward — XLA fuses the mask/noise generation into
+the consuming matmul, and ``jax.grad`` differentiates through it, which is
+exactly DL4J's behavior (gradients flow to the underlying weights).
+
+Applied by the network forward pass when ``layer.weight_noise`` is set and
+``train=True``; inference always sees the clean weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.weights import Distribution, sample_distribution
+
+Array = jax.Array
+
+WEIGHT_NOISE_REGISTRY: Dict[str, type] = {}
+
+
+def register_weight_noise(cls):
+    WEIGHT_NOISE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclasses.dataclass
+class IWeightNoise:
+    """SPI (``weightnoise/IWeightNoise.java``)."""
+
+    apply_to_bias: bool = False
+
+    def apply_param(self, param: Array, rng: jax.Array) -> Array:
+        raise NotImplementedError
+
+    def apply(self, layer, params: Dict[str, Array], rng: jax.Array,
+              train: bool) -> Dict[str, Array]:
+        """Noise the selected entries of one layer's param dict (train only)."""
+        if not train or rng is None:
+            return params
+        names = set(layer.weight_param_names())
+        if self.apply_to_bias:
+            names |= set(layer.bias_param_names())
+        out = {}
+        for n, v in params.items():
+            if n in names:
+                rng, k = jax.random.split(rng)
+                out[n] = self.apply_param(v, k)
+            else:
+                out[n] = v
+        return out
+
+    def to_dict(self) -> dict:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, Distribution):
+                v = v.to_dict()
+            d[f.name] = v
+        d["@weight_noise"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "IWeightNoise":
+        d = dict(d)
+        cls = WEIGHT_NOISE_REGISTRY[d.pop("@weight_noise")]
+        if isinstance(d.get("distribution"), dict):
+            d["distribution"] = Distribution.from_dict(d["distribution"])
+        return cls(**d)
+
+
+@register_weight_noise
+@dataclasses.dataclass
+class WeightNoise(IWeightNoise):
+    """Additive (W + n) or multiplicative (W ∘ n) noise drawn fresh each
+    forward from ``distribution`` (``WeightNoise.java``)."""
+
+    distribution: Optional[Distribution] = None
+    additive: bool = True
+
+    def __post_init__(self):
+        if self.distribution is None:
+            self.distribution = Distribution(kind="normal", mean=0.0, std=0.01)
+
+    def apply_param(self, param, rng):
+        noise = sample_distribution(rng, self.distribution, param.shape,
+                                    param.dtype)
+        return param + noise if self.additive else param * noise
+
+
+@register_weight_noise
+@dataclasses.dataclass
+class DropConnect(IWeightNoise):
+    """Zero each weight independently with probability ``1 − p`` at train
+    forward time (``DropConnect.java:19``). Matches the reference's plain
+    ``DropOut`` op: surviving weights are NOT rescaled by ``1/p``
+    (unlike activation :class:`~deeplearning4j_tpu.nn.dropout.Dropout`)."""
+
+    p: float = 0.5
+
+    def __post_init__(self):
+        from deeplearning4j_tpu.nn.updaters import Schedule
+        if isinstance(self.p, Schedule):
+            raise ValueError(
+                "DropConnect schedules are not supported (iteration is not "
+                "threaded into layer forwards); use a fixed retain prob")
+        if not (0.0 < self.p <= 1.0):
+            raise ValueError(
+                f"Weight retain probability must be in (0, 1]: got {self.p}")
+
+    def apply_param(self, param, rng):
+        if self.p >= 1.0:
+            return param
+        keep = jax.random.bernoulli(rng, self.p, param.shape)
+        return jnp.where(keep, param, jnp.zeros((), param.dtype))

@@ -136,25 +136,34 @@ def init_weight(
     if s == "distribution":
         if distribution is None:
             raise ValueError("WeightInit DISTRIBUTION requires a Distribution spec")
-        if isinstance(distribution, dict):
-            distribution = Distribution.from_dict(distribution)
-        d = distribution
-        if d.kind == "normal":
-            return d.mean + _normal(key, shape, d.std, dtype)
-        if d.kind == "truncated_normal":
-            return d.mean + _truncated_normal(key, shape, d.std, dtype)
-        if d.kind == "log_normal":
-            return jnp.exp(d.mean + _normal(key, shape, d.std, dtype))
-        if d.kind == "uniform":
-            return jax.random.uniform(key, shape, dtype, minval=d.lower, maxval=d.upper)
-        if d.kind == "orthogonal":
-            return _orthogonal(key, shape, d.gain, dtype)
-        if d.kind == "constant":
-            return jnp.full(shape, d.value, dtype)
-        if d.kind == "binomial":
-            return jax.random.binomial(key, d.n, d.p, shape).astype(dtype)
-        raise ValueError(f"Unknown distribution kind {d.kind!r}")
+        return sample_distribution(key, distribution, shape, dtype)
     raise ValueError(f"Unknown weight init scheme {scheme!r}")
+
+
+def sample_distribution(key: jax.Array,
+                        distribution: Union[Distribution, dict],
+                        shape: Sequence[int], dtype=jnp.float32) -> Array:
+    """Draw a tensor from a :class:`Distribution` spec (the sampling half of
+    WeightInit.DISTRIBUTION, also used by weight noise)."""
+    if isinstance(distribution, dict):
+        distribution = Distribution.from_dict(distribution)
+    d = distribution
+    shape = tuple(int(s) for s in shape)
+    if d.kind == "normal":
+        return d.mean + _normal(key, shape, d.std, dtype)
+    if d.kind == "truncated_normal":
+        return d.mean + _truncated_normal(key, shape, d.std, dtype)
+    if d.kind == "log_normal":
+        return jnp.exp(d.mean + _normal(key, shape, d.std, dtype))
+    if d.kind == "uniform":
+        return jax.random.uniform(key, shape, dtype, minval=d.lower, maxval=d.upper)
+    if d.kind == "orthogonal":
+        return _orthogonal(key, shape, d.gain, dtype)
+    if d.kind == "constant":
+        return jnp.full(shape, d.value, dtype)
+    if d.kind == "binomial":
+        return jax.random.binomial(key, d.n, d.p, shape).astype(dtype)
+    raise ValueError(f"Unknown distribution kind {d.kind!r}")
 
 
 ALL_SCHEMES = [

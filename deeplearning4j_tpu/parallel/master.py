@@ -402,6 +402,11 @@ class DistributedMultiLayerNetwork:
     def fit(self, data_iterator, epochs: int = 1):
         if self.network.params is None:
             self.network.init()
+        # settle the NTP offset BEFORE the first phase stamp so the timeline
+        # never jumps when a background sync lands mid-run (one blocking
+        # exchange at startup; no-op for already-synced / plain clocks)
+        from deeplearning4j_tpu.parallel.time_source import get_time_source
+        get_time_source().ensure_synced()
         for _ in range(epochs):
             if hasattr(data_iterator, "reset"):
                 data_iterator.reset()

@@ -31,6 +31,12 @@ class TimeSource:
     def current_time_millis(self) -> int:  # pragma: no cover - interface
         raise NotImplementedError
 
+    def ensure_synced(self) -> None:
+        """Block until the clock is usable for cross-host comparison (no-op
+        for clocks with nothing to measure). Callers that stamp timelines
+        (e.g. the TrainingMaster front end) invoke this once at startup so
+        the offset never jumps mid-run."""
+
 
 class SystemClockTimeSource(TimeSource):
     """The local clock (``SystemClockTimeSource`` in the reference)."""
@@ -122,6 +128,12 @@ class NTPTimeSource(TimeSource):
     @property
     def offset_millis(self) -> float:
         return self._offset_ms
+
+    def ensure_synced(self) -> None:
+        """One blocking exchange if no sync attempt has completed yet
+        (the eager background attempt may still be in flight)."""
+        if self._last_sync is None:
+            self.sync()
 
     def _sync_in_background(self) -> None:
         """Start one refresh thread if none is running (non-blocking)."""

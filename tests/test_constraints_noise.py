@@ -1,0 +1,414 @@
+"""Constraints, weight noise, and dropout variants.
+
+Reference: nn/conf/constraint/ (MaxNorm/MinMaxNorm/NonNeg/UnitNorm applied
+post-update), nn/conf/weightnoise/ (WeightNoise/DropConnect applied to
+weights at train forward time), nn/conf/dropout/ (Alpha/Gaussian dropout +
+GaussianNoise as real implementations, not plain-dropout approximations).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.constraints import (
+    MaxNormConstraint,
+    MinMaxNormConstraint,
+    NonNegativeConstraint,
+    UnitNormConstraint,
+)
+from deeplearning4j_tpu.nn.dropout import (
+    AlphaDropout,
+    Dropout,
+    GaussianDropout,
+    GaussianNoise,
+    SpatialDropout,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.nn.weightnoise import DropConnect, WeightNoise
+from deeplearning4j_tpu.nn.weights import Distribution
+
+
+def _col_norms(w):
+    return np.sqrt((np.asarray(w) ** 2).sum(axis=0))
+
+
+class TestConstraintMath:
+    """Per-constraint projection math (MaxNormConstraint.java:21 family)."""
+
+    def test_max_norm(self):
+        w = jnp.asarray(np.random.default_rng(0).normal(0, 3, (10, 6)),
+                        jnp.float32)
+        out = MaxNormConstraint(max_norm=1.5).apply(w)
+        norms = _col_norms(out)
+        assert (norms <= 1.5 + 1e-4).all()
+        # columns already under the cap are (nearly) unchanged
+        before = _col_norms(w)
+        for j in range(6):
+            if before[j] <= 1.5:
+                np.testing.assert_allclose(np.asarray(out)[:, j],
+                                           np.asarray(w)[:, j], rtol=1e-4)
+
+    def test_min_max_norm_and_rate(self):
+        w = jnp.asarray(np.random.default_rng(1).normal(0, 0.01, (8, 4)),
+                        jnp.float32)
+        out = MinMaxNormConstraint(min_norm=0.5, max_norm=1.0).apply(w)
+        norms = _col_norms(out)
+        assert (norms >= 0.5 - 1e-3).all() and (norms <= 1.0 + 1e-3).all()
+        # rate blends toward the projection: rate=0.5 lands halfway
+        half = MinMaxNormConstraint(min_norm=0.5, max_norm=1.0,
+                                    rate=0.5).apply(w)
+        full_scale = np.asarray(out) / np.asarray(w)
+        half_scale = np.asarray(half) / np.asarray(w)
+        np.testing.assert_allclose(half_scale, 0.5 * full_scale + 0.5,
+                                   rtol=1e-4)
+        with pytest.raises(ValueError):
+            MinMaxNormConstraint(rate=0.0)
+
+    def test_unit_norm(self):
+        w = jnp.asarray(np.random.default_rng(2).normal(0, 2, (5, 7)),
+                        jnp.float32)
+        out = UnitNormConstraint().apply(w)
+        np.testing.assert_allclose(_col_norms(out), 1.0, atol=1e-4)
+
+    def test_non_negative(self):
+        w = jnp.asarray([[-1.0, 2.0], [3.0, -4.0]], jnp.float32)
+        out = NonNegativeConstraint().apply(w)
+        np.testing.assert_allclose(np.asarray(out), [[0.0, 2.0], [3.0, 0.0]])
+
+    def test_conv_layout_reduces_over_all_but_last(self):
+        # conv W is [kh, kw, in, out]: per-filter norms, Keras axis=[0,1,2]
+        w = jnp.asarray(np.random.default_rng(3).normal(0, 2, (3, 3, 4, 5)),
+                        jnp.float32)
+        out = np.asarray(MaxNormConstraint(max_norm=1.0).apply(w))
+        norms = np.sqrt((out ** 2).sum(axis=(0, 1, 2)))
+        assert (norms <= 1.0 + 1e-4).all()
+
+    def test_explicit_dimensions(self):
+        w = jnp.asarray(np.random.default_rng(4).normal(0, 2, (6, 4)),
+                        jnp.float32)
+        out = np.asarray(UnitNormConstraint(dimensions=(1,)).apply(w))
+        np.testing.assert_allclose(np.sqrt((out ** 2).sum(axis=1)), 1.0,
+                                   atol=1e-4)
+
+
+class TestConstraintsInTraining:
+    """Constraints run INSIDE the jitted step after the updater
+    (builder hooks NeuralNetConfiguration.java:1031-1060)."""
+
+    def _fit(self, builder_mutator, steps=5, lr=0.5):
+        b = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(lr)))
+        builder_mutator(b)
+        conf = (b.list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        for _ in range(steps):
+            net.fit(DataSet(x, y))
+        return net
+
+    def test_constrain_weights_max_norm(self):
+        net = self._fit(lambda b: b.constrain_weights(
+            MaxNormConstraint(max_norm=0.7)))
+        for p in net.params:
+            assert (_col_norms(p["W"]) <= 0.7 + 1e-3).all()
+            # biases NOT constrained by constrain_weights
+        # big-lr training without the constraint violates the cap (sanity)
+        free = self._fit(lambda b: b)
+        assert any((_col_norms(p["W"]) > 0.7).any() for p in free.params)
+
+    def test_constrain_bias_only_touches_bias(self):
+        net = self._fit(lambda b: b.constrain_bias(NonNegativeConstraint()))
+        for p in net.params:
+            assert (np.asarray(p["b"]) >= 0).all()
+        assert any((np.asarray(p["W"]) < 0).any() for p in net.params)
+
+    def test_constrain_all(self):
+        net = self._fit(lambda b: b.constrain_all_parameters(
+            MaxNormConstraint(max_norm=0.5)))
+        for p in net.params:
+            for v in p.values():
+                if np.asarray(v).ndim == 1:
+                    assert np.sqrt((np.asarray(v) ** 2).sum()) <= 0.5 + 1e-3
+                else:
+                    assert (_col_norms(v) <= 0.5 + 1e-3).all()
+
+    def test_per_layer_constraints_field(self):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.5))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh",
+                                  constraints=[UnitNormConstraint()]))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(DataSet(x, y))
+        np.testing.assert_allclose(_col_norms(net.params[0]["W"]), 1.0,
+                                   atol=1e-3)
+        # second layer has no constraints
+        assert not np.allclose(_col_norms(net.params[1]["W"]), 1.0, atol=1e-3)
+
+    def test_serde_round_trip(self):
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .constrain_weights(MinMaxNormConstraint(min_norm=0.1,
+                                                        max_norm=2.0))
+                .list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(3)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        c = conf2.layers[0].constraints[0]
+        assert isinstance(c, MinMaxNormConstraint)
+        assert c.min_norm == 0.1 and c.max_norm == 2.0 and c.scope == "weights"
+
+    def test_wrapped_layer_constraints_enforced(self):
+        # LastTimeStep / Bidirectional wrappers must delegate constraint
+        # application to their inner layer (the Keras import shape)
+        from deeplearning4j_tpu.nn.layers import LSTMLayer
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            BidirectionalWrapper, LastTimeStepWrapper)
+        from deeplearning4j_tpu.nn.constraints import apply_constraints
+        inner = LSTMLayer(n_in=4, n_out=3,
+                          constraints=[MaxNormConstraint(
+                              max_norm=0.1, param_names=("W",))])
+        wrapper = LastTimeStepWrapper(layer=inner)
+        params = {"W": jnp.ones((4, 12)), "RW": jnp.ones((3, 12)),
+                  "b": jnp.zeros((12,))}
+        out = apply_constraints(wrapper, params)
+        assert (_col_norms(out["W"]) <= 0.1 + 1e-4).all()
+        np.testing.assert_allclose(np.asarray(out["RW"]), 1.0)  # untouched
+        bi = BidirectionalWrapper(layer=inner)
+        bparams = {f"{pre}{k}": v for pre in ("f_", "b_")
+                   for k, v in params.items()}
+        bout = apply_constraints(bi, bparams)
+        for pre in ("f_", "b_"):
+            assert (_col_norms(bout[pre + "W"]) <= 0.1 + 1e-4).all()
+            np.testing.assert_allclose(np.asarray(bout[pre + "RW"]), 1.0)
+
+    def test_graph_output_layer_weight_noise_trains(self):
+        # weight noise inherited onto a ComputationGraph OUTPUT layer must
+        # not crash the jitted step (fold_in key derivation) and must train
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+             .weight_noise(DropConnect(p=0.9))
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_out=3), "d")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(5)).build())
+        net = ComputationGraph(g).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y)
+        assert np.isfinite(float(net.score_))
+
+    def test_graph_constraints(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.5))
+             .constrain_weights(MaxNormConstraint(max_norm=0.6))
+             .graph_builder()
+             .add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=8, activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_out=3), "d")
+             .set_outputs("out")
+             .set_input_types(InputType.feed_forward(5)).build())
+        net = ComputationGraph(g).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        for _ in range(5):
+            net.fit(x, y)
+        for name in ("d", "out"):
+            assert (_col_norms(net.params[name]["W"]) <= 0.6 + 1e-3).all()
+
+
+class TestWeightNoise:
+    """IWeightNoise applied to weights at train forward time
+    (weightnoise/WeightNoise.java, DropConnect.java:19)."""
+
+    def test_drop_connect_zeroes_without_rescale(self):
+        w = jnp.ones((100, 100), jnp.float32)
+        out = np.asarray(DropConnect(p=0.7).apply_param(
+            w, jax.random.PRNGKey(0)))
+        kept = out != 0.0
+        assert abs(kept.mean() - 0.7) < 0.03
+        # NO inverted rescale: survivors keep their exact value (ND4J DropOut
+        # op semantics, unlike activation dropout's 1/p scaling)
+        np.testing.assert_allclose(out[kept], 1.0)
+
+    def test_weight_noise_additive_and_multiplicative(self):
+        w = jnp.full((200, 200), 3.0, jnp.float32)
+        dist = Distribution(kind="normal", mean=0.0, std=0.5)
+        add = np.asarray(WeightNoise(distribution=dist).apply_param(
+            w, jax.random.PRNGKey(1)))
+        assert abs((add - 3.0).mean()) < 0.02 and abs((add - 3.0).std() - 0.5) < 0.02
+        mul = np.asarray(WeightNoise(distribution=dist, additive=False)
+                         .apply_param(w, jax.random.PRNGKey(2)))
+        assert abs(mul.mean() - 0.0) < 0.05  # 3 * N(0, .5) has mean 0
+
+    def test_bias_scope(self):
+        layer = DenseLayer(n_in=4, n_out=3)
+        params = {"W": jnp.ones((4, 3)), "b": jnp.ones((3,))}
+        noised = DropConnect(p=0.5).apply(layer, params, jax.random.PRNGKey(0),
+                                          train=True)
+        np.testing.assert_allclose(np.asarray(noised["b"]), 1.0)  # untouched
+        noised2 = DropConnect(p=0.5, apply_to_bias=True).apply(
+            layer, params, jax.random.PRNGKey(3), train=True)
+        assert (np.asarray(noised2["b"]) == 0).any() or True  # may be all kept
+        # train=False is identity
+        clean = DropConnect(p=0.5).apply(layer, params, jax.random.PRNGKey(0),
+                                         train=False)
+        assert clean is params
+
+    def test_train_vs_inference_in_network(self):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+                .weight_noise(DropConnect(p=0.5))
+                .list()
+                .layer(DenseLayer(n_out=16, activation="identity",
+                                  has_bias=False))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        # inference path: deterministic, clean weights
+        o1, o2 = np.asarray(net.output(x)), np.asarray(net.output(x))
+        np.testing.assert_allclose(o1, o2)
+        # training path: the noised step still trains (finite score, params move)
+        w0 = np.asarray(net.params[0]["W"]).copy()
+        net.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score_))
+        assert not np.allclose(w0, np.asarray(net.params[0]["W"]))
+
+    def test_serde(self):
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .weight_noise(WeightNoise(
+                    distribution=Distribution(kind="normal", std=0.1),
+                    additive=False))
+                .list()
+                .layer(DenseLayer(n_out=4))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(3)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        wn = conf2.layers[0].weight_noise
+        assert isinstance(wn, WeightNoise) and not wn.additive
+        assert wn.distribution.std == 0.1
+
+
+class TestDropoutVariants:
+    def test_plain_dropout_inverted_scaling(self):
+        x = jnp.ones((1000,), jnp.float32)
+        out = np.asarray(Dropout(p=0.8).apply(x, jax.random.PRNGKey(0), True))
+        kept = out != 0
+        assert abs(kept.mean() - 0.8) < 0.05
+        np.testing.assert_allclose(out[kept], 1.0 / 0.8, rtol=1e-6)
+
+    def test_alpha_dropout_preserves_moments(self):
+        # AlphaDropout.java:38 / SNN paper pg6: mean AND variance of N(0,1)
+        # activations are preserved in expectation
+        x = jax.random.normal(jax.random.PRNGKey(1), (200_000,), jnp.float32)
+        ad = AlphaDropout(p=0.9)
+        out = np.asarray(ad.apply(x, jax.random.PRNGKey(2), True))
+        assert abs(out.mean()) < 0.02
+        assert abs(out.std() - 1.0) < 0.02
+        # dropped positions carry a·α' + b, not zero
+        dropped_value = ad.a(0.9) * ad.alpha_prime + ad.b(0.9)
+        vals = np.unique(np.round(out, 4))
+        assert np.min(np.abs(vals - round(dropped_value, 4))) < 1e-3
+
+    def test_alpha_dropout_constants_match_reference_formulas(self):
+        ad = AlphaDropout(p=0.5)
+        ap = ad.alpha_prime
+        assert np.isclose(ap, -1.0507009873554804 * 1.6732632423543772)
+        assert np.isclose(ad.a(0.5), 1.0 / np.sqrt(0.5 + ap * ap * 0.25))
+        assert np.isclose(ad.b(0.5), -ad.a(0.5) * 0.5 * ap)
+
+    def test_gaussian_dropout_multiplicative(self):
+        x = jnp.full((100_000,), 2.0, jnp.float32)
+        out = np.asarray(GaussianDropout(rate=0.5).apply(
+            x, jax.random.PRNGKey(3), True))
+        assert abs(out.mean() - 2.0) < 0.05         # E[x·N(1,s)] = x
+        assert abs(out.std() - 2.0 * 1.0) < 0.05    # s = sqrt(.5/.5) = 1
+
+    def test_gaussian_noise_additive(self):
+        x = jnp.zeros((100_000,), jnp.float32)
+        out = np.asarray(GaussianNoise(stddev=0.3).apply(
+            x, jax.random.PRNGKey(4), True))
+        assert abs(out.mean()) < 0.01 and abs(out.std() - 0.3) < 0.01
+
+    def test_spatial_dropout_drops_whole_channels(self):
+        x = jnp.ones((4, 5, 5, 32), jnp.float32)
+        out = np.asarray(SpatialDropout(p=0.6).apply(
+            x, jax.random.PRNGKey(5), True))
+        # each (example, channel) is uniformly zero or uniformly 1/p
+        per_chan = out.reshape(4, 25, 32)
+        assert ((per_chan == 0).all(axis=1) | (per_chan > 0).all(axis=1)).all()
+        kept = per_chan[:, 0, :] != 0
+        np.testing.assert_allclose(per_chan[:, :, :][kept[:, None, :]
+                                   .repeat(25, 1)], 1.0 / 0.6, rtol=1e-6)
+        with pytest.raises(ValueError):
+            SpatialDropout(p=0.5).apply(jnp.ones((4, 8)),
+                                        jax.random.PRNGKey(0), True)
+
+    def test_inference_is_identity(self):
+        x = jax.random.normal(jax.random.PRNGKey(6), (64,), jnp.float32)
+        for d in (Dropout(0.5), AlphaDropout(0.5), GaussianDropout(0.5),
+                  GaussianNoise(0.2)):
+            np.testing.assert_allclose(
+                np.asarray(d.apply(x, jax.random.PRNGKey(7), False)),
+                np.asarray(x))
+
+    def test_gradients_through_fixed_mask(self):
+        # with the rng key fixed the mask is constant, so autodiff gradients
+        # must match central finite differences (gradient-check tier)
+        key = jax.random.PRNGKey(8)
+        with jax.enable_x64(True):
+            for d in (AlphaDropout(0.7), GaussianDropout(0.3),
+                      GaussianNoise(0.2), Dropout(0.6)):
+                def f(x):
+                    return jnp.sum(d.apply(x, key, True) ** 2)
+                x = jnp.asarray(np.random.default_rng(0).normal(size=(20,)),
+                                jnp.float64)
+                g = np.asarray(jax.grad(f)(x))
+                eps = 1e-6
+                for i in range(0, 20, 5):
+                    xp = x.at[i].add(eps)
+                    xm = x.at[i].add(-eps)
+                    fd = (float(f(xp)) - float(f(xm))) / (2 * eps)
+                    assert abs(fd - g[i]) < 1e-4, (type(d).__name__, i, fd, g[i])
+
+    def test_layer_field_and_serde(self):
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="selu",
+                                  dropout=AlphaDropout(p=0.9)))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(5)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        d = conf2.layers[0].dropout
+        assert isinstance(d, AlphaDropout) and d.p == 0.9
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score_))
+
+    def test_schedule_rejected_loudly(self):
+        from deeplearning4j_tpu.nn.updaters import StepSchedule
+        with pytest.raises(ValueError, match="schedule"):
+            Dropout(p=StepSchedule(0.5, 0.9, 10))

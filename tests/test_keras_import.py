@@ -530,3 +530,145 @@ class TestLambdaImport:
         with pytest.raises(UnsupportedKerasConfigurationException,
                            match="register_lambda_layer"):
             KerasModelImport.import_keras_model_and_weights(p)
+
+
+class TestNoiseLayersAndConstraints:
+    """Keras noise layers import as their REAL implementations (not a
+    plain-dropout approximation), ThresholdedReLU keeps theta, and
+    kernel/bias constraints arrive as post-update constraints."""
+
+    def test_thresholded_relu_keeps_theta(self, tmp_path):
+        # Keras 3 dropped ThresholdedReLU, so drive the importer on a
+        # hand-authored Keras-2 config (the dialect the reference's fixtures
+        # use) and check the math f(x) = x·1[x > θ] directly
+        conf = {
+            "class_name": "Sequential",
+            "config": {"name": "m", "layers": [
+                {"class_name": "InputLayer",
+                 "config": {"name": "in", "batch_input_shape": [None, 6]}},
+                {"class_name": "ThresholdedReLU",
+                 "config": {"name": "t", "theta": 0.7}},
+            ]},
+        }
+        jp = tmp_path / "trelu.json"
+        jp.write_text(json.dumps(conf))
+        net_conf = KerasModelImport.import_keras_model_configuration(str(jp))
+        layer = net_conf.layers[0]
+        act = layer.activation
+        assert act[0] == "thresholdedrelu" and act[1]["theta"] == 0.7
+        x = np.random.RandomState(1).randn(5, 6).astype(np.float32)
+        y, _ = layer.forward({}, x)
+        np.testing.assert_allclose(np.asarray(y), np.where(x > 0.7, x, 0.0),
+                                   rtol=1e-6)
+
+    def test_noise_layers_map_to_real_variants(self, tmp_path):
+        from deeplearning4j_tpu.nn.dropout import (AlphaDropout,
+                                                   GaussianDropout,
+                                                   GaussianNoise)
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((8,)),
+            kl.AlphaDropout(0.3, name="a"),
+            kl.GaussianDropout(0.2, name="g"),
+            kl.GaussianNoise(0.4, name="n"),
+            kl.Dense(2, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "noise.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        kinds = [getattr(l, "dropout", None) for l in net.conf.layers[:3]]
+        assert isinstance(kinds[0], AlphaDropout)
+        assert np.isclose(kinds[0].p, 0.7)        # keep = 1 - rate
+        assert isinstance(kinds[1], GaussianDropout)
+        assert np.isclose(kinds[1].rate, 0.2)
+        assert isinstance(kinds[2], GaussianNoise)
+        assert np.isclose(kinds[2].stddev, 0.4)
+        # inference: identity, so outputs equal Keras inference
+        x = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+        _assert_close(net.output(x), m.predict(x, verbose=0))
+
+    def test_spatial_dropout_imports_channel_semantics(self, tmp_path):
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((6, 6, 3)),
+            kl.SpatialDropout2D(0.5, name="sd"),
+            kl.Conv2D(4, 3, activation="relu", name="c"),
+            kl.Flatten(),
+            kl.Dense(2, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "sdrop.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        sd = net.conf.layers[0].dropout
+        assert isinstance(sd, SpatialDropout) and np.isclose(sd.p, 0.5)
+        x = np.random.RandomState(3).randn(2, 6, 6, 3).astype(np.float32)
+        _assert_close(net.output(x), m.predict(x, verbose=0))
+
+    def test_alpha_dropout_training_moments(self, tmp_path):
+        # the imported AlphaDropout must preserve mean/variance at train
+        # time (the plain-dropout stand-in it replaces does not)
+        import jax
+        kl = keras.layers
+        m = keras.Sequential([kl.Input((2000,)),
+                              kl.AlphaDropout(0.1, name="a"),
+                              kl.Dense(2, activation="softmax", name="d")])
+        p = _save(m, tmp_path, "amom.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        ad = net.conf.layers[0].dropout
+        x = np.random.RandomState(4).randn(100, 2000).astype(np.float32)
+        out = np.asarray(ad.apply(x, jax.random.PRNGKey(0), True))
+        assert abs(out.mean()) < 0.02 and abs(out.std() - 1.0) < 0.02
+
+    def test_recurrent_constraints_name_their_params(self, tmp_path):
+        from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint,
+                                                       UnitNormConstraint)
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((5, 4)),
+            kl.LSTM(6, name="l", return_sequences=True,
+                    kernel_constraint=keras.constraints.MaxNorm(2.0),
+                    recurrent_constraint=keras.constraints.UnitNorm()),
+            kl.Dense(2, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "rconstr.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        cs = net.conf.layers[0].constraints
+        by_names = {c.param_names: c for c in cs}
+        assert isinstance(by_names[("W",)], MaxNormConstraint)
+        assert isinstance(by_names[("RW",)], UnitNormConstraint)
+
+    def test_unknown_constraint_rejected_loudly(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras.layers import (
+            UnsupportedKerasConfigurationException, _one_constraint)
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="constraint"):
+            _one_constraint({"class_name": "RadialConstraint", "config": {}},
+                            "weights")
+
+    def test_kernel_and_bias_constraints(self, tmp_path):
+        from deeplearning4j_tpu.nn.constraints import (MaxNormConstraint,
+                                                       NonNegativeConstraint)
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((8,)),
+            kl.Dense(6, name="d1",
+                     kernel_constraint=keras.constraints.MaxNorm(1.5),
+                     bias_constraint=keras.constraints.NonNeg()),
+            kl.Dense(2, activation="softmax", name="d2"),
+        ])
+        p = _save(m, tmp_path, "constr.h5", loss="categorical_crossentropy")
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        cs = net.conf.layers[0].constraints
+        assert any(isinstance(c, MaxNormConstraint) and c.max_norm == 1.5
+                   and c.scope == "weights" for c in cs)
+        assert any(isinstance(c, NonNegativeConstraint) and c.scope == "bias"
+                   for c in cs)
+        # and they actually run post-update: train with large lr, check cap
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.RandomState(5)
+        x = rng.rand(32, 8).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+        w = np.asarray(net.params[0]["W"])
+        assert (np.sqrt((w ** 2).sum(axis=0)) <= 1.5 + 1e-3).all()
+        assert (np.asarray(net.params[0]["b"]) >= 0).all()

@@ -8,6 +8,7 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
 from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer,
     BatchNormalizationLayer,
     ConvolutionLayer,
     DenseLayer,
@@ -220,3 +221,131 @@ class TestConvLSTMStateful:
         full = np.asarray(net.output(x))
         np.testing.assert_allclose(np.concatenate(step_outs, axis=1), full,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestMaskLayer:
+    """MaskLayer (nn/conf/layers/util/MaskLayer.java:24): applies the mask to
+    activations (and, via autodiff, gradients), otherwise pass-through."""
+
+    def test_2d_per_example_mask(self):
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        x = np.arange(12, dtype=np.float32).reshape(3, 4) + 1
+        m = np.array([1.0, 0.0, 1.0], np.float32)
+        y, _ = MaskLayer().forward({}, x, mask=m)
+        np.testing.assert_allclose(np.asarray(y), x * m[:, None])
+        y2, _ = MaskLayer().forward({}, x, mask=m[:, None])  # column vector
+        np.testing.assert_allclose(np.asarray(y2), x * m[:, None])
+
+    def test_3d_step_mask_and_4d_cnn(self):
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        rng = np.random.default_rng(0)
+        x3 = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        m3 = (rng.random((2, 5)) > 0.4).astype(np.float32)
+        y3, _ = MaskLayer().forward({}, x3, mask=m3)
+        np.testing.assert_allclose(np.asarray(y3), x3 * m3[:, :, None])
+        x4 = rng.normal(size=(3, 4, 4, 2)).astype(np.float32)
+        m4 = np.array([0.0, 1.0, 1.0], np.float32)
+        y4, _ = MaskLayer().forward({}, x4, mask=m4)
+        np.testing.assert_allclose(np.asarray(y4),
+                                   x4 * m4[:, None, None, None])
+
+    def test_full_elementwise_mask(self):
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 5, 3)).astype(np.float32)
+        m = (rng.random((2, 5, 3)) > 0.5).astype(np.float32)
+        y, _ = MaskLayer().forward({}, x, mask=m)
+        np.testing.assert_allclose(np.asarray(y), x * m)
+
+    def test_per_example_mask_reaches_mid_network_layer(self):
+        # a DL4J-style [N,1] feature mask must survive past 2d activations
+        # and zero the masked example's outputs at the MaskLayer
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        conf = (NeuralNetConfiguration.builder().seed(4).list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(MaskLayer())
+                .layer(ActivationLayer(activation="identity"))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(4, 3)).astype(np.float32)
+        m = np.array([[1.0], [0.0], [1.0], [0.0]], np.float32)
+        out = np.asarray(net.output(x, mask=m))
+        np.testing.assert_allclose(out[1], 0.0)
+        np.testing.assert_allclose(out[3], 0.0)
+        assert np.abs(out[0]).sum() > 0
+
+    def test_no_mask_is_identity_and_bad_mask_rejected(self):
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        x = np.ones((2, 3), np.float32)
+        y, _ = MaskLayer().forward({}, x)
+        np.testing.assert_allclose(np.asarray(y), x)
+        with np.testing.assert_raises_regex(ValueError, "MaskLayer"):
+            MaskLayer().forward({}, x, mask=np.ones((3,), np.float32))
+
+    def test_gradients_masked_through_network(self):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+
+        def f(x, m):
+            y, _ = MaskLayer().forward({}, x, mask=m)
+            return jnp.sum(y ** 2)
+
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        m = np.array([1.0, 0.0], np.float32)
+        g = np.asarray(jax.grad(f)(jnp.asarray(x), jnp.asarray(m)))
+        np.testing.assert_allclose(g[0], 2 * x[0])
+        np.testing.assert_allclose(g[1], 0.0)  # masked row: zero gradient
+
+    def test_per_example_mask_masks_the_loss(self):
+        # fitting with a [N,1] feature mask must equal fitting on the
+        # unmasked subset: masked examples contribute neither loss nor
+        # gradients (DL4J per-example score masking)
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        def _make():
+            conf = (NeuralNetConfiguration.builder().seed(6).updater(Sgd(0.1))
+                    .list()
+                    .layer(DenseLayer(n_out=6, activation="tanh"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        keep = np.array([1, 1, 0, 1, 0, 1, 1, 0], np.float32)
+
+        masked = _make()
+        masked.fit(DataSet(x, y, features_mask=keep[:, None]))
+        subset = _make()
+        subset.fit(DataSet(x[keep == 1], y[keep == 1]))
+
+        assert np.isclose(float(masked.score_), float(subset.score_),
+                          rtol=1e-5)
+        for pm, ps in zip(masked.params, subset.params):
+            for k in pm:
+                np.testing.assert_allclose(np.asarray(pm[k]),
+                                           np.asarray(ps[k]),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_in_network_serde_and_fit(self):
+        from deeplearning4j_tpu.nn.layers import MaskLayer
+        from deeplearning4j_tpu.nn.updaters import Adam
+        conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_out=8, activation="tanh"))
+                .layer(MaskLayer())
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(5)).build())
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert type(conf2.layers[1]).__name__ == "MaskLayer"
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(x, y)
+        assert np.isfinite(float(net.score_))
+        out = np.asarray(net.output(x))
+        assert out.shape == (16, 3)

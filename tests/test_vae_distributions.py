@@ -1,0 +1,208 @@
+"""VAE reconstruction distributions (nn/conf/layers/variational/ parity):
+Bernoulli, Gaussian, Exponential, Composite, LossFunctionWrapper — gradient
+checks for every distribution plus the reconstructionProbability /
+reconstructionError API family (VariationalAutoencoder.java:985/998/1146).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.layers import (
+    BernoulliReconstructionDistribution,
+    CompositeReconstructionDistribution,
+    ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution,
+    LossFunctionWrapper,
+    VariationalAutoencoderLayer,
+)
+
+RNG = np.random.default_rng(7)
+
+
+def _vae(dist, n_in=6):
+    return VariationalAutoencoderLayer(
+        n_in=n_in, n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+        activation="tanh", weight_init="xavier",
+        reconstruction_distribution=dist)
+
+
+def _grad_check(layer, x):
+    from deeplearning4j_tpu.util.gradient_check import check_gradients_fn
+    with jax.enable_x64(True):
+        params = layer.init_params(jax.random.PRNGKey(0), jnp.float64)
+        key = jax.random.PRNGKey(5)
+        return check_gradients_fn(lambda p: layer.pretrain_loss(p, jnp.asarray(x), key),
+                                  params, subset=40, print_results=True)
+
+
+class TestGradientChecks:
+    """The reference's VAE gradient-check suite covers every reconstruction
+    distribution (gradientcheck/VaeGradientCheckTests pattern)."""
+
+    def test_bernoulli(self):
+        x = (RNG.random((3, 6)) > 0.5).astype(np.float64)
+        assert _grad_check(_vae(BernoulliReconstructionDistribution()), x)
+
+    def test_gaussian(self):
+        x = RNG.normal(size=(3, 6)).astype(np.float64)
+        assert _grad_check(_vae(GaussianReconstructionDistribution()), x)
+
+    def test_gaussian_tanh_activation(self):
+        x = RNG.normal(size=(3, 6)).astype(np.float64)
+        assert _grad_check(
+            _vae(GaussianReconstructionDistribution(activation="tanh")), x)
+
+    def test_exponential(self):
+        x = RNG.exponential(1.0, size=(3, 6)).astype(np.float64)
+        assert _grad_check(_vae(ExponentialReconstructionDistribution()), x)
+
+    def test_loss_function_wrapper(self):
+        x = RNG.random((3, 6)).astype(np.float64)
+        assert _grad_check(
+            _vae(LossFunctionWrapper(loss="mse", activation="sigmoid")), x)
+
+    def test_composite(self):
+        # first 2 cols binary, next 2 real-valued, last 2 non-negative —
+        # the CompositeReconstructionDistribution.java:27 use case
+        comp = CompositeReconstructionDistribution(distributions=[
+            (2, BernoulliReconstructionDistribution()),
+            (2, GaussianReconstructionDistribution()),
+            (2, ExponentialReconstructionDistribution()),
+        ])
+        x = np.concatenate([
+            (RNG.random((3, 2)) > 0.5).astype(np.float64),
+            RNG.normal(size=(3, 2)),
+            RNG.exponential(1.0, size=(3, 2)),
+        ], axis=1)
+        assert _grad_check(_vae(comp), x)
+
+
+class TestDistributionMath:
+    def test_exponential_neg_log_prob_formula(self):
+        # -log p = λx − γ with γ = pre-out (identity activation)
+        d = ExponentialReconstructionDistribution()
+        gamma = jnp.asarray([[0.0, 1.0]])
+        x = jnp.asarray([[2.0, 0.5]])
+        want = (np.exp(0.0) * 2.0 - 0.0) + (np.exp(1.0) * 0.5 - 1.0)
+        np.testing.assert_allclose(
+            float(d.example_neg_log_prob(x, gamma)[0]), want, rtol=1e-6)
+        # mean = 1/λ = exp(−γ)
+        np.testing.assert_allclose(np.asarray(d.generate_at_mean(gamma)),
+                                   np.exp([[-0.0, -1.0]]), rtol=1e-6)
+
+    def test_exponential_sampling_mean(self):
+        d = ExponentialReconstructionDistribution()
+        gamma = jnp.full((50_000, 1), 0.7)
+        samples = np.asarray(d.generate_random(jax.random.PRNGKey(0), gamma))
+        assert (samples >= 0).all()
+        np.testing.assert_allclose(samples.mean(), np.exp(-0.7), rtol=0.05)
+
+    def test_gaussian_matches_manual_density(self):
+        d = GaussianReconstructionDistribution()
+        mean, log_var = 0.3, -0.5
+        pre = jnp.asarray([[mean, log_var]])
+        x = jnp.asarray([[1.1]])
+        var = np.exp(log_var)
+        want = 0.5 * (np.log(2 * np.pi) + log_var + (1.1 - mean) ** 2 / var)
+        np.testing.assert_allclose(float(d.example_neg_log_prob(x, pre)[0]),
+                                   want, rtol=1e-6)
+
+    def test_composite_sizes_and_slicing(self):
+        comp = CompositeReconstructionDistribution(distributions=[
+            (2, BernoulliReconstructionDistribution()),
+            (3, GaussianReconstructionDistribution()),
+        ])
+        assert comp.distribution_input_size(5) == 2 + 6
+        with pytest.raises(ValueError):
+            comp.distribution_input_size(4)
+        # generate_at_mean returns data-sized output
+        pre = jnp.asarray(RNG.normal(size=(4, 8)).astype(np.float32))
+        assert comp.generate_at_mean(pre).shape == (4, 5)
+        assert comp.generate_random(jax.random.PRNGKey(0), pre).shape == (4, 5)
+        # neg log prob = sum of the parts
+        x = jnp.asarray(np.concatenate(
+            [(RNG.random((4, 2)) > 0.5).astype(np.float32),
+             RNG.normal(size=(4, 3)).astype(np.float32)], axis=1))
+        total = comp.example_neg_log_prob(x, pre)
+        b = BernoulliReconstructionDistribution().example_neg_log_prob(
+            x[:, :2], pre[:, :2])
+        g = GaussianReconstructionDistribution().example_neg_log_prob(
+            x[:, 2:], pre[:, 2:])
+        np.testing.assert_allclose(np.asarray(total), np.asarray(b + g),
+                                   rtol=1e-5)
+
+
+class TestReconstructionAPIs:
+    def _trained(self, dist, x, steps=200):
+        layer = _vae(dist, n_in=x.shape[1])
+        params = layer.init_params(jax.random.PRNGKey(0), jnp.float32)
+        grad = jax.jit(jax.grad(lambda p, k: layer.pretrain_loss(
+            p, jnp.asarray(x, jnp.float32), k)))
+        key = jax.random.PRNGKey(1)
+        for _ in range(steps):
+            key, k = jax.random.split(key)
+            g = grad(params, k)
+            params = jax.tree_util.tree_map(lambda p, gg: p - 0.05 * gg,
+                                            params, g)
+        return layer, params
+
+    def test_reconstruction_log_probability_ranks_in_vs_out(self):
+        # after training on structured binary data, in-distribution examples
+        # must score higher log p(x) than far-out-of-distribution ones
+        x = np.zeros((64, 6), np.float32)
+        x[:, 0] = 1.0  # the dataset: always [1,0,0,0,0,0]
+        layer, params = self._trained(BernoulliReconstructionDistribution(), x)
+        inlp = np.asarray(layer.reconstruction_log_probability(
+            params, jnp.asarray(x[:4]), jax.random.PRNGKey(2), num_samples=16))
+        out = np.ones((4, 6), np.float32) - x[:4]  # inverted pattern
+        outlp = np.asarray(layer.reconstruction_log_probability(
+            params, jnp.asarray(out), jax.random.PRNGKey(3), num_samples=16))
+        assert inlp.shape == (4,)
+        assert (inlp > outlp + 1.0).all(), (inlp, outlp)
+        # probability form is exp of the log form
+        p = np.asarray(layer.reconstruction_probability(
+            params, jnp.asarray(x[:4]), jax.random.PRNGKey(2), num_samples=16))
+        assert (p <= 1.0).all() and (p > 0).all()
+
+    def test_loss_wrapper_error_api_and_probability_rejection(self):
+        x = RNG.random((32, 6)).astype(np.float32)
+        layer, params = self._trained(
+            LossFunctionWrapper(loss="mse", activation="sigmoid"), x, steps=50)
+        err = np.asarray(layer.reconstruction_error(params, jnp.asarray(x)))
+        assert err.shape == (32,) and (err >= 0).all()
+        with pytest.raises(ValueError, match="not probabilistic|LossFunction"):
+            layer.reconstruction_log_probability(params, jnp.asarray(x),
+                                                 jax.random.PRNGKey(0))
+        # and the converse: probabilistic configs reject reconstruction_error
+        layer2 = _vae(BernoulliReconstructionDistribution())
+        params2 = layer2.init_params(jax.random.PRNGKey(0), jnp.float32)
+        with pytest.raises(ValueError, match="loss-function"):
+            layer2.reconstruction_error(params2, jnp.asarray(x))
+
+    def test_generate_random_given_z(self):
+        layer = _vae(BernoulliReconstructionDistribution())
+        params = layer.init_params(jax.random.PRNGKey(0), jnp.float32)
+        z = jnp.asarray(RNG.normal(size=(5, 3)).astype(np.float32))
+        s = np.asarray(layer.generate_random(params, z, jax.random.PRNGKey(1)))
+        assert s.shape == (5, 6) and set(np.unique(s)) <= {0.0, 1.0}
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+        comp = CompositeReconstructionDistribution(distributions=[
+            (2, BernoulliReconstructionDistribution()),
+            (4, LossFunctionWrapper(loss="mse", activation="tanh")),
+        ])
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(_vae(comp))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(6)).build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        r = conf2.layers[0].recon
+        assert isinstance(r, CompositeReconstructionDistribution)
+        assert r.distributions[0][0] == 2
+        assert isinstance(r.distributions[1][1], LossFunctionWrapper)
+        assert r.distributions[1][1].loss == "mse"
