@@ -5,11 +5,13 @@ Reads the reference's ``MultiLayerConfiguration.toJson()`` format (Jackson,
 subtype names from the ``@JsonSubTypes`` registry in
 ``nn/conf/layers/Layer.java:54-86``; per-layer fields from ``BaseLayer.java:
 42-54`` / ``FeedForwardLayer.java:21-22`` / ``ConvolutionLayer.java:35-37``)
-and builds the equivalent config here. Also opens ``ModelSerializer`` zips
+and builds the equivalent config here. ``ModelSerializer`` zips
 (``util/ModelSerializer.java:120-125``: ``configuration.json`` +
-``coefficients.bin``) for their configuration; ``coefficients.bin`` is the
-external ND4J binary (not part of this repo's sources), so parameter values
-are not ingested — the returned network is freshly initialized.
+``coefficients.bin`` + ``updaterState.bin``) restore FULLY via
+:func:`restore_multi_layer_network` — the flattened ND4J parameter vector is
+parsed by ``nd4j_binary.py`` and mapped onto the param pytree (DL4J
+ParamInitializer order, 'f' weight order, conv OIHW→HWIO), and the updater
+state is rebuilt for uniform updater configs.
 
 The parser is deliberately tolerant about field spellings ("nin"/"nIn",
 activation as enum string or ``@class`` wrapper) — the same posture as the
@@ -268,6 +270,10 @@ def convert_dl4j_layer(type_name: str, cfg: dict):
             kw["eps"] = float(cfg["eps"])
         if "decay" in cfg:
             kw["decay"] = float(cfg["decay"])
+        if cfg.get("lockGammaBeta"):
+            # locked gamma/beta carry NO params in the DL4J vector — must be
+            # mirrored or every later slice shifts during ingestion
+            kw["lock_gamma_beta"] = True
         n = _get(cfg, "nin", "nIn", "nout", "nOut")
         if n:
             kw["n_in"] = int(n)
@@ -368,7 +374,64 @@ def import_dl4j_configuration(source: str):
     if bp == "TruncatedBPTT":
         lb.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
     built = lb.build()
+    for k, v in (d.get("inputPreProcessors") or {}).items():
+        fn = _convert_dl4j_preprocessor(v)
+        if fn is not None:
+            built.preprocessors[int(k)] = fn
     return built
+
+
+def _convert_dl4j_preprocessor(entry):
+    """One ``inputPreProcessors`` entry → activation fn (or None = identity).
+
+    Accepts both serde dialects: WRAPPER_OBJECT ``{"cnnToFeedForward":
+    {...}}`` and 1.0-era ``{"@class": "...CnnToFeedForwardPreProcessor",
+    ...}``. DL4J flattens CNN activations in NCHW order
+    (``CnnToFeedForwardPreProcessor.java``), so the dense weights of an
+    imported checkpoint index features as c·H·W + h·W + w — the transposes
+    below preserve that indexing over our NHWC activations.
+    Rnn↔FeedForward preprocessors are identity here: dense layers apply
+    position-wise over [N,T,C] natively. Unknown preprocessor types degrade
+    to a warning + identity so config-only import keeps working (the
+    reference's tolerant serde posture).
+    """
+    if isinstance(entry, dict) and "@class" in entry:
+        t, cfg = entry["@class"], entry
+    elif isinstance(entry, dict) and len(entry) == 1:
+        t, cfg = next(iter(entry.items()))
+    else:
+        raise InvalidDl4jConfigurationException(
+            f"bad inputPreProcessors entry {entry!r}")
+    cfg = cfg or {}
+    t = t[1:] if t.startswith(".") else t
+    name = t.rsplit(".", 1)[-1]
+    key = name[0].lower() + name[1:]
+    if key in ("cnnToFeedForwardPreProcessor", "cnnToFeedForward"):
+        return lambda x: x.transpose(0, 3, 1, 2).reshape(x.shape[0], -1)
+    if key in ("feedForwardToCnnPreProcessor", "feedForwardToCnn"):
+        h = int(_get(cfg, "inputHeight", "numRows"))
+        w = int(_get(cfg, "inputWidth", "numColumns"))
+        c = int(_get(cfg, "numChannels", "depth", default=1))
+        return lambda x: x.reshape(x.shape[0], c, h, w).transpose(0, 2, 3, 1)
+    if key in ("cnnToRnnPreProcessor", "cnnToRnn"):
+        # per-step NCHW-order flatten: [N,T,H,W,C] → [N,T,C·H·W]
+        return lambda x: x.transpose(0, 1, 4, 2, 3).reshape(
+            x.shape[0], x.shape[1], -1)
+    if key in ("rnnToCnnPreProcessor", "rnnToCnn"):
+        h = int(_get(cfg, "inputHeight", "numRows"))
+        w = int(_get(cfg, "inputWidth", "numColumns"))
+        c = int(_get(cfg, "numChannels", "depth", default=1))
+        return lambda x: x.reshape(
+            x.shape[0], x.shape[1], c, h, w).transpose(0, 1, 3, 4, 2)
+    if key in ("rnnToFeedForwardPreProcessor", "rnnToFeedForward",
+               "feedForwardToRnnPreProcessor", "feedForwardToRnn"):
+        return None  # position-wise application makes these identity here
+    import warnings
+    warnings.warn(
+        f"ignoring unsupported DL4J input preprocessor {t!r} (identity); "
+        "verify the imported network's activations if this index mattered",
+        stacklevel=2)
+    return None
 
 
 def _convert_dl4j_vertex(type_name: str, cfg: dict):
@@ -453,16 +516,21 @@ def import_dl4j_graph_configuration(source: str):
     return g.build()
 
 
+def _read_zip_configuration(z: "zipfile.ZipFile", path: str) -> dict:
+    """Shared ModelSerializer-zip prologue: validate + parse the JSON."""
+    names = set(z.namelist())
+    if "configuration.json" not in names:
+        raise InvalidDl4jConfigurationException(
+            f"{path}: no configuration.json in zip (entries: {sorted(names)})")
+    return json.loads(z.read("configuration.json").decode("utf-8"))
+
+
 def import_dl4j_zip(path: str):
-    """ModelSerializer zip → (config, metadata). Parameter values
-    (``coefficients.bin``, external ND4J binary) are not ingested; the
-    caller initializes fresh params from the imported config."""
+    """ModelSerializer zip → (config, metadata). For parameter ingestion
+    use :func:`restore_multi_layer_network`."""
     with zipfile.ZipFile(path) as z:
         names = set(z.namelist())
-        if "configuration.json" not in names:
-            raise InvalidDl4jConfigurationException(
-                f"{path}: no configuration.json in zip (entries: {sorted(names)})")
-        raw = json.loads(z.read("configuration.json").decode("utf-8"))
+        raw = _read_zip_configuration(z, path)
         conf = (import_dl4j_graph_configuration(raw) if "vertices" in raw
                 else import_dl4j_configuration(raw))
         meta = {"has_coefficients": "coefficients.bin" in names,
@@ -478,3 +546,229 @@ def restore_multi_layer_network_configuration(path: str):
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     conf, _ = import_dl4j_zip(path)
     return MultiLayerNetwork(conf)
+
+
+# ---- coefficients.bin parameter ingestion ---------------------------------
+#
+# ``ModelSerializer.restoreMultiLayerNetwork`` (``util/ModelSerializer.java:
+# 182``) restores configuration AND the flattened ``coefficients.bin``
+# parameter vector (+ ``updaterState.bin``). The vector is the network's
+# single flattened param buffer (``MultiLayerNetwork.init():549``), laid out
+# layer by layer in each layer's ParamInitializer order, with each weight
+# matrix stored in DL4J's default weight order 'f'
+# (``WeightInitUtil.DEFAULT_WEIGHT_INIT_ORDER``) except conv kernels, whose
+# initializer reshapes with 'c' ([nOut, nIn, kH, kW]).
+
+def _dl4j_param_specs(layer):
+    """Ordered flattened-view slices for one layer: each spec is
+    ``(name, dl4j_shape, memory_order, convert, target)`` with target
+    "param" or "state". Empty list = layer holds no parameters."""
+    import numpy as np
+
+    cls = type(layer).__name__
+    shapes = layer.param_shapes()
+
+    def ravel(a):
+        return np.ascontiguousarray(a).reshape(-1)
+
+    def ident(a):
+        return np.ascontiguousarray(a)
+
+    if cls == "BatchNormalizationLayer":
+        # BatchNormalizationParamInitializer order: gamma, beta, mean, var
+        n = layer.n_in
+        specs = []
+        if "gamma" in shapes:
+            specs += [("gamma", (1, n), "c", ravel, "param"),
+                      ("beta", (1, n), "c", ravel, "param")]
+        specs += [("mean", (1, n), "c", ravel, "state"),
+                  ("var", (1, n), "c", ravel, "state")]
+        return specs
+    if not shapes:
+        return []
+    if cls == "ConvolutionLayer":
+        # ConvolutionParamInitializer: W [nOut, nIn, kH, kW] 'c' → our HWIO
+        kh, kw = layer.kernel_size
+        specs = [("W", (layer.n_out, layer.n_in, kh, kw), "c",
+                  lambda a: np.transpose(a, (2, 3, 1, 0)), "param")]
+        if "b" in shapes:
+            specs.append(("b", (1, layer.n_out), "c", ravel, "param"))
+        return specs
+    if cls in ("LSTMLayer", "GravesLSTMLayer", "SimpleRnnLayer", "GRULayer"):
+        # LSTMParamInitializer order: W [nIn, 4H], RW [H, 4H(+3 peephole
+        # cols for Graves — our layout already matches)], b; IFOG gate order
+        # is shared (LSTMHelpers.java layout, see nn/layers/recurrent.py)
+        specs = [("W", shapes["W"], "f", ident, "param"),
+                 ("RW", shapes["RW"], "f", ident, "param")]
+        if "b" in shapes:
+            specs.append(("b", (1, shapes["b"][0]), "c", ravel, "param"))
+        return specs
+    if set(shapes) <= {"W", "b"} and len(shapes.get("W", (0, 0))) == 2:
+        # dense family (Dense/Output/Embedding/ElementWiseMult):
+        # DefaultParamInitializer, weights reshaped 'f'
+        specs = [("W", shapes["W"], "f", ident, "param")]
+        if "b" in shapes:
+            specs.append(("b", (1, shapes["b"][0]), "c", ravel, "param"))
+        return specs
+    raise UnsupportedDl4jConfigurationException(
+        f"coefficients.bin ingestion does not support layer type {cls} "
+        f"(params {sorted(shapes)}); restore the configuration only via "
+        "restore_multi_layer_network_configuration")
+
+
+def _iter_param_slices(conf, flat):
+    """Yield (layer_index, name, target, converted_array) walking the
+    flattened vector in DL4J layout order."""
+    import numpy as np
+
+    pos = 0
+    flat = np.asarray(flat).reshape(-1)
+    for i, layer in enumerate(conf.layers):
+        for name, dl4j_shape, order, convert, target in _dl4j_param_specs(layer):
+            n = int(np.prod(dl4j_shape))
+            seg = flat[pos:pos + n]
+            if seg.size != n:
+                raise InvalidDl4jConfigurationException(
+                    f"coefficients.bin too short: layer {i} param {name!r} "
+                    f"wants {n} values at offset {pos}, only {seg.size} left")
+            pos += n
+            arr = seg.reshape(dl4j_shape,
+                              order="F" if order == "f" else "C")
+            yield i, name, target, convert(arr)
+    if pos != flat.size:
+        raise InvalidDl4jConfigurationException(
+            f"coefficients.bin length mismatch: consumed {pos} of "
+            f"{flat.size} values — layer inventory disagrees with the "
+            "checkpoint")
+
+
+def apply_coefficients(net, flat) -> None:
+    """Map a DL4J flattened parameter vector onto an initialized
+    MultiLayerNetwork (params + BatchNorm running stats)."""
+    import jax.numpy as jnp
+
+    dtype = net.conf.global_conf.jnp_dtype()
+    params = [dict(p) for p in net.params]
+    states = [dict(s) for s in net.states]
+    for i, name, target, arr in _iter_param_slices(net.conf, flat):
+        dest = params[i] if target == "param" else states[i]
+        if name in dest and tuple(dest[name].shape) != tuple(arr.shape):
+            raise InvalidDl4jConfigurationException(
+                f"layer {i} param {name!r}: checkpoint shape {arr.shape} vs "
+                f"model shape {tuple(dest[name].shape)}")
+        # running stats keep their initialized dtype (BN pins them to f32
+        # regardless of the global dtype — see nn/layers/norm.py)
+        dt = dest[name].dtype if name in dest else dtype
+        dest[name] = jnp.asarray(arr, dt)
+    net.params = params
+    net.states = states
+
+
+# DL4J GradientUpdater state-view subdivision order → our state keys
+_UPDATER_STATE_SLOTS = {
+    "Adam": ("m", "v"), "AdaMax": ("m", "u"), "Nadam": ("m", "v"),
+    "AMSGrad": ("m", "v", "v_hat"), "Nesterovs": ("v",), "RmsProp": ("g2",),
+    "AdaGrad": ("h",), "AdaDelta": ("eg2", "edx2"), "Sgd": (), "NoOp": (),
+}
+
+
+def _updater_blocks(conf):
+    """DL4J ``UpdaterBlock`` boundaries over the flattened layout: trainable
+    params coalesce into contiguous blocks, SPLIT wherever a non-trainable
+    run (BatchNorm global mean/var, which DL4J pairs with a stateless NoOp
+    pseudo-updater) interrupts them. Yields lists of
+    ``(layer_idx, name, dl4j_shape, order, convert)`` per block."""
+    import numpy as np
+
+    blocks, current = [], []
+    for i, layer in enumerate(conf.layers):
+        for name, dl4j_shape, order, convert, target in _dl4j_param_specs(layer):
+            if target != "param":
+                if current:
+                    blocks.append(current)
+                    current = []
+                continue
+            current.append((i, name, dl4j_shape, order, convert))
+    if current:
+        blocks.append(current)
+    return blocks
+
+
+def apply_updater_state(net, flat) -> bool:
+    """Map a DL4J ``updaterState.bin`` vector onto the net's updater states.
+
+    Supported for a UNIFORM trainable-updater configuration (one updater
+    type across all trainable params). DL4J groups contiguous same-config
+    params into ``UpdaterBlock``s — BatchNorm global mean/var get a
+    stateless pseudo-updater, so each block's view is
+    ``[slot0(block), slot1(block), …]`` and blocks concatenate in flattened
+    order with the mean/var runs contributing nothing. Heterogeneous
+    updater configs return False (state left freshly initialized), since
+    those block boundaries cannot be recovered without the ND4J runtime.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    kinds = {type(u).__name__ for umap in net._updaters for u in umap.values()}
+    if len(kinds) != 1:
+        return False
+    kind = next(iter(kinds))
+    slots = _UPDATER_STATE_SLOTS.get(kind)
+    if slots is None:
+        return False
+    flat = np.asarray(flat).reshape(-1)
+    if not slots:
+        return flat.size == 0
+    blocks = _updater_blocks(net.conf)
+    want = len(slots) * sum(int(np.prod(shape))
+                            for b in blocks for (_, _, shape, _, _) in b)
+    if flat.size != want:
+        raise InvalidDl4jConfigurationException(
+            f"updaterState.bin length {flat.size} != expected {want} "
+            f"({len(slots)} {kind} slots over the trainable params)")
+    dtype = net.conf.global_conf.jnp_dtype()
+    new_states = [dict(s) for s in net.updater_states]
+    pos = 0
+    for block in blocks:
+        block_n = sum(int(np.prod(shape)) for (_, _, shape, _, _) in block)
+        for slot in slots:
+            at = pos
+            for i, name, dl4j_shape, order, convert in block:
+                n = int(np.prod(dl4j_shape))
+                arr = flat[at:at + n].reshape(
+                    dl4j_shape, order="F" if order == "f" else "C")
+                at += n
+                new_states[i][name] = {**new_states[i][name],
+                                       slot: jnp.asarray(convert(arr), dtype)}
+            pos = at  # next slot (or next block) starts right after
+    net.updater_states = new_states
+    return True
+
+
+def restore_multi_layer_network(path: str, load_params: bool = True,
+                                load_updater: bool = True):
+    """Full ``ModelSerializer.restoreMultiLayerNetwork`` parity
+    (``util/ModelSerializer.java:182``): configuration + flattened
+    ``coefficients.bin`` parameters (+ ``updaterState.bin`` when present and
+    the updater configuration is uniform). Returns an initialized
+    MultiLayerNetwork carrying the checkpoint's weights."""
+    from deeplearning4j_tpu.modelimport.nd4j_binary import (
+        read_nd4j_array_from_bytes)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        raw = _read_zip_configuration(z, path)
+        if "vertices" in raw:
+            raise UnsupportedDl4jConfigurationException(
+                "restore_multi_layer_network is for MultiLayerNetwork zips; "
+                "this is a ComputationGraph configuration")
+        conf = import_dl4j_configuration(raw)
+        net = MultiLayerNetwork(conf).init()
+        if load_params and "coefficients.bin" in names:
+            coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
+            apply_coefficients(net, coeff)
+        if (load_params and load_updater and "updaterState.bin" in names):
+            upd = read_nd4j_array_from_bytes(z.read("updaterState.bin"))
+            apply_updater_state(net, upd)
+    return net

@@ -1,0 +1,175 @@
+"""Generate DL4J ModelSerializer checkpoint fixtures with real
+``coefficients.bin`` / ``updaterState.bin`` payloads.
+
+The flattened layouts here are written INDEPENDENTLY of the importer
+(hand-coded per layer family, mirroring DL4J's ParamInitializer order and
+WeightInitUtil 'f' weight order / conv 'c' order) so the reader in
+``modelimport/dl4j.py`` is genuinely inverted against them, not round-tripped
+through its own logic.
+
+Run from the repo root:  python tests/fixtures/make_nd4j_checkpoint_fixtures.py
+"""
+
+import io
+import json
+import os
+import zipfile
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def nd4j_bytes(arr: np.ndarray) -> bytes:
+    from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
+    return nd4j_array_to_bytes(np.asarray(arr, np.float32).reshape(1, -1), "c")
+
+
+def conv_net_fixture():
+    """Conv(3x3,1→4) → BN(4) → Dense(100→10) → Output(10→3), Adam."""
+    rng = np.random.default_rng(1234)
+    conf = {
+        "backprop": True,
+        "backpropType": "Standard",
+        "confs": [
+            {"seed": 7, "layer": {"convolution": {
+                "layerName": "c0",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationReLU"},
+                "kernelSize": [3, 3], "stride": [1, 1], "padding": [0, 0],
+                "convolutionMode": "Truncate", "nin": 1, "nout": 4,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01},
+            }}},
+            {"layer": {"batchNormalization": {
+                "layerName": "bn", "eps": 1e-5, "decay": 0.9, "nin": 4,
+                "nout": 4,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01},
+            }}},
+            {"layer": {"dense": {
+                "layerName": "d0",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"},
+                "nin": 144, "nout": 10,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01},
+            }}},
+            {"layer": {"output": {
+                "layerName": "out",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "nin": 10, "nout": 3,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01},
+            }}},
+        ],
+        "inputPreProcessors": {"2": {"cnnToFeedForward": {
+            "inputHeight": 6, "inputWidth": 6, "numChannels": 4}}},
+    }
+    # per-layer params in OUR layouts
+    conv_W = rng.normal(0, 0.3, (3, 3, 1, 4)).astype(np.float32)   # HWIO
+    conv_b = rng.normal(0, 0.1, (4,)).astype(np.float32)
+    bn_gamma = rng.uniform(0.5, 1.5, (4,)).astype(np.float32)
+    bn_beta = rng.normal(0, 0.1, (4,)).astype(np.float32)
+    bn_mean = rng.normal(0, 0.2, (4,)).astype(np.float32)
+    bn_var = rng.uniform(0.5, 1.5, (4,)).astype(np.float32)
+    d_W = rng.normal(0, 0.1, (144, 10)).astype(np.float32)
+    d_b = rng.normal(0, 0.1, (10,)).astype(np.float32)
+    o_W = rng.normal(0, 0.2, (10, 3)).astype(np.float32)
+    o_b = rng.normal(0, 0.1, (3,)).astype(np.float32)
+
+    # DL4J flattened layout, hand-coded:
+    #   conv:  W as [nOut, nIn, kH, kW] 'c'  (our HWIO → OIHW transpose)
+    #   dense: W as [nIn, nOut] 'f'; biases & BN vectors flat
+    flat = np.concatenate([
+        np.transpose(conv_W, (3, 2, 0, 1)).flatten(order="C"), conv_b,
+        bn_gamma, bn_beta, bn_mean, bn_var,
+        d_W.flatten(order="F"), d_b,
+        o_W.flatten(order="F"), o_b,
+    ]).astype(np.float32)
+
+    # Adam updater state. DL4J groups contiguous same-updater params into
+    # UpdaterBlocks; BN global mean/var carry a stateless pseudo-updater, so
+    # the blocks here are A = [conv W,b + bn gamma,beta] and B = [dense +
+    # output], each stored as [M(block), V(block)] — hand-coded layout,
+    # independent of the reader.
+    n_a = conv_W.size + conv_b.size + bn_gamma.size + bn_beta.size
+    n_trainable = n_a + d_W.size + d_b.size + o_W.size + o_b.size
+    m = np.arange(n_trainable, dtype=np.float32) * 1e-3
+    v = np.arange(n_trainable, dtype=np.float32) * 1e-4 + 1e-6
+    upd = np.concatenate([m[:n_a], v[:n_a], m[n_a:], v[n_a:]])
+
+    zpath = os.path.join(HERE, "dl4j_checkpoint_convnet.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin", nd4j_bytes(flat))
+        z.writestr("updaterState.bin", nd4j_bytes(upd))
+
+    # recorded activations from the restored net (regression lock)
+    from deeplearning4j_tpu.modelimport.dl4j import restore_multi_layer_network
+    net = restore_multi_layer_network(zpath)
+    x = rng.normal(0, 1, (2, 8, 8, 1)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    np.savez(os.path.join(HERE, "dl4j_checkpoint_convnet_expected.npz"),
+             x=x, out=out,
+             conv_W=conv_W, conv_b=conv_b, bn_gamma=bn_gamma, bn_beta=bn_beta,
+             bn_mean=bn_mean, bn_var=bn_var, d_W=d_W, d_b=d_b, o_W=o_W,
+             o_b=o_b, m=m, v=v)
+    print("wrote", zpath, "out[0]:", out[0])
+
+
+def lstm_fixture():
+    """GravesLSTM(5→6, peepholes) → RnnOutput(6→2), Nesterovs."""
+    rng = np.random.default_rng(99)
+    conf = {
+        "backpropType": "TruncatedBPTT",
+        "tbpttFwdLength": 8, "tbpttBackLength": 8,
+        "confs": [
+            {"layer": {"gravesLSTM": {
+                "layerName": "l0",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"},
+                "nin": 5, "nout": 6, "forgetGateBiasInit": 1.0,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Nesterovs",
+                             "learningRate": 0.1, "momentum": 0.9},
+            }}},
+            {"layer": {"rnnoutput": {
+                "layerName": "out",
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "nin": 6, "nout": 2,
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Nesterovs",
+                             "learningRate": 0.1, "momentum": 0.9},
+            }}},
+        ],
+    }
+    h = 6
+    W = rng.normal(0, 0.2, (5, 4 * h)).astype(np.float32)
+    RW = rng.normal(0, 0.2, (h, 4 * h + 3)).astype(np.float32)  # peepholes
+    b = rng.normal(0, 0.05, (4 * h,)).astype(np.float32)
+    oW = rng.normal(0, 0.3, (h, 2)).astype(np.float32)
+    ob = rng.normal(0, 0.1, (2,)).astype(np.float32)
+    flat = np.concatenate([
+        W.flatten(order="F"), RW.flatten(order="F"), b,
+        oW.flatten(order="F"), ob,
+    ]).astype(np.float32)
+    upd = np.arange(flat.size, dtype=np.float32) * 1e-3  # Nesterovs: [V(all)]
+
+    zpath = os.path.join(HERE, "dl4j_checkpoint_lstm.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin", nd4j_bytes(flat))
+        z.writestr("updaterState.bin", nd4j_bytes(upd))
+
+    from deeplearning4j_tpu.modelimport.dl4j import restore_multi_layer_network
+    net = restore_multi_layer_network(zpath)
+    x = rng.normal(0, 1, (2, 7, 5)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    np.savez(os.path.join(HERE, "dl4j_checkpoint_lstm_expected.npz"),
+             x=x, out=out, W=W, RW=RW, b=b, oW=oW, ob=ob, upd=upd)
+    print("wrote", zpath, "out[0,0]:", out[0, 0])
+
+
+if __name__ == "__main__":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    conv_net_fixture()
+    lstm_fixture()

