@@ -146,7 +146,7 @@ def _updater(v):
         if "beta2" in v:
             kw["beta2"] = float(v["beta2"])
     if name == "rmsprop" and "rmsDecay" in v:
-        kw["decay"] = float(v["rmsDecay"])
+        kw["rms_decay"] = float(v["rmsDecay"])
     try:
         return table[name](**kw)
     except TypeError:
@@ -156,6 +156,108 @@ def _updater(v):
 
 def _weight_init(v) -> Optional[str]:
     return None if v is None else str(v).lower()
+
+
+def _legacy_updater(cfg: dict):
+    """Pre-0.9 dialect: the layer carries an ``updater`` ENUM string plus
+    flat hyperparameter fields (``learningRate``, ``momentum``,
+    ``rmsDecay``, ``rho``, ``adamMeanDecay``/``adamVarDecay``) — the exact
+    shape the reference's legacy deserializers convert to IUpdater
+    (exercised by ``regressiontest/RegressionTest050.java`` …080)."""
+    from deeplearning4j_tpu.nn import updaters as U
+
+    name = cfg.get("updater")
+    if not isinstance(name, str):
+        return None
+    name = name.lower()
+    lr = _get(cfg, "learningRate", "lr")
+    kw: Dict[str, Any] = {}
+    if lr is not None:
+        kw["learning_rate"] = float(lr)
+    if name == "nesterovs":
+        if "momentum" in cfg:
+            kw["momentum"] = float(cfg["momentum"])
+        return U.Nesterovs(**kw)
+    if name == "rmsprop":
+        if "rmsDecay" in cfg:
+            kw["rms_decay"] = float(cfg["rmsDecay"])
+        return U.RmsProp(**kw)
+    if name == "adam":
+        if "adamMeanDecay" in cfg:
+            kw["beta1"] = float(cfg["adamMeanDecay"])
+        if "adamVarDecay" in cfg:
+            kw["beta2"] = float(cfg["adamVarDecay"])
+        return U.Adam(**kw)
+    if name == "adadelta":
+        kw.pop("learning_rate", None)
+        return U.AdaDelta(rho=float(cfg.get("rho", 0.95)))
+    if name == "adagrad":
+        return U.AdaGrad(**kw)
+    if name == "adamax":
+        if "adamMeanDecay" in cfg:
+            kw["beta1"] = float(cfg["adamMeanDecay"])
+        if "adamVarDecay" in cfg:
+            kw["beta2"] = float(cfg["adamVarDecay"])
+        return U.AdaMax(**kw)
+    if name == "nadam":
+        return U.Nadam(**kw)
+    if name == "sgd":
+        return U.Sgd(**kw)
+    if name == "none":
+        return U.NoOp()  # Updater.NONE freezes the params (NoOp IUpdater)
+    if name == "custom":
+        return None
+    raise UnsupportedDl4jConfigurationException(
+        f"unknown legacy DL4J updater enum {cfg.get('updater')!r}")
+
+
+def _distribution(v):
+    """``dist`` field: legacy WRAPPER_OBJECT (``{"normal": {"mean": …}}``)
+    or ``@class``-tagged (``{"@class": "….NormalDistribution", …}``)."""
+    from deeplearning4j_tpu.nn.weights import Distribution
+
+    if not isinstance(v, dict):
+        return None
+    if "@class" in v:
+        kind = v["@class"].rsplit(".", 1)[-1]
+        kind = kind[:-len("Distribution")] if kind.endswith("Distribution") else kind
+        cfg = v
+    elif len(v) == 1:
+        kind, cfg = next(iter(v.items()))
+        cfg = cfg or {}
+    else:
+        return None
+    kind = kind.lower()
+    if kind == "normal" or kind == "gaussian":
+        return Distribution(kind="normal", mean=float(cfg.get("mean", 0.0)),
+                            std=float(_get(cfg, "std", "standardDeviation",
+                                           default=1.0)))
+    if kind == "uniform":
+        return Distribution(kind="uniform", lower=float(cfg.get("lower", -1.0)),
+                            upper=float(cfg.get("upper", 1.0)))
+    if kind == "binomial":
+        return Distribution(
+            kind="binomial",
+            n=int(_get(cfg, "numberOfTrials", "n", default=1)),
+            p=float(_get(cfg, "probabilityOfSuccess", "p", default=0.5)))
+    if kind in ("truncatednormal", "truncated_normal"):
+        return Distribution(kind="truncated_normal",
+                            mean=float(cfg.get("mean", 0.0)),
+                            std=float(_get(cfg, "std", "standardDeviation",
+                                           default=1.0)))
+    if kind in ("lognormal", "log_normal"):
+        return Distribution(kind="log_normal",
+                            mean=float(cfg.get("mean", 0.0)),
+                            std=float(_get(cfg, "std", "standardDeviation",
+                                           default=1.0)))
+    if kind == "orthogonal":
+        return Distribution(kind="orthogonal",
+                            gain=float(cfg.get("gain", 1.0)))
+    if kind == "constant":
+        return Distribution(kind="constant",
+                            value=float(cfg.get("value", 0.0)))
+    raise UnsupportedDl4jConfigurationException(
+        f"unknown DL4J distribution {v!r}")
 
 
 # -- per-layer conversion ----------------------------------------------------
@@ -169,15 +271,52 @@ def _base_kwargs(cfg: dict) -> dict:
     act = _activation(_get(cfg, "activationFn", "activationFunction",
                            "activation"))
     if act is not None:
-        kw["activation"] = act
+        if act == "leakyrelu" and "leakyreluAlpha" in cfg:
+            # pre-0.8 dialect: alpha rides the layer, not the activation
+            kw["activation"] = ("leakyrelu",
+                                {"alpha": float(cfg["leakyreluAlpha"])})
+        else:
+            kw["activation"] = act
     wi = _weight_init(_get(cfg, "weightInit", "weightinit"))
-    if wi and wi != "distribution":
+    if wi == "distribution":
+        dist = _distribution(cfg.get("dist"))
+        if dist is not None:
+            kw["weight_init"] = "distribution"
+            kw["distribution"] = dist
+    elif wi:
         kw["weight_init"] = wi
     for src, dst in (("l1", "l1"), ("l2", "l2")):
         val = cfg.get(src)
         if isinstance(val, (int, float)) and val == val and val != 0.0:
             kw[dst] = float(val)
-    upd = _updater(_get(cfg, "iUpdater", "iupdater", "updater"))
+    drop = _get(cfg, "dropOut", "dropout")
+    if isinstance(drop, (int, float)) and 0.0 < float(drop) < 1.0:
+        # pre-1.0 dropOut double == Dropout retain probability, ours too
+        kw["dropout"] = float(drop)
+    idrop = _get(cfg, "iDropout", "idropout")
+    if isinstance(idrop, dict):
+        from deeplearning4j_tpu.nn import dropout as D
+        cls = idrop.get("@class", "")
+        short = cls.rsplit(".", 1)[-1]
+        if short == "Dropout" and "p" in idrop:
+            kw["dropout"] = float(idrop["p"])
+        elif short == "AlphaDropout" and "p" in idrop:
+            kw["dropout"] = D.AlphaDropout(p=float(idrop["p"]))
+        elif short == "GaussianDropout" and "rate" in idrop:
+            kw["dropout"] = D.GaussianDropout(rate=float(idrop["rate"]))
+        elif short == "GaussianNoise" and "stddev" in idrop:
+            kw["dropout"] = D.GaussianNoise(stddev=float(idrop["stddev"]))
+        elif short == "SpatialDropout" and "p" in idrop:
+            kw["dropout"] = D.SpatialDropout(p=float(idrop["p"]))
+        else:
+            import warnings
+            warnings.warn(
+                f"ignoring unsupported DL4J iDropout {cls!r} — training "
+                "regularization of the imported model is dropped",
+                stacklevel=2)
+    upd_v = _get(cfg, "iUpdater", "iupdater", "updater")
+    upd = (_legacy_updater(cfg) if isinstance(upd_v, str)
+           else _updater(upd_v))
     if upd is not None:
         kw["updater"] = upd
     gn = _get(cfg, "gradientNormalization")

@@ -1,0 +1,391 @@
+"""Legacy DL4J JSON regression corpus — the four checkpoint generations.
+
+The reference locks its checkpoint-format compatibility with
+``regressiontest/RegressionTest050.java`` / 060 / 071 / 080: each
+deserializes model zips produced by that release and asserts layer-by-layer
+config fields. The original zips live in the external ``dl4j-test-resources``
+artifact (not in the snapshot), so the JSON below is hand-authored in each
+generation's serde dialect from those tests' assertions:
+
+- 0.5.0 era: WRAPPER_OBJECT layer names, activation as a PLAIN lowercase
+  string, loss as an enum string (``lossFunction``), updater as an ENUM on
+  the layer plus flat ``learningRate``/``momentum``/``rmsDecay`` fields,
+  ``dropOut`` double, ``dist`` as WRAPPER_OBJECT; no convolutionMode field
+  (defaults to Truncate).
+- 0.6.0 / 0.7.1: same dialect; 0.7.x adds ``convolutionMode``.
+- 0.8.0: activation and loss become ``@class``-tagged objects
+  (``ActivationLReLU``/``LossMCXENT``); updater still the legacy enum.
+
+Every assertion below mirrors one from the corresponding Java test.
+"""
+
+import json
+
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import import_dl4j_configuration
+from deeplearning4j_tpu.nn.dropout import Dropout
+from deeplearning4j_tpu.nn.layers import (
+    ConvolutionLayer,
+    DenseLayer,
+    GravesBidirectionalLSTMLayer,
+    GravesLSTMLayer,
+    OutputLayer,
+    RnnOutputLayer,
+    SubsamplingLayer,
+)
+from deeplearning4j_tpu.nn.updaters import AdaDelta, Nesterovs, RmsProp
+from deeplearning4j_tpu.nn.weights import Distribution
+
+A = 1e-6
+
+
+def _act_legacy(name):
+    """Pre-0.8: plain string."""
+    return name
+
+
+def _act_080(name):
+    """0.8.0: @class-tagged IActivation object."""
+    return {"@class": f"org.nd4j.linalg.activations.impl.Activation{name}"}
+
+
+def _loss_legacy(name):
+    return name
+
+
+def _loss_080(name):
+    return {"@class": f"org.nd4j.linalg.lossfunctions.impl.Loss{name}"}
+
+
+def mlp1(act, loss):
+    """RegressionTest*.regressionTestMLP1: dense(relu 3→4, XAVIER,
+    Nesterovs(0.15, 0.9)) → output(softmax, MCXENT, 4→5)."""
+    nest = {"updater": "NESTEROVS", "learningRate": 0.15, "momentum": 0.9}
+    return {"backprop": True, "confs": [
+        {"layer": {"dense": {"activationFn": act("ReLU") if act is _act_080
+                             else "relu",
+                             "nin": 3, "nout": 4, "weightInit": "XAVIER",
+                             **nest}}},
+        {"layer": {"output": {"activationFn": act("Softmax") if act is _act_080
+                              else "softmax",
+                              "lossFunction": loss("MCXENT"),
+                              "nin": 4, "nout": 5, "weightInit": "XAVIER",
+                              **nest}}},
+    ]}
+
+
+def check_mlp1(conf):
+    l0, l1 = conf.layers
+    assert isinstance(l0, DenseLayer)
+    assert l0.activation == "relu"
+    assert l0.n_in == 3 and l0.n_out == 4
+    assert l0.weight_init == "xavier"
+    assert isinstance(l0.updater, Nesterovs)
+    assert l0.updater.learning_rate == pytest.approx(0.15, abs=A)
+    assert l0.updater.momentum == pytest.approx(0.9, abs=A)
+    assert isinstance(l1, OutputLayer)
+    assert l1.activation == "softmax" and l1.loss == "mcxent"
+    assert l1.n_in == 4 and l1.n_out == 5
+    assert isinstance(l1.updater, Nesterovs)
+    assert l1.updater.learning_rate == pytest.approx(0.15, abs=A)
+
+
+def mlp2(act, loss):
+    """regressionTestMLP2: dense(leakyrelu, DISTRIBUTION N(0.1, 1.2),
+    RmsProp(0.15, rmsDecay 0.96), Dropout(0.6), l1 0.1 l2 0.2) →
+    output(identity, MSE)."""
+    rms = {"updater": "RMSPROP", "learningRate": 0.15, "rmsDecay": 0.96}
+    reg = {"l1": 0.1, "l2": 0.2, "dropOut": 0.6,
+           "weightInit": "DISTRIBUTION",
+           "dist": {"normal": {"mean": 0.1, "std": 1.2}}}
+    return {"backprop": True, "confs": [
+        {"layer": {"dense": {"activationFn": act("LReLU") if act is _act_080
+                             else "leakyrelu",
+                             "nin": 3, "nout": 4, **rms, **reg}}},
+        {"layer": {"output": {"activationFn": act("Identity") if act is _act_080
+                              else "identity",
+                              "lossFunction": loss("MSE"),
+                              "nin": 4, "nout": 5, **rms, **reg}}},
+    ]}
+
+
+def check_mlp2(conf):
+    l0, l1 = conf.layers
+    a = l0.activation
+    assert (a == "leakyrelu" or (isinstance(a, tuple) and a[0] == "leakyrelu"))
+    assert l0.weight_init == "distribution"
+    assert l0.distribution == Distribution(kind="normal", mean=0.1, std=1.2)
+    assert isinstance(l0.updater, RmsProp)
+    assert l0.updater.learning_rate == pytest.approx(0.15, abs=A)
+    assert l0.updater.rms_decay == pytest.approx(0.96, abs=A)
+    assert l0.dropout == pytest.approx(0.6, abs=A)  # Dropout(0.6) retain prob
+    assert l0.l1 == pytest.approx(0.1, abs=A)
+    assert l0.l2 == pytest.approx(0.2, abs=A)
+    assert isinstance(l1, OutputLayer)
+    assert l1.activation == "identity" and l1.loss == "mse"
+    assert l1.distribution == Distribution(kind="normal", mean=0.1, std=1.2)
+    assert l1.dropout == pytest.approx(0.6, abs=A)
+    assert l1.l1 == pytest.approx(0.1, abs=A)
+    assert l1.l2 == pytest.approx(0.2, abs=A)
+
+
+def cnn1(act, loss, with_conv_mode):
+    """regressionTestCNN1: conv(tanh, 3→3, RELU init, RmsProp, k2x2 s1x1
+    p0x0) → subsampling(max k2x2 s1x1) → output(sigmoid, NLL, 26·26·3→5).
+    Pre-0.7.0 JSON has NO convolutionMode — must default to Truncate."""
+    rms = {"updater": "RMSPROP", "learningRate": 0.15, "rmsDecay": 0.96}
+    mode = {"convolutionMode": "Truncate"} if with_conv_mode else {}
+    return {"backprop": True, "confs": [
+        {"layer": {"convolution": {"activationFn": act("TanH") if act is _act_080
+                                   else "tanh",
+                                   "nin": 3, "nout": 3, "weightInit": "RELU",
+                                   "kernelSize": [2, 2], "stride": [1, 1],
+                                   "padding": [0, 0], **rms, **mode}}},
+        {"layer": {"subsampling": {"poolingType": "MAX",
+                                   "kernelSize": [2, 2], "stride": [1, 1],
+                                   "padding": [0, 0], **mode}}},
+        {"layer": {"output": {"activationFn": act("Sigmoid") if act is _act_080
+                              else "sigmoid",
+                              "lossFunction": loss("NegativeLogLikelihood"),
+                              "nin": 26 * 26 * 3, "nout": 5, **rms}}},
+    ],
+        "inputPreProcessors": {"2": {"cnnToFeedForward": {
+            "inputHeight": 26, "inputWidth": 26, "numChannels": 3}}}}
+
+
+def check_cnn1(conf):
+    l0, l1, l2 = conf.layers
+    assert isinstance(l0, ConvolutionLayer)
+    assert l0.activation == "tanh"
+    assert l0.n_in == 3 and l0.n_out == 3
+    assert l0.weight_init == "relu"
+    assert isinstance(l0.updater, RmsProp)
+    assert l0.kernel_size == (2, 2) and l0.stride == (1, 1)
+    assert l0.padding == (0, 0)
+    assert l0.convolution_mode == "truncate"  # default when field absent
+    assert isinstance(l1, SubsamplingLayer)
+    assert l1.pooling_type == "max"
+    assert l1.kernel_size == (2, 2) and l1.stride == (1, 1)
+    assert l1.convolution_mode == "truncate"
+    assert isinstance(l2, OutputLayer)
+    assert l2.activation == "sigmoid"
+    assert l2.loss == "mcxent"  # NLL maps onto mcxent here
+    assert l2.n_in == 26 * 26 * 3 and l2.n_out == 5
+    assert 2 in conf.preprocessors  # cnnToFeedForward honored
+
+
+def lstm1(act, loss):
+    """regressionTestLSTM1 (060/071/080): gravesLSTM(tanh, 3→4, clip 1.5) →
+    gravesBidirectionalLSTM(softsign, 4→4) → rnnoutput(softmax, MCXENT,
+    4→5)."""
+    clip = {"gradientNormalization": "ClipElementWiseAbsoluteValue",
+            "gradientNormalizationThreshold": 1.5}
+    return {"backprop": True, "confs": [
+        {"layer": {"gravesLSTM": {"activationFn": act("TanH") if act is _act_080
+                                  else "tanh",
+                                  "nin": 3, "nout": 4, **clip}}},
+        {"layer": {"gravesBidirectionalLSTM": {
+            "activationFn": act("SoftSign") if act is _act_080 else "softsign",
+            "nin": 4, "nout": 4, **clip}}},
+        {"layer": {"rnnoutput": {"activationFn": act("Softmax") if act is _act_080
+                                 else "softmax",
+                                 "lossFunction": loss("MCXENT"),
+                                 "nin": 4, "nout": 5}}},
+    ]}
+
+
+def check_lstm1(conf):
+    l0, l1, l2 = conf.layers
+    assert isinstance(l0, GravesLSTMLayer)
+    assert l0.activation == "tanh"
+    assert l0.n_in == 3 and l0.n_out == 4
+    assert l0.gradient_normalization == "clip_element_wise_absolute_value"
+    assert l0.gradient_normalization_threshold == pytest.approx(1.5, abs=1e-5)
+    assert isinstance(l1, GravesBidirectionalLSTMLayer)
+    assert l1.activation == "softsign"
+    assert l1.n_in == 4 and l1.n_out == 4
+    assert l1.gradient_normalization == "clip_element_wise_absolute_value"
+    assert isinstance(l2, RnnOutputLayer)
+    assert l2.activation == "softmax" and l2.loss == "mcxent"
+    assert l2.n_in == 4 and l2.n_out == 5
+
+
+def cg_lstm1(act, loss):
+    """regressionTestCGLSTM1: the same three layers as a ComputationGraph
+    with numerically-named vertices."""
+    lv = lambda layer: {"LayerVertex": {"layerConf": {"layer": layer}}}
+    mlp = lstm1(act, loss)
+    layers = [c["layer"] for c in mlp["confs"]]
+    return {
+        "networkInputs": ["in"], "networkOutputs": ["2"],
+        "vertices": {"0": lv(layers[0]), "1": lv(layers[1]),
+                     "2": lv(layers[2])},
+        "vertexInputs": {"0": ["in"], "1": ["0"], "2": ["1"]},
+    }
+
+
+GENERATIONS = {
+    # generation → (activation dialect, loss dialect, has convolutionMode)
+    "050": (_act_legacy, _loss_legacy, False),
+    "060": (_act_legacy, _loss_legacy, False),
+    "071": (_act_legacy, _loss_legacy, True),
+    "080": (_act_080, _loss_080, True),
+}
+
+
+@pytest.mark.parametrize("gen", sorted(GENERATIONS))
+class TestLegacyGenerations:
+    def test_mlp1(self, gen):
+        act, loss, _ = GENERATIONS[gen]
+        check_mlp1(import_dl4j_configuration(json.dumps(mlp1(act, loss))))
+
+    def test_mlp2(self, gen):
+        act, loss, _ = GENERATIONS[gen]
+        check_mlp2(import_dl4j_configuration(json.dumps(mlp2(act, loss))))
+
+    def test_cnn1(self, gen):
+        act, loss, cm = GENERATIONS[gen]
+        check_cnn1(import_dl4j_configuration(json.dumps(cnn1(act, loss, cm))))
+
+    def test_lstm1(self, gen):
+        if gen == "050":
+            pytest.skip("no 0.5.0 LSTM regression fixture in the reference")
+        act, loss, _ = GENERATIONS[gen]
+        check_lstm1(import_dl4j_configuration(json.dumps(lstm1(act, loss))))
+
+    def test_cg_lstm1(self, gen):
+        if gen == "050":
+            pytest.skip("no 0.5.0 CG regression fixture in the reference")
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            import_dl4j_graph_configuration)
+        act, loss, _ = GENERATIONS[gen]
+        conf = import_dl4j_graph_configuration(
+            json.dumps(cg_lstm1(act, loss)))
+        names = ["0", "1", "2"]
+        l0 = conf.vertices[names[0]].obj
+        l1 = conf.vertices[names[1]].obj
+        l2 = conf.vertices[names[2]].obj
+        assert isinstance(l0, GravesLSTMLayer) and l0.n_out == 4
+        assert l0.gradient_normalization == "clip_element_wise_absolute_value"
+        assert isinstance(l1, GravesBidirectionalLSTMLayer)
+        assert l1.activation == "softsign"
+        assert isinstance(l2, RnnOutputLayer) and l2.loss == "mcxent"
+
+
+class TestLegacyNetsRun:
+    """Beyond field equality: each generation's configs must build nets that
+    actually run forward (the point of migration)."""
+
+    @pytest.mark.parametrize("gen", sorted(GENERATIONS))
+    def test_mlp2_trains(self, gen):
+        import numpy as np
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        act, loss, _ = GENERATIONS[gen]
+        conf = import_dl4j_configuration(json.dumps(mlp2(act, loss)))
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+        y = np.random.default_rng(1).normal(size=(8, 5)).astype(np.float32)
+        net.fit(x, y)
+        assert np.isfinite(float(net.score_))
+
+    def test_1x_era_idropout_object(self):
+        # 1.0.0-beta dialect: iDropout as @class-tagged object
+        from deeplearning4j_tpu.nn.dropout import AlphaDropout
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSELU"},
+                "nin": 3, "nout": 4,
+                "iDropout": {"@class": "org.deeplearning4j.nn.conf.dropout.AlphaDropout",
+                             "p": 0.8}}}},
+            {"layer": {"output": {
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "nin": 4, "nout": 2,
+                "iDropout": {"@class": "org.deeplearning4j.nn.conf.dropout.Dropout",
+                             "p": 0.7}}}},
+        ]}))
+        assert isinstance(conf.layers[0].dropout, AlphaDropout)
+        assert conf.layers[0].dropout.p == pytest.approx(0.8)
+        assert conf.layers[1].dropout == pytest.approx(0.7)
+
+
+class TestReviewDrivenFixes:
+    def test_tuple_activation_json_round_trip(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {"activationFn": "leakyrelu",
+                                 "leakyreluAlpha": 0.3, "nin": 3, "nout": 4}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT",
+                                  "nin": 4, "nout": 2}}},
+        ]}))
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        a = conf2.layers[0].activation
+        assert a == ("leakyrelu", {"alpha": 0.3})
+        net = MultiLayerNetwork(conf2).init()
+        out = np.asarray(net.output(np.ones((2, 3), np.float32)))
+        assert out.shape == (2, 2) and np.isfinite(out).all()
+
+    def test_legacy_adamax_nadam_none_enums(self):
+        from deeplearning4j_tpu.nn.updaters import AdaMax, Nadam, NoOp
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {"activationFn": "relu", "nin": 2, "nout": 3,
+                                 "updater": "ADAMAX", "learningRate": 0.1}}},
+            {"layer": {"dense": {"activationFn": "relu", "nin": 3, "nout": 3,
+                                 "updater": "NADAM", "learningRate": 0.2}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT", "nin": 3,
+                                  "nout": 2, "updater": "NONE"}}},
+        ]}))
+        assert isinstance(conf.layers[0].updater, AdaMax)
+        assert conf.layers[0].updater.learning_rate == pytest.approx(0.1)
+        assert isinstance(conf.layers[1].updater, Nadam)
+        assert isinstance(conf.layers[2].updater, NoOp)  # frozen, not default
+
+    def test_extended_distributions(self):
+        for cls, kind, extra in (
+                ("TruncatedNormalDistribution", "truncated_normal",
+                 {"mean": 0.0, "std": 0.5}),
+                ("LogNormalDistribution", "log_normal",
+                 {"mean": 0.0, "std": 0.5}),
+                ("OrthogonalDistribution", "orthogonal", {"gain": 1.2}),
+                ("ConstantDistribution", "constant", {"value": 0.25})):
+            conf = import_dl4j_configuration(json.dumps({"confs": [
+                {"layer": {"dense": {
+                    "activationFn": "relu", "nin": 2, "nout": 3,
+                    "weightInit": "DISTRIBUTION",
+                    "dist": {"@class": f"org.deeplearning4j.nn.conf.distribution.{cls}",
+                             **extra}}}},
+                {"layer": {"output": {"activationFn": "softmax",
+                                      "lossFunction": "MCXENT",
+                                      "nin": 3, "nout": 2}}},
+            ]}))
+            assert conf.layers[0].distribution.kind == kind, cls
+
+    def test_spatial_dropout_and_unknown_idropout_warns(self):
+        import warnings
+        from deeplearning4j_tpu.nn.dropout import SpatialDropout
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"convolution": {"activationFn": "relu", "nin": 1,
+                "nout": 2, "kernelSize": [3, 3],
+                "iDropout": {"@class": "org.deeplearning4j.nn.conf.dropout.SpatialDropout",
+                             "p": 0.8}}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT", "nout": 2}}},
+        ]}))
+        sd = conf.layers[0].dropout
+        assert isinstance(sd, SpatialDropout) and sd.p == pytest.approx(0.8)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            import_dl4j_configuration(json.dumps({"confs": [
+                {"layer": {"dense": {"activationFn": "relu", "nin": 2,
+                    "nout": 3,
+                    "iDropout": {"@class": "x.y.FancyCustomDropout", "p": 0.5}}}},
+                {"layer": {"output": {"activationFn": "softmax",
+                                      "lossFunction": "MCXENT", "nin": 3,
+                                      "nout": 2}}},
+            ]}))
+        assert any("iDropout" in str(x.message) for x in w)
