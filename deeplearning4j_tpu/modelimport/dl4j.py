@@ -57,17 +57,24 @@ _ACTIVATIONS = {
 }
 
 
-def _activation(v) -> Optional[str]:
+def _activation(v):
     """activationFn: enum string ("RELU"), {"@class": ".ActivationReLU"},
-    or WRAPPER_OBJECT {"ReLU": {...}}."""
+    or WRAPPER_OBJECT {"ReLU": {...}}. Parameterized activations
+    (ActivationLReLU/RReLU/ELU with an ``alpha`` field) come back as
+    ``(name, {"alpha": …})`` tuples so the coefficient is preserved."""
     if v is None:
         return None
+    params: dict = {}
     if isinstance(v, str):
         key = v.lower()
     elif isinstance(v, dict):
         cls = v.get("@class")
-        if cls is None and len(v) == 1:
+        if cls is not None:
+            params = v
+        elif len(v) == 1:
             cls = next(iter(v))
+            if isinstance(v[cls], dict):
+                params = v[cls]
         if cls is None:
             return None
         key = cls.rsplit(".", 1)[-1]
@@ -80,7 +87,10 @@ def _activation(v) -> Optional[str]:
     if key not in _ACTIVATIONS:
         raise UnsupportedDl4jConfigurationException(
             f"unknown DL4J activation {v!r}")
-    return _ACTIVATIONS[key]
+    mapped = _ACTIVATIONS[key]
+    if mapped in ("leakyrelu", "elu") and "alpha" in params:
+        return (mapped, {"alpha": float(params["alpha"])})
+    return mapped
 
 
 _LOSSES = {
@@ -158,7 +168,7 @@ def _weight_init(v) -> Optional[str]:
     return None if v is None else str(v).lower()
 
 
-def _legacy_updater(cfg: dict):
+def _legacy_updater(cfg: dict, name: Optional[str] = None):
     """Pre-0.9 dialect: the layer carries an ``updater`` ENUM string plus
     flat hyperparameter fields (``learningRate``, ``momentum``,
     ``rmsDecay``, ``rho``, ``adamMeanDecay``/``adamVarDecay``) — the exact
@@ -166,7 +176,7 @@ def _legacy_updater(cfg: dict):
     (exercised by ``regressiontest/RegressionTest050.java`` …080)."""
     from deeplearning4j_tpu.nn import updaters as U
 
-    name = cfg.get("updater")
+    name = name if name is not None else cfg.get("updater")
     if not isinstance(name, str):
         return None
     name = name.lower()
@@ -276,7 +286,7 @@ def _base_kwargs(cfg: dict) -> dict:
             kw["activation"] = ("leakyrelu",
                                 {"alpha": float(cfg["leakyreluAlpha"])})
         else:
-            kw["activation"] = act
+            kw["activation"] = act  # str, or (name, params) tuple
     wi = _weight_init(_get(cfg, "weightInit", "weightinit"))
     if wi == "distribution":
         dist = _distribution(cfg.get("dist"))
@@ -315,7 +325,7 @@ def _base_kwargs(cfg: dict) -> dict:
                 "regularization of the imported model is dropped",
                 stacklevel=2)
     upd_v = _get(cfg, "iUpdater", "iupdater", "updater")
-    upd = (_legacy_updater(cfg) if isinstance(upd_v, str)
+    upd = (_legacy_updater(cfg, upd_v) if isinstance(upd_v, str)
            else _updater(upd_v))
     if upd is not None:
         kw["updater"] = upd

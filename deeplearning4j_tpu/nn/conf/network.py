@@ -106,10 +106,8 @@ def global_conf_from_dict(d: dict) -> GlobalConf:
         g["dropout"] = IDropout.from_dict(g["dropout"])
     if isinstance(g.get("weight_noise"), dict):
         g["weight_noise"] = IWeightNoise.from_dict(g["weight_noise"])
-    a = g.get("activation")
-    if (isinstance(a, list) and len(a) == 2 and isinstance(a[0], str)
-            and isinstance(a[1], dict)):
-        g["activation"] = (a[0], dict(a[1]))  # parameterized activation tuple
+    from deeplearning4j_tpu.nn.layers.base import activation_from_config
+    g["activation"] = activation_from_config(g.get("activation"))
     for key in ("all_constraints", "weight_constraints", "bias_constraints"):
         if g.get(key):
             g[key] = [LayerConstraint.from_dict(c) if isinstance(c, dict)

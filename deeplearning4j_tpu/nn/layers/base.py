@@ -188,6 +188,16 @@ class Layer:
         return layer_from_dict(d)
 
 
+def activation_from_config(v):
+    """Parameterized activations (``("leakyrelu", {"alpha": …})``) are
+    tuples in memory but JSON lists on disk — ONE normalization shared by
+    layer and global-conf deserialization."""
+    if (isinstance(v, list) and len(v) == 2 and isinstance(v[0], str)
+            and isinstance(v[1], dict)):
+        return (v[0], dict(v[1]))
+    return v
+
+
 def layer_from_dict(d: dict) -> Layer:
     from deeplearning4j_tpu.nn.dropout import IDropout
     from deeplearning4j_tpu.nn.weightnoise import IWeightNoise
@@ -215,11 +225,8 @@ def layer_from_dict(d: dict) -> Layer:
             v = InputType.from_dict(v)
         elif k == "distribution" and isinstance(v, dict):
             v = Distribution.from_dict(v)
-        elif (k == "activation" and isinstance(v, list) and len(v) == 2
-              and isinstance(v[0], str) and isinstance(v[1], dict)):
-            # parameterized activations ("leakyrelu", {"alpha": …}) are
-            # tuples in memory but JSON lists on disk
-            v = (v[0], dict(v[1]))
+        elif k == "activation":
+            v = activation_from_config(v)
         elif (isinstance(v, list) and v
               and all(isinstance(c, dict) and "@constraint" in c for c in v)):
             from deeplearning4j_tpu.nn.constraints import constraints_from_config

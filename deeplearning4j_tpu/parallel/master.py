@@ -32,7 +32,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
+from deeplearning4j_tpu.parallel.mesh import (
+    DATA_AXIS,
+    is_multiprocess,
+    make_global,
+    make_mesh,
+    shard_map,
+)
 from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
 
 
@@ -354,14 +360,28 @@ class SharedTrainingMaster(TrainingMaster):
         if network.params is None:
             network.init()
         dtype = network.conf.global_conf.jnp_dtype()
+        mp = is_multiprocess(self.mesh)
+        rep, shard0 = P(), P(self.data_axis)
         if self._step_fn is None or self._net_ref is not network:
             # the compiled worker closes over the network: rebuild on switch
             self._net_ref = network
             self._step_fn = self._build_step(network)
             # stacked per-worker residuals, sharded over the data axis
             self._residual = jax.tree_util.tree_map(
-                lambda p: jnp.zeros((self.num_workers,) + p.shape, p.dtype),
+                lambda p: np.zeros((self.num_workers,) + p.shape,
+                                   np.asarray(p).dtype),
                 network.params)
+            if mp:
+                # cross-process run (jax.distributed): every host holds the
+                # same full values; lift them to GLOBAL arrays over the mesh
+                self._residual = make_global(self._residual, self.mesh, shard0)
+                network.params = make_global(network.params, self.mesh, rep)
+                network.states = make_global(network.states, self.mesh, rep)
+                network.updater_states = make_global(
+                    network.updater_states, self.mesh, rep)
+            else:
+                self._residual = jax.tree_util.tree_map(jnp.asarray,
+                                                        self._residual)
         t0 = time.perf_counter()
         for ds in data_iterator:
             x = np.asarray(ds.features)
@@ -369,6 +389,12 @@ class SharedTrainingMaster(TrainingMaster):
             if (x.shape[0] % self.num_workers
                     or ds.features_mask is not None
                     or ds.labels_mask is not None):
+                if mp:
+                    raise ValueError(
+                        "multi-process SharedTrainingMaster requires batch "
+                        f"sizes divisible by {self.num_workers} workers and "
+                        "no masks (got batch "
+                        f"{x.shape[0]}, masks={ds.features_mask is not None})")
                 # ragged tail or masked sequence data: the sharded step
                 # doesn't carry masks — run unsharded (same math, no DP)
                 network._fit_batch(ds)
@@ -376,11 +402,18 @@ class SharedTrainingMaster(TrainingMaster):
             it = jnp.asarray(network.iteration, jnp.float32)
             ep = jnp.asarray(network.epoch, jnp.float32)
             rng = network._next_rng()
+            xb = np.asarray(x, dtype)
+            yb = np.asarray(y, dtype)
+            if mp:
+                xb, yb = make_global((xb, yb), self.mesh, shard0)
+                it, ep, rng = make_global((it, ep, rng), self.mesh, rep)
+            else:
+                xb, yb = jnp.asarray(xb), jnp.asarray(yb)
             (network.params, network.states, network.updater_states,
              self._residual, loss, sparsity) = self._step_fn(
                 network.params, network.states, network.updater_states,
-                self._residual, it, ep, jnp.asarray(x, dtype),
-                jnp.asarray(y, dtype), rng, jnp.float32(self.threshold))
+                self._residual, it, ep, xb, yb, rng,
+                np.float32(self.threshold))
             network.score_ = loss
             network.iteration += 1
             self._adapt_threshold(float(sparsity))

@@ -77,3 +77,33 @@ def local_mesh(n: Optional[int] = None, axis: str = DATA_AXIS) -> Mesh:
     if n is not None:
         devices = devices[:n]
     return make_mesh({axis: len(devices)}, devices)
+
+
+def is_multiprocess(mesh: Mesh) -> bool:
+    """True when the mesh spans devices owned by other processes (a real
+    multi-host/multi-process run under ``jax.distributed``)."""
+    me = jax.process_index()
+    return any(d.process_index != me for d in mesh.devices.flat)
+
+
+def make_global(tree, mesh: Mesh, spec) -> object:
+    """Host-local full copies → GLOBAL jax.Arrays over a multi-process mesh.
+
+    Every process passes the SAME full-value tree (the single-controller
+    contract: identical host data everywhere, e.g. replicated params or a
+    full batch about to be split over the data axis); each process
+    contributes only its addressable shards via ``make_array_from_callback``.
+    This is the per-host input seam the reference fills with Spark broadcast
+    + ``ExecuteWorkerFlatMap`` (SURVEY §3.3) — here the "broadcast" is the
+    deterministic, identical host computation on each process.
+    """
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, spec)
+
+    def conv(a):
+        a = np.asarray(a)
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+
+    return jax.tree_util.tree_map(conv, tree)

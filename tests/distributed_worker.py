@@ -1,0 +1,80 @@
+"""Worker process for the 2-process jax.distributed test.
+
+Launched twice by tests/test_multiprocess.py (process_id 0 and 1). Each
+process owns ONE CPU device; together they form a 2-device global mesh and
+run SharedTrainingMaster over it — the reference's multi-node gradient-
+sharing topology (`SharedTrainingMaster.java:493`), with the JAX
+coordination service standing in for the Aeron introduction protocol and
+Gloo-backed CPU collectives for the UDP gradient messages.
+
+Determinism contract: both processes generate identical data and seeds, so
+the single-controller "broadcast" is plain identical host computation.
+Process 0 writes the final params to OUT as npz.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly ONE local CPU device
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    coordinator, pid, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+    from deeplearning4j_tpu.parallel import init_distributed
+
+    init_distributed(coordinator_address=coordinator, num_processes=2,
+                     process_id=pid)
+    assert jax.device_count() == 2, jax.devices()
+    assert len(jax.local_devices()) == 1
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel import (
+        DistributedMultiLayerNetwork,
+        SharedTrainingMaster,
+    )
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Sgd(0.05)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    rng = np.random.default_rng(0)
+    yc = rng.integers(0, 3, 256)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    x[np.arange(256), yc] += 2.5
+    y = np.eye(3, dtype=np.float32)[yc]
+
+    mesh = make_mesh({"data": 2})  # spans BOTH processes
+    master = SharedTrainingMaster(batch_size_per_worker=16, threshold=1e-3,
+                                  mesh=mesh)
+    front = DistributedMultiLayerNetwork(net, master)
+    it = ListDataSetIterator(DataSet(x, y), 32)
+    front.fit(it, epochs=3)
+
+    if pid == 0:
+        flat = {}
+        for i, layer in enumerate(net.params):
+            for k, v in layer.items():
+                flat[f"{i}:{k}"] = np.asarray(v)
+        flat["score"] = np.float32(net.score_)
+        np.savez(out_path, **flat)
+        print("WORKER0_DONE", flush=True)
+    else:
+        print("WORKER1_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -389,3 +389,30 @@ class TestReviewDrivenFixes:
                                       "nout": 2}}},
             ]}))
         assert any("iDropout" in str(x.message) for x in w)
+
+
+class TestParameterizedActivationImport:
+    def test_080_lrelu_alpha_preserved(self):
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationLReLU",
+                                 "alpha": 0.3},
+                "nin": 3, "nout": 4}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT",
+                                  "nin": 4, "nout": 2}}},
+        ]}))
+        assert conf.layers[0].activation == ("leakyrelu", {"alpha": 0.3})
+
+    def test_iupdater_string_dialect(self):
+        # updater enum found under the NEW key name must still resolve
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {"activationFn": "relu", "nin": 2, "nout": 3,
+                                 "iUpdater": "RMSPROP", "learningRate": 0.15,
+                                 "rmsDecay": 0.96}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT", "nin": 3,
+                                  "nout": 2}}},
+        ]}))
+        assert isinstance(conf.layers[0].updater, RmsProp)
+        assert conf.layers[0].updater.learning_rate == pytest.approx(0.15)
