@@ -16,6 +16,10 @@ from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory,
     NGramTokenizerFactory,
 )
+from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+    DictionaryTokenizerFactory,
+    MorphologicalDictionary,
+)
 from deeplearning4j_tpu.nlp.sentence import (
     BasicLineIterator,
     CollectionSentenceIterator,
@@ -35,7 +39,8 @@ from deeplearning4j_tpu.nlp.vectorizer import (
 __all__ = [
     "BagOfWordsVectorizer", "BasicLineIterator", "CollectionSentenceIterator",
     "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
-    "Glove", "InMemoryLookupTable", "NGramTokenizerFactory",
+    "DictionaryTokenizerFactory", "Glove", "InMemoryLookupTable",
+    "MorphologicalDictionary", "NGramTokenizerFactory",
     "ParagraphVectors", "SequenceVectors", "TfidfVectorizer", "VocabCache",
     "VocabConstructor", "VocabWord", "Word2Vec", "WordVectorSerializer",
 ]

@@ -1,0 +1,223 @@
+"""Dictionary-backed morphological tokenizer — MeCab-format lattice Viterbi.
+
+The reference vendors kuromoji (``deeplearning4j-nlp-japanese``, 6.9k LoC)
+and ansj (``deeplearning4j-nlp-chinese``) — dictionary-driven lattice
+segmenters. This module provides the same MECHANISM behind the existing
+:class:`~deeplearning4j_tpu.nlp.tokenization.TokenizerFactory` SPI: load a
+MeCab-format dictionary (the format kuromoji/ipadic/unidic ship in) and
+segment by minimum-cost Viterbi over the word lattice — word costs plus
+left/right connection costs, exactly kuromoji's decoding objective.
+
+What is NOT bundled: the dictionaries themselves. ipadic/unidic are tens of
+MB; kuromoji-level ACCURACY requires pointing ``MorphologicalDictionary.load``
+at a real dictionary directory (``*.csv`` entries + ``matrix.def``). With the
+small test dictionary in ``tests/fixtures/mini_ja_dict/`` the lattice
+machinery is exercised end to end (including the classic
+すもももももももものうち disambiguation that greedy longest-match gets
+wrong).
+
+File formats (MeCab conventions):
+
+- entries CSV: ``surface,left_id,right_id,word_cost,feature1,feature2,…``
+  — for ipadic the 7th feature (index 6) is the base form.
+- ``matrix.def``: first line ``L R``; then ``right_id left_id cost`` rows;
+  the connection cost between adjacent words a→b is
+  ``matrix[a.right_id][b.left_id]``.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    TokenPreProcess,
+    Tokenizer,
+    TokenizerFactory,
+)
+
+
+@dataclass
+class DictEntry:
+    surface: str
+    left_id: int
+    right_id: int
+    cost: int
+    features: Tuple[str, ...] = ()
+
+    @property
+    def base_form(self) -> str:
+        """ipadic convention: feature index 6; '*' or absent → surface."""
+        if len(self.features) > 6 and self.features[6] not in ("", "*"):
+            return self.features[6]
+        return self.surface
+
+
+class MorphologicalDictionary:
+    """Entries indexed by first character + connection-cost matrix."""
+
+    def __init__(self, entries: Iterable[DictEntry],
+                 connections: Optional[Dict[Tuple[int, int], int]] = None,
+                 unk_cost: int = 20000):
+        self._by_first: Dict[str, List[DictEntry]] = {}
+        self.max_len = 1
+        for e in entries:
+            if not e.surface:
+                continue
+            self._by_first.setdefault(e.surface[0], []).append(e)
+            self.max_len = max(self.max_len, len(e.surface))
+        # longest-first so ties in cost break toward longer words
+        for lst in self._by_first.values():
+            lst.sort(key=lambda e: -len(e.surface))
+        self.connections = connections or {}
+        self.unk_cost = unk_cost
+
+    # ------------------------------------------------------------- loading
+    @staticmethod
+    def load(path: str, encoding: str = "utf-8",
+             unk_cost: int = 20000) -> "MorphologicalDictionary":
+        """Load a MeCab-format dictionary directory (or a single CSV file):
+        every ``*.csv`` holds entries; ``matrix.def`` holds connection costs.
+        Point this at a real ipadic/unidic build for kuromoji-level accuracy.
+        """
+        csv_paths: List[str] = []
+        matrix_path = None
+        if os.path.isdir(path):
+            for name in sorted(os.listdir(path)):
+                p = os.path.join(path, name)
+                if name.endswith(".csv"):
+                    csv_paths.append(p)
+                elif name == "matrix.def":
+                    matrix_path = p
+        else:
+            csv_paths.append(path)
+        entries: List[DictEntry] = []
+        for p in csv_paths:
+            with open(p, encoding=encoding, newline="") as f:
+                for row in csv.reader(f):
+                    if len(row) < 4 or row[0].startswith("#"):
+                        continue
+                    entries.append(DictEntry(
+                        surface=row[0], left_id=int(row[1]),
+                        right_id=int(row[2]), cost=int(row[3]),
+                        features=tuple(row[4:])))
+        connections: Dict[Tuple[int, int], int] = {}
+        if matrix_path is not None:
+            with open(matrix_path, encoding=encoding) as f:
+                first = True
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    if first:
+                        first = False  # "L R" size header
+                        continue
+                    r, l, c = int(parts[0]), int(parts[1]), int(parts[2])
+                    connections[(r, l)] = c
+        return MorphologicalDictionary(entries, connections, unk_cost)
+
+    # ------------------------------------------------------------- lookup
+    def lookup(self, text: str, i: int) -> List[DictEntry]:
+        """Dictionary entries whose surface starts at ``text[i]``."""
+        out = []
+        for e in self._by_first.get(text[i], ()):
+            if text.startswith(e.surface, i):
+                out.append(e)
+        return out
+
+    def connection(self, right_id: int, left_id: int) -> int:
+        return self.connections.get((right_id, left_id), 0)
+
+
+_BOS_EOS_ID = 0
+
+
+@dataclass
+class _Node:
+    entry: DictEntry
+    start: int
+    total: int = 0
+    prev: Optional["_Node"] = None
+
+
+def viterbi_segment(text: str,
+                    dictionary: MorphologicalDictionary) -> List[DictEntry]:
+    """Minimum-cost path through the word lattice (kuromoji's decoding):
+    cost = Σ word_cost + Σ connection(prev.right_id, next.left_id).
+    Characters no entry covers become single-char unknown nodes with
+    ``unk_cost`` (kuromoji's unknown-word fallback, simplified to one
+    char per node)."""
+    n = len(text)
+    bos = _Node(DictEntry("", _BOS_EOS_ID, _BOS_EOS_ID, 0), 0)
+    # ends_at[i]: best nodes whose surface ends at position i
+    ends_at: List[List[_Node]] = [[] for _ in range(n + 1)]
+    ends_at[0] = [bos]
+    for i in range(n):
+        if not ends_at[i]:
+            continue  # unreachable position
+        candidates = dictionary.lookup(text, i)
+        if not candidates:
+            candidates = [DictEntry(text[i], _BOS_EOS_ID, _BOS_EOS_ID,
+                                    dictionary.unk_cost)]
+        for entry in candidates:
+            best_prev, best_total = None, None
+            for prev in ends_at[i]:
+                total = (prev.total + entry.cost
+                         + dictionary.connection(prev.entry.right_id,
+                                                 entry.left_id))
+                if best_total is None or total < best_total:
+                    best_prev, best_total = prev, total
+            node = _Node(entry, i, best_total, best_prev)
+            end = i + len(entry.surface)
+            ends_at[end].append(node)
+    # EOS: pick the cheapest path reaching n (counting the final connection)
+    best, best_total = None, None
+    for node in ends_at[n]:
+        total = node.total + dictionary.connection(node.entry.right_id,
+                                                   _BOS_EOS_ID)
+        if best_total is None or total < best_total:
+            best, best_total = node, total
+    if best is None:  # only possible for empty text
+        return []
+    path: List[DictEntry] = []
+    cur = best
+    while cur is not None and cur.prev is not None:
+        path.append(cur.entry)
+        cur = cur.prev
+    path.reverse()
+    return path
+
+
+class DictionaryTokenizerFactory(TokenizerFactory):
+    """MeCab-dictionary Viterbi tokenizer behind the TokenizerFactory SPI
+    (the kuromoji ``JapaneseTokenizerFactory`` / ansj role, with a LOADED
+    dictionary instead of a vendored one).
+
+    ``use_base_form`` mirrors the kuromoji factory's baseform mode: emit
+    the dictionary's base form (ipadic feature 7) instead of the surface.
+    """
+
+    def __init__(self, dictionary: MorphologicalDictionary,
+                 use_base_form: bool = False,
+                 keep_whitespace: bool = False,
+                 pre_processor: Optional[TokenPreProcess] = None):
+        self.dictionary = dictionary
+        self.use_base_form = use_base_form
+        self.keep_whitespace = keep_whitespace
+        self._pre = pre_processor
+
+    @staticmethod
+    def from_path(path: str, **kw) -> "DictionaryTokenizerFactory":
+        return DictionaryTokenizerFactory(MorphologicalDictionary.load(path),
+                                          **kw)
+
+    def create(self, sentence: str) -> Tokenizer:
+        entries = viterbi_segment(sentence, self.dictionary)
+        tokens = []
+        for e in entries:
+            if not self.keep_whitespace and e.surface.isspace():
+                continue
+            tokens.append(e.base_form if self.use_base_form else e.surface)
+        return Tokenizer(tokens, self._pre)

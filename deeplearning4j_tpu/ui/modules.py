@@ -119,6 +119,95 @@ class ConvolutionalListenerModule(TrainingListener):
         return 200, self.latest
 
 
+class CalibrationModule:
+    """Serves an :class:`~deeplearning4j_tpu.eval.calibration.EvaluationCalibration`
+    as JSON + a rendered panel — the calibration views the reference's
+    train UI builds from ``EvaluationCalibration``'s per-class reliability
+    diagrams, residual plots, and probability histograms.
+
+    Routes under ``/calibration``:
+      ``/calibration``            → summary (ECE, classes, label counts)
+      ``/calibration/reliability/<c>`` → per-class reliability diagram JSON
+      ``/calibration/residual``   / ``/residual/<c>`` → residual histograms
+      ``/calibration/probabilities`` / ``/probabilities/<c>`` → prob hists
+      ``/calibration/panel``      → standalone SVG/HTML panel
+    """
+
+    prefix = "/calibration"
+
+    def __init__(self, calibration=None):
+        self._cal = calibration
+
+    def attach(self, calibration) -> None:
+        self._cal = calibration
+
+    def handle(self, path: str, method: str = "GET",
+               body: Optional[bytes] = None):
+        cal = self._cal
+        if cal is None or cal.num_classes < 0:
+            return 404, {"error": "no calibration evaluation attached"}
+        sub = path[len(self.prefix):].strip("/")
+        parts = sub.split("/") if sub else []
+        if not parts:
+            return 200, {
+                "num_classes": cal.num_classes,
+                "expected_calibration_error": cal.expected_calibration_error(),
+                "label_counts": [int(v) for v in cal.label_counts],
+                "prediction_counts": [int(v) for v in cal.prediction_counts],
+            }
+        kind = parts[0]
+        cls = int(parts[1]) if len(parts) > 1 else None
+        if cls is not None and not (0 <= cls < cal.num_classes):
+            return 404, {"error": f"class index {cls} out of range "
+                                  f"[0, {cal.num_classes})"}
+        if kind == "reliability" and cls is not None:
+            return 200, cal.get_reliability_diagram(cls).to_dict()
+        if kind == "residual":
+            h = (cal.get_residual_plot(cls) if cls is not None
+                 else cal.get_residual_plot_all_classes())
+            return 200, h.to_dict()
+        if kind == "probabilities":
+            h = (cal.get_probability_histogram(cls) if cls is not None
+                 else cal.get_probability_histogram_all_classes())
+            return 200, h.to_dict()
+        if kind == "panel":
+            return 200, {"html": self.render_panel()}
+        return 404, {"error": f"unknown calibration route {sub!r}"}
+
+    def render_panel(self) -> str:
+        """Standalone page: reliability curves + per-class histograms."""
+        from deeplearning4j_tpu.ui.components import ChartHistogram
+        cal = self._cal
+        page = ComponentDiv(ComponentText(
+            f"Calibration — ECE {cal.expected_calibration_error():.4f}"))
+        rel = ChartLine(title="Reliability (all classes pooled)")
+        mean_p, obs = cal.reliability_diagram()
+        rel.add_series("observed", [float(v) for v in mean_p],
+                       [float(v) for v in obs])
+        rel.add_series("ideal", [0.0, 1.0], [0.0, 1.0])
+        page.add(rel)
+        for c in range(cal.num_classes):
+            d = cal.get_reliability_diagram(c)
+            line = ChartLine(title=d.title)
+            line.add_series(f"class {c}",
+                            [float(v) for v in d.mean_predicted_value],
+                            [float(v) for v in d.frac_positives])
+            page.add(line)
+            h = cal.get_probability_histogram(c)
+            hist = ChartHistogram(title=h.title)
+            edges = h.bin_edges
+            for i, count in enumerate(h.counts):
+                hist.add_bin(edges[i], edges[i + 1], float(count))
+            page.add(hist)
+            r = cal.get_residual_plot(c)
+            rh = ChartHistogram(title=r.title)
+            redges = r.bin_edges
+            for i, count in enumerate(r.counts):
+                rh.add_bin(redges[i], redges[i + 1], float(count))
+            page.add(rh)
+        return page.render_page("calibration")
+
+
 def timeline_html(stats, title: str = "training timeline") -> str:
     """Exportable timeline page from a TrainingStats (``StatsUtils.java``
     exportTimelineHtml role): per-phase durations as charts + a table."""

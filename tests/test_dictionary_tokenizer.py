@@ -1,0 +1,95 @@
+"""Dictionary-backed Viterbi tokenizer (kuromoji/ansj mechanism parity).
+
+The reference bundles kuromoji's lattice decoder + ipadic; here the SAME
+decoding objective (word costs + connection costs, minimum-cost path) runs
+behind the TokenizerFactory SPI over a LOADED MeCab-format dictionary. The
+mini dictionary in tests/fixtures/mini_ja_dict exercises the machinery,
+including the classic disambiguation greedy longest-match fails.
+"""
+
+import os
+
+import pytest
+
+from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+    DictEntry,
+    DictionaryTokenizerFactory,
+    MorphologicalDictionary,
+    viterbi_segment,
+)
+
+DICT_DIR = os.path.join(os.path.dirname(__file__), "fixtures", "mini_ja_dict")
+
+
+@pytest.fixture(scope="module")
+def mini_dict():
+    return MorphologicalDictionary.load(DICT_DIR)
+
+
+class TestLoading:
+    def test_entries_and_matrix(self, mini_dict):
+        hits = {e.surface for e in mini_dict.lookup("すもも", 0)}
+        assert hits == {"すもも"}
+        assert mini_dict.connection(1, 2) == -100  # noun → particle cheap
+        assert mini_dict.connection(1, 1) == 500   # noun → noun pricey
+        assert mini_dict.max_len >= 3
+
+    def test_single_csv_file_load(self, tmp_path):
+        p = tmp_path / "d.csv"
+        p.write_text("abc,1,1,100,pos\nab,1,1,50,pos\n", encoding="utf-8")
+        d = MorphologicalDictionary.load(str(p))
+        assert {e.surface for e in d.lookup("abc", 0)} == {"abc", "ab"}
+
+    def test_base_form_feature(self):
+        e = DictEntry("食べ", 3, 3, 900,
+                      ("動詞", "自立", "*", "*", "一段", "連用形", "食べる"))
+        assert e.base_form == "食べる"
+        assert DictEntry("x", 0, 0, 0, ("a", "b")).base_form == "x"
+
+
+class TestViterbi:
+    def test_costs_beat_greedy_longest_match(self, mini_dict):
+        # すもももももももものうち: greedy longest-match takes もも after
+        # すもも and derails into ...もの|うち; the cost lattice recovers
+        # すもも|も|もも|も|もも|の|うち (kuromoji's answer)
+        text = "すもももももももものうち"
+        segs = [e.surface for e in viterbi_segment(text, mini_dict)]
+        assert segs == ["すもも", "も", "もも", "も", "もも", "の", "うち"]
+
+    def test_word_cost_disambiguation(self, mini_dict):
+        # 食べた: the single noun entry (cost 5000) must LOSE to
+        # 食べ(900)+た(350)+conn(-300)
+        segs = [e.surface for e in viterbi_segment("食べた", mini_dict)]
+        assert segs == ["食べ", "た"]
+
+    def test_unknown_chars_fall_back(self, mini_dict):
+        segs = [e.surface for e in viterbi_segment("もXもY", mini_dict)]
+        assert segs == ["も", "X", "も", "Y"]
+
+    def test_empty(self, mini_dict):
+        assert viterbi_segment("", mini_dict) == []
+
+
+class TestFactorySPI:
+    def test_tokenizer_factory_protocol(self, mini_dict):
+        fac = DictionaryTokenizerFactory(mini_dict)
+        tok = fac.create("すもももももももものうち")
+        assert tok.get_tokens() == ["すもも", "も", "もも", "も", "もも",
+                                    "の", "うち"]
+
+    def test_base_form_mode(self, mini_dict):
+        fac = DictionaryTokenizerFactory(mini_dict, use_base_form=True)
+        assert fac.create("食べた").get_tokens() == ["食べる", "た"]
+
+    def test_from_path_and_word2vec_pipeline(self, tmp_path):
+        # the factory slots into the NLP training pipeline like any other
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        fac = DictionaryTokenizerFactory.from_path(DICT_DIR)
+        corpus = ["すもももももももものうち"] * 30
+        w2v = (Word2Vec.Builder().min_word_frequency(1).layer_size(8)
+               .seed(1).epochs(2).tokenizer_factory(fac)
+               .iterate(corpus).build())
+        w2v.fit()
+        assert w2v.has_word("すもも")
+        assert w2v.has_word("もも")
+        assert w2v.get_word_vector("すもも").shape == (8,)

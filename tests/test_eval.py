@@ -157,3 +157,145 @@ class TestEvaluationCalibration:
         preds = np.stack([1 - conf, conf], 1)
         cal.eval(np.stack([1 - labels, labels], 1), preds)
         assert cal.expected_calibration_error() > 0.3
+
+
+class TestCalibrationPerClass:
+    """Per-class depth (EvaluationCalibration.java getReliabilityDiagram /
+    getResidualPlot / getProbabilityHistogram parity)."""
+
+    def _three_class(self, rng, n=6000):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        cal = EvaluationCalibration(reliability_bins=10, histogram_bins=20)
+        cls = rng.integers(0, 3, n)
+        labels = np.eye(3)[cls]
+        logits = rng.normal(0, 1, (n, 3)) + 2.0 * labels
+        preds = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+        cal.eval(labels, preds)
+        return cal, labels, preds
+
+    def test_per_class_reliability(self, rng):
+        cal, labels, preds = self._three_class(rng)
+        for c in range(3):
+            d = cal.get_reliability_diagram(c)
+            assert len(d.mean_predicted_value) == len(d.frac_positives) > 0
+            # curve must be increasing-ish: low-prob bins less often positive
+            assert d.frac_positives[0] < d.frac_positives[-1]
+
+    def test_probability_histogram_selects_labelled_class(self, rng):
+        cal, labels, preds = self._three_class(rng)
+        h1 = cal.get_probability_histogram(1)
+        # counts = histogram of P(class 1) over examples LABELLED class 1
+        want, _ = np.histogram(preds[labels[:, 1] > 0.5, 1],
+                               bins=20, range=(0.0, 1.0))
+        np.testing.assert_array_equal(h1.counts, want)
+        # overall = every (example, class) probability
+        hall = cal.get_probability_histogram_all_classes()
+        wall, _ = np.histogram(preds.ravel(), bins=20, range=(0.0, 1.0))
+        np.testing.assert_array_equal(hall.counts, wall)
+
+    def test_residual_plots(self, rng):
+        cal, labels, preds = self._three_class(rng)
+        r0 = cal.get_residual_plot(0)
+        resid = np.abs(labels - preds)
+        want, _ = np.histogram(resid[labels[:, 0] > 0.5, 0],
+                               bins=20, range=(0.0, 1.0))
+        np.testing.assert_array_equal(r0.counts, want)
+        rall = cal.get_residual_plot_all_classes()
+        wall, _ = np.histogram(resid.ravel(), bins=20, range=(0.0, 1.0))
+        np.testing.assert_array_equal(rall.counts, wall)
+
+    def test_label_and_prediction_counts(self, rng):
+        cal, labels, preds = self._three_class(rng)
+        np.testing.assert_array_equal(cal.label_counts,
+                                      labels.sum(0).astype(np.int64))
+        np.testing.assert_array_equal(cal.prediction_counts,
+                                      np.bincount(preds.argmax(1), minlength=3))
+
+    def test_merge_and_reset(self, rng):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        cal_a, labels, preds = self._three_class(rng, n=512)
+        cal_b = EvaluationCalibration(reliability_bins=10, histogram_bins=20)
+        cal_b.eval(labels, preds)
+        both = EvaluationCalibration(reliability_bins=10, histogram_bins=20)
+        both.eval(labels, preds)
+        both.eval(labels, preds)
+        cal_a.merge(cal_b)
+        np.testing.assert_array_equal(cal_a.prob_by_class, both.prob_by_class)
+        np.testing.assert_array_equal(cal_a.rdiag_total, both.rdiag_total)
+        cal_a.reset()
+        assert cal_a.num_classes == -1
+
+    def test_per_example_mask(self, rng):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        cal = EvaluationCalibration(histogram_bins=20)
+        labels = np.eye(2)[rng.integers(0, 2, 100)]
+        preds = rng.random((100, 2))
+        preds = preds / preds.sum(1, keepdims=True)
+        keep = (rng.random(100) > 0.5).astype(np.float64)
+        cal.eval(labels, preds, mask=keep)
+        ref = EvaluationCalibration(histogram_bins=20)
+        ref.eval(labels[keep > 0], preds[keep > 0])
+        np.testing.assert_array_equal(cal.prob_overall, ref.prob_overall)
+        np.testing.assert_array_equal(cal.rdiag_total, ref.rdiag_total)
+
+    def test_ui_calibration_module(self, rng):
+        from deeplearning4j_tpu.ui.modules import CalibrationModule
+        cal, _, _ = self._three_class(rng, n=512)
+        mod = CalibrationModule(cal)
+        code, summary = mod.handle("/calibration")
+        assert code == 200 and summary["num_classes"] == 3
+        assert 0.0 <= summary["expected_calibration_error"] <= 1.0
+        code, rel = mod.handle("/calibration/reliability/1")
+        assert code == 200 and len(rel["mean_predicted_value"]) > 0
+        code, hist = mod.handle("/calibration/probabilities/2")
+        assert code == 200 and len(hist["counts"]) == 20
+        code, resid = mod.handle("/calibration/residual")
+        assert code == 200 and sum(resid["counts"]) == 512 * 3
+        code, panel = mod.handle("/calibration/panel")
+        assert code == 200 and "svg" in panel["html"].lower()
+        # unattached module 404s cleanly
+        code, err = CalibrationModule().handle("/calibration")
+        assert code == 404
+
+    def test_reset_clears_and_fresh_instance_is_safe(self, rng):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        fresh = EvaluationCalibration()
+        assert fresh.expected_calibration_error() == 0.0
+        assert fresh.get_residual_plot_all_classes().counts.sum() == 0
+        cal, _, _ = self._three_class(rng, n=256)
+        assert cal.expected_calibration_error() > 0 or True
+        cal.reset()
+        assert cal.expected_calibration_error() == 0.0
+        assert cal.get_probability_histogram_all_classes().counts.sum() == 0
+        with pytest.raises(ValueError):
+            cal.get_reliability_diagram(0)
+
+    def test_class_index_validation(self, rng):
+        from deeplearning4j_tpu.ui.modules import CalibrationModule
+        cal, _, _ = self._three_class(rng, n=128)
+        with pytest.raises(IndexError):
+            cal.get_residual_plot(-1)
+        with pytest.raises(IndexError):
+            cal.get_probability_histogram(3)
+        mod = CalibrationModule(cal)
+        assert mod.handle("/calibration/reliability/-1")[0] == 404
+        assert mod.handle("/calibration/probabilities/99")[0] == 404
+
+    def test_3d_per_output_mask(self, rng):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        labels = np.eye(2)[rng.integers(0, 2, (4, 5))]      # [N,T,C]
+        preds = rng.random((4, 5, 2))
+        preds = preds / preds.sum(-1, keepdims=True)
+        m3 = (rng.random((4, 5, 2)) > 0.4).astype(np.float64)
+        cal = EvaluationCalibration(histogram_bins=20)
+        cal.eval(labels, preds, mask=m3)                     # must not crash
+        assert cal.prob_overall.sum() == int(m3.sum())
+
+    def test_out_of_range_probs_counted_in_edge_bins(self):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        cal = EvaluationCalibration(histogram_bins=10)
+        labels = np.array([[1.0, 0.0]])
+        preds = np.array([[-0.05, 1.05]])  # drifted out of [0,1]
+        cal.eval(labels, preds)
+        assert cal.prob_overall.sum() == 2  # nothing silently dropped
+        assert cal.prob_overall[0] == 1 and cal.prob_overall[-1] == 1
