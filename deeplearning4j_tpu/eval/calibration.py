@@ -128,7 +128,9 @@ class EvaluationCalibration:
                   preds[valid])
 
         self.label_counts += pos.sum(axis=0).astype(np.int64)
-        pred_class = preds.argmax(axis=1)
+        # argmax over VALID outputs only (a per-output-masked column must
+        # not be countable as the predicted class)
+        pred_class = np.where(valid, preds, -np.inf).argmax(axis=1)
         row_valid = valid.any(axis=1)
         np.add.at(self.prediction_counts, pred_class[row_valid], 1)
 
@@ -151,6 +153,10 @@ class EvaluationCalibration:
             return
         if self._n_classes is None:
             self._init_state(other._n_classes)
+        elif self._n_classes != other._n_classes:
+            raise ValueError(
+                f"cannot merge calibrations over different class counts "
+                f"({self._n_classes} vs {other._n_classes})")
         for f in ("rdiag_pos", "rdiag_total", "rdiag_sum_pred", "label_counts",
                   "prediction_counts", "residual_overall", "residual_by_class",
                   "prob_overall", "prob_by_class"):

@@ -61,16 +61,16 @@ class MorphologicalDictionary:
     def __init__(self, entries: Iterable[DictEntry],
                  connections: Optional[Dict[Tuple[int, int], int]] = None,
                  unk_cost: int = 20000):
-        self._by_first: Dict[str, List[DictEntry]] = {}
+        # surface-keyed index: lookup is O(max_len) hash probes per text
+        # position, independent of dictionary size — scales to real
+        # ipadic/unidic builds (~400k entries)
+        self._by_surface: Dict[str, List[DictEntry]] = {}
         self.max_len = 1
         for e in entries:
             if not e.surface:
                 continue
-            self._by_first.setdefault(e.surface[0], []).append(e)
+            self._by_surface.setdefault(e.surface, []).append(e)
             self.max_len = max(self.max_len, len(e.surface))
-        # longest-first so ties in cost break toward longer words
-        for lst in self._by_first.values():
-            lst.sort(key=lambda e: -len(e.surface))
         self.connections = connections or {}
         self.unk_cost = unk_cost
 
@@ -120,11 +120,12 @@ class MorphologicalDictionary:
 
     # ------------------------------------------------------------- lookup
     def lookup(self, text: str, i: int) -> List[DictEntry]:
-        """Dictionary entries whose surface starts at ``text[i]``."""
-        out = []
-        for e in self._by_first.get(text[i], ()):
-            if text.startswith(e.surface, i):
-                out.append(e)
+        """Dictionary entries whose surface starts at ``text[i]`` — longest
+        first, bounded by ``max_len``."""
+        out: List[DictEntry] = []
+        top = min(self.max_len, len(text) - i)
+        for L in range(top, 0, -1):
+            out.extend(self._by_surface.get(text[i:i + L], ()))
         return out
 
     def connection(self, right_id: int, left_id: int) -> int:
@@ -137,7 +138,6 @@ _BOS_EOS_ID = 0
 @dataclass
 class _Node:
     entry: DictEntry
-    start: int
     total: int = 0
     prev: Optional["_Node"] = None
 
@@ -150,7 +150,7 @@ def viterbi_segment(text: str,
     ``unk_cost`` (kuromoji's unknown-word fallback, simplified to one
     char per node)."""
     n = len(text)
-    bos = _Node(DictEntry("", _BOS_EOS_ID, _BOS_EOS_ID, 0), 0)
+    bos = _Node(DictEntry("", _BOS_EOS_ID, _BOS_EOS_ID, 0))
     # ends_at[i]: best nodes whose surface ends at position i
     ends_at: List[List[_Node]] = [[] for _ in range(n + 1)]
     ends_at[0] = [bos]
@@ -169,7 +169,7 @@ def viterbi_segment(text: str,
                                                  entry.left_id))
                 if best_total is None or total < best_total:
                     best_prev, best_total = prev, total
-            node = _Node(entry, i, best_total, best_prev)
+            node = _Node(entry, best_total, best_prev)
             end = i + len(entry.surface)
             ends_at[end].append(node)
     # EOS: pick the cheapest path reaching n (counting the final connection)

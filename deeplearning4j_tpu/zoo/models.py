@@ -368,7 +368,7 @@ class ResNet50(ZooModel):
         g.add_layer("stem-zero", ZeroPaddingLayer(padding=(3, 3)), "input")
         g.add_layer("stem-cnn1",
                     ConvolutionLayer(n_out=64, kernel_size=(7, 7), stride=(2, 2),
-                                     activation="identity",
+                                     activation="identity", has_bias=False,
                                      space_to_depth_stem=True), "stem-zero")
         g.add_layer("stem-batch1", BatchNormalizationLayer(activation="identity"), "stem-cnn1")
         g.add_layer("stem-act1", ActivationLayer(activation="relu"), "stem-batch1")

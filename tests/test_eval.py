@@ -263,7 +263,7 @@ class TestCalibrationPerClass:
         assert fresh.expected_calibration_error() == 0.0
         assert fresh.get_residual_plot_all_classes().counts.sum() == 0
         cal, _, _ = self._three_class(rng, n=256)
-        assert cal.expected_calibration_error() > 0 or True
+        assert cal.expected_calibration_error() > 0
         cal.reset()
         assert cal.expected_calibration_error() == 0.0
         assert cal.get_probability_histogram_all_classes().counts.sum() == 0
@@ -299,3 +299,21 @@ class TestCalibrationPerClass:
         cal.eval(labels, preds)
         assert cal.prob_overall.sum() == 2  # nothing silently dropped
         assert cal.prob_overall[0] == 1 and cal.prob_overall[-1] == 1
+
+    def test_merge_rejects_class_mismatch(self, rng):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        a = EvaluationCalibration()
+        a.eval(np.eye(3)[[0, 1]], np.full((2, 3), 1 / 3))
+        b = EvaluationCalibration()
+        b.eval(np.ones((2, 1)), np.full((2, 1), 0.5))
+        with pytest.raises(ValueError, match="class counts"):
+            a.merge(b)
+
+    def test_prediction_counts_respect_per_output_mask(self):
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        cal = EvaluationCalibration()
+        labels = np.array([[0.0, 1.0, 0.0]])
+        preds = np.array([[0.1, 0.2, 0.7]])   # argmax=2 but class 2 masked
+        m = np.array([[1.0, 1.0, 0.0]])
+        cal.eval(labels, preds, mask=m)
+        np.testing.assert_array_equal(cal.prediction_counts, [0, 1, 0])

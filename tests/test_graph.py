@@ -451,3 +451,58 @@ class TestGraphTBPTT:
         d["backprop_type"] = "TruncatedBPTT"   # DL4J-dialect spelling
         conf2 = MultiLayerConfiguration.from_dict(d)
         assert conf2.backprop_type == "truncated_bptt"
+
+
+class TestFitBatchesOnDevice:
+    """Device-loop training window (lax.scan over stacked batches): one
+    dispatch == K sequential fit steps, same math."""
+
+    def _parts(self, seed=3):
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        g = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+             .graph_builder().add_inputs("in")
+             .add_layer("d", DenseLayer(n_out=12, activation="tanh"), "in")
+             .add_layer("out", OutputLayer(n_out=3), "d")
+             .set_outputs("out").set_input_types(InputType.feed_forward(6)))
+        return g.build()
+
+    def test_matches_sequential_fit(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        rng = np.random.default_rng(0)
+        batches = []
+        for i in range(5):
+            yc = rng.integers(0, 3, 16)
+            x = rng.normal(size=(16, 6)).astype(np.float32)
+            x[np.arange(16), yc] += 2.0
+            batches.append(DataSet(x, np.eye(3, dtype=np.float32)[yc]))
+
+        seq = ComputationGraph(self._parts()).init()
+        for ds in batches:
+            seq.fit(ds)
+        dev = ComputationGraph(self._parts()).init()
+        dev.fit_batches_on_device(batches)
+        assert dev.iteration == seq.iteration == 5
+        for name in seq.params:
+            for k in seq.params[name]:
+                np.testing.assert_allclose(
+                    np.asarray(dev.params[name][k]),
+                    np.asarray(seq.params[name][k]), rtol=2e-5, atol=2e-6)
+        np.testing.assert_allclose(float(dev.score_), float(seq.score_),
+                                   rtol=1e-4)
+
+    def test_rejects_masks_and_tbptt(self):
+        import numpy as np
+        import pytest
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(self._parts()).init()
+        x = np.ones((4, 6), np.float32)
+        y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+        with pytest.raises(ValueError, match="mask"):
+            net.fit_batches_on_device(
+                [DataSet(x, y, features_mask=np.ones((4, 1), np.float32))])
