@@ -53,6 +53,11 @@ class TpuProvisioner:
                 f"--project={self.project}", f"--zone={self.zone}",
                 f"--worker={worker}", f"--command={command}"]
 
+    def run_command(self, cmd: List[str]) -> str:
+        """Execute an arbitrary built command through the configured runner
+        (the single dispatch point — inject a fake runner to test/log)."""
+        return self._runner(cmd)
+
     def create(self, name: str, **kw) -> str:
         return self._runner(self.create_command(name, **kw))
 
@@ -123,3 +128,268 @@ class ObjectStorage:
             raise ImportError("boto3 is not installed; "
                               "use file:// URIs for local staging") from e
         return boto3.client("s3")
+
+
+class HostProvisioner:
+    """Per-host script staging + execution over the TPU-VM ssh/scp channel
+    (``aws/ec2/provision/HostProvisioner.java`` role: ``uploadAndRun``,
+    ``runRemoteCommand``, ``uploadForDeployment`` — JSch sessions become
+    ``gcloud compute tpus tpu-vm ssh/scp`` invocations)."""
+
+    def __init__(self, provisioner: TpuProvisioner, name: str,
+                 worker: str = "all"):
+        self.provisioner = provisioner
+        self.name = name
+        self.worker = worker
+
+    def scp_command(self, local_path: str, remote_path: str) -> List[str]:
+        p = self.provisioner
+        return ["gcloud", "compute", "tpus", "tpu-vm", "scp", local_path,
+                f"{self.name}:{remote_path}",
+                f"--project={p.project}", f"--zone={p.zone}",
+                f"--worker={self.worker}"]
+
+    def upload_for_deployment(self, local_path: str, remote_path: str) -> str:
+        """``uploadForDeployment``: stage a file on every worker."""
+        return self.provisioner.run_command(
+            self.scp_command(local_path, remote_path))
+
+    def run_remote_command(self, command: str) -> str:
+        return self.provisioner.run_on(self.name, command, worker=self.worker)
+
+    def upload_and_run(self, script_path: str, root_dir: str = "~") -> str:
+        """``uploadAndRun``: stage a setup script and execute it."""
+        import posixpath
+        import shlex
+        remote = posixpath.join(root_dir, os.path.basename(script_path))
+        self.upload_for_deployment(script_path, remote)
+        q = shlex.quote(remote)
+        return self.run_remote_command(f"chmod +x {q} && {q}")
+
+
+class ClusterProvisioner:
+    """Bring up N single-host TPU VMs (or one multi-host slice), wait until
+    they are READY, provision them in parallel, tear them down — the
+    ``Ec2BoxCreator`` + ``ClusterSetup`` orchestration
+    (``ec2/provision/ClusterSetup.java``: create boxes, blockTillAllRunning,
+    provision workers on a thread pool)."""
+
+    def __init__(self, provisioner: TpuProvisioner, num_workers: int = 1,
+                 accelerator_type: str = "v5p-8",
+                 version: str = "tpu-ubuntu2204-base",
+                 name_prefix: str = "dl4j-tpu"):
+        self.provisioner = provisioner
+        self.num_workers = num_workers
+        self.accelerator_type = accelerator_type
+        self.version = version
+        self.name_prefix = name_prefix
+
+    @property
+    def names(self) -> List[str]:
+        return [f"{self.name_prefix}-{i}" for i in range(self.num_workers)]
+
+    def describe_command(self, name: str) -> List[str]:
+        p = self.provisioner
+        return ["gcloud", "compute", "tpus", "tpu-vm", "describe", name,
+                f"--project={p.project}", f"--zone={p.zone}",
+                "--format=value(state)"]
+
+    def _pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+        return ThreadPoolExecutor(max_workers=max(1, min(8, self.num_workers)))
+
+    def create(self) -> List[str]:
+        """Create every VM in parallel (``Ec2BoxCreator.create``; creation is
+        the slowest step — minutes per node); returns the names."""
+        if not self.names:
+            return []
+        with self._pool() as ex:
+            list(ex.map(lambda n: self.provisioner.create(
+                n, accelerator_type=self.accelerator_type,
+                version=self.version), self.names))
+        return self.names
+
+    def block_till_all_running(self, poll_seconds: float = 10.0,
+                               timeout: float = 900.0) -> None:
+        """``blockTillAllRunning``: poll describe until every VM is READY."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        pending = list(self.names)
+        while pending:
+            still = []
+            for name in pending:
+                state = self.provisioner._runner(
+                    self.describe_command(name)).strip().upper()
+                if state != "READY":
+                    still.append(name)
+            if not still:
+                return
+            if _time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"TPU VMs not READY within {timeout}s: {still}")
+            _time.sleep(poll_seconds)
+            pending = still
+
+    def provision_workers(self, setup_script: str) -> List[str]:
+        """Run the worker setup script on every VM in parallel
+        (``ClusterSetup.provisionWorkers`` thread pool)."""
+        if not self.names:
+            return []
+        def one(name):
+            return HostProvisioner(self.provisioner, name).upload_and_run(
+                setup_script)
+        with self._pool() as ex:
+            return list(ex.map(one, self.names))
+
+    def teardown(self) -> None:
+        if not self.names:
+            return
+        with self._pool() as ex:
+            list(ex.map(self.provisioner.delete, self.names))
+
+
+class BucketDataSetIterator:
+    """Iterate serialized DataSets straight out of object storage
+    (``s3/reader/BaseS3DataSetIterator.java`` + ``BucketIterator`` role).
+
+    Keys are listed from the bucket URI (works with ``file://`` locally —
+    the test/emulation path, like every storage entry point here), each
+    object is fetched and deserialized with ``datasets.dataset.DataSet``'s
+    npz layout (features/labels [+ masks])."""
+
+    def __init__(self, bucket_uri: str, storage: Optional[ObjectStorage] = None,
+                 suffix: str = ".npz"):
+        self.bucket_uri = bucket_uri.rstrip("/")
+        self.storage = storage or ObjectStorage()
+        self.suffix = suffix
+        self._keys = self.list_keys()
+        self._pos = 0
+
+    def _prefix(self):
+        """(scheme, bucket, key_prefix) of the bucket URI itself. The prefix
+        keeps its trailing '/' (when non-empty) so sibling prefixes like
+        ``data-old/`` never match a ``data/`` listing."""
+        from urllib.parse import urlparse
+        p = urlparse(self.bucket_uri)
+        prefix = p.path.lstrip("/")
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        return p.scheme, p.netloc, prefix
+
+    def list_keys(self) -> List[str]:
+        """Keys RELATIVE to the bucket URI (nested keys keep their
+        subpath, so ``__next__`` re-joins to a real object URI)."""
+        scheme, bucket, prefix = self._prefix()
+        if scheme == "file":
+            root = self.bucket_uri[len("file://"):]
+            if not os.path.isdir(root):
+                return []
+            out = []
+            for base, _dirs, files in os.walk(root):
+                rel = os.path.relpath(base, root)
+                for n in files:
+                    if n.endswith(self.suffix):
+                        out.append(n if rel == "." else os.path.join(rel, n))
+            return sorted(out)
+        if scheme == "gs":
+            client = ObjectStorage._gcs()
+            return sorted(b.name[len(prefix):]
+                          for b in client.bucket(bucket).list_blobs(prefix=prefix)
+                          if b.name.endswith(self.suffix))
+        if scheme == "s3":
+            s3 = ObjectStorage._s3()
+            keys: List[str] = []
+            token = None
+            while True:  # paginate: list_objects_v2 caps at 1000 keys
+                kw = {"Bucket": bucket, "Prefix": prefix}
+                if token:
+                    kw["ContinuationToken"] = token
+                resp = s3.list_objects_v2(**kw)
+                keys.extend(o["Key"][len(prefix):]
+                            for o in resp.get("Contents", ())
+                            if o["Key"].endswith(self.suffix))
+                if not resp.get("IsTruncated"):
+                    break
+                token = resp.get("NextContinuationToken")
+            return sorted(keys)
+        raise ValueError(f"unsupported scheme {scheme!r}")
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._keys)
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    def __next__(self):
+        import tempfile
+
+        import numpy as np
+
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        if not self.has_next():
+            raise StopIteration
+        key = self._keys[self._pos]
+        self._pos += 1
+        with tempfile.TemporaryDirectory() as d:
+            local = os.path.join(d, os.path.basename(key))
+            self.storage.download(f"{self.bucket_uri}/{key}", local)
+            with np.load(local, allow_pickle=False) as z:
+                return DataSet(z["features"], z["labels"],
+                               z["features_mask"] if "features_mask" in z else None,
+                               z["labels_mask"] if "labels_mask" in z else None)
+
+    @staticmethod
+    def stage(datasets, bucket_uri: str,
+              storage: Optional[ObjectStorage] = None,
+              prefix: str = "part") -> List[str]:
+        """Serialize DataSets into the bucket (the uploader half;
+        ``S3Uploader`` role). Returns the written keys."""
+        import tempfile
+
+        import numpy as np
+        storage = storage or ObjectStorage()
+        keys = []
+        for i, ds in enumerate(datasets):
+            key = f"{prefix}-{i:05d}.npz"
+            with tempfile.TemporaryDirectory() as d:
+                local = os.path.join(d, key)
+                arrs = {"features": np.asarray(ds.features),
+                        "labels": np.asarray(ds.labels)}
+                if ds.features_mask is not None:
+                    arrs["features_mask"] = np.asarray(ds.features_mask)
+                if ds.labels_mask is not None:
+                    arrs["labels_mask"] = np.asarray(ds.labels_mask)
+                np.savez(local, **arrs)
+                storage.upload(local, f"{bucket_uri.rstrip('/')}/{key}")
+            keys.append(key)
+        return keys
+
+
+class TpuJobRunner:
+    """Ephemeral-cluster job execution: provision → stage → run → collect →
+    teardown (the ``emr/SparkEMRClient.java`` role — its EMR cluster + spark
+    submit become a TPU slice + per-worker script run). ``keep_alive`` keeps
+    the slice after the job like the EMR client's keepClusterAfterExecution.
+    """
+
+    def __init__(self, cluster: ClusterProvisioner, keep_alive: bool = False):
+        self.cluster = cluster
+        self.keep_alive = keep_alive
+
+    def run(self, job_script: str, setup_script: Optional[str] = None) -> List[str]:
+        try:
+            # inside the try: a PARTIAL create failure must still tear down
+            # the workers that did come up (ephemeral semantics)
+            self.cluster.create()
+            self.cluster.block_till_all_running()
+            if setup_script:
+                self.cluster.provision_workers(setup_script)
+            outs = self.cluster.provision_workers(job_script)
+            return outs
+        finally:
+            if not self.keep_alive:
+                self.cluster.teardown()
