@@ -157,13 +157,21 @@ class HostProvisioner:
     def run_remote_command(self, command: str) -> str:
         return self.provisioner.run_on(self.name, command, worker=self.worker)
 
-    def upload_and_run(self, script_path: str, root_dir: str = "~") -> str:
-        """``uploadAndRun``: stage a setup script and execute it."""
+    def upload_and_run(self, script_path: str, root_dir: str = "/tmp") -> str:
+        """``uploadAndRun``: stage a setup script and execute it.
+
+        ``~``-rooted paths are staged via scp's native tilde handling and
+        executed via ``$HOME`` inside double quotes (``shlex.quote`` would
+        freeze the tilde as a literal)."""
         import posixpath
         import shlex
         remote = posixpath.join(root_dir, os.path.basename(script_path))
         self.upload_for_deployment(script_path, remote)
-        q = shlex.quote(remote)
+        if remote == "~" or remote.startswith("~/"):
+            expanded = "$HOME" + remote[1:]
+            q = '"' + expanded.replace('"', "") + '"'  # $HOME expands in ""
+        else:
+            q = shlex.quote(remote)
         return self.run_remote_command(f"chmod +x {q} && {q}")
 
 
@@ -218,7 +226,7 @@ class ClusterProvisioner:
         while pending:
             still = []
             for name in pending:
-                state = self.provisioner._runner(
+                state = self.provisioner.run_command(
                     self.describe_command(name)).strip().upper()
                 if state != "READY":
                     still.append(name)
@@ -242,10 +250,22 @@ class ClusterProvisioner:
             return list(ex.map(one, self.names))
 
     def teardown(self) -> None:
+        """Delete every VM; per-VM failures are collected as warnings so a
+        teardown after a PARTIAL create (some VMs never existed) still
+        removes the ones that do, and never masks the original error."""
         if not self.names:
             return
+        import warnings
+
+        def one(name):
+            try:
+                self.provisioner.delete(name)
+            except Exception as e:  # noqa: BLE001 - best-effort cleanup
+                warnings.warn(f"teardown: could not delete {name}: {e}",
+                              stacklevel=2)
+
         with self._pool() as ex:
-            list(ex.map(self.provisioner.delete, self.names))
+            list(ex.map(one, self.names))
 
 
 class BucketDataSetIterator:

@@ -743,6 +743,16 @@ def _dl4j_param_specs(layer):
         if "b" in shapes:
             specs.append(("b", (1, layer.n_out), "c", ravel, "param"))
         return specs
+    if cls == "GravesBidirectionalLSTMLayer":
+        # GravesBidirectionalLSTMParamInitializer order: WF, RWF, bF then
+        # WB, RWB, bB — our wrapper stores them as f_/b_-prefixed leaves
+        specs = []
+        for pre in ("f_", "b_"):
+            specs += [(pre + "W", shapes[pre + "W"], "f", ident, "param"),
+                      (pre + "RW", shapes[pre + "RW"], "f", ident, "param"),
+                      (pre + "b", (1, shapes[pre + "b"][0]), "c", ravel,
+                       "param")]
+        return specs
     if cls in ("LSTMLayer", "GravesLSTMLayer", "SimpleRnnLayer", "GRULayer"):
         # LSTMParamInitializer order: W [nIn, 4H], RW [H, 4H(+3 peephole
         # cols for Graves — our layout already matches)], b; IFOG gate order
@@ -765,14 +775,28 @@ def _dl4j_param_specs(layer):
         "restore_multi_layer_network_configuration")
 
 
+def _layer_seq(conf):
+    """Uniform (key, layer) sequence for both network kinds: MLN confs walk
+    ``layers`` by index; graph confs walk LAYER vertices in topological
+    order — the order ``ComputationGraph.init`` allocates its flattened
+    param views in (``ComputationGraph.java:467-470``). NOTE: topological
+    sorts are not unique; for branchy graphs the reference's own sort is
+    assumed to match ours (true for chains and for graphs serialized in
+    creation order)."""
+    if hasattr(conf, "layers"):
+        return list(enumerate(conf.layers))
+    # derive from the SAME accessor ComputationGraph.init allocates from
+    return [(vd.name, vd.obj) for vd in conf.layer_vertices()]
+
+
 def _iter_param_slices(conf, flat):
-    """Yield (layer_index, name, target, converted_array) walking the
+    """Yield (layer_key, name, target, converted_array) walking the
     flattened vector in DL4J layout order."""
     import numpy as np
 
     pos = 0
     flat = np.asarray(flat).reshape(-1)
-    for i, layer in enumerate(conf.layers):
+    for i, layer in _layer_seq(conf):
         for name, dl4j_shape, order, convert, target in _dl4j_param_specs(layer):
             n = int(np.prod(dl4j_shape))
             seg = flat[pos:pos + n]
@@ -791,14 +815,22 @@ def _iter_param_slices(conf, flat):
             "checkpoint")
 
 
+def _copy_container(c):
+    """Shallow-copy a param container: MLN list-of-dicts or graph
+    name-keyed dict-of-dicts (both index the same way downstream)."""
+    if isinstance(c, dict):
+        return {k: dict(v) for k, v in c.items()}
+    return [dict(x) for x in c]
+
+
 def apply_coefficients(net, flat) -> None:
     """Map a DL4J flattened parameter vector onto an initialized
-    MultiLayerNetwork (params + BatchNorm running stats)."""
+    MultiLayerNetwork or ComputationGraph (params + BN running stats)."""
     import jax.numpy as jnp
 
     dtype = net.conf.global_conf.jnp_dtype()
-    params = [dict(p) for p in net.params]
-    states = [dict(s) for s in net.states]
+    params = _copy_container(net.params)
+    states = _copy_container(net.states)
     for i, name, target, arr in _iter_param_slices(net.conf, flat):
         dest = params[i] if target == "param" else states[i]
         if name in dest and tuple(dest[name].shape) != tuple(arr.shape):
@@ -826,11 +858,11 @@ def _updater_blocks(conf):
     params coalesce into contiguous blocks, SPLIT wherever a non-trainable
     run (BatchNorm global mean/var, which DL4J pairs with a stateless NoOp
     pseudo-updater) interrupts them. Yields lists of
-    ``(layer_idx, name, dl4j_shape, order, convert)`` per block."""
+    ``(layer_key, name, dl4j_shape, order, convert)`` per block."""
     import numpy as np
 
     blocks, current = [], []
-    for i, layer in enumerate(conf.layers):
+    for i, layer in _layer_seq(conf):
         for name, dl4j_shape, order, convert, target in _dl4j_param_specs(layer):
             if target != "param":
                 if current:
@@ -858,7 +890,9 @@ def apply_updater_state(net, flat) -> bool:
     import numpy as np
     import jax.numpy as jnp
 
-    kinds = {type(u).__name__ for umap in net._updaters for u in umap.values()}
+    umaps = (net._updaters.values() if isinstance(net._updaters, dict)
+             else net._updaters)
+    kinds = {type(u).__name__ for umap in umaps for u in umap.values()}
     if len(kinds) != 1:
         return False
     kind = next(iter(kinds))
@@ -876,7 +910,7 @@ def apply_updater_state(net, flat) -> bool:
             f"updaterState.bin length {flat.size} != expected {want} "
             f"({len(slots)} {kind} slots over the trainable params)")
     dtype = net.conf.global_conf.jnp_dtype()
-    new_states = [dict(s) for s in net.updater_states]
+    new_states = _copy_container(net.updater_states)
     pos = 0
     for block in blocks:
         block_n = sum(int(np.prod(shape)) for (_, _, shape, _, _) in block)
@@ -914,6 +948,36 @@ def restore_multi_layer_network(path: str, load_params: bool = True,
                 "this is a ComputationGraph configuration")
         conf = import_dl4j_configuration(raw)
         net = MultiLayerNetwork(conf).init()
+        if load_params and "coefficients.bin" in names:
+            coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
+            apply_coefficients(net, coeff)
+        if (load_params and load_updater and "updaterState.bin" in names):
+            upd = read_nd4j_array_from_bytes(z.read("updaterState.bin"))
+            apply_updater_state(net, upd)
+    return net
+
+
+def restore_computation_graph(path: str, load_params: bool = True,
+                              load_updater: bool = True):
+    """``ModelSerializer.restoreComputationGraph`` parity
+    (``util/ModelSerializer.java:389``): graph configuration + flattened
+    parameters (+ updater state for uniform updater configs). Parameter
+    layout follows the topological order of layer vertices, the order
+    ``ComputationGraph.init`` allocates its flattened views in."""
+    from deeplearning4j_tpu.modelimport.nd4j_binary import (
+        read_nd4j_array_from_bytes)
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        raw = _read_zip_configuration(z, path)
+        if "vertices" not in raw:
+            raise UnsupportedDl4jConfigurationException(
+                "restore_computation_graph is for ComputationGraph zips; "
+                "this is a MultiLayerNetwork configuration — use "
+                "restore_multi_layer_network")
+        conf = import_dl4j_graph_configuration(raw)
+        net = ComputationGraph(conf).init()
         if load_params and "coefficients.bin" in names:
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)

@@ -168,8 +168,63 @@ def lstm_fixture():
     print("wrote", zpath, "out[0,0]:", out[0, 0])
 
 
+def graph_fixture():
+    """ComputationGraph zip: two parallel dense branches + elementwise add +
+    output, plus a GravesBidirectionalLSTM head on a second input-free chain
+    is overkill — keep the branchy-but-chain-serialized shape DL4J's topo
+    sort shares with ours."""
+    rng = np.random.default_rng(7)
+    dense = lambda nin, nout, name: {"dense": {
+        "layerName": name, "nin": nin, "nout": nout,
+        "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"},
+        "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Nesterovs",
+                     "learningRate": 0.1, "momentum": 0.9}}}
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        "vertices": {
+            "a": {"LayerVertex": {"layerConf": {"layer": dense(4, 6, "a")}}},
+            "b": {"LayerVertex": {"layerConf": {"layer": dense(4, 6, "b")}}},
+            "ew": {"ElementWiseVertex": {"op": "Add"}},
+            "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                "layerName": "out", "nin": 6, "nout": 2,
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Nesterovs",
+                             "learningRate": 0.1, "momentum": 0.9}}}}}},
+        },
+        "vertexInputs": {"a": ["in"], "b": ["in"], "ew": ["a", "b"],
+                         "out": ["ew"]},
+    }
+    aW = rng.normal(0, 0.3, (4, 6)).astype(np.float32)
+    ab = rng.normal(0, 0.1, (6,)).astype(np.float32)
+    bW = rng.normal(0, 0.3, (4, 6)).astype(np.float32)
+    bb = rng.normal(0, 0.1, (6,)).astype(np.float32)
+    oW = rng.normal(0, 0.3, (6, 2)).astype(np.float32)
+    ob = rng.normal(0, 0.1, (2,)).astype(np.float32)
+    # flattened in topological layer order a, b, out; dense W 'f'
+    flat = np.concatenate([aW.flatten("F"), ab, bW.flatten("F"), bb,
+                           oW.flatten("F"), ob]).astype(np.float32)
+    upd = np.arange(flat.size, dtype=np.float32) * 1e-3  # Nesterovs [V(all)]
+
+    zpath = os.path.join(HERE, "dl4j_checkpoint_graph.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin", nd4j_bytes(flat))
+        z.writestr("updaterState.bin", nd4j_bytes(upd))
+
+    from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+    net = restore_computation_graph(zpath)
+    x = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    np.savez(os.path.join(HERE, "dl4j_checkpoint_graph_expected.npz"),
+             x=x, out=out, aW=aW, ab=ab, bW=bW, bb=bb, oW=oW, ob=ob, upd=upd)
+    print("wrote", zpath, "out[0]:", out[0])
+
+
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
     conv_net_fixture()
     lstm_fixture()
+    graph_fixture()

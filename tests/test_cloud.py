@@ -231,3 +231,30 @@ class TestReviewDrivenFixes:
         ssh = runner.calls[-1]
         cmd_arg = next(a for a in ssh if a.startswith("--command="))
         assert "'/tmp/my setup.sh'" in cmd_arg
+
+    def test_home_rooted_script_uses_dollar_home(self, tmp_path):
+        runner = FakeRunner()
+        p = TpuProvisioner("proj", "z", runner=runner)
+        script = tmp_path / "s.sh"
+        script.write_text("echo\n")
+        HostProvisioner(p, "n").upload_and_run(str(script), root_dir="~")
+        cmd_arg = next(a for a in runner.calls[-1] if a.startswith("--command="))
+        assert '"$HOME/s.sh"' in cmd_arg and "'~" not in cmd_arg
+
+    def test_teardown_survives_missing_vms(self):
+        class DeleteBoom(FakeRunner):
+            def __call__(self, cmd):
+                super().__call__(cmd)
+                if "delete" in cmd and cmd[5].endswith("-1"):
+                    raise RuntimeError("not found")
+                return "ok"
+        import warnings
+        runner = DeleteBoom()
+        cluster = ClusterProvisioner(TpuProvisioner("p", "z", runner=runner),
+                                     num_workers=2)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            cluster.teardown()  # must not raise
+        deletes = [c for c in runner.calls if "delete" in c]
+        assert len(deletes) == 2
+        assert any("could not delete" in str(x.message) for x in w)

@@ -261,3 +261,94 @@ class TestReviewDrivenEdgeCases:
         if f32_expected:
             assert net.states[1]["mean"].dtype == jnp.float32
         assert net.params[0]["W"].dtype == jnp.bfloat16
+
+
+class TestGraphCheckpoint:
+    """restoreComputationGraph parity (ModelSerializer.java:389): graph
+    config + coefficients + updater state, layers in topological order."""
+
+    ZIP = os.path.join(FIXTURES, "dl4j_checkpoint_graph.zip")
+    EXP = os.path.join(FIXTURES, "dl4j_checkpoint_graph_expected.npz")
+
+    def test_params_follow_topological_order(self):
+        from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+        exp = np.load(self.EXP)
+        net = restore_computation_graph(self.ZIP)
+        np.testing.assert_allclose(np.asarray(net.params["a"]["W"]),
+                                   exp["aW"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params["b"]["W"]),
+                                   exp["bW"], rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params["out"]["b"]),
+                                   exp["ob"], rtol=1e-6)
+
+    def test_output_matches_recorded(self):
+        from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+        exp = np.load(self.EXP)
+        net = restore_computation_graph(self.ZIP)
+        np.testing.assert_allclose(np.asarray(net.output(exp["x"])),
+                                   exp["out"], rtol=1e-5, atol=1e-6)
+
+    def test_updater_state_and_fine_tune(self):
+        from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+        exp = np.load(self.EXP)
+        net = restore_computation_graph(self.ZIP)
+        v = np.asarray(net.updater_states["a"]["W"]["v"])
+        np.testing.assert_allclose(v, exp["upd"][:24].reshape((4, 6), order="F"),
+                                   rtol=1e-6)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        net.fit(x, y)
+        assert np.isfinite(float(net.score_))
+
+    def test_mln_zip_rejected(self, tmp_path):
+        import json
+        import zipfile
+        from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+        p = str(tmp_path / "mln.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", json.dumps({"confs": []}))
+        with pytest.raises(UnsupportedDl4jConfigurationException,
+                           match="MultiLayerNetwork"):
+            restore_computation_graph(p)
+
+
+class TestGravesBidirectionalIngestion:
+    def test_bidirectional_param_layout(self, tmp_path):
+        import json
+        import zipfile
+        from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
+        from deeplearning4j_tpu.modelimport.dl4j import restore_multi_layer_network
+        rng = np.random.default_rng(3)
+        h, nin = 3, 2
+        conf = {"confs": [
+            {"layer": {"gravesBidirectionalLSTM": {
+                "activationFn": "tanh", "nin": nin, "nout": h}}},
+            {"layer": {"rnnoutput": {"activationFn": "softmax",
+                                     "lossFunction": "MCXENT",
+                                     "nin": h, "nout": 2}}},
+        ]}
+        fW = rng.normal(0, 0.2, (nin, 4 * h)).astype(np.float32)
+        fRW = rng.normal(0, 0.2, (h, 4 * h + 3)).astype(np.float32)
+        fb = rng.normal(0, 0.1, (4 * h,)).astype(np.float32)
+        bW = rng.normal(0, 0.2, (nin, 4 * h)).astype(np.float32)
+        bRW = rng.normal(0, 0.2, (h, 4 * h + 3)).astype(np.float32)
+        bb = rng.normal(0, 0.1, (4 * h,)).astype(np.float32)
+        oW = rng.normal(0, 0.2, (h, 2)).astype(np.float32)
+        ob = np.zeros(2, np.float32)
+        flat = np.concatenate([  # WF, RWF, bF, WB, RWB, bB (initializer order)
+            fW.flatten("F"), fRW.flatten("F"), fb,
+            bW.flatten("F"), bRW.flatten("F"), bb,
+            oW.flatten("F"), ob])
+        p = str(tmp_path / "bi.zip")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("configuration.json", json.dumps(conf))
+            z.writestr("coefficients.bin",
+                       nd4j_array_to_bytes(flat.reshape(1, -1)))
+        net = restore_multi_layer_network(p)
+        np.testing.assert_allclose(np.asarray(net.params[0]["f_W"]), fW,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(net.params[0]["b_RW"]), bRW,
+                                   rtol=1e-6)
+        out = net.output(np.zeros((1, 4, nin), np.float32))
+        assert np.asarray(out).shape == (1, 4, 2)
