@@ -168,8 +168,10 @@ class HostProvisioner:
         remote = posixpath.join(root_dir, os.path.basename(script_path))
         self.upload_for_deployment(script_path, remote)
         if remote == "~" or remote.startswith("~/"):
-            expanded = "$HOME" + remote[1:]
-            q = '"' + expanded.replace('"', "") + '"'  # $HOME expands in ""
+            # "$HOME" expands; the rest stays shlex-quoted so metacharacters
+            # in the basename can never execute remotely
+            q = '"$HOME"' + (("/" + shlex.quote(remote[2:])) if len(remote) > 2
+                             else "")
         else:
             q = shlex.quote(remote)
         return self.run_remote_command(f"chmod +x {q} && {q}")

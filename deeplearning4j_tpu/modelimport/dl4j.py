@@ -978,6 +978,20 @@ def restore_computation_graph(path: str, load_params: bool = True,
                 "restore_multi_layer_network")
         conf = import_dl4j_graph_configuration(raw)
         net = ComputationGraph(conf).init()
+        # coefficients follow DL4J's topologicalSortOrder; when our sort's
+        # layer order diverges from the zip's declaration order the tie-break
+        # MAY differ from the reference's — same-shaped parallel branches
+        # would then swap silently, so surface it
+        decl = [n for n, vd in conf.vertices.items() if vd.is_layer]
+        topo = [vd.name for vd in conf.layer_vertices()]
+        if decl != topo and load_params and "coefficients.bin" in names:
+            import warnings
+            warnings.warn(
+                "graph topological layer order "
+                f"{topo} differs from the checkpoint's declaration order "
+                f"{decl}; DL4J's own sort may tie-break differently on "
+                "parallel branches — verify restored outputs against known "
+                "activations", stacklevel=2)
         if load_params and "coefficients.bin" in names:
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)

@@ -299,6 +299,73 @@ class MultiLayerNetwork:
                     listener.on_epoch_end(self)
         return self
 
+    def _get_multi_train_step(self):
+        """K train steps as ONE compiled ``lax.scan`` over stacked batches
+        (ComputationGraph._get_multi_train_step counterpart — see
+        :meth:`fit_batches_on_device`)."""
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        key = ("train_scan", _helpers.version())
+        if key not in self._jit_cache:
+            self._evict_stale(_helpers.version())
+
+            def multi(params, states, upd_states, it0, ep, xs, ys, rng0):
+                def body(carry, batch):
+                    params, states, upd, it, rng = carry
+                    x, y = batch
+                    rng, sub = jax.random.split(rng)
+                    def lf(p):
+                        return self._loss_fn(p, states, x, y, sub, None, None,
+                                             train=True)
+                    (loss, (new_states, _)), grads = jax.value_and_grad(
+                        lf, has_aux=True)(params)
+                    new_params, new_upd = self._apply_updates(
+                        params, grads, upd, it, ep)
+                    return (new_params, new_states, new_upd, it + 1.0, rng), loss
+
+                (params, states, upd, _, _), losses = jax.lax.scan(
+                    body, (params, states, upd_states, it0, rng0), (xs, ys))
+                return params, states, upd, losses
+
+            self._jit_cache[key] = jax.jit(multi, donate_argnums=(0, 1, 2))
+        return self._jit_cache[key]
+
+    def fit_batches_on_device(self, datasets) -> "MultiLayerNetwork":
+        """Train on a window of equal-shape batches in ONE device dispatch
+        (``lax.scan`` over the stacked window) — semantically identical to
+        ``fit`` per batch; built for dispatch-bound setups on directly-
+        attached hardware (tunneled backends that stream operands lazily
+        can be SLOWER this way). Requires uniform shapes, no masks,
+        standard backprop."""
+        from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
+        if self.params is None:
+            self.init()
+        if normalize_backprop_type(self.conf.backprop_type) != "standard":
+            raise ValueError("fit_batches_on_device supports standard "
+                             "backprop only (not TBPTT)")
+        datasets = list(datasets)
+        if not datasets:
+            return self
+        if any(ds.features_mask is not None or ds.labels_mask is not None
+               for ds in datasets):
+            raise ValueError("fit_batches_on_device does not carry masks")
+        dtype = self.conf.global_conf.jnp_dtype()
+        xs = jnp.stack([_as_jnp(ds.features, dtype) for ds in datasets])
+        ys = jnp.stack([_as_jnp(ds.labels, dtype) for ds in datasets])
+        multi = self._get_multi_train_step()
+        it0 = jnp.asarray(self.iteration, jnp.float32)
+        ep = jnp.asarray(self.epoch, jnp.float32)
+        (self.params, self.states, self.updater_states, losses) = multi(
+            self.params, self.states, self.updater_states, it0, ep, xs, ys,
+            self._next_rng())
+        self.last_batch_size = int(xs.shape[1])
+        for i in range(len(datasets)):
+            self._score_arr = losses[i]
+            self.iteration += 1
+            for listener in self.listeners:
+                if hasattr(listener, "iteration_done"):
+                    listener.iteration_done(self, self.iteration, self.epoch)
+        return self
+
     def _fit_batch(self, ds) -> None:
         dtype = self.conf.global_conf.jnp_dtype()
         x = _as_jnp(ds.features, dtype)

@@ -239,7 +239,7 @@ class TestReviewDrivenFixes:
         script.write_text("echo\n")
         HostProvisioner(p, "n").upload_and_run(str(script), root_dir="~")
         cmd_arg = next(a for a in runner.calls[-1] if a.startswith("--command="))
-        assert '"$HOME/s.sh"' in cmd_arg and "'~" not in cmd_arg
+        assert '"$HOME"/s.sh' in cmd_arg and "'~" not in cmd_arg
 
     def test_teardown_survives_missing_vms(self):
         class DeleteBoom(FakeRunner):
@@ -258,3 +258,14 @@ class TestReviewDrivenFixes:
         deletes = [c for c in runner.calls if "delete" in c]
         assert len(deletes) == 2
         assert any("could not delete" in str(x.message) for x in w)
+
+    def test_home_rooted_metacharacters_stay_quoted(self, tmp_path):
+        runner = FakeRunner()
+        p = TpuProvisioner("proj", "z", runner=runner)
+        script = tmp_path / "se`tup`.sh"
+        script.write_text("echo\n")
+        HostProvisioner(p, "n").upload_and_run(str(script), root_dir="~")
+        cmd_arg = next(a for a in runner.calls[-1] if a.startswith("--command="))
+        assert '"$HOME"/' in cmd_arg
+        # the backtick basename is single-quoted -> no remote substitution
+        assert "'se`tup`.sh'" in cmd_arg

@@ -349,3 +349,51 @@ class TestMaskLayer:
         assert np.isfinite(float(net.score_))
         out = np.asarray(net.output(x))
         assert out.shape == (16, 3)
+
+
+class TestFitBatchesOnDeviceMLN:
+    def test_matches_sequential_fit(self):
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        def make():
+            conf = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                    .list()
+                    .layer(DenseLayer(n_out=10, activation="tanh"))
+                    .layer(OutputLayer(n_out=3))
+                    .set_input_type(InputType.feed_forward(6)).build())
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(0)
+        batches = []
+        for _ in range(4):
+            yc = rng.integers(0, 3, 16)
+            x = rng.normal(size=(16, 6)).astype(np.float32)
+            x[np.arange(16), yc] += 2.0
+            batches.append(DataSet(x, np.eye(3, dtype=np.float32)[yc]))
+        seq = make()
+        for ds in batches:
+            seq.fit(ds)
+        dev = make()
+        dev.fit_batches_on_device(batches)
+        assert dev.iteration == seq.iteration == 4
+        for pl, pd in zip(seq.params, dev.params):
+            for k in pl:
+                np.testing.assert_allclose(np.asarray(pd[k]),
+                                           np.asarray(pl[k]),
+                                           rtol=2e-5, atol=2e-6)
+
+    def test_listener_sees_every_iteration(self):
+        from deeplearning4j_tpu.optimize.listeners import CollectScoresIterationListener
+        conf = (NeuralNetConfiguration.builder().seed(5).list()
+                .layer(DenseLayer(n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        lst = CollectScoresIterationListener(frequency=1)
+        net.listeners.append(lst)
+        rng = np.random.default_rng(1)
+        batches = [DataSet(rng.normal(size=(8, 4)).astype(np.float32),
+                           np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)])
+                   for _ in range(3)]
+        net.fit_batches_on_device(batches)
+        assert len(lst.scores) == 3
