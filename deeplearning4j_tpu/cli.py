@@ -69,10 +69,50 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     return net
 
 
+def cluster_setup_main(argv: Optional[List[str]] = None, runner=None):
+    """``ClusterSetup`` parity (``aws/ec2/provision/ClusterSetup.java``
+    JCommander flags → argparse): bring up N TPU VMs, wait until READY,
+    provision each with the worker script. ``runner`` is injectable for
+    tests/dry runs; ``--dry-run`` prints the gcloud commands instead."""
+    ap = argparse.ArgumentParser("cloud-setup")
+    ap.add_argument("-w", "--workers", type=int, default=1,
+                    help="number of TPU VMs (ClusterSetup -w)")
+    ap.add_argument("--project", required=True)
+    ap.add_argument("--zone", required=True,
+                    help="GCP zone (the -region flag's role)")
+    ap.add_argument("--accelerator-type", default="v5p-8",
+                    help="TPU slice type (the -s instance-size flag's role)")
+    ap.add_argument("--version", default="tpu-ubuntu2204-base",
+                    help="TPU VM image (the -ami flag's role)")
+    ap.add_argument("--name-prefix", default="dl4j-tpu")
+    ap.add_argument("--wscript", default=None,
+                    help="worker setup script to upload and run on every VM")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the gcloud commands; execute nothing")
+    args = ap.parse_args(argv)
+
+    from deeplearning4j_tpu.cloud import ClusterProvisioner, TpuProvisioner
+
+    if args.dry_run:
+        import shlex
+        runner = lambda cmd: (print(shlex.join(cmd)), "READY")[-1]
+    prov = TpuProvisioner(args.project, args.zone, runner=runner)
+    cluster = ClusterProvisioner(prov, num_workers=args.workers,
+                                 accelerator_type=args.accelerator_type,
+                                 version=args.version,
+                                 name_prefix=args.name_prefix)
+    cluster.create()
+    cluster.block_till_all_running(poll_seconds=0.0 if args.dry_run else 10.0)
+    if args.wscript:
+        cluster.provision_workers(args.wscript)
+    return cluster
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
-        print("usage: python -m deeplearning4j_tpu.cli {train,nn-server} ...")
+        print("usage: python -m deeplearning4j_tpu.cli "
+              "{train,nn-server,cloud-setup} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
@@ -87,7 +127,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         except KeyboardInterrupt:
             server.stop()
         return 0
-    print(f"unknown command {cmd!r}; expected 'train' or 'nn-server'")
+    if cmd == "cloud-setup":
+        cluster_setup_main(rest)
+        return 0
+    print(f"unknown command {cmd!r}; expected 'train', 'nn-server', or "
+          "'cloud-setup'")
     return 2
 
 

@@ -53,7 +53,7 @@ _ACTIVATIONS = {
     "selu": "selu", "cube": "cube", "hardsigmoid": "hardsigmoid",
     "hardtanh": "hardtanh", "leakyrelu": "leakyrelu", "lrelu": "leakyrelu",
     "rationaltanh": "rationaltanh", "swish": "swish", "gelu": "gelu",
-    "rrelu": "leakyrelu", "thresholdedrelu": "relu",
+    "rrelu": "leakyrelu", "thresholdedrelu": "thresholdedrelu",
 }
 
 
@@ -90,6 +90,8 @@ def _activation(v):
     mapped = _ACTIVATIONS[key]
     if mapped in ("leakyrelu", "elu") and "alpha" in params:
         return (mapped, {"alpha": float(params["alpha"])})
+    if mapped == "thresholdedrelu" and "theta" in params:
+        return (mapped, {"theta": float(params["theta"])})
     return mapped
 
 
@@ -957,6 +959,27 @@ def restore_multi_layer_network(path: str, load_params: bool = True,
     return net
 
 
+def _layer_order_is_forced(conf, order) -> bool:
+    """True when every consecutive pair of layer vertices in ``order`` is
+    connected by a dependency path — then EVERY topological sort yields the
+    same layer sequence and the coefficient mapping is unambiguous."""
+    inputs = {name: set(vd.inputs) for name, vd in conf.vertices.items()}
+
+    def reaches(src, dst):  # dst depends (transitively) on src?
+        stack, seen = [dst], set()
+        while stack:
+            cur = stack.pop()
+            if cur == src:
+                return True
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(inputs.get(cur, ()))
+        return False
+
+    return all(reaches(a, b) for a, b in zip(order, order[1:]))
+
+
 def restore_computation_graph(path: str, load_params: bool = True,
                               load_updater: bool = True):
     """``ModelSerializer.restoreComputationGraph`` parity
@@ -978,20 +1001,20 @@ def restore_computation_graph(path: str, load_params: bool = True,
                 "restore_multi_layer_network")
         conf = import_dl4j_graph_configuration(raw)
         net = ComputationGraph(conf).init()
-        # coefficients follow DL4J's topologicalSortOrder; when our sort's
-        # layer order diverges from the zip's declaration order the tie-break
-        # MAY differ from the reference's — same-shaped parallel branches
-        # would then swap silently, so surface it
-        decl = [n for n, vd in conf.vertices.items() if vd.is_layer]
-        topo = [vd.name for vd in conf.layer_vertices()]
-        if decl != topo and load_params and "coefficients.bin" in names:
-            import warnings
-            warnings.warn(
-                "graph topological layer order "
-                f"{topo} differs from the checkpoint's declaration order "
-                f"{decl}; DL4J's own sort may tie-break differently on "
-                "parallel branches — verify restored outputs against known "
-                "activations", stacklevel=2)
+        # coefficients follow DL4J's topologicalSortOrder; when the LAYER
+        # order is not forced by the dependency structure (parallel layer
+        # branches), the reference's tie-break may differ from ours and
+        # same-shaped branches would swap silently — surface exactly that
+        # case (a forced order is provably correct, no warning)
+        if load_params and "coefficients.bin" in names:
+            order = [vd.name for vd in conf.layer_vertices()]
+            if not _layer_order_is_forced(conf, order):
+                import warnings
+                warnings.warn(
+                    "graph has parallel layer branches whose topological "
+                    f"order {order} is not forced by dependencies; DL4J's "
+                    "own sort may tie-break differently — verify restored "
+                    "outputs against known activations", stacklevel=2)
         if load_params and "coefficients.bin" in names:
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)

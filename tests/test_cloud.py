@@ -269,3 +269,24 @@ class TestReviewDrivenFixes:
         assert '"$HOME"/' in cmd_arg
         # the backtick basename is single-quoted -> no remote substitution
         assert "'se`tup`.sh'" in cmd_arg
+
+
+class TestClusterSetupCli:
+    def test_dry_run_and_injected_runner(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import cluster_setup_main
+        script = tmp_path / "w.sh"
+        script.write_text("echo\n")
+        runner = FakeRunner()
+        cluster = cluster_setup_main(
+            ["-w", "2", "--project", "p", "--zone", "z",
+             "--accelerator-type", "v5e-4", "--wscript", str(script)],
+            runner=runner)
+        assert cluster.names == ["dl4j-tpu-0", "dl4j-tpu-1"]
+        kinds = [c[4] for c in runner.calls]
+        assert kinds.count("create") == 2
+        assert kinds.count("scp") == 2 and kinds.count("ssh") == 2
+        # dry run prints commands, touches nothing real
+        cluster_setup_main(["-w", "1", "--project", "p", "--zone", "z",
+                            "--dry-run"])
+        out = capsys.readouterr().out
+        assert "gcloud compute tpus tpu-vm create" in out

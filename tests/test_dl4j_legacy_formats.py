@@ -416,3 +416,20 @@ class TestParameterizedActivationImport:
         ]}))
         assert isinstance(conf.layers[0].updater, RmsProp)
         assert conf.layers[0].updater.learning_rate == pytest.approx(0.15)
+
+    def test_thresholdedrelu_theta_preserved(self):
+        import numpy as np
+        conf = import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationThresholdedReLU",
+                                 "theta": 0.4},
+                "nin": 2, "nout": 3}}},
+            {"layer": {"output": {"activationFn": "softmax",
+                                  "lossFunction": "MCXENT",
+                                  "nin": 3, "nout": 2}}},
+        ]}))
+        assert conf.layers[0].activation == ("thresholdedrelu", {"theta": 0.4})
+        from deeplearning4j_tpu.nn import activations
+        f = activations.resolve(conf.layers[0].activation)
+        np.testing.assert_allclose(np.asarray(f(np.array([0.3, 0.5]))),
+                                   [0.0, 0.5])

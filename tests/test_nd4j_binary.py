@@ -352,3 +352,36 @@ class TestGravesBidirectionalIngestion:
                                    rtol=1e-6)
         out = net.output(np.zeros((1, 4, nin), np.float32))
         assert np.asarray(out).shape == (1, 4, 2)
+
+    def test_ordering_warning_only_for_unforced_branches(self, tmp_path):
+        import json
+        import warnings
+        import zipfile
+        from deeplearning4j_tpu.modelimport.dl4j import restore_computation_graph
+        from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
+        dense = lambda nin, nout, name: {"dense": {
+            "layerName": name, "nin": nin, "nout": nout,
+            "activationFn": "tanh"}}
+        # LINEAR chain (forced order): no warning even though branchless
+        lin = {"networkInputs": ["in"], "networkOutputs": ["out"],
+               "vertices": {
+                   "h": {"LayerVertex": {"layerConf": {"layer": dense(3, 4, "h")}}},
+                   "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                       "nin": 4, "nout": 2, "activationFn": "softmax",
+                       "lossFunction": "MCXENT"}}}}}},
+               "vertexInputs": {"h": ["in"], "out": ["h"]}}
+        flat = np.zeros(3 * 4 + 4 + 4 * 2 + 2, np.float32)
+        p1 = str(tmp_path / "lin.zip")
+        with zipfile.ZipFile(p1, "w") as z:
+            z.writestr("configuration.json", json.dumps(lin))
+            z.writestr("coefficients.bin", nd4j_array_to_bytes(flat.reshape(1, -1)))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            restore_computation_graph(p1)
+        assert not any("tie-break" in str(x.message) for x in w)
+        # the PARALLEL-branch fixture graph does warn
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            restore_computation_graph(
+                os.path.join(FIXTURES, "dl4j_checkpoint_graph.zip"))
+        assert any("tie-break" in str(x.message) for x in w)
