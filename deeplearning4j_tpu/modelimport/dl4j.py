@@ -1015,7 +1015,6 @@ def restore_computation_graph(path: str, load_params: bool = True,
                     f"order {order} is not forced by dependencies; DL4J's "
                     "own sort may tie-break differently — verify restored "
                     "outputs against known activations", stacklevel=2)
-        if load_params and "coefficients.bin" in names:
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)
         if (load_params and load_updater and "updaterState.bin" in names):

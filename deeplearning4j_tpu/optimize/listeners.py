@@ -115,22 +115,68 @@ class TimeIterationListener(TrainingListener):
 
 
 class EvaluativeListener(TrainingListener):
-    """Periodic evaluation on a held-out iterator (EvaluativeListener.java:34)."""
+    """Periodic evaluation on a held-out iterator (EvaluativeListener.java:34).
+
+    By default runs classification :class:`Evaluation` via
+    ``model.evaluate``; pass ``evaluations`` — factories of custom
+    IEvaluation-style objects (EvaluationCalibration, ROC, …: anything with
+    ``eval(labels, predictions, mask=…)``) — for the reference's
+    ``evalWith(IEvaluation...)`` mode: each window builds fresh evaluators
+    and streams the held-out predictions through all of them.
+    """
 
     def __init__(self, iterator, frequency: int = 1, unit: str = "epoch",
-                 printer: Callable = None):
+                 printer: Callable = None, evaluations=None):
         if unit not in ("epoch", "iteration"):
             raise ValueError("unit must be 'epoch' or 'iteration'")
         self.iterator = iterator
         self.frequency = max(1, frequency)
         self.unit = unit
         self.printer = printer or (lambda s: log.info(s))
+        self.eval_factories = list(evaluations) if evaluations else None
         self.evaluations: List = []
 
     def _evaluate(self, model):
-        e = model.evaluate(self.iterator)
-        self.evaluations.append(e)
-        self.printer(f"Evaluation: accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+        if self.eval_factories is None:
+            e = model.evaluate(self.iterator)
+            self.evaluations.append(e)
+            self.printer(
+                f"Evaluation: accuracy={e.accuracy():.4f} f1={e.f1():.4f}")
+            return
+        import inspect
+
+        import numpy as np
+        evs = [f() for f in self.eval_factories]
+        # detect keyword support up front — catch-and-retry would double-
+        # accumulate evaluators that fail mid-eval
+        takes_mask = []
+        for e in evs:
+            try:
+                takes_mask.append(
+                    "mask" in inspect.signature(e.eval).parameters)
+            except (TypeError, ValueError):
+                takes_mask.append(False)
+        try:
+            out_params = inspect.signature(model.output).parameters
+        except (TypeError, ValueError):
+            out_params = {}
+        it = self.iterator
+        if hasattr(it, "reset"):
+            it.reset()
+        for ds in it:
+            kw = {}
+            if ds.features_mask is not None and "mask" in out_params:
+                kw["mask"] = ds.features_mask  # padded steps stay masked
+            preds = np.asarray(model.output(ds.features, **kw))
+            labels = np.asarray(ds.labels)
+            for e, tm in zip(evs, takes_mask):
+                if tm:
+                    e.eval(labels, preds, mask=ds.labels_mask)
+                else:
+                    e.eval(labels, preds)
+        self.evaluations.append(evs)  # always a list: stable element type
+        parts = [e.stats() if hasattr(e, "stats") else repr(e) for e in evs]
+        self.printer("Evaluation: " + "; ".join(parts))
 
     def iteration_done(self, model, iteration, epoch):
         if self.unit == "iteration" and iteration % self.frequency == 0:

@@ -216,6 +216,28 @@ class TestListeners:
         net.fit(ListDataSetIterator(DataSet(x, y), 8), epochs=3)
         assert len(ev.evaluations) == 3
 
+    def test_evaluative_listener_custom_evaluations(self, rng):
+        # evalWith(IEvaluation...) parity: stream held-out predictions
+        # through custom evaluators (calibration + ROCMultiClass here)
+        from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        net = small_net()
+        x = rng.normal(size=(24, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 24)]
+        printed = []
+        ev = EvaluativeListener(
+            ListDataSetIterator(DataSet(x, y), 24), frequency=1,
+            printer=printed.append,
+            evaluations=[lambda: EvaluationCalibration(histogram_bins=20),
+                         lambda: ROCMultiClass()])
+        net.set_listeners(ev)
+        net.fit(ListDataSetIterator(DataSet(x, y), 8), epochs=2)
+        assert len(ev.evaluations) == 2
+        cal, roc = ev.evaluations[-1]
+        assert 0.0 <= cal.expected_calibration_error() <= 1.0
+        assert cal.num_classes == 3
+        assert any("ECE" in p for p in printed)
+
 
 class TestModelSerializer:
     def test_mln_roundtrip(self, rng, tmp_path):
