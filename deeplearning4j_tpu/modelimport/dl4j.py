@@ -272,10 +272,64 @@ def _distribution(v):
         f"unknown DL4J distribution {v!r}")
 
 
+def _constraints(v, conv: bool = False):
+    """DL4J serialized per-layer ``constraints`` list → our LayerConstraint
+    chain (``BaseConstraint.java:18``: Jackson ``@class`` entries carrying
+    ``params``/``epsilon``/``dimensions`` + subclass fields). The four
+    reference classes map 1:1 onto ``nn/constraints.py``.
+
+    DL4J ``dimensions`` are reduction axes over DL4J's param layouts
+    ([nIn,nOut] dense, [out,in,kH,kW] conv); the canonical per-unit choices
+    ([1] for 2D, [1,2,3] for conv — ``MaxNormConstraint.java:33``) both
+    correspond to this framework's default (all-but-last over [n_in,n_out] /
+    HWIO). Non-canonical dimension sets import with a warning and the
+    default axes rather than silently dropping the constraint."""
+    if not isinstance(v, list) or not v:
+        return None
+    from deeplearning4j_tpu.nn import constraints as C
+
+    out = []
+    for entry in v:
+        if not isinstance(entry, dict):
+            continue
+        short = entry.get("@class", "").rsplit(".", 1)[-1]
+        dims = entry.get("dimensions")
+        canonical = [1, 2, 3] if conv else [1]
+        if dims is not None and list(dims) != canonical:
+            import warnings
+            warnings.warn(
+                f"DL4J constraint {short} has non-canonical dimensions "
+                f"{list(dims)}; importing with this framework's default "
+                "(per-output-unit) reduction axes", stacklevel=3)
+        names = tuple(entry.get("params") or ()) or None
+        common = dict(param_names=names, dimensions=None)
+        if short == "MaxNormConstraint":
+            out.append(C.MaxNormConstraint(
+                max_norm=float(entry.get("maxNorm", 1.0)), **common))
+        elif short == "MinMaxNormConstraint":
+            out.append(C.MinMaxNormConstraint(
+                min_norm=float(entry.get("min", 0.0)),
+                max_norm=float(entry.get("max", 1.0)),
+                rate=float(entry.get("rate", 1.0)), **common))
+        elif short == "UnitNormConstraint":
+            out.append(C.UnitNormConstraint(**common))
+        elif short == "NonNegativeConstraint":
+            out.append(C.NonNegativeConstraint(**common))
+        else:
+            import warnings
+            warnings.warn(
+                f"ignoring unsupported DL4J constraint {short!r} — the "
+                "imported model loses this train-time projection",
+                stacklevel=3)
+    return out or None
+
+
 # -- per-layer conversion ----------------------------------------------------
 
-def _base_kwargs(cfg: dict) -> dict:
-    """Fields shared by BaseLayer subclasses."""
+def _base_kwargs(cfg: dict, conv: bool = False) -> dict:
+    """Fields shared by BaseLayer subclasses. ``conv`` flags layers whose
+    weights are 4-D in DL4J ([out,in,kH,kW]) so the canonical constraint
+    ``dimensions`` are [1,2,3] rather than [1]."""
     kw: Dict[str, Any] = {}
     name = _get(cfg, "layerName", "layername")
     if name:
@@ -326,6 +380,9 @@ def _base_kwargs(cfg: dict) -> dict:
                 f"ignoring unsupported DL4J iDropout {cls!r} — training "
                 "regularization of the imported model is dropped",
                 stacklevel=2)
+    cons = _constraints(cfg.get("constraints"), conv=conv)
+    if cons:
+        kw["constraints"] = cons
     upd_v = _get(cfg, "iUpdater", "iupdater", "updater")
     upd = (_legacy_updater(cfg, upd_v) if isinstance(upd_v, str)
            else _updater(upd_v))
@@ -371,7 +428,9 @@ def convert_dl4j_layer(type_name: str, cfg: dict):
     from deeplearning4j_tpu.nn import layers as L
 
     t = type_name
-    base = _base_kwargs(cfg)
+    base = _base_kwargs(cfg, conv=t in ("convolution", "deconvolution2d",
+                                        "separableConvolution2d",
+                                        "depthwiseConvolution2d"))
     ff = _nin_nout(cfg)
 
     if t == "dense":
@@ -855,68 +914,68 @@ _UPDATER_STATE_SLOTS = {
 }
 
 
-def _updater_blocks(conf):
-    """DL4J ``UpdaterBlock`` boundaries over the flattened layout: trainable
-    params coalesce into contiguous blocks, SPLIT wherever a non-trainable
-    run (BatchNorm global mean/var, which DL4J pairs with a stateless NoOp
-    pseudo-updater) interrupts them. Yields lists of
-    ``(layer_key, name, dl4j_shape, order, convert)`` per block."""
-    import numpy as np
-
-    blocks, current = [], []
+def _updater_blocks(conf, updaters):
+    """DL4J ``UpdaterBlock`` boundaries over the flattened layout
+    (``BaseMultiLayerUpdater.java:92``): trainable params coalesce into
+    contiguous blocks, SPLIT wherever (a) a non-trainable run (BatchNorm
+    global mean/var, which DL4J pairs with a stateless NoOp pseudo-updater)
+    interrupts them, or (b) adjacent params' updater CONFIGS differ
+    (``UpdaterUtils.updaterConfigurationsEquals``: full equality incl. LR
+    and schedules — our frozen-dataclass ``==`` is exactly that test).
+    Yields ``(updater, [(layer_key, name, dl4j_shape, order, convert), …])``
+    per block. (DL4J additionally never coalesces pretrain params across
+    layers; no pretrain-param layer type is in the restore scope here.)"""
+    blocks, current, cur_u = [], [], None
     for i, layer in _layer_seq(conf):
         for name, dl4j_shape, order, convert, target in _dl4j_param_specs(layer):
             if target != "param":
                 if current:
-                    blocks.append(current)
-                    current = []
+                    blocks.append((cur_u, current))
+                    current, cur_u = [], None
                 continue
+            u = updaters[i][name]
+            if current and u != cur_u:
+                blocks.append((cur_u, current))
+                current = []
+            cur_u = u
             current.append((i, name, dl4j_shape, order, convert))
     if current:
-        blocks.append(current)
+        blocks.append((cur_u, current))
     return blocks
 
 
 def apply_updater_state(net, flat) -> bool:
     """Map a DL4J ``updaterState.bin`` vector onto the net's updater states.
 
-    Supported for a UNIFORM trainable-updater configuration (one updater
-    type across all trainable params). DL4J groups contiguous same-config
-    params into ``UpdaterBlock``s — BatchNorm global mean/var get a
-    stateless pseudo-updater, so each block's view is
-    ``[slot0(block), slot1(block), …]`` and blocks concatenate in flattened
-    order with the mean/var runs contributing nothing. Heterogeneous
-    updater configs return False (state left freshly initialized), since
-    those block boundaries cannot be recovered without the ND4J runtime.
-    """
+    DL4J groups contiguous same-config params into ``UpdaterBlock``s and the
+    state view is each block's ``[slot0(block), slot1(block), …]`` segment
+    concatenated in flattened param order (``BaseMultiLayerUpdater.java:55``,
+    per-updater slot layout e.g. ``AdamUpdater.setStateViewArray``).
+    Heterogeneous configs (per-layer learning rates, bias updaters) are
+    handled by splitting blocks at every config change, exactly as DL4J
+    does. Returns False (state left freshly initialized) only when some
+    updater class has no known slot layout."""
     import numpy as np
     import jax.numpy as jnp
 
-    umaps = (net._updaters.values() if isinstance(net._updaters, dict)
-             else net._updaters)
-    kinds = {type(u).__name__ for umap in umaps for u in umap.values()}
-    if len(kinds) != 1:
-        return False
-    kind = next(iter(kinds))
-    slots = _UPDATER_STATE_SLOTS.get(kind)
-    if slots is None:
-        return False
     flat = np.asarray(flat).reshape(-1)
-    if not slots:
+    blocks = _updater_blocks(net.conf, net._updaters)
+    if any(type(u).__name__ not in _UPDATER_STATE_SLOTS for u, _ in blocks):
+        return False
+    want = sum(len(_UPDATER_STATE_SLOTS[type(u).__name__])
+               * int(np.prod(shape))
+               for u, b in blocks for (_, _, shape, _, _) in b)
+    if want == 0:
         return flat.size == 0
-    blocks = _updater_blocks(net.conf)
-    want = len(slots) * sum(int(np.prod(shape))
-                            for b in blocks for (_, _, shape, _, _) in b)
     if flat.size != want:
         raise InvalidDl4jConfigurationException(
             f"updaterState.bin length {flat.size} != expected {want} "
-            f"({len(slots)} {kind} slots over the trainable params)")
+            "(per-block updater slots over the trainable params)")
     dtype = net.conf.global_conf.jnp_dtype()
     new_states = _copy_container(net.updater_states)
     pos = 0
-    for block in blocks:
-        block_n = sum(int(np.prod(shape)) for (_, _, shape, _, _) in block)
-        for slot in slots:
+    for u, block in blocks:
+        for slot in _UPDATER_STATE_SLOTS[type(u).__name__]:
             at = pos
             for i, name, dl4j_shape, order, convert in block:
                 n = int(np.prod(dl4j_shape))
@@ -926,6 +985,7 @@ def apply_updater_state(net, flat) -> bool:
                 new_states[i][name] = {**new_states[i][name],
                                        slot: jnp.asarray(convert(arr), dtype)}
             pos = at  # next slot (or next block) starts right after
+        # next block starts right after this block's last slot
     net.updater_states = new_states
     return True
 

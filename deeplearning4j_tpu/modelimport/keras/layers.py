@@ -289,7 +289,10 @@ def _one_constraint(spec, scope: str):
         return None
     cls = spec.get("class_name", "")
     c = spec.get("config", {})
-    ax = c.get("axis")
+    # keras.constraints' own default is axis=0, NOT this framework's
+    # all-but-last: for conv kernels (HWIO) those differ ((0,) vs (0,1,2)),
+    # so a config that omits the field must get Keras's default.
+    ax = c.get("axis", 0)
     dims = None if ax is None else tuple(ax) if isinstance(ax, (list, tuple)) \
         else (int(ax),)
     if cls in ("MaxNorm", "max_norm", "maxnorm"):

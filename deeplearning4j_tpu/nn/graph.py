@@ -234,12 +234,16 @@ class ComputationGraph:
             p_out = params[out_name]
             if (getattr(layer, "weight_noise", None) is not None and train
                     and rng is not None):
-                # output layers get weight noise too (MLN parity); fold_in on
-                # a large offset + output index keeps keys distinct from
-                # forward's splits (fold_in data must be non-negative uint32)
-                p_out = layer.weight_noise.apply(
-                    layer, p_out, jax.random.fold_in(rng, 1_000_003 + oi),
-                    train)
+                # output layers get weight noise too (MLN parity). Re-derive
+                # the SAME key the vertex loop used for this vertex so the
+                # loss sees the identical noised weights as any downstream
+                # consumer of the output vertex's activation — one noise
+                # sample per layer per step.
+                topo = self.conf.topo_order
+                vi = topo.index(out_name)
+                rng_v = jax.random.split(rng, max(1, len(topo)))[vi]
+                rng_wn = jax.random.split(rng_v)[0]
+                p_out = layer.weight_noise.apply(layer, p_out, rng_wn, train)
             loss = loss + layer.compute_loss(p_out, h, labels[oi], mask=lm)
         loss = loss + self._regularization(params)
         return loss, (new_states, new_carries)

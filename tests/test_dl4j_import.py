@@ -222,3 +222,94 @@ class TestGraphImport:
                 "networkInputs": ["in"], "networkOutputs": ["x"],
                 "vertices": {"x": {"WarpVertex": {}}},
                 "vertexInputs": {"x": ["in"]}}))
+
+
+class TestConstraintImport:
+    """Serialized per-layer ``constraints`` (BaseConstraint.java Jackson
+    entries) must import as real projection chains, not silently drop."""
+
+    def _conf(self, entries):
+        return import_dl4j_configuration(json.dumps({"confs": [
+            {"layer": {"dense": {
+                "nin": 4, "nout": 8, "activationFn": "relu",
+                "constraints": entries}}},
+            {"layer": {"output": {"nin": 8, "nout": 2,
+                                  "activationFn": "softmax"}}},
+        ]}))
+
+    def test_all_four_classes_map(self):
+        from deeplearning4j_tpu.nn.constraints import (
+            MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+            UnitNormConstraint)
+        pre = "org.deeplearning4j.nn.conf.constraint."
+        conf = self._conf([
+            {"@class": pre + "MaxNormConstraint", "maxNorm": 2.5,
+             "params": ["W"], "epsilon": 1e-6, "dimensions": [1]},
+            {"@class": pre + "MinMaxNormConstraint", "min": 0.1, "max": 3.0,
+             "rate": 0.5, "params": ["W"], "dimensions": [1]},
+            {"@class": pre + "UnitNormConstraint", "params": ["W"],
+             "dimensions": [1]},
+            {"@class": pre + "NonNegativeConstraint", "params": ["b"]},
+        ])
+        cs = conf.layers[0].constraints
+        assert isinstance(cs[0], MaxNormConstraint)
+        assert cs[0].max_norm == pytest.approx(2.5)
+        assert cs[0].param_names == ("W",)
+        assert isinstance(cs[1], MinMaxNormConstraint)
+        assert cs[1].min_norm == pytest.approx(0.1)
+        assert cs[1].rate == pytest.approx(0.5)
+        assert isinstance(cs[2], UnitNormConstraint)
+        assert isinstance(cs[3], NonNegativeConstraint)
+        assert cs[3].param_names == ("b",)
+
+    def test_constrained_import_trains_and_projects(self):
+        import jax.numpy as jnp
+        pre = "org.deeplearning4j.nn.conf.constraint."
+        conf = self._conf([{"@class": pre + "MaxNormConstraint",
+                            "maxNorm": 0.5, "params": ["W"],
+                            "dimensions": [1]}])
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        net.fit(x, y, epochs=3)
+        norms = jnp.linalg.norm(net.params[0]["W"], axis=tuple(
+            range(net.params[0]["W"].ndim - 1)))
+        assert float(norms.max()) <= 0.5 + 1e-4
+
+    def test_unknown_constraint_warns(self):
+        import warnings
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._conf([{"@class": "com.example.WeirdConstraint"}])
+        assert any("WeirdConstraint" in str(x.message) for x in w)
+
+    def test_noncanonical_dimensions_warn(self):
+        import warnings
+        pre = "org.deeplearning4j.nn.conf.constraint."
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            self._conf([{"@class": pre + "MaxNormConstraint", "maxNorm": 1.0,
+                         "params": ["W"], "dimensions": [0]}])
+        assert any("non-canonical" in str(x.message) for x in w)
+
+    def test_conv_canonical_dims_are_123(self):
+        import warnings
+        pre = "org.deeplearning4j.nn.conf.constraint."
+        conv_conf = lambda dims: json.dumps({"confs": [
+            {"layer": {"convolution": {
+                "nin": 1, "nout": 4, "kernelSize": [3, 3],
+                "stride": [1, 1], "activationFn": "relu",
+                "constraints": [{"@class": pre + "MaxNormConstraint",
+                                 "maxNorm": 1.0, "params": ["W"],
+                                 "dimensions": dims}]}}},
+            {"layer": {"output": {"nout": 2, "activationFn": "softmax"}}},
+        ]})
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            import_dl4j_configuration(conv_conf([1, 2, 3]))
+        assert not any("non-canonical" in str(x.message) for x in w)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            import_dl4j_configuration(conv_conf([1]))  # dense-style dims on conv
+        assert any("non-canonical" in str(x.message) for x in w)

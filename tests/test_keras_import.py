@@ -672,3 +672,21 @@ class TestNoiseLayersAndConstraints:
         w = np.asarray(net.params[0]["W"])
         assert (np.sqrt((w ** 2).sum(axis=0)) <= 1.5 + 1e-3).all()
         assert (np.asarray(net.params[0]["b"]) >= 0).all()
+
+
+class TestConstraintAxisDefault:
+    def test_omitted_axis_means_keras_default_zero(self):
+        # keras.constraints' default is axis=0; a hand-written/older config
+        # that omits the field must NOT get this framework's all-but-last
+        # default (different projection for HWIO conv kernels).
+        from deeplearning4j_tpu.modelimport.keras.layers import _one_constraint
+        c = _one_constraint({"class_name": "MaxNorm",
+                             "config": {"max_value": 2.0}}, "weights")
+        assert c.dimensions == (0,)
+
+    def test_explicit_axis_passes_through(self):
+        from deeplearning4j_tpu.modelimport.keras.layers import _one_constraint
+        c = _one_constraint({"class_name": "MaxNorm",
+                             "config": {"max_value": 2.0, "axis": [0, 1, 2]}},
+                            "weights")
+        assert c.dimensions == (0, 1, 2)

@@ -385,3 +385,81 @@ class TestGravesBidirectionalIngestion:
             restore_computation_graph(
                 os.path.join(FIXTURES, "dl4j_checkpoint_graph.zip"))
         assert any("tie-break" in str(x.message) for x in w)
+
+
+class TestUpdaterBlockBoundaries:
+    """apply_updater_state must split UpdaterBlock boundaries on FULL
+    config equality (UpdaterUtils.updaterConfigurationsEquals /
+    BaseMultiLayerUpdater.java:92): per-layer learning rates and bias
+    updaters change the state layout from [m(all), v(all)] to per-block
+    [m(block), v(block)] segments — mapping must follow the blocks."""
+
+    def _net(self, lr0=None, bias_updater=None):
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+        b = NeuralNetConfiguration.builder().seed(0).updater(Adam(0.01))
+        if bias_updater is not None:
+            b = b.bias_updater(bias_updater)
+        conf = (b.list()
+                .layer(DenseLayer(n_in=3, n_out=4, activation="relu",
+                                  updater=None if lr0 is None else Adam(lr0)))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def _flat(self, net):
+        n = sum(int(np.prod(v.shape)) for p in net.params for v in p.values())
+        return np.arange(2 * n, dtype=np.float32)  # Adam: m + v per block
+
+    def test_uniform_config_single_block(self):
+        from deeplearning4j_tpu.modelimport.dl4j import apply_updater_state
+        net = self._net()
+        assert apply_updater_state(net, self._flat(net)) is True
+        # one block over all 26 params: m = flat[0:26], v = flat[26:52]
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[0]["b"]["m"]),
+            np.arange(12, 16, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[1]["b"]["v"]),
+            np.arange(50, 52, dtype=np.float32))
+
+    def test_per_layer_lr_splits_blocks(self):
+        from deeplearning4j_tpu.modelimport.dl4j import apply_updater_state
+        net = self._net(lr0=0.02)
+        assert apply_updater_state(net, self._flat(net)) is True
+        # blocks: [L0 W+b] (16 params) then [L1 W+b] (10 params)
+        # block0: m=flat[0:16] (b=12..16), v=flat[16:32]
+        # block1: m=flat[32:42] (b=40..42), v=flat[42:52] (b=50..52)
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[0]["b"]["m"]),
+            np.arange(12, 16, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[0]["b"]["v"]),
+            np.arange(28, 32, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[1]["b"]["m"]),
+            np.arange(40, 42, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[1]["b"]["v"]),
+            np.arange(50, 52, dtype=np.float32))
+
+    def test_bias_updater_splits_every_param(self):
+        from deeplearning4j_tpu.modelimport.dl4j import apply_updater_state
+        from deeplearning4j_tpu.nn.updaters import Adam
+        net = self._net(bias_updater=Adam(0.005))
+        assert apply_updater_state(net, self._flat(net)) is True
+        # blocks: [L0 W](24 state), [L0 b](8), [L1 W](16), [L1 b](4)
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[0]["b"]["m"]),
+            np.arange(24, 28, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[0]["b"]["v"]),
+            np.arange(28, 32, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[1]["b"]["m"]),
+            np.arange(48, 50, dtype=np.float32))
+        np.testing.assert_allclose(
+            np.asarray(net.updater_states[1]["b"]["v"]),
+            np.arange(50, 52, dtype=np.float32))
