@@ -72,7 +72,12 @@ from deeplearning4j_tpu.nn.layers.vae_distributions import (  # noqa: F401
     LossFunctionWrapper,
     ReconstructionDistribution,
 )
-from deeplearning4j_tpu.nn.layers.objdetect import Yolo2OutputLayer  # noqa: F401
+from deeplearning4j_tpu.nn.layers.objdetect import (  # noqa: F401
+    DetectedObject,
+    Yolo2OutputLayer,
+    get_predicted_objects,
+    nms,
+)
 from deeplearning4j_tpu.nn.layers.moe import MixtureOfExpertsLayer  # noqa: F401
 from deeplearning4j_tpu.nn.layers.wrappers import FrozenLayer, TimeDistributedWrapper  # noqa: F401
 from deeplearning4j_tpu.nn.layers.samediff import SameDiffLayer, SameDiffLambdaLayer  # noqa: F401

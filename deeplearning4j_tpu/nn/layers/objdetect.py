@@ -16,13 +16,138 @@ falls in the cell), one-hot class.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+import functools
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@dataclasses.dataclass
+class DetectedObject:
+    """One detected object (``objdetect/DetectedObject.java:17``).
+
+    Dimensions are GRID CELL units, like the reference: with 416x416 input
+    and 32x downsampling there are 13x13 cells, so ``center_x`` 5.5 means
+    5.5*32 = 176 pixels from the left."""
+
+    example: int
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+    class_predictions: np.ndarray
+    confidence: float
+
+    @property
+    def predicted_class(self) -> int:
+        """Index of the max-probability class (``getPredictedClass``)."""
+        return int(np.argmax(np.ravel(self.class_predictions)))
+
+    def top_left_xy(self) -> Tuple[float, float]:
+        return (self.center_x - self.width / 2.0,
+                self.center_y - self.height / 2.0)
+
+    def bottom_right_xy(self) -> Tuple[float, float]:
+        return (self.center_x + self.width / 2.0,
+                self.center_y + self.height / 2.0)
+
+
+def iou(o1: DetectedObject, o2: DetectedObject) -> float:
+    """Intersection over union of two detections (``YoloUtils.java:86``)."""
+    x1min, y1min = o1.top_left_xy()
+    x1max, y1max = o1.bottom_right_xy()
+    x2min, y2min = o2.top_left_xy()
+    x2max, y2max = o2.bottom_right_xy()
+    iw = max(min(x1max, x2max) - max(x1min, x2min), 0.0)
+    ih = max(min(y1max, y2max) - max(y1min, y2min), 0.0)
+    inter = iw * ih
+    union = o1.width * o1.height + o2.width * o2.height - inter
+    return 0.0 if union <= 0 else inter / union
+
+
+def nms(objects: List[DetectedObject], iou_threshold: float
+        ) -> List[DetectedObject]:
+    """Non-max suppression with the reference's exact semantics
+    (``YoloUtils.nms:105``): drop any detection for which a SAME-CLASS
+    detection with strictly higher confidence overlaps above the IOU
+    threshold. Mutates ``objects`` in place (reference parity) and also
+    returns it."""
+    keep = list(objects)
+    for i, o1 in enumerate(keep):
+        if o1 is None:
+            continue
+        for o2 in keep:
+            if (o2 is not None and o1 is not o2
+                    and o1.predicted_class == o2.predicted_class
+                    and o1.confidence < o2.confidence
+                    and iou(o1, o2) > iou_threshold):
+                keep[i] = None
+                break
+    objects[:] = [o for o in keep if o is not None]
+    return objects
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _decode_detections(output, n_boxes: int, n_classes: int, anchors=None):
+    """Device-side decode of RAW Yolo2 output [N,H,W,B*(5+C)] → absolute
+    grid-unit boxes + confidences + class probabilities, one fused XLA
+    call for the whole batch (the compute half of
+    ``YoloUtils.activate:25`` + ``getPredictedObjects:145``)."""
+    n, h, w, _ = output.shape
+    x = output.reshape(n, h, w, n_boxes, 5 + n_classes).astype(jnp.float32)
+    txy = jax.nn.sigmoid(x[..., 0:2])
+    cx = txy[..., 0] + jnp.arange(w)[None, None, :, None]
+    cy = txy[..., 1] + jnp.arange(h)[None, :, None, None]
+    wh = anchors * jnp.exp(x[..., 2:4])
+    conf = jax.nn.sigmoid(x[..., 4])
+    probs = jax.nn.softmax(x[..., 5:], axis=-1)
+    return cx, cy, wh, conf, probs
+
+
+def get_predicted_objects(boxes, network_output, conf_threshold: float,
+                          nms_threshold: float = 0.0,
+                          n_classes: Optional[int] = None
+                          ) -> List[DetectedObject]:
+    """``YoloUtils.getPredictedObjects:144``: RAW network output →
+    thresholded, (optionally) NMS-filtered detections.
+
+    TPU-first split: sigmoid/exp/softmax decoding runs as ONE jitted call
+    on device for the whole minibatch; only the (few) above-threshold
+    candidates come to the host for object construction + NMS.
+
+    ``network_output`` is the layer's raw NHWC activations
+    [N, H, W, B*(5+C)] (this framework's Yolo2OutputLayer forward is
+    identity, so network ``output()`` == raw scores; the reference's
+    separate ``activate`` step is fused into the decode here)."""
+    if not 0.0 <= conf_threshold <= 1.0:
+        raise ValueError(
+            f"Invalid confidence threshold: must be in [0,1], got {conf_threshold}")
+    if getattr(network_output, "ndim", None) != 4:
+        raise ValueError(
+            "Invalid network output activations array: should be rank 4. "
+            f"Got shape {getattr(network_output, 'shape', None)}")
+    anchors = jnp.asarray(boxes, jnp.float32)
+    b = anchors.shape[0]
+    if n_classes is None:
+        n_classes = network_output.shape[-1] // b - 5
+    cx, cy, wh, conf, probs = _decode_detections(
+        jnp.asarray(network_output), b, int(n_classes), anchors)
+    cx, cy, wh, conf, probs = (np.asarray(a)
+                               for a in (cx, cy, wh, conf, probs))
+    out: List[DetectedObject] = []
+    for i, yy, xx, bb in zip(*np.nonzero(conf >= conf_threshold)):
+        out.append(DetectedObject(
+            int(i), float(cx[i, yy, xx, bb]), float(cy[i, yy, xx, bb]),
+            float(wh[i, yy, xx, bb, 0]), float(wh[i, yy, xx, bb, 1]),
+            probs[i, yy, xx, bb].copy(), float(conf[i, yy, xx, bb])))
+    if nms_threshold > 0:
+        nms(out, nms_threshold)
+    return out
 
 
 @register_layer
@@ -79,6 +204,40 @@ class Yolo2OutputLayer(Layer):
 
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         return x, state or {}
+
+    # ------------------------------------------------- detection extraction
+    def get_predicted_objects(self, network_output, conf_threshold: float,
+                              nms_threshold: float = 0.0
+                              ) -> "List[DetectedObject]":
+        """Detections from raw network output
+        (``nn/layers/objdetect/Yolo2OutputLayer.java:575`` — which passes
+        ``nmsThreshold=0.0``; expose it as an argument here)."""
+        return get_predicted_objects(self.boxes, network_output,
+                                     conf_threshold, nms_threshold,
+                                     n_classes=self.n_classes)
+
+    def get_confidence_matrix(self, network_output, example: int,
+                              bb_number: int):
+        """Decoded confidence for all H/W positions of one anchor box
+        (``Yolo2OutputLayer.java:588``), shape [H, W]."""
+        anchors = jnp.asarray(self.boxes, jnp.float32)
+        _, _, _, conf, _ = _decode_detections(
+            jnp.asarray(network_output), anchors.shape[0],
+            int(self.n_classes), anchors)
+        return conf[example, :, :, bb_number]
+
+    def get_probability_matrix(self, network_output, example: int,
+                               class_number: int):
+        """Decoded softmax probability of one class for each cell and
+        anchor, shape [H, W, B] (``Yolo2OutputLayer.java:604`` — the
+        reference returns one class plane; here each anchor carries its own
+        softmax, consistently with ``YoloUtils.getPredictedObjects``'s
+        B*(5+C) layout, so the anchor axis is kept)."""
+        anchors = jnp.asarray(self.boxes, jnp.float32)
+        _, _, _, _, probs = _decode_detections(
+            jnp.asarray(network_output), anchors.shape[0],
+            int(self.n_classes), anchors)
+        return probs[example, :, :, :, class_number]
 
     def compute_loss(self, params, x, labels, mask=None, conf_target=None):
         """YOLO2 loss. ``conf_target`` (default: ``stop_gradient(iou)``, the
