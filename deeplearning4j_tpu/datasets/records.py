@@ -12,6 +12,7 @@ variable-length sequences become padding + mask, never ragged arrays).
 
 from __future__ import annotations
 
+import dataclasses
 import glob as _glob
 import os
 from typing import Iterable, List, Optional, Sequence, Union
@@ -23,12 +24,29 @@ from deeplearning4j_tpu.datasets.dataset import DataSet, DataSetIterator, MultiD
 Record = List  # a record is a list of values (DataVec "Writable"s)
 
 
+@dataclasses.dataclass(frozen=True)
+class RecordMetaData:
+    """Provenance of one record (DataVec ``RecordMetaDataLine`` /
+    ``RecordMetaDataIndex``): where it came from, so an evaluation error can
+    be traced back to — and the original record reloaded from — its source.
+    """
+
+    index: int                      # position within the reader
+    uri: Optional[str] = None       # source file, when file-backed
+    reader_class: str = ""
+
+    def get_location(self) -> str:
+        base = self.uri or "<memory>"
+        return f"{base}:{self.index}"
+
+
 # --------------------------------------------------------------------------
 # record readers
 # --------------------------------------------------------------------------
 class RecordReader:
     """SPI: iterate records (lists of values). Mirrors DataVec's RecordReader
-    as used by the bridge iterators."""
+    as used by the bridge iterators, including the metadata face
+    (``nextRecord()`` → Record-with-meta, ``loadFromMetaData``)."""
 
     def has_next(self) -> bool:
         raise NotImplementedError
@@ -38,6 +56,31 @@ class RecordReader:
 
     def reset(self) -> None:
         raise NotImplementedError
+
+    # ------------------------------------------------------------- metadata
+    def _meta_uri(self) -> Optional[str]:
+        paths = getattr(self, "_paths", None)
+        return paths[0] if paths else None
+
+    def next_record_with_meta(self):
+        """(record, RecordMetaData) — DataVec ``RecordReader.nextRecord()``.
+        The index is the reader-global record position (multi-file readers
+        concatenate; the uri is the first source path)."""
+        idx = int(getattr(self, "_pos", -1))
+        return self.next_record(), RecordMetaData(
+            index=idx, uri=self._meta_uri(), reader_class=type(self).__name__)
+
+    def _record_at(self, index: int) -> Record:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support loadFromMetaData")
+
+    def load_from_meta_data(self, metas) -> List[Record]:
+        """Reload the original records for the given metadata
+        (DataVec ``RecordReader.loadFromMetaData``) — the error-drilldown
+        path: Evaluation.get_prediction_errors() → back to source records."""
+        if isinstance(metas, RecordMetaData):
+            metas = [metas]
+        return [self._record_at(m.index) for m in metas]
 
     def __iter__(self):
         self.reset()
@@ -63,6 +106,9 @@ class CollectionRecordReader(RecordReader):
         self._pos += 1
         return list(r)
 
+    def _record_at(self, index):
+        return list(self._records[index])
+
 
 class LineRecordReader(RecordReader):
     """One record per line: ``[line]``. Files are read once at construction;
@@ -86,6 +132,9 @@ class LineRecordReader(RecordReader):
         r = [self._lines[self._pos]]
         self._pos += 1
         return r
+
+    def _record_at(self, index):
+        return [self._lines[index]]
 
 
 class CSVRecordReader(RecordReader):
@@ -120,6 +169,9 @@ class CSVRecordReader(RecordReader):
         r = self._records[self._pos]
         self._pos += 1
         return list(r)
+
+    def _record_at(self, index):
+        return list(self._records[index])
 
 
 class SequenceRecordReader:
@@ -225,7 +277,8 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __init__(self, record_reader: RecordReader, batch_size: int,
                  label_index: int = -1, num_possible_labels: int = -1,
                  label_index_to: int = -1, regression: bool = False,
-                 max_num_batches: int = -1, preprocessor=None):
+                 max_num_batches: int = -1, preprocessor=None,
+                 collect_meta_data: bool = False):
         self.reader = record_reader
         self.batch_size = batch_size
         self.label_index = label_index
@@ -234,6 +287,9 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.regression = regression
         self.max_num_batches = max_num_batches
         self.preprocessor = preprocessor
+        # setCollectMetaData(true) parity: emitted DataSets carry per-example
+        # RecordMetaData, the source Evaluation's error drilldown reads
+        self.collect_meta_data = collect_meta_data
         if regression and label_index >= 0 and num_possible_labels > 0:
             raise ValueError("regression=True is incompatible with "
                              "num_possible_labels (one-hot classification)")
@@ -262,25 +318,41 @@ class RecordReaderDataSetIterator(DataSetIterator):
     def __iter__(self):
         self.reset()
         batches = 0
-        feats, labels = [], []
-        for rec in self.reader:
+        feats, labels, metas = [], [], []
+        while self.reader.has_next():
+            if self.collect_meta_data:
+                rec, meta = self.reader.next_record_with_meta()
+                metas.append(meta)
+            else:
+                rec = self.reader.next_record()
             f, l = self._split(rec)
             feats.append(f)
             labels.append(l)
             if len(feats) == self.batch_size:
-                yield self._emit(feats, labels)
-                feats, labels = [], []
+                yield self._emit(feats, labels, metas)
+                feats, labels, metas = [], [], []
                 batches += 1
                 if 0 < self.max_num_batches <= batches:
                     return
         if feats:
-            yield self._emit(feats, labels)
+            yield self._emit(feats, labels, metas)
 
-    def _emit(self, feats, labels):
-        ds = DataSet(np.stack(feats), np.stack(labels))
+    def _emit(self, feats, labels, metas=()):
+        ds = DataSet(np.stack(feats), np.stack(labels),
+                     example_meta_data=list(metas) or None)
         if self.preprocessor is not None:
             self.preprocessor.preprocess(ds)
         return ds
+
+    def load_from_meta_data(self, metas) -> DataSet:
+        """Rebuild a DataSet from recorded metadata
+        (``RecordReaderDataSetIterator.loadFromMetaData``) — fetches the
+        original records and re-applies the feature/label split."""
+        if isinstance(metas, RecordMetaData):
+            metas = [metas]
+        recs = self.reader.load_from_meta_data(metas)
+        feats, labels = zip(*(self._split(r) for r in recs))
+        return self._emit(list(feats), list(labels), metas)
 
 
 class AlignmentMode:

@@ -1,4 +1,4 @@
-from deeplearning4j_tpu.eval.evaluation import Evaluation  # noqa: F401
+from deeplearning4j_tpu.eval.evaluation import Evaluation, Prediction  # noqa: F401
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation  # noqa: F401
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass  # noqa: F401
 from deeplearning4j_tpu.eval.binary import EvaluationBinary  # noqa: F401

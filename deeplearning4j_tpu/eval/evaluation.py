@@ -4,39 +4,79 @@ Reference: ``deeplearning4j-nn/.../eval/Evaluation.java:72``. Metrics follow
 DL4J conventions: macro-averaged precision/recall/F1 over classes that have
 at least one true/predicted instance; per-timestep rnn output is flattened
 with the label mask applied.
+
+Depth features beyond the basics:
+- **top-N accuracy** (``Evaluation.java:144`` constructor, counting at
+  ``:437-455``): an example is top-N correct when fewer than N other class
+  probabilities are strictly greater than the true class's probability.
+- **prediction recording with metadata** (``Evaluation.java:1481``
+  ``addToMetaConfusionMatrix``, ``:1506`` ``getPredictionErrors``): pass
+  ``record_meta_data`` (e.g. from a ``RecordReaderDataSetIterator`` with
+  ``collect_meta_data=True``) to ``eval`` and drill into per-record errors
+  afterwards.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
-from typing import Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 
+@dataclasses.dataclass
+class Prediction:
+    """One recorded prediction (``eval/meta/Prediction.java``)."""
+
+    actual: int
+    predicted: int
+    record_meta_data: Any
+
+    def get_record_meta_data(self):
+        return self.record_meta_data
+
+
 class Evaluation:
-    def __init__(self, num_classes: Optional[int] = None, labels_list=None):
+    def __init__(self, num_classes: Optional[int] = None, labels_list=None,
+                 top_n: int = 1):
         self.num_classes = num_classes
         self.labels_list = labels_list
         self.confusion: Optional[np.ndarray] = None  # [true, predicted]
+        self.top_n = max(int(top_n), 1)
+        self.top_n_correct_count = 0
+        self.top_n_total_count = 0
+        # (actual, predicted) → list of metadata; None until metadata seen
+        self.confusion_meta: Optional[
+            Dict[Tuple[int, int], List[Any]]] = None
 
     # ----------------------------------------------------------------- eval
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
-             mask: Optional[np.ndarray] = None) -> None:
+             mask: Optional[np.ndarray] = None,
+             record_meta_data: Optional[List[Any]] = None) -> None:
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:  # [N,T,C] → flatten time, applying mask
             n, t, c = labels.shape
             labels = labels.reshape(n * t, c)
             predictions = predictions.reshape(n * t, -1)
+            if record_meta_data is not None:
+                record_meta_data = [m for m in record_meta_data
+                                    for _ in range(t)]
             if mask is not None:
                 m = np.asarray(mask).reshape(n * t).astype(bool)
                 labels = labels[m]
                 predictions = predictions[m]
+                if record_meta_data is not None:
+                    record_meta_data = [x for x, keep
+                                        in zip(record_meta_data, m) if keep]
         elif mask is not None:
             m = np.asarray(mask).astype(bool).ravel()
             labels = labels[m]
             predictions = predictions[m]
+            if record_meta_data is not None:
+                record_meta_data = [x for x, keep
+                                    in zip(record_meta_data, m) if keep]
 
         if labels.ndim == 2 and labels.shape[1] > 1:
             true_idx = np.argmax(labels, axis=1)
@@ -65,6 +105,27 @@ class Evaluation:
             self.confusion = np.zeros((self.num_classes, self.num_classes), np.int64)
         np.add.at(self.confusion, (true_idx, pred_idx), 1)
 
+        # top-N accuracy (Evaluation.java:437: top-N correct when fewer
+        # than N probabilities are STRICTLY greater than the true class's)
+        if (self.top_n > 1 and predictions.ndim == 2
+                and predictions.shape[1] > 1):
+            true_prob = predictions[np.arange(len(true_idx)), true_idx]
+            greater = (predictions > true_prob[:, None]).sum(axis=1)
+            self.top_n_correct_count += int((greater < self.top_n).sum())
+            self.top_n_total_count += len(true_idx)
+
+        # per-record metadata → meta confusion matrix
+        # (Evaluation.java:1481 addToMetaConfusionMatrix)
+        if record_meta_data is not None:
+            if len(record_meta_data) != len(true_idx):
+                raise ValueError(
+                    f"record_meta_data length {len(record_meta_data)} != "
+                    f"number of (unmasked) examples {len(true_idx)}")
+            if self.confusion_meta is None:
+                self.confusion_meta = {}
+            for a, p, m in zip(true_idx, pred_idx, record_meta_data):
+                self.confusion_meta.setdefault((int(a), int(p)), []).append(m)
+
     def eval_time_series(self, labels, predictions, labels_mask=None):
         self.eval(labels, predictions, mask=labels_mask)
 
@@ -77,6 +138,16 @@ class Evaluation:
         self._check()
         total = self.confusion.sum()
         return float(np.trace(self.confusion)) / max(total, 1)
+
+    def top_n_accuracy(self) -> float:
+        """``Evaluation.java:1159``: fraction of examples whose true class
+        probability is among the N highest. Equals ``accuracy()`` when
+        ``top_n == 1``."""
+        if self.top_n <= 1:
+            return self.accuracy()
+        if self.top_n_total_count == 0:
+            return 0.0
+        return self.top_n_correct_count / self.top_n_total_count
 
     def _tp(self, i) -> int:
         return int(self.confusion[i, i])
@@ -131,13 +202,71 @@ class Evaluation:
         self._check()
         return self.confusion.copy()
 
+    # -------------------------------------------- prediction introspection
+    def get_prediction_errors(self) -> Optional[List[Prediction]]:
+        """Per-record misclassifications (``Evaluation.java:1506``), sorted
+        by (actual, predicted). Only available when ``eval`` was called with
+        ``record_meta_data``; returns None otherwise (reference contract)."""
+        if self.confusion_meta is None:
+            return None
+        out: List[Prediction] = []
+        for (a, p) in sorted(self.confusion_meta):
+            if a == p:
+                continue
+            out.extend(Prediction(a, p, m) for m in self.confusion_meta[(a, p)])
+        return out
+
+    def get_predictions_by_actual_class(self, actual_class: int
+                                        ) -> Optional[List[Prediction]]:
+        """All recorded predictions whose TRUE class is ``actual_class``
+        (``Evaluation.java:1554``)."""
+        if self.confusion_meta is None:
+            return None
+        return [Prediction(a, p, m)
+                for (a, p), ms in self.confusion_meta.items()
+                if a == actual_class for m in ms]
+
+    def get_prediction_by_predicted_class(self, predicted_class: int
+                                          ) -> Optional[List[Prediction]]:
+        """All recorded predictions whose PREDICTED class is
+        ``predicted_class`` (``Evaluation.java:1583``)."""
+        if self.confusion_meta is None:
+            return None
+        return [Prediction(a, p, m)
+                for (a, p), ms in self.confusion_meta.items()
+                if p == predicted_class for m in ms]
+
+    def get_predictions(self, actual_class: int, predicted_class: int
+                        ) -> Optional[List[Prediction]]:
+        """Recorded predictions for one confusion-matrix cell
+        (``Evaluation.java:1610``)."""
+        if self.confusion_meta is None:
+            return None
+        return [Prediction(actual_class, predicted_class, m)
+                for m in self.confusion_meta.get(
+                    (actual_class, predicted_class), [])]
+
     def merge(self, other: "Evaluation") -> "Evaluation":
         if other.confusion is not None:
             if self.confusion is None:
                 self.num_classes = other.num_classes
                 self.confusion = other.confusion.copy()
             else:
-                self.confusion += other.confusion
+                if other.confusion.shape[0] > self.confusion.shape[0]:
+                    grown = np.zeros_like(other.confusion)
+                    grown[:self.confusion.shape[0],
+                          :self.confusion.shape[1]] = self.confusion
+                    self.confusion = grown
+                    self.num_classes = other.num_classes
+                self.confusion[:other.confusion.shape[0],
+                               :other.confusion.shape[1]] += other.confusion
+        self.top_n_correct_count += other.top_n_correct_count
+        self.top_n_total_count += other.top_n_total_count
+        if other.confusion_meta is not None:
+            if self.confusion_meta is None:
+                self.confusion_meta = {}
+            for k, ms in other.confusion_meta.items():
+                self.confusion_meta.setdefault(k, []).extend(ms)
         return self
 
     # ---------------------------------------------------------------- serde
@@ -145,14 +274,19 @@ class Evaluation:
         return json.dumps({
             "num_classes": self.num_classes,
             "confusion": None if self.confusion is None else self.confusion.tolist(),
+            "top_n": self.top_n,
+            "top_n_correct_count": self.top_n_correct_count,
+            "top_n_total_count": self.top_n_total_count,
         })
 
     @staticmethod
     def from_json(s: str) -> "Evaluation":
         d = json.loads(s)
-        e = Evaluation(num_classes=d["num_classes"])
+        e = Evaluation(num_classes=d["num_classes"], top_n=d.get("top_n", 1))
         if d["confusion"] is not None:
             e.confusion = np.asarray(d["confusion"], np.int64)
+        e.top_n_correct_count = d.get("top_n_correct_count", 0)
+        e.top_n_total_count = d.get("top_n_total_count", 0)
         return e
 
     def stats(self) -> str:
@@ -161,6 +295,11 @@ class Evaluation:
             "========================Evaluation Metrics========================",
             f" # of classes:    {self.num_classes}",
             f" Accuracy:        {self.accuracy():.4f}",
+        ]
+        if self.top_n > 1 and self.top_n_total_count > 0:
+            lines.append(
+                f" Top {self.top_n} Accuracy:  {self.top_n_accuracy():.4f}")
+        lines += [
             f" Precision:       {self.precision():.4f}",
             f" Recall:          {self.recall():.4f}",
             f" F1 Score:        {self.f1():.4f}",

@@ -1,14 +1,32 @@
 """ROC / AUC evaluation (DL4J ``eval/ROC.java``, ``ROCBinary``, ``ROCMultiClass``).
 
-Exact (threshold-free) AUROC/AUPRC via sorting, equivalent to DL4J's
-``thresholdSteps=0`` exact mode.
+Two modes, matching ``ROC.java:61-85``:
+
+- ``threshold_steps=0`` (default): EXACT mode — scores are retained and
+  AUROC/AUPRC computed by sorting (threshold-free).
+- ``threshold_steps=N > 0``: BINNED mode — fixed thresholds ``i/N`` for
+  ``i in 0..N``; only (TP, FP) counts per threshold plus the actual
+  positive/negative totals are kept. This is the mode built for batched /
+  distributed evaluation: state is O(N) regardless of dataset size and
+  ``merge`` is count addition, so shards evaluate independently and merge
+  without ever holding the score set in one host's memory. (Reference
+  caveat applies: with very skewed score distributions the thresholded
+  approach can underestimate the true area.)
+
+Counting semantics match the reference's CompareAndSet pair
+(``ROC.java:268-280``): predicted-positive at threshold t iff score >= t,
+except at t == 1.0 where nothing is predicted positive — giving the curve
+its (0,0) endpoint.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import json
+from typing import Optional, Tuple
 
 import numpy as np
+
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz
 
 
 def _auc_roc(labels: np.ndarray, scores: np.ndarray) -> float:
@@ -57,9 +75,38 @@ def _auc_pr(labels: np.ndarray, scores: np.ndarray) -> float:
 class ROC:
     """Binary ROC: labels [N] or [N,2] (prob of class 1 scored)."""
 
-    def __init__(self):
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self.is_exact = self.threshold_steps <= 0
         self.labels = []
         self.scores = []
+        if not self.is_exact:
+            n = self.threshold_steps
+            self.thresholds = np.arange(n + 1, dtype=np.float64) / n
+            self.tp_counts = np.zeros(n + 1, np.int64)
+            self.fp_counts = np.zeros(n + 1, np.int64)
+            self.count_actual_positive = 0
+            self.count_actual_negative = 0
+
+    def _eval_binned(self, labels: np.ndarray, scores: np.ndarray) -> None:
+        pos = labels > 0.5
+        self.count_actual_positive += int(pos.sum())
+        self.count_actual_negative += int((~pos).sum())
+        n = self.threshold_steps
+        # O(N + steps): histogram scores into [i/n, (i+1)/n) bins, then
+        # #(score >= i/n) is a reverse cumulative sum — score == i/n lands
+        # in bin i, so the >= boundary semantics are exact.
+        # +1e-9: keep a score EXACTLY at a threshold on the >= side despite
+        # float rounding in scores * n (e.g. 0.3 * 10 == 2.9999999999999996)
+        bins = np.clip(np.floor(scores * n + 1e-9).astype(np.int64), 0, n)
+        pos_hist = np.bincount(bins[pos], minlength=n + 1)
+        neg_hist = np.bincount(bins[~pos], minlength=n + 1)
+        at_least = lambda h: np.cumsum(h[::-1])[::-1]
+        tp, fp = at_least(pos_hist), at_least(neg_hist)
+        tp[-1] = 0  # ROC.java:268 CompareAndSet pair: nothing passes t=1.0
+        fp[-1] = 0
+        self.tp_counts += tp
+        self.fp_counts += fp
 
     def eval(self, labels: np.ndarray, predictions: np.ndarray,
              mask: Optional[np.ndarray] = None) -> None:
@@ -81,14 +128,107 @@ class ROC:
         if mask is not None:
             m = np.asarray(mask).astype(bool).ravel()
             labels, predictions = labels[m], predictions[m]
-        self.labels.append(labels.ravel())
-        self.scores.append(predictions.ravel())
+        if self.is_exact:
+            self.labels.append(labels.ravel())
+            self.scores.append(predictions.ravel())
+        else:
+            self._eval_binned(labels.ravel(), predictions.ravel())
+
+    # ---------------------------------------------------------------- curves
+    def get_roc_curve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(thresholds, fpr, tpr). Binned mode: one point per fixed
+        threshold (``ROC.java getRocCurve``); exact mode: one point per
+        distinct score."""
+        if not self.is_exact:
+            p = max(self.count_actual_positive, 1)
+            n = max(self.count_actual_negative, 1)
+            return (self.thresholds.copy(), self.fp_counts / n,
+                    self.tp_counts / p)
+        labels = np.concatenate(self.labels)
+        scores = np.concatenate(self.scores)
+        order = np.argsort(-scores)
+        l = labels[order] > 0.5
+        tp = np.cumsum(l)
+        fp = np.cumsum(~l)
+        n_pos, n_neg = max(int(l.sum()), 1), max(int((~l).sum()), 1)
+        return (scores[order], fp / n_neg, tp / n_pos)
+
+    def get_precision_recall_curve(self
+                                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(thresholds, precision, recall) — ``getPrecisionRecallCurve``."""
+        if not self.is_exact:
+            p = max(self.count_actual_positive, 1)
+            pred_pos = self.tp_counts + self.fp_counts
+            precision = np.where(pred_pos > 0, self.tp_counts
+                                 / np.maximum(pred_pos, 1), 1.0)
+            return (self.thresholds.copy(), precision, self.tp_counts / p)
+        labels = np.concatenate(self.labels)
+        scores = np.concatenate(self.scores)
+        order = np.argsort(-scores)
+        l = labels[order] > 0.5
+        tp = np.cumsum(l)
+        fp = np.cumsum(~l)
+        n_pos = max(int(l.sum()), 1)
+        return (scores[order], tp / np.maximum(tp + fp, 1), tp / n_pos)
 
     def calculate_auc(self) -> float:
-        return _auc_roc(np.concatenate(self.labels), np.concatenate(self.scores))
+        if self.is_exact:
+            return _auc_roc(np.concatenate(self.labels),
+                            np.concatenate(self.scores))
+        _, fpr, tpr = self.get_roc_curve()
+        # thresholds ascend → (fpr, tpr) descend from (1,1) to (0,0)
+        return float(_trapezoid(tpr[::-1], fpr[::-1]))
 
     def calculate_auc_pr(self) -> float:
-        return _auc_pr(np.concatenate(self.labels), np.concatenate(self.scores))
+        if self.is_exact:
+            return _auc_pr(np.concatenate(self.labels),
+                           np.concatenate(self.scores))
+        _, precision, recall = self.get_precision_recall_curve()
+        r, p = recall[::-1], precision[::-1]  # recall ascending
+        return float(_trapezoid(p, r))
+
+    # ----------------------------------------------------------- merge/serde
+    def merge(self, other: "ROC") -> "ROC":
+        """Distributed merge (``BaseEvaluation.merge``): count addition in
+        binned mode (O(steps) state), score concatenation in exact mode."""
+        if self.is_exact != other.is_exact or (
+                not self.is_exact
+                and self.threshold_steps != other.threshold_steps):
+            raise ValueError(
+                "cannot merge ROC instances with different threshold_steps "
+                f"({self.threshold_steps} vs {other.threshold_steps})")
+        if self.is_exact:
+            self.labels.extend(other.labels)
+            self.scores.extend(other.scores)
+        else:
+            self.tp_counts += other.tp_counts
+            self.fp_counts += other.fp_counts
+            self.count_actual_positive += other.count_actual_positive
+            self.count_actual_negative += other.count_actual_negative
+        return self
+
+    def to_json(self) -> str:
+        if self.is_exact:
+            raise ValueError("exact-mode ROC state is the raw score set; "
+                             "use threshold_steps > 0 for compact "
+                             "serializable/mergeable state")
+        return json.dumps({
+            "threshold_steps": self.threshold_steps,
+            "tp_counts": self.tp_counts.tolist(),
+            "fp_counts": self.fp_counts.tolist(),
+            "count_actual_positive": self.count_actual_positive,
+            "count_actual_negative": self.count_actual_negative,
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "ROC":
+        d = json.loads(s)
+        r = ROC(threshold_steps=d["threshold_steps"])
+        r.tp_counts = np.asarray(d["tp_counts"], np.int64)
+        r.fp_counts = np.asarray(d["fp_counts"], np.int64)
+        r.count_actual_positive = d["count_actual_positive"]
+        r.count_actual_negative = d["count_actual_negative"]
+        return r
 
 
 class ROCBinary:

@@ -542,16 +542,22 @@ class MultiLayerNetwork:
         return h[:, -1, :] if squeeze and h.ndim == 3 else h
 
     # ------------------------------------------------------------ evaluation
-    def evaluate(self, iterator) -> "Evaluation":
+    def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
+        """Evaluate over an iterator (``MultiLayerNetwork.evaluate``).
+        ``top_n`` > 1 additionally tracks top-N accuracy; when the iterator
+        collects record metadata (``collect_meta_data=True``), per-record
+        predictions are recorded for error drilldown (``doEvaluation``
+        passes ``getExampleMetaData`` through, MultiLayerNetwork.java)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
+        e = Evaluation(top_n=top_n)
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
             out = self.output(ds.features, mask=None if ds.features_mask is None
                               else _as_jnp(ds.features_mask))
             e.eval(np.asarray(ds.labels), np.asarray(out),
-                   mask=None if ds.labels_mask is None else np.asarray(ds.labels_mask))
+                   mask=None if ds.labels_mask is None else np.asarray(ds.labels_mask),
+                   record_meta_data=getattr(ds, "example_meta_data", None))
         return e
 
     def evaluate_regression(self, iterator) -> "RegressionEvaluation":

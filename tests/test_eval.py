@@ -317,3 +317,252 @@ class TestCalibrationPerClass:
         m = np.array([[1.0, 1.0, 0.0]])
         cal.eval(labels, preds, mask=m)
         np.testing.assert_array_equal(cal.prediction_counts, [0, 1, 0])
+
+
+class TestTopNAccuracy:
+    """Evaluation.java:144 constructor + :437 counting: top-N correct when
+    fewer than N probabilities are strictly greater than the true class's."""
+
+    def test_imagenet_shape_logits(self):
+        rng = np.random.default_rng(0)
+        n, c = 512, 1000                      # ImageNet-shape output
+        true = rng.integers(0, c, n)
+        preds = rng.dirichlet(np.ones(c), size=n).astype(np.float64)
+        # plant: first 200 exactly right, next 150 true class at rank 2-5,
+        # rest leave random (true prob tiny)
+        for i in range(200):
+            preds[i, true[i]] = 1.0           # rank 1
+        for i in range(200, 350):
+            order = np.argsort(-preds[i])
+            k = int(rng.integers(1, 5))       # rank 2..5
+            preds[i, true[i]] = (preds[i, order[k - 1]]
+                                 + preds[i, order[k]]) / 2
+        labels = np.eye(c)[true]
+        e1 = Evaluation(top_n=1)
+        e1.eval(labels, preds)
+        e5 = Evaluation(top_n=5)
+        e5.eval(labels, preds)
+        assert e5.top_n_accuracy() >= e5.accuracy()
+        assert e5.top_n_accuracy() == pytest.approx(350 / 512, abs=0.02)
+        assert e1.top_n_accuracy() == e1.accuracy()
+        assert "Top 5 Accuracy" in e5.stats()
+
+    def test_exact_counting_small(self):
+        labels = np.eye(4)[[0, 1, 2, 3]]
+        preds = np.array([
+            [0.4, 0.3, 0.2, 0.1],   # true 0 at rank 1
+            [0.4, 0.3, 0.2, 0.1],   # true 1 at rank 2
+            [0.4, 0.3, 0.2, 0.1],   # true 2 at rank 3
+            [0.4, 0.3, 0.2, 0.1],   # true 3 at rank 4
+        ])
+        e2 = Evaluation(top_n=2)
+        e2.eval(labels, preds)
+        assert e2.top_n_correct_count == 2 and e2.top_n_total_count == 4
+        assert e2.top_n_accuracy() == pytest.approx(0.5)
+        e3 = Evaluation(top_n=3)
+        e3.eval(labels, preds)
+        assert e3.top_n_accuracy() == pytest.approx(0.75)
+
+    def test_merge_and_serde_carry_topn(self):
+        labels = np.eye(3)[[0, 1]]
+        preds = np.array([[0.5, 0.3, 0.2], [0.5, 0.3, 0.2]])
+        a = Evaluation(top_n=2)
+        a.eval(labels, preds)
+        b = Evaluation(top_n=2)
+        b.eval(labels, preds)
+        a.merge(b)
+        assert a.top_n_total_count == 4 and a.top_n_correct_count == 4
+        back = Evaluation.from_json(a.to_json())
+        assert back.top_n == 2
+        assert back.top_n_accuracy() == pytest.approx(1.0)
+
+
+class TestPredictionRecording:
+    """Evaluation.java:1481/:1506/:1583 — metadata-backed error drilldown,
+    wired through records.py RecordMetaData."""
+
+    def _eval_with_meta(self):
+        from deeplearning4j_tpu.datasets.records import RecordMetaData
+        labels = np.eye(3)[[0, 0, 1, 2, 2]]
+        preds = np.array([
+            [0.8, 0.1, 0.1],   # 0 → 0 correct
+            [0.1, 0.8, 0.1],   # 0 → 1 ERROR
+            [0.1, 0.8, 0.1],   # 1 → 1 correct
+            [0.7, 0.2, 0.1],   # 2 → 0 ERROR
+            [0.1, 0.2, 0.7],   # 2 → 2 correct
+        ])
+        metas = [RecordMetaData(i, uri="data.csv") for i in range(5)]
+        e = Evaluation()
+        e.eval(labels, preds, record_meta_data=metas)
+        return e, metas
+
+    def test_errors_sorted_and_diagonal_skipped(self):
+        e, metas = self._eval_with_meta()
+        errs = e.get_prediction_errors()
+        assert [(p.actual, p.predicted) for p in errs] == [(0, 1), (2, 0)]
+        assert errs[0].record_meta_data is metas[1]
+        assert errs[1].record_meta_data is metas[3]
+        assert "data.csv:3" == errs[1].record_meta_data.get_location()
+
+    def test_by_actual_and_predicted_class(self):
+        e, metas = self._eval_with_meta()
+        by_actual = e.get_predictions_by_actual_class(2)
+        assert sorted((p.actual, p.predicted) for p in by_actual) == \
+            [(2, 0), (2, 2)]
+        by_pred = e.get_prediction_by_predicted_class(1)
+        assert sorted((p.actual, p.predicted) for p in by_pred) == \
+            [(0, 1), (1, 1)]
+        cell = e.get_predictions(0, 1)
+        assert len(cell) == 1 and cell[0].record_meta_data is metas[1]
+
+    def test_none_without_metadata(self):
+        e = Evaluation()
+        e.eval(np.eye(2)[[0, 1]], np.array([[0.9, 0.1], [0.2, 0.8]]))
+        assert e.get_prediction_errors() is None
+        assert e.get_predictions_by_actual_class(0) is None
+
+    def test_merge_combines_metadata(self):
+        a, _ = self._eval_with_meta()
+        b, _ = self._eval_with_meta()
+        a.merge(b)
+        assert len(a.get_prediction_errors()) == 4
+
+    def test_end_to_end_through_records_and_network(self):
+        """CSV → RecordReaderDataSetIterator(collect_meta_data=True) →
+        net.evaluate → get_prediction_errors → load_from_meta_data returns
+        the original source records (the full reference drilldown loop)."""
+        import tempfile, os
+        from deeplearning4j_tpu.datasets.records import (
+            CSVRecordReader, RecordReaderDataSetIterator)
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        rng = np.random.default_rng(3)
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "data.csv")
+            rows = []
+            for i in range(60):
+                cls = i % 3
+                f = rng.normal(0, 0.2, 4)
+                f[cls] += 2.0
+                rows.append(",".join(f"{v:.6f}" for v in f) + f",{cls}")
+            with open(path, "w") as fh:
+                fh.write("\n".join(rows))
+            conf = (NeuralNetConfiguration.builder().seed(1)
+                    .updater(Adam(0.05)).list()
+                    .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+                    .layer(OutputLayer(n_in=16, n_out=3))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            train_it = RecordReaderDataSetIterator(
+                CSVRecordReader(path), 16, label_index=4,
+                num_possible_labels=3)
+            for _ in range(15):
+                net.fit(train_it)
+            eval_it = RecordReaderDataSetIterator(
+                CSVRecordReader(path), 16, label_index=4,
+                num_possible_labels=3, collect_meta_data=True)
+            e = net.evaluate(eval_it)
+            assert e.accuracy() > 0.9
+            errs = e.get_prediction_errors()
+            assert errs is not None  # metadata was collected
+            # every recorded prediction maps back to its source record
+            recorded = e.get_predictions_by_actual_class(1)
+            assert len(recorded) == 20
+            reloaded = eval_it.load_from_meta_data(
+                [p.record_meta_data for p in recorded])
+            assert reloaded.num_examples() == 20
+            lab = np.asarray(reloaded.labels)
+            assert (np.argmax(lab, 1) == 1).all()
+
+
+class TestBinnedROC:
+    """ROC.java:61-85 thresholded mode: O(steps) mergeable state for
+    batched/distributed evaluation."""
+
+    def _scored(self, n, seed):
+        rng = np.random.default_rng(seed)
+        labels = (rng.random(n) < 0.4).astype(np.float64)
+        # informative but noisy scores
+        scores = np.clip(0.5 * labels + rng.normal(0.35, 0.25, n), 0, 1)
+        return labels, scores
+
+    def test_binned_close_to_exact(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        labels, scores = self._scored(4000, 0)
+        exact = ROC()
+        exact.eval(labels, scores)
+        binned = ROC(threshold_steps=200)
+        binned.eval(labels, scores)
+        assert binned.calculate_auc() == pytest.approx(
+            exact.calculate_auc(), abs=0.01)
+        assert binned.calculate_auc_pr() == pytest.approx(
+            exact.calculate_auc_pr(), abs=0.02)
+
+    def test_sharded_merge_equals_single_pass(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        labels, scores = self._scored(6000, 1)
+        whole = ROC(threshold_steps=100)
+        whole.eval(labels, scores)
+        shards = []
+        for k in range(6):  # six "workers"
+            r = ROC(threshold_steps=100)
+            r.eval(labels[k * 1000:(k + 1) * 1000],
+                   scores[k * 1000:(k + 1) * 1000])
+            shards.append(r)
+        merged = shards[0]
+        for r in shards[1:]:
+            merged.merge(r)
+        np.testing.assert_array_equal(merged.tp_counts, whole.tp_counts)
+        np.testing.assert_array_equal(merged.fp_counts, whole.fp_counts)
+        assert merged.calculate_auc() == whole.calculate_auc()
+        # and the merged-binned AUC tracks the exact AUC
+        exact = ROC()
+        exact.eval(labels, scores)
+        assert merged.calculate_auc() == pytest.approx(
+            exact.calculate_auc(), abs=0.01)
+
+    def test_curve_endpoints_and_monotonicity(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        labels, scores = self._scored(1000, 2)
+        r = ROC(threshold_steps=50)
+        r.eval(labels, scores)
+        thr, fpr, tpr = r.get_roc_curve()
+        assert thr[0] == 0.0 and thr[-1] == 1.0
+        assert fpr[0] == 1.0 and tpr[0] == 1.0     # t=0: everything positive
+        assert fpr[-1] == 0.0 and tpr[-1] == 0.0   # t=1: nothing positive
+        assert (np.diff(fpr) <= 0).all() and (np.diff(tpr) <= 0).all()
+
+    def test_threshold_boundary_is_geq(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        r = ROC(threshold_steps=10)
+        # score exactly at threshold 0.3 must count as predicted-positive
+        r.eval(np.array([1.0, 0.0]), np.array([0.3, 0.3]))
+        i = 3  # threshold 0.3
+        assert r.tp_counts[i] == 1 and r.fp_counts[i] == 1
+        assert r.tp_counts[i + 1] == 0
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        labels, scores = self._scored(500, 3)
+        r = ROC(threshold_steps=40)
+        r.eval(labels, scores)
+        back = ROC.from_json(r.to_json())
+        assert back.calculate_auc() == r.calculate_auc()
+        exact = ROC()
+        exact.eval(labels, scores)
+        with pytest.raises(ValueError, match="exact-mode"):
+            exact.to_json()
+        with pytest.raises(ValueError, match="threshold_steps"):
+            r.merge(ROC(threshold_steps=20))
+
+    def test_masked_and_two_column_inputs(self):
+        from deeplearning4j_tpu.eval.roc import ROC
+        labels2 = np.array([[1, 0], [0, 1], [0, 1], [1, 0]], np.float64)
+        preds2 = np.array([[0.8, 0.2], [0.3, 0.7], [0.4, 0.6], [0.9, 0.1]])
+        r = ROC(threshold_steps=10)
+        r.eval(labels2, preds2, mask=np.array([1, 1, 1, 0]))
+        assert r.count_actual_positive == 2
+        assert r.count_actual_negative == 1
