@@ -83,6 +83,112 @@ class WordVectorSerializer:
         model.lookup_table.syn0 = jnp.asarray(mat)
         return model
 
+    # ----------------------------------------- Google word2vec binary format
+
+    @staticmethod
+    def write_binary_model(model: SequenceVectors, path: str,
+                           compress: Optional[bool] = None) -> None:
+        """Write the Google word2vec C binary format (the original
+        ``word2vec.c`` layout, the de-facto pretrained-embedding
+        interchange): ASCII header ``"<words> <size>\\n"``, then per word
+        ``word + b' '`` followed by ``size`` packed little-endian float32s
+        and a newline. ``compress`` (default: from a ``.gz`` suffix) gzips
+        the stream, the GoogleNews-vectors distribution style."""
+        import gzip as _gzip
+
+        if compress is None:
+            compress = path.endswith(".gz")
+        mat = np.asarray(model.lookup_table.all_vectors(), np.float32)
+        opener = _gzip.open if compress else open
+        with opener(path, "wb") as fh:
+            fh.write(f"{mat.shape[0]} {mat.shape[1]}\n".encode("utf-8"))
+            for i in range(mat.shape[0]):
+                word = model.vocab.word_at_index(i)
+                fh.write(word.encode("utf-8") + b" ")
+                fh.write(mat[i].astype("<f4").tobytes())
+                fh.write(b"\n")
+
+    @staticmethod
+    def read_binary_model(path: str, linebreaks: Optional[bool] = None,
+                          normalize: bool = False) -> SequenceVectors:
+        """Read a Google word2vec C binary file
+        (``WordVectorSerializer.readBinaryModel``,
+        ``WordVectorSerializer.java:165``): header words/size as ASCII,
+        each word terminated by a space (``readString:282`` stops at space
+        or newline), then packed little-endian float32s (``getFloat:265``).
+
+        ``linebreaks=None`` auto-detects the per-word trailing newline
+        variant (the C tool writes one; some exporters don't — the
+        reference makes the caller choose, ``loadGoogleModel:117``).
+        ``normalize=True`` unit-normalizes each vector on load, matching
+        the reference's ``Transforms.unitVec`` path."""
+        import gzip as _gzip
+
+        with open(path, "rb") as probe:
+            magic = probe.read(2)
+        opener = _gzip.open if magic == b"\x1f\x8b" else open
+        with opener(path, "rb") as fh:
+            data = fh.read()
+
+        def token(pos):
+            end = pos
+            while data[end] not in (0x20, 0x0A):
+                end += 1
+            return data[pos:end], end + 1
+
+        head, pos = token(0)
+        n_words = int(head)
+        head, pos = token(pos)
+        size = int(head)
+        words, rows = [], np.empty((n_words, size), np.float32)
+        for i in range(n_words):
+            # skip the previous row's newline (linebreaks variant); words
+            # themselves can't start with \n
+            if linebreaks is not False and pos < len(data) \
+                    and data[pos] == 0x0A:
+                pos += 1
+            raw, pos = token(pos)
+            words.append(raw.decode("utf-8"))
+            rows[i] = np.frombuffer(data, "<f4", count=size, offset=pos)
+            pos += 4 * size
+            if linebreaks is True:
+                pos += 1
+        if normalize:
+            norms = np.linalg.norm(rows, axis=1, keepdims=True)
+            rows = rows / np.maximum(norms, 1e-12)
+        cache = VocabCache()
+        for w in words:
+            cache.add_token(VocabWord(w))
+        cache._by_index = [cache.word_for(w) for w in words]
+        for i, vw in enumerate(cache._by_index):
+            vw.index = i
+        model = SequenceVectors(layer_size=size)
+        model.vocab = cache
+        model.lookup_table = InMemoryLookupTable(cache, size, init_syn0=False)
+        model.lookup_table.syn0 = jnp.asarray(rows)
+        return model
+
+    @staticmethod
+    def load_static_model(path: str) -> SequenceVectors:
+        """``WordVectorSerializer.loadStaticModel:2481``: inference-only
+        word vectors from ANY supported artifact — tries this framework's
+        zip model, then the C text format, then the Google binary format
+        (the reference's exact fallback order: dl4j zip → csv → binary)."""
+        try:
+            return WordVectorSerializer.read_word2vec_model(path)
+        except (zipfile.BadZipFile, KeyError, OSError):
+            pass
+        try:
+            return WordVectorSerializer.load_txt_vectors(path)
+        except (UnicodeDecodeError, ValueError, IndexError):
+            pass
+        try:
+            return WordVectorSerializer.read_binary_model(path)
+        except Exception as e:
+            raise ValueError(
+                f"Unable to guess input file format for {path!r} (tried "
+                "zip model, text vectors, Google binary)") from e
+
     # -------------------------------------------------------- zip format
 
     @staticmethod

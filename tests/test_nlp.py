@@ -366,3 +366,97 @@ class TestFileCorpusFastPath:
         # entry, not 'word.'/'word,' variants
         assert w2v.has_word("word")
         assert not w2v.has_word("word.")
+
+
+# ----------------------------------------- Google word2vec binary format
+
+def _hand_built_bin(words_vecs, linebreaks=True):
+    """Build the C binary format INDEPENDENTLY of the writer (struct.pack
+    per the word2vec.c layout) so the reader is genuinely inverted."""
+    import struct
+    size = len(words_vecs[0][1])
+    out = f"{len(words_vecs)} {size}\n".encode()
+    for w, v in words_vecs:
+        out += w.encode("utf-8") + b" "
+        out += struct.pack(f"<{size}f", *v)
+        if linebreaks:
+            out += b"\n"
+    return out
+
+
+def test_binary_read_hand_built_fixture(tmp_path):
+    vecs = [("the", [0.1, -0.2, 0.3]), ("cat", [1.0, 2.0, -3.0]),
+            ("sat", [0.0, 0.5, 0.25])]
+    p = str(tmp_path / "mini.bin")
+    with open(p, "wb") as fh:
+        fh.write(_hand_built_bin(vecs))
+    m = WordVectorSerializer.read_binary_model(p)
+    assert m.vocab.num_words() == 3
+    assert m.layer_size == 3
+    np.testing.assert_allclose(m.get_word_vector("cat"), [1.0, 2.0, -3.0],
+                               rtol=1e-6)
+    # file order preserved (readBinaryModel adds in stream order)
+    assert m.vocab.word_at_index(0) == "the"
+    assert m.vocab.word_at_index(2) == "sat"
+
+
+def test_binary_read_no_linebreaks_variant(tmp_path):
+    vecs = [("a", [0.5, 0.5]), ("b", [1.5, -1.5])]
+    p = str(tmp_path / "nolb.bin")
+    with open(p, "wb") as fh:
+        fh.write(_hand_built_bin(vecs, linebreaks=False))
+    m = WordVectorSerializer.read_binary_model(p)  # auto-detect
+    np.testing.assert_allclose(m.get_word_vector("b"), [1.5, -1.5], rtol=1e-6)
+
+
+def test_binary_normalize_matches_unitvec(tmp_path):
+    vecs = [("x", [3.0, 4.0])]
+    p = str(tmp_path / "n.bin")
+    with open(p, "wb") as fh:
+        fh.write(_hand_built_bin(vecs))
+    m = WordVectorSerializer.read_binary_model(p, normalize=True)
+    np.testing.assert_allclose(m.get_word_vector("x"), [0.6, 0.8], rtol=1e-6)
+
+
+def test_binary_write_read_roundtrip_and_gzip(tmp_path):
+    w2v = Word2Vec(layer_size=12, window_size=2, min_word_frequency=2,
+                   epochs=1, seed=9).fit(_toy_corpus())
+    for name in ("vec.bin", "vec.bin.gz"):
+        p = str(tmp_path / name)
+        WordVectorSerializer.write_binary_model(w2v, p)
+        loaded = WordVectorSerializer.read_binary_model(p)
+        assert loaded.vocab.num_words() == w2v.vocab.num_words()
+        np.testing.assert_allclose(loaded.get_word_vector("cat"),
+                                   np.asarray(w2v.get_word_vector("cat"),
+                                              np.float32), rtol=1e-6)
+
+
+def test_binary_utf8_words_survive(tmp_path):
+    vecs = [("猫", [1.0, 0.0]), ("über", [0.0, 1.0])]
+    p = str(tmp_path / "u.bin")
+    with open(p, "wb") as fh:
+        fh.write(_hand_built_bin(vecs))
+    m = WordVectorSerializer.read_binary_model(p)
+    np.testing.assert_allclose(m.get_word_vector("猫"), [1.0, 0.0])
+    np.testing.assert_allclose(m.get_word_vector("über"), [0.0, 1.0])
+
+
+def test_load_static_model_dispatches_all_three_formats(tmp_path):
+    w2v = Word2Vec(layer_size=8, window_size=2, min_word_frequency=2,
+                   epochs=1, seed=4).fit(_toy_corpus())
+    zp = str(tmp_path / "model.zip")
+    tp = str(tmp_path / "model.txt")
+    bp = str(tmp_path / "model.bin")
+    WordVectorSerializer.write_word2vec_model(w2v, zp)
+    WordVectorSerializer.write_word_vectors(w2v, tp)
+    WordVectorSerializer.write_binary_model(w2v, bp)
+    ref = np.asarray(w2v.get_word_vector("cat"), np.float32)
+    for p in (zp, tp, bp):
+        m = WordVectorSerializer.load_static_model(p)
+        np.testing.assert_allclose(
+            np.asarray(m.get_word_vector("cat"), np.float32), ref,
+            atol=1e-5)
+    with open(str(tmp_path / "junk.xyz"), "wb") as fh:
+        fh.write(b"\x00\x01 not a model \x02")
+    with pytest.raises(ValueError, match="guess input file format"):
+        WordVectorSerializer.load_static_model(str(tmp_path / "junk.xyz"))
