@@ -76,12 +76,57 @@ class ZooModel:
         p = os.path.join(root, f"{type(self).__name__.lower()}_{pretrained_type}.zip")
         return p if os.path.exists(p) else None
 
-    def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET):
+    #: subclasses/users may register expected Adler32 checksums per
+    #: pretrained type (``ZooModel.pretrainedChecksum``; 0 = don't verify)
+    PRETRAINED_CHECKSUMS: Dict[str, int] = {}
+
+    def pretrained_checksum(self, pretrained_type: str) -> int:
+        return int(self.PRETRAINED_CHECKSUMS.get(pretrained_type, 0))
+
+    def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET,
+                        expected_checksum: Optional[int] = None):
+        """Build this architecture carrying pretrained weights
+        (``ZooModel.initPretrained``, ``ZooModel.java:51-93``): resolve the
+        cached artifact, verify its Adler32 checksum when one is expected,
+        then restore through the FULL checkpoint reader — both this
+        framework's own zips and the reference's DL4J ModelSerializer zips
+        (``coefficients.bin`` + ``updaterState.bin``) load, for
+        MultiLayerNetwork and ComputationGraph alike.
+
+        Unlike the reference (which deletes its own downloaded cache on
+        mismatch), a user-placed file is never deleted — the error reports
+        both checksums instead."""
+        import zipfile
+        import zlib
+
         path = self.pretrained_checkpoint(pretrained_type)
         if path is None:
             raise FileNotFoundError(
                 f"No pretrained weights for {type(self).__name__} ({pretrained_type}); "
                 f"place a checkpoint under $DL4J_TPU_ZOO_DIR to enable.")
+        expected = (self.pretrained_checksum(pretrained_type)
+                    if expected_checksum is None else int(expected_checksum))
+        if expected != 0:
+            adler = 1  # zlib.adler32 seed, matches java.util.zip.Adler32
+            with open(path, "rb") as fh:
+                for chunk in iter(lambda: fh.read(1 << 20), b""):
+                    adler = zlib.adler32(chunk, adler)
+            if adler != expected:
+                raise ValueError(
+                    f"Pretrained model file failed checksum: local Adler32 "
+                    f"{adler}, expecting {expected} ({path}); the file is "
+                    "left in place — replace it with an intact copy.")
+        with zipfile.ZipFile(path) as z:
+            names = set(z.namelist())
+        if "coefficients.bin" in names:  # reference DL4J ModelSerializer zip
+            import json as _json
+            from deeplearning4j_tpu.modelimport.dl4j import (
+                restore_computation_graph, restore_multi_layer_network)
+            with zipfile.ZipFile(path) as z:
+                raw = z.read("configuration.json").decode("utf-8")
+            if "vertices" in _json.loads(raw):
+                return restore_computation_graph(path)
+            return restore_multi_layer_network(path)
         from deeplearning4j_tpu.util.model_serializer import restore_model
         return restore_model(path)
 

@@ -171,3 +171,76 @@ class TestTransformerEncoder:
         out_short = np.asarray(net.output(x_short))
         out_pad = np.asarray(net.output(x_pad, masks=[mask]))
         np.testing.assert_allclose(out_pad, out_short, atol=1e-5)
+
+
+class TestInitPretrained:
+    """ZooModel.java:51-93 — cache lookup, Adler32 verification, full
+    restore through the real checkpoint readers (own zip AND reference
+    DL4J ModelSerializer zip)."""
+
+    def _stage(self, tmp_path, monkeypatch, src, name):
+        import shutil
+        zoo_dir = tmp_path / "zoo"
+        zoo_dir.mkdir(exist_ok=True)
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(zoo_dir))
+        dst = zoo_dir / name
+        shutil.copyfile(src, dst)
+        return str(dst)
+
+    def test_dl4j_zip_restores_through_zoo_path(self, tmp_path, monkeypatch):
+        import os
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import LeNet
+        fix = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "dl4j_checkpoint_convnet.zip")
+        self._stage(tmp_path, monkeypatch, fix, "lenet_mnist.zip")
+        net = LeNet(num_labels=3).init_pretrained(PretrainedType.MNIST)
+        exp = np.load(os.path.join(os.path.dirname(__file__), "fixtures",
+                                   "dl4j_checkpoint_convnet_expected.npz"))
+        out = np.asarray(net.output(exp["x"]))
+        np.testing.assert_allclose(out, exp["out"], rtol=1e-5, atol=1e-6)
+
+    def test_checksum_pass_and_mismatch(self, tmp_path, monkeypatch):
+        import os
+        import zlib
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import LeNet
+        fix = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "dl4j_checkpoint_convnet.zip")
+        staged = self._stage(tmp_path, monkeypatch, fix, "lenet_mnist.zip")
+        with open(staged, "rb") as fh:
+            good = zlib.adler32(fh.read())
+        net = LeNet(num_labels=3).init_pretrained(
+            PretrainedType.MNIST, expected_checksum=good)
+        assert net.params is not None
+        with pytest.raises(ValueError, match="failed checksum"):
+            LeNet(num_labels=3).init_pretrained(
+                PretrainedType.MNIST, expected_checksum=good + 1)
+        assert os.path.exists(staged)  # user files are never deleted
+        # registered class-level checksum is honored too
+        monkeypatch.setattr(LeNet, "PRETRAINED_CHECKSUMS",
+                            {PretrainedType.MNIST: good}, raising=False)
+        assert LeNet(num_labels=3).init_pretrained(
+            PretrainedType.MNIST).params is not None
+
+    def test_own_format_zip_loads(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        m = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)).init()
+        src = tmp_path / "own.zip"
+        write_model(m, str(src))
+        self._stage(tmp_path, monkeypatch, str(src), "simplecnn_cifar10.zip")
+        net = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(m.output(x)), rtol=1e-5)
+
+    def test_missing_raises(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import LeNet
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError, match="No pretrained weights"):
+            LeNet().init_pretrained(PretrainedType.VGGFACE)
