@@ -836,18 +836,95 @@ def _dl4j_param_specs(layer):
         "restore_multi_layer_network_configuration")
 
 
+def _java_int_set_iter(elems):
+    """Iteration order of a ``java.util.HashSet<Integer>`` populated by
+    ``add()`` in ``elems`` order: buckets ascend (Integer hash is the value;
+    HashMap's spread ``h ^ h>>>16`` is the identity below 2^16), entries
+    within a bucket keep insertion order (Java 8 appends to the tail, and
+    resize splits preserve relative order). Capacity starts at 16 and
+    doubles whenever size exceeds 0.75 * capacity."""
+    cap = 16
+    while len(elems) > 0.75 * cap:
+        cap *= 2
+    buckets = {}
+    for e in elems:
+        h = e ^ (e >> 16)
+        buckets.setdefault(h & (cap - 1), []).append(e)
+    out = []
+    for b in sorted(buckets):
+        out.extend(buckets[b])
+    return out
+
+
+def _dl4j_topological_order(conf, java_set_order: bool = True):
+    """Replicate ``ComputationGraph.topologicalSortOrder()``
+    (``ComputationGraph.java:1211``) exactly: Kahn's algorithm over vertex
+    INDICES (networkInputs in order, then vertices in serialization order),
+    a FIFO work queue, and successor processing in Java HashSet<Integer>
+    iteration order. The initial queue ascends by index because
+    ``inputEdges`` is a ``HashMap<Integer, ...>`` whose keys 0..n-1 all land
+    in their own buckets (capacity > n after resize).
+
+    ``java_set_order=False`` runs the same sort with plain ascending
+    successor order — used to detect the (rare, >16-vertex fan-out) cases
+    where the bucket-order emulation is the only thing pinning the result.
+    """
+    names = list(conf.inputs) + list(conf.vertices)
+    idx = {n: i for i, n in enumerate(names)}
+    input_edges = {}
+    output_elems = {}
+    for n in conf.inputs:
+        input_edges[idx[n]] = set()
+    for name, vd in conf.vertices.items():
+        i = idx[name]
+        srcs = list(vd.inputs)
+        if not srcs:
+            input_edges[i] = set()
+            continue
+        s = set()
+        for src in srcs:
+            j = idx[src]
+            s.add(j)
+            lst = output_elems.setdefault(j, [])
+            if i not in lst:
+                lst.append(i)
+        input_edges[i] = s
+    queue = [i for i in sorted(input_edges) if not input_edges[i]]
+    out = []
+    while queue:
+        nxt = queue.pop(0)
+        out.append(nxt)
+        succs = output_elems.get(nxt, [])
+        succs = (_java_int_set_iter(succs) if java_set_order
+                 else sorted(succs))
+        for v in succs:
+            input_edges[v].discard(nxt)
+            if not input_edges[v]:
+                queue.append(v)
+    if len(out) != len(names):
+        raise InvalidDl4jConfigurationException("graph contains a cycle")
+    return [names[i] for i in out]
+
+
+def _graph_layer_order(conf):
+    """LAYER vertices in the order DL4J's ``ComputationGraph.init``
+    allocates flattened param views (its topological order filtered to
+    layer vertices, ``ComputationGraph.java:467-470``)."""
+    order = _dl4j_topological_order(conf)
+    return [n for n in order
+            if n in conf.vertices and conf.vertices[n].is_layer]
+
+
 def _layer_seq(conf):
     """Uniform (key, layer) sequence for both network kinds: MLN confs walk
-    ``layers`` by index; graph confs walk LAYER vertices in topological
-    order — the order ``ComputationGraph.init`` allocates its flattened
-    param views in (``ComputationGraph.java:467-470``). NOTE: topological
-    sorts are not unique; for branchy graphs the reference's own sort is
-    assumed to match ours (true for chains and for graphs serialized in
-    creation order)."""
+    ``layers`` by index; graph confs walk LAYER vertices in DL4J's OWN
+    topological order (``_dl4j_topological_order`` — exact
+    ``topologicalSortOrder()`` emulation, deterministic for branchy
+    graphs), the order ``ComputationGraph.init`` allocates its flattened
+    param views in (``ComputationGraph.java:467-470``)."""
     if hasattr(conf, "layers"):
         return list(enumerate(conf.layers))
-    # derive from the SAME accessor ComputationGraph.init allocates from
-    return [(vd.name, vd.obj) for vd in conf.layer_vertices()]
+    return [(n, conf.vertices[n].obj) for n in _graph_layer_order(conf)]
 
 
 def _iter_param_slices(conf, flat):
@@ -1061,19 +1138,23 @@ def restore_computation_graph(path: str, load_params: bool = True,
                 "restore_multi_layer_network")
         conf = import_dl4j_graph_configuration(raw)
         net = ComputationGraph(conf).init()
-        # coefficients follow DL4J's topologicalSortOrder; when the LAYER
-        # order is not forced by the dependency structure (parallel layer
-        # branches), the reference's tie-break may differ from ours and
-        # same-shaped branches would swap silently — surface exactly that
-        # case (a forced order is provably correct, no warning)
+        # coefficients follow DL4J's topologicalSortOrder, which
+        # _dl4j_topological_order replicates exactly (FIFO Kahn over vertex
+        # indices + Java HashSet successor iteration), so branchy graphs map
+        # deterministically. The one residual assumption is the Java
+        # HashSet BUCKET order for fan-out sets holding indices >= 16; warn
+        # iff that assumption is the only thing pinning the layer order.
         if load_params and "coefficients.bin" in names:
-            order = [vd.name for vd in conf.layer_vertices()]
-            if not _layer_order_is_forced(conf, order):
+            emulated = _graph_layer_order(conf)
+            plain = [n for n in _dl4j_topological_order(
+                conf, java_set_order=False)
+                if n in conf.vertices and conf.vertices[n].is_layer]
+            if emulated != plain:
                 import warnings
                 warnings.warn(
-                    "graph has parallel layer branches whose topological "
-                    f"order {order} is not forced by dependencies; DL4J's "
-                    "own sort may tie-break differently — verify restored "
+                    "graph layer order depends on Java HashSet bucket-order "
+                    "emulation for >=16-way vertex indices "
+                    f"({emulated} vs ascending {plain}); verify restored "
                     "outputs against known activations", stacklevel=2)
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)

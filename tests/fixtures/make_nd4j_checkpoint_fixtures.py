@@ -222,9 +222,83 @@ def graph_fixture():
     print("wrote", zpath, "out[0]:", out[0])
 
 
+def branchy_graph_fixture():
+    """Adversarial parallel-branch graph: three same-shaped dense branches
+    whose INSERTION order (z, m, a) disagrees with name order, merged by
+    concat. DL4J's topologicalSortOrder processes them by vertex INDEX
+    (insertion order), so the flattened coefficients follow z, m, a — a
+    lexicographic tie-break would swap the branch weights silently. The
+    expected output is computed by a MANUAL numpy forward pass, independent
+    of the importer."""
+    rng = np.random.default_rng(21)
+    dense = lambda nin, nout, name: {"dense": {
+        "layerName": name, "nin": nin, "nout": nout,
+        "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationTanH"},
+        "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                     "learningRate": 0.01, "beta1": 0.9, "beta2": 0.999}}}
+    conf = {
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        "vertices": {
+            "stem": {"LayerVertex": {"layerConf": {"layer": dense(4, 5, "stem")}}},
+            "z_branch": {"LayerVertex": {"layerConf": {"layer": dense(5, 3, "z_branch")}}},
+            "m_branch": {"LayerVertex": {"layerConf": {"layer": dense(5, 3, "m_branch")}}},
+            "a_branch": {"LayerVertex": {"layerConf": {"layer": dense(5, 3, "a_branch")}}},
+            "merge": {"MergeVertex": {}},
+            "out": {"LayerVertex": {"layerConf": {"layer": {"output": {
+                "layerName": "out", "nin": 9, "nout": 2,
+                "activationFn": {"@class": "org.nd4j.linalg.activations.impl.ActivationSoftmax"},
+                "lossFn": {"@class": "org.nd4j.linalg.lossfunctions.impl.LossMCXENT"},
+                "iUpdater": {"@class": "org.nd4j.linalg.learning.config.Adam",
+                             "learningRate": 0.01, "beta1": 0.9,
+                             "beta2": 0.999}}}}}},
+        },
+        "vertexInputs": {"stem": ["in"], "z_branch": ["stem"],
+                         "m_branch": ["stem"], "a_branch": ["stem"],
+                         "merge": ["z_branch", "m_branch", "a_branch"],
+                         "out": ["merge"]},
+    }
+    P = {}
+    for name, (nin, nout) in [("stem", (4, 5)), ("z", (5, 3)), ("m", (5, 3)),
+                              ("a", (5, 3)), ("o", (9, 2))]:
+        P[name + "W"] = rng.normal(0, 0.4, (nin, nout)).astype(np.float32)
+        P[name + "b"] = rng.normal(0, 0.2, (nout,)).astype(np.float32)
+    # DL4J topologicalSortOrder: FIFO Kahn over vertex indices (insertion
+    # order) -> layer order stem, z_branch, m_branch, a_branch, out
+    flat = np.concatenate([
+        P["stemW"].flatten("F"), P["stemb"],
+        P["zW"].flatten("F"), P["zb"],
+        P["mW"].flatten("F"), P["mb"],
+        P["aW"].flatten("F"), P["ab"],
+        P["oW"].flatten("F"), P["ob"]]).astype(np.float32)
+    # Adam state [M(all), V(all)] over the same layout
+    upd = np.arange(2 * flat.size, dtype=np.float32) * 1e-3
+
+    zpath = os.path.join(HERE, "dl4j_checkpoint_branchy_graph.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("configuration.json", json.dumps(conf))
+        z.writestr("coefficients.bin", nd4j_bytes(flat))
+        z.writestr("updaterState.bin", nd4j_bytes(upd))
+
+    # independent manual forward
+    x = rng.normal(0, 1, (3, 4)).astype(np.float32)
+    h = np.tanh(x @ P["stemW"] + P["stemb"])
+    zb = np.tanh(h @ P["zW"] + P["zb"])
+    mb = np.tanh(h @ P["mW"] + P["mb"])
+    ab = np.tanh(h @ P["aW"] + P["ab"])
+    merged = np.concatenate([zb, mb, ab], axis=1)
+    logits = merged @ P["oW"] + P["ob"]
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    out = e / e.sum(axis=1, keepdims=True)
+    np.savez(os.path.join(HERE, "dl4j_checkpoint_branchy_graph_expected.npz"),
+             x=x, out=out, upd=upd, **P)
+    print("wrote", zpath)
+
+
 if __name__ == "__main__":
     import jax
     jax.config.update("jax_platforms", "cpu")
     conv_net_fixture()
     lstm_fixture()
     graph_fixture()
+    branchy_graph_fixture()
