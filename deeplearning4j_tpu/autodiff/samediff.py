@@ -569,16 +569,27 @@ class SameDiff:
 
     def while_loop(self, loop_vars: Sequence["SDVariable"],
                    cond_fn: Callable, body_fn: Callable,
-                   name: Optional[str] = None) -> List["SDVariable"]:
+                   name: Optional[str] = None,
+                   max_iterations: Optional[int] = None) -> List["SDVariable"]:
         """Carried loop (ND4J ``sd.whileLoop(loopVars, cond, body)``):
         ``cond_fn(sd, *vars) -> scalar`` and ``body_fn(sd, *vars) ->
         [vars']`` build subgraphs over symbolic loop variables (closing over
-        outer variables is fine); shapes must be loop-invariant. Lowered to
-        ``jax.lax.while_loop`` — the trip count is decided on device at run
-        time, so the loop is jittable with NO host round-trips per
-        iteration. Forward-only (XLA cannot reverse-differentiate a dynamic
-        trip count; the reference's loops are likewise not gradient-trained).
-        Returns the final loop variables."""
+        outer variables is fine); shapes must be loop-invariant. Returns the
+        final loop variables.
+
+        Two lowerings:
+
+        - ``max_iterations=None``: ``jax.lax.while_loop`` — the trip count
+          is decided on device at run time, NO host round-trips per
+          iteration. Forward-only (XLA cannot reverse-differentiate a
+          dynamic trip count).
+        - ``max_iterations=K``: ``jax.lax.scan`` over K steps with an
+          active-flag mask — iterations after the condition first fails are
+          identity. Same results whenever the true trip count is <= K, and
+          REVERSE-MODE DIFFERENTIABLE: gradients flow through the executed
+          iterations (masked steps pass them through unchanged), so loops
+          can sit inside trained graphs. XLA unrolls nothing — one compiled
+          scan body regardless of K."""
         name = name or self._fresh_name("while")
         init = [self._as_var(v) for v in loop_vars]
 
@@ -601,12 +612,14 @@ class SameDiff:
         (c_out, b_outs), scope = self._scoped_build(name, build)
         outer = self._outer_deps(
             scope, outs=[c_out.name] + [b.name for b in b_outs])
+        attrs = {"scope": list(scope), "cond_out": c_out.name,
+                 "body_outs": [b.name for b in b_outs],
+                 "n_loop_vars": len(init)}
+        if max_iterations is not None:
+            attrs["max_iterations"] = int(max_iterations)
         self._register(SDVariable(
             self, name, "op", op="while_loop",
-            inputs=[v.name for v in init] + outer,
-            attrs={"scope": list(scope), "cond_out": c_out.name,
-                   "body_outs": [b.name for b in b_outs],
-                   "n_loop_vars": len(init)}))
+            inputs=[v.name for v in init] + outer, attrs=attrs))
         return [self._op("tuple_get", [self._nodes[name]],
                          name=f"{name}_out{i}", attrs={"index": i})
                 for i in range(len(init))]
@@ -701,14 +714,34 @@ class SameDiff:
                     nlv = a["n_loop_vars"]
                     init = tuple(env[i] for i in node.inputs[:nlv])
                     operands = {d: env[d] for d in node.inputs[nlv:]}
-                    env[n] = jax.lax.while_loop(
-                        lambda carry, _a=a, _o=operands: jnp.reshape(
-                            run_scope(_a["scope"], _o, carry)[_a["cond_out"]],
-                            ()) != 0,
-                        lambda carry, _a=a, _o=operands: tuple(
-                            run_scope(_a["scope"], _o, carry)[m]
-                            for m in _a["body_outs"]),
-                        init)
+                    max_it = a.get("max_iterations")
+                    if max_it:
+                        # bounded loop → lax.scan with an active-flag mask:
+                        # reverse-mode differentiable (scan has a VJP;
+                        # masked steps are identity for value AND gradient)
+                        def step(carry, _x, _a=a, _o=operands):
+                            vars_, active = carry
+                            env2 = run_scope(_a["scope"], _o, vars_)
+                            cond = jnp.reshape(
+                                env2[_a["cond_out"]], ()) != 0
+                            act = jnp.logical_and(active, cond)
+                            new_vars = tuple(
+                                jnp.where(act, env2[m], v) for m, v
+                                in zip(_a["body_outs"], vars_))
+                            return (new_vars, act), None
+                        (final, _), _ = jax.lax.scan(
+                            step, (init, jnp.asarray(True)), None,
+                            length=int(max_it))
+                        env[n] = final
+                    else:
+                        env[n] = jax.lax.while_loop(
+                            lambda carry, _a=a, _o=operands: jnp.reshape(
+                                run_scope(_a["scope"], _o, carry)[_a["cond_out"]],
+                                ()) != 0,
+                            lambda carry, _a=a, _o=operands: tuple(
+                                run_scope(_a["scope"], _o, carry)[m]
+                                for m in _a["body_outs"]),
+                            init)
                 else:
                     env[n] = OPS[node.op](*(env[i] for i in node.inputs),
                                           **node.attrs)
