@@ -1,0 +1,253 @@
+"""Generate the CJK accuracy fixture: a few-hundred-entry MeCab-format
+mini-dictionary (tests/fixtures/ja_eval_dict/) plus a tagged evaluation
+corpus (tests/fixtures/ja_tagged_corpus.tsv, ``sentence<TAB>tok|tok|...``).
+
+The dictionary is hand-designed in ipadic's shape: context-id classes for
+noun / case-particle / binding-particle / adnominal / verb-renyou /
+verb-basic / auxiliary / adjective / adverb / punctuation, per-word costs,
+and a full connection matrix in MeCab's ``matrix.def`` layout. Sentences are
+built compositionally from the vocabulary so the gold segmentation is the
+construction itself — including adversarial strings where greedy
+longest-match derails (すもも…, longest-entry traps).
+
+Run from the repo root:  PYTHONPATH=. python tests/fixtures/make_ja_eval_dict.py
+"""
+
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT_DIR = os.path.join(HERE, "ja_eval_dict")
+CORPUS = os.path.join(HERE, "ja_tagged_corpus.tsv")
+
+# context-id classes (0 = BOS/EOS, MeCab convention)
+NOUN, CASE, BIND, ADNOM, VREN, VBAS, AUX, ADJ, ADV, PUNCT = range(1, 11)
+
+NOUNS = """私 犬 猫 鳥 魚 山 川 海 空 雨 雪 風 花 木 森 水 朝 昼 夜 人
+子供 先生 学生 友達 家 学校 会社 駅 道 町 村 国 世界 言葉 本 紙 手紙 机
+椅子 窓 部屋 庭 車 電車 自転車 飛行機 船 音楽 歌 絵 写真 映画 電話 新聞
+雑誌 料理 今日 明日 昨日 今 東京 京都 日本 名前 天気 問題 質問 答え 意味
+話 仕事 旅行 買い物 散歩 勉強 運動 練習 試験 宿題 休み 時間 お金 店 服
+靴 帽子 傘 鞄 箱 石 橋 池 月 星 太陽 地図 公園 病院 銀行 図書館 鶏
+すもも もも うち もの 春 夏 秋 冬 雲 光 声 音 味 色 形 夢 心 力 目 耳
+口 手 足 頭 顔 体 肉 野菜 果物 茶 米 酒 塩 砂糖 卵 牛乳 医者 警察
+兄 姉 弟 妹 父 母 祖父 祖母 家族 犬小屋""".split()
+
+CASE_PARTICLES = "が を に で と へ から まで より や".split()
+BIND_PARTICLES = "は も".split()
+
+# (renyou stem, basic form) pairs
+VERBS = [("食べ", "食べる"), ("見", "見る"), ("行き", "行く"),
+         ("来", "来る"), ("し", "する"), ("読み", "読む"), ("書き", "書く"),
+         ("話し", "話す"), ("聞き", "聞く"), ("買い", "買う"),
+         ("歩き", "歩く"), ("走り", "走る"), ("泳ぎ", "泳ぐ"),
+         ("飲み", "飲む"), ("作り", "作る"), ("使い", "使う"),
+         ("待ち", "待つ"), ("立ち", "立つ"), ("座り", "座る"),
+         ("寝", "寝る"), ("起き", "起きる"), ("働き", "働く"),
+         ("遊び", "遊ぶ"), ("学び", "学ぶ"), ("教え", "教える"),
+         ("帰り", "帰る"), ("入り", "入る"), ("出", "出る"),
+         ("思い", "思う"), ("言い", "言う"), ("泣き", "泣く"),
+         ("笑い", "笑う"), ("歌い", "歌う"), ("撮り", "撮る"),
+         ("売り", "売る"), ("開け", "開ける"), ("閉め", "閉める"),
+         ("届き", "届く"), ("住み", "住む"), ("降り", "降る")]
+AUXES = "ます ました ません た ない です でした たい".split()
+ADJS = """高い 安い 大きい 小さい 新しい 古い 良い 悪い 早い 遅い 暑い
+寒い 白い 黒い 赤い 青い 楽しい 美しい 強い 弱い 長い 短い 重い 軽い
+広い 狭い 近い 遠い 甘い 辛い""".split()
+ADVS = "とても すぐ もう まだ よく 少し たくさん いつも 時々 今朝".split()
+PUNCTS = "。 、".split()
+
+
+def entries():
+    out = []
+    for w in NOUNS:
+        out.append((w, NOUN, NOUN, 3000 + 500 * max(0, 2 - len(w)),
+                    "名詞,一般,*,*,*,*," + w))
+    for w in CASE_PARTICLES:
+        out.append((w, CASE, CASE, 800, "助詞,格助詞,*,*,*,*," + w))
+    for w in BIND_PARTICLES:
+        out.append((w, BIND, BIND, 900, "助詞,係助詞,*,*,*,*," + w))
+    out.append(("の", ADNOM, ADNOM, 700, "助詞,連体化,*,*,*,*,の"))
+    for ren, basic in VERBS:
+        out.append((ren, VREN, VREN, 3200,
+                    f"動詞,自立,*,*,一段,連用形,{basic}"))
+        out.append((basic, VBAS, VBAS, 3400,
+                    f"動詞,自立,*,*,一段,基本形,{basic}"))
+    for w in AUXES:
+        out.append((w, AUX, AUX, 1200, "助動詞,*,*,*,*,基本形," + w))
+    for w in ADJS:
+        out.append((w, ADJ, ADJ, 3300, "形容詞,自立,*,*,*,基本形," + w))
+    for w in ADVS:
+        out.append((w, ADV, ADV, 3100, "副詞,一般,*,*,*,*," + w))
+    for w in PUNCTS:
+        out.append((w, PUNCT, PUNCT, 100, "記号,句点,*,*,*,*," + w))
+    # adversarial longest-match traps: long entries whose COSTS must lose
+    # to the compositional segmentation (the 食べた-noun pattern)
+    out.append(("食べた", NOUN, NOUN, 9000, "名詞,一般,*,*,*,*,食べた"))
+    out.append(("ものの", NOUN, NOUN, 9500, "名詞,一般,*,*,*,*,ものの"))
+    out.append(("日本語", NOUN, NOUN, 2800, "名詞,一般,*,*,*,*,日本語"))
+    out.append(("今日は", NOUN, NOUN, 9800, "名詞,一般,*,*,*,*,今日は"))
+    return out
+
+
+def matrix():
+    """connection(prev.right_id, next.left_id) — MeCab matrix.def layout
+    (rows ``right left cost``). Negative = preferred transition."""
+    n = 11
+    default = 2000
+    m = {(r, l): default for r in range(n) for l in range(n)}
+
+    def set_(r, l, c):
+        m[(r, l)] = c
+
+    BOSEOS = 0
+    for l in (NOUN, ADV, ADJ, VREN, VBAS):
+        set_(BOSEOS, l, 0)          # sentences start with content words
+    set_(BOSEOS, CASE, 6000)
+    set_(BOSEOS, BIND, 6000)
+    set_(BOSEOS, ADNOM, 6000)
+    set_(BOSEOS, AUX, 6000)
+    # noun → particles cheap, noun→noun pricey (compounds are explicit
+    # dictionary entries, not free concatenation)
+    set_(NOUN, CASE, -800)
+    set_(NOUN, BIND, -800)
+    set_(NOUN, ADNOM, -600)
+    set_(NOUN, PUNCT, -200)
+    set_(NOUN, NOUN, 2600)
+    set_(NOUN, AUX, -300)           # 学生です
+    set_(NOUN, BOSEOS, 400)
+    # case particle → content
+    for l in (NOUN, VREN, VBAS, ADJ, ADV):
+        set_(CASE, l, -500)
+    set_(CASE, BIND, 400)           # には, では: particle chains allowed
+    set_(CASE, PUNCT, 3000)
+    # binding particle → content
+    for l in (NOUN, VREN, VBAS, ADJ, ADV):
+        set_(BIND, l, -500)
+    set_(BIND, PUNCT, 3000)
+    # の → noun
+    set_(ADNOM, NOUN, -900)
+    set_(ADNOM, CASE, 4000)
+    set_(ADNOM, BIND, 4000)
+    set_(ADNOM, ADNOM, 4000)
+    # verb renyou → aux strongly
+    set_(VREN, AUX, -1200)
+    set_(VREN, PUNCT, 2500)
+    set_(VREN, BOSEOS, 2500)
+    # verb basic → punct / EOS / noun (relative clause)
+    set_(VBAS, PUNCT, -400)
+    set_(VBAS, BOSEOS, -200)
+    set_(VBAS, NOUN, 600)
+    # aux → aux (ませ+ん not modeled; ました is one entry), punct, EOS
+    set_(AUX, PUNCT, -500)
+    set_(AUX, BOSEOS, -300)
+    set_(AUX, AUX, 800)
+    set_(AUX, NOUN, 1500)
+    # adjective → noun (高い山), punct, EOS, aux (高いです)
+    set_(ADJ, NOUN, -400)
+    set_(ADJ, PUNCT, -200)
+    set_(ADJ, BOSEOS, -100)
+    set_(ADJ, AUX, -200)
+    # adverb → verb/adj
+    for l in (VREN, VBAS, ADJ):
+        set_(ADV, l, -400)
+    set_(ADV, NOUN, 800)
+    # punct → start-ish
+    for l in (NOUN, ADV, ADJ, VREN, VBAS):
+        set_(PUNCT, l, 0)
+    set_(PUNCT, BOSEOS, -500)
+    return n, m
+
+
+# -- corpus ------------------------------------------------------------------
+def sentences():
+    """(gold token list) per sentence; surface = ''.join(tokens)."""
+    S = []
+
+    def s(*toks):
+        S.append(list(toks))
+
+    # everyday SOV sentences
+    s("私", "は", "本", "を", "読み", "ます", "。")
+    s("犬", "が", "庭", "で", "遊び", "ます", "。")
+    s("先生", "は", "学生", "に", "言葉", "を", "教え", "ます", "。")
+    s("子供", "は", "牛乳", "を", "飲み", "ました", "。")
+    s("友達", "と", "映画", "を", "見", "ます", "。")
+    s("母", "は", "料理", "を", "作り", "ました", "。")
+    s("鳥", "が", "空", "へ", "飛行機", "より", "早い", "。")
+    s("私", "は", "東京", "へ", "行き", "ます", "。")
+    s("学生", "は", "図書館", "で", "勉強", "を", "し", "ます", "。")
+    s("父", "は", "新聞", "を", "読み", "ません", "。")
+    s("姉", "は", "歌", "を", "歌い", "ました", "。")
+    s("弟", "は", "川", "で", "泳ぎ", "たい", "。")
+    s("祖母", "は", "手紙", "を", "書き", "ます", "。")
+    s("警察", "は", "町", "を", "歩き", "ます", "。")
+    s("医者", "は", "病院", "で", "働き", "ます", "。")
+    s("雨", "が", "降り", "ます", "。")
+    s("雪", "が", "降り", "ました", "。")
+    s("私", "は", "駅", "から", "家", "まで", "歩き", "ました", "。")
+    # genitive chains
+    s("日本", "の", "山", "は", "高い", "。")
+    s("京都", "の", "寒い", "冬", "の", "朝", "。")
+    s("先生", "の", "話", "は", "長い", "。")
+    s("友達", "の", "犬", "の", "名前", "。")
+    s("世界", "の", "海", "は", "広い", "。")
+    s("子供", "の", "声", "が", "聞き", "たい", "。")
+    # adjectives / adverbs
+    s("今日", "の", "天気", "は", "良い", "です", "。")
+    s("とても", "大きい", "家", "です", "。")
+    s("すぐ", "帰り", "ます", "。")
+    s("まだ", "宿題", "を", "し", "ません", "。")
+    s("いつも", "朝", "は", "早い", "。")
+    s("時々", "海", "へ", "行き", "ます", "。")
+    s("新しい", "服", "を", "買い", "ました", "。")
+    s("古い", "橋", "を", "使い", "ません", "。")
+    s("甘い", "果物", "が", "良い", "。")
+    # particle chains には / では
+    s("庭", "に", "は", "鶏", "が", "遊び", "ます", "。")
+    s("森", "で", "は", "鳥", "が", "歌い", "ます", "。")
+    # adversarial: the classic, plus longest-entry traps
+    s("すもも", "も", "もも", "も", "もも", "の", "うち", "。")
+    s("私", "は", "すもも", "を", "食べ", "た", "。")
+    s("もの", "の", "意味", "を", "聞き", "ます", "。")
+    s("今日", "は", "休み", "です", "。")          # vs 今日は entry
+    s("魚", "を", "食べ", "た", "犬", "。")        # vs 食べた noun
+    s("日本語", "を", "学び", "ます", "。")
+    s("うち", "の", "猫", "は", "黒い", "。")
+    s("もも", "の", "花", "が", "美しい", "。")
+    # longer compositions
+    s("私", "の", "兄", "は", "会社", "で", "働き", "ます", "。")
+    s("昨日", "は", "雨", "でした", "。")
+    s("明日", "の", "朝", "、", "公園", "を", "走り", "ます", "。")
+    s("夏", "の", "夜", "は", "暑い", "です", "。")
+    s("冬", "の", "山", "は", "白い", "。")
+    s("店", "で", "靴", "と", "帽子", "を", "買い", "ました", "。")
+    s("銀行", "の", "近い", "店", "は", "安い", "。")
+    s("池", "の", "魚", "は", "小さい", "。")
+    s("光", "が", "窓", "から", "入り", "ます", "。")
+    s("音楽", "を", "聞き", "たい", "。")
+    s("写真", "を", "撮り", "ました", "。")
+    s("夢", "の", "話", "を", "し", "ました", "。")
+    return [x for x in S if x]
+
+
+def main():
+    os.makedirs(OUT_DIR, exist_ok=True)
+    with open(os.path.join(OUT_DIR, "entries.csv"), "w",
+              encoding="utf-8") as f:
+        for surface, lid, rid, cost, feats in entries():
+            f.write(f"{surface},{lid},{rid},{cost},{feats}\n")
+    n, m = matrix()
+    with open(os.path.join(OUT_DIR, "matrix.def"), "w",
+              encoding="utf-8") as f:
+        f.write(f"{n} {n}\n")
+        for (r, l), c in sorted(m.items()):
+            f.write(f"{r} {l} {c}\n")
+    with open(CORPUS, "w", encoding="utf-8") as f:
+        for toks in sentences():
+            f.write("".join(toks) + "\t" + "|".join(toks) + "\n")
+    print(f"wrote {OUT_DIR} ({len(entries())} entries) and {CORPUS} "
+          f"({len(sentences())} sentences)")
+
+
+if __name__ == "__main__":
+    main()
