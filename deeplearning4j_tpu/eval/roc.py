@@ -234,32 +234,83 @@ class ROC:
 class ROCBinary:
     """Per-output binary ROC for multi-label outputs [N, C]."""
 
-    def __init__(self):
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self.is_exact = self.threshold_steps <= 0
         self.labels = []
         self.scores = []
+        self._per_col = {}  # col -> binned ROC (ROCBinary.java mode)
+
+    def _col_roc(self, col: int) -> "ROC":
+        if col not in self._per_col:
+            self._per_col[col] = ROC(threshold_steps=self.threshold_steps)
+        return self._per_col[col]
 
     def eval(self, labels, predictions, mask=None) -> None:
-        self.labels.append(np.asarray(labels, np.float64))
-        self.scores.append(np.asarray(predictions, np.float64))
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if mask is not None:
+            m = np.asarray(mask).astype(bool).ravel()
+            labels, predictions = labels[m], predictions[m]
+        if self.is_exact:
+            self.labels.append(labels)
+            self.scores.append(predictions)
+        else:
+            for col in range(labels.shape[1]):
+                self._col_roc(col).eval(labels[:, col], predictions[:, col])
 
     def calculate_auc(self, col: int) -> float:
+        if not self.is_exact:
+            return self._col_roc(col).calculate_auc()
         l = np.concatenate(self.labels)[:, col]
         s = np.concatenate(self.scores)[:, col]
         return _auc_roc(l, s)
+
+    def merge(self, other: "ROCBinary") -> "ROCBinary":
+        if self.is_exact != other.is_exact:
+            raise ValueError("cannot merge exact with binned ROCBinary")
+        if self.is_exact:
+            self.labels.extend(other.labels)
+            self.scores.extend(other.scores)
+        else:
+            for col, r in other._per_col.items():
+                self._col_roc(col).merge(r)
+        return self
 
 
 class ROCMultiClass:
     """One-vs-all ROC per class for softmax outputs [N, C]."""
 
-    def __init__(self):
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self.is_exact = self.threshold_steps <= 0
         self.labels = []
         self.scores = []
+        self._per_cls = {}
+
+    def _cls_roc(self, cls: int) -> "ROC":
+        if cls not in self._per_cls:
+            self._per_cls[cls] = ROC(threshold_steps=self.threshold_steps)
+        return self._per_cls[cls]
 
     def eval(self, labels, predictions, mask=None) -> None:
-        self.labels.append(np.asarray(labels, np.float64))
-        self.scores.append(np.asarray(predictions, np.float64))
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if mask is not None:
+            m = np.asarray(mask).astype(bool).ravel()
+            labels, predictions = labels[m], predictions[m]
+        if self.is_exact:
+            self.labels.append(labels)
+            self.scores.append(predictions)
+            return
+        for cls in range(predictions.shape[1]):
+            binary = (labels[:, cls] if labels.ndim == 2
+                      else (labels == cls).astype(np.float64))
+            self._cls_roc(cls).eval(binary, predictions[:, cls])
 
     def calculate_auc(self, cls: int) -> float:
+        if not self.is_exact:
+            return self._cls_roc(cls).calculate_auc()
         l = np.concatenate(self.labels)
         s = np.concatenate(self.scores)
         if l.ndim == 2:
@@ -267,3 +318,14 @@ class ROCMultiClass:
         else:
             binary = (l == cls).astype(np.float64)
         return _auc_roc(binary, s[:, cls])
+
+    def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
+        if self.is_exact != other.is_exact:
+            raise ValueError("cannot merge exact with binned ROCMultiClass")
+        if self.is_exact:
+            self.labels.extend(other.labels)
+            self.scores.extend(other.scores)
+        else:
+            for cls, r in other._per_cls.items():
+                self._cls_roc(cls).merge(r)
+        return self

@@ -566,3 +566,41 @@ class TestBinnedROC:
         r.eval(labels2, preds2, mask=np.array([1, 1, 1, 0]))
         assert r.count_actual_positive == 2
         assert r.count_actual_negative == 1
+
+
+class TestBinnedROCFamilies:
+    def test_rocbinary_binned_merge_tracks_exact(self):
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        rng = np.random.default_rng(4)
+        labels = (rng.random((2000, 3)) < 0.3).astype(np.float64)
+        scores = np.clip(0.5 * labels + rng.normal(0.3, 0.25, (2000, 3)),
+                         0, 1)
+        exact = ROCBinary()
+        exact.eval(labels, scores)
+        a = ROCBinary(threshold_steps=150)
+        b = ROCBinary(threshold_steps=150)
+        a.eval(labels[:1000], scores[:1000])
+        b.eval(labels[1000:], scores[1000:])
+        a.merge(b)
+        for col in range(3):
+            assert a.calculate_auc(col) == pytest.approx(
+                exact.calculate_auc(col), abs=0.015)
+
+    def test_rocmulticlass_binned_merge_tracks_exact(self):
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        rng = np.random.default_rng(5)
+        true = rng.integers(0, 4, 2000)
+        labels = np.eye(4)[true]
+        scores = rng.dirichlet(np.ones(4), 2000)
+        scores[np.arange(2000), true] += 0.3
+        scores = scores / scores.sum(1, keepdims=True)
+        exact = ROCMultiClass()
+        exact.eval(labels, scores)
+        a = ROCMultiClass(threshold_steps=150)
+        b = ROCMultiClass(threshold_steps=150)
+        a.eval(labels[:1000], scores[:1000])
+        b.eval(labels[1000:], scores[1000:])
+        a.merge(b)
+        for cls in range(4):
+            assert a.calculate_auc(cls) == pytest.approx(
+                exact.calculate_auc(cls), abs=0.02)

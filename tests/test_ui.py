@@ -286,3 +286,67 @@ class TestModelDrilldownAndI18n:
             assert "data-i18n" in html and "/train/model/" in html
         finally:
             server.stop()
+
+
+class TestEvaluationModule:
+    """Metadata-backed error drilldown served through the UI module SPI
+    (Evaluation.getPredictionErrors -> web surface)."""
+
+    def _eval(self):
+        from deeplearning4j_tpu.datasets.records import RecordMetaData
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation(top_n=2)
+        labels = np.eye(3)[[0, 0, 1, 2]]
+        preds = np.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1],
+                          [0.1, 0.8, 0.1], [0.6, 0.3, 0.1]])
+        metas = [RecordMetaData(i, uri="eval.csv") for i in range(4)]
+        e.eval(labels, preds, record_meta_data=metas)
+        return e
+
+    def test_routes(self):
+        from deeplearning4j_tpu.ui.modules import EvaluationModule
+        m = EvaluationModule(self._eval())
+        code, body = m.handle("/evaluation")
+        assert code == 200 and body["num_classes"] == 3
+        assert body["has_metadata"] is True
+        assert body["top_n"] == 2
+        code, body = m.handle("/evaluation/errors")
+        assert code == 200
+        assert [(p["actual"], p["predicted"]) for p in body["errors"]] == \
+            [(0, 1), (2, 0)]
+        assert body["errors"][0]["record"] == "eval.csv:1"
+        code, body = m.handle("/evaluation/by-predicted/1")
+        assert code == 200 and len(body["predictions"]) == 2
+        code, body = m.handle("/evaluation/cell/2/0")
+        assert code == 200 and len(body["predictions"]) == 1
+        code, body = m.handle("/evaluation/panel")
+        assert code == 200 and "misclassified" in body["html"]
+
+    def test_no_metadata_404(self):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.ui.modules import EvaluationModule
+        e = Evaluation()
+        e.eval(np.eye(2)[[0, 1]], np.array([[0.9, 0.1], [0.2, 0.8]]))
+        m = EvaluationModule(e)
+        code, body = m.handle("/evaluation/errors")
+        assert code == 404
+        code, body = m.handle("/evaluation")
+        assert code == 200 and body["has_metadata"] is False
+
+    def test_registered_on_server(self):
+        from deeplearning4j_tpu.ui.modules import (EvaluationModule,
+                                                   register_module)
+        from deeplearning4j_tpu.ui.server import UIServer
+        server = UIServer(port=0)
+        mod = EvaluationModule(self._eval())
+        register_module(server, mod)
+        port = server.start()
+        try:
+            import json as _json
+            import urllib.request
+            url = f"http://127.0.0.1:{port}/evaluation/errors"
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = _json.loads(r.read())
+            assert len(body["errors"]) == 2
+        finally:
+            server.stop()

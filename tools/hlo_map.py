@@ -1,0 +1,296 @@
+"""HLO-text analysis helpers for the TPU perf session.
+
+Maps profiled op names back to what they COMPUTE: every instruction in the
+module is indexed (name -> shape/opkind/metadata), fusions resolve to their
+body instructions, conv FLOPs are computed by resolving operand shapes, and
+classification uses the jax METADATA op_name (scope paths such as
+``transpose(jvp(...))/conv_general_dilated``), not XLA's fusion names —
+round 1's mislabeled-fusion lesson.
+"""
+
+import re
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+DTYPE_BYTES = {"bf16": 2, "f32": 4, "f16": 2, "s32": 4, "pred": 1,
+               "u32": 4, "s8": 1, "u8": 1, "f64": 8, "s64": 8, "u64": 8}
+
+# TPU HLO types carry layout/tiling annotations (e.g.
+# bf16[256]{0:T(256)(128)(2,1)S(1)}) and tuple types, so the type token
+# cannot be matched with a simple char class: find the opcode as the first
+# lowercase word followed by '(' after '=' (dtypes are followed by '[',
+# layout tokens are digits/uppercase).
+_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*)$")
+_OPCODE_RE = re.compile(r"(?:^|\s)([a-z][a-z0-9\-_]*)\(")
+_META_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_CALLS_RE = re.compile(r"calls=%?([\w.\-]+)")
+
+
+def shape_of(tok):
+    """First shape in a type token -> (elem_count, shape tuple, dtype)."""
+    m = _SHAPE_RE.search(tok)
+    if not m:
+        return 0, (), None
+    dt, dims = m.group(1), m.group(2)
+    shape = tuple(int(d) for d in dims.split(",") if d) if dims else ()
+    n = 1
+    for d in shape:
+        n *= d
+    return n, shape, dt
+
+
+class HloModule:
+    def __init__(self, txt):
+        self.instr = {}        # name -> dict (first definition wins)
+        self.by_comp = {}      # computation -> {name -> dict}
+        self.comp_members = {}  # computation name -> [instr names]
+        self.entry = []        # instr names in ENTRY
+        cur_comp = None
+        in_entry = False
+        for raw in txt.splitlines():
+            s = raw.strip()
+            if s.startswith("ENTRY"):
+                in_entry = True
+                cur_comp = "__entry__"
+                self.comp_members[cur_comp] = []
+                continue
+            m_comp = re.match(r"^%?([\w.\-]+)\s+\(.*\)\s*->\s*.*\{$", s)
+            if m_comp and not s.startswith("ENTRY"):
+                cur_comp = m_comp.group(1)
+                in_entry = False
+                self.comp_members[cur_comp] = []
+                continue
+            if s.startswith("}"):
+                cur_comp = None
+                in_entry = False
+                continue
+            m = _NAME_RE.match(s)
+            if not m or cur_comp is None:
+                continue
+            name, rest = m.groups()
+            om = _OPCODE_RE.search(rest)
+            if not om:
+                continue
+            outtok, opkind = rest[:om.start()].strip(), om.group(1)
+            meta = _META_RE.search(s)
+            cm = _CALLS_RE.search(s)
+            info = {
+                "out": outtok, "op": opkind, "line": s,
+                "meta": meta.group(1) if meta else "",
+                "calls": cm.group(1) if cm else None,
+                "comp": cur_comp,
+            }
+            # names like param_0 repeat in every fused computation —
+            # resolution must be computation-local first (a global-only
+            # map silently resolves operands against the WRONG computation)
+            self.by_comp.setdefault(cur_comp, {})[name] = info
+            if name not in self.instr:
+                self.instr[name] = info
+            self.comp_members[cur_comp].append(name)
+            if in_entry:
+                self.entry.append(name)
+
+    # ------------------------------------------------------------ resolve
+    def body_of(self, name):
+        """Instruction names inside a fusion (or [name] itself)."""
+        info = self.instr.get(name)
+        if info is None:
+            return []
+        if info["calls"] and info["calls"] in self.comp_members:
+            return self.comp_members[info["calls"]]
+        return [name]
+
+    def member_infos(self, name):
+        """Info dicts of a fusion's body instructions, resolved in the
+        CALLED computation's namespace (param names collide globally)."""
+        info = self.instr.get(name)
+        if info is None:
+            return []
+        if info["calls"] and info["calls"] in self.comp_members:
+            comp = info["calls"]
+            return [self.by_comp[comp][m] for m in self.comp_members[comp]]
+        return [info]
+
+    def operand_shapes(self, line, comp=None):
+        """Shapes of the operands of an instruction line. The operand list
+        is the balanced paren group right after the opcode (layout
+        annotations both before and inside it contain parens, so naive
+        regex grouping fails); top-level commas split operands. ``comp``
+        scopes name resolution to the instruction's own computation."""
+        rest = line.split("=", 1)
+        if len(rest) < 2:
+            return []
+        om = _OPCODE_RE.search(rest[1])
+        if not om:
+            return []
+        s = rest[1]
+        start = s.index("(", om.end() - 1)
+        depth, toks, cur = 0, [], []
+        for ch in s[start:]:
+            if ch == "(":
+                depth += 1
+                if depth == 1:
+                    continue
+            elif ch == ")":
+                depth -= 1
+                if depth == 0:
+                    toks.append("".join(cur))
+                    break
+            elif ch == "," and depth == 1:
+                toks.append("".join(cur))
+                cur = []
+                continue
+            cur.append(ch)
+        local = self.by_comp.get(comp, {})
+        out = []
+        for tok in toks:
+            tok = tok.strip()
+            key = tok.lstrip("%")
+            ref = local.get(key) or self.instr.get(key)
+            if ref:
+                out.append(shape_of(ref["out"]))
+            else:
+                out.append(shape_of(tok))  # inline-typed operand
+        return out
+
+    # --------------------------------------------------------------- conv
+    @staticmethod
+    def _dim_taps(out_size, win, stride, pad_lo, lhs_dil, rhs_dil, in_size):
+        """Σ over output positions of VALID window taps in one spatial dim.
+        XLA canonicalizes backward convs into forms where most taps fall in
+        padding or dilation holes (e.g. a 1x1 input-grad appears as a
+        55x55-window conv with pad=54) — counting nominal window size
+        overstates FLOPs by orders of magnitude."""
+        total = 0
+        for o in range(out_size):
+            base = o * stride - pad_lo
+            for w in range(win):
+                pos = base + w * rhs_dil
+                if pos % lhs_dil:
+                    continue
+                if 0 <= pos // lhs_dil < in_size:
+                    total += 1
+        return total
+
+    def conv_flops(self, info):
+        """FLOPs + out shape of one convolution instruction:
+        2 * out_nonspatial * contracted * Π_d valid_taps_d."""
+        if isinstance(info, str):
+            info = self.instr[info]
+        line = info["line"]
+        _, out_shape, _ = shape_of(info["out"])
+        dl = re.search(r"dim_labels=(\S+?)(,|\s|$)", line)
+        ops = self.operand_shapes(line, info["comp"])
+        if not out_shape or not dl or len(ops) < 2:
+            return 0, out_shape
+        specs = dl.group(1)
+        lspec, rest = specs.split("_")
+        rspec, ospec = rest.split("->")
+        _, lhs_shape, _ = ops[0]
+        _, rhs_shape, _ = ops[1]
+        if ("i" not in rspec or len(rspec) != len(rhs_shape)
+                or len(lspec) != len(lhs_shape)
+                or len(ospec) != len(out_shape)):
+            return 0, out_shape
+        contracted = rhs_shape[rspec.index("i")]
+        spatial = [ch for ch in ospec if ch.isdigit()]
+        wspec = re.search(r"window=\{([^}]*)\}", line)
+        wtxt = wspec.group(1) if wspec else ""
+        geti = lambda key, n, dflt: (
+            [int(v) for v in m.group(1).split("x")]
+            if (m := re.search(key + r"=([\dx]+)", wtxt)) else [dflt] * n)
+        n = len(spatial)
+        sizes = geti("size", n, 1)
+        strides = geti("stride", n, 1)
+        lhsd = geti("lhs_dilate", n, 1)
+        rhsd = geti("rhs_dilate", n, 1)
+        pm = re.search(r"pad=([-\dx_]+)", wtxt)
+        pads = ([tuple(int(v) for v in p.split("_"))
+                 for p in pm.group(1).split("x")] if pm else [(0, 0)] * n)
+        taps = 1
+        for d, ch in enumerate(spatial):
+            out_size = out_shape[ospec.index(ch)]
+            in_size = lhs_shape[lspec.index(ch)]
+            taps *= self._dim_taps(out_size, sizes[d], strides[d],
+                                   pads[d][0], lhsd[d], rhsd[d], in_size)
+        out_nonspatial = 1
+        for i, ch in enumerate(ospec):
+            if not ch.isdigit():
+                out_nonspatial *= out_shape[i]
+        return 2 * out_nonspatial * contracted * taps, out_shape
+
+    # ------------------------------------------------------------ classify
+    def classify(self, name, batch):
+        """(category, flops) for a profiled instruction name."""
+        info = self.instr.get(name)
+        if info is None:
+            return "unmatched", 0
+        members = self.member_infos(name)
+        metas = [m["meta"] for m in members] + [info["meta"]]
+        ops = [m["op"] for m in members]
+        flops = 0
+        conv_infos = [m for m in members if m["op"] == "convolution"]
+        if info["op"] == "convolution":
+            conv_infos = [info]
+        if conv_infos:
+            cats = set()
+            for ci in conv_infos:
+                f, out_shape = self.conv_flops(ci)
+                flops += f
+                line = ci["line"]
+                out_elems = 1
+                for d in out_shape:
+                    out_elems *= d
+                op_elems = [n for (n, _, _)
+                            in self.operand_shapes(line, ci["comp"]) if n]
+                rev = re.search(r"rhs_reversal=([\dx]+)", line)
+                lhsd = re.search(r"lhs_dilate=([\dx]+)", line)
+                # filter grads contract the batch dim: their output (a
+                # kernel) is far smaller than either operand
+                if op_elems and out_elems * 4 < min(op_elems):
+                    cats.add("conv_bwd_filter")
+                elif ((rev and any(v != "0" for v in
+                                   rev.group(1).split("x")))
+                      or (lhsd and any(v != "1" for v in
+                                       lhsd.group(1).split("x")))
+                      or "transpose(" in ci["meta"]):
+                    cats.add("conv_bwd_input")
+                else:
+                    # NOTE: 1x1 stride-1 input-grad convs with stripped
+                    # metadata are structurally identical to forward convs
+                    # and land here — fwd/bwd_input may blur for those
+                    cats.add("conv_fwd")
+            cat = (sorted(cats)[0] if len(cats) == 1
+                   else "conv_mixed")
+            return cat, flops
+        joined = " ".join(metas)
+        if "select_and_scatter" in joined or "select-and-scatter" in ops:
+            return "maxpool_bwd", 0
+        if "reduce_window" in joined or any(o == "reduce-window"
+                                            for o in ops):
+            if "transpose(" in joined or "vjp" in joined:
+                return "pool_bwd", 0
+            return "pool_fwd", 0
+        if any(o == "dot" for o in ops):
+            return "matmul", 0
+        if any(o == "reduce" for o in ops):
+            return "reduction", 0
+        if info["op"] in ("copy", "transpose", "bitcast", "reshape",
+                          "copy-start", "copy-done"):
+            return "copy", 0
+        if info["op"] in ("all-reduce", "all-gather", "reduce-scatter"):
+            return "collective", 0
+        return "elementwise", 0
+
+    def stream_bytes(self, name):
+        """Approximate bytes moved by an elementwise fusion: output plus
+        every parameter of its fused computation."""
+        info = self.instr.get(name)
+        if info is None:
+            return 0
+        n, shape, dt = shape_of(info["out"])
+        total = n * DTYPE_BYTES.get(dt, 4)
+        for mi in self.member_infos(name):
+            if mi["op"] == "parameter":
+                pn, _, pdt = shape_of(mi["out"])
+                total += pn * DTYPE_BYTES.get(pdt, 4)
+        return total
