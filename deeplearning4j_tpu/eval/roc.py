@@ -239,6 +239,7 @@ class ROCBinary:
         self.is_exact = self.threshold_steps <= 0
         self.labels = []
         self.scores = []
+        self.masks = []  # per-batch [N, C] masks (or None), exact mode
         self._per_col = {}  # col -> binned ROC (ROCBinary.java mode)
 
     def _col_roc(self, col: int) -> "ROC":
@@ -249,22 +250,33 @@ class ROCBinary:
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
+        m2 = None  # [N, C] per-output mask (ROCBinary.java supports both)
         if mask is not None:
-            m = np.asarray(mask).astype(bool).ravel()
-            labels, predictions = labels[m], predictions[m]
+            m = np.asarray(mask).astype(bool)
+            if m.ndim == 2:
+                m2 = m
+            else:
+                m = m.ravel()
+                labels, predictions = labels[m], predictions[m]
         if self.is_exact:
             self.labels.append(labels)
             self.scores.append(predictions)
+            self.masks.append(m2)
         else:
             for col in range(labels.shape[1]):
-                self._col_roc(col).eval(labels[:, col], predictions[:, col])
+                keep = slice(None) if m2 is None else m2[:, col]
+                self._col_roc(col).eval(labels[keep, col],
+                                        predictions[keep, col])
 
     def calculate_auc(self, col: int) -> float:
         if not self.is_exact:
             return self._col_roc(col).calculate_auc()
         l = np.concatenate(self.labels)[:, col]
         s = np.concatenate(self.scores)[:, col]
-        return _auc_roc(l, s)
+        ms = [np.ones(len(lb), bool) if mk is None else mk[:, col]
+              for lb, mk in zip(self.labels, self.masks)]
+        keep = np.concatenate(ms)
+        return _auc_roc(l[keep], s[keep])
 
     def merge(self, other: "ROCBinary") -> "ROCBinary":
         if self.is_exact != other.is_exact:
@@ -272,6 +284,7 @@ class ROCBinary:
         if self.is_exact:
             self.labels.extend(other.labels)
             self.scores.extend(other.scores)
+            self.masks.extend(other.masks)
         else:
             for col, r in other._per_col.items():
                 self._col_roc(col).merge(r)
@@ -297,7 +310,14 @@ class ROCMultiClass:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
         if mask is not None:
-            m = np.asarray(mask).astype(bool).ravel()
+            # one-vs-all over softmax outputs: a mask is per-EXAMPLE; a 2-D
+            # [N, 1] column is accepted and flattened
+            m = np.asarray(mask).astype(bool)
+            if m.ndim == 2 and m.shape[1] != 1:
+                raise ValueError(
+                    "ROCMultiClass masks are per-example ([N] or [N,1]); "
+                    f"got shape {m.shape}")
+            m = m.ravel()
             labels, predictions = labels[m], predictions[m]
         if self.is_exact:
             self.labels.append(labels)

@@ -604,3 +604,45 @@ class TestBinnedROCFamilies:
         for cls in range(4):
             assert a.calculate_auc(cls) == pytest.approx(
                 exact.calculate_auc(cls), abs=0.02)
+
+
+class TestROCFamilyMasks:
+    def test_rocbinary_per_output_mask(self):
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        labels = np.array([[1, 0], [0, 1], [1, 1], [0, 0]], np.float64)
+        scores = np.array([[0.9, 0.2], [0.1, 0.8], [0.8, 0.7], [0.2, 0.1]])
+        m2 = np.array([[1, 1], [1, 0], [1, 1], [0, 1]], np.float64)
+        for steps in (0, 50):
+            r = ROCBinary(threshold_steps=steps)
+            r.eval(labels, scores, mask=m2)
+            # col 0 keeps rows 0,1,2; col 1 keeps rows 0,2,3
+            ref0 = ROCBinary(threshold_steps=steps)
+            ref0.eval(labels[[0, 1, 2]], scores[[0, 1, 2]])
+            assert r.calculate_auc(0) == pytest.approx(ref0.calculate_auc(0))
+            ref1 = ROCBinary(threshold_steps=steps)
+            ref1.eval(labels[[0, 2, 3]], scores[[0, 2, 3]])
+            assert r.calculate_auc(1) == pytest.approx(ref1.calculate_auc(1))
+
+    def test_rocbinary_exact_mode_1d_mask(self):
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        labels = np.array([[1, 0], [0, 1], [1, 0]], np.float64)
+        scores = np.array([[0.9, 0.2], [0.1, 0.8], [0.3, 0.4]])
+        r = ROCBinary()
+        r.eval(labels, scores, mask=np.array([1, 1, 0]))
+        ref = ROCBinary()
+        ref.eval(labels[:2], scores[:2])
+        assert r.calculate_auc(0) == pytest.approx(ref.calculate_auc(0))
+
+    def test_rocmulticlass_mask_shapes(self):
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        labels = np.eye(3)[[0, 1, 2, 0]]
+        scores = np.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2],
+                           [0.1, 0.2, 0.7], [0.5, 0.3, 0.2]])
+        r = ROCMultiClass()
+        r.eval(labels, scores, mask=np.array([[1], [1], [1], [0]]))
+        ref = ROCMultiClass()
+        ref.eval(labels[:3], scores[:3])
+        assert r.calculate_auc(0) == pytest.approx(ref.calculate_auc(0))
+        with pytest.raises(ValueError, match="per-example"):
+            ROCMultiClass().eval(labels, scores,
+                                 mask=np.ones((4, 3)))

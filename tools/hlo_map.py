@@ -289,8 +289,16 @@ class HloModule:
             return 0
         n, shape, dt = shape_of(info["out"])
         total = n * DTYPE_BYTES.get(dt, 4)
-        for mi in self.member_infos(name):
-            if mi["op"] == "parameter":
-                pn, _, pdt = shape_of(mi["out"])
-                total += pn * DTYPE_BYTES.get(pdt, 4)
+        members = self.member_infos(name)
+        if len(members) == 1 and members[0] is info:
+            # unfused op (plain copy/transpose/add): count its operand
+            # reads, or the reported GB/s understates traffic ~2x
+            for pn, _, pdt in self.operand_shapes(info["line"],
+                                                  info["comp"]):
+                total += pn * DTYPE_BYTES.get(pdt or "f32", 4)
+        else:
+            for mi in members:
+                if mi["op"] == "parameter":
+                    pn, _, pdt = shape_of(mi["out"])
+                    total += pn * DTYPE_BYTES.get(pdt, 4)
         return total

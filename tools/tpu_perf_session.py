@@ -356,9 +356,13 @@ def analyze(net, ds, out_path, do_roofline=True):
                   f"{r['time_s']*1e3:7.2f} ms isolated fwd+bwd", flush=True)
         print(f"  isolated conv total (fwd+bwd all layers): "
               f"{iso_total:.1f} ms/step", flush=True)
-        print(f"  in-step conv bucket time:                 "
-              f"{step_conv_ms:.1f} ms/step  "
-              f"(ratio {step_conv_ms/iso_total:.2f})", flush=True)
+        if iso_total > 0:
+            print(f"  in-step conv bucket time:                 "
+                  f"{step_conv_ms:.1f} ms/step  "
+                  f"(ratio {step_conv_ms/iso_total:.2f})", flush=True)
+        else:
+            print("  (no conv microbenches succeeded — ratio unavailable; "
+                  "bench+profile results still written)", flush=True)
 
         print("\n== bandwidth-bound buckets vs HBM ==", flush=True)
         # v5e HBM is ~819 GB/s; each elementwise/copy op's achieved GB/s
