@@ -42,3 +42,10 @@ __all__ = [
     "VGG16", "VGG19", "YOLO2", "beam_search", "generate",
     "generate_on_device", "lm_labels",
 ]
+from deeplearning4j_tpu.zoo.labels import (  # noqa: F401
+    ClassPrediction,
+    COCOLabels,
+    ImageNetLabels,
+    Labels,
+    VOCLabels,
+)

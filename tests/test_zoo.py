@@ -244,3 +244,52 @@ class TestInitPretrained:
         monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path / "empty"))
         with pytest.raises(FileNotFoundError, match="No pretrained weights"):
             LeNet().init_pretrained(PretrainedType.VGGFACE)
+
+
+class TestLabels:
+    """zoo/util label helpers (Labels SPI, decodePredictions,
+    VOC/COCO/ImageNet tables)."""
+
+    def test_voc_and_coco_tables(self):
+        from deeplearning4j_tpu.zoo.labels import COCOLabels, VOCLabels
+        voc, coco = VOCLabels(), COCOLabels()
+        assert len(voc) == 20 and len(coco) == 80
+        assert voc.get_label(14) == "person"
+        assert coco.get_label(0) == "person"
+        assert coco.get_label(79) == "toothbrush"
+
+    def test_decode_predictions_top5(self):
+        from deeplearning4j_tpu.zoo.labels import VOCLabels
+        rng = np.random.default_rng(0)
+        probs = rng.dirichlet(np.ones(20), size=3)
+        probs[1, 7] = 5.0  # cat dominates example 1
+        probs = probs / probs.sum(1, keepdims=True)
+        decoded = VOCLabels().decode_predictions(probs, top=5)
+        assert len(decoded) == 3 and len(decoded[0]) == 5
+        assert decoded[1][0].label == "cat"
+        assert decoded[1][0].probability > 0.5
+        # descending probability within each example
+        ps = [c.probability for c in decoded[0]]
+        assert ps == sorted(ps, reverse=True)
+
+    def test_class_count_mismatch_raises(self):
+        from deeplearning4j_tpu.zoo.labels import VOCLabels
+        with pytest.raises(ValueError, match="label"):
+            VOCLabels().decode_predictions(np.ones((2, 80)) / 80)
+
+    def test_imagenet_loads_keras_index_format(self, tmp_path, monkeypatch):
+        import json
+        from deeplearning4j_tpu.zoo.labels import ImageNetLabels
+        idx = {str(i): [f"n{i:08d}", f"class_{i}"] for i in range(1000)}
+        idx["0"] = ["n01440764", "tench"]
+        p = tmp_path / "imagenet_class_index.json"
+        p.write_text(json.dumps(idx))
+        labels = ImageNetLabels(str(p))
+        assert len(labels) == 1000
+        assert labels.get_label(0) == "tench"
+        # env-dir resolution
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path))
+        assert ImageNetLabels().get_label(0) == "tench"
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path / "none"))
+        with pytest.raises(FileNotFoundError, match="label table"):
+            ImageNetLabels()
