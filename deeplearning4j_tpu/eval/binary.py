@@ -40,6 +40,20 @@ class EvaluationBinary:
         self.tn += np.sum(valid & ~labels & ~preds, axis=0)
         self.fn += np.sum(valid & labels & ~preds, axis=0)
 
+    def merge(self, other: "EvaluationBinary") -> "EvaluationBinary":
+        """Distributed merge (``BaseEvaluation.merge``): count addition."""
+        if other.tp is None:
+            return self
+        if self.tp is None:
+            self.tp, self.fp = other.tp.copy(), other.fp.copy()
+            self.tn, self.fn = other.tn.copy(), other.fn.copy()
+            return self
+        self.tp += other.tp
+        self.fp += other.fp
+        self.tn += other.tn
+        self.fn += other.fn
+        return self
+
     def accuracy(self, col: int = 0) -> float:
         total = self.tp[col] + self.fp[col] + self.tn[col] + self.fn[col]
         return float(self.tp[col] + self.tn[col]) / max(total, 1)

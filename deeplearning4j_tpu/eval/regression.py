@@ -47,6 +47,24 @@ class RegressionEvaluation:
         self.abs_err_sum += np.abs(labels - predictions).sum(0)
         self.sq_err_sum += ((labels - predictions) ** 2).sum(0)
 
+    def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
+        """Distributed merge (``BaseEvaluation.merge``): every metric here
+        is derived from per-column sums, so merging is sum addition."""
+        if other.labels_sum is None:
+            return self
+        if self.labels_sum is None:
+            for a in ("labels_sum", "labels_sq_sum", "preds_sum",
+                      "preds_sq_sum", "cross_sum", "abs_err_sum",
+                      "sq_err_sum"):
+                setattr(self, a, getattr(other, a).copy())
+            self.n = other.n
+            return self
+        for a in ("labels_sum", "labels_sq_sum", "preds_sum",
+                  "preds_sq_sum", "cross_sum", "abs_err_sum", "sq_err_sum"):
+            setattr(self, a, getattr(self, a) + getattr(other, a))
+        self.n += other.n
+        return self
+
     def mean_squared_error(self, col: int = 0) -> float:
         return float(self.sq_err_sum[col] / self.n)
 
