@@ -1,0 +1,149 @@
+"""tools/hlo_map.py — the HLO-text analysis behind the perf roofline.
+
+The parser is load-bearing for the recorded perf evidence (ROOFLINE_r03),
+so its subtle parts are locked here: TPU layout-annotated type tokens,
+computation-local operand namespaces (param_N collides globally), balanced
+operand-list scanning, valid-tap conv FLOP counting (XLA's canonicalized
+backward convs bury a 1x1's work under a 55x55 window of padding), and
+metadata/structure-based classification.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from hlo_map import HloModule, shape_of  # noqa: E402
+
+
+MINI_HLO = """
+HloModule step, entry_computation_layout={()->()}
+
+%fused_computation.1 (param_0.1: bf16[8,6,6,4], param_1.2: bf16[3,3,4,16]) -> bf16[8,6,6,16] {
+  %param_0.1 = bf16[8,6,6,4]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %param_1.2 = bf16[3,3,4,16]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  ROOT %conv.1 = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} convolution(%param_0.1, %param_1.2), window={size=3x3 pad=1_1x1_1}, dim_labels=b01f_01io->b01f, metadata={op_name="jit(step)/jvp()/conv_general_dilated"}
+}
+
+%fused_computation.2 (param_0.3: bf16[16], param_1.4: bf16[8,6,6,16]) -> bf16[8,6,6,16] {
+  %param_0.3 = bf16[16]{0:T(256)(128)(2,1)} parameter(0)
+  %param_1.4 = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  %broadcast.1 = bf16[8,6,6,16]{3,2,1,0} broadcast(%param_0.3), dimensions={3}
+  ROOT %add.1 = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} add(%param_1.4, %broadcast.1)
+}
+
+ENTRY %step () -> bf16[8,6,6,16] {
+  %p0 = bf16[8,6,6,4]{3,2,1,0:T(8,128)(2,1)} parameter(0)
+  %p1 = bf16[3,3,4,16]{3,2,1,0:T(8,128)(2,1)} parameter(1)
+  %p2 = bf16[16]{0:T(256)(128)(2,1)} parameter(2)
+  %fusion.1 = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} fusion(%p0, %p1), kind=kOutput, calls=%fused_computation.1
+  %fusion.2 = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} fusion(%p2, %fusion.1), kind=kLoop, calls=%fused_computation.2
+  %bwd_in = bf16[8,6,6,4]{3,2,1,0:T(8,128)(2,1)} convolution(%fusion.2, %p1), window={size=3x3 pad=1_1x1_1 rhs_reversal=1x1}, dim_labels=b01f_01oi->b01f, metadata={op_name="jit(step)/transpose(jvp())/conv_general_dilated"}
+  %canon = bf16[8,6,6,4]{3,2,1,0:T(8,128)(2,1)} convolution(%p1, %fusion.2), window={size=6x6 pad=5_5x5_5}, dim_labels=01bf_o01i->f01b
+  %big0 = bf16[64,6,6,4]{3,2,1,0:T(8,128)(2,1)} parameter(3)
+  %big1 = bf16[64,6,6,16]{3,2,1,0:T(8,128)(2,1)} parameter(4)
+  %grad_w = bf16[3,3,4,16]{3,2,1,0:T(8,128)(2,1)} convolution(%big0, %big1), window={size=6x6 pad=1_1x1_1}, dim_labels=f01b_i01o->01bf
+  %mp = bf16[8,3,3,16]{3,2,1,0} reduce-window(%fusion.2, %p2), window={size=1x2x2x1 stride=1x2x2x1}
+  %sas = bf16[8,6,6,16]{3,2,1,0} select-and-scatter(%fusion.2, %mp, %p2), window={size=1x2x2x1}
+  ROOT %out = bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)} copy(%fusion.2)
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return HloModule(MINI_HLO)
+
+
+class TestParsing:
+    def test_shape_of_handles_tpu_layouts(self):
+        n, shape, dt = shape_of("bf16[8,6,6,16]{3,2,1,0:T(8,128)(2,1)}")
+        assert shape == (8, 6, 6, 16) and dt == "bf16"
+        assert n == 8 * 6 * 6 * 16
+        n2, shape2, dt2 = shape_of("f32[256]{0:T(256)(128)(2,1)S(1)}")
+        assert shape2 == (256,) and dt2 == "f32"
+
+    def test_entry_and_computations_indexed(self, mod):
+        assert "fusion.1" in mod.entry and "bwd_in" in mod.entry
+        assert "conv.1" in mod.comp_members["fused_computation.1"]
+
+    def test_param_names_resolve_computation_locally(self, mod):
+        # param_0.* differs per computation; conv.1's lhs must resolve to
+        # the [8,6,6,4] input of ITS computation, not another's param
+        info = mod.by_comp["fused_computation.1"]["conv.1"]
+        ops = mod.operand_shapes(info["line"], info["comp"])
+        assert ops[0][1] == (8, 6, 6, 4)
+        assert ops[1][1] == (3, 3, 4, 16)
+
+    def test_operand_scan_survives_layout_parens(self, mod):
+        info = mod.instr["fusion.2"]
+        ops = mod.operand_shapes(info["line"], "__entry__")
+        assert [o[1] for o in ops] == [(16,), (8, 6, 6, 16)]
+
+
+class TestConvFlops:
+    def test_forward_conv_flops(self, mod):
+        info = mod.by_comp["fused_computation.1"]["conv.1"]
+        flops, out_shape = mod.conv_flops(info)
+        assert out_shape == (8, 6, 6, 16)
+        # 'same' 3x3 over 6x6: interior taps = sum over positions of valid
+        # taps = (6*3 - 2)^2 per dim pair; per-dim: 16 valid (6 pos * 3 - 2)
+        taps_1d = sum(1 for o in range(6) for w in range(3)
+                      if 0 <= o + w - 1 < 6)
+        assert flops == 2 * (8 * 16) * 4 * taps_1d * taps_1d
+
+    def test_canonicalized_backward_conv_not_overcounted(self, mod):
+        # window=6x6 pad=5_5: only one valid tap per output position —
+        # nominal counting would overstate by 36x
+        info = mod.instr["canon"]
+        flops, _ = mod.conv_flops(info)
+        # lhs spatial is 3 (the [3,3,4,16] "kernel-as-input"): valid taps
+        # per dim = #{o,w in 0..5 : 0 <= o+w-5 < 3} = 15, vs the naive
+        # window count of 36 per dim — a 5.8x per-dim overcount avoided
+        taps_1d = sum(1 for o in range(6) for w in range(6)
+                      if 0 <= o + w - 5 < 3)
+        assert taps_1d == 15
+        # kernel operand is %fusion.2 [8,6,6,16] with spec o01i -> i=16
+        assert flops == 2 * (8 * 4) * 16 * taps_1d * taps_1d
+
+
+class TestClassification:
+    def test_fused_forward_conv(self, mod):
+        cat, flops = mod.classify("fusion.1", 8)
+        assert cat == "conv_fwd" and flops > 0
+
+    def test_elementwise_fusion(self, mod):
+        cat, flops = mod.classify("fusion.2", 8)
+        assert cat == "elementwise" and flops == 0
+
+    def test_bwd_input_by_rhs_reversal(self, mod):
+        cat, _ = mod.classify("bwd_in", 8)
+        assert cat == "conv_bwd_input"
+
+    def test_bwd_filter_by_small_output(self, mod):
+        cat, _ = mod.classify("grad_w", 8)
+        assert cat == "conv_bwd_filter"
+
+    def test_pool_and_scatter_and_copy(self, mod):
+        assert mod.classify("mp", 8)[0] == "pool_fwd"
+        assert mod.classify("sas", 8)[0] == "maxpool_bwd"
+        assert mod.classify("out", 8)[0] == "copy"
+
+    def test_unmatched(self, mod):
+        assert mod.classify("nonexistent.999", 8)[0] == "unmatched"
+
+
+class TestStreamBytes:
+    def test_fusion_counts_params_and_output(self, mod):
+        # fusion.2: out [8,6,6,16] bf16 + params [16] + [8,6,6,16]
+        b = mod.stream_bytes("fusion.2")
+        big = 8 * 6 * 6 * 16 * 2
+        assert b == big + 16 * 2 + big
+
+    def test_unfused_copy_counts_operand_reads(self, mod):
+        b = mod.stream_bytes("out")
+        big = 8 * 6 * 6 * 16 * 2
+        assert b == 2 * big  # read + write
