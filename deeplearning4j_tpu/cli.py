@@ -108,15 +108,102 @@ def cluster_setup_main(argv: Optional[List[str]] = None, runner=None):
     return cluster
 
 
+def _lower_step_hlo(net, ds) -> str:
+    """Compiled HLO text of the net's jitted train step (MLN or graph)."""
+    import jax.numpy as jnp
+    dtype = net.conf.global_conf.jnp_dtype()
+    it = jnp.asarray(net.iteration, jnp.float32)
+    ep = jnp.asarray(net.epoch, jnp.float32)
+    rng = net._next_rng()
+    if hasattr(net, "_to_mds"):  # ComputationGraph
+        mds = net._to_mds(ds)
+        inputs = {n: jnp.asarray(f, dtype)
+                  for n, f in zip(net.conf.inputs, mds.features)}
+        labels = [jnp.asarray(l, dtype) for l in mds.labels]
+        step = net._get_train_step()
+        lowered = step.lower(net.params, net.states, net.updater_states,
+                             it, ep, inputs, labels, None, None, rng)
+    else:  # MultiLayerNetwork
+        x = jnp.asarray(np.asarray(ds.features), dtype)
+        y = jnp.asarray(np.asarray(ds.labels), dtype)
+        step = net._get_train_step(False)
+        lowered = step.lower(net.params, net.states, net.updater_states,
+                             it, ep, x, y, None, None, rng, None)
+    return lowered.compile().as_text()
+
+
+def profile_main(argv: Optional[List[str]] = None):
+    """Profile a saved model's jitted train step on the current backend:
+    a trace window via ProfilerListener, bucketed per-op device time via
+    the HLO-mapped xplane analysis (the tools/tpu_perf_session.py
+    machinery, exposed as a framework command)."""
+    import json as _json
+    import os as _os
+
+    ap = argparse.ArgumentParser("profile")
+    ap.add_argument("--modelPath", required=True,
+                    help="model zip written by ModelSerializer")
+    ap.add_argument("--dataPath", required=True,
+                    help=".npz with 'features' and 'labels' arrays")
+    ap.add_argument("--batchSize", type=int, default=32)
+    ap.add_argument("--logDir", default="/tmp/dl4j_tpu_profile")
+    ap.add_argument("--out", default=None, help="write the report JSON here")
+    args = ap.parse_args(argv)
+
+    _os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION",
+                           "python")
+    tools = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "tools")
+    if tools not in sys.path:
+        sys.path.insert(0, tools)
+    from hlo_map import HloModule
+    from tpu_perf_session import profile_step
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.util import model_serializer
+
+    net = model_serializer.restore_model(args.modelPath)
+    z = np.load(args.dataPath)
+    ds = DataSet(z["features"][:args.batchSize],
+                 z["labels"][:args.batchSize])
+    mod = HloModule(_lower_step_hlo(net, ds))
+    times = profile_step(net, ds, args.logDir)
+    total = sum(t for t, _ in times.values())
+    buckets = {}
+    batch = int(np.asarray(ds.features).shape[0])
+    for nm, (t, c) in times.items():
+        key = nm.split(" = ")[0].strip().lstrip("%")
+        cat, flops = mod.classify(key, batch)
+        b = buckets.setdefault(cat, {"time": 0.0, "flops": 0})
+        b["time"] += t
+        b["flops"] += flops * c
+    report = {
+        "device_ms_per_step": round(total / 4 * 1e3, 3),
+        "buckets": {k: {"share_pct": round(v["time"] / total * 100, 1),
+                        "ms_per_step": round(v["time"] / 4 * 1e3, 3),
+                        "tflops": (round(v["flops"] / v["time"] / 1e12, 1)
+                                   if v["flops"] else None)}
+                    for k, v in sorted(buckets.items(),
+                                       key=lambda kv: -kv[1]["time"])},
+    }
+    print(_json.dumps(report, indent=1))
+    if args.out:
+        with open(args.out, "w") as fh:
+            _json.dump(report, fh, indent=1)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m deeplearning4j_tpu.cli "
-              "{train,nn-server,cloud-setup} ...")
+              "{train,nn-server,cloud-setup,profile} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
         parallel_wrapper_main(rest)
+        return 0
+    if cmd == "profile":
+        profile_main(rest)
         return 0
     if cmd == "nn-server":
         from deeplearning4j_tpu.clustering.server import NearestNeighborsServer
@@ -130,8 +217,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cmd == "cloud-setup":
         cluster_setup_main(rest)
         return 0
-    print(f"unknown command {cmd!r}; expected 'train', 'nn-server', or "
-          "'cloud-setup'")
+    print(f"unknown command {cmd!r}; expected 'train', 'nn-server', "
+          "'cloud-setup', or 'profile'")
     return 2
 
 
