@@ -185,6 +185,81 @@ class Evaluation:
                 if self.confusion[:, i].sum() + self.confusion[i, :].sum() > 0]
         return float(np.mean(vals)) if vals else 0.0
 
+    # ------------------------------------------- averaging / extra metrics
+    def _counts(self, i):
+        tp = self._tp(i)
+        fp = self._fp(i)
+        fn = self._fn(i)
+        tn = int(self.confusion.sum()) - tp - fp - fn
+        return tp, fp, fn, tn
+
+    def precision_averaged(self, averaging: str = "macro") -> float:
+        """``Evaluation.precision(EvaluationAveraging)``: macro averages
+        per-class values over ALL classes; micro pools the counts."""
+        self._check()
+        if averaging == "macro":
+            return float(np.mean([self.precision(i)
+                                  for i in range(self.num_classes)]))
+        tp = sum(self._tp(i) for i in range(self.num_classes))
+        fp = sum(self._fp(i) for i in range(self.num_classes))
+        return tp / (tp + fp) if tp + fp else 0.0
+
+    def recall_averaged(self, averaging: str = "macro") -> float:
+        self._check()
+        if averaging == "macro":
+            return float(np.mean([self.recall(i)
+                                  for i in range(self.num_classes)]))
+        tp = sum(self._tp(i) for i in range(self.num_classes))
+        fn = sum(self._fn(i) for i in range(self.num_classes))
+        return tp / (tp + fn) if tp + fn else 0.0
+
+    def g_measure(self, cls: Optional[int] = None,
+                  averaging: str = "macro") -> float:
+        """Geometric mean of precision and recall
+        (``Evaluation.gMeasure``)."""
+        self._check()
+        if cls is not None:
+            return float(np.sqrt(self.precision(cls) * self.recall(cls)))
+        if averaging == "macro":
+            return float(np.mean([self.g_measure(i)
+                                  for i in range(self.num_classes)]))
+        p = self.precision_averaged("micro")
+        r = self.recall_averaged("micro")
+        return float(np.sqrt(p * r))
+
+    def matthews_correlation_averaged(self, averaging: str = "macro"
+                                      ) -> float:
+        """``Evaluation.matthewsCorrelation(EvaluationAveraging)``."""
+        self._check()
+        if averaging == "macro":
+            return float(np.mean([self.matthews_correlation(i)
+                                  for i in range(self.num_classes)]))
+        tp, fp, fn, tn = (sum(self._counts(i)[j]
+                              for i in range(self.num_classes))
+                          for j in range(4))
+        denom = np.sqrt(float((tp + fp) * (tp + fn)
+                              * (tn + fp) * (tn + fn)))
+        return ((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def score_for_metric(self, metric: str) -> float:
+        """``Evaluation.scoreForMetric(Metric)`` — the hook early-stopping
+        score calculators select on: ACCURACY, F1, PRECISION, RECALL,
+        GMEASURE, MCC (case-insensitive)."""
+        m = metric.upper()
+        if m == "ACCURACY":
+            return self.accuracy()
+        if m == "F1":
+            return self.f1()
+        if m == "PRECISION":
+            return self.precision()
+        if m == "RECALL":
+            return self.recall()
+        if m == "GMEASURE":
+            return self.g_measure(averaging="macro")
+        if m == "MCC":
+            return self.matthews_correlation_averaged("macro")
+        raise ValueError(f"Unknown metric: {metric}")
+
     def false_positive_rate(self, cls: int) -> float:
         self._check()
         tn = self.confusion.sum() - self._tp(cls) - self._fp(cls) - self._fn(cls)

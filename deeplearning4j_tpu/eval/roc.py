@@ -278,6 +278,23 @@ class ROCBinary:
         keep = np.concatenate(ms)
         return _auc_roc(l[keep], s[keep])
 
+    def get_roc_curve(self, col: int):
+        """(thresholds, fpr, tpr) for one output column
+        (``ROCBinary.getRocCurve``)."""
+        return self._single(col).get_roc_curve()
+
+    def get_precision_recall_curve(self, col: int):
+        return self._single(col).get_precision_recall_curve()
+
+    def _single(self, col: int) -> "ROC":
+        if not self.is_exact:
+            return self._col_roc(col)
+        r = ROC()
+        for lb, sc, mk in zip(self.labels, self.scores, self.masks):
+            keep = slice(None) if mk is None else mk[:, col]
+            r.eval(lb[keep, col], sc[keep, col])
+        return r
+
     def merge(self, other: "ROCBinary") -> "ROCBinary":
         if self.is_exact != other.is_exact:
             raise ValueError("cannot merge exact with binned ROCBinary")
@@ -338,6 +355,24 @@ class ROCMultiClass:
         else:
             binary = (l == cls).astype(np.float64)
         return _auc_roc(binary, s[:, cls])
+
+    def get_roc_curve(self, cls: int):
+        """(thresholds, fpr, tpr) one-vs-all for one class
+        (``ROCMultiClass.getRocCurve``)."""
+        return self._single(cls).get_roc_curve()
+
+    def get_precision_recall_curve(self, cls: int):
+        return self._single(cls).get_precision_recall_curve()
+
+    def _single(self, cls: int) -> "ROC":
+        if not self.is_exact:
+            return self._cls_roc(cls)
+        r = ROC()
+        for lb, sc in zip(self.labels, self.scores):
+            binary = (lb[:, cls] if lb.ndim == 2
+                      else (lb == cls).astype(np.float64))
+            r.eval(binary, sc[:, cls])
+        return r
 
     def merge(self, other: "ROCMultiClass") -> "ROCMultiClass":
         if self.is_exact != other.is_exact:

@@ -687,3 +687,63 @@ class TestRemainingMerges:
             assert a.pearson_correlation(col) == pytest.approx(
                 whole.pearson_correlation(col))
             assert a.r_squared(col) == pytest.approx(whole.r_squared(col))
+
+
+class TestAveragingAndCurves:
+    def _eval(self):
+        e = Evaluation()
+        labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+        preds = np.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1],
+                          [0.1, 0.8, 0.1], [0.1, 0.8, 0.1],
+                          [0.1, 0.1, 0.8], [0.8, 0.1, 0.1]])
+        e.eval(labels, preds)
+        return e
+
+    def test_micro_vs_macro_precision_recall(self):
+        e = self._eval()
+        # micro precision == micro recall == accuracy for single-label
+        assert e.precision_averaged("micro") == pytest.approx(e.accuracy())
+        assert e.recall_averaged("micro") == pytest.approx(e.accuracy())
+        assert e.precision_averaged("macro") == pytest.approx(
+            np.mean([e.precision(i) for i in range(3)]))
+
+    def test_gmeasure_and_mcc(self):
+        e = self._eval()
+        assert e.g_measure(0) == pytest.approx(
+            np.sqrt(e.precision(0) * e.recall(0)))
+        macro = np.mean([np.sqrt(e.precision(i) * e.recall(i))
+                         for i in range(3)])
+        assert e.g_measure(averaging="macro") == pytest.approx(macro)
+        assert -1.0 <= e.matthews_correlation_averaged("micro") <= 1.0
+        assert e.matthews_correlation_averaged("macro") == pytest.approx(
+            np.mean([e.matthews_correlation(i) for i in range(3)]))
+
+    def test_score_for_metric(self):
+        e = self._eval()
+        assert e.score_for_metric("ACCURACY") == e.accuracy()
+        assert e.score_for_metric("f1") == e.f1()
+        assert e.score_for_metric("GMEASURE") == pytest.approx(
+            e.g_measure(averaging="macro"))
+        with pytest.raises(ValueError, match="Unknown metric"):
+            e.score_for_metric("BLEU")
+
+    def test_roc_family_curves(self):
+        from deeplearning4j_tpu.eval.roc import ROCBinary, ROCMultiClass
+        rng = np.random.default_rng(7)
+        labels = (rng.random((500, 2)) < 0.4).astype(np.float64)
+        scores = np.clip(0.5 * labels + rng.normal(0.3, 0.2, (500, 2)), 0, 1)
+        for steps in (0, 60):
+            rb = ROCBinary(threshold_steps=steps)
+            rb.eval(labels, scores)
+            thr, fpr, tpr = rb.get_roc_curve(1)
+            assert len(thr) == len(fpr) == len(tpr) > 2
+            t2, prec, rec = rb.get_precision_recall_curve(1)
+            assert len(prec) == len(rec)
+        true = rng.integers(0, 3, 500)
+        ml = np.eye(3)[true]
+        ms = rng.dirichlet(np.ones(3), 500)
+        for steps in (0, 60):
+            rm = ROCMultiClass(threshold_steps=steps)
+            rm.eval(ml, ms)
+            thr, fpr, tpr = rm.get_roc_curve(2)
+            assert len(thr) == len(fpr) == len(tpr) > 2
