@@ -54,7 +54,13 @@ class ClassificationScoreCalculator(ScoreCalculator):
 
     def calculate_score(self, model) -> float:
         e = model.evaluate(self.iterator)
-        return 1.0 - getattr(e, self.metric)()
+        # the reference selects via Evaluation.Metric / scoreForMetric;
+        # accept both the enum-style names (GMEASURE, MCC) and the
+        # method-style ones (accuracy, f1, ...)
+        try:
+            return 1.0 - e.score_for_metric(self.metric)
+        except ValueError:
+            return 1.0 - getattr(e, self.metric)()
 
 
 # ---------------------------------------------------------------- termination
