@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 import glob as _glob
 import os
-from typing import Iterable, List, Optional, Sequence, Union
+from typing import Iterable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -174,6 +174,85 @@ class CSVRecordReader(RecordReader):
         return list(self._records[index])
 
 
+class ImageRecordReader(RecordReader):
+    """Image directory reader (DataVec's ``ImageRecordReader`` role — the
+    external dependency the reference's datavec bridge consumes; not in the
+    reference snapshot itself). Walks a directory tree, decodes each image
+    to a ``[height, width, channels]`` float32 array (0-255, PIL-backed,
+    bilinear resize), and labels from the PARENT DIRECTORY name
+    (ParentPathLabelGenerator semantics: one subdirectory per class,
+    label indices assigned in sorted directory order).
+
+    Records are ``[image_array, label_index]`` — feed to
+    :class:`RecordReaderDataSetIterator` with ``label_index=1`` and
+    ``num_possible_labels=len(reader.labels)``; scale with
+    :class:`~deeplearning4j_tpu.datasets.normalizers.ImagePreProcessingScaler`.
+    """
+
+    EXTENSIONS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 path: Optional[str] = None):
+        self.height = int(height)
+        self.width = int(width)
+        self.channels = int(channels)
+        self._files: List[Tuple[str, int]] = []
+        self.labels: List[str] = []
+        self._pos = 0
+        if path is not None:
+            self.initialize(path)
+
+    def initialize(self, path: str) -> "ImageRecordReader":
+        """Collect (file, label) pairs from ``path/<label>/<image>``; files
+        directly under ``path`` get label 0 with a single '' class."""
+        entries = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                if f.lower().endswith(self.EXTENSIONS):
+                    rel = os.path.relpath(root, path)
+                    label = "" if rel == "." else rel.split(os.sep)[0]
+                    entries.append((os.path.join(root, f), label))
+        self.labels = sorted({lab for _, lab in entries})
+        idx = {lab: i for i, lab in enumerate(self.labels)}
+        entries.sort(key=lambda e: (e[1], e[0]))
+        self._files = [(p, idx[lab]) for p, lab in entries]
+        self.reset()
+        return self
+
+    def reset(self):
+        self._pos = 0
+
+    def has_next(self):
+        return self._pos < len(self._files)
+
+    def _decode(self, path: str) -> np.ndarray:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert("L" if self.channels == 1 else "RGB")
+            im = im.resize((self.width, self.height), Image.BILINEAR)
+            arr = np.asarray(im, np.float32)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+
+    def next_record(self):
+        path, label = self._files[self._pos]
+        self._pos += 1
+        return [self._decode(path), label]
+
+    def next_record_with_meta(self):
+        idx = self._pos
+        path, _ = self._files[idx]
+        rec = self.next_record()
+        return rec, RecordMetaData(index=idx, uri=path,
+                                   reader_class=type(self).__name__)
+
+    def _record_at(self, index):
+        path, label = self._files[index]
+        return [self._decode(path), label]
+
+
 class SequenceRecordReader:
     """SPI: iterate sequences (lists of records)."""
 
@@ -298,6 +377,24 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.reader.reset()
 
     def _split(self, rec: Record):
+        # tensor-valued records (ImageRecordReader: [array, label]) pass
+        # the array through as the feature block (NDArrayWritable role)
+        if rec and isinstance(rec[0], np.ndarray) and rec[0].ndim > 1:
+            f = np.asarray(rec[0], np.float32)
+            if self.label_index < 0:
+                return f, f
+            cls = int(float(rec[self.label_index]))
+            if self.regression:
+                return f, np.asarray([float(rec[i]) for i in
+                                      range(self.label_index,
+                                            self.label_index_to + 1)],
+                                     np.float32)
+            if not 0 <= cls < self.num_possible_labels:
+                raise ValueError(
+                    f"Label {cls} outside [0, {self.num_possible_labels})")
+            l = np.zeros(self.num_possible_labels, np.float32)
+            l[cls] = 1.0
+            return f, l
         if self.label_index < 0:
             f = np.asarray([float(v) for v in rec], np.float32)
             return f, f
@@ -341,7 +438,17 @@ class RecordReaderDataSetIterator(DataSetIterator):
         ds = DataSet(np.stack(feats), np.stack(labels),
                      example_meta_data=list(metas) or None)
         if self.preprocessor is not None:
-            self.preprocessor.preprocess(ds)
+            # DataSetPreProcessor.preProcess (mutating) / Normalizer
+            # .transform (returning) — accept whichever face the object
+            # exposes, and keep the metadata across a returned copy
+            pre = (getattr(self.preprocessor, "preprocess", None)
+                   or getattr(self.preprocessor, "pre_process", None)
+                   or getattr(self.preprocessor, "transform", None))
+            out = pre(ds)
+            if out is not None:
+                if getattr(out, "example_meta_data", None) is None:
+                    out.example_meta_data = ds.example_meta_data
+                ds = out
         return ds
 
     def load_from_meta_data(self, metas) -> DataSet:

@@ -203,3 +203,71 @@ class TestMultiDataSetIterator:
             labels_reader=CollectionSequenceRecordReader(l))
         with pytest.raises(ValueError, match="sequence counts differ"):
             list(it)
+
+
+class TestImageRecordReader:
+    """DataVec ImageRecordReader role: directory tree -> labeled image
+    DataSets, with metadata pointing at the source files."""
+
+    @staticmethod
+    def _make_tree(tmp_path, n_per_class=4, size=(10, 8)):
+        from PIL import Image
+        for ci, cls in enumerate(("cats", "dogs")):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(n_per_class):
+                arr = np.full((size[1], size[0], 3),
+                              40 * ci + 10 * i, np.uint8)
+                Image.fromarray(arr).save(str(d / f"img_{i}.png"))
+        return str(tmp_path)
+
+    def test_walks_labels_and_decodes(self, tmp_path):
+        from deeplearning4j_tpu.datasets.records import ImageRecordReader
+        root = self._make_tree(tmp_path)
+        r = ImageRecordReader(6, 5, 3, path=root)
+        assert r.labels == ["cats", "dogs"]
+        recs = list(r)
+        assert len(recs) == 8
+        img, label = recs[0]
+        assert img.shape == (6, 5, 3) and img.dtype == np.float32
+        assert label == 0
+        assert {lab for _, lab in recs} == {0, 1}
+        # grayscale variant
+        g = ImageRecordReader(6, 5, 1, path=root)
+        img1, _ = next(iter(g))
+        assert img1.shape == (6, 5, 1)
+
+    def test_through_iterator_with_scaler_and_metadata(self, tmp_path):
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        from deeplearning4j_tpu.datasets.records import (
+            ImageRecordReader, RecordReaderDataSetIterator)
+        root = self._make_tree(tmp_path)
+        r = ImageRecordReader(6, 5, 3, path=root)
+        it = RecordReaderDataSetIterator(
+            r, 3, label_index=1, num_possible_labels=len(r.labels),
+            preprocessor=ImagePreProcessingScaler(),
+            collect_meta_data=True)
+        batches = list(it)
+        assert sum(b.num_examples() for b in batches) == 8
+        f = np.asarray(batches[0].features)
+        assert f.shape == (3, 6, 5, 3)
+        assert 0.0 <= f.min() and f.max() <= 1.0  # scaled to [0,1]
+        assert np.asarray(batches[0].labels).shape == (3, 2)
+        meta = batches[0].example_meta_data
+        assert meta[0].uri.endswith(".png")
+        # drilldown reload returns the same decoded image
+        ds = it.load_from_meta_data(meta[:1])
+        np.testing.assert_allclose(np.asarray(ds.features)[0], f[0],
+                                   atol=1e-6)
+
+    def test_flat_directory_single_class(self, tmp_path):
+        from PIL import Image
+        from deeplearning4j_tpu.datasets.records import ImageRecordReader
+        d = tmp_path / "flat"
+        d.mkdir()
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            str(d / "a.png"))
+        r = ImageRecordReader(4, 4, 3, path=str(d))
+        assert r.labels == [""]
+        assert next(iter(r))[1] == 0
