@@ -245,3 +245,17 @@ class ComputationGraphConfiguration:
     @staticmethod
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
+
+    def to_yaml(self, **kw) -> str:
+        """YAML form of the same serde dict (``ComputationGraphConfiguration
+        .toYaml``)."""
+        import json as _json
+
+        import yaml
+        return yaml.safe_dump(_json.loads(self.to_json()), sort_keys=False,
+                              **kw)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))

@@ -410,3 +410,15 @@ class MultiLayerConfiguration:
     @staticmethod
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
+
+    def to_yaml(self, **kw) -> str:
+        """YAML form of the same serde dict (``MultiLayerConfiguration
+        .toYaml`` — the reference's Jackson YAML face)."""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False,
+                              **kw)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))

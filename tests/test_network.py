@@ -397,3 +397,34 @@ class TestFitBatchesOnDeviceMLN:
                    for _ in range(3)]
         net.fit_batches_on_device(batches)
         assert len(lst.scores) == 3
+
+
+class TestYamlSerde:
+    """MultiLayerConfiguration.toYaml/fromYaml parity (the reference's
+    Jackson YAML face) — same dict as the JSON round trip."""
+
+    def test_mln_yaml_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+                .l2(1e-4).list()
+                .layer(DenseLayer(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        y = conf.to_yaml()
+        assert "layers" in y and "dense" in y.lower()
+        back = MultiLayerConfiguration.from_yaml(y)
+        assert back.to_json() == conf.to_json()
+        net = MultiLayerNetwork(back).init()
+        assert net.output(np.zeros((2, 8), np.float32)).shape == (2, 3)
+
+    def test_graph_yaml_round_trip(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration)
+        g = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+             .graph_builder().add_inputs("in"))
+        g.add_layer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=2), "h")
+        conf = g.set_outputs("out").build()
+        back = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+        assert back.to_json() == conf.to_json()
