@@ -176,6 +176,24 @@ class Evaluation:
                 if self.confusion[:, i].sum() + self.confusion[i, :].sum() > 0]
         return float(np.mean(vals)) if vals else 0.0
 
+    def f_beta(self, beta: float, cls: Optional[int] = None,
+               averaging: str = "macro") -> float:
+        """``Evaluation.fBeta(beta, class)`` — F-measure with recall
+        weighted beta times as much as precision."""
+        self._check()
+        b2 = beta * beta
+        if cls is not None:
+            p, r = self.precision(cls), self.recall(cls)
+            denom = b2 * p + r
+            return (1 + b2) * p * r / denom if denom else 0.0
+        if averaging == "macro":
+            return float(np.mean([self.f_beta(beta, i)
+                                  for i in range(self.num_classes)]))
+        p = self.precision_averaged("micro")
+        r = self.recall_averaged("micro")
+        denom = b2 * p + r
+        return (1 + b2) * p * r / denom if denom else 0.0
+
     def f1(self, cls: Optional[int] = None) -> float:
         if cls is not None:
             p, r = self.precision(cls), self.recall(cls)
@@ -380,6 +398,16 @@ class Evaluation:
             f" F1 Score:        {self.f1():.4f}",
             "",
             "=========================Confusion Matrix=========================",
-            str(self.confusion),
         ]
+        if self.labels_list:
+            # labeled per-class block (Evaluation.stats() label output)
+            width = max(len(str(l)) for l in self.labels_list)
+            for i in range(self.num_classes):
+                name = (self.labels_list[i] if i < len(self.labels_list)
+                        else str(i))
+                lines.append(
+                    f" {name:<{width}}  " + " ".join(
+                        f"{int(v):6d}" for v in self.confusion[i]))
+        else:
+            lines.append(str(self.confusion))
         return "\n".join(lines)

@@ -747,3 +747,22 @@ class TestAveragingAndCurves:
             rm.eval(ml, ms)
             thr, fpr, tpr = rm.get_roc_curve(2)
             assert len(thr) == len(fpr) == len(tpr) > 2
+
+
+class TestFBetaAndLabeledStats:
+    def test_fbeta_reduces_to_f1(self):
+        e = Evaluation()
+        e.eval(np.eye(3)[[0, 1, 2, 0]], np.array(
+            [[0.8, 0.1, 0.1], [0.1, 0.8, 0.1],
+             [0.1, 0.1, 0.8], [0.1, 0.8, 0.1]]))
+        for c in range(3):
+            assert e.f_beta(1.0, c) == pytest.approx(e.f1(c))
+        # beta=2 weighs recall more: for class 1 (recall 1, precision 0.5)
+        assert e.f_beta(2.0, 1) > e.f1(1)
+        assert 0.0 <= e.f_beta(0.5, averaging="micro") <= 1.0
+
+    def test_stats_uses_label_names(self):
+        e = Evaluation(labels_list=["cat", "dog"])
+        e.eval(np.eye(2)[[0, 1]], np.array([[0.9, 0.1], [0.2, 0.8]]))
+        s = e.stats()
+        assert "cat" in s and "dog" in s
