@@ -652,9 +652,12 @@ class ComputationGraph:
         return outs[0] if len(outs) == 1 else outs
 
     # ------------------------------------------------------------ evaluation
-    def evaluate(self, iterator) -> "Evaluation":
+    def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
+        """Evaluate the first output over an iterator
+        (``ComputationGraph.evaluate``); ``top_n`` and collected record
+        metadata flow through exactly as in MultiLayerNetwork.evaluate."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation()
+        e = Evaluation(top_n=top_n)
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
@@ -662,7 +665,8 @@ class ComputationGraph:
             out = self.output(*mds.features)
             if isinstance(out, list):
                 out = out[0]
-            e.eval(np.asarray(mds.labels[0]), np.asarray(out))
+            e.eval(np.asarray(mds.labels[0]), np.asarray(out),
+                   record_meta_data=getattr(ds, "example_meta_data", None))
         return e
 
     # ------------------------------------------------------------------ misc

@@ -506,3 +506,40 @@ class TestFitBatchesOnDevice:
         with pytest.raises(ValueError, match="mask"):
             net.fit_batches_on_device(
                 [DataSet(x, y, features_mask=np.ones((4, 1), np.float32))])
+
+
+def test_graph_evaluate_topn_and_metadata(tmp_path):
+    """ComputationGraph.evaluate carries top_n and record metadata through
+    like MultiLayerNetwork.evaluate."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.records import (
+        CollectionRecordReader, RecordReaderDataSetIterator)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    g = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.05))
+         .graph_builder().add_inputs("in"))
+    g.add_layer("h", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_in=16, n_out=3), "h")
+    net = ComputationGraph(g.set_outputs("out").build()).init()
+    rng = np.random.default_rng(0)
+    recs = []
+    for i in range(60):
+        cls = i % 3
+        f = rng.normal(0, 0.3, 4)
+        f[cls] += 2.0
+        recs.append(list(f) + [cls])
+    it = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), 16, label_index=4,
+        num_possible_labels=3)
+    for _ in range(15):
+        net.fit(it)
+    eval_it = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), 16, label_index=4,
+        num_possible_labels=3, collect_meta_data=True)
+    e = net.evaluate(eval_it, top_n=2)
+    assert e.accuracy() > 0.9
+    assert e.top_n_accuracy() >= e.accuracy()
+    assert e.get_predictions_by_actual_class(0) is not None
