@@ -85,6 +85,37 @@ class ParallelWrapper:
         shard_model(model, self.mesh, tp_axis=tp_axis)
         self.n_workers = self.mesh.shape[data_axis]
 
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, iterator, top_n: int = 1):
+        """Data-parallel evaluation over the mesh
+        (``SparkDl4jMultiLayer.evaluate`` role): each batch's features are
+        sharded over the 'data' axis (params replicated), so the forward
+        pass all-gathers nothing and each device scores its shard; metrics
+        accumulate in one host-side Evaluation (the eval classes' ``merge``
+        covers multi-process topologies). Ragged tail batches run
+        unsharded, same policy as training."""
+        import numpy as _np
+
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        e = Evaluation(top_n=top_n)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        put = lambda a: jax.device_put(
+            jnp.asarray(a),
+            batch_sharding(self.mesh, _np.asarray(a).ndim, self.data_axis))
+        for ds in iterator:
+            x = _np.asarray(ds.features)
+            feats = put(x) if x.shape[0] % self.n_workers == 0 else x
+            out = self.model.output(feats)
+            if isinstance(out, list):
+                out = out[0]
+            e.eval(_np.asarray(ds.labels), _np.asarray(out),
+                   mask=None if ds.labels_mask is None
+                   else _np.asarray(ds.labels_mask),
+                   record_meta_data=getattr(ds, "example_meta_data", None))
+        return e
+
     # ------------------------------------------------------------------ fit
     def fit(self, data, labels=None, *, epochs: int = 1) -> "ParallelWrapper":
         from deeplearning4j_tpu.datasets.dataset import DataSet

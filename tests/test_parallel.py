@@ -395,3 +395,23 @@ class TestConvBnDataParallel:
         np.testing.assert_allclose(np.asarray(dist.states[1]["mean"]),
                                    np.asarray(ref.states[1]["mean"]),
                                    rtol=1e-4, atol=1e-6)
+
+
+class TestDistributedEvaluate:
+    def test_mesh_evaluate_equals_single_device(self, rng):
+        """ParallelWrapper.evaluate shards batches over the mesh and must
+        reproduce the single-device Evaluation exactly (SparkDl4jMultiLayer
+        .evaluate pattern)."""
+        from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+        x, y = make_data(rng, n=96)
+        net = small_net()
+        net.fit(x, y)
+        ref = net.evaluate(ListDataSetIterator(DataSet(x, y), 16))
+        pw = ParallelWrapper(net, make_mesh({"data": 8}),
+                             mode="shared_gradients")
+        dist = pw.evaluate(ListDataSetIterator(DataSet(x, y), 16), top_n=2)
+        np.testing.assert_array_equal(dist.confusion, ref.confusion)
+        assert dist.top_n_accuracy() >= dist.accuracy()
+        # ragged batches (batch 20 over 8 workers) take the unsharded path
+        dist2 = pw.evaluate(ListDataSetIterator(DataSet(x, y), 20))
+        np.testing.assert_array_equal(dist2.confusion, ref.confusion)
