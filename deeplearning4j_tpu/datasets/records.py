@@ -254,7 +254,9 @@ class ImageRecordReader(RecordReader):
 
 
 class SequenceRecordReader:
-    """SPI: iterate sequences (lists of records)."""
+    """SPI: iterate sequences (lists of records), with the same metadata
+    face as RecordReader (``SequenceRecordReader.nextSequence()`` /
+    ``loadSequenceFromMetaData``)."""
 
     def has_next(self) -> bool:
         raise NotImplementedError
@@ -264,6 +266,25 @@ class SequenceRecordReader:
 
     def reset(self) -> None:
         raise NotImplementedError
+
+    def _meta_uri(self) -> Optional[str]:
+        paths = getattr(self, "_paths", None)
+        return paths[0] if paths else None
+
+    def next_sequence_with_meta(self):
+        idx = int(getattr(self, "_pos", -1))
+        return self.next_sequence(), RecordMetaData(
+            index=idx, uri=self._meta_uri(),
+            reader_class=type(self).__name__)
+
+    def _sequence_at(self, index: int) -> List[Record]:
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support loadSequenceFromMetaData")
+
+    def load_sequence_from_meta_data(self, metas):
+        if isinstance(metas, RecordMetaData):
+            metas = [metas]
+        return [self._sequence_at(m.index) for m in metas]
 
     def __iter__(self):
         self.reset()
@@ -287,6 +308,9 @@ class CollectionSequenceRecordReader(SequenceRecordReader):
         self._pos += 1
         return [list(r) for r in s]
 
+    def _sequence_at(self, index):
+        return [list(r) for r in self._seqs[index]]
+
 
 class CSVSequenceRecordReader(SequenceRecordReader):
     """One sequence per file (DataVec CSVSequenceRecordReader): each line of a
@@ -306,16 +330,26 @@ class CSVSequenceRecordReader(SequenceRecordReader):
         return self._pos < len(self._paths)
 
     def next_sequence(self):
-        p = self._paths[self._pos]
+        seq = self._sequence_at(self._pos)
         self._pos += 1
+        return seq
+
+    def next_sequence_with_meta(self):
+        idx = self._pos
+        return self.next_sequence(), RecordMetaData(
+            index=idx, uri=self._paths[idx],
+            reader_class=type(self).__name__)
+
+    def _sequence_at(self, index):
         seq = []
-        with open(p, "r", encoding="utf-8") as f:
+        with open(self._paths[index], "r", encoding="utf-8") as f:
             for i, line in enumerate(f):
                 if i < self.skip_lines:
                     continue
                 line = line.strip()
                 if line:
-                    seq.append([_parse_field(v) for v in line.split(self.delimiter)])
+                    seq.append([_parse_field(v)
+                                for v in line.split(self.delimiter)])
         return seq
 
 
@@ -485,7 +519,8 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
                  num_possible_labels: int = -1, label_index: int = -1,
                  regression: bool = False,
                  labels_reader: Optional[SequenceRecordReader] = None,
-                 alignment_mode: str = AlignmentMode.ALIGN_START):
+                 alignment_mode: str = AlignmentMode.ALIGN_START,
+                 collect_meta_data: bool = False):
         self.features_reader = features_reader
         self.labels_reader = labels_reader
         self.batch_size = batch_size
@@ -493,6 +528,7 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
         self.label_index = label_index
         self.regression = regression
         self.alignment_mode = alignment_mode
+        self.collect_meta_data = collect_meta_data
 
     def reset(self):
         self.features_reader.reset()
@@ -509,9 +545,14 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
 
     def __iter__(self):
         self.reset()
-        fs, ls = [], []
+        fs, ls, metas = [], [], []
         lab_iter = iter(self.labels_reader) if self.labels_reader is not None else None
-        for seq in self.features_reader:
+        while self.features_reader.has_next():
+            if self.collect_meta_data:
+                seq, meta = self.features_reader.next_sequence_with_meta()
+                metas.append(meta)
+            else:
+                seq = self.features_reader.next_sequence()
             if lab_iter is not None:
                 try:
                     lseq = next(lab_iter)
@@ -535,12 +576,12 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             fs.append(f)
             ls.append(l)
             if len(fs) == self.batch_size:
-                yield self._emit(fs, ls)
-                fs, ls = [], []
+                yield self._emit(fs, ls, metas)
+                fs, ls, metas = [], [], []
         if fs:
-            yield self._emit(fs, ls)
+            yield self._emit(fs, ls, metas)
 
-    def _emit(self, fs, ls):
+    def _emit(self, fs, ls, metas=()):
         n = len(fs)
         tf = max(f.shape[0] for f in fs)
         tl = max(l.shape[0] for l in ls)
@@ -566,7 +607,8 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             lm[i, lo:lo + l.shape[0]] = 1.0
         all_f = bool(np.all(fm == 1.0))
         all_l = bool(np.all(lm == 1.0))
-        return DataSet(x, y, None if all_f else fm, None if all_l else lm)
+        return DataSet(x, y, None if all_f else fm, None if all_l else lm,
+                       example_meta_data=list(metas) or None)
 
 
 class RecordReaderMultiDataSetIterator:

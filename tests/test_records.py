@@ -271,3 +271,31 @@ class TestImageRecordReader:
         r = ImageRecordReader(4, 4, 3, path=str(d))
         assert r.labels == [""]
         assert next(iter(r))[1] == 0
+
+
+class TestSequenceMetadata:
+    def test_sequence_iterator_collects_and_reloads(self, tmp_path):
+        from deeplearning4j_tpu.datasets.records import (
+            CSVSequenceRecordReader, SequenceRecordReaderDataSetIterator)
+        for i in range(3):
+            (tmp_path / f"seq_{i}.csv").write_text(
+                "\n".join(f"{t}.0,{t + i}.0,{i % 2}" for t in range(4 + i)))
+        rdr = CSVSequenceRecordReader(str(tmp_path / "seq_*.csv"))
+        it = SequenceRecordReaderDataSetIterator(
+            rdr, 2, num_possible_labels=2, label_index=2,
+            collect_meta_data=True)
+        batches = list(it)
+        meta = batches[0].example_meta_data
+        assert len(meta) == 2 and meta[0].uri.endswith("seq_0.csv")
+        # reload the original sequence behind the metadata
+        seqs = rdr.load_sequence_from_meta_data(meta[1])
+        assert len(seqs[0]) == 5  # seq_1 has 5 timesteps
+        assert seqs[0][0][:2] == [0.0, 1.0]
+
+    def test_collection_sequence_at(self):
+        from deeplearning4j_tpu.datasets.records import (
+            CollectionSequenceRecordReader)
+        r = CollectionSequenceRecordReader([[[1, 0]], [[2, 1]], [[3, 0]]])
+        seq, meta = r.next_sequence_with_meta()
+        assert seq == [[1, 0]] and meta.index == 0
+        assert r.load_sequence_from_meta_data(meta) == [[[1, 0]]]
