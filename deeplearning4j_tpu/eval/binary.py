@@ -69,3 +69,22 @@ class EvaluationBinary:
     def f1(self, col: int = 0) -> float:
         p, r = self.precision(col), self.recall(col)
         return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def stats(self, labels=None) -> str:
+        """Per-output table (``EvaluationBinary.stats()``)."""
+        if self.tp is None:
+            raise ValueError("No evaluation data; call eval() first")
+        n = len(self.tp)
+        labels = labels or [f"label_{i}" for i in range(n)]
+        width = max(len(str(l)) for l in labels)
+        lines = ["================== Evaluation (binary) ==================",
+                 f" {'':<{width}}  {'acc':>7} {'prec':>7} {'rec':>7} "
+                 f"{'f1':>7} {'tp':>6} {'fp':>6} {'tn':>6} {'fn':>6}"]
+        for i in range(n):
+            lines.append(
+                f" {labels[i]:<{width}}  {self.accuracy(i):7.4f} "
+                f"{self.precision(i):7.4f} {self.recall(i):7.4f} "
+                f"{self.f1(i):7.4f} {int(self.tp[i]):6d} "
+                f"{int(self.fp[i]):6d} {int(self.tn[i]):6d} "
+                f"{int(self.fn[i]):6d}")
+        return "\n".join(lines)

@@ -134,6 +134,10 @@ class ROC:
         else:
             self._eval_binned(labels.ravel(), predictions.ravel())
 
+    def stats(self) -> str:
+        """``ROC.stats()``: "AUC: [x]"."""
+        return f"AUC: [{self.calculate_auc():.6f}]"
+
     # ---------------------------------------------------------------- curves
     def get_roc_curve(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(thresholds, fpr, tpr). Binned mode: one point per fixed

@@ -766,3 +766,15 @@ class TestFBetaAndLabeledStats:
         e.eval(np.eye(2)[[0, 1]], np.array([[0.9, 0.1], [0.2, 0.8]]))
         s = e.stats()
         assert "cat" in s and "dog" in s
+
+
+def test_binary_and_roc_stats_strings():
+    from deeplearning4j_tpu.eval.binary import EvaluationBinary
+    from deeplearning4j_tpu.eval.roc import ROC
+    e = EvaluationBinary()
+    e.eval(np.array([[1, 0], [0, 1]]), np.array([[0.9, 0.2], [0.3, 0.8]]))
+    s = e.stats(labels=["toxic", "spam"])
+    assert "toxic" in s and "spam" in s and "f1" in s
+    r = ROC()
+    r.eval(np.array([1.0, 0.0, 1.0]), np.array([0.8, 0.3, 0.6]))
+    assert r.stats().startswith("AUC: [")
