@@ -669,6 +669,34 @@ class ComputationGraph:
                    record_meta_data=getattr(ds, "example_meta_data", None))
         return e
 
+    def summary(self) -> str:
+        """Vertex table with parameter counts
+        (``ComputationGraph.summary()``)."""
+        if self.params is None:
+            self.init()
+        rows = []
+        total = 0
+        for name in self.conf.topo_order:
+            vd = self.conf.vertices[name]
+            if vd.is_layer:
+                p = self.params.get(name, {})
+                n = sum(int(np.prod(v.shape)) for v in p.values())
+                total += n
+                kind = type(vd.obj).__name__
+            else:
+                n, kind = 0, type(vd.obj).__name__
+            rows.append((name, kind, f"{n:,}", ", ".join(vd.inputs)))
+        w0 = max(6, max(len(r[0]) for r in rows))
+        w1 = max(10, max(len(r[1]) for r in rows))
+        w2 = max(8, max(len(r[2]) for r in rows))
+        lines = ["=" * 76,
+                 f"{'vertex':<{w0}}  {'type':<{w1}}  {'params':>{w2}}  inputs",
+                 "-" * 76]
+        for r in rows:
+            lines.append(f"{r[0]:<{w0}}  {r[1]:<{w1}}  {r[2]:>{w2}}  {r[3]}")
+        lines += ["-" * 76, f"Total parameters: {total:,}", "=" * 76]
+        return "\n".join(lines)
+
     # ------------------------------------------------------------------ misc
     def num_params(self) -> int:
         if self.params is None:

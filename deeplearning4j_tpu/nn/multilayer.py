@@ -591,6 +591,33 @@ class MultiLayerNetwork:
     def add_listeners(self, *listeners) -> None:
         self.listeners.extend(listeners)
 
+    def summary(self) -> str:
+        """Layer table with parameter counts
+        (``MultiLayerNetwork.summary()``)."""
+        if self.params is None:
+            self.init()
+        rows = []
+        total = 0
+        for i, (l, p) in enumerate(zip(self.layers, self.params)):
+            n = sum(int(np.prod(v.shape)) for v in p.values())
+            total += n
+            shapes = ", ".join(f"{k}{tuple(v.shape)}"
+                               for k, v in sorted(p.items()))
+            name = getattr(l, "name", None) or ""
+            rows.append((str(i), f"{type(l).__name__}"
+                         + (f" ({name})" if name else ""),
+                         f"{n:,}", shapes))
+        w0 = max(5, max(len(r[0]) for r in rows))
+        w1 = max(10, max(len(r[1]) for r in rows))
+        w2 = max(8, max(len(r[2]) for r in rows))
+        lines = ["=" * 76,
+                 f"{'index':<{w0}}  {'layer':<{w1}}  {'params':>{w2}}  shapes",
+                 "-" * 76]
+        for r in rows:
+            lines.append(f"{r[0]:<{w0}}  {r[1]:<{w1}}  {r[2]:>{w2}}  {r[3]}")
+        lines += ["-" * 76, f"Total parameters: {total:,}", "=" * 76]
+        return "\n".join(lines)
+
     # ------------------------------------------------------------------ misc
     def num_params(self) -> int:
         if self.params is None:

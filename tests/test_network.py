@@ -428,3 +428,25 @@ class TestYamlSerde:
         conf = g.set_outputs("out").build()
         back = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
         assert back.to_json() == conf.to_json()
+
+
+def test_summary_tables():
+    """MultiLayerNetwork.summary() / ComputationGraph.summary() parity."""
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu",
+                              name="hidden"))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    s = net.summary()
+    assert "DenseLayer (hidden)" in s and "OutputLayer" in s
+    assert f"Total parameters: {net.num_params():,}" in s
+
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    g = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+         .graph_builder().add_inputs("in"))
+    g.add_layer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"), "in")
+    g.add_layer("out", OutputLayer(n_in=8, n_out=2), "h")
+    cg = ComputationGraph(g.set_outputs("out").build()).init()
+    s2 = cg.summary()
+    assert "h" in s2 and "OutputLayer" in s2 and "Total parameters" in s2
