@@ -123,6 +123,29 @@ OPS: Dict[str, Callable] = {
     "reciprocal": lambda a: 1.0 / a,
     "log1p": jnp.log1p, "expm1": jnp.expm1,
     # comparisons (float outputs, ND4J-style)
+    "cumsum": lambda a, axis=0: jnp.cumsum(a, axis=axis),
+    "cumprod": lambda a, axis=0: jnp.cumprod(a, axis=axis),
+    "sort": lambda a, axis=-1, descending=False: (
+        -jnp.sort(-a, axis=axis) if descending else jnp.sort(a, axis=axis)),
+    "logsumexp": lambda a, axis=None, keepdims=False: (
+        jax.scipy.special.logsumexp(a, axis=axis, keepdims=keepdims)),
+    "l2_normalize": lambda a, axis=-1, eps=1e-12: a / jnp.sqrt(
+        jnp.maximum(jnp.sum(jnp.square(a), axis=axis, keepdims=True), eps)),
+    "mod": lambda a, b: jnp.mod(a, b),
+    "floor_div": lambda a, b: jnp.floor_divide(a, b),
+    "atan": jnp.arctan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "atan2": lambda a, b: jnp.arctan2(a, b),
+    "isnan": lambda a: jnp.isnan(a).astype(jnp.float32),
+    "isinf": lambda a: jnp.isinf(a).astype(jnp.float32),
+    "diag": jnp.diag,
+    "trace": jnp.trace,
     "gt": lambda a, b: (a > b).astype(jnp.float32),
     "gte": lambda a, b: (a >= b).astype(jnp.float32),
     "lt": lambda a, b: (a < b).astype(jnp.float32),
@@ -374,7 +397,10 @@ _MATH_OPS = {n: n for n in (
     "abs exp log sqrt square sin cos tan floor ceil round sign erf "
     "reciprocal log1p expm1 neg maximum minimum pow clip_by_value "
     "sum mean max min prod std variance argmax argmin norm2 norm1 "
-    "gt gte lt lte eq neq where cast tanh").split()}
+    "gt gte lt lte eq neq where cast tanh "
+    "cumsum cumprod sort logsumexp l2_normalize mod floor_div "
+    "atan asin acos sinh cosh asinh acosh atanh atan2 isnan isinf "
+    "diag trace").split()}
 _NN_OPS = {n: n for n in (
     "relu relu6 elu selu gelu softplus softsign swish hard_sigmoid "
     "leaky_relu softmax log_softmax sigmoid tanh linear layer_norm dropout "

@@ -497,3 +497,50 @@ class TestBoundedWhileLoopGradients:
         # true trip count 10 > K=3: the scan stops at K iterations
         got = sd.output({"n": np.float32(10)}, fin[1].name)[fin[1].name]
         assert got == 1 + 2 + 3
+
+
+class TestExtendedMathOps:
+    def test_cumulative_and_sort(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=(4,))
+        sd.math.cumsum(x, name="cs")
+        sd.math.cumprod(x, name="cp")
+        sd.math.sort(x, descending=True, name="srt")
+        xv = np.array([3.0, 1.0, 2.0, 4.0], np.float32)
+        out = sd.output({"x": xv}, "cs", "cp", "srt")
+        np.testing.assert_allclose(out["cs"], np.cumsum(xv))
+        np.testing.assert_allclose(out["cp"], np.cumprod(xv))
+        np.testing.assert_allclose(out["srt"], [4, 3, 2, 1])
+
+    def test_trig_family_and_checks(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=(3,))
+        sd.math.atan(x, name="at")
+        sd.math.sinh(x, name="sh")
+        sd.math.isnan(x, name="nn")
+        xv = np.array([0.0, 0.5, np.nan], np.float32)
+        out = sd.output({"x": xv}, "at", "sh", "nn")
+        np.testing.assert_allclose(out["at"][:2], np.arctan(xv[:2]),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(out["nn"], [0.0, 0.0, 1.0])
+
+    def test_l2_normalize_and_logsumexp_gradients_flow(self):
+        sd = SameDiff.create()
+        x = sd.place_holder("x", shape=(2, 3))
+        w = sd.var("w", value=np.ones((2, 3), np.float32))
+        h = sd.math.l2_normalize(x.mul(w), name="l2n")
+        sd.math.logsumexp(h, name="lse")
+        sd.set_loss_variables("lse")
+        g = sd.calculate_gradients(
+            {"x": np.arange(6, dtype=np.float32).reshape(2, 3) + 1}, "w")
+        assert np.isfinite(g["w"]).all()
+
+    def test_diag_trace_mod(self):
+        sd = SameDiff.create()
+        m = sd.place_holder("m", shape=(3, 3))
+        sd.math.trace(m, name="tr")
+        sd.math.mod(m, sd.constant("two", np.float32(2.0)), name="md")
+        mv = np.arange(9, dtype=np.float32).reshape(3, 3)
+        out = sd.output({"m": mv}, "tr", "md")
+        assert out["tr"] == np.trace(mv)
+        np.testing.assert_allclose(out["md"], mv % 2)
