@@ -144,6 +144,7 @@ OPS: Dict[str, Callable] = {
     "atan2": lambda a, b: jnp.arctan2(a, b),
     "isnan": lambda a: jnp.isnan(a).astype(jnp.float32),
     "isinf": lambda a: jnp.isinf(a).astype(jnp.float32),
+    "top_k": lambda a, k=1: jax.lax.top_k(a, k),
     "diag": jnp.diag,
     "trace": jnp.trace,
     "gt": lambda a, b: (a > b).astype(jnp.float32),
@@ -590,6 +591,22 @@ class SameDiff:
             attrs={"true_scope": list(t_scope), "false_scope": list(f_scope),
                    "true_out": t_out.name, "false_out": f_out.name,
                    "n_outer": len(outer)}))
+
+    def top_k(self, x, k: int, name: Optional[str] = None):
+        """(values, indices) of the k largest along the last axis
+        (ND4J ``sd.nn.topK`` / ``lax.top_k``). The node's value is the
+        pair; the returned SDVariables are its ``tuple_get`` views."""
+        name = name or self._fresh_name("topk")
+        node = self._register(SDVariable(
+            self, name, "op", op="top_k", inputs=[self._as_var(x).name],
+            attrs={"k": int(k)}))
+        values = self._op("tuple_get", [node], name=f"{name}_values",
+                          attrs={"index": 0})
+        indices = self._op("tuple_get", [node], name=f"{name}_indices",
+                           attrs={"index": 1})
+        return values, indices
+
+    topK = top_k  # ND4J spelling
 
     ifCond = if_cond  # ND4J spelling
 

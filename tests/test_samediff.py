@@ -544,3 +544,14 @@ class TestExtendedMathOps:
         out = sd.output({"m": mv}, "tr", "md")
         assert out["tr"] == np.trace(mv)
         np.testing.assert_allclose(out["md"], mv % 2)
+
+
+def test_top_k_values_and_indices():
+    sd = SameDiff.create()
+    x = sd.place_holder("x", shape=(2, 5))
+    vals, idx = sd.top_k(x, 2)
+    xv = np.array([[1.0, 5.0, 3.0, 2.0, 4.0],
+                   [9.0, 0.0, 8.0, 7.0, 1.0]], np.float32)
+    out = sd.output({"x": xv}, vals.name, idx.name)
+    np.testing.assert_allclose(out[vals.name], [[5, 4], [9, 8]])
+    np.testing.assert_allclose(out[idx.name], [[1, 4], [0, 2]])
