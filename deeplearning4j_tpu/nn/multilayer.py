@@ -574,6 +574,33 @@ class MultiLayerNetwork:
                    record_meta_data=getattr(ds, "example_meta_data", None))
         return e
 
+    def evaluate_roc(self, iterator, threshold_steps: int = 0) -> "ROC":
+        """Binary ROC over an iterator (``MultiLayerNetwork.evaluateROC
+        :2999``); ``threshold_steps > 0`` uses the binned mergeable mode."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        r = ROC(threshold_steps=threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            r.eval(np.asarray(ds.labels), np.asarray(out),
+                   mask=None if ds.labels_mask is None
+                   else np.asarray(ds.labels_mask))
+        return r
+
+    def evaluate_roc_multi_class(self, iterator,
+                                 threshold_steps: int = 0
+                                 ) -> "ROCMultiClass":
+        """One-vs-all ROC per class (``evaluateROCMultiClass``)."""
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        r = ROCMultiClass(threshold_steps=threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features)
+            r.eval(np.asarray(ds.labels), np.asarray(out))
+        return r
+
     def evaluate_regression(self, iterator) -> "RegressionEvaluation":
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
         e = RegressionEvaluation()

@@ -778,3 +778,34 @@ def test_binary_and_roc_stats_strings():
     r = ROC()
     r.eval(np.array([1.0, 0.0, 1.0]), np.array([0.8, 0.3, 0.6]))
     assert r.stats().startswith("AUC: [")
+
+
+def test_network_evaluate_roc_methods():
+    """MultiLayerNetwork.evaluateROC / evaluateROCMultiClass parity."""
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(2)
+    cls = rng.integers(0, 2, 128)
+    x = rng.normal(size=(128, 4)).astype(np.float32)
+    x[np.arange(128), cls] += 2.0
+    y = np.eye(2, dtype=np.float32)[cls]
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=16, activation="relu"))
+            .layer(OutputLayer(n_in=16, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(x, y, epochs=20)
+    it = ListDataSetIterator(DataSet(x, y), 32)
+    roc = net.evaluate_roc(it)
+    assert roc.calculate_auc() > 0.9
+    binned = net.evaluate_roc(it, threshold_steps=100)
+    assert binned.calculate_auc() == pytest.approx(roc.calculate_auc(),
+                                                   abs=0.02)
+    multi = net.evaluate_roc_multi_class(it, threshold_steps=50)
+    assert multi.calculate_auc(0) > 0.9
