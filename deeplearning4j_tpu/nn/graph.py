@@ -697,6 +697,21 @@ class ComputationGraph:
         lines += ["-" * 76, f"Total parameters: {total:,}", "=" * 76]
         return "\n".join(lines)
 
+    def evaluate_regression(self, iterator):
+        """Per-column regression metrics over the first output
+        (``ComputationGraph.evaluateRegression``)."""
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        e = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[0]
+            e.eval(np.asarray(mds.labels[0]), np.asarray(out))
+        return e
+
     def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0):
         """One-vs-all ROC per class over the first output
         (``ComputationGraph.evaluateROCMultiClass``)."""

@@ -461,6 +461,20 @@ class MultiLayerNetwork:
         return self._jit_cache[key]
 
     def output(self, x, mask=None) -> Array:
+        """Inference forward. Also accepts a DataSetIterator (the
+        reference's ``output(DataSetIterator)`` overload) — batch outputs
+        are concatenated."""
+        if hasattr(x, "features") or (hasattr(x, "__iter__")
+                                      and not hasattr(x, "shape")
+                                      and not isinstance(x, (list, tuple))):
+            it = [x] if hasattr(x, "features") else x
+            if hasattr(it, "reset"):
+                it.reset()
+            outs = [np.asarray(self.output(
+                ds.features,
+                mask=None if ds.features_mask is None else ds.features_mask))
+                for ds in it]
+            return jnp.concatenate([jnp.asarray(o) for o in outs], axis=0)
         dtype = self.conf.global_conf.jnp_dtype()
         x = _as_jnp(x, dtype)
         mask = None if mask is None else _as_jnp(mask)

@@ -450,3 +450,20 @@ def test_summary_tables():
     cg = ComputationGraph(g.set_outputs("out").build()).init()
     s2 = cg.summary()
     assert "h" in s2 and "OutputLayer" in s2 and "Total parameters" in s2
+
+
+def test_output_accepts_iterator_and_dataset():
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=3))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    x = np.random.default_rng(0).normal(size=(20, 4)).astype(np.float32)
+    y = np.zeros((20, 3), np.float32)
+    direct = np.asarray(net.output(x))
+    via_it = np.asarray(net.output(ListDataSetIterator(DataSet(x, y), 8)))
+    np.testing.assert_allclose(via_it, direct, rtol=1e-6)
+    via_ds = np.asarray(net.output(DataSet(x, y)))
+    np.testing.assert_allclose(via_ds, direct, rtol=1e-6)
