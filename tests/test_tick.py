@@ -1,0 +1,120 @@
+"""Device-resident training tick (nn/tick.py) edge cases.
+
+The fit loop's (iteration, epoch, rng) ride on device through the donated
+train step; the host keeps int mirrors. These tests lock the invalidation
+contract: any external mutation of the mirrors must fall back to a fresh
+host placement (never a deleted donated buffer), and the on-device rng
+chain must stay deterministic.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, DropoutLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _net(seed=3, dropout=False):
+    b = NeuralNetConfiguration.builder().seed(seed).updater(Adam(0.01)).list()
+    b = b.layer(DenseLayer(n_in=6, n_out=12, activation="relu"))
+    if dropout:
+        b = b.layer(DropoutLayer(dropout=0.5))
+    conf = b.layer(OutputLayer(n_in=12, n_out=3)).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+class TestTickInvalidation:
+    def test_external_iteration_reset_replaces_tick(self):
+        net = _net()
+        x, y = _data()
+        net.fit(x, y, epochs=3)
+        assert net.iteration == 3
+        net.iteration = 0  # external mutation (restore / manual reset)
+        net.fit(x, y)      # must NOT touch the stale donated tick
+        assert net.iteration == 1
+        assert np.isfinite(net.score_)
+
+    def test_epoch_boundaries_and_interleaved_inference(self):
+        net = _net(dropout=True)
+        x, y = _data()
+        for _ in range(2):
+            net.fit(x, y)          # epoch stays, tick chain continues
+            _ = np.asarray(net.output(x))  # inference between steps is fine
+        net.epoch += 1             # external epoch bump -> fresh tick
+        net.fit(x, y)
+        assert net.iteration == 3 and np.isfinite(net.score_)
+
+    def test_clone_trains_independently(self):
+        net = _net()
+        x, y = _data()
+        net.fit(x, y)
+        other = net.clone()
+        other.fit(x, y)
+        net.fit(x, y)
+        assert net.iteration == 2 and other.iteration == 2
+        assert np.isfinite(net.score_) and np.isfinite(other.score_)
+
+    def test_lr_schedule_sees_advancing_iteration(self):
+        """The on-device `it` counter must actually advance: a step-decay
+        schedule changes the update magnitude when it crosses its step."""
+        from deeplearning4j_tpu.nn.updaters import Sgd, StepSchedule
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(StepSchedule("iteration", 1.0, 0.0, 2.0)))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=4, activation="identity"))
+                .layer(OutputLayer(n_in=4, n_out=2, loss="mse",
+                                   activation="identity"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.ones((4, 4), np.float32)
+        y = np.zeros((4, 2), np.float32)
+        w0 = np.asarray(net.params[0]["W"]).copy()
+        net.fit(x, y)  # it=0: lr 1.0 -> params move
+        w1 = np.asarray(net.params[0]["W"]).copy()
+        assert np.abs(w1 - w0).max() > 0
+        net.fit(x, y)  # it=1: lr 1.0
+        net.fit(x, y)  # it=2: decayed to 0.0 -> params frozen
+        w2 = np.asarray(net.params[0]["W"]).copy()
+        net.fit(x, y)
+        w3 = np.asarray(net.params[0]["W"])
+        np.testing.assert_allclose(w3, w2)
+
+
+class TestTickDeterminism:
+    def test_dropout_chain_reproducible_across_fresh_nets(self):
+        """Two identically-seeded nets must produce identical params after
+        N dropout-training steps — locks the on-device rng split chain."""
+        x, y = _data()
+        a, b = _net(seed=11, dropout=True), _net(seed=11, dropout=True)
+        for _ in range(4):
+            a.fit(x, y)
+            b.fit(x, y)
+        for pa, pb in zip(a.params, b.params):
+            for n in pa:
+                np.testing.assert_array_equal(np.asarray(pa[n]),
+                                              np.asarray(pb[n]))
+
+    def test_mixed_wrapper_and_direct_fit(self):
+        """ParallelWrapper bumps the host mirrors in its own ways; a direct
+        fit afterwards must re-place the tick, not reuse a stale one."""
+        import jax
+        from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+        net = _net()
+        x, y = _data(n=64)
+        pw = ParallelWrapper(net, make_mesh({"data": 8}), mode="averaging",
+                             averaging_frequency=2)
+        pw.fit([DataSet(x[:32], y[:32]), DataSet(x[32:], y[32:])])
+        it_after = net.iteration
+        net.fit(x, y)
+        assert net.iteration == it_after + 1
+        assert np.isfinite(net.score_)
