@@ -388,3 +388,122 @@ class JointParallelDataSetIterator(DataSetIterator):
                     active[k] = False
                     if self.stop_on_first_exhausted:
                         return
+
+
+class DoublesDataSetIterator(INDArrayDataSetIterator):
+    """(features, labels) pairs of plain float sequences
+    (DoublesDataSetIterator.java) — f64 arrays."""
+
+    def __init__(self, pairs: Sequence, batch_size: int):
+        super().__init__([(np.asarray(f, np.float64), np.asarray(l, np.float64))
+                          for f, l in pairs], batch_size)
+
+
+class FloatsDataSetIterator(INDArrayDataSetIterator):
+    """(features, labels) pairs of plain float sequences
+    (FloatsDataSetIterator.java) — f32 arrays."""
+
+    def __init__(self, pairs: Sequence, batch_size: int):
+        super().__init__([(np.asarray(f, np.float32), np.asarray(l, np.float32))
+                          for f, l in pairs], batch_size)
+
+
+class ReconstructionDataSetIterator(DataSetIterator):
+    """Labels := features (ReconstructionDataSetIterator.java) — the
+    autoencoder wrapper over any DataSetIterator."""
+
+    def __init__(self, base: DataSetIterator):
+        self.base = base
+
+    def reset(self) -> None:
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for ds in self.base:
+            yield DataSet(ds.features, ds.features, ds.features_mask,
+                          ds.features_mask)
+
+
+class IteratorMultiDataSetIterator:
+    """Re-batches a stream of MultiDataSets into ``batch_size`` examples
+    (IteratorMultiDataSetIterator.java)."""
+
+    def __init__(self, source, batch_size: int):
+        self._items = list(source)
+        self.batch_size = batch_size
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self):
+        buf, count = [], 0
+        for mds in self._items:
+            buf.append(mds)
+            count += int(np.asarray(mds.features[0]).shape[0])
+            if count >= self.batch_size:
+                yield _merge_mds(buf)
+                buf, count = [], 0
+        if buf:
+            yield _merge_mds(buf)
+
+
+def _merge_mds(items):
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    n_f = len(items[0].features)
+    n_l = len(items[0].labels)
+    feats = [np.concatenate([np.asarray(m.features[i]) for m in items])
+             for i in range(n_f)]
+    labels = [np.concatenate([np.asarray(m.labels[i]) for m in items])
+              for i in range(n_l)]
+    return MultiDataSet(feats, labels)
+
+
+class MultiDataSetWrapperIterator(DataSetIterator):
+    """Single-input/single-output MultiDataSet iterator exposed as a plain
+    DataSetIterator (MultiDataSetWrapperIterator.java)."""
+
+    def __init__(self, base):
+        self.base = base
+
+    def reset(self) -> None:
+        if hasattr(self.base, "reset"):
+            self.base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        for mds in self.base:
+            if len(mds.features) != 1 or len(mds.labels) != 1:
+                raise ValueError(
+                    "MultiDataSetWrapperIterator requires single-input/"
+                    f"single-output MultiDataSets (got {len(mds.features)} "
+                    f"inputs, {len(mds.labels)} outputs)")
+            fm = (None if mds.features_masks is None
+                  else mds.features_masks[0])
+            lm = None if mds.labels_masks is None else mds.labels_masks[0]
+            yield DataSet(mds.features[0], mds.labels[0], fm, lm)
+
+
+class DummyPreProcessor:
+    """No-op DataSet pre-processor (DummyPreProcessor.java)."""
+
+    def preprocess(self, ds) -> None:
+        return None
+
+
+class CombinedPreProcessor:
+    """Apply a list of pre-processors / normalizers in order
+    (CombinedPreProcessor.java). Handles both mutating ``preprocess`` and
+    returning ``transform`` faces; returns the final DataSet."""
+
+    def __init__(self, *preprocessors):
+        self.preprocessors = list(preprocessors)
+
+    def preprocess(self, ds):
+        for p in self.preprocessors:
+            fn = (getattr(p, "preprocess", None)
+                  or getattr(p, "pre_process", None)
+                  or getattr(p, "transform", None))
+            out = fn(ds)
+            if out is not None:
+                ds = out
+        return ds

@@ -216,3 +216,50 @@ class TestProfilerListener:
         assert pl._done and not pl._active
         pl.reset()
         assert not pl._done
+
+
+class TestRemainingIterators:
+    def test_floats_doubles_and_reconstruction(self):
+        from deeplearning4j_tpu.datasets.iterators import (
+            DoublesDataSetIterator, FloatsDataSetIterator,
+            ReconstructionDataSetIterator)
+        pairs = [([1.0, 2.0], [1.0]), ([3.0, 4.0], [0.0]),
+                 ([5.0, 6.0], [1.0])]
+        fl = list(FloatsDataSetIterator(pairs, 2))
+        assert np.asarray(fl[0].features).dtype == np.float32
+        assert fl[0].num_examples() == 2 and fl[1].num_examples() == 1
+        db = list(DoublesDataSetIterator(pairs, 3))
+        assert np.asarray(db[0].features).dtype == np.float64
+        rec = list(ReconstructionDataSetIterator(
+            FloatsDataSetIterator(pairs, 2)))
+        np.testing.assert_array_equal(np.asarray(rec[0].labels),
+                                      np.asarray(rec[0].features))
+
+    def test_mds_rebatch_and_wrapper(self):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            IteratorMultiDataSetIterator, MultiDataSetWrapperIterator)
+        ms = [MultiDataSet([np.ones((1, 3))], [np.zeros((1, 2))])
+              for _ in range(5)]
+        rebatched = list(IteratorMultiDataSetIterator(ms, 2))
+        assert [np.asarray(m.features[0]).shape[0] for m in rebatched] == \
+            [2, 2, 1]
+        wrapped = list(MultiDataSetWrapperIterator(rebatched))
+        assert wrapped[0].num_examples() == 2
+        bad = MultiDataSet([np.ones((1, 3))] * 2, [np.zeros((1, 2))])
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="single-input"):
+            list(MultiDataSetWrapperIterator([bad]))
+
+    def test_combined_and_dummy_preprocessors(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import (
+            CombinedPreProcessor, DummyPreProcessor)
+        from deeplearning4j_tpu.datasets.normalizers import (
+            ImagePreProcessingScaler)
+        ds = DataSet(np.full((2, 3), 255.0, np.float32),
+                     np.zeros((2, 1), np.float32))
+        combo = CombinedPreProcessor(DummyPreProcessor(),
+                                     ImagePreProcessingScaler())
+        out = combo.preprocess(ds)
+        assert float(np.asarray(out.features).max()) == 1.0
