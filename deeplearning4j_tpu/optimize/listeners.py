@@ -260,6 +260,36 @@ class CheckpointListener(TrainingListener):
         cps = self._checkpoints()
         return cps[-1] if cps else None
 
+    # -- static loaders (CheckpointListener.loadCheckpointMLN:…) ------------
+    @staticmethod
+    def available_checkpoints(model_dir) -> List[dict]:
+        """List saved checkpoints with parsed (number, iteration, epoch)
+        (``CheckpointListener.availableCheckpoints``)."""
+        out = []
+        for p in sorted(Path(model_dir).glob("checkpoint_*.zip"),
+                        key=lambda q: int(q.name.split("_")[1])):
+            parts = p.stem.split("_")
+            out.append({"number": int(parts[1]), "iteration": int(parts[3]),
+                        "epoch": int(parts[5]), "path": p})
+        return out
+
+    @staticmethod
+    def load_checkpoint(model_dir, number: Optional[int] = None):
+        """Restore a checkpointed model — the newest, or checkpoint
+        ``number`` (``loadCheckpointMLN`` / ``loadLastCheckpointMLN``)."""
+        from deeplearning4j_tpu.util.model_serializer import restore_model
+        cps = CheckpointListener.available_checkpoints(model_dir)
+        if not cps:
+            raise FileNotFoundError(f"no checkpoints under {model_dir}")
+        if number is None:
+            return restore_model(cps[-1]["path"])
+        for c in cps:
+            if c["number"] == number:
+                return restore_model(c["path"])
+        raise FileNotFoundError(
+            f"no checkpoint number {number} under {model_dir} "
+            f"(available: {[c['number'] for c in cps]})")
+
     # -- hooks ---------------------------------------------------------------
     def iteration_done(self, model, iteration, epoch):
         if (self.save_every_n_iterations and

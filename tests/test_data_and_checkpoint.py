@@ -356,3 +356,38 @@ class TestMultiNormalizer:
         a = norm.transform(mds).features[0]
         b = norm2.transform(mds).features[0]
         np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_checkpoint_static_loaders(tmp_path):
+    """CheckpointListener.loadCheckpointMLN / availableCheckpoints parity."""
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.optimize.listeners import CheckpointListener
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    net.listeners.append(CheckpointListener(
+        tmp_path, save_every_n_iterations=1))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    for _ in range(3):
+        net.fit(x, y)
+    cps = CheckpointListener.available_checkpoints(tmp_path)
+    assert [c["number"] for c in cps] == [1, 2, 3]
+    assert cps[-1]["iteration"] == 3
+    latest = CheckpointListener.load_checkpoint(tmp_path)
+    np.testing.assert_allclose(np.asarray(latest.params[0]["W"]),
+                               np.asarray(net.params[0]["W"]), rtol=1e-6)
+    second = CheckpointListener.load_checkpoint(tmp_path, number=2)
+    assert second.params is not None
+    import pytest as _pytest
+    with _pytest.raises(FileNotFoundError, match="no checkpoint number 9"):
+        CheckpointListener.load_checkpoint(tmp_path, number=9)
