@@ -570,6 +570,74 @@ class MultiLayerNetwork:
         return h[:, -1, :] if squeeze and h.ndim == 3 else h
 
     # ------------------------------------------------------------ evaluation
+    # ------------------------------------------------------------- pretrain
+    def pretrain_layer(self, layer_idx: int, data, epochs: int = 1
+                       ) -> "MultiLayerNetwork":
+        """Unsupervised pretraining of ONE layer
+        (``MultiLayerNetwork.pretrainLayer``): inputs are featurized
+        through the frozen layers below, then the layer's own
+        ``pretrain_loss`` (VAE ELBO / autoencoder reconstruction) is
+        minimized with its configured updater in a jitted step."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if self.params is None:
+            self.init()
+        layer = self.layers[layer_idx]
+        if not hasattr(layer, "pretrain_loss"):
+            raise ValueError(
+                f"layer {layer_idx} ({type(layer).__name__}) has no "
+                "pretrain_loss — only VAE/autoencoder layers pretrain")
+        if hasattr(data, "features"):
+            iterator = [data]
+        elif isinstance(data, np.ndarray) or hasattr(data, "shape"):
+            iterator = [DataSet(data, data)]
+        else:
+            iterator = data
+        dtype = self.conf.global_conf.jnp_dtype()
+
+        def step(p_i, upd_i, it, x, rng):
+            loss, grads = jax.value_and_grad(
+                lambda p: layer.pretrain_loss(p, x, rng))(p_i)
+            new_p, new_upd = {}, {}
+            for n, g in grads.items():
+                u = self._updaters[layer_idx][n]
+                lr = u.lr_at(it, 0.0)
+                delta, s = u.update(g, upd_i[n], lr, it + 1.0)
+                new_p[n] = p_i[n] - delta.astype(p_i[n].dtype)
+                new_upd[n] = s
+            return new_p, new_upd, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        it_count = 0
+        loss = None
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                x = _as_jnp(ds.features, dtype)
+                h, _, _ = self._forward_all(
+                    self.params, self.states, x, train=False, rng=None,
+                    mask=None, upto=layer_idx)
+                (self.params[layer_idx], self.updater_states[layer_idx],
+                 loss) = jstep(self.params[layer_idx],
+                               self.updater_states[layer_idx],
+                               jnp.asarray(float(it_count), jnp.float32),
+                               h, self._next_rng())
+                it_count += 1
+        if loss is not None:
+            self._score_arr = loss
+        return self
+
+    def pretrain(self, data, epochs: int = 1) -> "MultiLayerNetwork":
+        """Layer-wise unsupervised pretraining over every pretrainable
+        layer in order (``MultiLayerNetwork.pretrain(DataSetIterator)``)."""
+        if self.params is None:
+            self.init()
+        for i, l in enumerate(self.layers):
+            if hasattr(l, "pretrain_loss"):
+                self.pretrain_layer(i, data, epochs=epochs)
+        return self
+
     def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
         """Evaluate over an iterator (``MultiLayerNetwork.evaluate``).
         ``top_n`` > 1 additionally tracks top-N accuracy; when the iterator

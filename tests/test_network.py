@@ -467,3 +467,29 @@ def test_output_accepts_iterator_and_dataset():
     np.testing.assert_allclose(via_it, direct, rtol=1e-6)
     via_ds = np.asarray(net.output(DataSet(x, y)))
     np.testing.assert_allclose(via_ds, direct, rtol=1e-6)
+
+
+def test_layerwise_pretraining():
+    """MultiLayerNetwork.pretrain / pretrainLayer: unsupervised layer-wise
+    training drives the autoencoder layer's reconstruction loss down."""
+    from deeplearning4j_tpu.nn.layers import AutoEncoderLayer
+
+    conf = (NeuralNetConfiguration.builder().seed(4).updater(Adam(0.01))
+            .list()
+            .layer(AutoEncoderLayer(n_in=8, n_out=4, activation="sigmoid"))
+            .layer(OutputLayer(n_in=4, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = (rng.random((64, 8)) < 0.3).astype(np.float32)
+    import jax.numpy as jnp
+    import jax
+    l0 = float(jax.jit(net.layers[0].pretrain_loss)(
+        net.params[0], jnp.asarray(x), jax.random.PRNGKey(0)))
+    net.pretrain(x, epochs=30)
+    l1 = float(jax.jit(net.layers[0].pretrain_loss)(
+        net.params[0], jnp.asarray(x), jax.random.PRNGKey(0)))
+    assert l1 < l0 * 0.9
+    # non-pretrainable layer rejected loudly
+    with pytest.raises(ValueError, match="no\\s+pretrain_loss|no pretrain_loss"):
+        net.pretrain_layer(1, x)
