@@ -652,6 +652,76 @@ class ComputationGraph:
         return outs[0] if len(outs) == 1 else outs
 
     # ------------------------------------------------------------ evaluation
+    # ------------------------------------------------------------- pretrain
+    def pretrain_layer(self, vertex_name: str, data, epochs: int = 1
+                       ) -> "ComputationGraph":
+        """Unsupervised pretraining of one layer vertex
+        (``ComputationGraph.pretrainLayer``): the vertex's input activation
+        is featurized with the rest of the graph frozen, then its own
+        ``pretrain_loss`` is minimized with its configured updater."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        if self.params is None:
+            self.init()
+        vd = self.conf.vertices[vertex_name]
+        layer = vd.obj if vd.is_layer else None
+        if layer is None or not hasattr(layer, "pretrain_loss"):
+            raise ValueError(
+                f"vertex {vertex_name!r} is not a pretrainable layer "
+                "(needs pretrain_loss — VAE/autoencoder)")
+        if hasattr(data, "features") or hasattr(data, "shape"):
+            iterator = [data if hasattr(data, "features")
+                        else DataSet(data, data)]
+        else:
+            iterator = data
+        dtype = self.conf.global_conf.jnp_dtype()
+
+        def step(p_v, upd_v, it, h, rng):
+            loss, grads = jax.value_and_grad(
+                lambda p: layer.pretrain_loss(p, h, rng))(p_v)
+            new_p, new_upd = {}, {}
+            for n, g in grads.items():
+                u = self._updaters[vertex_name][n]
+                lr = u.lr_at(it, 0.0)
+                delta, s = u.update(g, upd_v[n], lr, it + 1.0)
+                new_p[n] = p_v[n] - delta.astype(p_v[n].dtype)
+                new_upd[n] = s
+            return new_p, new_upd, loss
+
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+        it_count = 0
+        for _ in range(epochs):
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+            for ds in iterator:
+                mds = self._to_mds(ds)
+                inputs = {n: _as_jnp(f, dtype)
+                          for n, f in zip(self.conf.inputs, mds.features)}
+                acts, _, _, _ = self._forward_all(
+                    self.params, self.states, inputs, train=False, rng=None)
+                ins = [acts[s] for s in vd.inputs]
+                h = ins[0] if len(ins) == 1 else jnp.concatenate(ins, -1)
+                (self.params[vertex_name],
+                 self.updater_states[vertex_name], loss) = jstep(
+                    self.params[vertex_name],
+                    self.updater_states[vertex_name],
+                    jnp.asarray(float(it_count), jnp.float32), h,
+                    self._next_rng())
+                it_count += 1
+                self._score_arr = loss
+        return self
+
+    def pretrain(self, data, epochs: int = 1) -> "ComputationGraph":
+        """Layer-wise pretraining over every pretrainable vertex in
+        topological order (``ComputationGraph.pretrain``)."""
+        if self.params is None:
+            self.init()
+        for name in self.conf.topo_order:
+            vd = self.conf.vertices[name]
+            if vd.is_layer and hasattr(vd.obj, "pretrain_loss"):
+                self.pretrain_layer(name, data, epochs=epochs)
+        return self
+
     def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
         """Evaluate the first output over an iterator
         (``ComputationGraph.evaluate``); ``top_n`` and collected record

@@ -543,3 +543,34 @@ def test_graph_evaluate_topn_and_metadata(tmp_path):
     assert e.accuracy() > 0.9
     assert e.top_n_accuracy() >= e.accuracy()
     assert e.get_predictions_by_actual_class(0) is not None
+
+
+def test_graph_pretrain_layer():
+    """ComputationGraph.pretrainLayer on an autoencoder vertex."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import (AutoEncoderLayer, DenseLayer,
+                                              OutputLayer)
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    g = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01))
+         .graph_builder().add_inputs("in"))
+    g.add_layer("ae", AutoEncoderLayer(n_in=8, n_out=4,
+                                       activation="sigmoid"), "in")
+    g.add_layer("out", OutputLayer(n_in=4, n_out=2), "ae")
+    net = ComputationGraph(g.set_outputs("out").build()).init()
+    rng = np.random.default_rng(1)
+    x = (rng.random((64, 8)) < 0.3).astype(np.float32)
+    ae = net.conf.vertices["ae"].obj
+    l0 = float(jax.jit(ae.pretrain_loss)(net.params["ae"], jnp.asarray(x),
+                                         jax.random.PRNGKey(0)))
+    net.pretrain(x, epochs=30)
+    l1 = float(jax.jit(ae.pretrain_loss)(net.params["ae"], jnp.asarray(x),
+                                         jax.random.PRNGKey(0)))
+    assert l1 < l0 * 0.9
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="pretrainable"):
+        net.pretrain_layer("out", x)
