@@ -509,6 +509,35 @@ class MultiLayerNetwork:
                                 train=False)
         return float(loss)
 
+    def score_examples(self, ds, add_regularization: bool = False) -> np.ndarray:
+        """Per-example losses (``MultiLayerNetwork.scoreExamples``): the
+        data term of each example's loss, computed in one jitted ``vmap``
+        over single-example batches (inference statistics, so examples are
+        independent); ``add_regularization`` adds the network's l1/l2 term
+        to every score, matching the reference."""
+        dtype = self.conf.global_conf.jnp_dtype()
+        x = _as_jnp(ds.features, dtype)
+        y = _as_jnp(ds.labels, dtype)
+        lmask = None if ds.labels_mask is None else _as_jnp(ds.labels_mask)
+
+        def one(xi, yi, lmi):
+            loss, _ = self._loss_fn(self.params, self.states, xi[None],
+                                    yi[None], None, None,
+                                    None if lmi is None else lmi[None],
+                                    train=False)
+            return loss
+
+        if lmask is None:
+            scores = jax.jit(jax.vmap(lambda a, b: one(a, b, None)))(x, y)
+        else:
+            scores = jax.jit(jax.vmap(one))(x, y, lmask)
+        reg = self._regularization(self.params)
+        # _loss_fn includes the regularization term once per (1-example)
+        # batch; scoreExamples semantics: data term per example, plus reg
+        # only when requested
+        scores = scores - reg + (reg if add_regularization else 0.0)
+        return np.asarray(scores)
+
     def compute_gradient_and_score(self, x, y, features_mask=None, labels_mask=None):
         """Returns (gradients pytree, score) without updating params —
         the hook used by gradient checks (GradientCheckUtil parity)."""

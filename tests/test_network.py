@@ -493,3 +493,33 @@ def test_layerwise_pretraining():
     # non-pretrainable layer rejected loudly
     with pytest.raises(ValueError, match="no\\s+pretrain_loss|no pretrain_loss"):
         net.pretrain_layer(1, x)
+
+
+def test_score_examples_per_example_losses():
+    """MultiLayerNetwork.scoreExamples: per-example data-term losses; the
+    mean matches score(), and regularization adds uniformly on request."""
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01))
+            .l2(1e-3).list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    cls = rng.integers(0, 2, 16)
+    y = np.eye(2, dtype=np.float32)[cls]
+    ds = DataSet(x, y)
+    scores = net.score_examples(ds)
+    assert scores.shape == (16,)
+    # score(ds) includes the reg term once; per-example data terms average
+    # to the data component
+    reg = float(net._regularization(net.params))
+    assert np.mean(scores) == pytest.approx(net.score(ds) - reg, rel=1e-4)
+    with_reg = net.score_examples(ds, add_regularization=True)
+    np.testing.assert_allclose(with_reg, scores + reg, rtol=1e-5)
+    # an obviously-wrong-labeled example scores higher than a correct one
+    y_bad = y.copy()
+    y_bad[0] = 1 - y_bad[0]
+    s_bad = net.score_examples(DataSet(x, y_bad))
+    assert s_bad[0] != pytest.approx(scores[0])
