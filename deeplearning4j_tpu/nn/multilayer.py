@@ -756,6 +756,76 @@ class MultiLayerNetwork:
         lines += ["-" * 76, f"Total parameters: {total:,}", "=" * 76]
         return "\n".join(lines)
 
+    def set_learning_rate(self, lr) -> None:
+        """Override every updater's learning rate at runtime
+        (``MultiLayerNetwork.setLearningRate``): updaters are frozen
+        dataclasses closed over by the jitted step, so the override
+        rebuilds them (state layouts are unchanged — momentum carries
+        over) and invalidates the jit cache for a retrace."""
+        import dataclasses as _dc
+        self._updaters = [
+            {n: _dc.replace(u, learning_rate=lr) for n, u in umap.items()}
+            for umap in self._updaters]
+        for i, l in enumerate(self.layers):
+            if l.updater is not None:
+                l.updater = _dc.replace(l.updater, learning_rate=lr)
+        g = self.conf.global_conf
+        if g.updater is not None:
+            g.updater = _dc.replace(g.updater, learning_rate=lr)
+        self._jit_cache.clear()
+
+    def layer_size(self, layer_idx: int) -> int:
+        """``layerSize(int)``: the layer's output size (nOut)."""
+        l = self.layers[layer_idx]
+        n = getattr(l, "n_out", None)
+        if n:
+            return int(n)
+        p = (self.params or [{}] * len(self.layers))[layer_idx]
+        if "W" in p:
+            return int(p["W"].shape[-1])
+        raise ValueError(f"layer {layer_idx} has no defined output size")
+
+    def get_layer_names(self) -> List[str]:
+        """``getLayerNames``: per-layer names (class name when unnamed)."""
+        return [getattr(l, "name", None) or type(l).__name__
+                for l in self.layers]
+
+    def to_computation_graph(self) -> "Any":
+        """Convert to an equivalent single-chain ComputationGraph carrying
+        the SAME parameters and states (``toComputationGraph``)."""
+        import copy
+
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            GraphBuilder, VertexDef)
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        names = []
+        counts = {}
+        for l in self.layers:
+            base = getattr(l, "name", None) or type(l).__name__.lower()
+            counts[base] = counts.get(base, 0) + 1
+            names.append(base if counts[base] == 1 else
+                         f"{base}_{counts[base]}")
+        g = GraphBuilder(copy.deepcopy(self.conf.global_conf))
+        g.add_inputs("input")
+        prev = "input"
+        for nm, l in zip(names, self.layers):
+            g.add_layer(nm, copy.deepcopy(l), prev)
+            prev = nm
+        conf = g.set_outputs(prev).build()
+        net = ComputationGraph(conf)
+        if self.params is not None:
+            net.init()
+            net.params = {nm: dict(p) for nm, p in zip(names, self.params)}
+            net.states = {nm: dict(s) for nm, s in zip(names, self.states)}
+            net.updater_states = {nm: {k: dict(v) for k, v in u.items()}
+                                  for nm, u in zip(names,
+                                                   self.updater_states)}
+            net.iteration = self.iteration
+            net.epoch = self.epoch
+        return net
+
     # ------------------------------------------------------------------ misc
     def num_params(self) -> int:
         if self.params is None:

@@ -523,3 +523,31 @@ def test_score_examples_per_example_losses():
     y_bad[0] = 1 - y_bad[0]
     s_bad = net.score_examples(DataSet(x, y_bad))
     assert s_bad[0] != pytest.approx(scores[0])
+
+
+def test_set_learning_rate_layer_names_and_to_graph():
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    conf = (NeuralNetConfiguration.builder().seed(2).updater(Adam(0.01))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="relu",
+                              name="enc"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    assert net.get_layer_names() == ["enc", "OutputLayer"]
+    assert net.layer_size(0) == 8 and net.layer_size(1) == 2
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(8, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+    net.fit(x, y)
+    net.set_learning_rate(0.0)  # frozen from here
+    w = np.asarray(net.params[0]["W"]).copy()
+    net.fit(x, y)
+    np.testing.assert_allclose(np.asarray(net.params[0]["W"]), w)
+
+    cg = net.to_computation_graph()
+    np.testing.assert_allclose(np.asarray(cg.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
+    cg.fit(x, y)  # the converted graph trains
+    assert np.isfinite(cg.score_)
