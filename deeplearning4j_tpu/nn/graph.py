@@ -782,6 +782,21 @@ class ComputationGraph:
             e.eval(np.asarray(mds.labels[0]), np.asarray(out))
         return e
 
+    def evaluate_roc(self, iterator, threshold_steps: int = 0):
+        """Binary ROC over the first output (``ComputationGraph
+        .evaluateROC``)."""
+        from deeplearning4j_tpu.eval.roc import ROC
+        r = ROC(threshold_steps=threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[0]
+            r.eval(np.asarray(mds.labels[0]), np.asarray(out))
+        return r
+
     def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0):
         """One-vs-all ROC per class over the first output
         (``ComputationGraph.evaluateROCMultiClass``)."""
@@ -796,6 +811,66 @@ class ComputationGraph:
                 out = out[0]
             r.eval(np.asarray(mds.labels[0]), np.asarray(out))
         return r
+
+    def output_single(self, *xs) -> Array:
+        """First output as a single array (``outputSingle``)."""
+        out = self.output(*xs)
+        return out[0] if isinstance(out, list) else out
+
+    def get_vertex(self, name: str):
+        """Vertex definition by name (``getVertex``)."""
+        return self.conf.vertices[name]
+
+    def layer_size(self, name: str) -> int:
+        """Output size of a layer vertex (``layerSize``)."""
+        vd = self.conf.vertices[name]
+        n = getattr(vd.obj, "n_out", None) if vd.is_layer else None
+        if n:
+            return int(n)
+        p = (self.params or {}).get(name, {})
+        if "W" in p:
+            return int(p["W"].shape[-1])
+        raise ValueError(f"vertex {name!r} has no defined output size")
+
+    def set_learning_rate(self, lr) -> None:
+        """Runtime LR override for every updater (``setLearningRate``);
+        rebuilds the frozen updater dataclasses and invalidates the jit
+        cache (momentum/state carries over)."""
+        import dataclasses as _dc
+        self._updaters = {
+            name: {n: _dc.replace(u, learning_rate=lr)
+                   for n, u in umap.items()}
+            for name, umap in self._updaters.items()}
+        for vd in self.conf.layer_vertices():
+            if vd.obj.updater is not None:
+                vd.obj.updater = _dc.replace(vd.obj.updater,
+                                             learning_rate=lr)
+        g = self.conf.global_conf
+        if g.updater is not None:
+            g.updater = _dc.replace(g.updater, learning_rate=lr)
+        self._jit_cache.clear()
+
+    def score_examples(self, ds, add_regularization: bool = False
+                       ) -> np.ndarray:
+        """Per-example losses over the first labels
+        (``ComputationGraph.scoreExamples``), one jitted vmap."""
+        mds = self._to_mds(ds)
+        dtype = self.conf.global_conf.jnp_dtype()
+        inputs = {n: _as_jnp(f, dtype)
+                  for n, f in zip(self.conf.inputs, mds.features)}
+        labels = [_as_jnp(l, dtype) for l in mds.labels]
+
+        def one(ins, labs):
+            loss, _ = self._loss_fn(
+                self.params, self.states,
+                {k: v[None] for k, v in ins.items()},
+                [l[None] for l in labs], None, None, None, train=False)
+            return loss
+
+        scores = jax.jit(jax.vmap(one))(inputs, labels)
+        reg = self._regularization(self.params)
+        scores = scores - reg + (reg if add_regularization else 0.0)
+        return np.asarray(scores)
 
     # ------------------------------------------------------------------ misc
     def num_params(self) -> int:

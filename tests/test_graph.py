@@ -574,3 +574,40 @@ def test_graph_pretrain_layer():
     import pytest as _pytest
     with _pytest.raises(ValueError, match="pretrainable"):
         net.pretrain_layer("out", x)
+
+
+def test_graph_surface_methods():
+    """evaluateROC, scoreExamples, setLearningRate, outputSingle,
+    layerSize, getVertex on ComputationGraph."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    g = (NeuralNetConfiguration.builder().seed(3).updater(Adam(0.05))
+         .graph_builder().add_inputs("in"))
+    g.add_layer("h", DenseLayer(n_in=4, n_out=16, activation="relu"), "in")
+    g.add_layer("out", OutputLayer(n_in=16, n_out=2), "h")
+    net = ComputationGraph(g.set_outputs("out").build()).init()
+    assert net.layer_size("h") == 16
+    assert net.get_vertex("h").is_layer
+    rng = np.random.default_rng(1)
+    cls = rng.integers(0, 2, 64)
+    x = rng.normal(size=(64, 4)).astype(np.float32)
+    x[np.arange(64), cls] += 2.0
+    y = np.eye(2, dtype=np.float32)[cls]
+    for _ in range(20):
+        net.fit(x, y)
+    assert net.output_single(x).shape == (64, 2)
+    roc = net.evaluate_roc(ListDataSetIterator(DataSet(x, y), 32))
+    assert roc.calculate_auc() > 0.9
+    scores = net.score_examples(DataSet(x, y))
+    assert scores.shape == (64,)
+    assert np.isfinite(scores).all()
+    net.set_learning_rate(0.0)
+    w = np.asarray(net.params["h"]["W"]).copy()
+    net.fit(x, y)
+    np.testing.assert_allclose(np.asarray(net.params["h"]["W"]), w)
