@@ -837,16 +837,18 @@ class ComputationGraph:
         rebuilds the frozen updater dataclasses and invalidates the jit
         cache (momentum/state carries over)."""
         import dataclasses as _dc
+        rep = lambda u: (_dc.replace(u, learning_rate=lr)
+                         if hasattr(u, "learning_rate") else u)
         self._updaters = {
-            name: {n: _dc.replace(u, learning_rate=lr)
-                   for n, u in umap.items()}
+            name: {n: rep(u) for n, u in umap.items()}
             for name, umap in self._updaters.items()}
         for vd in self.conf.layer_vertices():
-            if vd.obj.updater is not None:
+            if vd.obj.updater is not None and hasattr(
+                    vd.obj.updater, "learning_rate"):
                 vd.obj.updater = _dc.replace(vd.obj.updater,
                                              learning_rate=lr)
         g = self.conf.global_conf
-        if g.updater is not None:
+        if g.updater is not None and hasattr(g.updater, "learning_rate"):
             g.updater = _dc.replace(g.updater, learning_rate=lr)
         self._jit_cache.clear()
 

@@ -763,14 +763,17 @@ class MultiLayerNetwork:
         rebuilds them (state layouts are unchanged — momentum carries
         over) and invalidates the jit cache for a retrace."""
         import dataclasses as _dc
+        rep = lambda u: (_dc.replace(u, learning_rate=lr)
+                         if hasattr(u, "learning_rate") else u)
         self._updaters = [
-            {n: _dc.replace(u, learning_rate=lr) for n, u in umap.items()}
+            {n: rep(u) for n, u in umap.items()}
             for umap in self._updaters]
         for i, l in enumerate(self.layers):
-            if l.updater is not None:
+            if l.updater is not None and hasattr(l.updater,
+                                                 "learning_rate"):
                 l.updater = _dc.replace(l.updater, learning_rate=lr)
         g = self.conf.global_conf
-        if g.updater is not None:
+        if g.updater is not None and hasattr(g.updater, "learning_rate"):
             g.updater = _dc.replace(g.updater, learning_rate=lr)
         self._jit_cache.clear()
 
