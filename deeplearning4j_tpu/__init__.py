@@ -20,3 +20,24 @@ from deeplearning4j_tpu.nn.conf import (  # noqa: F401
     MultiLayerConfiguration,
     InputType,
 )
+
+# Lazy top-level conveniences: the heavyweight model/zoo modules import on
+# first attribute access, keeping bare `import deeplearning4j_tpu` fast.
+_LAZY = {
+    "MultiLayerNetwork": "deeplearning4j_tpu.nn.multilayer",
+    "ComputationGraph": "deeplearning4j_tpu.nn.graph",
+    "ParallelWrapper": "deeplearning4j_tpu.parallel",
+    "ParallelInference": "deeplearning4j_tpu.parallel",
+    "Evaluation": "deeplearning4j_tpu.eval",
+    "DataSet": "deeplearning4j_tpu.datasets.dataset",
+    "ModelSelector": "deeplearning4j_tpu.zoo.zoo_model",
+    "SameDiff": "deeplearning4j_tpu.autodiff.samediff",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)
