@@ -95,6 +95,14 @@ class CollectScoresIterationListener(TrainingListener):
         if iteration % self.frequency == 0:
             self.scores.append((iteration, model.score_))
 
+    def export_scores(self, path, delimiter: str = ",") -> None:
+        """Write collected (iteration, score) pairs
+        (``CollectScoresIterationListener.exportScores``)."""
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"iteration{delimiter}score\n")
+            for it, sc in self.scores:
+                fh.write(f"{it}{delimiter}{sc}\n")
+
 
 class TimeIterationListener(TrainingListener):
     """ETA logging over a planned iteration count (TimeIterationListener)."""

@@ -391,3 +391,19 @@ def test_checkpoint_static_loaders(tmp_path):
     import pytest as _pytest
     with _pytest.raises(FileNotFoundError, match="no checkpoint number 9"):
         CheckpointListener.load_checkpoint(tmp_path, number=9)
+
+
+def test_collect_scores_export(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import (
+        CollectScoresIterationListener)
+    l = CollectScoresIterationListener()
+    class M:  # minimal model stand-in
+        score_ = 0.5
+    for i in range(1, 4):
+        M.score_ = 1.0 / i
+        l.iteration_done(M, i, 0)
+    p = tmp_path / "scores.csv"
+    l.export_scores(p)
+    lines = p.read_text().strip().splitlines()
+    assert lines[0] == "iteration,score" and len(lines) == 4
+    assert lines[1].startswith("1,")
