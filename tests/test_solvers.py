@@ -157,3 +157,89 @@ class TestModelSolver:
         net, ds = self._net_and_data("lbfgs")
         with pytest.raises(ValueError):
             Solver(net, algo="newton").optimize(ds)
+
+
+class TestHpo:
+    """optimize/hpo.py — the Arbiter role: spaces, random + grid search."""
+
+    def test_spaces_sample_in_range(self):
+        from deeplearning4j_tpu.optimize.hpo import (Choice, IntRange,
+                                                     LogUniform, Uniform)
+        rng = np.random.default_rng(0)
+        assert Choice("a", "b").sample(rng) in ("a", "b")
+        assert 2 <= IntRange(2, 5).sample(rng) <= 5
+        assert 0.1 <= Uniform(0.1, 0.2).sample(rng) < 0.2
+        v = LogUniform(1e-4, 1e-1).sample(rng)
+        assert 1e-4 <= v < 1e-1
+        assert IntRange(1, 3).grid_values() == [1, 2, 3]
+
+    def test_random_search_finds_planted_optimum(self):
+        from deeplearning4j_tpu.optimize.hpo import (LogUniform,
+                                                     RandomSearch, Choice)
+        calls = []
+
+        def model_fn(p):
+            calls.append(p)
+            return p
+
+        def score_fn(model, p):
+            # quadratic bowl around lr=1e-2 plus a penalty for width 8
+            return (np.log10(p["lr"]) + 2) ** 2 + (0.5 if p["width"] == 8
+                                                   else 0.0)
+
+        rs = RandomSearch({"lr": LogUniform(1e-4, 1e0),
+                           "width": Choice(8, 16)},
+                          model_fn, score_fn)
+        best = rs.optimize(n_trials=40, seed=1)
+        assert len(rs.trials) == 40 and len(calls) == 40
+        assert best.params["width"] == 16
+        assert 3e-3 < best.params["lr"] < 3e-2
+
+    def test_grid_search_enumerates_product(self):
+        from deeplearning4j_tpu.optimize.hpo import Choice, GridSearch, IntRange
+        gs = GridSearch({"a": Choice(1, 2), "b": IntRange(0, 2)},
+                        lambda p: p, lambda m, p: p["a"] * 10 + p["b"])
+        best = gs.optimize()
+        assert len(gs.trials) == 6
+        assert best.params == {"a": 1, "b": 0}
+        from deeplearning4j_tpu.optimize.hpo import Uniform
+        import pytest as _pytest
+        with _pytest.raises(NotImplementedError, match="continuous"):
+            GridSearch({"u": Uniform(0, 1)}, lambda p: p,
+                       lambda m, p: 0.0).optimize()
+
+    def test_end_to_end_tiny_training_search(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.updaters import Adam
+        from deeplearning4j_tpu.optimize.hpo import Choice, GridSearch
+
+        rng = np.random.default_rng(0)
+        cls = rng.integers(0, 2, 64)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        x[np.arange(64), cls] += 2.0
+        y = np.eye(2, dtype=np.float32)[cls]
+
+        def model_fn(p):
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(p["lr"])).list()
+                    .layer(DenseLayer(n_in=4, n_out=p["width"],
+                                      activation="relu"))
+                    .layer(OutputLayer(n_in=p["width"], n_out=2))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            for _ in range(10):
+                net.fit(x, y)
+            return net
+
+        def score_fn(net, p):
+            e = net.evaluate(ListDataSetIterator(DataSet(x, y), 32))
+            return 1.0 - e.accuracy()
+
+        best = GridSearch({"lr": Choice(1e-5, 5e-2), "width": Choice(8)},
+                          model_fn, score_fn, keep_models=True).optimize()
+        assert best.params["lr"] == 5e-2  # the learnable configuration wins
+        assert best.score < 0.2
+        assert best.model is not None
