@@ -9,7 +9,8 @@ the TPU-native stack:
 2. **Center loss** — `CenterLossOutputLayer` pulls same-class embeddings
    toward learned centers (tutorial 07's FaceNet recipe): intra-class
    spread shrinks vs a plain softmax head;
-3. **Hyperparameter search** — a small random search driven by
+3. **Hyperparameter search** — `optimize/hpo.py` (the Arbiter role:
+   parameter spaces + RandomSearch) driven by
    `EarlyStoppingTrainer` with held-out scoring picks width/learning-rate
    (tutorial 11 uses Arbiter, an external dependency of the reference; the
    search loop here is plain Python over the same config builder).
@@ -106,14 +107,14 @@ def main():
     yh = np.eye(4, dtype=np.float32)[np.argmax(xh @ wh, axis=1)]
     train, val = DataSet(xh[:384], yh[:384]), DataSet(xh[384:], yh[384:])
 
-    space = {"width": [8, 32, 128], "lr": [3e-4, 3e-3, 3e-2]}
-    results = []
-    for trial in range(5):
-        width = space["width"][rng.integers(0, 3)]
-        lr = space["lr"][rng.integers(0, 3)]
-        conf = (NeuralNetConfiguration.builder().seed(trial).updater(Adam(lr))
-                .list()
-                .layer(DenseLayer(n_in=10, n_out=width, activation="relu"))
+    from deeplearning4j_tpu.optimize.hpo import (Choice, LogUniform,
+                                                 RandomSearch)
+
+    def model_fn(p):
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .updater(Adam(p["lr"])).list()
+                .layer(DenseLayer(n_in=10, n_out=p["width"],
+                                  activation="relu"))
                 .layer(OutputLayer(n_out=4, activation="softmax",
                                    loss="mcxent"))
                 .set_input_type(InputType.feed_forward(10))
@@ -124,17 +125,20 @@ def main():
             epoch_termination_conditions=[
                 MaxEpochsTerminationCondition(30),
                 ScoreImprovementEpochTerminationCondition(5)])
-        result = EarlyStoppingTrainer(
+        return EarlyStoppingTrainer(
             es, MultiLayerNetwork(conf).init(),
             ListDataSetIterator(train, 64, shuffle=True)).fit()
-        results.append((result.best_model_score, width, lr, result))
-        print(f"  trial {trial}: width={width:<4} lr={lr:<7} "
-              f"val loss {result.best_model_score:.4f} "
-              f"(stopped at epoch {result.total_epochs}, "
-              f"best {result.best_model_epoch})")
-    best_score, width, lr, best = min(results, key=lambda r: r[0])
-    ev = best.best_model.evaluate(ListDataSetIterator(val, 128))
-    print(f"best config: width={width} lr={lr} -> "
+
+    search = RandomSearch(
+        {"width": Choice(8, 32, 128), "lr": LogUniform(3e-4, 3e-2)},
+        model_fn, lambda result, p: result.best_model_score,
+        keep_models=True)
+    best = search.optimize(n_trials=5, seed=7)
+    for t in search.trials:
+        print(f"  width={t.params['width']:<4} lr={t.params['lr']:.2e} "
+              f"val loss {t.score:.4f}")
+    ev = best.model.best_model.evaluate(ListDataSetIterator(val, 128))
+    print(f"best config: {best.params} -> "
           f"val accuracy {ev.accuracy():.3f}")
 
 
