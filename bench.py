@@ -77,10 +77,11 @@ def main():
         from tpu_perf_session import profile_step
         times = profile_step(net, ds, "/tmp/bench_prof")
         dev = sum(t for t, _ in times.values()) / 4
-        record["device_ms_per_step"] = round(dev * 1e3, 2)
-        record["device_time_images_per_sec"] = round(batch / dev, 1)
-        record["dispatch_overhead_ms_per_step"] = round(
-            dt / steps * 1e3 - dev * 1e3, 2)
+        if dev > 0:  # CPU hosts have no TPU plane -> omit, don't report 0
+            record["device_ms_per_step"] = round(dev * 1e3, 2)
+            record["device_time_images_per_sec"] = round(batch / dev, 1)
+            record["dispatch_overhead_ms_per_step"] = round(
+                dt / steps * 1e3 - dev * 1e3, 2)
     except Exception:
         pass
     print(json.dumps(record))

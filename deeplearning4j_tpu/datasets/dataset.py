@@ -74,6 +74,22 @@ class DataSet:
         return DataSet(f, l, fm, lm)
 
 
+def apply_preprocessor(pre, ds):
+    """Apply a DataSet pre-processor or normalizer, whichever face it
+    exposes — mutating ``preprocess``/``pre_process`` or returning
+    ``transform`` — and carry ``example_meta_data`` across a returned
+    copy. The one shared implementation of this duck-typing."""
+    fn = (getattr(pre, "preprocess", None)
+          or getattr(pre, "pre_process", None)
+          or getattr(pre, "transform", None))
+    out = fn(ds)
+    if out is not None:
+        if getattr(out, "example_meta_data", None) is None:
+            out.example_meta_data = getattr(ds, "example_meta_data", None)
+        ds = out
+    return ds
+
+
 class MultiDataSet:
     """Multiple features/labels arrays (ComputationGraph input/output sets)."""
 

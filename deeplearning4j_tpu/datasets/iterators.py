@@ -499,11 +499,7 @@ class CombinedPreProcessor:
         self.preprocessors = list(preprocessors)
 
     def preprocess(self, ds):
+        from deeplearning4j_tpu.datasets.dataset import apply_preprocessor
         for p in self.preprocessors:
-            fn = (getattr(p, "preprocess", None)
-                  or getattr(p, "pre_process", None)
-                  or getattr(p, "transform", None))
-            out = fn(ds)
-            if out is not None:
-                ds = out
+            ds = apply_preprocessor(p, ds)
         return ds

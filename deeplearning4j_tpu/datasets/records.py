@@ -210,7 +210,9 @@ class ImageRecordReader(RecordReader):
             for f in sorted(files):
                 if f.lower().endswith(self.EXTENSIONS):
                     rel = os.path.relpath(root, path)
-                    label = "" if rel == "." else rel.split(os.sep)[0]
+                    # ParentPathLabelGenerator: the file's IMMEDIATE parent
+                    # directory names the class (root/a/b/x.png -> "b")
+                    label = "" if rel == "." else os.path.basename(root)
                     entries.append((os.path.join(root, f), label))
         self.labels = sorted({lab for _, lab in entries})
         idx = {lab: i for i, lab in enumerate(self.labels)}
@@ -472,17 +474,8 @@ class RecordReaderDataSetIterator(DataSetIterator):
         ds = DataSet(np.stack(feats), np.stack(labels),
                      example_meta_data=list(metas) or None)
         if self.preprocessor is not None:
-            # DataSetPreProcessor.preProcess (mutating) / Normalizer
-            # .transform (returning) — accept whichever face the object
-            # exposes, and keep the metadata across a returned copy
-            pre = (getattr(self.preprocessor, "preprocess", None)
-                   or getattr(self.preprocessor, "pre_process", None)
-                   or getattr(self.preprocessor, "transform", None))
-            out = pre(ds)
-            if out is not None:
-                if getattr(out, "example_meta_data", None) is None:
-                    out.example_meta_data = ds.example_meta_data
-                ds = out
+            from deeplearning4j_tpu.datasets.dataset import apply_preprocessor
+            ds = apply_preprocessor(self.preprocessor, ds)
         return ds
 
     def load_from_meta_data(self, metas) -> DataSet:

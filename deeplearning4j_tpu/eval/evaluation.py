@@ -187,8 +187,9 @@ class Evaluation:
             denom = b2 * p + r
             return (1 + b2) * p * r / denom if denom else 0.0
         if averaging == "macro":
-            return float(np.mean([self.f_beta(beta, i)
-                                  for i in range(self.num_classes)]))
+            cs = self._support_classes()
+            return float(np.mean([self.f_beta(beta, i) for i in cs])) \
+                if cs else 0.0
         p = self.precision_averaged("micro")
         r = self.recall_averaged("micro")
         denom = b2 * p + r
@@ -211,13 +212,23 @@ class Evaluation:
         tn = int(self.confusion.sum()) - tp - fp - fn
         return tp, fp, fn, tn
 
+    def _support_classes(self):
+        """Classes with at least one true or predicted instance — the
+        subset this framework's macro averages run over (consistent with
+        ``precision()``/``recall()``/``f1()``)."""
+        return [i for i in range(self.num_classes)
+                if self.confusion[:, i].sum()
+                + self.confusion[i, :].sum() > 0]
+
     def precision_averaged(self, averaging: str = "macro") -> float:
         """``Evaluation.precision(EvaluationAveraging)``: macro averages
-        per-class values over ALL classes; micro pools the counts."""
+        per-class values (over supported classes, matching ``precision()``
+        — the reference divides by ALL classes); micro pools counts."""
         self._check()
         if averaging == "macro":
-            return float(np.mean([self.precision(i)
-                                  for i in range(self.num_classes)]))
+            cs = self._support_classes()
+            return float(np.mean([self.precision(i) for i in cs])) if cs \
+                else 0.0
         tp = sum(self._tp(i) for i in range(self.num_classes))
         fp = sum(self._fp(i) for i in range(self.num_classes))
         return tp / (tp + fp) if tp + fp else 0.0
@@ -225,8 +236,9 @@ class Evaluation:
     def recall_averaged(self, averaging: str = "macro") -> float:
         self._check()
         if averaging == "macro":
-            return float(np.mean([self.recall(i)
-                                  for i in range(self.num_classes)]))
+            cs = self._support_classes()
+            return float(np.mean([self.recall(i) for i in cs])) if cs \
+                else 0.0
         tp = sum(self._tp(i) for i in range(self.num_classes))
         fn = sum(self._fn(i) for i in range(self.num_classes))
         return tp / (tp + fn) if tp + fn else 0.0
@@ -239,8 +251,9 @@ class Evaluation:
         if cls is not None:
             return float(np.sqrt(self.precision(cls) * self.recall(cls)))
         if averaging == "macro":
-            return float(np.mean([self.g_measure(i)
-                                  for i in range(self.num_classes)]))
+            cs = self._support_classes()
+            return float(np.mean([self.g_measure(i) for i in cs])) if cs \
+                else 0.0
         p = self.precision_averaged("micro")
         r = self.recall_averaged("micro")
         return float(np.sqrt(p * r))
@@ -250,8 +263,9 @@ class Evaluation:
         """``Evaluation.matthewsCorrelation(EvaluationAveraging)``."""
         self._check()
         if averaging == "macro":
+            cs = self._support_classes()
             return float(np.mean([self.matthews_correlation(i)
-                                  for i in range(self.num_classes)]))
+                                  for i in cs])) if cs else 0.0
         tp, fp, fn, tn = (sum(self._counts(i)[j]
                               for i in range(self.num_classes))
                           for j in range(4))

@@ -732,10 +732,13 @@ class ComputationGraph:
             iterator.reset()
         for ds in iterator:
             mds = self._to_mds(ds)
-            out = self.output(*mds.features)
+            out = self.output(*mds.features, masks=mds.features_masks)
             if isinstance(out, list):
                 out = out[0]
+            lm = (None if mds.labels_masks is None
+                  else mds.labels_masks[0])
             e.eval(np.asarray(mds.labels[0]), np.asarray(out),
+                   mask=None if lm is None else np.asarray(lm),
                    record_meta_data=getattr(ds, "example_meta_data", None))
         return e
 
@@ -791,10 +794,13 @@ class ComputationGraph:
             iterator.reset()
         for ds in iterator:
             mds = self._to_mds(ds)
-            out = self.output(*mds.features)
+            out = self.output(*mds.features, masks=mds.features_masks)
             if isinstance(out, list):
                 out = out[0]
-            r.eval(np.asarray(mds.labels[0]), np.asarray(out))
+            lm = (None if mds.labels_masks is None
+                  else mds.labels_masks[0])
+            r.eval(np.asarray(mds.labels[0]), np.asarray(out),
+                   mask=None if lm is None else np.asarray(lm))
         return r
 
     def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0):

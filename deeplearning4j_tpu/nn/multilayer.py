@@ -693,7 +693,9 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features,
+                              mask=None if ds.features_mask is None
+                              else _as_jnp(ds.features_mask))
             r.eval(np.asarray(ds.labels), np.asarray(out),
                    mask=None if ds.labels_mask is None
                    else np.asarray(ds.labels_mask))
@@ -708,7 +710,9 @@ class MultiLayerNetwork:
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
-            out = self.output(ds.features)
+            out = self.output(ds.features,
+                              mask=None if ds.features_mask is None
+                              else _as_jnp(ds.features_mask))
             r.eval(np.asarray(ds.labels), np.asarray(out))
         return r
 

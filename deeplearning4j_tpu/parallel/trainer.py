@@ -106,8 +106,16 @@ class ParallelWrapper:
             batch_sharding(self.mesh, _np.asarray(a).ndim, self.data_axis))
         for ds in iterator:
             x = _np.asarray(ds.features)
-            feats = put(x) if x.shape[0] % self.n_workers == 0 else x
-            out = self.model.output(feats)
+            shardable = x.shape[0] % self.n_workers == 0
+            feats = put(x) if shardable else x
+            fm = ds.features_mask
+            if fm is not None:
+                fm = put(fm) if shardable else _np.asarray(fm)
+            if hasattr(self.model, "_to_mds"):  # ComputationGraph face
+                out = self.model.output(
+                    feats, masks=None if fm is None else [fm])
+            else:
+                out = self.model.output(feats, mask=fm)
             if isinstance(out, list):
                 out = out[0]
             e.eval(_np.asarray(ds.labels), _np.asarray(out),

@@ -299,3 +299,19 @@ class TestSequenceMetadata:
         seq, meta = r.next_sequence_with_meta()
         assert seq == [[1, 0]] and meta.index == 0
         assert r.load_sequence_from_meta_data(meta) == [[[1, 0]]]
+
+
+def test_image_reader_nested_tree_uses_immediate_parent(tmp_path):
+    """ParentPathLabelGenerator semantics: root/a/b/x.png is labeled 'b'
+    (the file's IMMEDIATE parent), not the first path component."""
+    from PIL import Image
+    from deeplearning4j_tpu.datasets.records import ImageRecordReader
+    for sub in ("cats/kittens", "cats/adults", "dogs"):
+        d = tmp_path / sub
+        d.mkdir(parents=True)
+        Image.fromarray(np.zeros((4, 4, 3), np.uint8)).save(
+            str(d / "img.png"))
+    r = ImageRecordReader(4, 4, 3, path=str(tmp_path))
+    assert r.labels == ["adults", "dogs", "kittens"]
+    labels = {lab for _, lab in r}
+    assert labels == {0, 1, 2}
