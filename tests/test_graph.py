@@ -611,3 +611,50 @@ def test_graph_surface_methods():
     w = np.asarray(net.params["h"]["W"]).copy()
     net.fit(x, y)
     np.testing.assert_allclose(np.asarray(net.params["h"]["W"]), w)
+
+
+def test_graph_masked_evaluation_matches_mln():
+    """Padded sequence batches: graph evaluate must thread the feature
+    mask into the forward pass and the label mask into eval — identical
+    confusion to the same layers evaluated as a MultiLayerNetwork (the
+    round-3 review's mask-dropping regression)."""
+    import numpy as np
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(0)
+    N, T, F, C = 12, 7, 4, 3
+    x = rng.normal(size=(N, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (N, T))]
+    lengths = rng.integers(2, T + 1, N)
+    m = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    ds = DataSet(x, y, m, m)
+
+    mconf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+             .list()
+             .layer(LSTMLayer(n_in=F, n_out=8))
+             .layer(RnnOutputLayer(n_in=8, n_out=C))
+             .build())
+    mln = MultiLayerNetwork(mconf).init()
+
+    g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(0.01))
+         .graph_builder().add_inputs("in")
+         .set_input_types(InputType.recurrent(F)))
+    g.add_layer("lstm", LSTMLayer(n_in=F, n_out=8), "in")
+    g.add_layer("out", RnnOutputLayer(n_in=8, n_out=C), "lstm")
+    cg = ComputationGraph(g.set_outputs("out").build()).init()
+    # identical params
+    cg.params["lstm"] = dict(mln.params[0])
+    cg.params["out"] = dict(mln.params[1])
+
+    it = ListDataSetIterator(ds, 6)
+    em = mln.evaluate(it)
+    eg = cg.evaluate(ListDataSetIterator(ds, 6))
+    np.testing.assert_array_equal(eg.confusion, em.confusion)
+    # total scored predictions == number of VALID timesteps, not N*T
+    assert em.confusion.sum() == int(m.sum())
