@@ -384,12 +384,14 @@ class Evaluation:
             "top_n": self.top_n,
             "top_n_correct_count": self.top_n_correct_count,
             "top_n_total_count": self.top_n_total_count,
+            "labels_list": self.labels_list,
         })
 
     @staticmethod
     def from_json(s: str) -> "Evaluation":
         d = json.loads(s)
-        e = Evaluation(num_classes=d["num_classes"], top_n=d.get("top_n", 1))
+        e = Evaluation(num_classes=d["num_classes"], top_n=d.get("top_n", 1),
+                       labels_list=d.get("labels_list"))
         if d["confusion"] is not None:
             e.confusion = np.asarray(d["confusion"], np.int64)
         e.top_n_correct_count = d.get("top_n_correct_count", 0)

@@ -809,3 +809,11 @@ def test_network_evaluate_roc_methods():
                                                    abs=0.02)
     multi = net.evaluate_roc_multi_class(it, threshold_steps=50)
     assert multi.calculate_auc(0) > 0.9
+
+
+def test_evaluation_serde_keeps_labels_list():
+    e = Evaluation(labels_list=["cat", "dog"])
+    e.eval(np.eye(2)[[0, 1]], np.array([[0.9, 0.1], [0.2, 0.8]]))
+    back = Evaluation.from_json(e.to_json())
+    assert back.labels_list == ["cat", "dog"]
+    assert "cat" in back.stats()
