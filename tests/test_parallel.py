@@ -415,3 +415,31 @@ class TestDistributedEvaluate:
         # ragged batches (batch 20 over 8 workers) take the unsharded path
         dist2 = pw.evaluate(ListDataSetIterator(DataSet(x, y), 20))
         np.testing.assert_array_equal(dist2.confusion, ref.confusion)
+
+
+def test_mesh_evaluate_masked_sequences(rng):
+    """ParallelWrapper.evaluate threads feature masks through the sharded
+    forward (round-3 review fix) — equality with the unsharded evaluate."""
+    from deeplearning4j_tpu.datasets.dataset import ListDataSetIterator
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import LSTMLayer, RnnOutputLayer
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    N, T, F, C = 16, 6, 4, 3
+    x = rng.normal(size=(N, T, F)).astype(np.float32)
+    y = np.eye(C, dtype=np.float32)[rng.integers(0, C, (N, T))]
+    lengths = rng.integers(2, T + 1, N)
+    m = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(0.01))
+            .list()
+            .layer(LSTMLayer(n_in=F, n_out=8))
+            .layer(RnnOutputLayer(n_in=8, n_out=C))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y, m, m)
+    ref = net.evaluate(ListDataSetIterator(ds, 8))
+    pw = ParallelWrapper(net, make_mesh({"data": 8}),
+                         mode="shared_gradients")
+    dist = pw.evaluate(ListDataSetIterator(ds, 8))
+    np.testing.assert_array_equal(dist.confusion, ref.confusion)
+    assert dist.confusion.sum() == int(m.sum())
