@@ -21,8 +21,32 @@ from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
     MorphologicalDictionary,
 )
 from deeplearning4j_tpu.nlp.sentence import (
+    AggregatingSentenceIterator,
+    BasicLabelAwareIterator,
     BasicLineIterator,
     CollectionSentenceIterator,
+    FileDocumentIterator,
+    FileLabelAwareIterator,
+    FilenamesLabelAwareIterator,
+    FileSentenceIterator,
+    LabelsSource,
+    LineSentenceIterator,
+    MutipleEpochsSentenceIterator,
+    PrefetchingSentenceIterator,
+    StreamLineIterator,
+    SynchronizedSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.cnn_sentence import (
+    CnnSentenceDataSetIterator,
+    CollectionLabeledSentenceProvider,
+    FileLabeledSentenceProvider,
+    LabelAwareConverter,
+    LabeledSentenceProvider,
+)
+from deeplearning4j_tpu.nlp.text_utils import (
+    InMemoryInvertedIndex,
+    InputHomogenization,
+    StopWords,
 )
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, VocabWord
 from deeplearning4j_tpu.nlp.lookup import InMemoryLookupTable
@@ -37,10 +61,20 @@ from deeplearning4j_tpu.nlp.vectorizer import (
 )
 
 __all__ = [
-    "BagOfWordsVectorizer", "BasicLineIterator", "CollectionSentenceIterator",
-    "CommonPreprocessor", "DefaultTokenizer", "DefaultTokenizerFactory",
-    "DictionaryTokenizerFactory", "Glove", "InMemoryLookupTable",
-    "MorphologicalDictionary", "NGramTokenizerFactory",
-    "ParagraphVectors", "SequenceVectors", "TfidfVectorizer", "VocabCache",
-    "VocabConstructor", "VocabWord", "Word2Vec", "WordVectorSerializer",
+    "AggregatingSentenceIterator", "BagOfWordsVectorizer",
+    "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
+    "FileLabeledSentenceProvider", "LabelAwareConverter",
+    "LabeledSentenceProvider",
+    "BasicLabelAwareIterator", "BasicLineIterator",
+    "CollectionSentenceIterator", "CommonPreprocessor", "DefaultTokenizer",
+    "DefaultTokenizerFactory", "DictionaryTokenizerFactory",
+    "FileDocumentIterator", "FileLabelAwareIterator",
+    "FilenamesLabelAwareIterator", "FileSentenceIterator", "Glove",
+    "InMemoryInvertedIndex", "InMemoryLookupTable", "InputHomogenization",
+    "LabelsSource", "LineSentenceIterator", "MorphologicalDictionary",
+    "MutipleEpochsSentenceIterator", "NGramTokenizerFactory",
+    "ParagraphVectors", "PrefetchingSentenceIterator", "SequenceVectors",
+    "StopWords", "StreamLineIterator", "SynchronizedSentenceIterator",
+    "TfidfVectorizer", "VocabCache", "VocabConstructor", "VocabWord",
+    "Word2Vec", "WordVectorSerializer",
 ]

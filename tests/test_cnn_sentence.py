@@ -1,0 +1,194 @@
+"""CnnSentenceDataSetIterator + LabeledSentenceProvider family.
+
+Reference: deeplearning4j-nlp/iterator/CnnSentenceDataSetIterator.java,
+iterator/provider/*.java.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.cnn_sentence import (
+    CnnSentenceDataSetIterator,
+    CollectionLabeledSentenceProvider,
+    FileLabeledSentenceProvider,
+    LabelAwareConverter,
+)
+from deeplearning4j_tpu.nlp.sentence import LabelAwareIterator
+
+
+class FakeWordVectors:
+    """Minimal word-vector model: 3-dim one-hot-ish vectors."""
+
+    _table = {
+        "the": [2.0, 0.0, 0.0],
+        "cat": [0.0, 2.0, 0.0],
+        "sat": [0.0, 0.0, 2.0],
+        "dog": [2.0, 2.0, 0.0],
+    }
+
+    class vocab:  # noqa: N801 - mimics .vocab.words()
+        @staticmethod
+        def words():
+            return list(FakeWordVectors._table)
+
+    def get_word_vector(self, w):
+        v = self._table.get(w)
+        return None if v is None else np.asarray(v, np.float32)
+
+    def has_word(self, w):
+        return w in self._table
+
+
+def make_iterator(sentences, labels, **kw):
+    provider = CollectionLabeledSentenceProvider(sentences, labels, rng=None)
+    kw.setdefault("use_normalized_word_vectors", False)
+    return CnnSentenceDataSetIterator(provider, FakeWordVectors(),
+                                      minibatch_size=32, **kw)
+
+
+class TestProviders:
+    def test_collection_provider_shuffle_off_order(self):
+        p = CollectionLabeledSentenceProvider(["a", "b"], ["x", "y"], rng=None)
+        assert p.next_sentence() == ("a", "x")
+        assert p.next_sentence() == ("b", "y")
+        assert not p.has_next()
+        p.reset()
+        assert p.total_num_sentences() == 2
+        assert p.all_labels() == ["x", "y"]
+
+    def test_collection_provider_length_mismatch(self):
+        with pytest.raises(ValueError):
+            CollectionLabeledSentenceProvider(["a"], ["x", "y"])
+
+    def test_file_provider(self, tmp_path):
+        pos = tmp_path / "p.txt"; pos.write_text("the cat")
+        neg = tmp_path / "n.txt"; neg.write_text("the dog")
+        p = FileLabeledSentenceProvider(
+            {"pos": [str(pos)], "neg": [str(neg)]}, rng=None)
+        assert p.all_labels() == ["neg", "pos"]  # sorted
+        seen = {p.next_sentence() for _ in range(2)}
+        assert seen == {("the cat", "pos"), ("the dog", "neg")}
+
+    def test_label_aware_converter(self):
+        it = LabelAwareIterator([("doc one", ["a"]), ("doc two", ["b"])])
+        p = LabelAwareConverter(it)
+        assert p.all_labels() == ["a", "b"]
+        assert p.next_sentence() == ("doc one", "a")
+
+
+class TestCnnSentenceIterator:
+    def test_feature_shape_along_height(self):
+        it = make_iterator(["the cat sat", "the dog"], ["pos", "neg"])
+        ds = it.next()
+        assert ds.features.shape == (2, 1, 3, 3)  # [mb, 1, maxLen, wv]
+        assert ds.labels.shape == (2, 2)
+        # labels one-hot against sorted label list: neg=0, pos=1
+        np.testing.assert_allclose(ds.labels, [[0, 1], [1, 0]])
+        # mask present because lengths differ (3 vs 2)
+        np.testing.assert_allclose(ds.features_mask, [[1, 1, 1], [1, 1, 0]])
+        # word vectors in the right rows
+        np.testing.assert_allclose(ds.features[0, 0, 1], [0, 2, 0])  # cat
+        np.testing.assert_allclose(ds.features[1, 0, 2], [0, 0, 0])  # padding
+
+    def test_feature_shape_along_width(self):
+        it = make_iterator(["the cat sat"], ["pos"],
+                           sentences_along_height=False)
+        ds = it.next()
+        assert ds.features.shape == (1, 1, 3, 3)  # [mb, 1, wv, maxLen]
+        np.testing.assert_allclose(ds.features[0, 0, :, 1], [0, 2, 0])  # cat
+
+    def test_no_mask_when_same_length(self):
+        it = make_iterator(["the cat", "the dog"], ["a", "b"])
+        ds = it.next()
+        assert ds.features_mask is None
+
+    def test_unknown_remove_and_skip_empty(self):
+        # 'zzz qqq' tokenizes to nothing -> sentence skipped entirely
+        it = make_iterator(["zzz qqq", "the cat"], ["a", "b"])
+        ds = it.next()
+        assert ds.features.shape[0] == 1
+        np.testing.assert_allclose(ds.labels, [[0, 1]])
+        assert not it.has_next()
+
+    def test_unknown_use_unknown_vector(self):
+        it = make_iterator(["zzz cat"], ["a"],
+                           unknown_word_handling="use_unknown",
+                           unknown_vector=np.array([9.0, 9.0, 9.0]))
+        ds = it.next()
+        assert ds.features.shape == (1, 1, 2, 3)
+        np.testing.assert_allclose(ds.features[0, 0, 0], [9, 9, 9])
+
+    def test_normalized_vectors(self):
+        it = make_iterator(["the"], ["a"], use_normalized_word_vectors=True)
+        ds = it.next()
+        np.testing.assert_allclose(ds.features[0, 0, 0], [1, 0, 0])
+
+    def test_max_sentence_length_truncates(self):
+        it = make_iterator(["the cat sat the cat"], ["a"],
+                           max_sentence_length=2)
+        ds = it.next()
+        assert ds.features.shape == (1, 1, 2, 3)
+
+    def test_labels_and_class_map(self):
+        it = make_iterator(["the"], ["b"], )
+        # label map covers the provider's label set, sorted
+        provider = CollectionLabeledSentenceProvider(
+            ["x", "y"], ["m", "k"], rng=None)
+        it2 = CnnSentenceDataSetIterator(
+            provider, FakeWordVectors(), use_normalized_word_vectors=False)
+        assert it2.get_labels() == ["k", "m"]
+        assert it2.get_label_class_map() == {"k": 0, "m": 1}
+        assert it2.input_columns() == 3
+        assert it2.total_examples() == 2
+
+    def test_iteration_and_reset(self):
+        it = make_iterator(["the cat", "the dog", "sat"], ["a", "b", "a"])
+        it.minibatch_size = 2
+        batches = [ds.features.shape[0] for ds in it]
+        assert batches == [2, 1]
+        batches2 = [ds.features.shape[0] for ds in it]  # __iter__ resets
+        assert batches2 == [2, 1]
+
+    def test_load_single_sentence(self):
+        it = make_iterator(["the cat"], ["a"])
+        f = it.load_single_sentence("cat sat")
+        assert f.shape == (1, 1, 2, 3)
+        np.testing.assert_allclose(f[0, 0, 0], [0, 2, 0])
+        with pytest.raises(ValueError):
+            it.load_single_sentence("zzz")
+
+    def test_nhwc_feature_format(self):
+        it = make_iterator(["the cat sat", "the dog"], ["pos", "neg"],
+                           feature_format="NHWC")
+        ds = it.next()
+        assert ds.features.shape == (2, 3, 3, 1)  # [mb, maxLen, wv, 1]
+        np.testing.assert_allclose(ds.features[0, 1, :, 0], [0, 2, 0])  # cat
+        f = it.load_single_sentence("cat")
+        assert f.shape == (1, 1, 3, 1)
+
+    def test_trainable_end_to_end(self):
+        """A tiny conv+global-pool classifier fits CNN sentence batches."""
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+        from deeplearning4j_tpu.nn.layers.pooling import GlobalPoolingLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+
+        sentences = ["the cat sat", "the dog sat", "cat cat sat", "dog the dog"]
+        labels = ["animal", "pet", "animal", "pet"]
+        it = make_iterator(sentences, labels, feature_format="NHWC",
+                           max_sentence_length=3)
+        conf = (NeuralNetConfiguration.builder().seed(7).updater("adam")
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(2, 3),
+                                        convolution_mode="same"))
+                .layer(GlobalPoolingLayer(pooling_type="max"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="negativeloglikelihood"))
+                .set_input_type(InputType.convolutional(3, 3, 1))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        ds = it.next()
+        net.fit(ds.features, ds.labels)  # just must run without shape errors

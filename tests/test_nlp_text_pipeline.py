@@ -1,0 +1,257 @@
+"""Sentence/document iterator family, text utils, moving windows.
+
+Reference behaviors: text/sentenceiterator/*.java, text/documentiterator/*.java,
+text/inputsanitation/InputHomogenization.java, text/stopwords/StopWords.java,
+text/movingwindow/*.java (deeplearning4j-nlp).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.sentence import (
+    AggregatingSentenceIterator,
+    BasicLabelAwareIterator,
+    CollectionSentenceIterator,
+    DocumentIterator,
+    FileDocumentIterator,
+    FileLabelAwareIterator,
+    FilenamesLabelAwareIterator,
+    LabelsSource,
+    LineSentenceIterator,
+    MutipleEpochsSentenceIterator,
+    PrefetchingSentenceIterator,
+    StreamLineIterator,
+    SynchronizedSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.text_utils import (
+    InMemoryInvertedIndex,
+    InputHomogenization,
+    StopWords,
+)
+from deeplearning4j_tpu.nlp import movingwindow as mw
+
+
+class TestSentenceIterators:
+    def test_pre_processor_applied_on_iteration(self):
+        it = CollectionSentenceIterator(["Hello World", "BYE"])
+        it.set_pre_processor(str.lower)
+        assert list(it) == ["hello world", "bye"]
+
+    def test_pre_processor_applied_on_explicit_protocol(self):
+        # nextSentence() itself applies it, as in the reference
+        it = CollectionSentenceIterator(["Hello", "WORLD"])
+        it.set_pre_processor(str.lower)
+        it.reset()
+        out = []
+        while it.has_next():
+            out.append(it.next_sentence())
+        assert out == ["hello", "world"]
+
+    def test_prefetching_propagates_source_error(self):
+        class Exploding(CollectionSentenceIterator):
+            def next_sentence(self):
+                if self._pos >= 2:
+                    raise IOError("disk on fire")
+                return super().next_sentence()
+
+        it = PrefetchingSentenceIterator(Exploding(["a", "b", "c", "d"]), 1)
+        got, err = [], None
+        try:
+            while it.has_next():
+                got.append(it.next_sentence())
+        except IOError as e:
+            err = e
+        assert got == ["a", "b"]
+        assert err is not None  # no deadlock, error surfaced
+
+    def test_line_sentence_iterator(self, tmp_path):
+        p = tmp_path / "f.txt"
+        p.write_text("one\ntwo\nthree\n")
+        it = LineSentenceIterator(str(p))
+        assert list(it) == ["one", "two", "three"]
+        # reset() restarts
+        assert list(it) == ["one", "two", "three"]
+
+    def test_stream_line_iterator_from_documents(self, tmp_path):
+        (tmp_path / "a.txt").write_text("l1\nl2")
+        (tmp_path / "b.txt").write_text("l3")
+        docs = FileDocumentIterator(str(tmp_path))
+        it = StreamLineIterator(docs)
+        assert list(it) == ["l1", "l2", "l3"]
+
+    def test_aggregating_builder_chains_and_preprocesses(self):
+        agg = (AggregatingSentenceIterator.builder()
+               .add_sentence_iterator(CollectionSentenceIterator(["A", "B"]))
+               .add_sentence_iterator(CollectionSentenceIterator(["C"]))
+               .add_sentence_pre_processor(str.lower)
+               .build())
+        assert list(agg) == ["a", "b", "c"]
+
+    def test_multiple_epochs(self):
+        it = MutipleEpochsSentenceIterator(
+            CollectionSentenceIterator(["x", "y"]), 3)
+        assert list(it) == ["x", "y"] * 3
+        with pytest.raises(ValueError):
+            MutipleEpochsSentenceIterator(CollectionSentenceIterator([]), 0)
+
+    def test_prefetching_matches_and_resets(self):
+        src = [str(i) for i in range(100)]
+        it = PrefetchingSentenceIterator(CollectionSentenceIterator(src), 8)
+        assert list(it) == src
+        assert list(it) == src  # reset spawns a fresh producer
+
+    def test_synchronized_concurrent_consumers(self):
+        import threading
+        src = [str(i) for i in range(500)]
+        it = SynchronizedSentenceIterator(CollectionSentenceIterator(src))
+        it.reset()
+        seen = []
+        lock = threading.Lock()
+
+        def consume():
+            while True:
+                s = it.next_sentence()
+                if s is None:
+                    return
+                with lock:
+                    seen.append(s)
+
+        threads = [threading.Thread(target=consume) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(seen, key=int) == src  # each sentence exactly once
+
+
+class TestDocumentIterators:
+    def test_file_document_iterator(self, tmp_path):
+        (tmp_path / "1.txt").write_text("first doc")
+        (tmp_path / "2.txt").write_text("second doc")
+        docs = list(FileDocumentIterator(str(tmp_path)))
+        assert docs == ["first doc", "second doc"]
+
+    def test_labels_source_template_and_formatter(self):
+        ls = LabelsSource("DOC_%d")
+        assert ls.next_label() == "DOC_0"
+        assert ls.next_label() == "DOC_1"
+        assert ls.get_labels() == ["DOC_0", "DOC_1"]
+        plain = LabelsSource("SENT_")
+        assert plain.next_label() == "SENT_0"
+
+    def test_labels_source_template_store_does_not_flip_mode(self):
+        ls = LabelsSource("DOC_%d")
+        ls.store_label("extra")          # stored, but template still drives
+        assert ls.next_label() == "DOC_0"
+        assert ls.next_label() == "DOC_1"
+
+    def test_stream_line_iterator_from_generator(self):
+        it = StreamLineIterator(iter(["a\nb", "c"]))  # one-shot source
+        assert list(it) == ["a", "b", "c"]
+        assert list(it) == ["a", "b", "c"]  # snapshot makes reset() work
+
+    def test_labels_source_list_and_store(self):
+        ls = LabelsSource(["a", "b"])
+        assert ls.next_label() == "a"
+        ls.store_label("c")
+        ls.store_label("c")  # dedupe
+        assert ls.get_labels() == ["a", "b", "c"]
+        assert ls.index_of("c") == 2
+
+    def test_basic_label_aware_iterator(self):
+        it = BasicLabelAwareIterator(
+            CollectionSentenceIterator(["d0", "d1"]),
+            LabelsSource("DOC_%d"))
+        docs = list(it)
+        assert [d.content for d in docs] == ["d0", "d1"]
+        assert [d.labels for d in docs] == [["DOC_0"], ["DOC_1"]]
+        assert it.labels_source.get_labels() == ["DOC_0", "DOC_1"]
+
+    def test_file_label_aware_iterator(self, tmp_path):
+        for label, texts in [("pos", ["good", "great"]), ("neg", ["bad"])]:
+            d = tmp_path / label
+            d.mkdir()
+            for i, t in enumerate(texts):
+                (d / f"{i}.txt").write_text(t)
+        it = FileLabelAwareIterator.builder().add_source_folder(str(tmp_path)).build()
+        docs = list(it)
+        assert {(d.content, d.labels[0]) for d in docs} == {
+            ("good", "pos"), ("great", "pos"), ("bad", "neg")}
+        assert sorted(it.labels_source.get_labels()) == ["neg", "pos"]
+
+    def test_filenames_label_aware_iterator(self, tmp_path):
+        (tmp_path / "x.txt").write_text("content x")
+        it = FilenamesLabelAwareIterator(str(tmp_path))
+        docs = list(it)
+        assert docs[0].labels == ["x.txt"]
+        assert docs[0].content == "content x"
+
+
+class TestTextUtils:
+    def test_input_homogenization(self):
+        # digits -> d, lowercase, punctuation stripped, ! runs collapsed
+        assert InputHomogenization("Hello, World!!! 42").transform() == \
+            "hello world! dd"
+        assert InputHomogenization("ABC", preserve_case=True).transform() == "ABC"
+        out = InputHomogenization("a.b", ignore_characters_containing=["."]).transform()
+        assert out == "a.b"  # ignored chars survive the punctuation strip
+        assert InputHomogenization("a.b").transform() == "ab"
+
+    def test_stop_words(self):
+        words = StopWords.get_stop_words()
+        assert "the" in words and "and" in words
+        assert len(words) > 100
+        assert StopWords.get_stop_words() is words  # cached
+
+    def test_inverted_index(self):
+        idx = InMemoryInvertedIndex()
+        idx.add_words_to_doc(0, ["the", "cat"])
+        idx.add_words_to_doc(1, ["the", "dog"])
+        assert idx.documents("the") == [0, 1]
+        assert idx.documents("cat") == [0]
+        assert idx.document(1) == ["the", "dog"]
+        assert idx.num_documents() == 2
+        assert idx.total_words() == 4
+        assert idx.words() == {"the", "cat", "dog"}
+        batches = list(idx.batch_iter(1))
+        assert batches == [[["the", "cat"]], [["the", "dog"]]]
+
+
+class TestMovingWindow:
+    def test_windows_padding_and_focus(self):
+        ws = mw.windows("the quick brown", 3)
+        assert [w.focus_word() for w in ws] == ["the", "quick", "brown"]
+        assert ws[0].words == ["<s>", "the", "quick"]
+        assert ws[-1].words == ["quick", "brown", "</s>"]
+
+    def test_window_label_detection(self):
+        w = mw.Window(["<LOC>", "york", "</LOC>"], 3, 0, 3)
+        assert w.label == "LOC"
+        assert w.begin_label and w.end_label
+
+    def test_as_example_array_concats_vectors(self):
+        class Vecs:
+            def vector(self, w):
+                return {"a": [1.0, 0.0], "b": [0.0, 2.0]}.get(w)
+        w = mw.Window(["a", "b", "a"], 3, 0, 3)
+        arr = mw.as_example_array(w, Vecs())
+        np.testing.assert_allclose(arr, [1, 0, 0, 2, 1, 0])
+        # normalized
+        arr_n = mw.as_example_array(w, Vecs(), normalize=True)
+        np.testing.assert_allclose(arr_n, [1, 0, 0, 1, 1, 0])
+
+    def test_as_example_matrix_zeros_unknown(self):
+        class Vecs:
+            def vector(self, w):
+                return [3.0] if w == "a" else None
+        w = mw.Window(["a", "zz", "a"], 3, 0, 3)
+        np.testing.assert_allclose(mw.as_example_matrix(w, Vecs()), [3, 0, 3])
+
+    def test_string_with_labels(self):
+        s, spans = mw.string_with_labels("i live in <LOC> new york </LOC> now")
+        assert s == "i live in new york now"
+        assert spans == {(3, 5): "LOC"}
+        with pytest.raises(ValueError):
+            mw.string_with_labels("broken </LOC> here")
