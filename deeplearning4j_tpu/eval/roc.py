@@ -251,6 +251,14 @@ class ROCBinary:
             self._per_col[col] = ROC(threshold_steps=self.threshold_steps)
         return self._per_col[col]
 
+    def num_labels(self) -> int:
+        """Number of output columns seen so far (``numLabels``)."""
+        if self._per_col:
+            return max(self._per_col) + 1
+        if self.labels:
+            return int(np.asarray(self.labels[0]).shape[1])
+        return 0
+
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
@@ -326,6 +334,14 @@ class ROCMultiClass:
         if cls not in self._per_cls:
             self._per_cls[cls] = ROC(threshold_steps=self.threshold_steps)
         return self._per_cls[cls]
+
+    def num_classes(self) -> int:
+        """Number of classes seen so far."""
+        if self._per_cls:
+            return max(self._per_cls) + 1
+        if self.scores:
+            return int(np.asarray(self.scores[0]).shape[1])
+        return 0
 
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels, np.float64)

@@ -1,0 +1,88 @@
+"""Guess-and-load models/configs from an arbitrary file.
+
+Parity with ``deeplearning4j-core/.../util/ModelGuesser.java``: try each
+known loader in the reference's order until one succeeds —
+
+``load_model_guess``: own MultiLayerNetwork zip → own ComputationGraph
+zip → reference DL4J MLN zip → reference DL4J CG zip → Keras HDF5
+(functional, then sequential).
+
+``load_config_guess``: MultiLayerConfiguration JSON → Keras config
+(sequential and functional share one entry point here) →
+ComputationGraphConfiguration JSON → MLN YAML → CG YAML (JSON is tried
+before YAML deliberately, as in the reference — YAML "accidentally"
+parses JSON).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+
+class ModelGuesserException(Exception):
+    """No known loader accepted the file."""
+
+
+def _try_all(path: str, attempts: List[Tuple[str, Any]], kind: str):
+    errors = []
+    for name, fn in attempts:
+        try:
+            return fn(path)
+        except Exception as e:  # noqa: BLE001 - each loader may fail its own way
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+    detail = "; ".join(errors)
+    raise ModelGuesserException(
+        f"Unable to load {kind} from path {path} "
+        f"(invalid file or not a known {kind} type). Tried: {detail}")
+
+
+def load_model_guess(path: str):
+    """Load a full model of unknown provenance (``loadModelGuess``)."""
+    from deeplearning4j_tpu.util import model_serializer as ms
+    from deeplearning4j_tpu.modelimport import dl4j
+    from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+
+    attempts = [
+        ("own MultiLayerNetwork zip", ms.restore_multi_layer_network),
+        ("own ComputationGraph zip", ms.restore_computation_graph),
+        ("DL4J MultiLayerNetwork zip", dl4j.restore_multi_layer_network),
+        ("DL4J ComputationGraph zip", dl4j.restore_computation_graph),
+        ("Keras model h5", KerasModelImport.import_keras_model_and_weights),
+        ("Keras sequential h5",
+         KerasModelImport.import_keras_sequential_model_and_weights),
+    ]
+    return _try_all(path, attempts, "model")
+
+
+def load_config_guess(path: str):
+    """Load a network configuration of unknown provenance
+    (``loadConfigGuess``)."""
+    from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
+    from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+
+    def _read(p):
+        with open(p, "r", encoding="utf-8") as fh:
+            return fh.read()
+
+    attempts = [
+        ("MultiLayerConfiguration JSON",
+         lambda p: MultiLayerConfiguration.from_json(_read(p))),
+        # one Keras entry: import_keras_model_configuration dispatches
+        # sequential vs functional internally
+        ("Keras config",
+         KerasModelImport.import_keras_model_configuration),
+        ("ComputationGraphConfiguration JSON",
+         lambda p: ComputationGraphConfiguration.from_json(_read(p))),
+        ("MultiLayerConfiguration YAML",
+         lambda p: MultiLayerConfiguration.from_yaml(_read(p))),
+        ("ComputationGraphConfiguration YAML",
+         lambda p: ComputationGraphConfiguration.from_yaml(_read(p))),
+    ]
+    return _try_all(path, attempts, "configuration")
+
+
+def load_normalizer(path: str):
+    """Facade for ``ModelSerializer.restoreNormalizerFromFile``."""
+    from deeplearning4j_tpu.util import model_serializer as ms
+    return ms.restore_normalizer(path)

@@ -1,0 +1,84 @@
+"""EvaluationTools: ROC/calibration chart HTML export.
+
+Reference: deeplearning4j-core/evaluation/EvaluationTools.java.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.calibration import EvaluationCalibration
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.eval.tools import (
+    calibration_chart_to_html,
+    export_roc_charts_to_html_file,
+    roc_chart_to_html,
+)
+
+
+def _binary_data(n=200, seed=0):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 2, n)
+    scores = np.clip(labels * 0.5 + rng.rand(n) * 0.6, 0, 1)
+    return labels, scores
+
+
+class TestRocChartToHtml:
+    def test_single_roc_page(self):
+        labels, scores = _binary_data()
+        roc = ROC()
+        roc.eval(labels, scores)
+        html = roc_chart_to_html(roc)
+        assert html.startswith("<!DOCTYPE html>") or "<html" in html
+        assert "AUC (ROC)" in html
+        assert f"{roc.calculate_auc():.5f}" in html
+        assert "Precision" in html and "svg" in html
+
+    def test_multiclass_sections(self):
+        rng = np.random.RandomState(1)
+        n, c = 150, 3
+        y = rng.randint(0, c, n)
+        labels = np.eye(c)[y]
+        logits = rng.rand(n, c) + labels * 1.5
+        probs = logits / logits.sum(axis=1, keepdims=True)
+        roc = ROCMultiClass()
+        roc.eval(labels, probs)
+        html = roc_chart_to_html(roc, class_names=["ant", "bee", "cow"])
+        for name in ("ant", "bee", "cow"):
+            assert f"Class: {name}" in html
+
+    def test_rocbinary_sections(self):
+        rng = np.random.RandomState(2)
+        labels = rng.randint(0, 2, (100, 2))
+        scores = np.clip(labels * 0.4 + rng.rand(100, 2) * 0.7, 0, 1)
+        roc = ROCBinary()
+        roc.eval(labels, scores)
+        html = roc_chart_to_html(roc)
+        assert "Class: 0" in html and "Class: 1" in html
+
+    def test_export_to_file(self, tmp_path):
+        labels, scores = _binary_data()
+        roc = ROC()
+        roc.eval(labels, scores)
+        path = str(tmp_path / "roc.html")
+        export_roc_charts_to_html_file(roc, path)
+        with open(path) as fh:
+            assert "AUC" in fh.read()
+
+    def test_type_error(self):
+        with pytest.raises(TypeError):
+            roc_chart_to_html(object())
+
+
+class TestCalibrationChart:
+    def test_calibration_page(self):
+        rng = np.random.RandomState(3)
+        n = 300
+        y = rng.randint(0, 2, n)
+        labels = np.eye(2)[y]
+        p1 = np.clip(0.3 + 0.4 * y + 0.3 * rng.rand(n), 0, 1)
+        probs = np.stack([1 - p1, p1], axis=1)
+        cal = EvaluationCalibration()
+        cal.eval(labels, probs)
+        html = calibration_chart_to_html(cal, class_idx=1)
+        assert "Reliability Diagram" in html
+        assert "svg" in html

@@ -1,0 +1,77 @@
+"""ModelGuesser: load models/configs of unknown provenance.
+
+Reference: deeplearning4j-core/util/ModelGuesser.java (loadModelGuess,
+loadConfigGuess, loadNormalizer).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.util import model_serializer as ms
+from deeplearning4j_tpu.util.model_guesser import (
+    ModelGuesserException,
+    load_config_guess,
+    load_model_guess,
+    load_normalizer,
+)
+
+
+def small_net():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+            .layer(DenseLayer(n_in=3, n_out=4, activation="tanh"))
+            .layer(OutputLayer(n_in=4, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestModelGuesser:
+    def test_guess_own_mln_zip(self, tmp_path):
+        net = small_net()
+        path = str(tmp_path / "m.zip")
+        ms.write_model(net, path)
+        loaded = load_model_guess(path)
+        assert isinstance(loaded, MultiLayerNetwork)
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(loaded.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_guess_config_json(self, tmp_path):
+        net = small_net()
+        p = tmp_path / "conf.json"
+        p.write_text(net.conf.to_json())
+        conf = load_config_guess(str(p))
+        assert len(conf.layers) == 2
+
+    def test_guess_config_yaml(self, tmp_path):
+        net = small_net()
+        p = tmp_path / "conf.yaml"
+        p.write_text(net.conf.to_yaml())
+        conf = load_config_guess(str(p))
+        assert len(conf.layers) == 2
+
+    def test_guess_garbage_raises(self, tmp_path):
+        p = tmp_path / "junk.bin"
+        p.write_bytes(b"\x00\x01\x02 not a model")
+        with pytest.raises(ModelGuesserException):
+            load_model_guess(str(p))
+        with pytest.raises(ModelGuesserException):
+            load_config_guess(str(p))
+
+    def test_load_normalizer(self, tmp_path):
+        from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize
+        net = small_net()
+        path = str(tmp_path / "m.zip")
+        ms.write_model(net, path)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        feats = np.random.RandomState(0).randn(10, 3).astype(np.float32)
+        norm = NormalizerStandardize()
+        norm.fit(DataSet(feats, np.zeros((10, 2), np.float32)))
+        ms.add_normalizer_to_model(path, norm)
+        loaded = load_normalizer(path)
+        assert isinstance(loaded, NormalizerStandardize)
