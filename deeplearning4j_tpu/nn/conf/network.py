@@ -253,6 +253,16 @@ class ListBuilder:
         self._backprop_type: str = "standard"
         self._tbptt_fwd: int = 20
         self._tbptt_bwd: int = 20
+        self._input_pre_processors: dict = {}
+
+    def input_pre_processor(self, index: int, spec: str) -> "ListBuilder":
+        """Explicit preprocessor before layer ``index`` (DL4J
+        ``ListBuilder.inputPreProcessor``), overriding automatic InputType
+        inference. ``spec`` is a ``nn/conf/preprocessors.py`` spec string
+        (e.g. ``"cnn_to_ff"``, ``"ff_to_cnn:28,28,1"``,
+        ``"zero_mean|unit_variance"``)."""
+        self._input_pre_processors[int(index)] = spec
+        return self
 
     def layer(self, layer: Layer, index: Optional[int] = None) -> "ListBuilder":
         if index is not None and index != len(self._layers):
@@ -282,6 +292,7 @@ class ListBuilder:
             backprop_type=self._backprop_type,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_bwd_length=self._tbptt_bwd,
+            input_pre_processors=dict(self._input_pre_processors),
         )
         conf.finalize()
         return conf
@@ -310,6 +321,8 @@ class MultiLayerConfiguration:
     backprop_type: str = "standard"
     tbptt_fwd_length: int = 20
     tbptt_bwd_length: int = 20
+    # explicit per-index preprocessor specs (ListBuilder.inputPreProcessor)
+    input_pre_processors: dict = dataclasses.field(default_factory=dict)
     # computed in finalize():
     preprocessors: dict = dataclasses.field(default_factory=dict)  # idx -> fn
     layer_input_types: List[InputType] = dataclasses.field(default_factory=list)
@@ -327,14 +340,22 @@ class MultiLayerConfiguration:
             raise ValueError("Configuration has no layers")
         for l in self.layers:
             l.apply_global_defaults(self.global_conf)  # type: ignore[arg-type]
+        from deeplearning4j_tpu.nn.conf import preprocessors as pp
         it = self.input_type
         self.layer_input_types = []
         for i, l in enumerate(self.layers):
-            if it is not None:
+            if i in self.input_pre_processors:
+                # explicit spec overrides automatic inference
+                spec = self.input_pre_processors[i]
+                self.preprocessors[i] = (lambda x, _s=spec: pp.apply(_s, x))
+                if it is not None:
+                    it = pp.output_type(spec, it)
+            elif it is not None:
                 pre = l.input_preprocessor(it)
                 if pre is not None:
                     fn, it = pre
                     self.preprocessors[i] = fn
+            if it is not None:
                 l.set_n_in(it)
                 self.layer_input_types.append(it)
                 it = l.output_type(it)
@@ -389,6 +410,8 @@ class MultiLayerConfiguration:
             "backprop_type": self.backprop_type,
             "tbptt_fwd_length": self.tbptt_fwd_length,
             "tbptt_bwd_length": self.tbptt_bwd_length,
+            "input_pre_processors": {str(k): v for k, v
+                                     in self.input_pre_processors.items()},
         }
 
     def to_json(self, **kw) -> str:
@@ -403,6 +426,8 @@ class MultiLayerConfiguration:
             backprop_type=d.get("backprop_type", "standard"),
             tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
             tbptt_bwd_length=d.get("tbptt_bwd_length", 20),
+            input_pre_processors={int(k): v for k, v in
+                                  d.get("input_pre_processors", {}).items()},
         )
         conf.finalize()
         return conf

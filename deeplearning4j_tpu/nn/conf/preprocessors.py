@@ -2,19 +2,29 @@
 
 Reference: ``nn/conf/preprocessor/`` — CnnToFeedForwardPreProcessor,
 FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor,
-FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor —
-plus the Keras-import TensorFlowCnnToFeedForwardPreProcessor.
+FeedForwardToRnnPreProcessor, CnnToRnnPreProcessor, RnnToCnnPreProcessor,
+ZeroMeanPrePreProcessor, UnitVarianceProcessor,
+ZeroMeanAndUnitVariancePreProcessor, BinomialSamplingPreProcessor,
+ComposableInputPreProcessor — plus the Keras-import
+TensorFlowCnnToFeedForwardPreProcessor.
 
 Each preprocessor is addressed by a spec string so graph configs stay
-JSON-serializable: ``"cnn_to_ff"`` or parameterized ``"ff_to_cnn:28,28,1"``.
-Data layout here is NHWC / [N,T,C] (channels-last), so most conversions are
-pure reshapes XLA folds away.
+JSON-serializable: ``"cnn_to_ff"``, parameterized ``"ff_to_cnn:28,28,1"``,
+or composed with ``|`` (``"zero_mean|unit_variance"`` =
+ComposableInputPreProcessor). Data layout here is NHWC / [N,T,C]
+(channels-last), so most conversions are pure reshapes XLA folds away.
+Backward shape mapping (the reference's ``backprop`` half) comes free
+from autodiff. Explicit placement between layers:
+``ListBuilder.input_pre_processor(idx, spec)``, overriding the automatic
+InputType inference like ``NeuralNetConfiguration.ListBuilder
+.inputPreProcessor`` does.
 """
 
 from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.nn.conf.inputs import InputType
@@ -28,9 +38,35 @@ def _parse(spec: str) -> Tuple[str, Tuple[int, ...]]:
 
 
 def apply(spec: str, x):
+    if "|" in spec:  # ComposableInputPreProcessor
+        for part in spec.split("|"):
+            x = apply(part, x)
+        return x
     name, args = _parse(spec)
     if name == "identity":
         return x
+    # zero_mean/unit_variance/standardize use PER-FEATURE statistics over
+    # the batch axis (column means/stds), matching the reference's
+    # subiRowVector(mean(0)) / diviRowVector(std(0)) semantics
+    if name == "zero_mean":          # ZeroMeanPrePreProcessor
+        return x - jnp.mean(x, axis=0, keepdims=True)
+    if name == "unit_variance":      # UnitVarianceProcessor
+        std = jnp.std(x, axis=0, keepdims=True)
+        return x / jnp.where(std == 0, 1.0, std)
+    if name == "standardize":        # ZeroMeanAndUnitVariancePreProcessor
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        std = jnp.std(x, axis=0, keepdims=True)
+        return (x - mean) / jnp.where(std == 0, 1.0, std)
+    if name == "binomial_sampling":  # BinomialSamplingPreProcessor
+        # stateless draw, deterministic per seed — one fixed mask per
+        # traced program (the reference's ND4J RNG is stateful; under jit
+        # the key must be data-independent). Straight-through gradient:
+        # the reference's backprop passes epsilons through unchanged, and
+        # a raw bernoulli would zero every upstream gradient.
+        seed = args[0] if args else 0
+        key = jax.random.PRNGKey(seed)
+        sample = jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+        return x + jax.lax.stop_gradient(sample - x)
     if name == "cnn_to_ff":          # [N,H,W,C] → [N, H*W*C]
         return x.reshape(x.shape[0], -1)
     if name == "ff_to_cnn":          # [N, H*W*C] → [N,H,W,C]
@@ -53,8 +89,13 @@ def apply(spec: str, x):
 
 
 def output_type(spec: str, it: InputType) -> InputType:
+    if "|" in spec:
+        for part in spec.split("|"):
+            it = output_type(part, it)
+        return it
     name, args = _parse(spec)
-    if name == "identity":
+    if name in ("identity", "zero_mean", "unit_variance", "standardize",
+                "binomial_sampling"):
         return it
     if name == "cnn_to_ff":
         return InputType.feed_forward(it.height * it.width * it.channels)

@@ -1,0 +1,124 @@
+"""Explicit InputPreProcessor family: utility specs, composition,
+ListBuilder.input_pre_processor override + serde.
+
+Reference: nn/conf/preprocessor/*.java (ZeroMeanPrePreProcessor,
+UnitVarianceProcessor, ZeroMeanAndUnitVariancePreProcessor,
+BinomialSamplingPreProcessor, ComposableInputPreProcessor,
+NeuralNetConfiguration.ListBuilder.inputPreProcessor).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import preprocessors as pp
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class TestUtilitySpecs:
+    # zero_mean/unit_variance/standardize use per-FEATURE statistics over
+    # the batch axis (DL4J subiRowVector(mean(0)) semantics)
+    def test_zero_mean(self):
+        x = jnp.asarray([[0.0, 2.0], [2.0, 4.0]])
+        out = np.asarray(pp.apply("zero_mean", x))
+        np.testing.assert_allclose(out, [[-1, -1], [1, 1]], atol=1e-7)
+
+    def test_unit_variance_and_zero_guard(self):
+        x = jnp.asarray([[1.0, 5.0], [3.0, 5.0]])
+        out = np.asarray(pp.apply("unit_variance", x))
+        assert abs(out[:, 0].std() - 1.0) < 1e-6
+        np.testing.assert_allclose(out[:, 1], [5.0, 5.0])  # std=0 column unchanged
+
+    def test_standardize(self):
+        x = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0], [4.0, 40.0]])
+        out = np.asarray(pp.apply("standardize", x))
+        np.testing.assert_allclose(out.mean(axis=0), [0, 0], atol=1e-6)
+        np.testing.assert_allclose(out.std(axis=0), [1, 1], atol=1e-6)
+
+    def test_binomial_sampling_deterministic(self):
+        x = jnp.full((4, 100), 0.5)
+        a = np.asarray(pp.apply("binomial_sampling:7", x))
+        b = np.asarray(pp.apply("binomial_sampling:7", x))
+        np.testing.assert_array_equal(a, b)  # same seed -> same draw
+        assert set(np.unique(a)) <= {0.0, 1.0}
+        assert 0.2 < a.mean() < 0.8
+        # p=0 and p=1 are certain
+        zeros = np.asarray(pp.apply("binomial_sampling", jnp.zeros((3, 3))))
+        ones = np.asarray(pp.apply("binomial_sampling", jnp.ones((3, 3))))
+        assert zeros.sum() == 0 and ones.sum() == 9
+
+    def test_composition_spec(self):
+        x = jnp.asarray([[2.0, 40.0], [4.0, 80.0], [6.0, 120.0]])
+        out = np.asarray(pp.apply("zero_mean|unit_variance", x))
+        np.testing.assert_allclose(out.mean(axis=0), [0, 0], atol=1e-6)
+        np.testing.assert_allclose(out.std(axis=0), [1, 1], atol=1e-6)
+        # output_type passes through identity-shaped specs
+        it = InputType.feed_forward(2)
+        assert pp.output_type("zero_mean|unit_variance", it) == it
+
+    def test_composed_reshape_chain(self):
+        x = jnp.ones((2, 4, 4, 3))
+        out = pp.apply("cnn_to_ff|standardize", x)
+        assert out.shape == (2, 48)
+        it = pp.output_type("cnn_to_ff|standardize",
+                            InputType.convolutional(4, 4, 3))
+        assert it.kind == "ff" and it.size == 48
+
+    def test_unknown_spec_raises(self):
+        with pytest.raises(ValueError):
+            pp.apply("warp_drive", jnp.ones((1, 2)))
+        with pytest.raises(ValueError):
+            pp.output_type("warp_drive", InputType.feed_forward(2))
+
+
+class TestExplicitOverride:
+    def _conf(self):
+        return (NeuralNetConfiguration.builder().seed(3).updater("sgd").list()
+                .layer(DenseLayer(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .input_pre_processor(0, "cnn_to_ff")
+                .set_input_type(InputType.convolutional(4, 4, 3))
+                .build())
+
+    def test_override_sets_n_in_and_runs(self):
+        conf = self._conf()
+        assert conf.layers[0].n_in == 48
+        net = MultiLayerNetwork(conf)
+        net.init()
+        out = net.output(np.ones((2, 4, 4, 3), np.float32))
+        assert np.asarray(out).shape == (2, 2)
+
+    def test_serde_round_trip_preserves_override(self):
+        conf = self._conf()
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        assert conf2.input_pre_processors == {0: "cnn_to_ff"}
+        assert conf2.layers[0].n_in == 48
+        net = MultiLayerNetwork(conf2)
+        net.init()
+        assert np.asarray(net.output(np.ones((1, 4, 4, 3), np.float32))).shape == (1, 2)
+
+    def test_normalizing_preprocessor_changes_activations(self):
+        base = (NeuralNetConfiguration.builder().seed(3).updater("sgd").list()
+                .layer(DenseLayer(n_in=3, n_out=4, activation="identity"))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        with_pre = (NeuralNetConfiguration.builder().seed(3).updater("sgd").list()
+                    .layer(DenseLayer(n_in=3, n_out=4, activation="identity"))
+                    .layer(OutputLayer(n_in=4, n_out=2))
+                    .input_pre_processor(0, "standardize")
+                    .build())
+        x = np.asarray([[10.0, 20.0, 30.0], [40.0, 60.0, 80.0]], np.float32)
+        n1 = MultiLayerNetwork(base); n1.init()
+        n2 = MultiLayerNetwork(with_pre); n2.init()
+        o1, o2 = np.asarray(n1.output(x)), np.asarray(n2.output(x))
+        assert not np.allclose(o1, o2)
+        # column-standardized input fed to the base net == preprocessed net
+        xs = (x - x.mean(axis=0)) / x.std(axis=0)
+        np.testing.assert_allclose(np.asarray(n1.output(xs)), o2, rtol=1e-5)
