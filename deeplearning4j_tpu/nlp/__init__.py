@@ -43,6 +43,21 @@ from deeplearning4j_tpu.nlp.cnn_sentence import (
     LabelAwareConverter,
     LabeledSentenceProvider,
 )
+from deeplearning4j_tpu.nlp.stemming import (
+    CustomStemmingPreprocessor,
+    EmbeddedStemmingPreprocessor,
+    PorterStemmer,
+    PosTokenizerFactory,
+    StemmingPreprocessor,
+)
+from deeplearning4j_tpu.nlp.sentiment import SWN3
+from deeplearning4j_tpu.nlp.trees import (
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    Tree,
+    TreeVectorizer,
+)
 from deeplearning4j_tpu.nlp.text_utils import (
     InMemoryInvertedIndex,
     InputHomogenization,
@@ -65,6 +80,10 @@ __all__ = [
     "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
     "FileLabeledSentenceProvider", "LabelAwareConverter",
     "LabeledSentenceProvider",
+    "BinarizeTreeTransformer", "CollapseUnaries", "CustomStemmingPreprocessor",
+    "EmbeddedStemmingPreprocessor", "HeadWordFinder", "PorterStemmer",
+    "PosTokenizerFactory", "SWN3", "StemmingPreprocessor", "Tree",
+    "TreeVectorizer",
     "BasicLabelAwareIterator", "BasicLineIterator",
     "CollectionSentenceIterator", "CommonPreprocessor", "DefaultTokenizer",
     "DefaultTokenizerFactory", "DictionaryTokenizerFactory",

@@ -1,0 +1,120 @@
+"""SentiWordNet sentiment scoring.
+
+Parity with ``text/corpora/sentiwordnet/SWN3.java``: loads the standard
+SentiWordNet 3.0 tab-separated format (POS, id, PosScore, NegScore,
+SynsetTerms, ...), aggregates per ``word#pos`` with the 1/rank-weighted
+average the reference computes, and scores token lists with the same
+negation-flip and seven-class polarity buckets. The data file is not
+vendored (it carries its own license) — point ``SWN3`` at a local copy;
+a tiny built-in lexicon keeps the class usable for tests/demos.
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["SWN3"]
+
+# minimal fallback lexicon (word#pos -> polarity in [-1, 1]) so the API
+# works without the 20 MB SentiWordNet download
+_BUILTIN = {
+    "good#a": 0.625, "great#a": 0.75, "excellent#a": 0.875,
+    "happy#a": 0.625, "love#v": 0.625, "love#n": 0.625, "like#v": 0.375,
+    "wonderful#a": 0.75, "best#a": 0.875, "nice#a": 0.5,
+    "bad#a": -0.625, "terrible#a": -0.75, "awful#a": -0.75,
+    "horrible#a": -0.75, "hate#v": -0.75, "hate#n": -0.75,
+    "worst#a": -0.875, "sad#a": -0.625, "poor#a": -0.5, "wrong#a": -0.5,
+}
+
+
+class SWN3:
+    """Word/sentence polarity from SentiWordNet (``SWN3.java``)."""
+
+    NEGATION_WORDS = {"could", "would", "should", "not", "isn't", "aren't",
+                      "wasn't", "weren't", "haven't", "doesn't", "didn't",
+                      "don't"}
+
+    def __init__(self, senti_word_net_path: Optional[str] = None):
+        if senti_word_net_path is None:
+            self._dict: Dict[str, float] = dict(_BUILTIN)
+        else:
+            self._dict = self._load(senti_word_net_path)
+
+    @staticmethod
+    def _load(path: str) -> Dict[str, float]:
+        temp: Dict[str, Dict[int, float]] = {}
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rt", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.rstrip("\n")
+                if not line or line.startswith("#"):
+                    continue
+                data = line.split("\t")
+                if len(data) < 5 or not data[2] or not data[3]:
+                    continue
+                try:
+                    score = float(data[2]) - float(data[3])
+                except ValueError:
+                    continue
+                for w in data[4].split(" "):
+                    if not w or "#" not in w:
+                        continue
+                    term, rank_s = w.rsplit("#", 1)
+                    try:
+                        rank = int(rank_s)
+                    except ValueError:
+                        continue
+                    temp.setdefault(f"{term}#{data[0]}", {})[rank] = score
+        out: Dict[str, float] = {}
+        for key, ranks in temp.items():
+            # 1/rank-weighted mean over synset senses (SWN3.java tail)
+            total = sum(s / r for r, s in ranks.items())
+            norm = sum(1.0 / r for r in ranks)
+            out[key] = total / norm if norm else 0.0
+        return out
+
+    # -- scoring -------------------------------------------------------------
+    def extract(self, word: str) -> float:
+        """Summed polarity of a word over the n/a/r/v POS entries
+        (``extract``)."""
+        return sum(self._dict.get(f"{word}#{pos}", 0.0)
+                   for pos in ("n", "a", "r", "v"))
+
+    def score_tokens(self, tokens: Sequence[str]) -> float:
+        """Sentence score with the reference's negation flip
+        (``scoreTokens``): any negation word present inverts the sum."""
+        total = sum(self.extract(t.lower()) for t in tokens)
+        if any(t.lower() in self.NEGATION_WORDS for t in tokens):
+            total *= -1.0
+        return total
+
+    def score(self, text: str, tokenizer_factory=None) -> float:
+        if tokenizer_factory is not None:
+            tokens = tokenizer_factory.create(text).get_tokens()
+        else:
+            tokens = text.split()
+        return self.score_tokens(tokens)
+
+    def classify(self, text: str, tokenizer_factory=None) -> str:
+        return self.class_for_score(self.score(text, tokenizer_factory))
+
+    @staticmethod
+    def class_for_score(score: float) -> str:
+        """Seven-bucket polarity label (``classForScore``). The
+        reference's conditionals overlap ("> 0.25 && <= 0.5" vs
+        "> 0 && >= 0.25"); rationalized here to contiguous monotone
+        buckets with the same thresholds."""
+        if score >= 0.75:
+            return "strong_positive"
+        if score > 0.25:
+            return "positive"
+        if score > 0:
+            return "weak_positive"
+        if score == 0:
+            return "neutral"
+        if score >= -0.25:
+            return "weak_negative"
+        if score > -0.75:
+            return "negative"
+        return "strong_negative"

@@ -37,6 +37,15 @@ def _parse(spec: str) -> Tuple[str, Tuple[int, ...]]:
     return spec, ()
 
 
+def _safe_std(x):
+    """Per-column std with 0-variance columns mapped to 1 — masked BEFORE
+    the sqrt so the backward pass stays finite (the naive
+    ``where(std==0, 1, std)`` still differentiates sqrt at 0 -> NaN)."""
+    var = jnp.var(x, axis=0, keepdims=True)
+    zero = var == 0
+    return jnp.where(zero, 1.0, jnp.sqrt(jnp.where(zero, 1.0, var)))
+
+
 def apply(spec: str, x):
     if "|" in spec:  # ComposableInputPreProcessor
         for part in spec.split("|"):
@@ -51,12 +60,10 @@ def apply(spec: str, x):
     if name == "zero_mean":          # ZeroMeanPrePreProcessor
         return x - jnp.mean(x, axis=0, keepdims=True)
     if name == "unit_variance":      # UnitVarianceProcessor
-        std = jnp.std(x, axis=0, keepdims=True)
-        return x / jnp.where(std == 0, 1.0, std)
+        return x / _safe_std(x)
     if name == "standardize":        # ZeroMeanAndUnitVariancePreProcessor
         mean = jnp.mean(x, axis=0, keepdims=True)
-        std = jnp.std(x, axis=0, keepdims=True)
-        return (x - mean) / jnp.where(std == 0, 1.0, std)
+        return (x - mean) / _safe_std(x)
     if name == "binomial_sampling":  # BinomialSamplingPreProcessor
         # stateless draw, deterministic per seed — one fixed mask per
         # traced program (the reference's ND4J RNG is stateful; under jit

@@ -1,0 +1,210 @@
+"""UIMA-pack depth: trees, sentiment, stemming, POS filtering.
+
+Reference: deeplearning4j-nlp-uima — text/corpora/treeparser/*.java,
+text/corpora/sentiwordnet/SWN3.java,
+tokenization/tokenizer/preprocessor/StemmingPreprocessor.java,
+tokenization/tokenizer/PosUimaTokenizer.java.
+"""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.trees import (
+    BinarizeTreeTransformer,
+    CollapseUnaries,
+    HeadWordFinder,
+    Tree,
+    TreeVectorizer,
+)
+from deeplearning4j_tpu.nlp.sentiment import SWN3
+from deeplearning4j_tpu.nlp.stemming import (
+    CustomStemmingPreprocessor,
+    EmbeddedStemmingPreprocessor,
+    PorterStemmer,
+    PosTokenizerFactory,
+    StemmingPreprocessor,
+    heuristic_pos_tagger,
+)
+
+PTB = "(S (NP (DT the) (NN cat)) (VP (VBZ sits) (PP (IN on) (NP (DT the) (NN mat)))))"
+
+
+class TestTree:
+    def test_penn_round_trip(self):
+        t = Tree.from_penn(PTB)
+        assert t.label == "S"
+        assert t.yield_words() == ["the", "cat", "sits", "on", "the", "mat"]
+        assert t.tags() == ["DT", "NN", "VBZ", "IN", "DT", "NN"]
+        assert Tree.from_penn(t.to_penn()).to_penn() == t.to_penn()
+
+    def test_structure_predicates(self):
+        t = Tree.from_penn(PTB)
+        np = t.children[0]
+        assert not np.is_leaf() and not np.is_pre_terminal()
+        dt = np.children[0]
+        assert dt.is_pre_terminal()
+        assert dt.children[0].is_leaf()
+        assert t.depth() == 5  # S > VP > PP > NP > NN > leaf
+        assert t.first_child() is np
+
+    def test_ptb_empty_wrapper(self):
+        # real .mrg files wrap every sentence in an empty-label node
+        t = Tree.from_penn("( (S (NP (NN dog)) (VP (VBZ barks))) )")
+        assert t.label == "S"
+        assert t.yield_words() == ["dog", "barks"]
+
+    def test_collapse_does_not_mutate_source(self):
+        t1 = Tree.from_penn("(S (X (NP (DT the) (NN cat))))")
+        np_node = t1.children[0].children[0]
+        CollapseUnaries().transform(t1)
+        # source tree's structure and parent pointers untouched
+        assert np_node.parent is t1.children[0]
+        assert t1.to_penn() == "(S (X (NP (DT the) (NN cat))))"
+
+    def test_unbalanced_raises(self):
+        with pytest.raises(ValueError):
+            Tree.from_penn("(S (NP")
+        with pytest.raises(ValueError):
+            Tree.from_penn("(S a)) extra")
+
+
+class TestTransformers:
+    def test_binarize_right(self):
+        t = Tree.from_penn("(X (A a) (B b) (C c) (D d))")
+        b = BinarizeTreeTransformer().transform(t)
+        # every internal node now has <= 2 children
+        def check(node):
+            assert len(node.children) <= 2
+            for c in node.children:
+                check(c)
+        check(b)
+        assert b.yield_words() == ["a", "b", "c", "d"]  # yield preserved
+        assert b.children[0].label == "A"
+        assert b.children[1].label.startswith("X-(")  # intermediate label
+
+    def test_binarize_left(self):
+        t = Tree.from_penn("(X (A a) (B b) (C c))")
+        b = BinarizeTreeTransformer(factor="left").transform(t)
+        assert b.yield_words() == ["a", "b", "c"]
+        assert b.children[1].label == "C"
+
+    def test_collapse_unaries(self):
+        t = Tree.from_penn("(S (X (Y (NP (DT the) (NN cat)))))")
+        c = CollapseUnaries().transform(t)
+        # chain S->X->Y->NP collapses; top label kept, children are NP's
+        assert c.label == "S"
+        assert [ch.label for ch in c.children] == ["DT", "NN"]
+        assert c.yield_words() == ["the", "cat"]
+
+    def test_vectorizer_with_labels(self):
+        tv = TreeVectorizer()
+        trees = tv.get_trees_with_labels([PTB], "pos", ["neg", "pos"])
+        assert len(trees) == 1
+
+        def all_labeled(node):
+            assert node.gold_label == 1
+            for c in node.children:
+                all_labeled(c)
+        all_labeled(trees[0])
+        with pytest.raises(ValueError):
+            tv.get_trees_with_labels([PTB], "missing", ["neg", "pos"])
+
+
+class TestHeadWordFinder:
+    def test_head_rules(self):
+        t = Tree.from_penn(PTB)
+        hf = HeadWordFinder()
+        # S -> VP (head1), VP -> VBZ (head1) -> 'sits'
+        head = hf.find_head(t)
+        assert head.value == "sits"
+        np = t.children[0]
+        assert hf.find_head(np).value == "cat"  # NP NN rule
+
+
+class TestSWN3:
+    def test_builtin_lexicon_scoring(self):
+        swn = SWN3()
+        assert swn.extract("good") > 0
+        assert swn.extract("terrible") < 0
+        assert swn.score("a good movie") > 0
+        # negation flips the sentence score — case-insensitively
+        assert swn.score("not a good movie") < 0
+        assert swn.score("Not a good movie") < 0
+        assert swn.class_for_score(0.8) == "strong_positive"
+        assert swn.class_for_score(-0.8) == "strong_negative"
+        assert swn.class_for_score(0.0) == "neutral"
+
+    def test_load_swn_format(self, tmp_path):
+        p = tmp_path / "swn.txt"
+        p.write_text(
+            "# comment line\n"
+            "a\t001\t0.75\t0\tgood#1 unspoiled#2\tgloss text\n"
+            "a\t002\t0\t0.625\tbad#1\tgloss\n"
+            "v\t003\t0.5\t0\tgood#1\tgloss\n")
+        swn = SWN3(str(p))
+        assert swn.extract("good") == pytest.approx(0.75 + 0.5)
+        assert swn.extract("unspoiled") == pytest.approx(0.75)  # rank-weighted single sense
+        assert swn.extract("bad") == pytest.approx(-0.625)
+        assert swn.classify("bad bad bad") in ("strong_negative", "negative")
+
+
+class TestStemming:
+    def test_porter_classic_cases(self):
+        st = PorterStemmer()
+        for word, stem in [
+            ("caresses", "caress"), ("ponies", "poni"), ("cats", "cat"),
+            ("feed", "feed"), ("agreed", "agre"), ("plastered", "plaster"),
+            ("motoring", "motor"), ("sing", "sing"), ("conflated", "conflat"),
+            ("happy", "happi"), ("relational", "relat"),
+            ("conditional", "condit"), ("rational", "ration"),
+            ("digitizer", "digit"), ("operator", "oper"),
+            ("feudalism", "feudal"), ("decisiveness", "decis"),
+            ("hopefulness", "hope"), ("formaliti", "formal"),
+            ("triplicate", "triplic"), ("formative", "form"),
+            ("formalize", "formal"), ("electrical", "electr"),
+            ("hopeful", "hope"), ("goodness", "good"),
+            ("revival", "reviv"), ("allowance", "allow"),
+            ("inference", "infer"), ("airliner", "airlin"),
+            ("adoption", "adopt"), ("activate", "activ"),
+            ("probate", "probat"), ("controll", "control"),
+            ("roll", "roll"),
+        ]:
+            assert st.stem(word) == stem, word
+
+    def test_stemming_preprocessor_cleans_and_stems(self):
+        pre = StemmingPreprocessor()
+        # CommonPreprocessor strips punctuation/lowercases, then stems
+        assert pre.pre_process("Motoring,") == "motor"
+
+    def test_embedded_and_custom(self):
+        class Upper:
+            def pre_process(self, t):
+                return t.lower()
+        emb = EmbeddedStemmingPreprocessor(Upper())
+        assert emb.pre_process("MOTORING") == "motor"
+
+        class FakeStemmer:
+            def stem(self, t):
+                return t[:3]
+        cus = CustomStemmingPreprocessor(FakeStemmer())
+        assert cus.pre_process("abcdef") == "abc"
+
+
+class TestPosTokenizer:
+    def test_heuristic_tagger(self):
+        tags = heuristic_pos_tagger(["the", "cat", "is", "running", "quickly"])
+        assert tags == ["DT", "NN", "VBZ", "VBG", "RB"]
+
+    def test_pos_filter_none_substitution(self):
+        tf = PosTokenizerFactory(allowed_pos_tags={"NN", "NNS"})
+        tokens = tf.create("the cat is running").get_tokens()
+        assert tokens == ["NONE", "cat", "NONE", "NONE"]
+
+    def test_pos_filter_strip(self):
+        tf = PosTokenizerFactory(allowed_pos_tags={"NN"}, strip_nones=True)
+        assert tf.create("the cat sat <TAG>").get_tokens() == ["cat", "sat"]
+
+    def test_custom_tagger(self):
+        tf = PosTokenizerFactory(allowed_pos_tags={"KEEP"},
+                                 tagger=lambda ts: ["KEEP" if t == "x" else "DROP"
+                                                    for t in ts])
+        assert tf.create("x y x").get_tokens() == ["x", "NONE", "x"]

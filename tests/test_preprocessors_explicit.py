@@ -71,6 +71,13 @@ class TestUtilitySpecs:
                             InputType.convolutional(4, 4, 3))
         assert it.kind == "ff" and it.size == 48
 
+    def test_standardize_gradient_finite_on_constant_column(self):
+        import jax
+        x = jnp.asarray([[1.0, 5.0], [2.0, 5.0], [3.0, 5.0]])
+        for spec in ("standardize", "unit_variance"):
+            g = jax.grad(lambda v: pp.apply(spec, v).sum())(x)
+            assert bool(jnp.isfinite(g).all()), spec
+
     def test_unknown_spec_raises(self):
         with pytest.raises(ValueError):
             pp.apply("warp_drive", jnp.ones((1, 2)))
