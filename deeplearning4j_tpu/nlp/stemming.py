@@ -271,8 +271,9 @@ class PosTokenizerFactory(TokenizerFactory):
                 if not self.strip_nones:
                     out.append("NONE")
             else:
-                out.append(tok)
-        t = Tokenizer(out)
-        if self._pre is not None:
-            t.set_token_pre_processor(self._pre)
-        return t
+                # preprocess only VALID tokens (PosUimaTokenizer does the
+                # same) — running the preprocessor over the sentinel would
+                # mangle the literal "NONE" downstream consumers filter on
+                out.append(self._pre.pre_process(tok)
+                           if self._pre is not None else tok)
+        return Tokenizer(out)

@@ -161,8 +161,10 @@ class BinarizeTreeTransformer:
 
     ``factor="right"`` (default here and in practice) splits
     ``A -> c1 c2 c3 c4`` into a right-branching chain whose intermediate
-    nodes are labeled ``A-(c2-c3-c4`` style, truncated to
-    ``horizontal_markov`` sibling labels, as the reference does.
+    nodes are labeled ``A@c2-c3-c4`` (the sibling labels truncated to
+    ``horizontal_markov``). The reference embeds a bare ``(`` in these
+    labels; a paren-free separator is used here so binarized trees stay
+    round-trippable through ``to_penn``/``from_penn``.
     """
 
     def __init__(self, factor: str = "right", horizontal_markov: int = 999):
@@ -181,12 +183,12 @@ class BinarizeTreeTransformer:
             if self.factor == "right":
                 rest = kids[1:]
                 labels = [k.label for k in rest[: self.horizontal_markov]]
-                inner = Tree(f"{tree.label}-({'-'.join(labels)}", rest)
+                inner = Tree(f"{tree.label}@{'-'.join(labels)}", rest)
                 node.connect([kids[0], inner])
             else:
                 rest = kids[:-1]
                 labels = [k.label for k in rest[-self.horizontal_markov:]][::-1]
-                inner = Tree(f"{tree.label}-({'-'.join(labels)}", rest)
+                inner = Tree(f"{tree.label}@{'-'.join(labels)}", rest)
                 node.connect([inner, kids[-1]])
             node = inner
         return out

@@ -827,6 +827,62 @@ class ComputationGraph:
         """Vertex definition by name (``getVertex``)."""
         return self.conf.vertices[name]
 
+    def get_layer(self, name: str):
+        """Layer object of a layer vertex (``getLayer``)."""
+        vd = self.conf.vertices[name]
+        if not vd.is_layer:
+            raise KeyError(f"vertex {name!r} is not a layer vertex")
+        return vd.obj
+
+    def get_layers(self) -> list:
+        """All layer objects in topological order (``getLayers``)."""
+        return [vd.obj for vd in self.conf.vertices.values() if vd.is_layer]
+
+    def param_table(self) -> dict:
+        """All parameters keyed ``"<vertexName>_<param>"``
+        (``paramTable()``), e.g. ``"dense0_W"``."""
+        out = {}
+        for vname, p in (self.params or {}).items():
+            for pname, arr in p.items():
+                out[f"{vname}_{pname}"] = arr
+        return out
+
+    def get_param(self, key: str) -> Array:
+        """One parameter by ``"<vertexName>_<param>"`` key (``getParam``).
+        The vertex name is matched longest-first since names may contain
+        underscores."""
+        vname, pname = self._split_param_key(key)
+        return self.params[vname][pname]
+
+    def set_param(self, key: str, value) -> None:
+        """Replace one parameter (``setParam``); shape must match."""
+        vname, pname = self._split_param_key(key)
+        old = self.params[vname][pname]
+        arr = jnp.asarray(value, old.dtype)
+        if arr.shape != old.shape:
+            raise ValueError(
+                f"shape mismatch for {key}: {arr.shape} vs {old.shape}")
+        self.params[vname] = {**self.params[vname], pname: arr}
+
+    def _split_param_key(self, key: str):
+        for vname in sorted(self.params or {}, key=len, reverse=True):
+            prefix = f"{vname}_"
+            if key.startswith(prefix) and key[len(prefix):] in self.params[vname]:
+                return vname, key[len(prefix):]
+        raise KeyError(f"no parameter {key!r}")
+
+    def save(self, path: str, save_updater: bool = True) -> None:
+        """Write this graph as a checkpoint zip (``ComputationGraph.save``)."""
+        from deeplearning4j_tpu.util import model_serializer
+        model_serializer.write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "ComputationGraph":
+        """Restore from a checkpoint zip (``ComputationGraph.load``)."""
+        from deeplearning4j_tpu.util import model_serializer
+        return model_serializer.restore_computation_graph(
+            path, load_updater=load_updater)
+
     def layer_size(self, name: str) -> int:
         """Output size of a layer vertex (``layerSize``)."""
         vd = self.conf.vertices[name]

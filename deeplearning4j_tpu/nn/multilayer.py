@@ -493,6 +493,139 @@ class MultiLayerNetwork:
             acts.append(h)
         return acts
 
+    def feed_forward_to_layer(self, layer_num: int, x,
+                              train: bool = False) -> List[Array]:
+        """Activations through layer ``layer_num`` inclusive, stopping
+        there (``feedForwardToLayer:949``)."""
+        if not 0 <= layer_num < len(self.layers):
+            raise ValueError(f"layer_num {layer_num} out of range "
+                             f"[0, {len(self.layers)})")
+        dtype = self.conf.global_conf.jnp_dtype()
+        h = _as_jnp(x, dtype)
+        acts = [h]
+        for i in range(layer_num + 1):
+            if i in self.conf.preprocessors:
+                h = self.conf.preprocessors[i](h)
+            h, _ = self.layers[i].forward(self.params[i], h,
+                                          state=self.states[i],
+                                          train=train, rng=None)
+            acts.append(h)
+        return acts
+
+    # -- layer / parameter access (MultiLayerNetwork getters) ---------------
+    @property
+    def n_layers(self) -> int:
+        """``getnLayers()``."""
+        return len(self.layers)
+
+    def get_layer(self, idx) -> Layer:
+        """Layer by index or by name (``getLayer``)."""
+        if isinstance(idx, str):
+            for l in self.layers:
+                if l.name == idx:
+                    return l
+            raise KeyError(f"no layer named {idx!r}")
+        return self.layers[idx]
+
+    def get_layers(self) -> List[Layer]:
+        return list(self.layers)
+
+    def get_output_layer(self) -> Layer:
+        """``getOutputLayer()`` — the final layer."""
+        return self.layers[-1]
+
+    def param_table(self) -> Dict[str, Array]:
+        """All parameters keyed DL4J-style ``"<layerIdx>_<name>"``
+        (``paramTable()``), e.g. ``"0_W"``."""
+        out: Dict[str, Array] = {}
+        for i, p in enumerate(self.params or []):
+            for name, arr in p.items():
+                out[f"{i}_{name}"] = arr
+        return out
+
+    def get_param(self, key: str) -> Array:
+        """One parameter by ``"<layerIdx>_<name>"`` key (``getParam``)."""
+        idx, name = key.split("_", 1)
+        return self.params[int(idx)][name]
+
+    def set_param(self, key: str, value) -> None:
+        """Replace one parameter (``setParam``); shape must match."""
+        idx, name = key.split("_", 1)
+        i = int(idx)
+        old = self.params[i][name]
+        arr = jnp.asarray(value, old.dtype)
+        if arr.shape != old.shape:
+            raise ValueError(
+                f"shape mismatch for {key}: {arr.shape} vs {old.shape}")
+        self.params[i] = {**self.params[i], name: arr}
+
+    def num_labels(self) -> int:
+        """Output dimension of the final layer (``numLabels``)."""
+        out = getattr(self.layers[-1], "n_out", None)
+        if not out:
+            raise ValueError("output layer has no n_out")
+        return int(out)
+
+    # -- convenience classifier metrics -------------------------------------
+    def f1_score(self, features, labels) -> float:
+        """Macro F1 on a batch (``f1Score``)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation()
+        e.eval(np.asarray(labels), np.asarray(self.output(features)))
+        return float(e.f1())
+
+    def label_probabilities(self, x) -> np.ndarray:
+        """Per-class probabilities (``labelProbabilities``) — the output
+        activations for a softmax/sigmoid head."""
+        return np.asarray(self.output(x))
+
+    # -- rnn stored-state access --------------------------------------------
+    def rnn_get_previous_state(self, layer: int):
+        """Stored carry of a recurrent layer (``rnnGetPreviousState``),
+        or None before any ``rnn_time_step`` call."""
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries[layer]
+
+    def rnn_set_previous_state(self, layer: int, state,
+                               position: Optional[int] = None) -> None:
+        """Overwrite a recurrent layer's stored carry
+        (``rnnSetPreviousState``); requires a prior ``rnn_time_step`` so
+        the carry list exists.
+
+        ``position``: total timesteps already absorbed by ``state``.
+        Mandatory when any layer has a finite carry (KV cache) — the
+        host-side capacity guard tracks position separately from the
+        opaque carry, and a restored cache whose write offset disagrees
+        with the guard would let a jitted ``dynamic_update_slice``
+        silently clamp out-of-range writes."""
+        if self._rnn_carries is None:
+            raise ValueError(
+                "no stored rnn state to overwrite; call rnn_time_step "
+                "first to initialize the carries")
+        if position is not None:
+            self._rnn_pos = int(position)
+        elif any(isinstance(l, BaseRecurrentLayer)
+                 and l.carry_capacity() is not None for l in self.layers):
+            raise ValueError(
+                "rnn_set_previous_state needs position= when a layer has "
+                "a finite carry capacity (KV cache): the restored cache's "
+                "write offset must match the capacity guard")
+        self._rnn_carries[layer] = state
+
+    # -- save/load facades ----------------------------------------------------
+    def save(self, path: str, save_updater: bool = True) -> None:
+        """Write this model as a checkpoint zip (``MultiLayerNetwork.save``)."""
+        from deeplearning4j_tpu.util import model_serializer
+        model_serializer.write_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":
+        """Restore from a checkpoint zip (``MultiLayerNetwork.load``)."""
+        from deeplearning4j_tpu.util import model_serializer
+        return model_serializer.restore_multi_layer_network(
+            path, load_updater=load_updater)
+
     def predict(self, x) -> np.ndarray:
         out = self.output(x)
         return np.asarray(jnp.argmax(out, axis=-1))

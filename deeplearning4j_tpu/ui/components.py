@@ -193,6 +193,188 @@ class ChartHistogram(_Chart):
         return "".join(parts)
 
 
+class ChartHorizontalBar(_Chart):
+    """Horizontal bar chart (``ChartHorizontalBar.java``): one bar per
+    named category."""
+
+    component_type = "chart_horizontal_bar"
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(title, style)
+        self.bars: List[Tuple[str, float]] = []
+
+    def add_bar(self, name: str, value: float) -> "ChartHorizontalBar":
+        self.bars.append((name, float(value)))
+        return self
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "style": self.style.to_dict(),
+                "bars": [{"name": n, "value": v} for n, v in self.bars]}
+
+    def render(self) -> str:
+        parts = self._svg_open()
+        if self.bars:
+            s = self.style
+            m = s.margin
+            vmin = min(0.0, min(v for _, v in self.bars))
+            vmax = max(0.0, max(v for _, v in self.bars))
+            vr = max(vmax - vmin, 1e-12)
+            band = (s.height - 2 * m) / len(self.bars)
+            x_of = lambda v: m + (s.width - 2 * m) * (v - vmin) / vr
+            for i, (name, v) in enumerate(self.bars):
+                color = s.colors[i % len(s.colors)]
+                y0 = m + i * band
+                x0, x1 = sorted((x_of(0.0), x_of(v)))
+                parts.append(
+                    f'<rect x="{x0:.1f}" y="{y0:.1f}" width="{x1 - x0:.1f}" '
+                    f'height="{band * 0.8:.1f}" fill="{color}"/>')
+                parts.append(
+                    f'<text x="{m - 4}" y="{y0 + band * 0.5:.1f}" '
+                    f'font-size="10" text-anchor="end">{html.escape(name)}'
+                    f"</text>")
+                parts.append(
+                    f'<text x="{x1 + 4:.1f}" y="{y0 + band * 0.5:.1f}" '
+                    f'font-size="10">{v:.3g}</text>')
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+class ChartStackedArea(_Chart):
+    """Stacked area chart (``ChartStackedArea.java``): series share an
+    x-axis and stack additively."""
+
+    component_type = "chart_stacked_area"
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(title, style)
+        self.x: List[float] = []
+        self.series: List[Tuple[str, List[float]]] = []
+
+    def set_x_values(self, x: Sequence[float]) -> "ChartStackedArea":
+        self.x = [float(v) for v in x]
+        return self
+
+    def add_series(self, name: str, y: Sequence[float]) -> "ChartStackedArea":
+        if len(y) != len(self.x):
+            raise ValueError("series length must match x values")
+        self.series.append((name, [float(v) for v in y]))
+        return self
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "style": self.style.to_dict(), "x": self.x,
+                "series": [{"name": n, "y": y} for n, y in self.series]}
+
+    def render(self) -> str:
+        parts = self._svg_open()
+        if self.x and self.series:
+            totals = [sum(y[i] for _, y in self.series)
+                      for i in range(len(self.x))]
+            sx, sy = self._scales(min(self.x), max(self.x), 0.0, max(totals))
+            parts += self._axes(sx, sy, min(self.x), max(self.x), 0.0,
+                                max(totals))
+            base = [0.0] * len(self.x)
+            for i, (name, y) in enumerate(self.series):
+                color = self.style.colors[i % len(self.style.colors)]
+                top = [b + v for b, v in zip(base, y)]
+                fwd = [f"{sx(a):.1f},{sy(b):.1f}"
+                       for a, b in zip(self.x, top)]
+                back = [f"{sx(a):.1f},{sy(b):.1f}"
+                        for a, b in zip(reversed(self.x), reversed(base))]
+                parts.append(f'<polygon points="{" ".join(fwd + back)}" '
+                             f'fill="{color}" fill-opacity="0.7" '
+                             f'stroke="{color}"/>')
+                parts.append(
+                    f'<text x="{self.style.width - self.style.margin}" '
+                    f'y="{self.style.margin + 12 * i}" font-size="10" '
+                    f'text-anchor="end" fill="{color}">{html.escape(name)}'
+                    f"</text>")
+                base = top
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+class ChartTimeline(_Chart):
+    """Swim-lane timeline (``ChartTimeline.java``): one lane per named
+    track, entries are (start, end, label) spans."""
+
+    component_type = "chart_timeline"
+
+    def __init__(self, title: str = "", style: Optional[StyleChart] = None):
+        super().__init__(title, style)
+        self.lanes: List[Tuple[str, List[Tuple[float, float, str]]]] = []
+
+    def add_lane(self, name: str,
+                 entries: Sequence[Tuple[float, float, str]]) -> "ChartTimeline":
+        self.lanes.append(
+            (name, [(float(a), float(b), str(l)) for a, b, l in entries]))
+        return self
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "style": self.style.to_dict(),
+                "lanes": [{"name": n,
+                           "entries": [{"start": a, "end": b, "label": l}
+                                       for a, b, l in es]}
+                          for n, es in self.lanes]}
+
+    def render(self) -> str:
+        parts = self._svg_open()
+        spans = [e for _, es in self.lanes for e in es]
+        if spans:
+            s = self.style
+            m = s.margin
+            tmin = min(a for a, _, _ in spans)
+            tmax = max(b for _, b, _ in spans)
+            tr = max(tmax - tmin, 1e-12)
+            band = (s.height - 2 * m) / len(self.lanes)
+            x_of = lambda t: m + (s.width - 2 * m) * (t - tmin) / tr
+            for i, (name, entries) in enumerate(self.lanes):
+                y0 = m + i * band
+                parts.append(f'<text x="{m - 4}" y="{y0 + band * 0.5:.1f}" '
+                             f'font-size="10" text-anchor="end">'
+                             f"{html.escape(name)}</text>")
+                for j, (a, b, label) in enumerate(entries):
+                    color = s.colors[j % len(s.colors)]
+                    parts.append(
+                        f'<rect x="{x_of(a):.1f}" y="{y0:.1f}" '
+                        f'width="{max(x_of(b) - x_of(a), 1.0):.1f}" '
+                        f'height="{band * 0.8:.1f}" fill="{color}" '
+                        f'fill-opacity="0.8"><title>{html.escape(label)}'
+                        f"</title></rect>")
+        parts.append("</svg>")
+        return "".join(parts)
+
+
+class DecoratorAccordion(Component):
+    """Collapsible section wrapping child components
+    (``DecoratorAccordion.java``); renders as <details>/<summary>."""
+
+    component_type = "decorator_accordion"
+
+    def __init__(self, title: str = "", default_collapsed: bool = False,
+                 *children: Component):
+        self.title = title
+        self.default_collapsed = default_collapsed
+        self.children = list(children)
+
+    def add(self, child: Component) -> "DecoratorAccordion":
+        self.children.append(child)
+        return self
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "default_collapsed": self.default_collapsed,
+                "children": [c.to_dict() for c in self.children]}
+
+    def render(self) -> str:
+        open_attr = "" if self.default_collapsed else " open"
+        inner = "".join(c.render() for c in self.children)
+        return (f"<details{open_attr}><summary>{html.escape(self.title)}"
+                f"</summary>{inner}</details>")
+
+
 class ComponentTable(Component):
     """Simple table (``ComponentTable.java``)."""
 

@@ -1,0 +1,172 @@
+"""MultiLayerNetwork API-surface parity: layer/param access, stored rnn
+state, classifier conveniences, save/load facades.
+
+Reference: MultiLayerNetwork.java (getLayer/paramTable/getParam/setParam,
+feedForwardToLayer:949, rnnGetPreviousState/rnnSetPreviousState,
+f1Score/labelProbabilities/numLabels, save/load).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTMLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+def dense_net():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+            .layer(DenseLayer(n_in=3, n_out=4, activation="tanh", name="d0"))
+            .layer(OutputLayer(n_in=4, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestLayerParamAccess:
+    def test_layer_getters(self):
+        net = dense_net()
+        assert net.n_layers == 2
+        assert net.get_layer(0) is net.layers[0]
+        assert net.get_layer("d0") is net.layers[0]
+        assert net.get_output_layer() is net.layers[1]
+        assert net.get_layers() == net.layers
+        with pytest.raises(KeyError):
+            net.get_layer("missing")
+
+    def test_param_table_keys(self):
+        net = dense_net()
+        table = net.param_table()
+        assert set(table) == {"0_W", "0_b", "1_W", "1_b"}
+        assert table["0_W"].shape == (3, 4)
+
+    def test_get_set_param_roundtrip(self):
+        net = dense_net()
+        w = np.asarray(net.get_param("0_W"))
+        net.set_param("0_W", w * 0.0)
+        assert float(np.abs(np.asarray(net.get_param("0_W"))).sum()) == 0.0
+        with pytest.raises(ValueError):
+            net.set_param("0_W", np.zeros((2, 2)))
+
+    def test_set_param_changes_output(self):
+        net = dense_net()
+        x = np.ones((2, 3), np.float32)
+        before = np.asarray(net.output(x))
+        net.set_param("1_b", np.asarray([5.0, -5.0]))
+        after = np.asarray(net.output(x))
+        assert not np.allclose(before, after)
+
+    def test_num_labels(self):
+        assert dense_net().num_labels() == 2
+
+
+class TestFeedForwardToLayer:
+    def test_prefix_of_feed_forward(self):
+        net = dense_net()
+        x = np.ones((2, 3), np.float32)
+        acts = net.feed_forward_to_layer(0, x)
+        full = net.feed_forward(x)
+        assert len(acts) == 2  # input + layer0
+        np.testing.assert_allclose(np.asarray(acts[1]), np.asarray(full[1]))
+        with pytest.raises(ValueError):
+            net.feed_forward_to_layer(5, x)
+
+
+class TestClassifierConvenience:
+    def test_f1_and_probabilities(self):
+        net = dense_net()
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 8)]
+        f1 = net.f1_score(x, y)
+        assert 0.0 <= f1 <= 1.0
+        probs = net.label_probabilities(x)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+
+
+class TestRnnStoredState:
+    def _rnn_net(self):
+        conf = (NeuralNetConfiguration.builder().seed(2).updater("sgd").list()
+                .layer(LSTMLayer(n_in=3, n_out=5))
+                .layer(RnnOutputLayer(n_in=5, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    def test_get_set_previous_state(self):
+        net = self._rnn_net()
+        assert net.rnn_get_previous_state(0) is None
+        x = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+        net.rnn_time_step(x)
+        state = net.rnn_get_previous_state(0)
+        assert state is not None
+        # continuing from a saved state == re-setting it and continuing
+        x2 = np.random.RandomState(1).randn(2, 2, 3).astype(np.float32)
+        out_a = np.asarray(net.rnn_time_step(x2))
+        net.rnn_clear_previous_state()
+        net.rnn_time_step(x)  # rebuild the same state
+        net.rnn_set_previous_state(0, state)
+        out_b = np.asarray(net.rnn_time_step(x2))
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-5)
+
+    def test_set_before_step_raises(self):
+        net = self._rnn_net()
+        with pytest.raises(ValueError):
+            net.rnn_set_previous_state(0, None)
+
+
+class TestSaveLoadFacade:
+    def test_instance_save_static_load(self, tmp_path):
+        net = dense_net()
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        again = MultiLayerNetwork.load(p)
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(again.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+
+class TestGraphApiSurface:
+    """ComputationGraph mirrors: getLayer/paramTable/getParam/setParam/save/load."""
+
+    def _graph(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("dense_0", DenseLayer(n_in=3, n_out=4, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_in=4, n_out=2), "dense_0")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        return g
+
+    def test_layer_and_param_access(self):
+        g = self._graph()
+        assert g.get_layer("dense_0").n_out == 4
+        assert len(g.get_layers()) == 2
+        table = g.param_table()
+        assert "dense_0_W" in table and "out_b" in table
+        # vertex names containing underscores resolve correctly
+        w = np.asarray(g.get_param("dense_0_W"))
+        assert w.shape == (3, 4)
+        g.set_param("dense_0_W", w * 0)
+        assert float(np.abs(np.asarray(g.get_param("dense_0_W"))).sum()) == 0
+        with pytest.raises(KeyError):
+            g.get_param("nope_W")
+        with pytest.raises(ValueError):
+            g.set_param("out_b", np.zeros(7))
+
+    def test_save_load_facade(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = self._graph()
+        p = str(tmp_path / "g.zip")
+        g.save(p)
+        again = ComputationGraph.load(p)
+        x = np.ones((2, 3), np.float32)
+        np.testing.assert_allclose(np.asarray(again.output_single(x)),
+                                   np.asarray(g.output_single(x)), rtol=1e-6)

@@ -79,7 +79,9 @@ class TestTransformers:
         check(b)
         assert b.yield_words() == ["a", "b", "c", "d"]  # yield preserved
         assert b.children[0].label == "A"
-        assert b.children[1].label.startswith("X-(")  # intermediate label
+        assert b.children[1].label.startswith("X@")  # intermediate label
+        # binarized trees stay parseable by the module's own serde
+        assert Tree.from_penn(b.to_penn()).to_penn() == b.to_penn()
 
     def test_binarize_left(self):
         t = Tree.from_penn("(X (A a) (B b) (C c))")
@@ -198,6 +200,13 @@ class TestPosTokenizer:
         tf = PosTokenizerFactory(allowed_pos_tags={"NN", "NNS"})
         tokens = tf.create("the cat is running").get_tokens()
         assert tokens == ["NONE", "cat", "NONE", "NONE"]
+
+    def test_preprocessor_skips_sentinel(self):
+        tf = PosTokenizerFactory(allowed_pos_tags={"NN", "VBG"})
+        tf.set_token_pre_processor(StemmingPreprocessor())
+        tokens = tf.create("the cat is running").get_tokens()
+        # valid tokens stemmed; sentinel NONE untouched (not 'none')
+        assert tokens == ["NONE", "cat", "NONE", "run"]
 
     def test_pos_filter_strip(self):
         tf = PosTokenizerFactory(allowed_pos_tags={"NN"}, strip_nones=True)

@@ -10,11 +10,15 @@ import pytest
 
 from deeplearning4j_tpu.ui.components import (
     ChartHistogram,
+    ChartHorizontalBar,
     ChartLine,
     ChartScatter,
+    ChartStackedArea,
+    ChartTimeline,
     ComponentDiv,
     ComponentTable,
     ComponentText,
+    DecoratorAccordion,
 )
 from deeplearning4j_tpu.util import model_serializer
 
@@ -59,6 +63,43 @@ class TestUiComponents:
         assert len(hist.bins) == 12
         assert "rect" in hist.render()
         assert sum(b["count"] for b in hist.to_dict()["bins"]) == 500
+
+    def test_horizontal_bar(self):
+        bar = (ChartHorizontalBar("per-class F1")
+               .add_bar("cat", 0.9).add_bar("dog", -0.2))
+        d = bar.to_dict()
+        assert d["type"] == "chart_horizontal_bar" and len(d["bars"]) == 2
+        svg = bar.render()
+        assert svg.count("<rect") == 2 and "cat" in svg
+
+    def test_stacked_area(self):
+        area = (ChartStackedArea("memory")
+                .set_x_values([0, 1, 2])
+                .add_series("params", [1, 1, 1])
+                .add_series("activations", [0, 2, 1]))
+        svg = area.render()
+        assert svg.count("<polygon") == 2
+        with pytest.raises(ValueError):
+            area.add_series("bad", [1, 2])  # length mismatch
+        d = area.to_dict()
+        assert d["x"] == [0, 1, 2] and len(d["series"]) == 2
+
+    def test_timeline(self):
+        tl = (ChartTimeline("phases")
+              .add_lane("worker0", [(0, 5, "fit"), (5, 6, "sync")])
+              .add_lane("worker1", [(0, 4, "fit")]))
+        svg = tl.render()
+        assert svg.count("<rect") == 3 and "worker1" in svg
+        assert "<title>fit</title>" in svg  # hover labels
+
+    def test_accordion(self):
+        acc = DecoratorAccordion("details", False,
+                                 ComponentText("hidden content"))
+        out = acc.render()
+        assert out.startswith("<details open>")
+        assert "hidden content" in out
+        closed = DecoratorAccordion("c", True).add(ComponentText("x")).render()
+        assert closed.startswith("<details>")
 
     def test_table_text_div_page(self):
         page = ComponentDiv(
