@@ -41,6 +41,7 @@ class Evaluation:
     def __init__(self, num_classes: Optional[int] = None, labels_list=None,
                  top_n: int = 1):
         self.num_classes = num_classes
+        self._initial_num_classes = num_classes  # restored by reset()
         self.labels_list = labels_list
         self.confusion: Optional[np.ndarray] = None  # [true, predicted]
         self.top_n = max(int(top_n), 1)
@@ -212,6 +213,102 @@ class Evaluation:
         tn = int(self.confusion.sum()) - tp - fp - fn
         return tp, fp, fn, tn
 
+    # -- per-class count maps (Evaluation.java truePositives() family) ------
+    def true_positives(self) -> Dict[int, int]:
+        self._check()
+        return {i: self._tp(i) for i in range(self.num_classes)}
+
+    def false_positives(self) -> Dict[int, int]:
+        self._check()
+        return {i: self._fp(i) for i in range(self.num_classes)}
+
+    def false_negatives(self) -> Dict[int, int]:
+        self._check()
+        return {i: self._fn(i) for i in range(self.num_classes)}
+
+    def true_negatives(self) -> Dict[int, int]:
+        self._check()
+        return {i: self._counts(i)[3] for i in range(self.num_classes)}
+
+    def positive(self) -> Dict[int, int]:
+        """Actual count per class (``positive()``)."""
+        self._check()
+        return {i: int(self.confusion[i, :].sum())
+                for i in range(self.num_classes)}
+
+    def negative(self) -> Dict[int, int]:
+        """Actual-negative count per class (``negative()``)."""
+        self._check()
+        total = int(self.confusion.sum())
+        return {i: total - p for i, p in self.positive().items()}
+
+    def false_negative_rate(self, cls: int, edge_case: float = 0.0) -> float:
+        """FN / (FN + TP) (``falseNegativeRate``)."""
+        self._check()
+        tp, _, fn, _ = self._counts(cls)
+        return fn / (fn + tp) if (fn + tp) else edge_case
+
+    def false_alarm_rate(self) -> float:
+        """Mean of macro FPR and FNR (``falseAlarmRate``)."""
+        self._check()
+        fpr = np.mean([self.false_positive_rate(i)
+                       for i in range(self.num_classes)])
+        fnr = np.mean([self.false_negative_rate(i)
+                       for i in range(self.num_classes)])
+        return float((fpr + fnr) / 2.0)
+
+    def class_count(self, cls: int) -> int:
+        """Actual instances of a class (``classCount``)."""
+        self._check()
+        return int(self.confusion[cls, :].sum())
+
+    def get_num_row_counter(self) -> int:
+        """Total examples seen (``getNumRowCounter``)."""
+        return 0 if self.confusion is None else int(self.confusion.sum())
+
+    def get_class_label(self, cls: int) -> str:
+        """Label string for a class index (``getClassLabel``)."""
+        if self.labels_list and cls < len(self.labels_list):
+            return str(self.labels_list[cls])
+        return str(cls)
+
+    def get_top_n_correct_count(self) -> int:
+        return self.top_n_correct_count
+
+    def get_top_n_total_count(self) -> int:
+        return self.top_n_total_count
+
+    def reset(self) -> None:
+        """Clear all accumulated state (``reset()``), restoring the
+        constructor's class count."""
+        self.confusion = None
+        if self._initial_num_classes is not None:
+            self.num_classes = self._initial_num_classes
+        elif self.labels_list is not None:
+            self.num_classes = len(self.labels_list)
+        else:
+            self.num_classes = None
+        self.top_n_correct_count = 0
+        self.top_n_total_count = 0
+        self.confusion_meta = None
+
+    def confusion_to_string(self) -> str:
+        """Formatted confusion matrix (``confusionToString``): predicted
+        classes across, actual down."""
+        self._check()
+        names = [self.get_class_label(i) for i in range(self.num_classes)]
+        width = max(6, max(len(n) for n in names) + 1)
+        head = " " * width + "".join(f"{n:>{width}}" for n in names)
+        rows = [head]
+        for i in range(self.num_classes):
+            cells = "".join(f"{int(self.confusion[i, j]):>{width}}"
+                            for j in range(self.num_classes))
+            rows.append(f"{names[i]:>{width}}" + cells)
+        rows.append("")
+        rows.append(f"Confusion matrix format: Actual (rowClass) predicted "
+                    f"as (columnClass) N times")
+        return "\n".join(rows)
+
     def _support_classes(self):
         """Classes with at least one true or predicted instance — the
         subset this framework's macro averages run over (consistent with
@@ -219,6 +316,24 @@ class Evaluation:
         return [i for i in range(self.num_classes)
                 if self.confusion[:, i].sum()
                 + self.confusion[i, :].sum() > 0]
+
+    def _num_classes_excluded(self) -> int:
+        """Classes left out of the macro averages for lack of support
+        (``averageF1NumClassesExcluded`` family)."""
+        self._check()
+        return self.num_classes - len(self._support_classes())
+
+    def average_f1_num_classes_excluded(self) -> int:
+        return self._num_classes_excluded()
+
+    def average_f_beta_num_classes_excluded(self) -> int:
+        return self._num_classes_excluded()
+
+    def average_precision_num_classes_excluded(self) -> int:
+        return self._num_classes_excluded()
+
+    def average_recall_num_classes_excluded(self) -> int:
+        return self._num_classes_excluded()
 
     def precision_averaged(self, averaging: str = "macro") -> float:
         """``Evaluation.precision(EvaluationAveraging)``: macro averages

@@ -92,8 +92,55 @@ class RegressionEvaluation:
         ss_tot = self.labels_sq_sum[col] - self.n * mean_label ** 2
         return float(1.0 - self.sq_err_sum[col] / ss_tot) if ss_tot else 0.0
 
+    # -- column-averaged metrics + introspection (RegressionEvaluation.java
+    #    averageX()/numColumns/reset/scoreForMetric surface) ----------------
+    def num_columns(self) -> int:
+        return 0 if self.labels_sum is None else len(self.labels_sum)
+
+    def reset(self) -> None:
+        self.n = 0
+        for a in ("labels_sum", "labels_sq_sum", "preds_sum", "preds_sq_sum",
+                  "cross_sum", "abs_err_sum", "sq_err_sum"):
+            setattr(self, a, None)
+
     def average_mean_squared_error(self) -> float:
         return float(np.mean(self.sq_err_sum / self.n))
+
+    def average_mean_absolute_error(self) -> float:
+        return float(np.mean(self.abs_err_sum / self.n))
+
+    def average_root_mean_squared_error(self) -> float:
+        return float(np.mean([self.root_mean_squared_error(c)
+                              for c in range(self.num_columns())]))
+
+    def average_relative_squared_error(self) -> float:
+        return float(np.mean([self.relative_squared_error(c)
+                              for c in range(self.num_columns())]))
+
+    def average_pearson_correlation(self) -> float:
+        return float(np.mean([self.pearson_correlation(c)
+                              for c in range(self.num_columns())]))
+
+    def average_r_squared(self) -> float:
+        return float(np.mean([self.r_squared(c)
+                              for c in range(self.num_columns())]))
+
+    def score_for_metric(self, metric: str) -> float:
+        """Column-averaged metric by name (``scoreForMetric``): MSE, MAE,
+        RMSE, RSE, PC, R2 (case-insensitive)."""
+        key = metric.upper()
+        table = {
+            "MSE": self.average_mean_squared_error,
+            "MAE": self.average_mean_absolute_error,
+            "RMSE": self.average_root_mean_squared_error,
+            "RSE": self.average_relative_squared_error,
+            "PC": self.average_pearson_correlation,
+            "R2": self.average_r_squared,
+        }
+        if key not in table:
+            raise ValueError(f"unknown regression metric {metric!r}; "
+                             f"expected one of {sorted(table)}")
+        return table[key]()
 
     def stats(self) -> str:
         cols = len(self.labels_sum)

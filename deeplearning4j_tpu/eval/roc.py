@@ -256,12 +256,25 @@ class ROCBinary:
         if self._per_col:
             return max(self._per_col) + 1
         if self.labels:
-            return int(np.asarray(self.labels[0]).shape[1])
+            return int(np.asarray(self.labels[0]).shape[-1])
         return 0
 
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:
+            # time series [N,T,C]: flatten time; a [N,T] mask selects rows
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, -1)
+            if mask is not None:
+                m = np.asarray(mask).astype(bool)
+                if m.shape == (n, t):
+                    keep = m.reshape(n * t)
+                    labels, predictions = labels[keep], predictions[keep]
+                    mask = None
+                else:  # [N,T,C] per-output mask
+                    mask = m.reshape(n * t, c)
         m2 = None  # [N, C] per-output mask (ROCBinary.java supports both)
         if mask is not None:
             m = np.asarray(mask).astype(bool)
@@ -340,7 +353,7 @@ class ROCMultiClass:
         if self._per_cls:
             return max(self._per_cls) + 1
         if self.scores:
-            return int(np.asarray(self.scores[0]).shape[1])
+            return int(np.asarray(self.scores[0]).shape[-1])
         return 0
 
     def eval(self, labels, predictions, mask=None) -> None:

@@ -818,6 +818,25 @@ class ComputationGraph:
             r.eval(np.asarray(mds.labels[0]), np.asarray(out))
         return r
 
+    def evaluate_roc_binary(self, iterator, threshold_steps: int = 0):
+        """Per-output binary ROC over the first output
+        (``doEvaluation`` with ROCBinary), label masks honored."""
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        r = ROCBinary(threshold_steps=threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            mds = self._to_mds(ds)
+            out = self.output(*mds.features)
+            if isinstance(out, list):
+                out = out[0]
+            lmask = None
+            if getattr(mds, "labels_masks", None):
+                lmask = mds.labels_masks[0]
+            r.eval(np.asarray(mds.labels[0]), np.asarray(out),
+                   mask=None if lmask is None else np.asarray(lmask))
+        return r
+
     def output_single(self, *xs) -> Array:
         """First output as a single array (``outputSingle``)."""
         out = self.output(*xs)
@@ -834,9 +853,75 @@ class ComputationGraph:
             raise KeyError(f"vertex {name!r} is not a layer vertex")
         return vd.obj
 
+    def get_vertices(self) -> dict:
+        """All vertex definitions by name (``getVertices``)."""
+        return dict(self.conf.vertices)
+
+    def get_num_layers(self) -> int:
+        """Number of layer vertices (``getNumLayers``)."""
+        return len(self.conf.layer_vertices())
+
+    def get_num_input_arrays(self) -> int:
+        """``getNumInputArrays``."""
+        return len(self.conf.inputs)
+
+    def get_num_output_arrays(self) -> int:
+        """``getNumOutputArrays``."""
+        return len(self.conf.outputs)
+
+    def get_output_layer(self, index: int = 0):
+        """Layer object of the index-th output vertex (``getOutputLayer``)."""
+        name = self.conf.outputs[index]
+        return self.get_layer(name)
+
+    def topological_sort_order(self) -> list:
+        """Vertex names in execution order (``topologicalSortOrder``)."""
+        return list(self.conf.topo_order)
+
+    def rnn_get_previous_state(self, name: str):
+        """Stored carry of a recurrent layer vertex
+        (``rnnGetPreviousState``), or None before any rnn_time_step."""
+        if self._rnn_carries is None:
+            return None
+        return self._rnn_carries.get(name)
+
+    def rnn_get_previous_states(self) -> dict:
+        """All stored carries by vertex name (``rnnGetPreviousStates``)."""
+        return dict(self._rnn_carries or {})
+
+    def rnn_set_previous_state(self, name: str, state,
+                               position: Optional[int] = None) -> None:
+        """Overwrite a recurrent vertex's stored carry
+        (``rnnSetPreviousState``); ``position`` (total timesteps already
+        absorbed) is required when any layer has a finite carry so the
+        host-side capacity guard stays in sync with the restored cache."""
+        if self._rnn_carries is None:
+            raise ValueError(
+                "no stored rnn state to overwrite; call rnn_time_step "
+                "first to initialize the carries")
+        if position is not None:
+            self._rnn_pos = int(position)
+        else:
+            from deeplearning4j_tpu.nn.layers.recurrent import BaseRecurrentLayer
+            finite = any(
+                vd.is_layer and isinstance(vd.obj, BaseRecurrentLayer)
+                and vd.obj.carry_capacity() is not None
+                for vd in self.conf.vertices.values())
+            if finite:
+                raise ValueError(
+                    "rnn_set_previous_state needs position= when a layer "
+                    "has a finite carry capacity (KV cache)")
+        self._rnn_carries[name] = state
+
+    def rnn_set_previous_states(self, states: dict,
+                                position: Optional[int] = None) -> None:
+        """Overwrite several carries at once (``rnnSetPreviousStates``)."""
+        for name, state in states.items():
+            self.rnn_set_previous_state(name, state, position=position)
+
     def get_layers(self) -> list:
         """All layer objects in topological order (``getLayers``)."""
-        return [vd.obj for vd in self.conf.vertices.values() if vd.is_layer]
+        return [vd.obj for vd in self.conf.layer_vertices()]
 
     def param_table(self) -> dict:
         """All parameters keyed ``"<vertexName>_<param>"``

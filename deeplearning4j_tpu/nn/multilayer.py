@@ -482,16 +482,8 @@ class MultiLayerNetwork:
 
     def feed_forward(self, x, train: bool = False) -> List[Array]:
         """Per-layer activations (MultiLayerNetwork.feedForward parity)."""
-        dtype = self.conf.global_conf.jnp_dtype()
-        h = _as_jnp(x, dtype)
-        acts = [h]
-        for i, layer in enumerate(self.layers):
-            if i in self.conf.preprocessors:
-                h = self.conf.preprocessors[i](h)
-            h, _ = layer.forward(self.params[i], h, state=self.states[i],
-                                 train=train, rng=None)
-            acts.append(h)
-        return acts
+        return self.feed_forward_to_layer(len(self.layers) - 1, x,
+                                          train=train)
 
     def feed_forward_to_layer(self, layer_num: int, x,
                               train: bool = False) -> List[Array]:
@@ -847,6 +839,23 @@ class MultiLayerNetwork:
                               mask=None if ds.features_mask is None
                               else _as_jnp(ds.features_mask))
             r.eval(np.asarray(ds.labels), np.asarray(out))
+        return r
+
+    def evaluate_roc_binary(self, iterator,
+                            threshold_steps: int = 0) -> "ROCBinary":
+        """Per-output binary ROC for multi-label heads
+        (``doEvaluation`` with ROCBinary), masks honored."""
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        r = ROCBinary(threshold_steps=threshold_steps)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(ds.features,
+                              mask=None if ds.features_mask is None
+                              else _as_jnp(ds.features_mask))
+            r.eval(np.asarray(ds.labels), np.asarray(out),
+                   mask=None if ds.labels_mask is None
+                   else np.asarray(ds.labels_mask))
         return r
 
     def evaluate_regression(self, iterator) -> "RegressionEvaluation":

@@ -63,6 +63,163 @@ class ClassificationScoreCalculator(ScoreCalculator):
             return 1.0 - getattr(e, self.metric)()
 
 
+class RegressionScoreCalculator(ScoreCalculator):
+    """Column-averaged regression metric to minimize
+    (``RegressionScoreCalculator.java``). metric: MSE | MAE | RMSE | RSE |
+    PC | R2 — correlation-style metrics (PC, R2) are negated so lower
+    stays better."""
+
+    _MAXIMIZED = {"PC", "R2"}
+
+    def __init__(self, iterator, metric: str = "MSE"):
+        self.iterator = iterator
+        self.metric = metric
+
+    def calculate_score(self, model) -> float:
+        e = model.evaluate_regression(self.iterator)
+        v = e.score_for_metric(self.metric)
+        return -v if self.metric.upper() in self._MAXIMIZED else v
+
+
+class ROCScoreCalculator(ScoreCalculator):
+    """1 - AUC so better ranking minimizes (``ROCScoreCalculator.java``).
+    roc_type: "roc" (binary), "binary" (per-output ROCBinary average) or
+    "multiclass" (ROCMultiClass average); metric: "auc" or "auprc"."""
+
+    def __init__(self, iterator, roc_type: str = "roc",
+                 metric: str = "auc"):
+        if roc_type not in ("roc", "binary", "multiclass"):
+            raise ValueError("roc_type must be roc|binary|multiclass")
+        if metric not in ("auc", "auprc"):
+            raise ValueError("metric must be auc|auprc")
+        self.iterator = iterator
+        self.roc_type = roc_type
+        self.metric = metric
+
+    def calculate_score(self, model) -> float:
+        import numpy as _np
+        if self.roc_type == "roc":
+            roc = model.evaluate_roc(self.iterator)
+            auc = (roc.calculate_auc() if self.metric == "auc"
+                   else roc.calculate_auc_pr())
+        elif self.roc_type == "multiclass":
+            roc = model.evaluate_roc_multi_class(self.iterator)
+            n = roc.num_classes()
+            vals = [(roc._single(c).calculate_auc() if self.metric == "auc"
+                     else roc._single(c).calculate_auc_pr())
+                    for c in range(n)]
+            auc = float(_np.mean(vals)) if vals else 0.0
+        else:
+            roc = model.evaluate_roc_binary(self.iterator)
+            n = roc.num_labels()
+            vals = [(roc._single(c).calculate_auc() if self.metric == "auc"
+                     else roc._single(c).calculate_auc_pr())
+                    for c in range(n)]
+            auc = float(_np.mean(vals)) if vals else 0.0
+        return 1.0 - auc
+
+
+def _activation_into_layer(model, layer_index: int, x):
+    """The exact activation layer ``layer_index`` sees in a normal
+    forward: earlier layers applied via feed_forward_to_layer, plus the
+    input preprocessor configured AT the layer itself."""
+    import numpy as _np
+    if layer_index > 0:
+        x = _np.asarray(model.feed_forward_to_layer(layer_index - 1, x)[-1])
+    pre = model.conf.preprocessors.get(layer_index)
+    if pre is not None:
+        x = _np.asarray(pre(x))
+    return x
+
+
+class AutoencoderScoreCalculator(ScoreCalculator):
+    """Mean reconstruction error of an AutoEncoder layer on held-out data
+    (``AutoencoderScoreCalculator.java``): forward to the layer, decode,
+    and score reconstruction vs input."""
+
+    def __init__(self, iterator, layer_index: int = 0, metric: str = "mse"):
+        self.iterator = iterator
+        self.layer_index = layer_index
+        self.metric = metric.lower()
+
+    def calculate_score(self, model) -> float:
+        import numpy as _np
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        layer = model.layers[self.layer_index]
+        params = model.params[self.layer_index]
+        for ds in self.iterator:
+            x = _activation_into_layer(model, self.layer_index,
+                                       _np.asarray(ds.features))
+            h = _np.asarray(layer.encode(params, x))
+            recon = _np.asarray(layer.decode(params, h))
+            err = ((recon - x) ** 2 if self.metric == "mse"
+                   else _np.abs(recon - x))
+            total += float(err.sum())
+            n += x.shape[0]
+        return total / n if n else float("nan")
+
+
+class VAEReconErrorScoreCalculator(ScoreCalculator):
+    """Mean deterministic reconstruction error of a VAE layer
+    (``VAEReconErrorScoreCalculator.java``; loss-function configs only)."""
+
+    def __init__(self, iterator, layer_index: int = 0):
+        self.iterator = iterator
+        self.layer_index = layer_index
+
+    def calculate_score(self, model) -> float:
+        import numpy as _np
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        layer = model.layers[self.layer_index]
+        params = model.params[self.layer_index]
+        for ds in self.iterator:
+            x = _activation_into_layer(model, self.layer_index,
+                                       _np.asarray(ds.features))
+            err = _np.asarray(layer.reconstruction_error(params, x))
+            total += float(err.sum())
+            n += x.shape[0]
+        return total / n if n else float("nan")
+
+
+class VAEReconProbScoreCalculator(ScoreCalculator):
+    """Negative mean reconstruction log-probability of a VAE layer
+    (``VAEReconProbScoreCalculator.java``; probabilistic reconstruction
+    distributions only) — negated so higher likelihood minimizes."""
+
+    def __init__(self, iterator, layer_index: int = 0,
+                 num_samples: int = 1, log_prob: bool = True, seed: int = 0):
+        self.iterator = iterator
+        self.layer_index = layer_index
+        self.num_samples = num_samples
+        self.log_prob = log_prob
+        self.seed = seed
+
+    def calculate_score(self, model) -> float:
+        import jax as _jax
+        import numpy as _np
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        layer = model.layers[self.layer_index]
+        params = model.params[self.layer_index]
+        rng = _jax.random.PRNGKey(self.seed)
+        for i, ds in enumerate(self.iterator):
+            x = _activation_into_layer(model, self.layer_index,
+                                       _np.asarray(ds.features))
+            lp = _np.asarray(layer.reconstruction_log_probability(
+                params, x, _jax.random.fold_in(rng, i),
+                num_samples=self.num_samples))
+            if not self.log_prob:
+                lp = _np.exp(lp)
+            total += float(lp.sum())
+            n += x.shape[0]
+        return -(total / n) if n else float("nan")
+
+
 # ---------------------------------------------------------------- termination
 class EpochTerminationCondition:
     def initialize(self) -> None:

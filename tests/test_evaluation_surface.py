@@ -1,0 +1,164 @@
+"""Evaluation count-map / rate / introspection surface.
+
+Reference: Evaluation.java (truePositives()/falsePositives()/
+falseNegatives()/trueNegatives(), positive()/negative(),
+falseNegativeRate, falseAlarmRate, classCount, getNumRowCounter,
+getClassLabel, confusionToString, reset, averageF1NumClassesExcluded).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+
+def make_eval():
+    """3-class eval with a known confusion matrix:
+        actual 0: predicted [0,0,1]      -> tp0=2, one 0->1 error
+        actual 1: predicted [1]          -> tp1=1
+        actual 2: predicted [2,0]        -> tp2=1, one 2->0 error
+    """
+    e = Evaluation(labels_list=["ant", "bee", "cow"])
+    labels = np.eye(3)[[0, 0, 0, 1, 2, 2]]
+    preds = np.eye(3)[[0, 0, 1, 1, 2, 0]]
+    e.eval(labels, preds)
+    return e
+
+
+class TestCountMaps:
+    def test_tp_fp_fn_tn(self):
+        e = make_eval()
+        assert e.true_positives() == {0: 2, 1: 1, 2: 1}
+        assert e.false_positives() == {0: 1, 1: 1, 2: 0}
+        assert e.false_negatives() == {0: 1, 1: 0, 2: 1}
+        tn = e.true_negatives()
+        # n=6: tn_c = 6 - tp - fp - fn
+        assert tn == {0: 2, 1: 4, 2: 4}
+
+    def test_positive_negative_class_count(self):
+        e = make_eval()
+        assert e.positive() == {0: 3, 1: 1, 2: 2}
+        assert e.negative() == {0: 3, 1: 5, 2: 4}
+        assert e.class_count(0) == 3
+        assert e.get_num_row_counter() == 6
+
+    def test_rates(self):
+        e = make_eval()
+        assert e.false_negative_rate(0) == pytest.approx(1 / 3)
+        assert e.false_negative_rate(1) == 0.0
+        assert 0.0 < e.false_alarm_rate() < 1.0
+
+    def test_class_labels_and_confusion_string(self):
+        e = make_eval()
+        assert e.get_class_label(0) == "ant"
+        assert e.get_class_label(2) == "cow"
+        s = e.confusion_to_string()
+        assert "ant" in s and "bee" in s and "cow" in s
+        assert "Actual (rowClass)" in s
+
+    def test_reset(self):
+        e = make_eval()
+        e.reset()
+        assert e.get_num_row_counter() == 0
+        assert e.num_classes == 3  # labels_list keeps the class count
+        # usable again after reset
+        e.eval(np.eye(3)[[0, 1]], np.eye(3)[[0, 1]])
+        assert e.accuracy() == 1.0
+
+    def test_num_classes_excluded(self):
+        e = Evaluation()
+        # class 2 never appears (true or predicted)
+        labels = np.eye(3)[[0, 1, 0]]
+        preds = np.eye(3)[[0, 1, 1]]
+        e.eval(labels, preds)
+        assert e.average_f1_num_classes_excluded() == 1
+        assert e.average_precision_num_classes_excluded() == 1
+
+    def test_top_n_counters(self):
+        e = Evaluation(top_n=2)
+        labels = np.eye(3)[[0, 1]]
+        preds = np.asarray([[0.2, 0.5, 0.3],   # true 0 is rank 3 -> not top2
+                            [0.4, 0.5, 0.1]])  # true 1 is rank 1 -> top2
+        e.eval(labels, preds)
+        assert e.get_top_n_total_count() == 2
+        assert e.get_top_n_correct_count() == 1
+
+
+class TestRegressionSurface:
+    """RegressionEvaluation averageX()/numColumns/reset/scoreForMetric."""
+
+    def _ev(self):
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+        rng = np.random.RandomState(0)
+        labels = rng.randn(50, 3)
+        preds = labels + 0.1 * rng.randn(50, 3)
+        e = RegressionEvaluation()
+        e.eval(labels, preds)
+        return e
+
+    def test_averages_and_columns(self):
+        e = self._ev()
+        assert e.num_columns() == 3
+        assert e.average_mean_squared_error() == pytest.approx(
+            np.mean([e.mean_squared_error(c) for c in range(3)]))
+        assert e.average_pearson_correlation() > 0.9
+        assert e.average_r_squared() > 0.9
+        assert e.average_root_mean_squared_error() > 0
+
+    def test_score_for_metric(self):
+        e = self._ev()
+        assert e.score_for_metric("mse") == e.average_mean_squared_error()
+        assert e.score_for_metric("R2") == e.average_r_squared()
+        with pytest.raises(ValueError):
+            e.score_for_metric("nope")
+
+    def test_reset(self):
+        e = self._ev()
+        e.reset()
+        assert e.num_columns() == 0 and e.n == 0
+        e.eval(np.ones((4, 2)), np.ones((4, 2)))
+        assert e.mean_squared_error(0) == 0.0
+
+
+class TestEvaluateRocBinary:
+    def test_masks_honored(self):
+        """evaluate_roc_binary drops masked timesteps like evaluate_roc."""
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+        from deeplearning4j_tpu.nn.layers.recurrent import SimpleRnnLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd").list()
+                .layer(SimpleRnnLayer(n_in=2, n_out=4))
+                .layer(RnnOutputLayer(n_in=4, n_out=2, activation="sigmoid",
+                                      loss="xent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 5, 2).astype(np.float32)
+        y = (rng.rand(6, 5, 2) > 0.5).astype(np.float32)
+        mask = np.ones((6, 5), np.float32)
+        mask[:, 3:] = 0  # last two steps padded
+        it = ListDataSetIterator(DataSet(x, y, mask, mask), 6)
+        roc = net.evaluate_roc_binary(it)
+        assert roc.num_labels() == 2
+        # masked eval == hand-trimmed eval (padded steps really dropped)
+        from deeplearning4j_tpu.eval.roc import ROCBinary
+        out = np.asarray(net.output(x))
+        manual = ROCBinary()
+        manual.eval(y[:, :3].reshape(-1, 2), out[:, :3].reshape(-1, 2))
+        assert roc.calculate_auc(0) == pytest.approx(manual.calculate_auc(0))
+        # and differs from the unmasked curve (padding would bias it)
+        unmasked = ROCBinary()
+        unmasked.eval(y.reshape(-1, 2), out.reshape(-1, 2))
+        assert roc.calculate_auc(0) != pytest.approx(unmasked.calculate_auc(0))
+
+    def test_reset_restores_constructor_classes(self):
+        e = Evaluation(num_classes=5)
+        e.eval(np.eye(3)[[0, 1]], np.eye(3)[[0, 1]])
+        assert e.num_classes == 5
+        e.reset()
+        assert e.num_classes == 5
+        with pytest.raises(ValueError):
+            e.negative()  # consistent _check before data

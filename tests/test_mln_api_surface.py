@@ -170,3 +170,40 @@ class TestGraphApiSurface:
         x = np.ones((2, 3), np.float32)
         np.testing.assert_allclose(np.asarray(again.output_single(x)),
                                    np.asarray(g.output_single(x)), rtol=1e-6)
+
+    def test_graph_introspection(self):
+        g = self._graph()
+        assert g.get_num_layers() == 2
+        assert g.get_num_input_arrays() == 1
+        assert g.get_num_output_arrays() == 1
+        assert g.get_output_layer(0).n_out == 2
+        assert "dense_0" in g.get_vertices()
+        order = g.topological_sort_order()
+        assert order.index("dense_0") < order.index("out")
+
+    def test_graph_rnn_state_roundtrip(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers.output import RnnOutputLayer
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTMLayer
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("sgd")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTMLayer(n_in=3, n_out=5), "in")
+                .add_layer("out", RnnOutputLayer(n_in=5, n_out=2), "lstm")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        assert g.rnn_get_previous_state("lstm") is None
+        x = np.random.RandomState(0).randn(2, 4, 3).astype(np.float32)
+        g.rnn_time_step(x)
+        states = g.rnn_get_previous_states()
+        assert "lstm" in states and states["lstm"] is not None
+        x2 = np.random.RandomState(1).randn(2, 2, 3).astype(np.float32)
+        out_a = np.asarray(g.rnn_time_step(x2))
+        g.rnn_clear_previous_state()
+        g.rnn_time_step(x)
+        g.rnn_set_previous_states(states)
+        out_b = np.asarray(g.rnn_time_step(x2))
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-5)

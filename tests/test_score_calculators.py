@@ -1,0 +1,144 @@
+"""Early-stopping score calculators beyond DataSetLoss/Classification.
+
+Reference: earlystopping/scorecalc — RegressionScoreCalculator,
+ROCScoreCalculator, AutoencoderScoreCalculator,
+VAEReconErrorScoreCalculator, VAEReconProbScoreCalculator.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.autoencoder import AutoEncoderLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoderLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.optimize.earlystopping import (
+    AutoencoderScoreCalculator,
+    ROCScoreCalculator,
+    RegressionScoreCalculator,
+    VAEReconErrorScoreCalculator,
+    VAEReconProbScoreCalculator,
+)
+
+
+def regression_net():
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam").list()
+            .layer(DenseLayer(n_in=3, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="identity",
+                               loss="mse"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def classifier_net(n_out=2):
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("adam").list()
+            .layer(DenseLayer(n_in=3, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=n_out))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _reg_iter(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(64, 3).astype(np.float32)
+    y = np.stack([x.sum(1), x[:, 0] - x[:, 1]], axis=1).astype(np.float32)
+    return ListDataSetIterator(DataSet(x, y), 32)
+
+
+def _cls_iter(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(64, 3).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(1) > 0).astype(int)]
+    return ListDataSetIterator(DataSet(x, y), 32)
+
+
+class TestRegressionScoreCalculator:
+    def test_mse_improves_with_training(self):
+        net = regression_net()
+        calc = RegressionScoreCalculator(_reg_iter(), metric="MSE")
+        before = calc.calculate_score(net)
+        net.fit(_reg_iter(), epochs=30)
+        after = calc.calculate_score(net)
+        assert after < before
+
+    def test_maximized_metrics_negated(self):
+        net = regression_net()
+        net.fit(_reg_iter(), epochs=30)
+        r2 = RegressionScoreCalculator(_reg_iter(), metric="R2")
+        score = r2.calculate_score(net)
+        assert score < 0  # good R2 -> negative score (lower is better)
+
+
+class TestROCScoreCalculator:
+    def test_binary_auc(self):
+        net = classifier_net()
+        calc = ROCScoreCalculator(_cls_iter(), roc_type="roc", metric="auc")
+        net.fit(_cls_iter(), epochs=60)
+        score = calc.calculate_score(net)
+        assert 0.0 <= score < 0.5  # AUC > 0.5 after training
+
+    def test_multiclass(self):
+        net = classifier_net()
+        calc = ROCScoreCalculator(_cls_iter(), roc_type="multiclass")
+        s = calc.calculate_score(net)
+        assert 0.0 <= s <= 1.0
+
+    def test_bad_args(self):
+        with pytest.raises(ValueError):
+            ROCScoreCalculator(None, roc_type="nope")
+        with pytest.raises(ValueError):
+            ROCScoreCalculator(None, metric="nope")
+
+
+class TestAutoencoderScoreCalculator:
+    def test_reconstruction_improves(self):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam").list()
+                .layer(AutoEncoderLayer(n_in=4, n_out=2))
+                .layer(OutputLayer(n_in=2, n_out=4, activation="identity",
+                                   loss="mse"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(64, 4).astype(np.float32)
+        it = ListDataSetIterator(DataSet(x, x), 32)
+        calc = AutoencoderScoreCalculator(it, layer_index=0)
+        before = calc.calculate_score(net)
+        net.pretrain_layer(0, ListDataSetIterator(DataSet(x, x), 32),
+                           epochs=40)
+        after = calc.calculate_score(net)
+        assert np.isfinite(before) and np.isfinite(after)
+        assert after < before
+
+
+class TestVAECalculators:
+    def _vae_net(self, recon):
+        conf = (NeuralNetConfiguration.builder().seed(4).updater("adam").list()
+                .layer(VariationalAutoencoderLayer(
+                    n_in=4, n_out=2, encoder_layer_sizes=(8,),
+                    decoder_layer_sizes=(8,),
+                    reconstruction_distribution=recon))
+                .layer(OutputLayer(n_in=2, n_out=2))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_recon_error_loss_config(self):
+        from deeplearning4j_tpu.nn.layers.vae_distributions import LossFunctionWrapper
+        net = self._vae_net(LossFunctionWrapper(activation="sigmoid", loss="mse"))
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        it = ListDataSetIterator(DataSet(x, x), 16)
+        score = VAEReconErrorScoreCalculator(it, 0).calculate_score(net)
+        assert np.isfinite(score) and score >= 0
+
+    def test_recon_prob_probabilistic_config(self):
+        net = self._vae_net("bernoulli")
+        rng = np.random.RandomState(0)
+        x = (rng.rand(32, 4) > 0.5).astype(np.float32)
+        it = ListDataSetIterator(DataSet(x, x), 16)
+        score = VAEReconProbScoreCalculator(it, 0, num_samples=2)
+        v = score.calculate_score(net)
+        assert np.isfinite(v)
+        assert v > 0  # -(negative log prob sum)/n of an untrained model
