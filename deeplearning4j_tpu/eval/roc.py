@@ -112,6 +112,17 @@ class ROC:
              mask: Optional[np.ndarray] = None) -> None:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:
+            # time series [N,T,C]: flatten time; a [N,T] mask selects steps
+            n, t, c = labels.shape
+            labels = labels.reshape(n * t, c)
+            predictions = predictions.reshape(n * t, -1)
+            if mask is not None:
+                m = np.asarray(mask).astype(bool)
+                if m.shape != (n, t):
+                    raise ValueError(
+                        f"time-series ROC mask must be [N,T]; got {m.shape}")
+                mask = m.reshape(n * t)
         if labels.ndim == 2 and labels.shape[1] == 2:
             labels = labels[:, 1]
             predictions = predictions[:, 1]
@@ -359,6 +370,19 @@ class ROCMultiClass:
     def eval(self, labels, predictions, mask=None) -> None:
         labels = np.asarray(labels, np.float64)
         predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3 or predictions.ndim == 3:
+            # time series [N,T,C]: flatten time; a [N,T] mask selects steps
+            n, t = predictions.shape[:2]
+            predictions = predictions.reshape(n * t, -1)
+            labels = (labels.reshape(n * t, -1) if labels.ndim == 3
+                      else labels.reshape(n * t))
+            if mask is not None:
+                m = np.asarray(mask).astype(bool)
+                if m.shape != (n, t):
+                    raise ValueError(
+                        f"time-series ROCMultiClass mask must be [N,T]; got "
+                        f"{m.shape}")
+                mask = m.reshape(n * t)
         if mask is not None:
             # one-vs-all over softmax outputs: a mask is per-EXAMPLE; a 2-D
             # [N, 1] column is accepted and flattened

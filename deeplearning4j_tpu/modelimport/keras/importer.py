@@ -97,6 +97,19 @@ class KerasModelImport:
         return KerasModel(cfg).conf
 
     @staticmethod
+    def import_keras_sequential_configuration(json_path: str):
+        """Sequential config-only import
+        (``KerasModelImport.importKerasSequentialConfiguration``); rejects
+        functional-model JSON loudly."""
+        with open(json_path) as f:
+            model_json = json.load(f)
+        if not _is_sequential(model_json):
+            raise ValueError(
+                f"{json_path} is not a Sequential model config; use "
+                "import_keras_model_configuration")
+        return KerasSequentialModel(KerasModelConfig(model_json)).conf
+
+    @staticmethod
     def import_keras_model_from_json(model_json: Union[str, dict],
                                      training_json: Optional[dict] = None):
         """In-memory JSON → built (uninitialized params) Keras model wrapper."""

@@ -722,12 +722,12 @@ class ComputationGraph:
                 self.pretrain_layer(name, data, epochs=epochs)
         return self
 
-    def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
-        """Evaluate the first output over an iterator
-        (``ComputationGraph.evaluate``); ``top_n`` and collected record
-        metadata flow through exactly as in MultiLayerNetwork.evaluate."""
-        from deeplearning4j_tpu.eval.evaluation import Evaluation
-        e = Evaluation(top_n=top_n)
+    def _eval_first_output(self, iterator, consume) -> None:
+        """One evaluate loop for every evaluator: reset, convert to
+        MultiDataSet, forward the FIRST output with features masks
+        applied, then hand (labels, out, label_mask, ds) to ``consume``.
+        Keeping a single code path prevents the evaluators from drifting
+        apart on mask handling."""
         if hasattr(iterator, "reset"):
             iterator.reset()
         for ds in iterator:
@@ -737,9 +737,20 @@ class ComputationGraph:
                 out = out[0]
             lm = (None if mds.labels_masks is None
                   else mds.labels_masks[0])
-            e.eval(np.asarray(mds.labels[0]), np.asarray(out),
-                   mask=None if lm is None else np.asarray(lm),
-                   record_meta_data=getattr(ds, "example_meta_data", None))
+            consume(np.asarray(mds.labels[0]), np.asarray(out),
+                    None if lm is None else np.asarray(lm), ds)
+
+    def evaluate(self, iterator, top_n: int = 1) -> "Evaluation":
+        """Evaluate the first output over an iterator
+        (``ComputationGraph.evaluate``); ``top_n`` and collected record
+        metadata flow through exactly as in MultiLayerNetwork.evaluate."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        e = Evaluation(top_n=top_n)
+        self._eval_first_output(
+            iterator,
+            lambda labels, out, lm, ds: e.eval(
+                labels, out, mask=lm,
+                record_meta_data=getattr(ds, "example_meta_data", None)))
         return e
 
     def summary(self) -> str:
@@ -775,14 +786,9 @@ class ComputationGraph:
         (``ComputationGraph.evaluateRegression``)."""
         from deeplearning4j_tpu.eval.regression import RegressionEvaluation
         e = RegressionEvaluation()
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in iterator:
-            mds = self._to_mds(ds)
-            out = self.output(*mds.features)
-            if isinstance(out, list):
-                out = out[0]
-            e.eval(np.asarray(mds.labels[0]), np.asarray(out))
+        self._eval_first_output(
+            iterator,
+            lambda labels, out, lm, ds: e.eval(labels, out, mask=lm))
         return e
 
     def evaluate_roc(self, iterator, threshold_steps: int = 0):
@@ -790,17 +796,9 @@ class ComputationGraph:
         .evaluateROC``)."""
         from deeplearning4j_tpu.eval.roc import ROC
         r = ROC(threshold_steps=threshold_steps)
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in iterator:
-            mds = self._to_mds(ds)
-            out = self.output(*mds.features, masks=mds.features_masks)
-            if isinstance(out, list):
-                out = out[0]
-            lm = (None if mds.labels_masks is None
-                  else mds.labels_masks[0])
-            r.eval(np.asarray(mds.labels[0]), np.asarray(out),
-                   mask=None if lm is None else np.asarray(lm))
+        self._eval_first_output(
+            iterator,
+            lambda labels, out, lm, ds: r.eval(labels, out, mask=lm))
         return r
 
     def evaluate_roc_multi_class(self, iterator, threshold_steps: int = 0):
@@ -808,33 +806,20 @@ class ComputationGraph:
         (``ComputationGraph.evaluateROCMultiClass``)."""
         from deeplearning4j_tpu.eval.roc import ROCMultiClass
         r = ROCMultiClass(threshold_steps=threshold_steps)
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in iterator:
-            mds = self._to_mds(ds)
-            out = self.output(*mds.features)
-            if isinstance(out, list):
-                out = out[0]
-            r.eval(np.asarray(mds.labels[0]), np.asarray(out))
+        self._eval_first_output(
+            iterator,
+            lambda labels, out, lm, ds: r.eval(labels, out, mask=lm))
         return r
 
     def evaluate_roc_binary(self, iterator, threshold_steps: int = 0):
         """Per-output binary ROC over the first output
-        (``doEvaluation`` with ROCBinary), label masks honored."""
+        (``doEvaluation`` with ROCBinary), features and label masks
+        honored."""
         from deeplearning4j_tpu.eval.roc import ROCBinary
         r = ROCBinary(threshold_steps=threshold_steps)
-        if hasattr(iterator, "reset"):
-            iterator.reset()
-        for ds in iterator:
-            mds = self._to_mds(ds)
-            out = self.output(*mds.features)
-            if isinstance(out, list):
-                out = out[0]
-            lmask = None
-            if getattr(mds, "labels_masks", None):
-                lmask = mds.labels_masks[0]
-            r.eval(np.asarray(mds.labels[0]), np.asarray(out),
-                   mask=None if lmask is None else np.asarray(lmask))
+        self._eval_first_output(
+            iterator,
+            lambda labels, out, lm, ds: r.eval(labels, out, mask=lm))
         return r
 
     def output_single(self, *xs) -> Array:

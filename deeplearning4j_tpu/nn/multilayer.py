@@ -838,7 +838,9 @@ class MultiLayerNetwork:
             out = self.output(ds.features,
                               mask=None if ds.features_mask is None
                               else _as_jnp(ds.features_mask))
-            r.eval(np.asarray(ds.labels), np.asarray(out))
+            r.eval(np.asarray(ds.labels), np.asarray(out),
+                   mask=None if ds.labels_mask is None
+                   else np.asarray(ds.labels_mask))
         return r
 
     def evaluate_roc_binary(self, iterator,

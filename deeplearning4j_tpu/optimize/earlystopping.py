@@ -119,14 +119,29 @@ class ROCScoreCalculator(ScoreCalculator):
         return 1.0 - auc
 
 
-def _activation_into_layer(model, layer_index: int, x):
-    """The exact activation layer ``layer_index`` sees in a normal
-    forward: earlier layers applied via feed_forward_to_layer, plus the
-    input preprocessor configured AT the layer itself."""
+def _resolve_layer(model, layer_ref):
+    """(layer, params) for an MLN layer index or a ComputationGraph
+    vertex name — the AE/VAE calculators work on both model types."""
+    if isinstance(layer_ref, str):
+        return model.get_layer(layer_ref), model.params[layer_ref]
+    return model.layers[layer_ref], model.params[layer_ref]
+
+
+def _activation_into_layer(model, layer_ref, x):
+    """The exact activation the target layer sees in a normal forward:
+    preceding layers applied, plus the input preprocessor configured AT
+    the layer itself. ``layer_ref`` is an MLN layer index or a CG vertex
+    name."""
     import numpy as _np
-    if layer_index > 0:
-        x = _np.asarray(model.feed_forward_to_layer(layer_index - 1, x)[-1])
-    pre = model.conf.preprocessors.get(layer_index)
+    if isinstance(layer_ref, str):
+        # ComputationGraph: gather the vertex's input activations
+        vd = model.conf.vertices[layer_ref]
+        acts = model.feed_forward(x)
+        ins = [_np.asarray(acts[s]) for s in vd.inputs]
+        x = ins[0] if len(ins) == 1 else _np.concatenate(ins, axis=-1)
+    elif layer_ref > 0:
+        x = _np.asarray(model.feed_forward_to_layer(layer_ref - 1, x)[-1])
+    pre = model.conf.preprocessors.get(layer_ref)
     if pre is not None:
         x = _np.asarray(pre(x))
     return x
@@ -137,7 +152,8 @@ class AutoencoderScoreCalculator(ScoreCalculator):
     (``AutoencoderScoreCalculator.java``): forward to the layer, decode,
     and score reconstruction vs input."""
 
-    def __init__(self, iterator, layer_index: int = 0, metric: str = "mse"):
+    def __init__(self, iterator, layer_index=0, metric: str = "mse"):
+        # layer_index: MLN layer index, or a ComputationGraph vertex name
         self.iterator = iterator
         self.layer_index = layer_index
         self.metric = metric.lower()
@@ -147,8 +163,7 @@ class AutoencoderScoreCalculator(ScoreCalculator):
         total, n = 0.0, 0
         if hasattr(self.iterator, "reset"):
             self.iterator.reset()
-        layer = model.layers[self.layer_index]
-        params = model.params[self.layer_index]
+        layer, params = _resolve_layer(model, self.layer_index)
         for ds in self.iterator:
             x = _activation_into_layer(model, self.layer_index,
                                        _np.asarray(ds.features))
@@ -174,8 +189,7 @@ class VAEReconErrorScoreCalculator(ScoreCalculator):
         total, n = 0.0, 0
         if hasattr(self.iterator, "reset"):
             self.iterator.reset()
-        layer = model.layers[self.layer_index]
-        params = model.params[self.layer_index]
+        layer, params = _resolve_layer(model, self.layer_index)
         for ds in self.iterator:
             x = _activation_into_layer(model, self.layer_index,
                                        _np.asarray(ds.features))
@@ -204,8 +218,7 @@ class VAEReconProbScoreCalculator(ScoreCalculator):
         total, n = 0.0, 0
         if hasattr(self.iterator, "reset"):
             self.iterator.reset()
-        layer = model.layers[self.layer_index]
-        params = model.params[self.layer_index]
+        layer, params = _resolve_layer(model, self.layer_index)
         rng = _jax.random.PRNGKey(self.seed)
         for i, ds in enumerate(self.iterator):
             x = _activation_into_layer(model, self.layer_index,

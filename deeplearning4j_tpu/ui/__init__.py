@@ -25,4 +25,4 @@ from deeplearning4j_tpu.ui.stats import (  # noqa: F401
     StatsUpdateConfiguration,
 )
 from deeplearning4j_tpu.ui.server import RemoteReceiverModule, UIServer  # noqa: F401
-from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter, WebReporter  # noqa: F401
+from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter, UiConnectionInfo, WebReporter  # noqa: F401

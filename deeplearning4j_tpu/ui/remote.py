@@ -21,6 +21,47 @@ from deeplearning4j_tpu.ui.storage import Persistable, StatsStorageRouter
 log = logging.getLogger(__name__)
 
 
+class UiConnectionInfo:
+    """Address builder for a remote UI endpoint
+    (``deeplearning4j-core/.../ui/UiConnectionInfo.java``): scheme +
+    host:port + path, with a session id query and optional login
+    credentials."""
+
+    def __init__(self, address: str = "localhost", port: int = 8080,
+                 path: str = "", use_https: bool = False,
+                 session_id: Optional[str] = None,
+                 login: Optional[str] = None, password: Optional[str] = None):
+        import uuid
+        self.address = address
+        self.port = int(port)
+        self.path = path
+        self.use_https = use_https
+        self.session_id = session_id or str(uuid.uuid4())
+        self.login = login
+        self.password = password
+
+    def get_first_part(self) -> str:
+        scheme = "https" if self.use_https else "http"
+        return f"{scheme}://{self.address}:{self.port}"
+
+    def get_second_part(self, n_path: str = "") -> str:
+        import re
+        out = ""
+        if self.path:
+            out += (self.path if self.path.startswith("/")
+                    else "/" + self.path) + "/"
+        if n_path:
+            n_path = n_path.lstrip("/")
+            out += "/" + n_path + "/"
+        return re.sub(r"/{2,}", "/", out)
+
+    def get_full_address(self, n_path: str = "") -> str:
+        if not n_path:
+            return self.get_first_part() + self.get_second_part()
+        return (self.get_first_part() + self.get_second_part(n_path)
+                + f"?sid={self.session_id}")
+
+
 class WebReporter:
     """POST a JSON payload to a URL with retries (``WebReporter.java``)."""
 

@@ -162,3 +162,22 @@ class TestEvaluateRocBinary:
         assert e.num_classes == 5
         with pytest.raises(ValueError):
             e.negative()  # consistent _check before data
+
+
+class TestRocMultiClassTimeSeries:
+    def test_3d_input_flattens_with_mask(self):
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+        rng = np.random.RandomState(0)
+        n, t, c = 4, 5, 3
+        y = np.eye(c)[rng.randint(0, c, (n, t))]
+        p = rng.rand(n, t, c)
+        p /= p.sum(-1, keepdims=True)
+        mask = np.ones((n, t)); mask[:, 3:] = 0
+        roc = ROCMultiClass()
+        roc.eval(y, p, mask=mask)
+        assert roc.num_classes() == c
+        manual = ROCMultiClass()
+        manual.eval(y[:, :3].reshape(-1, c), p[:, :3].reshape(-1, c))
+        for cls in range(c):
+            assert roc.calculate_auc(cls) == pytest.approx(
+                manual.calculate_auc(cls))

@@ -142,3 +142,44 @@ class TestVAECalculators:
         v = score.calculate_score(net)
         assert np.isfinite(v)
         assert v > 0  # -(negative log prob sum)/n of an untrained model
+
+
+class TestCalculatorsOnComputationGraph:
+    def test_autoencoder_calculator_on_graph_vertex(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("ae", AutoEncoderLayer(n_in=4, n_out=2), "in")
+                .add_layer("out", OutputLayer(n_in=2, n_out=4,
+                                              activation="identity",
+                                              loss="mse"), "ae")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        it = ListDataSetIterator(DataSet(x, x), 16)
+        score = AutoencoderScoreCalculator(it, layer_index="ae").calculate_score(g)
+        assert np.isfinite(score) and score >= 0
+
+    def test_roc_binary_calculator_on_graph(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=3, n_out=8, activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                              activation="sigmoid",
+                                              loss="xent"), "d")
+                .set_outputs("out")
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(32, 3).astype(np.float32)
+        y = (rng.rand(32, 2) > 0.5).astype(np.float32)
+        it = ListDataSetIterator(DataSet(x, y), 16)
+        s = ROCScoreCalculator(it, roc_type="binary").calculate_score(g)
+        assert 0.0 <= s <= 1.0

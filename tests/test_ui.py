@@ -350,3 +350,35 @@ class TestEvaluationModule:
             assert len(body["errors"]) == 2
         finally:
             server.stop()
+
+
+class TestUiConnectionInfo:
+    """UiConnectionInfo address building (deeplearning4j-core/ui)."""
+
+    def test_address_parts(self):
+        from deeplearning4j_tpu.ui import UiConnectionInfo
+        u = UiConnectionInfo("host1", 9000, path="train", use_https=True,
+                             session_id="s1")
+        assert u.get_first_part() == "https://host1:9000"
+        assert u.get_full_address() == "https://host1:9000/train/"
+        assert u.get_full_address("remote") == \
+            "https://host1:9000/train/remote/?sid=s1"
+
+    def test_defaults(self):
+        from deeplearning4j_tpu.ui import UiConnectionInfo
+        u = UiConnectionInfo()
+        assert u.get_first_part() == "http://localhost:8080"
+        assert u.session_id  # generated
+
+
+class TestKerasSequentialConfigImport:
+    def test_rejects_functional(self, tmp_path):
+        import json
+        import pytest as _pytest
+        from deeplearning4j_tpu.modelimport.keras.importer import KerasModelImport
+        functional = {"class_name": "Model", "config": {
+            "name": "m", "layers": [], "input_layers": [], "output_layers": []}}
+        p = tmp_path / "f.json"
+        p.write_text(json.dumps(functional))
+        with _pytest.raises(ValueError):
+            KerasModelImport.import_keras_sequential_configuration(str(p))
