@@ -34,7 +34,9 @@ from deeplearning4j_tpu.optimize.solvers import (  # noqa: F401
 from deeplearning4j_tpu.optimize.listeners import (  # noqa: F401
     CheckpointListener,
     CollectScoresIterationListener,
+    ComposableIterationListener,
     EvaluativeListener,
+    ParamAndGradientIterationListener,
     PerformanceListener,
     ProfilerListener,
     ScoreIterationListener,

@@ -371,6 +371,20 @@ class LocalFileModelSaver:
 
 
 # ------------------------------------------------------------------ config
+class EarlyStoppingListener:
+    """Hooks into the early-stopping loop
+    (``earlystopping/listener/EarlyStoppingListener.java``)."""
+
+    def on_start(self, config, model) -> None:
+        pass
+
+    def on_epoch(self, epoch: int, score: float, config, model) -> None:
+        pass
+
+    def on_completion(self, result) -> None:
+        pass
+
+
 class EarlyStoppingConfiguration:
     """Builder-style config (``EarlyStoppingConfiguration.java``)."""
 
@@ -418,8 +432,16 @@ class EarlyStoppingTrainer:
         (the parallel trainer sends it through a ParallelWrapper)."""
         self.model.fit(self.iterator, epochs=1)
 
+    def set_listener(self, listener: Optional[EarlyStoppingListener]) -> None:
+        """Attach an EarlyStoppingListener (``BaseEarlyStoppingTrainer
+        .setListener``)."""
+        self._es_listener = listener
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
+        listener = getattr(self, "_es_listener", None)
+        if listener is not None:
+            listener.on_start(cfg, self.model)
         for c in cfg.epoch_conditions:
             c.initialize()
         for c in cfg.iteration_conditions:
@@ -447,6 +469,10 @@ class EarlyStoppingTrainer:
                 if last_eval < best_score:
                     best_score, best_epoch = last_eval, epoch
                     cfg.saver.save_best_model(self.model, last_eval)
+                if listener is not None:
+                    # fired only on epochs that actually evaluated, with the
+                    # fresh score (BaseEarlyStoppingTrainer onEpoch timing)
+                    listener.on_epoch(epoch, last_eval, cfg, self.model)
             # epoch termination is checked EVERY epoch (with the most recent
             # eval score), so MaxEpochs cannot overshoot when
             # evaluate_every_n_epochs > 1 (BaseEarlyStoppingTrainer.fit parity)
@@ -459,8 +485,11 @@ class EarlyStoppingTrainer:
                 break
             epoch += 1
         best = cfg.saver.get_best_model() or self.model
-        return EarlyStoppingResult(reason, details, scores, best_epoch,
-                                   best_score, epoch, best)
+        result = EarlyStoppingResult(reason, details, scores, best_epoch,
+                                     best_score, epoch, best)
+        if listener is not None:
+            listener.on_completion(result)
+        return result
 
 
 class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):

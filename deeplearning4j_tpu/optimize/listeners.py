@@ -186,15 +186,149 @@ class EvaluativeListener(TrainingListener):
         parts = [e.stats() if hasattr(e, "stats") else repr(e) for e in evs]
         self.printer("Evaluation: " + "; ".join(parts))
 
+    def set_callback(self, callback) -> None:
+        """Post-evaluation hook (``callbacks/EvaluationCallback.java``):
+        ``callback(listener, evaluations, model)`` after each window.
+        ``evaluations`` is always a LIST of evaluator objects (the
+        reference passes an IEvaluation[]), in both default and
+        ``evaluations=`` factory mode."""
+        self._callback = callback
+
     def iteration_done(self, model, iteration, epoch):
         if self.unit == "iteration" and iteration % self.frequency == 0:
             self._evaluate(model)
+            self._fire_callback(model)
 
     def on_epoch_end(self, model):
         # model.epoch is already the completed-epoch count here (the fit loop
         # increments it before firing on_epoch_end).
         if self.unit == "epoch" and model.epoch % self.frequency == 0:
             self._evaluate(model)
+            self._fire_callback(model)
+
+    def _fire_callback(self, model) -> None:
+        cb = getattr(self, "_callback", None)
+        if cb is not None:
+            last = self.evaluations[-1]
+            cb(self, last if isinstance(last, list) else [last], model)
+
+
+class ComposableIterationListener(TrainingListener):
+    """Bundles several listeners behind one handle
+    (``ComposableIterationListener.java``)."""
+
+    def __init__(self, *listeners):
+        if len(listeners) == 1 and isinstance(listeners[0], (list, tuple)):
+            listeners = tuple(listeners[0])
+        self.listeners = list(listeners)
+
+    def iteration_done(self, model, iteration, epoch):
+        for l in self.listeners:
+            l.iteration_done(model, iteration, epoch)
+
+    def on_epoch_start(self, model):
+        for l in self.listeners:
+            l.on_epoch_start(model)
+
+    def on_epoch_end(self, model):
+        for l in self.listeners:
+            l.on_epoch_end(model)
+
+
+class ParamAndGradientIterationListener(TrainingListener):
+    """Periodic per-parameter AND per-gradient statistics
+    (``ParamAndGradientIterationListener.java``): mean magnitude (and
+    optionally min/max) of every parameter tensor, and of its gradient,
+    every N iterations, written through ``printer`` as tab-separated
+    lines.
+
+    Gradient columns need ``gradient_batch`` — a DataSet (or ``(x, y)``
+    tuple) the gradients are computed on at each window via
+    ``compute_gradient_and_score``. The reference reads the last training
+    gradient off the model; here the jitted donated-buffer step never
+    materializes gradients to host, so a fixed probe batch supplies the
+    same vanishing/exploding-gradient signal deterministically. Without
+    ``gradient_batch`` only parameter columns are emitted."""
+
+    def __init__(self, iterations: int = 1, print_header: bool = True,
+                 print_mean: bool = True, print_min_max: bool = False,
+                 gradient_batch=None, printer: Callable = None):
+        self.iterations = max(1, iterations)
+        self.print_header = print_header
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.gradient_batch = gradient_batch
+        self.printer = printer or (lambda s: log.info(s))
+        self._header_done = False
+
+    def _param_items(self, model):
+        if hasattr(model, "param_table"):
+            return sorted(model.param_table().items())
+        return []
+
+    def _gradient_items(self, model):
+        if self.gradient_batch is None:
+            return []
+        import numpy as np
+        ds = self.gradient_batch
+        if isinstance(ds, tuple):
+            x, y = ds
+            grads, _ = model.compute_gradient_and_score(x, y)
+        else:
+            grads, _ = model.compute_gradient_and_score(
+                ds.features, ds.labels,
+                features_mask=ds.features_mask, labels_mask=ds.labels_mask)
+        out = []
+        if isinstance(grads, dict):  # ComputationGraph: vertex-name keys
+            for vname in sorted(grads):
+                for pname in sorted(grads[vname]):
+                    out.append((f"{vname}_{pname}",
+                                np.asarray(grads[vname][pname])))
+        else:  # MLN: per-layer list
+            for i, g in enumerate(grads):
+                for pname in sorted(g):
+                    out.append((f"{i}_{pname}", np.asarray(g[pname])))
+        return out
+
+    def _stat_cols(self, key, suffix=""):
+        cols = []
+        if self.print_mean:
+            cols.append(f"{key}_{suffix}mean_mag")
+        if self.print_min_max:
+            cols += [f"{key}_{suffix}min", f"{key}_{suffix}max"]
+        return cols
+
+    def _stat_vals(self, arr):
+        import numpy as np
+        a = np.asarray(arr)
+        vals = []
+        if self.print_mean:
+            vals.append(f"{float(np.abs(a).mean()):.6e}")
+        if self.print_min_max:
+            vals += [f"{float(a.min()):.6e}", f"{float(a.max()):.6e}"]
+        return vals
+
+    def iteration_done(self, model, iteration, epoch):
+        if iteration % self.iterations != 0:
+            return
+        items = self._param_items(model)
+        if not items:
+            return
+        grad_items = self._gradient_items(model)
+        if self.print_header and not self._header_done:
+            cols = ["iteration", "score"]
+            for key, _ in items:
+                cols += self._stat_cols(key)
+            for key, _ in grad_items:
+                cols += self._stat_cols(key, "grad_")
+            self.printer("\t".join(cols))
+            self._header_done = True
+        vals = [str(iteration), f"{model.score_:.6f}"]
+        for _, arr in items:
+            vals += self._stat_vals(arr)
+        for _, arr in grad_items:
+            vals += self._stat_vals(arr)
+        self.printer("\t".join(vals))
 
 
 class SleepyTrainingListener(TrainingListener):

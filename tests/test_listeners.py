@@ -1,0 +1,197 @@
+"""Listener family additions: ComposableIterationListener,
+ParamAndGradientIterationListener, EvaluativeListener callbacks.
+
+Reference: optimize/listeners/ComposableIterationListener.java,
+ParamAndGradientIterationListener.java, callbacks/EvaluationCallback.java.
+"""
+
+class TestComposableListener:
+    def test_fans_out_to_children(self):
+        from deeplearning4j_tpu.optimize.listeners import (
+            ComposableIterationListener, TrainingListener)
+
+        calls = []
+
+        class Probe(TrainingListener):
+            def __init__(self, tag):
+                self.tag = tag
+
+            def iteration_done(self, model, iteration, epoch):
+                calls.append(("it", self.tag, iteration))
+
+            def on_epoch_end(self, model):
+                calls.append(("ep", self.tag))
+
+        comp = ComposableIterationListener(Probe("a"), Probe("b"))
+        comp.iteration_done(None, 3, 0)
+        comp.on_epoch_end(None)
+        assert calls == [("it", "a", 3), ("it", "b", 3), ("ep", "a"), ("ep", "b")]
+
+
+class TestParamAndGradientListener:
+    def test_stats_lines(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.listeners import (
+            ParamAndGradientIterationListener)
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 8)]
+        lines = []
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        probe = DataSet(x, y)
+        listener = ParamAndGradientIterationListener(
+            iterations=2, print_min_max=True, gradient_batch=probe,
+            printer=lines.append)
+        net.add_listeners(listener)
+        for _ in range(4):
+            net.fit(x, y)
+        assert lines[0].startswith("iteration\tscore")
+        assert "0_W_mean_mag" in lines[0] and "1_b_max" in lines[0]
+        assert "0_W_grad_mean_mag" in lines[0]  # gradient half present
+        assert len(lines) >= 3  # header + iterations 0 and 2
+        # gradient values are finite numbers
+        first = lines[1].split("\t")
+        assert all(np.isfinite(float(v)) for v in first[1:])
+
+
+class TestEvaluativeCallback:
+    def test_callback_fires_after_eval(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.listeners import EvaluativeListener
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 8)]
+        it = ListDataSetIterator(DataSet(x, y), 8)
+        seen = []
+        listener = EvaluativeListener(it, frequency=1, unit="epoch",
+                                      printer=lambda s: None)
+        listener.set_callback(lambda l, evals, m: seen.append(evals))
+        net.add_listeners(listener)
+        net.fit(it, epochs=2)
+        assert len(seen) == 2
+        # callback always receives a LIST (IEvaluation[] parity), even in
+        # default single-Evaluation mode
+        assert isinstance(seen[0], list) and hasattr(seen[0][0], "accuracy")
+
+
+class TestEarlyStoppingListener:
+    def test_hooks_fire(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingListener, EarlyStoppingTrainer, InMemoryModelSaver,
+            MaxEpochsTerminationCondition)
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 16)]
+        it = ListDataSetIterator(DataSet(x, y), 8)
+
+        events = []
+
+        class Probe(EarlyStoppingListener):
+            def on_start(self, config, model):
+                events.append("start")
+
+            def on_epoch(self, epoch, score, config, model):
+                events.append(("epoch", epoch))
+
+            def on_completion(self, result):
+                events.append(("done", result.total_epochs))
+
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(it),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            model_saver=InMemoryModelSaver())
+        trainer = EarlyStoppingTrainer(es, net, it)
+        trainer.set_listener(Probe())
+        trainer.fit()
+        assert events[0] == "start"
+        assert ("epoch", 0) in events and ("epoch", 2) in events
+        assert events[-1][0] == "done"
+
+    def test_on_epoch_only_fires_with_fresh_score(self):
+        import numpy as np
+        from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+        from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers.core import DenseLayer
+        from deeplearning4j_tpu.nn.layers.output import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.optimize.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingListener, EarlyStoppingTrainer, InMemoryModelSaver,
+            MaxEpochsTerminationCondition)
+
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2)).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(16, 3).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.random.RandomState(1).randint(0, 2, 16)]
+        it = ListDataSetIterator(DataSet(x, y), 8)
+        scores = []
+
+        class Probe(EarlyStoppingListener):
+            def on_epoch(self, epoch, score, config, model):
+                scores.append((epoch, score))
+
+        es = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(it),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(4)],
+            model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=2)
+        trainer = EarlyStoppingTrainer(es, net, it)
+        trainer.set_listener(Probe())
+        trainer.fit()
+        # fires only on evaluated epochs (1 and 3), never with NaN
+        assert [e for e, _ in scores] == [1, 3]
+        assert all(np.isfinite(s) for _, s in scores)
+
+
+class TestSimpleClassificationResults:
+    def test_rank_result(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.simple import RankClassificationResult
+        probs = np.asarray([[0.1, 0.7, 0.2], [0.5, 0.2, 0.3]])
+        r = RankClassificationResult(probs, labels=["a", "b", "c"])
+        assert r.max_outcomes() == ["b", "a"]
+        assert r.ranked_labels_for_row(0) == ["b", "c", "a"]
+        assert r.probability_for_row(0, 1) == np.float32(0.7)
+        # default integer labels, vector input
+        r2 = RankClassificationResult(np.asarray([0.2, 0.8]))
+        assert r2.max_outcomes() == ["1"]
+
+    def test_binary_result(self):
+        import numpy as np
+        from deeplearning4j_tpu.nn.simple import BinaryClassificationResult
+        b = BinaryClassificationResult(decision_threshold=0.6)
+        out = b.decide(np.asarray([[0.5, 0.5], [0.2, 0.8]]))
+        np.testing.assert_array_equal(out, [0, 1])
+        weighted = BinaryClassificationResult(
+            decision_threshold=0.5, class_weights=[1.0, 3.0])
+        # weighting pushes borderline probabilities over the threshold
+        assert weighted.decide(np.asarray([0.3]))[0] == 1
