@@ -1,0 +1,219 @@
+"""Orbax-backed checkpointing — the TPU-scale checkpoint path.
+
+The zip checkpoints (``util/model_serializer.py``) are the
+DL4J-compatible interchange (``ModelSerializer.java:51`` role). This
+module adds the idiomatic JAX path on top: the same model state (params
++ updater state + training counters + config JSON) stored through
+``orbax.checkpoint``, which brings sharding-aware, per-host-parallel,
+optionally async writes and step-managed retention — what checkpointing
+a multi-host mesh actually needs (CheckpointListener rotation at pod
+scale). Restore returns a fully wired MultiLayerNetwork /
+ComputationGraph, like the zip restore does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = [
+    "save_model",
+    "restore_model",
+    "AsyncSaveHandle",
+    "OrbaxCheckpointManager",
+]
+
+_CONFIG_FILE = "model_config.json"
+
+
+# -- shared helpers ----------------------------------------------------------
+
+def _write_meta(model, directory: str) -> None:
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    kind = "mln" if isinstance(model, MultiLayerNetwork) else "graph"
+    with open(os.path.join(directory, _CONFIG_FILE), "w") as fh:
+        json.dump({"kind": kind, "conf": json.loads(model.conf.to_json())},
+                  fh)
+
+
+def _build_model(directory: str):
+    with open(os.path.join(directory, _CONFIG_FILE)) as fh:
+        meta = json.load(fh)
+    if meta["kind"] == "mln":
+        from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        model = MultiLayerNetwork(
+            MultiLayerConfiguration.from_dict(meta["conf"]))
+    else:
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        model = ComputationGraph(
+            ComputationGraphConfiguration.from_dict(meta["conf"]))
+    model.init()  # allocates the target pytree structure + updaters
+    return model
+
+
+def _state_pytree(model, with_updater: bool) -> Dict[str, Any]:
+    state: Dict[str, Any] = {"params": model.params, "states": model.states}
+    if with_updater and model.updater_states is not None:
+        state["updater_states"] = model.updater_states
+    state["counters"] = {"iteration": np.asarray(model.iteration),
+                         "epoch": np.asarray(model.epoch)}
+    return state
+
+
+def _template_for(model, metadata) -> Dict[str, Any]:
+    """Restore template matching what the checkpoint actually contains
+    (a template/on-disk structure mismatch is a hard orbax error)."""
+    has_updater = True
+    try:
+        tree = getattr(metadata, "item_metadata", metadata)
+        tree = getattr(tree, "tree", tree)
+        if hasattr(tree, "keys"):
+            has_updater = "updater_states" in tree
+    except Exception:  # noqa: BLE001 - fall back to assuming present
+        pass
+    return _state_pytree(model, with_updater=has_updater)
+
+
+def _apply_state(model, state: Dict[str, Any], load_updater: bool):
+    model.params = state["params"]
+    model.states = state["states"]
+    if load_updater and "updater_states" in state:
+        model.updater_states = state["updater_states"]
+    counters = state.get("counters", {})
+    model.iteration = int(np.asarray(counters.get("iteration", 0)))
+    model.epoch = int(np.asarray(counters.get("epoch", 0)))
+    return model
+
+
+# -- one-shot save / restore -------------------------------------------------
+
+class AsyncSaveHandle:
+    """Returned by ``save_model(..., async_write=True)``: the write runs
+    in the background; call :meth:`wait_until_finished` (or use as a
+    context manager) before reading the checkpoint or exiting."""
+
+    def __init__(self, checkpointer):
+        self._ckptr = checkpointer
+
+    def wait_until_finished(self) -> None:
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+            self._ckptr.close()
+            self._ckptr = None
+
+    def __enter__(self) -> "AsyncSaveHandle":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait_until_finished()
+
+
+def save_model(model, directory: str, *, save_updater: bool = True,
+               async_write: bool = False) -> Optional[AsyncSaveHandle]:
+    """Write a model checkpoint into ``directory`` via orbax.
+
+    ``async_write=True`` returns an :class:`AsyncSaveHandle` as soon as
+    the device arrays are snapshotted — training continues while bytes
+    hit disk; call ``handle.wait_until_finished()`` before relying on
+    the files. Synchronous saves return None.
+    """
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    _write_meta(model, directory)
+
+    state = _state_pytree(model, with_updater=save_updater)
+    target = os.path.join(directory, "state")
+    if async_write:
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr.save(target, args=ocp.args.StandardSave(state), force=True)
+        return AsyncSaveHandle(ckptr)
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        ckptr.save(target, args=ocp.args.StandardSave(state), force=True)
+    return None
+
+
+def restore_model(directory: str, *, load_updater: bool = True):
+    """Restore a model saved by :func:`save_model`. Works regardless of
+    whether the checkpoint contains updater state."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    model = _build_model(directory)
+    target = os.path.join(directory, "state")
+    with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        template = _template_for(model, ckptr.metadata(target))
+        state = ckptr.restore(target,
+                              args=ocp.args.StandardRestore(template))
+    return _apply_state(model, state, load_updater)
+
+
+# -- step-managed rotation ---------------------------------------------------
+
+class OrbaxCheckpointManager:
+    """Step-managed rotation over orbax (CheckpointListener's
+    keepLast/saveEvery semantics at pod scale, via
+    ``ocp.CheckpointManager``)."""
+
+    def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
+                 save_interval_steps: int = 1):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=max(1, save_interval_steps))
+        self._mgr = ocp.CheckpointManager(self.directory,
+                                          options=self._options)
+        self._meta_written = False
+
+    def save(self, step: int, model, *, save_updater: bool = True) -> bool:
+        """Save at ``step`` (skipped when the interval says so; returns
+        whether a save happened)."""
+        import orbax.checkpoint as ocp
+        if not self._meta_written:
+            _write_meta(model, self.directory)
+            self._meta_written = True
+        return self._mgr.save(
+            step, args=ocp.args.StandardSave(
+                _state_pytree(model, with_updater=save_updater)))
+
+    def all_steps(self) -> List[int]:
+        return list(self._mgr.all_steps())
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(self, step: Optional[int] = None, *,
+                load_updater: bool = True):
+        """Restore the model at ``step`` (default: latest)."""
+        import orbax.checkpoint as ocp
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise ValueError(f"no checkpoints in {self.directory}")
+        model = _build_model(self.directory)
+        template = _template_for(model, self._mgr.item_metadata(step))
+        state = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template))
+        return _apply_state(model, state, load_updater)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "OrbaxCheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
