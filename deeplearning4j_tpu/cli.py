@@ -192,15 +192,58 @@ def profile_main(argv: Optional[List[str]] = None):
             _json.dump(report, fh, indent=1)
 
 
+def evaluate_main(argv: Optional[List[str]] = None):
+    """``evaluate`` subcommand: load any supported model artifact
+    (ModelGuesser chain) and print classification metrics over a CSV
+    dataset — the ``MultiLayerNetwork.evaluate`` flow from the shell."""
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu evaluate")
+    p.add_argument("--model", required=True,
+                   help="model artifact (own/DL4J zip or Keras h5)")
+    p.add_argument("--csv", required=True, help="delimited dataset file")
+    p.add_argument("--label-index", type=int, default=-1,
+                   help="label column (default: last column)")
+    p.add_argument("--classes", type=int, required=True,
+                   help="number of classes")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--delimiter", default=",")
+    p.add_argument("--skip-lines", type=int, default=0)
+    p.add_argument("--top-n", type=int, default=1)
+    args = p.parse_args(argv)
+
+    from deeplearning4j_tpu.datasets.records import (
+        CSVRecordReader,
+        RecordReaderDataSetIterator,
+    )
+    from deeplearning4j_tpu.util.model_guesser import load_model_guess
+
+    model = load_model_guess(args.model)
+    reader = CSVRecordReader(args.csv, skip_lines=args.skip_lines,
+                             delimiter=args.delimiter)
+    label_index = args.label_index
+    if label_index < 0:
+        first = reader.next_record()
+        reader.reset()
+        label_index = len(first) - 1  # a Record is a list of values
+    it = RecordReaderDataSetIterator(reader, args.batch,
+                                     label_index=label_index,
+                                     num_possible_labels=args.classes)
+    e = model.evaluate(it, top_n=args.top_n)
+    print(e.stats())
+    return e
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m deeplearning4j_tpu.cli "
-              "{train,nn-server,cloud-setup,profile} ...")
+              "{train,evaluate,nn-server,cloud-setup,profile} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "train":
         parallel_wrapper_main(rest)
+        return 0
+    if cmd == "evaluate":
+        evaluate_main(rest)
         return 0
     if cmd == "profile":
         profile_main(rest)
@@ -217,8 +260,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cmd == "cloud-setup":
         cluster_setup_main(rest)
         return 0
-    print(f"unknown command {cmd!r}; expected 'train', 'nn-server', "
-          "'cloud-setup', or 'profile'")
+    print(f"unknown command {cmd!r}; expected 'train', 'evaluate', "
+          "'nn-server', 'cloud-setup', or 'profile'")
     return 2
 
 

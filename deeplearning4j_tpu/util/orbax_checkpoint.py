@@ -32,16 +32,24 @@ _CONFIG_FILE = "model_config.json"
 # -- shared helpers ----------------------------------------------------------
 
 def _write_meta(model, directory: str) -> None:
+    # primary-host-gated like orbax's own writes (every process calling
+    # save on a pod must not race on the shared meta file), and through
+    # epath so gs:// checkpoint directories work like local ones
+    import jax
+    if jax.process_index() != 0:
+        return
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from etils import epath
     kind = "mln" if isinstance(model, MultiLayerNetwork) else "graph"
-    with open(os.path.join(directory, _CONFIG_FILE), "w") as fh:
-        json.dump({"kind": kind, "conf": json.loads(model.conf.to_json())},
-                  fh)
+    d = epath.Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / _CONFIG_FILE).write_text(
+        json.dumps({"kind": kind, "conf": json.loads(model.conf.to_json())}))
 
 
 def _build_model(directory: str):
-    with open(os.path.join(directory, _CONFIG_FILE)) as fh:
-        meta = json.load(fh)
+    from etils import epath
+    meta = json.loads((epath.Path(directory) / _CONFIG_FILE).read_text())
     if meta["kind"] == "mln":
         from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
         from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
@@ -127,7 +135,6 @@ def save_model(model, directory: str, *, save_updater: bool = True,
     import orbax.checkpoint as ocp
 
     directory = os.path.abspath(directory)
-    os.makedirs(directory, exist_ok=True)
     _write_meta(model, directory)
 
     state = _state_pytree(model, with_updater=save_updater)

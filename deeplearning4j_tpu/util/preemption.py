@@ -38,12 +38,16 @@ class PreemptionHandler:
 
     def __init__(self, model, checkpoint_path: str,
                  signals=(signal.SIGTERM,), exit_after_save: bool = False,
-                 on_preempt: Optional[Callable] = None):
+                 on_preempt: Optional[Callable] = None,
+                 backend: str = "zip"):
+        if backend not in ("zip", "orbax"):
+            raise ValueError("backend must be 'zip' or 'orbax'")
         self.model = model
         self.checkpoint_path = str(checkpoint_path)
         self.signals = tuple(signals)
         self.exit_after_save = exit_after_save
         self.on_preempt = on_preempt
+        self.backend = backend
         self._previous = {}
         self.preempted = threading.Event()
         self.saved = threading.Event()
@@ -51,6 +55,23 @@ class PreemptionHandler:
 
     # -- checkpointing ---------------------------------------------------
     def save(self) -> str:
+        if self.backend == "orbax":
+            # step-rotated saves (max_to_keep=2): a plain overwrite would
+            # delete the previous good checkpoint BEFORE the new one
+            # commits (orbax force=True rmtree), so a grace window
+            # expiring mid-write would lose both. With rotation the old
+            # step survives until the new step finalizes.
+            from deeplearning4j_tpu.util.orbax_checkpoint import (
+                OrbaxCheckpointManager,
+            )
+            if getattr(self, "_orbax_mgr", None) is None:
+                self._orbax_mgr = OrbaxCheckpointManager(
+                    self.checkpoint_path, max_to_keep=2)
+            step = (self._orbax_mgr.latest_step() or 0) + 1
+            self._orbax_mgr.save(step, self.model)
+            self._orbax_mgr.wait_until_finished()
+            self.saved.set()
+            return self.checkpoint_path
         import zipfile
 
         from deeplearning4j_tpu.util import model_serializer
@@ -72,8 +93,24 @@ class PreemptionHandler:
 
     @staticmethod
     def resume(checkpoint_path: str):
-        """(model, state_dict) from a preemption checkpoint."""
+        """(model, state_dict) from a preemption checkpoint — a zip file
+        or an orbax directory, detected from what is on disk. Orbax
+        directories restore the latest COMMITTED step (a save torn by the
+        grace window falls back to the preceding one)."""
         import zipfile
+
+        if os.path.isdir(str(checkpoint_path)):
+            from deeplearning4j_tpu.util.orbax_checkpoint import (
+                OrbaxCheckpointManager,
+                restore_model,
+            )
+            with OrbaxCheckpointManager(str(checkpoint_path)) as mgr:
+                if mgr.latest_step() is not None:
+                    model = mgr.restore()
+                else:  # plain save_model layout (no step dirs)
+                    model = restore_model(str(checkpoint_path))
+            return model, {"iteration": model.iteration,
+                           "epoch": model.epoch}
 
         from deeplearning4j_tpu.util import model_serializer
 

@@ -139,3 +139,39 @@ class TestManagerRotation:
         with OrbaxCheckpointManager(str(tmp_path / "empty")) as mgr:
             with pytest.raises(ValueError):
                 mgr.restore()
+
+
+class TestPreemptionOrbaxBackend:
+    def test_orbax_backend_save_resume(self, tmp_path):
+        from deeplearning4j_tpu.util.preemption import PreemptionHandler
+        net, x, y = trained_net()
+        d = str(tmp_path / "preempt_ckpt")
+        handler = PreemptionHandler(net, d, backend="orbax")
+        handler.save()
+        model, state = PreemptionHandler.resume(d)
+        assert state["iteration"] == net.iteration
+        np.testing.assert_allclose(np.asarray(model.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_orbax_backend_second_save_keeps_previous(self, tmp_path):
+        """Rotation means the earlier checkpoint is still on disk while
+        (and after) the new one commits — the grace-window durability the
+        zip path gets from tmp+os.replace."""
+        import os
+        from deeplearning4j_tpu.util.preemption import PreemptionHandler
+        net, x, y = trained_net()
+        d = str(tmp_path / "preempt2")
+        handler = PreemptionHandler(net, d, backend="orbax")
+        handler.save()
+        net.fit(x, y)
+        handler.save()
+        steps = sorted(int(p) for p in os.listdir(d) if p.isdigit())
+        assert len(steps) == 2  # both checkpoints retained (max_to_keep=2)
+        model, state = PreemptionHandler.resume(d)
+        assert state["iteration"] == net.iteration  # latest wins
+
+    def test_bad_backend_rejected(self, tmp_path):
+        from deeplearning4j_tpu.util.preemption import PreemptionHandler
+        net, _, _ = trained_net(steps=1)
+        with pytest.raises(ValueError):
+            PreemptionHandler(net, str(tmp_path / "x"), backend="tape")
