@@ -23,6 +23,7 @@ from deeplearning4j_tpu.zoo.models import (
     TextGenerationLSTM,
     TinyYOLO,
     TransformerEncoder,
+    VisionTransformer,
     TransformerLM,
     VGG16,
     VGG19,
@@ -39,6 +40,7 @@ __all__ = [
     "AlexNet", "Darknet19", "FaceNetNN4Small2", "GoogLeNet",
     "InceptionResNetV1", "LeNet", "ResNet50", "SimpleCNN",
     "TextGenerationLSTM", "TinyYOLO", "TransformerEncoder", "TransformerLM",
+    "VisionTransformer",
     "VGG16", "VGG19", "YOLO2", "beam_search", "generate",
     "generate_on_device", "lm_labels",
 ]

@@ -1118,3 +1118,86 @@ class TransformerEncoder(ZooModel):
                     "pool")
         g.set_outputs("out")
         return g.build()
+
+
+@register_zoo_model
+class VisionTransformer(ZooModel):
+    """ViT (Dosovitskiy et al. 2020) — patchify-and-attend image
+    classifier (no reference counterpart; the conv+attention composition
+    the snapshot-era zoo could not express, built entirely from this
+    framework's vertices).
+
+    Images [N,H,W,C] → non-overlapping patch embedding (Conv2D with
+    kernel == stride == patch) → [N, T=HW/p², d_model] token sequence →
+    learned positions → encoder blocks (the TransformerEncoder blocks)
+    → mean-pool → classifier. Defaults are ViT-Tiny-ish for trainability
+    at test scale; pass ViT-B/16 numbers (12 layers, d_model 768,
+    12 heads, d_ff 3072, patch 16, image 224) for the paper shape.
+    """
+
+    def __init__(self, num_labels: int = 10, seed: int = 123,
+                 image_size: int = 32, channels: int = 3,
+                 patch_size: int = 4, n_layers: int = 4,
+                 d_model: int = 64, n_heads: int = 4, d_ff: int = 128):
+        super().__init__(num_labels, seed)
+        if image_size % patch_size != 0:
+            raise ValueError(
+                f"image_size {image_size} not divisible by patch_size "
+                f"{patch_size}")
+        self.image_size = image_size
+        self.channels = channels
+        self.patch_size = patch_size
+        self.n_layers = n_layers
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.d_ff = d_ff
+
+    @property
+    def num_patches(self) -> int:
+        side = self.image_size // self.patch_size
+        return side * side
+
+    def meta_data(self):
+        return ModelMetaData(
+            ((self.channels, self.image_size, self.image_size),), 1, "cnn")
+
+    def conf(self):
+        from deeplearning4j_tpu.nn.layers import (
+            GlobalPoolingLayer,
+            PositionalEmbeddingLayer,
+        )
+        from deeplearning4j_tpu.nn.vertices import ReshapeVertex
+
+        g = (NeuralNetConfiguration.builder().seed(self.seed)
+             .weight_init("xavier").updater(Adam(3e-4)).graph_builder()
+             .add_inputs("image")
+             .set_input_types(InputType.convolutional(
+                 self.image_size, self.image_size, self.channels)))
+        # one conv with kernel == stride IS the patch embedding: each
+        # patch hits the MXU as a single [p*p*C, d_model] matmul
+        g.add_layer("patch",
+                    ConvolutionLayer(n_out=self.d_model,
+                                     kernel_size=(self.patch_size,
+                                                  self.patch_size),
+                                     stride=(self.patch_size,
+                                             self.patch_size),
+                                     activation="identity"), "image")
+        g.add_vertex("tokens",
+                     ReshapeVertex(shape=(self.num_patches, self.d_model)),
+                     "patch")
+        g.add_layer("pos",
+                    PositionalEmbeddingLayer(n_in=self.d_model,
+                                             max_len=self.num_patches),
+                    "tokens")
+        src = "pos"
+        for i in range(self.n_layers):
+            src = transformer_encoder_block(g, f"block{i}", src,
+                                            self.d_model, self.n_heads,
+                                            self.d_ff)
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), src)
+        g.add_layer("out", OutputLayer(n_in=self.d_model,
+                                       n_out=self.num_labels,
+                                       activation="softmax", loss="mcxent"),
+                    "pool")
+        g.set_outputs("out")
+        return g.build()

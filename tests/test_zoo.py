@@ -117,7 +117,8 @@ class TestZooInstantiation:
         assert reference_13 <= set(names)
         # ... plus the attention-era additions with no reference counterpart
         assert set(names) - reference_13 == {"transformerencoder",
-                                             "transformerlm"}
+                                             "transformerlm",
+                                             "visiontransformer"}
         m = ModelSelector.select("lenet", num_labels=10)
         assert isinstance(m, LeNet)
         with pytest.raises(KeyError):
@@ -148,6 +149,34 @@ class TestTransformerEncoder:
         for _ in range(60):
             net.fit(x, y)
         assert net.score_ < s0
+
+    def test_vit_patchifies_and_learns(self):
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.zoo.models import VisionTransformer
+
+        m = VisionTransformer(num_labels=2, image_size=16, patch_size=4,
+                              n_layers=2, d_model=32, n_heads=4, d_ff=64,
+                              seed=7)
+        assert m.num_patches == 16
+        net = ComputationGraph(m.conf()).init()
+        rng = np.random.default_rng(0)
+        # learnable toy task: class = bright top-left patch
+        x = rng.normal(0, 0.3, size=(64, 16, 16, 3)).astype(np.float32)
+        cls = rng.integers(0, 2, 64)
+        x[cls == 1, :4, :4, :] += 2.0
+        y = np.eye(2, dtype=np.float32)[cls]
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        s0 = net.score(DataSet(x, y))
+        for _ in range(40):
+            net.fit(x, y)
+        assert net.score_ < s0
+        pred = np.asarray(net.output_single(x)).argmax(1)
+        assert (pred == cls).mean() > 0.9
+
+    def test_vit_rejects_indivisible_patch(self):
+        from deeplearning4j_tpu.zoo.models import VisionTransformer
+        with pytest.raises(ValueError):
+            VisionTransformer(image_size=30, patch_size=4)
 
     def test_selector_has_transformer(self):
         from deeplearning4j_tpu.zoo.zoo_model import ModelSelector
