@@ -1,0 +1,196 @@
+"""Config-serde fuzz: random layer stacks must survive JSON/YAML round
+trips with identical outputs.
+
+The reference locks its config format with per-release regression tests
+(RegressionTest050..080); this sweep goes further — a seeded generator
+builds random MultiLayerConfigurations across the layer/regularizer/
+preprocessor space, and for each one asserts that from_json(to_json)
+builds a network whose outputs match the original exactly (same init
+seed). Catches any layer field missing from to_dict/from_dict.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import (
+    MultiLayerConfiguration,
+    NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+from deeplearning4j_tpu.nn.layers.core import (
+    ActivationLayer,
+    DenseLayer,
+    DropoutLayer,
+    ElementWiseMultiplicationLayer,
+    PReLULayer,
+)
+from deeplearning4j_tpu.nn.layers.norm import BatchNormalizationLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer
+from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+ACTIVATIONS = ["relu", "tanh", "sigmoid", "elu", "swish", "gelu",
+               "leakyrelu", "softsign"]
+UPDATERS = ["sgd", "adam", "rmsprop", "nesterovs", "adagrad", "amsgrad"]
+
+
+def random_dense_conf(rng: random.Random) -> MultiLayerConfiguration:
+    b = (NeuralNetConfiguration.builder()
+         .seed(rng.randint(0, 10_000))
+         .updater(rng.choice(UPDATERS))
+         .weight_init(rng.choice(["xavier", "relu", "lecun_normal"]))
+         .l2(rng.choice([0.0, 1e-4]))
+         .list())
+    width = rng.choice([4, 8, 12])
+    n_hidden = rng.randint(1, 4)
+    b.layer(DenseLayer(n_in=5, n_out=width,
+                       activation=rng.choice(ACTIVATIONS),
+                       dropout=rng.choice([None, 0.9])))
+    for _ in range(n_hidden - 1):
+        kind = rng.randrange(4)
+        if kind == 0:
+            b.layer(DenseLayer(n_in=width, n_out=width,
+                               activation=rng.choice(ACTIVATIONS)))
+        elif kind == 1:
+            b.layer(ActivationLayer(activation=rng.choice(ACTIVATIONS)))
+        elif kind == 2:
+            b.layer(ElementWiseMultiplicationLayer(n_in=width, n_out=width))
+        else:
+            b.layer(PReLULayer(input_shape=(width,)))
+    b.layer(OutputLayer(n_in=width, n_out=3))
+    if rng.random() < 0.3:
+        b.input_pre_processor(0, rng.choice(["zero_mean", "standardize"]))
+    return b.build()
+
+
+def random_conv_conf(rng: random.Random) -> MultiLayerConfiguration:
+    b = (NeuralNetConfiguration.builder()
+         .seed(rng.randint(0, 10_000))
+         .updater(rng.choice(UPDATERS))
+         .list())
+    channels = rng.choice([4, 8])
+    b.layer(ConvolutionLayer(n_out=channels, kernel_size=(3, 3),
+                             convolution_mode="same",
+                             activation=rng.choice(ACTIVATIONS)))
+    if rng.random() < 0.5:
+        b.layer(BatchNormalizationLayer())
+    if rng.random() < 0.5:
+        b.layer(SubsamplingLayer())
+    if rng.random() < 0.3:
+        b.layer(DropoutLayer(dropout=0.8))
+    b.layer(DenseLayer(n_out=8, activation="relu"))
+    b.layer(OutputLayer(n_out=3))
+    b.set_input_type(InputType.convolutional(8, 8, 2))
+    return b.build()
+
+
+def assert_round_trip_identical(conf: MultiLayerConfiguration, x: np.ndarray,
+                                seed_idx: int, fmt: str) -> None:
+    if fmt == "json":
+        restored = MultiLayerConfiguration.from_json(conf.to_json())
+    else:
+        restored = MultiLayerConfiguration.from_yaml(conf.to_yaml())
+    a = MultiLayerNetwork(conf)
+    a.init(seed=42)
+    b = MultiLayerNetwork(restored)
+    b.init(seed=42)
+    np.testing.assert_allclose(
+        np.asarray(a.output(x)), np.asarray(b.output(x)), rtol=1e-6,
+        err_msg=f"case {seed_idx} ({fmt}): round-tripped config diverges\n"
+                f"{conf.to_json()}")
+    # training one step keeps them identical too (updaters serialized)
+    y = np.eye(3, dtype=np.float32)[np.arange(len(x)) % 3]
+    a.fit(x, y)
+    b.fit(x, y)
+    np.testing.assert_allclose(
+        np.asarray(a.output(x)), np.asarray(b.output(x)), rtol=1e-5,
+        err_msg=f"case {seed_idx} ({fmt}): diverged after one train step")
+
+
+class TestConfigFuzz:
+    @pytest.mark.parametrize("case", range(12))
+    def test_dense_stacks_round_trip(self, case):
+        rng = random.Random(1000 + case)
+        conf = random_dense_conf(rng)
+        x = np.random.RandomState(case).randn(6, 5).astype(np.float32)
+        fmt = "yaml" if case % 3 == 0 else "json"
+        assert_round_trip_identical(conf, x, case, fmt)
+
+    @pytest.mark.parametrize("case", range(8))
+    def test_conv_stacks_round_trip(self, case):
+        rng = random.Random(2000 + case)
+        conf = random_conv_conf(rng)
+        x = np.random.RandomState(case).randn(4, 8, 8, 2).astype(np.float32)
+        fmt = "yaml" if case % 3 == 0 else "json"
+        assert_round_trip_identical(conf, x, case, fmt)
+
+
+def random_graph_conf(rng: random.Random):
+    """Random DAG: dense chain with skip connections through merge or
+    elementwise vertices."""
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+
+    width = rng.choice([4, 8])
+    g = (NeuralNetConfiguration.builder()
+         .seed(rng.randint(0, 10_000))
+         .updater(rng.choice(UPDATERS))
+         .graph_builder()
+         .add_inputs("in"))
+    g.add_layer("d0", DenseLayer(n_in=5, n_out=width,
+                                 activation=rng.choice(ACTIVATIONS)), "in")
+    prev = "d0"
+    for i in range(1, rng.randint(2, 4)):
+        g.add_layer(f"d{i}", DenseLayer(n_in=width, n_out=width,
+                                        activation=rng.choice(ACTIVATIONS)),
+                    prev)
+        if rng.random() < 0.5:
+            # skip connection: combine with the previous activation
+            kind = rng.randrange(2)
+            if kind == 0:
+                g.add_vertex(f"skip{i}", ElementWiseVertex(op="add"),
+                             prev, f"d{i}")
+                prev = f"skip{i}"
+            else:
+                g.add_vertex(f"skip{i}", MergeVertex(), prev, f"d{i}")
+                g.add_layer(f"proj{i}", DenseLayer(n_in=2 * width,
+                                                   n_out=width,
+                                                   activation="identity"),
+                            f"skip{i}")
+                prev = f"proj{i}"
+        else:
+            prev = f"d{i}"
+    g.add_layer("out", OutputLayer(n_in=width, n_out=3), prev)
+    g.set_outputs("out")
+    return g.build()
+
+
+class TestGraphConfigFuzz:
+    @pytest.mark.parametrize("case", range(10))
+    def test_random_dags_round_trip(self, case):
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ComputationGraphConfiguration,
+        )
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+
+        rng = random.Random(3000 + case)
+        conf = random_graph_conf(rng)
+        restored = ComputationGraphConfiguration.from_json(conf.to_json())
+        a = ComputationGraph(conf)
+        a.init(seed=42)
+        b = ComputationGraph(restored)
+        b.init(seed=42)
+        x = np.random.RandomState(case).randn(6, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(a.output_single(x)), np.asarray(b.output_single(x)),
+            rtol=1e-6,
+            err_msg=f"graph case {case}: round-trip diverges\n{conf.to_json()}")
+        y = np.eye(3, dtype=np.float32)[np.arange(6) % 3]
+        a.fit(x, y)
+        b.fit(x, y)
+        np.testing.assert_allclose(
+            np.asarray(a.output_single(x)), np.asarray(b.output_single(x)),
+            rtol=1e-5,
+            err_msg=f"graph case {case}: diverged after one train step")
