@@ -194,3 +194,55 @@ class TestGraphConfigFuzz:
             np.asarray(a.output_single(x)), np.asarray(b.output_single(x)),
             rtol=1e-5,
             err_msg=f"graph case {case}: diverged after one train step")
+
+
+class TestGradientFuzz:
+    """Randomized composite gradient checks (GradientCheckUtil backbone,
+    fuzzed): tiny random stacks must pass f64 central differences."""
+
+    @pytest.mark.parametrize("case", range(5))
+    def test_random_dense_stack_gradients(self, case):
+        from deeplearning4j_tpu.util.gradient_check import check_model_gradients
+
+        rng = random.Random(4000 + case)
+        b = (NeuralNetConfiguration.builder()
+             .seed(rng.randint(0, 10_000))
+             .updater("sgd")
+             .activation(rng.choice(["tanh", "sigmoid", "softsign"]))
+             .l2(rng.choice([0.0, 1e-3]))
+             .list())
+        width = 3
+        b.layer(DenseLayer(n_in=3, n_out=width))
+        if rng.random() < 0.5:
+            b.layer(ElementWiseMultiplicationLayer(n_in=width, n_out=width))
+        if rng.random() < 0.5:
+            b.layer(PReLULayer(input_shape=(width,)))
+        b.layer(OutputLayer(n_in=width, n_out=2,
+                            loss=rng.choice(["mcxent",
+                                             "negativeloglikelihood"])))
+        net = MultiLayerNetwork(b.build())
+        net.init(seed=7)
+        x = np.random.RandomState(case).randn(4, 3)
+        y = np.eye(2)[np.random.RandomState(case + 1).randint(0, 2, 4)]
+        assert check_model_gradients(net, x, y, subset=40, seed=case)
+
+    @pytest.mark.parametrize("case", range(3))
+    def test_random_conv_stack_gradients(self, case):
+        from deeplearning4j_tpu.util.gradient_check import check_model_gradients
+
+        rng = random.Random(5000 + case)
+        b = (NeuralNetConfiguration.builder()
+             .seed(rng.randint(0, 10_000))
+             .updater("sgd").activation("tanh").list())
+        b.layer(ConvolutionLayer(n_out=2, kernel_size=(2, 2),
+                                 convolution_mode="same"))
+        if rng.random() < 0.5:
+            b.layer(SubsamplingLayer())
+        b.layer(DenseLayer(n_out=4))
+        b.layer(OutputLayer(n_out=2))
+        b.set_input_type(InputType.convolutional(4, 4, 2))
+        net = MultiLayerNetwork(b.build())
+        net.init(seed=7)
+        x = np.random.RandomState(case).randn(3, 4, 4, 2)
+        y = np.eye(2)[np.random.RandomState(case + 1).randint(0, 2, 3)]
+        assert check_model_gradients(net, x, y, subset=40, seed=case)
