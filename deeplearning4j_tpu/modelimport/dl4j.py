@@ -459,6 +459,7 @@ def convert_dl4j_layer(type_name: str, cfg: dict):
                   kernel_size=_pair(_get(cfg, "kernelSize"), (3, 3)),
                   stride=_pair(_get(cfg, "stride"), (1, 1)),
                   padding=_pair(_get(cfg, "padding"), (0, 0)),
+                  dilation=_pair(_get(cfg, "dilation"), (1, 1)),
                   convolution_mode=_conv_mode(_get(cfg, "convolutionMode")))
         cls = L.Convolution1DLayer if t == "convolution1d" else L.ConvolutionLayer
         if t == "convolution1d":
@@ -471,6 +472,7 @@ def convert_dl4j_layer(type_name: str, cfg: dict):
                   pooling_type="avg" if pt in ("avg", "average") else pt,
                   kernel_size=_pair(_get(cfg, "kernelSize"), (2, 2)),
                   stride=_pair(_get(cfg, "stride"), (2, 2)),
+                  padding=_pair(_get(cfg, "padding"), (0, 0)),
                   convolution_mode=_conv_mode(_get(cfg, "convolutionMode")))
         return (L.Subsampling1DLayer if t == "subsampling1d"
                 else L.SubsamplingLayer)(**kw)
@@ -584,6 +586,10 @@ def import_dl4j_configuration(source: str):
     if bp == "TruncatedBPTT":
         lb.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
     built = lb.build()
+    # 1.0-era training counters (absent in 0.9.x zips): carried so a
+    # resumed Adam/Nadam keeps its bias-correction step count
+    built._dl4j_counters = (int(d.get("iterationCount", 0)),
+                            int(d.get("epochCount", 0)))
     for k, v in (d.get("inputPreProcessors") or {}).items():
         fn = _convert_dl4j_preprocessor(v)
         if fn is not None:
@@ -1090,6 +1096,9 @@ def restore_multi_layer_network(path: str, load_params: bool = True,
         if load_params and "coefficients.bin" in names:
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)
+        counters = getattr(net.conf, "_dl4j_counters", None)
+        if counters is not None:
+            net.iteration, net.epoch = counters
         if (load_params and load_updater and "updaterState.bin" in names):
             upd = read_nd4j_array_from_bytes(z.read("updaterState.bin"))
             apply_updater_state(net, upd)

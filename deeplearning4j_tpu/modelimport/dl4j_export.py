@@ -1,0 +1,386 @@
+"""Reverse migration: write our models as DL4J-format checkpoints.
+
+``modelimport/dl4j.py`` reads the reference's ``ModelSerializer`` zips;
+this module writes them — ``configuration.json`` in the DL4J
+MultiLayerConfiguration JSON dialect, ``coefficients.bin`` in the ND4J
+binary layout, and ``updaterState.bin`` for known updater classes
+(``ModelSerializer.java:51`` writeModel's file set) — so a model trained
+here can be handed back to a DL4J deployment and keep fine-tuning.
+
+Scope: MultiLayerNetworks over the common layer families (Dense, Output/
+RnnOutput, Convolution, Subsampling, BatchNormalization, Embedding,
+Activation, Dropout, LSTM/GravesLSTM, SimpleRnn, GlobalPooling, Loss).
+Anything the dialect cannot express raises loudly (IDropout objects,
+lr schedules, other layer types). The emitted dialect is exactly what
+``import_dl4j_configuration`` parses, and the flattened parameter vector
+follows ``_dl4j_param_specs`` (ParamInitializer order, 'f' weight order,
+HWIO→OIHW conv kernels, BN running stats in-line). Layout boundaries
+(cnn→ff flatten with its NHWC→NCHW dense-weight row permutation, and
+DL4J's rnn↔ff preprocessors around time-distributed dense layers) are
+emitted as ``inputPreProcessors``.
+
+Like the reader, the wire format is implemented from the 0.9.x layout;
+round trips are verified through the reader (no ND4J runtime exists in
+this image to cross-check).
+"""
+
+from __future__ import annotations
+
+import json
+import zipfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    _UPDATER_STATE_SLOTS,
+    UnsupportedDl4jConfigurationException,
+    _dl4j_param_specs,
+    _updater_blocks,
+)
+from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
+
+__all__ = ["export_multi_layer_network"]
+
+_ACT_CLASS = {
+    "relu": "ActivationReLU", "relu6": "ActivationReLU6",
+    "sigmoid": "ActivationSigmoid", "tanh": "ActivationTanH",
+    "softmax": "ActivationSoftmax", "identity": "ActivationIdentity",
+    "linear": "ActivationIdentity", "softplus": "ActivationSoftPlus",
+    "softsign": "ActivationSoftSign", "elu": "ActivationELU",
+    "selu": "ActivationSELU", "cube": "ActivationCube",
+    "hardsigmoid": "ActivationHardSigmoid",
+    "hardtanh": "ActivationHardTanH", "leakyrelu": "ActivationLReLU",
+    "rationaltanh": "ActivationRationalTanh", "swish": "ActivationSwish",
+    "gelu": "ActivationGELU", "thresholdedrelu": "ActivationThresholdedReLU",
+}
+
+_LOSS_CLASS = {
+    "mcxent": "LossMCXENT", "negativeloglikelihood": "LossMCXENT",
+    "mse": "LossMSE", "xent": "LossBinaryXENT", "l1": "LossL1",
+    "mae": "LossL1", "kld": "LossKLD", "poisson": "LossPoisson",
+    "cosine_proximity": "LossCosineProximity", "hinge": "LossHinge",
+    "squared_hinge": "LossSquaredHinge",
+    "msle": "LossMeanSquaredLogarithmicError",
+}
+
+_CONV_MODE = {"truncate": "Truncate", "same": "Same", "strict": "Strict"}
+
+# layers whose DL4J implementation consumes/produces time-major 3-D input
+_RNN_NATURED = {"LSTMLayer", "GravesLSTMLayer", "GravesBidirectionalLSTMLayer",
+                "SimpleRnnLayer", "GRULayer", "RnnOutputLayer"}
+_FF_NATURED = {"DenseLayer", "OutputLayer", "ElementWiseMultiplicationLayer",
+               "EmbeddingLayer"}
+
+
+def _activation_entry(act) -> Optional[dict]:
+    if act is None:
+        return None
+    params: Dict[str, float] = {}
+    if isinstance(act, tuple):
+        act, params = act[0], dict(act[1])
+    key = str(act).lower()
+    cls = _ACT_CLASS.get(key)
+    if cls is None:
+        raise UnsupportedDl4jConfigurationException(
+            f"cannot express activation {act!r} in the DL4J dialect")
+    out = {"@class": f"org.nd4j.linalg.activations.impl.{cls}"}
+    out.update(params)
+    return out
+
+
+def _loss_entry(loss) -> dict:
+    cls = _LOSS_CLASS.get(str(loss).lower())
+    if cls is None:
+        raise UnsupportedDl4jConfigurationException(
+            f"cannot express loss {loss!r} in the DL4J dialect")
+    return {"@class": f"org.nd4j.linalg.lossfunctions.impl.{cls}"}
+
+
+def _updater_entry(u) -> Optional[dict]:
+    if u is None:
+        return None
+    name = type(u).__name__
+    table = {"Sgd": "Sgd", "Adam": "Adam", "AdaMax": "AdaMax",
+             "AdaDelta": "AdaDelta", "AdaGrad": "AdaGrad", "Nadam": "Nadam",
+             "Nesterovs": "Nesterovs", "RmsProp": "RmsProp", "NoOp": "NoOp"}
+    if name not in table:
+        raise UnsupportedDl4jConfigurationException(
+            f"cannot express updater {name} in the DL4J dialect")
+    out: Dict[str, object] = {
+        "@class": f"org.nd4j.linalg.learning.config.{table[name]}"}
+    lr = getattr(u, "learning_rate", None)
+    if isinstance(lr, (int, float)):
+        out["learningRate"] = float(lr)
+    elif lr is not None:
+        raise UnsupportedDl4jConfigurationException(
+            "cannot export a learning-rate SCHEDULE to the DL4J dialect")
+    for ours, theirs in (("beta1", "beta1"), ("beta2", "beta2"),
+                         ("momentum", "momentum"),
+                         ("rms_decay", "rmsDecay")):
+        v = getattr(u, ours, None)
+        if isinstance(v, (int, float)):
+            out[theirs] = float(v)
+    return out
+
+
+def _layer_entry(layer, updater_entry) -> Tuple[str, dict]:
+    """(WRAPPER_OBJECT type name, cfg dict) for one layer."""
+    cls = type(layer).__name__
+    cfg: Dict[str, object] = {}
+    if getattr(layer, "name", None):
+        cfg["layerName"] = layer.name
+    act = _activation_entry(getattr(layer, "activation", None))
+    if act is not None:
+        cfg["activationFn"] = act
+    if updater_entry is not None:
+        cfg["iUpdater"] = updater_entry
+    drop = getattr(layer, "dropout", None)
+    if drop is not None:
+        if not isinstance(drop, (int, float)):
+            raise UnsupportedDl4jConfigurationException(
+                f"cannot express dropout object {type(drop).__name__} in "
+                "the DL4J dialect (scalar keep probabilities only)")
+        cfg["dropOut"] = float(drop)
+    # per-layer regularization / init travel with the layer so handback
+    # fine-tuning keeps training the same objective
+    for ours, theirs in (("l1", "l1"), ("l2", "l2"),
+                         ("l1_bias", "l1Bias"), ("l2_bias", "l2Bias")):
+        v = getattr(layer, ours, None)
+        if v:
+            cfg[theirs] = float(v)
+    wi = getattr(layer, "weight_init", None)
+    if wi:
+        cfg["weightInit"] = str(wi).upper()
+
+    def ff():
+        cfg["nin"] = int(layer.n_in)
+        cfg["nout"] = int(layer.n_out)
+
+    if cls == "DenseLayer":
+        ff()
+        cfg["hasBias"] = bool(getattr(layer, "has_bias", True))
+        return "dense", cfg
+    if cls in ("OutputLayer", "RnnOutputLayer"):
+        ff()
+        cfg["lossFn"] = _loss_entry(layer.loss)
+        return ("output" if cls == "OutputLayer" else "rnnoutput"), cfg
+    if cls == "LossLayer":
+        cfg["lossFn"] = _loss_entry(layer.loss)
+        return "loss", cfg
+    if cls == "ConvolutionLayer":
+        ff()
+        cfg["kernelSize"] = list(layer.kernel_size)
+        cfg["stride"] = list(layer.stride)
+        cfg["padding"] = list(layer.padding)
+        cfg["dilation"] = list(layer.dilation)
+        cfg["convolutionMode"] = _CONV_MODE[layer.convolution_mode]
+        return "convolution", cfg
+    if cls == "SubsamplingLayer":
+        cfg["poolingType"] = layer.pooling_type.upper()
+        cfg["kernelSize"] = list(layer.kernel_size)
+        cfg["stride"] = list(layer.stride)
+        cfg["padding"] = list(layer.padding)
+        cfg["convolutionMode"] = _CONV_MODE[layer.convolution_mode]
+        return "subsampling", cfg
+    if cls == "BatchNormalizationLayer":
+        cfg["eps"] = float(layer.eps)
+        cfg["decay"] = float(layer.decay)
+        cfg["nin"] = cfg["nout"] = int(layer.n_in)
+        if getattr(layer, "lock_gamma_beta", False):
+            cfg["lockGammaBeta"] = True
+        return "batchNormalization", cfg
+    if cls == "EmbeddingLayer":
+        ff()
+        cfg["hasBias"] = bool(getattr(layer, "has_bias", False))
+        return "embedding", cfg
+    if cls == "ActivationLayer":
+        return "activation", cfg
+    if cls == "DropoutLayer":
+        return "dropout", cfg
+    if cls in ("LSTMLayer", "GravesLSTMLayer"):
+        ff()
+        cfg["forgetGateBiasInit"] = float(
+            getattr(layer, "forget_gate_bias_init", 1.0))
+        return ("LSTM" if cls == "LSTMLayer" else "gravesLSTM"), cfg
+    if cls == "SimpleRnnLayer":
+        ff()
+        return "SimpleRnn", cfg
+    if cls == "GlobalPoolingLayer":
+        cfg["poolingType"] = layer.pooling_type.upper()
+        return "GlobalPooling", cfg
+    raise UnsupportedDl4jConfigurationException(
+        f"export does not support layer type {cls}")
+
+
+def _walk_boundaries(conf):
+    """(preprocessor entries, cnn→ff weight-permutation map).
+
+    Tracks the DL4J-side data nature (ff / rnn / cnn) through the stack
+    and emits the preprocessor DL4J needs at every transition:
+    ``cnnToFeedForward`` (with the NHWC→NCHW weight permutation recorded
+    for the receiving dense layer), ``rnnToFeedForward`` /
+    ``feedForwardToRnn`` around time-distributed dense layers. Boundary
+    kinds with no DL4J spelling here (cnn3d / cnn_seq / cnn_flat inputs)
+    raise instead of silently exporting a wrong checkpoint.
+    """
+    pre: Dict[str, dict] = {}
+    permute: Dict[int, Tuple[int, int, int]] = {}
+    it = conf.input_type
+    if it is not None and it.kind not in ("ff", "rnn", "cnn"):
+        raise UnsupportedDl4jConfigurationException(
+            f"cannot export input type kind {it.kind!r} to the DL4J "
+            "dialect (ff / rnn / cnn only)")
+    nature = it.kind if it is not None else None
+    for i, layer in enumerate(conf.layers):
+        cls = type(layer).__name__
+        fed = conf.layer_input_types[i]
+        if cls in _RNN_NATURED:
+            if nature == "ff":
+                pre[str(i)] = {"feedForwardToRnn": {}}
+            elif nature == "cnn":
+                raise UnsupportedDl4jConfigurationException(
+                    "cnn→rnn boundary export is not supported")
+            nature = "rnn"
+        elif cls in _FF_NATURED:
+            if nature == "cnn":
+                if fed is None or fed.kind != "ff" or it is None:
+                    raise UnsupportedDl4jConfigurationException(
+                        f"unsupported cnn boundary into layer {i} ({cls})")
+                pre[str(i)] = {"cnnToFeedForward": {
+                    "inputHeight": it.height, "inputWidth": it.width,
+                    "numChannels": it.channels}}
+                permute[i] = (it.height, it.width, it.channels)
+            elif nature == "rnn":
+                # time-distributed dense: DL4J flattens time around it
+                pre[str(i)] = {"rnnToFeedForward": {}}
+            nature = "ff"
+        elif cls in ("ConvolutionLayer", "SubsamplingLayer"):
+            if nature not in ("cnn", None):
+                raise UnsupportedDl4jConfigurationException(
+                    f"{nature}→cnn boundary export is not supported")
+            nature = "cnn"
+        elif cls == "GlobalPoolingLayer":
+            nature = "ff"  # DL4J GlobalPooling consumes rnn/cnn natively
+        # shape-preserving layers (BN, Activation, Dropout) keep nature
+        if it is not None and fed is not None:
+            it = layer.output_type(fed)
+    return pre, permute
+
+
+def _flatten_segment(layer, name, order, arr) -> np.ndarray:
+    """Inverse of _iter_param_slices' reshape/convert for one value."""
+    a = np.asarray(arr, np.float32)
+    cls = type(layer).__name__
+    if cls == "ConvolutionLayer" and name == "W":
+        # ours HWIO → DL4J OIHW, then C-order flatten
+        return np.transpose(a, (3, 2, 0, 1)).reshape(-1)
+    if order == "f":
+        return a.reshape(-1, order="F")
+    return a.reshape(-1)
+
+
+def _permute_nhwc_rows_to_nchw(w: np.ndarray, h: int, wdt: int,
+                               c: int) -> np.ndarray:
+    """Reorder dense-weight ROWS from our NHWC flatten index
+    (h·W·C + w·C + c) to DL4J's NCHW (c·H·W + h·W + w)."""
+    idx = np.arange(h * wdt * c).reshape(h, wdt, c)      # ours: [h][w][c]
+    nchw_order = idx.transpose(2, 0, 1).reshape(-1)      # walk c, h, w
+    return np.asarray(w)[nchw_order]
+
+
+def _export_value(layer, i, name, order, container, permute) -> np.ndarray:
+    arr = np.asarray(container[name], np.float32)
+    if i in permute and name == "W":
+        arr = _permute_nhwc_rows_to_nchw(arr, *permute[i])
+    return _flatten_segment(layer, name, order, arr)
+
+
+def _updater_state_vector(net, permute) -> Optional[np.ndarray]:
+    """updaterState.bin contents in DL4J's block/slot layout, or None
+    when some updater class has no known slot layout."""
+    blocks = _updater_blocks(net.conf, net._updaters)
+    segs: List[np.ndarray] = []
+    layers = {i: l for i, l in (enumerate(net.conf.layers)
+                                if hasattr(net.conf, "layers") else [])}
+    for u, block in blocks:
+        slots = _UPDATER_STATE_SLOTS.get(type(u).__name__)
+        if slots is None:
+            return None
+        for slot in slots:
+            for i, name, _shape, order, _convert in block:
+                state = net.updater_states[i][name]
+                if slot not in state:
+                    return None
+                segs.append(_export_value(layers[i], i, name, order,
+                                          {name: state[slot]}, permute))
+    if not segs:
+        return np.zeros(0, np.float32)
+    return np.concatenate(segs)
+
+
+def export_multi_layer_network(net, path: str,
+                               save_updater: bool = True) -> None:
+    """Write ``net`` as a DL4J-format zip (configuration.json +
+    coefficients.bin + updaterState.bin); re-importable via
+    ``restore_multi_layer_network`` and structured for DL4J's own
+    ``ModelSerializer``."""
+    conf = net.conf
+    if conf.input_pre_processors:
+        raise UnsupportedDl4jConfigurationException(
+            "explicit input_pre_processor specs have no DL4J serialized "
+            "form; export supports automatically inferred boundaries only")
+
+    g = conf.global_conf
+    default_updater = _updater_entry(g.updater) or {
+        "@class": "org.nd4j.linalg.learning.config.Sgd",
+        "learningRate": 0.1}
+
+    confs: List[dict] = []
+    for i, layer in enumerate(conf.layers):
+        upd = _updater_entry(layer.updater) or default_updater
+        t, cfg = _layer_entry(layer, upd)
+        entry: Dict[str, object] = {"layer": {t: cfg}}
+        if i == 0:
+            entry["seed"] = int(g.seed)
+        confs.append(entry)
+
+    pre, permute = _walk_boundaries(conf)
+
+    doc: Dict[str, object] = {"backprop": True, "confs": confs,
+                              # 1.0-era MultiLayerConfiguration counters:
+                              # Adam/Nadam bias correction needs the step
+                              # count to resume identically
+                              "iterationCount": int(net.iteration),
+                              "epochCount": int(net.epoch)}
+    if conf.backprop_type == "truncated_bptt":
+        doc["backpropType"] = "TruncatedBPTT"
+        doc["tbpttFwdLength"] = int(conf.tbptt_fwd_length)
+        doc["tbpttBackLength"] = int(conf.tbptt_bwd_length)
+    else:
+        doc["backpropType"] = "Standard"
+    if pre:
+        doc["inputPreProcessors"] = pre
+
+    # flattened parameter vector in DL4J layout order
+    segments: List[np.ndarray] = []
+    for i, layer in enumerate(conf.layers):
+        for name, _shape, order, _convert, target in _dl4j_param_specs(layer):
+            container = net.params[i] if target == "param" else net.states[i]
+            if name not in container:
+                raise UnsupportedDl4jConfigurationException(
+                    f"layer {i} has no value for expected param {name!r}")
+            segments.append(_export_value(layer, i, name, order,
+                                          container, permute))
+    flat = (np.concatenate(segments) if segments
+            else np.zeros(0, np.float32)).reshape(1, -1)
+
+    upd_flat = _updater_state_vector(net, permute) if save_updater else None
+
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("configuration.json", json.dumps(doc, indent=1))
+        z.writestr("coefficients.bin", nd4j_array_to_bytes(flat, order="c"))
+        if upd_flat is not None and upd_flat.size:
+            z.writestr("updaterState.bin",
+                       nd4j_array_to_bytes(upd_flat.reshape(1, -1),
+                                           order="c"))

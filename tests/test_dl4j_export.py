@@ -1,0 +1,283 @@
+"""Reverse migration: our model -> DL4J-format zip -> re-import equality.
+
+The exporter emits the same dialect the importer parses (the only DL4J
+oracle in this image), so every test is an export->import round trip
+asserting output equality — including the NHWC->NCHW dense-weight
+permutation at cnn->ff boundaries, BN running stats, and LSTM layouts.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import (
+    UnsupportedDl4jConfigurationException,
+    restore_multi_layer_network,
+)
+from deeplearning4j_tpu.modelimport.dl4j_export import export_multi_layer_network
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer, EmbeddingLayer
+from deeplearning4j_tpu.nn.layers.norm import BatchNormalizationLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTMLayer, LSTMLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def round_trip(net, x, tmp_path, train_steps=0, y=None):
+    if train_steps:
+        for _ in range(train_steps):
+            net.fit(x, y)
+    path = str(tmp_path / "export.zip")
+    export_multi_layer_network(net, path)
+    again = restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(again.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=2e-5, atol=1e-6)
+    return again
+
+
+class TestDenseExport:
+    def test_dense_round_trip(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-3))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(DenseLayer(n_in=6, n_out=5, activation="relu"))
+                .layer(OutputLayer(n_in=5, n_out=3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        round_trip(net, x, tmp_path)
+
+    def test_trained_state_survives(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_in=6, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        round_trip(net, x, tmp_path, train_steps=5, y=y)
+
+
+class TestConvExport:
+    def test_conv_bn_pool_dense_round_trip(self, tmp_path):
+        """The hard case: conv -> BN (running stats) -> pool -> dense over
+        a cnn->ff boundary (NHWC->NCHW weight permutation)."""
+        conf = (NeuralNetConfiguration.builder().seed(7).updater("adam")
+                .list()
+                .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(BatchNormalizationLayer())
+                .layer(SubsamplingLayer())
+                .layer(DenseLayer(n_out=10, activation="tanh"))
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        x = rng.randn(6, 8, 8, 2).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 6)]
+        # train so BN running stats are non-trivial and must survive
+        again = round_trip(net, x, tmp_path, train_steps=4, y=y)
+        np.testing.assert_allclose(
+            np.asarray(again.states[1]["mean"]),
+            np.asarray(net.states[1]["mean"]), rtol=1e-5)
+
+    def test_resumed_training_tracks_through_boundary(self, tmp_path):
+        """Fine-tuning after handback == uninterrupted training, through
+        the cnn->ff boundary (outputs compared — the imported net stores
+        the boundary dense W in NCHW row order by design)."""
+        conf = (NeuralNetConfiguration.builder().seed(11).updater("adam")
+                .l2(1e-4).list()
+                .layer(ConvolutionLayer(n_out=6, kernel_size=(3, 3),
+                                        activation="relu"))
+                .layer(BatchNormalizationLayer())
+                .layer(SubsamplingLayer())
+                .layer(DenseLayer(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=4))
+                .set_input_type(InputType.convolutional(10, 8, 3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 10, 8, 3).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.randint(0, 4, 16)]
+        for _ in range(6):
+            net.fit(x, y)
+        path = str(tmp_path / "hb.zip")
+        export_multi_layer_network(net, path)
+        back = restore_multi_layer_network(path)
+        assert back.iteration == net.iteration  # Adam bias correction
+        for _ in range(4):
+            net.fit(x, y)
+            back.fit(x, y)
+        np.testing.assert_allclose(np.asarray(back.output(x)),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_asymmetric_spatial_dims_permutation(self, tmp_path):
+        """H != W makes a wrong NHWC/NCHW permutation impossible to hide."""
+        conf = (NeuralNetConfiguration.builder().seed(9).updater("sgd")
+                .list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(2, 2),
+                                        activation="tanh"))
+                .layer(DenseLayer(n_out=6, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(6, 4, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(2).randn(4, 6, 4, 2).astype(np.float32)
+        round_trip(net, x, tmp_path)
+
+
+class TestRecurrentExport:
+    @pytest.mark.parametrize("layer_cls", [LSTMLayer, GravesLSTMLayer])
+    def test_lstm_round_trip(self, layer_cls, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+                .list()
+                .layer(layer_cls(n_in=3, n_out=5))
+                .layer(RnnOutputLayer(n_in=5, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(3).randn(4, 6, 3).astype(np.float32)
+        round_trip(net, x, tmp_path)
+
+    def test_updater_state_survives_handback(self, tmp_path):
+        """Adam m/v moments travel in updaterState.bin: resumed training
+        after export->import == uninterrupted training."""
+        conf = (NeuralNetConfiguration.builder().seed(3).updater("adam")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_in=6, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        for _ in range(5):
+            net.fit(x, y)
+        path = str(tmp_path / "hb.zip")
+        export_multi_layer_network(net, path)
+        import zipfile
+        assert "updaterState.bin" in zipfile.ZipFile(path).namelist()
+        resumed = restore_multi_layer_network(path)
+        for _ in range(3):
+            net.fit(x, y)
+            resumed.fit(x, y)
+        for a, b in zip(net.params, resumed.params):
+            for k in a:
+                np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                           rtol=2e-4, atol=1e-6)
+
+    def test_dense_between_rnns_emits_preprocessors(self, tmp_path):
+        """DL4J needs rnnToFeedForward/feedForwardToRnn around a
+        time-distributed dense layer; the export records them."""
+        import json, zipfile
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+                .list()
+                .layer(LSTMLayer(n_in=3, n_out=5))
+                .layer(DenseLayer(n_in=5, n_out=4, activation="tanh"))
+                .layer(RnnOutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        path = str(tmp_path / "td.zip")
+        export_multi_layer_network(net, path)
+        doc = json.loads(zipfile.ZipFile(path).read("configuration.json"))
+        pre = doc["inputPreProcessors"]
+        assert "rnnToFeedForward" in pre["1"]
+        assert "feedForwardToRnn" in pre["2"]
+        x = np.random.RandomState(3).randn(4, 6, 3).astype(np.float32)
+        again = restore_multi_layer_network(path)
+        np.testing.assert_allclose(np.asarray(again.output(x)),
+                                   np.asarray(net.output(x)), rtol=2e-5,
+                                   atol=1e-6)
+
+    def test_dilation_and_pool_padding_round_trip(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(9).updater("sgd")
+                .list()
+                .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                        dilation=(2, 2), activation="tanh"))
+                .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                        padding=(1, 1)))
+                .layer(DenseLayer(n_out=6, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.convolutional(10, 10, 2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(2).randn(3, 10, 10, 2).astype(np.float32)
+        again = round_trip(net, x, tmp_path)
+        assert again.conf.layers[0].dilation == (2, 2)
+        assert again.conf.layers[1].padding == (1, 1)
+
+    def test_regularization_travels(self, tmp_path):
+        import json, zipfile
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .l2(1e-3).list()
+                .layer(DenseLayer(n_in=3, n_out=4, l1=1e-4))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        path = str(tmp_path / "reg.zip")
+        export_multi_layer_network(net, path)
+        doc = json.loads(zipfile.ZipFile(path).read("configuration.json"))
+        d0 = doc["confs"][0]["layer"]["dense"]
+        assert d0["l1"] == pytest.approx(1e-4)
+        assert d0["l2"] == pytest.approx(1e-3)  # global default applied
+        again = restore_multi_layer_network(path)
+        assert again.conf.layers[0].l1 == pytest.approx(1e-4)
+        assert again.conf.layers[0].l2 == pytest.approx(1e-3)
+
+    def test_embedding_lstm_tbptt_config(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+                .list()
+                .layer(EmbeddingLayer(n_in=20, n_out=8))
+                .layer(LSTMLayer(n_in=8, n_out=6))
+                .layer(RnnOutputLayer(n_in=6, n_out=4))
+                .t_bptt_length(5)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(4).randint(0, 20, (3, 7)).astype(np.float32)
+        again = round_trip(net, x, tmp_path)
+        assert again.conf.backprop_type == "truncated_bptt"
+        assert again.conf.tbptt_fwd_length == 5
+
+
+class TestExportRejections:
+    def test_unsupported_layer_raises(self, tmp_path):
+        from deeplearning4j_tpu.nn.layers.vae import VariationalAutoencoderLayer
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .list()
+                .layer(VariationalAutoencoderLayer(
+                    n_in=4, n_out=2, encoder_layer_sizes=(4,),
+                    decoder_layer_sizes=(4,)))
+                .layer(OutputLayer(n_in=2, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            export_multi_layer_network(net, str(tmp_path / "x.zip"))
+
+    def test_dropout_object_raises(self, tmp_path):
+        from deeplearning4j_tpu.nn.dropout import AlphaDropout
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=4, dropout=AlphaDropout(0.9)))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            export_multi_layer_network(net, str(tmp_path / "x.zip"))
+
+    def test_explicit_preprocessor_raises(self, tmp_path):
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=3, n_out=4))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .input_pre_processor(0, "standardize")
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            export_multi_layer_network(net, str(tmp_path / "x.zip"))
