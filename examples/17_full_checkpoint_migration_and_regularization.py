@@ -103,10 +103,48 @@ def dictionary_tokenization():
     print("embedding for すもも:", w2v.get_word_vector("すもも")[:4], "…")
 
 
+def reverse_migration():
+    """Hand a model trained HERE back to a DL4J deployment: export as a
+    ModelSerializer zip (config dialect + coefficients.bin +
+    updaterState.bin) and prove the round trip."""
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.modelimport.dl4j import restore_multi_layer_network
+    from deeplearning4j_tpu.modelimport.dl4j_export import (
+        export_multi_layer_network,
+    )
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.builder().seed(3).updater("adam").list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 32)]
+    for _ in range(5):
+        net.fit(x, y)
+    with tempfile.TemporaryDirectory() as d:
+        path = f"{d}/handback.zip"
+        export_multi_layer_network(net, path)
+        back = restore_multi_layer_network(path)
+        back.fit(x, y)  # Adam moments travelled: fine-tuning continues
+        net.fit(x, y)
+        diff = float(np.abs(np.asarray(net.output(x))
+                            - np.asarray(back.output(x))).max())
+        print(f"reverse migration: resumed-training output diff {diff:.2e}")
+
+
 def main():
     restore_and_finetune()
     regularization_family()
     dictionary_tokenization()
+    reverse_migration()
 
 
 if __name__ == "__main__":
