@@ -29,6 +29,17 @@ __all__ = [
 _CONFIG_FILE = "model_config.json"
 
 
+def _canonical_dir(directory: str) -> str:
+    """Absolutize local paths; leave URL-style paths (``gs://…``)
+    untouched — ``os.path.abspath('gs://b/ckpt')`` would mangle them
+    into ``<cwd>/gs:/b/ckpt`` and silently redirect cloud saves to a
+    bogus local directory. Scheme paths flow through etils ``epath``,
+    which handles both existence checks and mkdir for remote stores."""
+    if "://" in directory:
+        return directory
+    return os.path.abspath(directory)
+
+
 # -- shared helpers ----------------------------------------------------------
 
 def _write_meta(model, directory: str) -> None:
@@ -134,7 +145,7 @@ def save_model(model, directory: str, *, save_updater: bool = True,
     """
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
+    directory = _canonical_dir(directory)
     _write_meta(model, directory)
 
     state = _state_pytree(model, with_updater=save_updater)
@@ -153,7 +164,7 @@ def restore_model(directory: str, *, load_updater: bool = True):
     whether the checkpoint contains updater state."""
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
+    directory = _canonical_dir(directory)
     model = _build_model(directory)
     target = os.path.join(directory, "state")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
@@ -173,8 +184,9 @@ class OrbaxCheckpointManager:
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
                  save_interval_steps: int = 1):
         import orbax.checkpoint as ocp
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        from etils import epath
+        self.directory = _canonical_dir(directory)
+        epath.Path(self.directory).mkdir(parents=True, exist_ok=True)
         self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=max(1, save_interval_steps))

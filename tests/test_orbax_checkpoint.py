@@ -175,3 +175,17 @@ class TestPreemptionOrbaxBackend:
         net, _, _ = trained_net(steps=1)
         with pytest.raises(ValueError):
             PreemptionHandler(net, str(tmp_path / "x"), backend="tape")
+
+
+class TestSchemePaths:
+    def test_url_paths_not_mangled(self):
+        """gs:// checkpoint dirs must not be abspath'd into <cwd>/gs:/…
+        (they route through etils epath instead)."""
+        from deeplearning4j_tpu.util.orbax_checkpoint import _canonical_dir
+        assert _canonical_dir("gs://bucket/ckpt") == "gs://bucket/ckpt"
+        assert _canonical_dir("s3://bucket/ckpt") == "s3://bucket/ckpt"
+
+    def test_local_paths_absolutized(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.util.orbax_checkpoint import _canonical_dir
+        monkeypatch.chdir(tmp_path)
+        assert _canonical_dir("ckpt") == str(tmp_path / "ckpt")
