@@ -13,9 +13,13 @@ instance. All impls are pure jnp functions of (x, rng) so they trace into
 the jitted train step; at inference they are identity, matching the
 reference's train-only application.
 
-Dropout SCHEDULES (``pSchedule``) are not supported: the iteration counter
-is not threaded into layer forward calls by design (it would fragment the
-compiled step). Passing a Schedule raises.
+Dropout SCHEDULES (``Dropout.java:45,68`` ``pSchedule``, and the
+``rateSchedule``/``stddevSchedule`` twins on the Gaussian variants) are
+supported: any scalar field also accepts a ``Schedule``, evaluated at the
+device-resident ``(iteration, epoch)`` tick the train step carries
+(``nn/tick.py``) — the schedule compiles INTO the step as a function of
+the tick tracers, so no retrace or step fragmentation occurs. Outside a
+train step (probe forwards) a schedule evaluates at tick (0, 0).
 """
 
 from __future__ import annotations
@@ -37,13 +41,38 @@ def register_dropout(cls):
     return cls
 
 
-def _check_no_schedule(value, what: str):
+def _coerce_scalar(value):
+    """Config-time normalization: Schedules (and their serde dicts) pass
+    through; everything else becomes a float."""
     from deeplearning4j_tpu.nn.updaters import Schedule
     if isinstance(value, Schedule):
-        raise ValueError(
-            f"{what} schedules are not supported (the iteration counter is "
-            "not threaded into layer forwards); use a fixed value")
+        return value
+    if isinstance(value, dict) and "@schedule" in value:
+        return Schedule.from_dict(value)
     return float(value)
+
+
+def _now(value, lo=None, hi=None):
+    """Apply-time value: floats as-is; Schedules evaluated at the train
+    step's device tick (a tracer inside jit — the schedule fuses into the
+    compiled step). ``lo``/``hi`` clamp SCHEDULED values into the field's
+    valid range — a schedule that wanders out of range (e.g. a decay
+    driving retain-p to 0) cannot be rejected loudly inside jit the way a
+    bad fixed float is at construction, so it saturates instead of
+    producing division-by-zero NaNs."""
+    from deeplearning4j_tpu.nn.updaters import Schedule
+    if isinstance(value, Schedule):
+        from deeplearning4j_tpu.nn.tick import current_schedule_tick
+        v = value.value(*current_schedule_tick())
+        if lo is not None or hi is not None:
+            v = jnp.clip(v, lo, hi)
+        return v
+    return value
+
+
+def _is_schedule(value) -> bool:
+    from deeplearning4j_tpu.nn.updaters import Schedule
+    return isinstance(value, Schedule)
 
 
 @dataclasses.dataclass
@@ -54,7 +83,12 @@ class IDropout:
         raise NotImplementedError
 
     def to_dict(self) -> dict:
-        d = {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                continue
+            d[f.name] = v.to_dict() if _is_schedule(v) else v
         d["@dropout"] = type(self).__name__
         return d
 
@@ -62,28 +96,34 @@ class IDropout:
     def from_dict(d: dict) -> "IDropout":
         d = dict(d)
         cls = DROPOUT_REGISTRY[d.pop("@dropout")]
-        return cls(**d)
+        return cls(**d)  # scalar fields re-inflate schedules via _coerce_scalar
 
 
 @register_dropout
 @dataclasses.dataclass
 class Dropout(IDropout):
     """Inverted dropout (``Dropout.java``, via ``DropOutInverted``):
-    keep with probability ``p``, scale kept values by ``1/p``."""
+    keep with probability ``p``, scale kept values by ``1/p``. ``p`` may
+    be a ``Schedule`` (``Dropout.java:45`` ``pSchedule`` on the retain
+    probability), evaluated at the step's device tick."""
 
     p: float = 0.5
 
     def __post_init__(self):
-        self.p = _check_no_schedule(self.p, "Dropout")
-        if not (0.0 < self.p <= 1.0):
+        self.p = _coerce_scalar(self.p)
+        if not _is_schedule(self.p) and not (0.0 < self.p <= 1.0):
             raise ValueError(
                 f"Activation retain probability must be in (0, 1]: got {self.p}")
 
     def apply(self, x, rng, train):
-        if not train or self.p >= 1.0 or rng is None:
+        if not train or rng is None:
             return x
-        keep = jax.random.bernoulli(rng, self.p, x.shape)
-        return jnp.where(keep, x / self.p, jnp.zeros((), x.dtype))
+        if not _is_schedule(self.p) and self.p >= 1.0:
+            return x
+        p = _now(self.p, lo=1e-6, hi=1.0)
+        keep = jax.random.bernoulli(rng, p, x.shape)
+        return jnp.where(keep, x / jnp.asarray(p, x.dtype),
+                         jnp.zeros((), x.dtype))
 
 
 @register_dropout
@@ -99,8 +139,8 @@ class AlphaDropout(IDropout):
     lambda_: float = 1.0507009873554804  # DEFAULT_LAMBDA
 
     def __post_init__(self):
-        self.p = _check_no_schedule(self.p, "AlphaDropout")
-        if not (0.0 < self.p <= 1.0):
+        self.p = _coerce_scalar(self.p)
+        if not _is_schedule(self.p) and not (0.0 < self.p <= 1.0):
             raise ValueError(
                 f"Activation retain probability must be in (0, 1]: got {self.p}")
 
@@ -118,13 +158,18 @@ class AlphaDropout(IDropout):
         return -self.a(p) * (1.0 - p) * self.alpha_prime
 
     def apply(self, x, rng, train):
-        if not train or self.p >= 1.0 or rng is None:
+        if not train or rng is None:
             return x
-        d = jax.random.bernoulli(rng, self.p, x.shape)
-        a = jnp.asarray(self.a(self.p), x.dtype)
-        b = jnp.asarray(self.b(self.p), x.dtype)
-        ap = jnp.asarray(self.alpha_prime, x.dtype)
-        return a * jnp.where(d, x, ap) + b
+        if not _is_schedule(self.p) and self.p >= 1.0:
+            return x
+        p = _now(self.p, lo=1e-6, hi=1.0)
+        ap = self.alpha_prime
+        # jnp forms of a(p)/b(p) so a scheduled p (a tracer) flows through
+        a = 1.0 / jnp.sqrt(p + ap * ap * p * (1.0 - p))
+        b = -a * (1.0 - p) * ap
+        d = jax.random.bernoulli(rng, p, x.shape)
+        return (jnp.asarray(a, x.dtype) * jnp.where(d, x, jnp.asarray(ap, x.dtype))
+                + jnp.asarray(b, x.dtype))
 
 
 @register_dropout
@@ -136,15 +181,19 @@ class GaussianDropout(IDropout):
     rate: float = 0.5
 
     def __post_init__(self):
-        self.rate = _check_no_schedule(self.rate, "GaussianDropout")
-        if not (0.0 <= self.rate < 1.0):
+        self.rate = _coerce_scalar(self.rate)
+        if not _is_schedule(self.rate) and not (0.0 <= self.rate < 1.0):
             raise ValueError(f"rate must be in [0, 1): got {self.rate}")
 
     def apply(self, x, rng, train):
-        if not train or self.rate == 0.0 or rng is None:
+        if not train or rng is None:
             return x
-        stdev = math.sqrt(self.rate / (1.0 - self.rate))
-        noise = 1.0 + stdev * jax.random.normal(rng, x.shape, x.dtype)
+        if not _is_schedule(self.rate) and self.rate == 0.0:
+            return x
+        rate = _now(self.rate, lo=0.0, hi=1.0 - 1e-6)
+        stdev = jnp.sqrt(rate / (1.0 - rate))
+        noise = 1.0 + jnp.asarray(stdev, x.dtype) * jax.random.normal(
+            rng, x.shape, x.dtype)
         return x * noise
 
 
@@ -157,12 +206,15 @@ class GaussianNoise(IDropout):
     stddev: float = 0.1
 
     def __post_init__(self):
-        self.stddev = _check_no_schedule(self.stddev, "GaussianNoise")
+        self.stddev = _coerce_scalar(self.stddev)
 
     def apply(self, x, rng, train):
-        if not train or self.stddev == 0.0 or rng is None:
+        if not train or rng is None:
             return x
-        return x + self.stddev * jax.random.normal(rng, x.shape, x.dtype)
+        if not _is_schedule(self.stddev) and self.stddev == 0.0:
+            return x
+        return x + jnp.asarray(_now(self.stddev, lo=0.0), x.dtype) * jax.random.normal(
+            rng, x.shape, x.dtype)
 
 
 @register_dropout
@@ -177,29 +229,37 @@ class SpatialDropout(IDropout):
     p: float = 0.5
 
     def __post_init__(self):
-        self.p = _check_no_schedule(self.p, "SpatialDropout")
-        if not (0.0 < self.p <= 1.0):
+        self.p = _coerce_scalar(self.p)
+        if not _is_schedule(self.p) and not (0.0 < self.p <= 1.0):
             raise ValueError(
                 f"Activation retain probability must be in (0, 1]: got {self.p}")
 
     def apply(self, x, rng, train):
-        if not train or self.p >= 1.0 or rng is None:
+        if not train or rng is None:
+            return x
+        if not _is_schedule(self.p) and self.p >= 1.0:
             return x
         if x.ndim < 3:
             raise ValueError(
                 f"SpatialDropout expects [N, ..., C] rank>=3 input, got shape "
                 f"{x.shape}; use Dropout for 2d activations")
+        p = _now(self.p, lo=1e-6, hi=1.0)
         mask_shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
-        keep = jax.random.bernoulli(rng, self.p, mask_shape)
-        return jnp.where(keep, x / self.p, jnp.zeros((), x.dtype))
+        keep = jax.random.bernoulli(rng, p, mask_shape)
+        return jnp.where(keep, x / jnp.asarray(p, x.dtype),
+                         jnp.zeros((), x.dtype))
 
 
 def resolve_dropout(v) -> Optional[IDropout]:
     """Normalize a layer's ``dropout`` config value: float keep-prob →
-    :class:`Dropout`; IDropout instances pass through; None stays None.
-    Keep-prob <= 0 or >= 1 floats mean "off" (DL4J treats them as no-op)."""
+    :class:`Dropout`; a ``Schedule`` → :class:`Dropout` on that schedule
+    (DL4J's ``Dropout(ISchedule)`` constructor); IDropout instances pass
+    through; None stays None. Keep-prob <= 0 or >= 1 floats mean "off"
+    (DL4J treats them as no-op)."""
     if v is None or isinstance(v, IDropout):
         return v
+    if _is_schedule(v):
+        return Dropout(v)
     p = float(v)
     if p <= 0.0 or p >= 1.0:
         return None

@@ -301,8 +301,10 @@ class ComputationGraph:
                     return self._loss_fn(p, states, inputs, labels, rng_use,
                                          masks, label_masks, train=True,
                                          carries=carries)
-                (loss, (new_states, new_carries)), grads = \
-                    jax.value_and_grad(lf, has_aux=True)(params)
+                from deeplearning4j_tpu.nn.tick import schedule_tick
+                with schedule_tick(it, ep):  # dropout pSchedule sees the tick
+                    (loss, (new_states, new_carries)), grads = \
+                        jax.value_and_grad(lf, has_aux=True)(params)
                 new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
                 return (new_params, new_states, new_upd, loss, new_carries,
                         it + 1.0, rng_next)
@@ -329,8 +331,10 @@ class ComputationGraph:
                     def lf(p):
                         return self._loss_fn(p, states, inputs, labels, sub,
                                              None, None, train=True)
-                    (loss, (new_states, _)), grads = jax.value_and_grad(
-                        lf, has_aux=True)(params)
+                    from deeplearning4j_tpu.nn.tick import schedule_tick
+                    with schedule_tick(it, ep):
+                        (loss, (new_states, _)), grads = jax.value_and_grad(
+                            lf, has_aux=True)(params)
                     new_params, new_upd = self._apply_updates(
                         params, grads, upd, it, ep)
                     return (new_params, new_states, new_upd, it + 1.0, rng), loss

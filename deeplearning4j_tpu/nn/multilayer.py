@@ -262,7 +262,9 @@ class MultiLayerNetwork:
             def lf(p):
                 return self._loss_fn(p, states, x, y, rng_use, mask, label_mask,
                                      train=True, carries=carries if tbptt else None)
-            (loss, (new_states, new_carries)), grads = jax.value_and_grad(lf, has_aux=True)(params)
+            from deeplearning4j_tpu.nn.tick import schedule_tick
+            with schedule_tick(it, ep):  # dropout pSchedule sees the device tick
+                (loss, (new_states, new_carries)), grads = jax.value_and_grad(lf, has_aux=True)(params)
             new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
             if tbptt:
                 new_carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
@@ -330,8 +332,10 @@ class MultiLayerNetwork:
                     def lf(p):
                         return self._loss_fn(p, states, x, y, sub, None, None,
                                              train=True)
-                    (loss, (new_states, _)), grads = jax.value_and_grad(
-                        lf, has_aux=True)(params)
+                    from deeplearning4j_tpu.nn.tick import schedule_tick
+                    with schedule_tick(it, ep):
+                        (loss, (new_states, _)), grads = jax.value_and_grad(
+                            lf, has_aux=True)(params)
                     new_params, new_upd = self._apply_updates(
                         params, grads, upd, it, ep)
                     return (new_params, new_states, new_upd, it + 1.0, rng), loss

@@ -15,7 +15,37 @@ placed from host state.
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import jax.numpy as jnp
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def schedule_tick(it, ep):
+    """Make ``(iteration, epoch)`` visible to schedule-bearing configs
+    (dropout ``pSchedule`` — ``conf/dropout/Dropout.java:45,68``) while the
+    train step traces. The values are the step's device tracers, so a
+    scheduled retain probability compiles INTO the step instead of
+    fragmenting it — the reason schedules were rejected before the device
+    tick existed. Thread-local: safe under ParallelWrapper's worker
+    threads."""
+    prev = getattr(_TLS, "tick", None)
+    _TLS.tick = (it, ep)
+    try:
+        yield
+    finally:
+        _TLS.tick = prev
+
+
+def current_schedule_tick():
+    """(iteration, epoch) of the train step being traced, or ``(0, 0)``
+    outside one (a scheduled value then evaluates at its initial point —
+    e.g. a probe forward before training starts)."""
+    t = getattr(_TLS, "tick", None)
+    return t if t is not None else (0.0, 0.0)
 
 
 def device_tick(model):

@@ -295,8 +295,10 @@ class SharedTrainingMaster(TrainingMaster):
             def lf(p):
                 return net._loss_fn(p, states, x, y, rng, None, None, train=True)
 
-            (loss, (new_states, _)), grads = jax.value_and_grad(
-                lf, has_aux=True)(params)
+            from deeplearning4j_tpu.nn.tick import schedule_tick
+            with schedule_tick(it, ep):  # dropout pSchedule sees the tick
+                (loss, (new_states, _)), grads = jax.value_and_grad(
+                    lf, has_aux=True)(params)
             # local updater: update magnitudes, not raw grads, are shared
             # (StochasticGradientDescent.java:66-73 stores the UPDATE)
             stepped, new_upd = net._apply_updates(params, grads, upd, it, ep)

@@ -46,7 +46,9 @@ def make_pure_step(net, train: bool = True):
         def lf(p):
             return net._loss_fn(p, states, x, y, rng, mask, lmask, train=train)
 
-        (loss, (new_states, _)), grads = jax.value_and_grad(lf, has_aux=True)(params)
+        from deeplearning4j_tpu.nn.tick import schedule_tick
+        with schedule_tick(it, ep):  # dropout pSchedule sees the tick here too
+            (loss, (new_states, _)), grads = jax.value_and_grad(lf, has_aux=True)(params)
         new_params, new_upd = net._apply_updates(params, grads, upd, it, ep)
         return new_params, new_states, new_upd, loss
 

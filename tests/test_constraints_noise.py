@@ -408,7 +408,153 @@ class TestDropoutVariants:
         net.fit(DataSet(x, y))
         assert np.isfinite(float(net.score_))
 
-    def test_schedule_rejected_loudly(self):
+    def test_scheduled_p_follows_the_tick(self):
+        """Dropout.java:45,68 pSchedule: the retain probability is a
+        function of the train step's (iteration, epoch) tick."""
+        from deeplearning4j_tpu.nn.tick import schedule_tick
+        from deeplearning4j_tpu.nn.updaters import MapSchedule
+        d = Dropout(p=MapSchedule(values=((0, 1.0), (3, 0.5))))
+        x = jnp.ones((4000,), jnp.float32)
+        key = jax.random.PRNGKey(0)
+        with schedule_tick(jnp.asarray(0.0), jnp.asarray(0.0)):
+            early = np.asarray(d.apply(x, key, True))
+        with schedule_tick(jnp.asarray(5.0), jnp.asarray(0.0)):
+            late = np.asarray(d.apply(x, key, True))
+        np.testing.assert_allclose(early, 1.0)  # p=1.0: nothing dropped
+        kept = late != 0
+        assert abs(kept.mean() - 0.5) < 0.05
+        np.testing.assert_allclose(late[kept], 2.0, rtol=1e-6)
+
+    def test_scheduled_stddev_matches_formula_exactly(self):
+        from deeplearning4j_tpu.nn.tick import schedule_tick
+        from deeplearning4j_tpu.nn.updaters import ExponentialSchedule
+        sched = ExponentialSchedule(initial_value=0.4, gamma=0.5)
+        gn = GaussianNoise(stddev=sched)
+        x = jnp.zeros((512,), jnp.float32)
+        key = jax.random.PRNGKey(3)
+        for it in (0.0, 1.0, 4.0):
+            with schedule_tick(jnp.asarray(it), jnp.asarray(0.0)):
+                out = np.asarray(gn.apply(x, key, True))
+            expect = float(0.4 * 0.5 ** it) * np.asarray(
+                jax.random.normal(key, x.shape, x.dtype))
+            np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_fixed_schedule_equals_plain_float_training(self):
+        """Schedule machinery adds nothing: FixedSchedule(0.6) trains to
+        EXACTLY the same params as Dropout(0.6)."""
+        from deeplearning4j_tpu.nn.updaters import FixedSchedule
+
+        def build(drop):
+            conf = (NeuralNetConfiguration.builder().seed(11).updater(Sgd(0.1))
+                    .list()
+                    .layer(DenseLayer(n_in=5, n_out=8, activation="tanh",
+                                      dropout=drop))
+                    .layer(OutputLayer(n_in=8, n_out=3))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        a = build(Dropout(0.6))
+        b = build(Dropout(FixedSchedule(value_=0.6)))
+        for _ in range(3):
+            a.fit(DataSet(x, y))
+            b.fit(DataSet(x, y))
+        for pa, pb in zip(a.params, b.params):
+            for k in pa:
+                np.testing.assert_array_equal(np.asarray(pa[k]),
+                                              np.asarray(pb[k]))
+
+    def test_scheduled_dropout_trains_in_jitted_step(self):
+        """The schedule traces into the jitted step (no retrace per
+        iteration) and the loss stays finite across schedule breakpoints."""
         from deeplearning4j_tpu.nn.updaters import StepSchedule
-        with pytest.raises(ValueError, match="schedule"):
-            Dropout(p=StepSchedule(0.5, 0.9, 10))
+        conf = (NeuralNetConfiguration.builder().seed(1).updater(Sgd(0.05))
+                .list()
+                .layer(DenseLayer(n_in=5, n_out=8, activation="relu",
+                                  dropout=Dropout(
+                                      StepSchedule(initial_value=0.9,
+                                                   decay_rate=0.5, step=2.0))))
+                .layer(OutputLayer(n_in=8, n_out=3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(8, 5)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+        for _ in range(5):
+            net.fit(DataSet(x, y))
+            assert np.isfinite(float(net.score_))
+
+    def test_scheduled_dropout_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.updaters import MapSchedule
+        sched = MapSchedule(values=((0, 0.9), (10, 0.5)))
+        conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=5, n_out=4,
+                                  dropout=GaussianDropout(rate=sched)))
+                .layer(OutputLayer(n_in=4, n_out=2))
+                .build())
+        conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+        d = conf2.layers[0].dropout
+        assert isinstance(d, GaussianDropout)
+        assert isinstance(d.rate, MapSchedule)
+        assert tuple(map(tuple, d.rate.values)) == ((0, 0.9), (10, 0.5))
+
+
+class TestScheduleTickInParallelPaths:
+    def test_pure_step_sees_the_tick(self):
+        """parallel/trainer.make_pure_step (the ParallelWrapper/
+        SharedTrainingMaster building block) must evaluate dropout
+        schedules at ITS (it, ep) arguments, not freeze them at (0,0)."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.updaters import MapSchedule
+        from deeplearning4j_tpu.parallel.trainer import make_pure_step
+
+        def build(drop):
+            conf = (NeuralNetConfiguration.builder().seed(4).updater(Sgd(0.0))
+                    .list()
+                    .layer(DenseLayer(n_in=5, n_out=16, activation="tanh",
+                                      dropout=drop))
+                    .layer(OutputLayer(n_in=16, n_out=3))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        sched_net = build(Dropout(MapSchedule(values=((0, 1.0), (3, 0.5)))))
+        plain_net = build(None)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))
+        y = jnp.asarray(np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)])
+        key = jax.random.PRNGKey(0)
+
+        def loss_at(net, it):
+            step = make_pure_step(net)
+            out = step(net.params, net.states, net.updater_states,
+                       jnp.asarray(float(it)), jnp.asarray(0.0),
+                       x, y, None, None, key)
+            return float(out[3])
+
+        # iteration 0: scheduled p=1.0 == no dropout, losses equal exactly
+        assert loss_at(sched_net, 0) == loss_at(plain_net, 0)
+        # iteration 5: p=0.5 — dropout active, loss must differ
+        assert loss_at(sched_net, 5) != loss_at(plain_net, 5)
+
+    def test_out_of_range_schedule_saturates_not_nan(self):
+        """A schedule decaying retain-p toward 0 saturates at the clamp
+        instead of emitting division-by-zero NaNs."""
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.tick import schedule_tick
+        from deeplearning4j_tpu.nn.updaters import StepSchedule
+        d = Dropout(p=StepSchedule(initial_value=0.5, decay_rate=0.0,
+                                   step=1.0))  # p == 0 from iteration 1 on
+        x = jnp.ones((64,), jnp.float32)
+        with schedule_tick(jnp.asarray(10.0), jnp.asarray(0.0)):
+            out = np.asarray(d.apply(x, jax.random.PRNGKey(0), True))
+        assert np.isfinite(out).all()
+        g = GaussianDropout(rate=StepSchedule(initial_value=2.0,
+                                              decay_rate=1.0, step=1.0))
+        with schedule_tick(jnp.asarray(0.0), jnp.asarray(0.0)):
+            out = np.asarray(g.apply(x, jax.random.PRNGKey(1), True))
+        assert np.isfinite(out).all()
