@@ -388,6 +388,12 @@ def _base_kwargs(cfg: dict, conv: bool = False) -> dict:
            else _updater(upd_v))
     if upd is not None:
         kw["updater"] = upd
+    # per-layer bias updater override (BaseLayer.java biasUpdater) — this
+    # shifts UpdaterBlock boundaries, so dropping it would corrupt the
+    # updaterState.bin mapping
+    bias_upd = _updater(_get(cfg, "biasUpdater", "biasupdater"))
+    if bias_upd is not None:
+        kw["bias_updater"] = bias_upd
     gn = _get(cfg, "gradientNormalization")
     if gn and gn != "None":
         snake = "".join(("_" + c.lower() if c.isupper() else c)

@@ -124,6 +124,46 @@ def _updater_entry(u) -> Optional[dict]:
     return out
 
 
+def _distribution_entry(dist) -> dict:
+    """Serialize a ``Distribution`` spec into DL4J's ``@class``-tagged
+    ``dist`` field (``org.deeplearning4j.nn.conf.distribution.*`` —
+    the inverse of ``dl4j._distribution``), so ``DISTRIBUTION`` weight
+    init exports with the payload DL4J needs to re-init from it."""
+    if dist is None:
+        raise UnsupportedDl4jConfigurationException(
+            "weightInit DISTRIBUTION without a Distribution spec cannot "
+            "be expressed in the DL4J dialect")
+    if isinstance(dist, dict):
+        from deeplearning4j_tpu.nn.weights import Distribution
+        dist = Distribution.from_dict(dist)
+    pkg = "org.deeplearning4j.nn.conf.distribution"
+    k = dist.kind
+    if k == "normal":
+        return {"@class": f"{pkg}.NormalDistribution",
+                "mean": float(dist.mean), "std": float(dist.std)}
+    if k == "uniform":
+        return {"@class": f"{pkg}.UniformDistribution",
+                "lower": float(dist.lower), "upper": float(dist.upper)}
+    if k == "truncated_normal":
+        return {"@class": f"{pkg}.TruncatedNormalDistribution",
+                "mean": float(dist.mean), "std": float(dist.std)}
+    if k == "log_normal":
+        return {"@class": f"{pkg}.LogNormalDistribution",
+                "mean": float(dist.mean), "std": float(dist.std)}
+    if k == "orthogonal":
+        return {"@class": f"{pkg}.OrthogonalDistribution",
+                "gain": float(dist.gain)}
+    if k == "constant":
+        return {"@class": f"{pkg}.ConstantDistribution",
+                "value": float(dist.value)}
+    if k == "binomial":
+        return {"@class": f"{pkg}.BinomialDistribution",
+                "numberOfTrials": int(dist.n),
+                "probabilityOfSuccess": float(dist.p)}
+    raise UnsupportedDl4jConfigurationException(
+        f"cannot express distribution kind {k!r} in the DL4J dialect")
+
+
 def _layer_entry(layer, updater_entry) -> Tuple[str, dict]:
     """(WRAPPER_OBJECT type name, cfg dict) for one layer."""
     cls = type(layer).__name__
@@ -152,6 +192,9 @@ def _layer_entry(layer, updater_entry) -> Tuple[str, dict]:
     wi = getattr(layer, "weight_init", None)
     if wi:
         cfg["weightInit"] = str(wi).upper()
+        if str(wi) == "distribution":
+            cfg["dist"] = _distribution_entry(
+                getattr(layer, "distribution", None))
 
     def ff():
         cfg["nin"] = int(layer.n_in)
@@ -340,6 +383,15 @@ def export_multi_layer_network(net, path: str,
     for i, layer in enumerate(conf.layers):
         upd = _updater_entry(layer.updater) or default_updater
         t, cfg = _layer_entry(layer, upd)
+        # effective bias updater (layer override, else global bias updater;
+        # multilayer.py:85 resolution) — emitted when it differs from the
+        # weight updater, since it moves UpdaterBlock boundaries and with
+        # them the updaterState.bin layout (BaseLayer.java biasUpdater)
+        bias_u = getattr(layer, "bias_updater", None) or g.bias_updater
+        if bias_u is not None:
+            bias_entry = _updater_entry(bias_u)
+            if bias_entry != upd:
+                cfg["biasUpdater"] = bias_entry
         entry: Dict[str, object] = {"layer": {t: cfg}}
         if i == 0:
             entry["seed"] = int(g.seed)

@@ -281,3 +281,101 @@ class TestExportRejections:
         net = MultiLayerNetwork(conf).init()
         with pytest.raises(UnsupportedDl4jConfigurationException):
             export_multi_layer_network(net, str(tmp_path / "x.zip"))
+
+
+class TestDistributionWeightInit:
+    def test_distribution_init_round_trips_with_payload(self, tmp_path):
+        """DISTRIBUTION weightInit must export its dist payload (the
+        config is otherwise un-reinitializable by DL4J)."""
+        from deeplearning4j_tpu.nn.weights import Distribution
+        conf = (NeuralNetConfiguration.builder().seed(7).updater("sgd")
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh",
+                                  weight_init="distribution",
+                                  distribution=Distribution(
+                                      kind="normal", mean=0.5, std=0.25)))
+                .layer(OutputLayer(n_in=6, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        again = round_trip(net, x, tmp_path)
+        lyr = again.conf.layers[0]
+        assert lyr.weight_init == "distribution"
+        assert lyr.distribution.kind == "normal"
+        assert lyr.distribution.mean == 0.5
+        assert lyr.distribution.std == 0.25
+
+    def test_distribution_init_without_spec_raises(self, tmp_path):
+        """A layer claiming DISTRIBUTION init with no spec is rejected
+        loudly rather than exported as an unusable config."""
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            _distribution_entry,
+        )
+        with pytest.raises(UnsupportedDl4jConfigurationException):
+            _distribution_entry(None)
+
+
+class TestHeterogeneousUpdaterMigration:
+    def test_three_distinct_updaters_round_trip(self, tmp_path):
+        """UpdaterBlock.java:25 / BaseMultiLayerUpdater.java:38: per-layer
+        updater overrides split the state vector into blocks with DIFFERENT
+        slot layouts (Adam m+v, RmsProp g2, Nesterovs v). Export must write
+        them block-by-block and import must restore them exactly — resumed
+        training equals uninterrupted training."""
+        from deeplearning4j_tpu.nn.updaters import Nesterovs, RmsProp
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(1e-2))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(DenseLayer(n_in=6, n_out=5, activation="relu",
+                                  updater=RmsProp(5e-3)))
+                .layer(OutputLayer(n_in=5, n_out=2,
+                                   updater=Nesterovs(1e-2, momentum=0.9)))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(1)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        for _ in range(5):
+            net.fit(x, y)
+        path = str(tmp_path / "hetero.zip")
+        export_multi_layer_network(net, path)
+        import zipfile
+        assert "updaterState.bin" in zipfile.ZipFile(path).namelist()
+        resumed = restore_multi_layer_network(path)
+        # restored per-layer updater configs survive the dialect
+        assert type(resumed._updaters[1]["W"]).__name__ == "RmsProp"
+        assert type(resumed._updaters[2]["W"]).__name__ == "Nesterovs"
+        for _ in range(3):
+            net.fit(x, y)
+            resumed.fit(x, y)
+        for a, b in zip(net.params, resumed.params):
+            for k in a:
+                np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                           rtol=2e-4, atol=1e-6)
+
+    def test_bias_updater_override_round_trip(self, tmp_path):
+        """A global bias updater (Sgd on biases, Adam on weights) doubles
+        the block count; the wire layout must still round-trip."""
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-2))
+                .bias_updater(Sgd(0.1))
+                .list()
+                .layer(DenseLayer(n_in=4, n_out=6, activation="tanh"))
+                .layer(OutputLayer(n_in=6, n_out=2))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 4).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 16)]
+        for _ in range(4):
+            net.fit(x, y)
+        path = str(tmp_path / "biasupd.zip")
+        export_multi_layer_network(net, path)
+        resumed = restore_multi_layer_network(path)
+        for _ in range(3):
+            net.fit(x, y)
+            resumed.fit(x, y)
+        for a, b in zip(net.params, resumed.params):
+            for k in a:
+                np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
+                                           rtol=2e-4, atol=1e-6)
