@@ -46,12 +46,23 @@ from deeplearning4j_tpu.zoo.helpers import (
     resnet_conv_block,
     resnet_identity_block,
 )
-from deeplearning4j_tpu.zoo.zoo_model import ModelMetaData, ZooModel, register_zoo_model
+from deeplearning4j_tpu.zoo.zoo_model import (
+    ModelMetaData,
+    PretrainedType,
+    ZooModel,
+    register_zoo_model,
+)
 
 
 @register_zoo_model
 class LeNet(ZooModel):
     """LeNet-5-style CNN (``zoo/model/LeNet.java``: 20/50 conv, 500 dense)."""
+
+    # the reference's published artifact registry (LeNet.java:58-70); these
+    # DL4J ModelSerializer zips restore through our DL4J reader when fetched
+    PRETRAINED_URLS = {PretrainedType.MNIST:
+                       "http://blob.deeplearning4j.org/models/lenet_dl4j_mnist_inference.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.MNIST: 1906861161}
 
     def __init__(self, num_labels: int = 10, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (1, 28, 28)):
@@ -174,6 +185,16 @@ def _vgg_conf(blocks, num_labels, seed, input_shape):
 class VGG16(ZooModel):
     """VGG-16 (``zoo/model/VGG16.java``; Simonyan & Zisserman 2014)."""
 
+    # published artifacts (VGG16.java:58-79)
+    PRETRAINED_URLS = {
+        PretrainedType.IMAGENET: "http://blob.deeplearning4j.org/models/vgg16_dl4j_inference.zip",
+        PretrainedType.CIFAR10: "http://blob.deeplearning4j.org/models/vgg16_dl4j_cifar10_inference.v1.zip",
+        PretrainedType.VGGFACE: "http://blob.deeplearning4j.org/models/vgg16_dl4j_vggface_inference.v1.zip",
+    }
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 3501732770,
+                            PretrainedType.CIFAR10: 2192260131,
+                            PretrainedType.VGGFACE: 2706403553}
+
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):
         super().__init__(num_labels, seed)
@@ -191,6 +212,10 @@ class VGG16(ZooModel):
 class VGG19(ZooModel):
     """VGG-19 (``zoo/model/VGG19.java``)."""
 
+    PRETRAINED_URLS = {PretrainedType.IMAGENET:
+                       "http://blob.deeplearning4j.org/models/vgg19_dl4j_inference.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 2782932419}
+
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):
         super().__init__(num_labels, seed)
@@ -206,7 +231,11 @@ class VGG19(ZooModel):
 
 @register_zoo_model
 class Darknet19(ZooModel):
-    """Darknet-19 classifier (``zoo/model/Darknet19.java`` via DarknetHelper)."""
+    """Darknet-19 classifier (``zoo/model/Darknet19.java`` via DarknetHelper).
+
+    The published artifact depends on the input resolution
+    (``Darknet19.java:60-76``) — :meth:`pretrained_url` and
+    :meth:`pretrained_checksum` override the registries accordingly."""
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):
@@ -215,6 +244,27 @@ class Darknet19(ZooModel):
 
     def meta_data(self):
         return ModelMetaData((self.input_shape,), 1, "cnn")
+
+    def _artifact_name(self, pretrained_type):
+        # 224 and 448 weights are different artifacts (different URLs and
+        # checksums) — they must not share one cache slot
+        if self.input_shape[1] == 448 and self.input_shape[2] == 448:
+            return f"darknet19_448_{pretrained_type}.zip"
+        return f"darknet19_{pretrained_type}.zip"
+
+    def pretrained_url(self, pretrained_type):
+        if pretrained_type != PretrainedType.IMAGENET:
+            return None
+        if self.input_shape[1] == 448 and self.input_shape[2] == 448:
+            return "http://blob.deeplearning4j.org/models/darknet19_448_dl4j_inference.v1.zip"
+        return "http://blob.deeplearning4j.org/models/darknet19_dl4j_inference.v1.zip"
+
+    def pretrained_checksum(self, pretrained_type):
+        if pretrained_type != PretrainedType.IMAGENET:
+            return 0
+        if self.input_shape[1] == 448 and self.input_shape[2] == 448:
+            return 870575230
+        return 3952910425
 
     def conf(self):
         c, h, w = self.input_shape
@@ -259,6 +309,10 @@ YOLO2_ANCHORS = ((0.57273, 0.677385), (1.87446, 2.06253), (3.33843, 5.47434),
 class TinyYOLO(ZooModel):
     """Tiny YOLOv2 detector (``zoo/model/TinyYOLO.java``)."""
 
+    PRETRAINED_URLS = {PretrainedType.IMAGENET:
+                       "http://blob.deeplearning4j.org/models/tiny-yolo-voc_dl4j_inference.v1.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 2004171617}
+
     def __init__(self, num_labels: int = 20, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 416, 416)):
         super().__init__(num_labels, seed)
@@ -294,6 +348,10 @@ class TinyYOLO(ZooModel):
 class YOLO2(ZooModel):
     """YOLOv2 with Darknet-19 backbone + passthrough reorg
     (``zoo/model/YOLO2.java``: SpaceToDepth passthrough merged before head)."""
+
+    PRETRAINED_URLS = {PretrainedType.IMAGENET:
+                       "http://blob.deeplearning4j.org/models/yolo2_dl4j_inference.v1.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 1357637732}
 
     def __init__(self, num_labels: int = 80, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 608, 608)):
@@ -351,6 +409,10 @@ class ResNet50(ZooModel):
     """ResNet-50 (``zoo/model/ResNet50.java:89-216``): 7x7 stem then
     [3,4,6,3] bottleneck stages."""
 
+    PRETRAINED_URLS = {PretrainedType.IMAGENET:
+                       "http://blob.deeplearning4j.org/models/resnet50_dl4j_inference.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 1982516793}
+
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):
         super().__init__(num_labels, seed)
@@ -403,6 +465,10 @@ class ResNet50(ZooModel):
 @register_zoo_model
 class GoogLeNet(ZooModel):
     """GoogLeNet / Inception-v1 (``zoo/model/GoogLeNet.java``)."""
+
+    PRETRAINED_URLS = {PretrainedType.IMAGENET:
+                       "http://blob.deeplearning4j.org/models/googlenet_dl4j_inference.zip"}
+    PRETRAINED_CHECKSUMS = {PretrainedType.IMAGENET: 3337733202}
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape: Tuple[int, int, int] = (3, 224, 224)):

@@ -65,16 +65,36 @@ class ZooModel:
             return ComputationGraph(c).init(seed=self.seed)
         return MultiLayerNetwork(c).init(seed=self.seed)
 
-    def pretrained_checkpoint(self, pretrained_type: str = PretrainedType.IMAGENET) -> Optional[str]:
-        """Local path to pretrained weights, or None if unavailable.
+    def _artifact_name(self, pretrained_type: str) -> str:
+        """Cache-slot file name; models whose artifact varies beyond
+        (class, type) — e.g. Darknet19's resolution-dependent weights —
+        must extend this so distinct artifacts get distinct slots."""
+        return f"{type(self).__name__.lower()}_{pretrained_type}.zip"
 
-        The reference downloads from ``blob.deeplearning4j.org`` with an MD5
-        check (``ZooModel.java:51-69``); here weights are looked up under
-        ``$DL4J_TPU_ZOO_DIR/<model>_<type>.zip``.
+    def _cache_path(self, pretrained_type: str) -> str:
+        root = os.environ.get("DL4J_TPU_ZOO_DIR",
+                              os.path.expanduser("~/.deeplearning4j_tpu/zoo"))
+        return os.path.join(root, self._artifact_name(pretrained_type))
+
+    def pretrained_checkpoint(self, pretrained_type: str = PretrainedType.IMAGENET) -> Optional[str]:
+        """Local cache path to pretrained weights, or None if absent.
+
+        The reference's cache is ``~/.deeplearning4j/models`` filled by its
+        downloader (``ZooModel.java:51-69``); ours is
+        ``$DL4J_TPU_ZOO_DIR/<model>_<type>.zip``, filled either by the user
+        or by :meth:`init_pretrained` fetching a registered URL.
         """
-        root = os.environ.get("DL4J_TPU_ZOO_DIR", os.path.expanduser("~/.deeplearning4j_tpu/zoo"))
-        p = os.path.join(root, f"{type(self).__name__.lower()}_{pretrained_type}.zip")
+        p = self._cache_path(pretrained_type)
         return p if os.path.exists(p) else None
+
+    #: subclasses/users may register weight-artifact URLs per pretrained
+    #: type (``ZooModel.pretrainedUrl``; the reference points these at
+    #: ``blob.deeplearning4j.org``). ``file://`` URLs work identically —
+    #: the transport below is scheme-agnostic urllib.
+    PRETRAINED_URLS: Dict[str, str] = {}
+
+    def pretrained_url(self, pretrained_type: str) -> Optional[str]:
+        return self.PRETRAINED_URLS.get(pretrained_type)
 
     #: subclasses/users may register expected Adler32 checksums per
     #: pretrained type (``ZooModel.pretrainedChecksum``; 0 = don't verify)
@@ -93,25 +113,62 @@ class ZooModel:
         (``coefficients.bin`` + ``updaterState.bin``) load, for
         MultiLayerNetwork and ComputationGraph alike.
 
-        Unlike the reference (which deletes its own downloaded cache on
-        mismatch), a user-placed file is never deleted — the error reports
-        both checksums instead."""
+        A cache miss with a registered URL (:attr:`PRETRAINED_URLS`)
+        triggers a fetch into the cache first — ``file://`` URLs exercise
+        the identical transport/cache/checksum path as HTTP. Provenance
+        decides what the registry checksum applies to: artifacts the
+        fetcher wrote (marked with a ``.src`` sidecar) verify against the
+        registered checksum on EVERY load, like the reference's cache; a
+        user-placed file is their own choice of weights and only verifies
+        when an explicit ``expected_checksum`` is passed. On mismatch, the
+        artifact THIS call downloaded is deleted (``ZooModel.java:75-81``,
+        so the next call re-fetches); any pre-existing file — even a
+        marked cache the user may have replaced — is never deleted, the
+        error explains how to recover instead."""
         import zipfile
         import zlib
 
         path = self.pretrained_checkpoint(pretrained_type)
+        downloaded = False
         if path is None:
-            raise FileNotFoundError(
-                f"No pretrained weights for {type(self).__name__} ({pretrained_type}); "
-                f"place a checkpoint under $DL4J_TPU_ZOO_DIR to enable.")
-        expected = (self.pretrained_checksum(pretrained_type)
-                    if expected_checksum is None else int(expected_checksum))
+            url = self.pretrained_url(pretrained_type)
+            if url is None:
+                raise FileNotFoundError(
+                    f"No pretrained weights for {type(self).__name__} ({pretrained_type}); "
+                    f"place a checkpoint under $DL4J_TPU_ZOO_DIR or register "
+                    f"a PRETRAINED_URLS entry to enable.")
+            path = self._fetch(url, self._cache_path(pretrained_type))
+            downloaded = True
+        fetched = downloaded or os.path.exists(path + ".src")
+        if expected_checksum is not None:
+            expected = int(expected_checksum)
+        else:
+            expected = self.pretrained_checksum(pretrained_type) if fetched else 0
         if expected != 0:
             adler = 1  # zlib.adler32 seed, matches java.util.zip.Adler32
             with open(path, "rb") as fh:
                 for chunk in iter(lambda: fh.read(1 << 20), b""):
                     adler = zlib.adler32(chunk, adler)
             if adler != expected:
+                if downloaded:
+                    # ZooModel.java:75-81: a corrupt download is removed so
+                    # the next attempt re-fetches instead of failing forever.
+                    # Only a file THIS call wrote is ever deleted — a slot
+                    # the user may have touched since a past fetch is not.
+                    os.remove(path)
+                    if os.path.exists(path + ".src"):
+                        os.remove(path + ".src")
+                    raise ValueError(
+                        f"Pretrained model file failed checksum: fetched "
+                        f"Adler32 {adler}, expecting {expected} ({path}); "
+                        "the corrupt download was deleted — retry.")
+                if fetched:
+                    raise ValueError(
+                        f"Pretrained model file failed checksum: cached "
+                        f"Adler32 {adler}, expecting {expected} ({path}). "
+                        "If the cache rotted, delete the file and its .src "
+                        "marker to re-fetch; if you placed your own weights "
+                        "in this slot, delete just the .src marker.")
                 raise ValueError(
                     f"Pretrained model file failed checksum: local Adler32 "
                     f"{adler}, expecting {expected} ({path}); the file is "
@@ -129,6 +186,39 @@ class ZooModel:
             return restore_multi_layer_network(path)
         from deeplearning4j_tpu.util.model_serializer import restore_model
         return restore_model(path)
+
+    @staticmethod
+    def _fetch(url: str, dest: str) -> str:
+        """Stream ``url`` into ``dest`` (the cache slot) atomically: bytes
+        land in ``dest + '.part'`` first so an interrupted transfer never
+        poses as a finished artifact. Scheme-agnostic — ``file://`` and
+        ``http(s)://`` share the path (``ZooModel.java:63-66``'s
+        ``FileUtils.copyURLToFile`` role)."""
+        import shutil
+        import urllib.request
+
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        part = dest + ".part"
+        try:
+            with urllib.request.urlopen(url) as resp, open(part, "wb") as out:
+                shutil.copyfileobj(resp, out)
+            # provenance marker BEFORE installing the artifact: a crash
+            # between the two steps then leaves a marker with no artifact
+            # (harmless — the next call re-fetches and rewrites it), never
+            # a fetched artifact without a marker, which would dodge the
+            # registry checksum on every later load
+            with open(dest + ".src", "w") as fh:
+                fh.write(url)
+            os.replace(part, dest)
+        finally:
+            if os.path.exists(part):
+                # failed mid-fetch: remove the orphan marker too, so a file
+                # the USER later places in the slot is not misattributed to
+                # the fetcher (and wrongly checksum-gated)
+                os.remove(part)
+                if os.path.exists(dest + ".src") and not os.path.exists(dest):
+                    os.remove(dest + ".src")
+        return dest
 
 
 _ZOO_REGISTRY: Dict[str, Type[ZooModel]] = {}

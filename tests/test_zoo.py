@@ -275,6 +275,148 @@ class TestInitPretrained:
             LeNet().init_pretrained(PretrainedType.VGGFACE)
 
 
+class TestPretrainedTransport:
+    """ZooModel.java:51-81 — the FULL transport round trip: registered URL
+    → fetch → Adler32 verify → cache → restore; corrupt downloads deleted
+    so a retry re-fetches; cache hits skip the transport entirely.
+    file:// URLs drive the identical urllib path as http(s)."""
+
+    def _serve(self, tmp_path, monkeypatch):
+        """Stage a weight blob at a file:// 'origin' + point the cache at
+        an empty dir. Returns (model_cls, origin_path, checksum, cache_dir,
+        reference_net)."""
+        import os
+        import zlib
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        origin = tmp_path / "origin"
+        origin.mkdir()
+        m = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)).init()
+        blob = origin / "weights.zip"
+        write_model(m, str(blob))
+        with open(blob, "rb") as fh:
+            good = zlib.adler32(fh.read())
+        cache = tmp_path / "cache"
+        monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(cache))
+        return blob, good, cache, m
+
+    def test_fetch_checksum_cache_restore(self, tmp_path, monkeypatch):
+        import os
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        blob, good, cache, ref = self._serve(tmp_path, monkeypatch)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_URLS",
+            {PretrainedType.CIFAR10: blob.as_uri()}, raising=False)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_CHECKSUMS",
+            {PretrainedType.CIFAR10: good}, raising=False)
+        net = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        # the artifact landed in the cache slot (and no .part residue)
+        cached = cache / "simplecnn_cifar10.zip"
+        assert cached.exists()
+        assert not (cache / "simplecnn_cifar10.zip.part").exists()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(x)),
+                                   np.asarray(ref.output(x)), rtol=1e-5)
+        # cache HIT: origin removed, second init must not touch transport
+        os.remove(blob)
+        net2 = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        np.testing.assert_allclose(np.asarray(net2.output(x)),
+                                   np.asarray(ref.output(x)), rtol=1e-5)
+
+    def test_corrupt_download_deleted_then_refetch_succeeds(
+            self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        blob, good, cache, _ = self._serve(tmp_path, monkeypatch)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_URLS",
+            {PretrainedType.CIFAR10: blob.as_uri()}, raising=False)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_CHECKSUMS",
+            {PretrainedType.CIFAR10: good + 1}, raising=False)
+        with pytest.raises(ValueError, match="corrupt download was deleted"):
+            SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+                .init_pretrained(PretrainedType.CIFAR10)
+        # the reference deletes bad downloads (ZooModel.java:75-81): the
+        # cache slot must be empty so the next attempt re-fetches
+        assert not (cache / "simplecnn_cifar10.zip").exists()
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_CHECKSUMS",
+            {PretrainedType.CIFAR10: good}, raising=False)
+        net = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        assert net.params is not None
+
+    def test_fetched_cache_reverified_user_files_trusted(
+            self, tmp_path, monkeypatch):
+        """A fetched artifact re-verifies against the registry checksum on
+        every load (corruption in the cache is caught and evicted); a
+        user-placed file is their own weights — registry checksums don't
+        apply, only an explicit expected_checksum does."""
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        blob, good, cache, _ = self._serve(tmp_path, monkeypatch)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_URLS",
+            {PretrainedType.CIFAR10: blob.as_uri()}, raising=False)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_CHECKSUMS",
+            {PretrainedType.CIFAR10: good}, raising=False)
+        SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        slot = cache / "simplecnn_cifar10.zip"
+        marker = cache / "simplecnn_cifar10.zip.src"
+        assert marker.exists()
+        # corrupt the fetched cache: the next load must catch it, but never
+        # delete a file it didn't just download (the slot could equally be
+        # the user's own replacement)
+        slot.write_bytes(slot.read_bytes() + b"bitrot")
+        with pytest.raises(ValueError, match="delete the file"):
+            SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+                .init_pretrained(PretrainedType.CIFAR10)
+        assert slot.exists()
+        slot.unlink()
+        marker.unlink()
+        # user-placed file in the slot (their own fine-tune, a DIFFERENT
+        # byte stream than the registry artifact): registry checksum does
+        # NOT apply — it loads
+        import zlib
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        own = SimpleCNN(num_labels=4, input_shape=(3, 32, 32), seed=777).init()
+        write_model(own, str(slot))
+        with open(slot, "rb") as fh:
+            assert zlib.adler32(fh.read()) != good
+        net = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        assert net.params is not None
+
+    def test_interrupted_fetch_leaves_no_artifact(self, tmp_path, monkeypatch):
+        """A transport failure mid-stream must not leave a half-written
+        file posing as a finished artifact in the cache slot."""
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType, ZooModel
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        blob, good, cache, _ = self._serve(tmp_path, monkeypatch)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_URLS",
+            {PretrainedType.CIFAR10: blob.as_uri()}, raising=False)
+
+        import shutil
+        def explode(src, dst):
+            dst.write(b"partial")
+            raise OSError("link dropped")
+        monkeypatch.setattr(shutil, "copyfileobj", explode)
+        with pytest.raises(OSError, match="link dropped"):
+            SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+                .init_pretrained(PretrainedType.CIFAR10)
+        assert not (cache / "simplecnn_cifar10.zip").exists()
+        assert not (cache / "simplecnn_cifar10.zip.part").exists()
+
+
 class TestLabels:
     """zoo/util label helpers (Labels SPI, decodePredictions,
     VOC/COCO/ImageNet tables)."""
@@ -322,3 +464,32 @@ class TestLabels:
         monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path / "none"))
         with pytest.raises(FileNotFoundError, match="label table"):
             ImageNetLabels()
+
+
+def test_darknet19_resolution_specific_cache_slots(monkeypatch, tmp_path):
+    """224 and 448 Darknet19 weights are different artifacts (different
+    URLs/checksums) — they must occupy different cache slots."""
+    from deeplearning4j_tpu.zoo.models import Darknet19
+    monkeypatch.setenv("DL4J_TPU_ZOO_DIR", str(tmp_path))
+    p224 = Darknet19(input_shape=(3, 224, 224))._cache_path("imagenet")
+    p448 = Darknet19(input_shape=(3, 448, 448))._cache_path("imagenet")
+    assert p224 != p448
+
+
+def test_fetch_failure_leaves_no_orphan_src_marker(monkeypatch, tmp_path):
+    """A crash mid-fetch must not leave a .src marker without an artifact
+    in a way that later misattributes a user-placed file to the fetcher."""
+    from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+    dest = tmp_path / "slot.zip"
+    import shutil
+
+    def explode(src, dst):
+        raise OSError("mid-stream failure")
+    monkeypatch.setattr(shutil, "copyfileobj", explode)
+    blob = tmp_path / "origin.zip"
+    blob.write_bytes(b"payload")
+    with pytest.raises(OSError):
+        ZooModel._fetch(blob.as_uri(), str(dest))
+    assert not dest.exists()
+    assert not (tmp_path / "slot.zip.part").exists()
+    assert not (tmp_path / "slot.zip.src").exists()
