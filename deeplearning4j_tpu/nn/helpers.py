@@ -65,6 +65,28 @@ def clear_all_helpers() -> None:
     _HELPERS.clear()
 
 
+# -- flash-attention auto-registration ---------------------------------------
+# When NO attention helper is registered, causal attention at T >= 2048 on a
+# TPU backend automatically uses the causal PallasFlashAttentionHelper — the
+# measured win region (LM training 1.45x at T=2048, 2.64x at T=4096; the
+# kernel skips the masked upper triangle the einsum path still computes).
+# Registering any helper, or set_auto_flash_attention(False), overrides.
+_AUTO_FLASH = True
+
+
+def set_auto_flash_attention(enabled: bool) -> None:
+    """Opt out of (or back into) the automatic causal-flash fallback.
+    Bumps the registry version so already-compiled networks retrace."""
+    global _AUTO_FLASH, _VERSION
+    if _AUTO_FLASH != bool(enabled):
+        _AUTO_FLASH = bool(enabled)
+        _VERSION += 1
+
+
+def auto_flash_attention_enabled() -> bool:
+    return _AUTO_FLASH
+
+
 class LSTMHelper:
     """Interface (`LSTMHelper.java:34`): accelerate the LSTM sequence pass."""
 

@@ -192,3 +192,72 @@ class TestCausalFlashAttentionHelper:
             helpers.clear_helper("attention")
         assert calls, "causal flash helper was never consulted"
         np.testing.assert_allclose(out, ref, rtol=2e-2, atol=2e-3)
+
+
+class TestAutoFlashAttention:
+    """With NO helper registered, causal attention at T >= 2048 auto-uses
+    the causal flash kernel (opt-out via set_auto_flash_attention) — the
+    measured LM-training win should not depend on knowing the seam exists."""
+
+    def _spy(self, calls):
+        class Spy:
+            def supports(self, layer, q_shape, mask, dropout_active,
+                         causal=False):
+                return causal
+            def attend(self, q, k, v):
+                calls.append(q.shape)
+                # distinguishable-but-wrong output is fine: only SELECTION
+                # is under test here (numerics are covered on TPU above)
+                return q * 0 + 7.0
+        return Spy()
+
+    def _qkv(self, t):
+        import jax.numpy as jnp
+        shape = (1, 2, t, 64)
+        q = jnp.ones(shape, jnp.float32)
+        return q, q, q
+
+    def test_auto_used_in_win_region_only(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import attention as A
+        calls = []
+        monkeypatch.setattr(A, "_auto_flash_helper", lambda: self._spy(calls))
+        q, k, v = self._qkv(2048)
+        out = A.dot_product_attention(q, k, v, causal=True)
+        assert len(calls) == 1 and float(out[0, 0, 0, 0]) == 7.0
+        # below the threshold: einsum path
+        q2, k2, v2 = self._qkv(1024)
+        A.dot_product_attention(q2, k2, v2, causal=True)
+        assert len(calls) == 1
+        # non-causal: never auto (the kernel's semantics are causal)
+        A.dot_product_attention(q, k, v, causal=False)
+        assert len(calls) == 1
+
+    def test_opt_out_and_version_bump(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import attention as A
+        calls = []
+        monkeypatch.setattr(A, "_auto_flash_helper", lambda: self._spy(calls))
+        q, k, v = self._qkv(2048)
+        v0 = helpers.version()
+        helpers.set_auto_flash_attention(False)
+        try:
+            assert helpers.version() == v0 + 1  # compiled nets must retrace
+            A.dot_product_attention(q, k, v, causal=True)
+            assert not calls
+        finally:
+            helpers.set_auto_flash_attention(True)
+        assert helpers.version() == v0 + 2
+        A.dot_product_attention(q, k, v, causal=True)
+        assert len(calls) == 1
+
+    def test_registered_helper_takes_precedence(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import attention as A
+        auto_calls, reg_calls = [], []
+        monkeypatch.setattr(A, "_auto_flash_helper",
+                            lambda: self._spy(auto_calls))
+        helpers.set_helper("attention", self._spy(reg_calls))
+        try:
+            q, k, v = self._qkv(2048)
+            A.dot_product_attention(q, k, v, causal=True)
+            assert reg_calls and not auto_calls
+        finally:
+            helpers.clear_helper("attention")
