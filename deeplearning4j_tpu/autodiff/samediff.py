@@ -71,6 +71,78 @@ def _pool2d(x, kind, size, stride, padding):
     return y
 
 
+def _conv1d(x, w, stride=1, padding="SAME"):
+    """x [N,T,C], w [k,Cin,Cout] (ND4J Conv1D in NWC here — the repo's
+    sequence layout)."""
+    return jax.lax.conv_general_dilated(
+        x, w, (int(stride),), padding,
+        dimension_numbers=("NWC", "WIO", "NWC"))
+
+
+def _depthwise_conv2d(x, w, stride=(1, 1), padding="SAME"):
+    """x [N,H,W,C], w [kH,kW,C,mult] (ND4J DepthwiseConv2D, NHWC)."""
+    c = x.shape[-1]
+    w2 = jnp.reshape(w, (w.shape[0], w.shape[1], 1, c * w.shape[3]))
+    return jax.lax.conv_general_dilated(
+        x, w2, tuple(int(s) for s in stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c)
+
+
+def _deconv2d(x, w, stride=(2, 2), padding="SAME"):
+    """Transposed conv (ND4J DeConv2D): x [N,H,W,Cin], w [kH,kW,Cin,Cout].
+    The kernel is spatially FLIPPED (gradient-of-conv semantics, matching
+    DL4J and this repo's Deconvolution2DLayer — ``nn/layers/conv.py:222``);
+    ``lax.conv_transpose`` alone computes the un-flipped variant."""
+    return jax.lax.conv_transpose(
+        x, jnp.flip(w, (0, 1)), tuple(int(s) for s in stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _space_to_depth(x, block_size=2):
+    n, h, w, c = x.shape
+    b = int(block_size)
+    x = jnp.reshape(x, (n, h // b, b, w // b, b, c))
+    return jnp.reshape(jnp.transpose(x, (0, 1, 3, 2, 4, 5)),
+                       (n, h // b, w // b, b * b * c))
+
+
+def _depth_to_space(x, block_size=2):
+    n, h, w, c = x.shape
+    b = int(block_size)
+    x = jnp.reshape(x, (n, h, w, b, b, c // (b * b)))
+    return jnp.reshape(jnp.transpose(x, (0, 1, 3, 2, 4, 5)),
+                       (n, h * b, w * b, c // (b * b)))
+
+
+def _gather_nd(params, indices):
+    """ND4J ``gatherNd``: indices [..., D] index the first D dims."""
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return params[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+def _ids(idx):
+    return jnp.asarray(idx).astype(jnp.int32)
+
+
+def _nseg(num_segments, op: str) -> int:
+    """Segment ops need a STATIC segment count (it is the output shape —
+    XLA cannot infer it from the ids at trace time the way ND4J's eager
+    segmentSum does from max(ids))."""
+    if num_segments is None:
+        raise ValueError(
+            f"{op} requires num_segments (static output size), e.g. "
+            f"sd.math.{op}(data, ids, 5) or num_segments=5")
+    return int(num_segments)
+
+
+def _segment_mean(data, segment_ids, num_segments=None):
+    ids = _ids(segment_ids)
+    n = _nseg(num_segments, "segment_mean")
+    tot = jax.ops.segment_sum(data, ids, n)
+    cnt = jax.ops.segment_sum(jnp.ones(ids.shape, data.dtype), ids, n)
+    return tot / jnp.maximum(cnt.reshape(cnt.shape + (1,) * (tot.ndim - cnt.ndim)), 1.0)
+
+
 OPS: Dict[str, Callable] = {
     # arithmetic
     "add": lambda a, b: a + b,
@@ -193,6 +265,35 @@ OPS: Dict[str, Callable] = {
            + 1e-12)),
     "loss_hinge": lambda labels, preds: jnp.mean(
         jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * preds)),
+    # scatter family (ND4J ScatterUpdate/ScatterAdd/… — indices address dim
+    # 0 of ref; updates shape = indices.shape + ref.shape[1:]). Duplicate
+    # indices: the arithmetic ops (add/sub/mul/div) accumulate all updates
+    # like ND4J; scatter_update's winner among duplicates is undefined.
+    "scatter_update": lambda ref, idx, upd: ref.at[_ids(idx)].set(upd),
+    "scatter_add": lambda ref, idx, upd: ref.at[_ids(idx)].add(upd),
+    "scatter_sub": lambda ref, idx, upd: ref.at[_ids(idx)].add(-upd),
+    "scatter_mul": lambda ref, idx, upd: ref.at[_ids(idx)].multiply(upd),
+    "scatter_div": lambda ref, idx, upd: ref.at[_ids(idx)].divide(upd),
+    "scatter_max": lambda ref, idx, upd: ref.at[_ids(idx)].max(upd),
+    "scatter_min": lambda ref, idx, upd: ref.at[_ids(idx)].min(upd),
+    "gather_nd": _gather_nd,
+    # segment reductions (ND4J (unsorted)segment* — one op serves both; the
+    # sorted precondition is not required by the XLA lowering)
+    "segment_sum": lambda d, ids, num_segments=None:
+        jax.ops.segment_sum(d, _ids(ids), _nseg(num_segments, "segment_sum")),
+    "segment_mean": _segment_mean,
+    "segment_max": lambda d, ids, num_segments=None:
+        jax.ops.segment_max(d, _ids(ids), _nseg(num_segments, "segment_max")),
+    "segment_min": lambda d, ids, num_segments=None:
+        jax.ops.segment_min(d, _ids(ids), _nseg(num_segments, "segment_min")),
+    "segment_prod": lambda d, ids, num_segments=None:
+        jax.ops.segment_prod(d, _ids(ids), _nseg(num_segments, "segment_prod")),
+    # conv builder ops beyond conv2d
+    "conv1d": _conv1d,
+    "depthwise_conv2d": _depthwise_conv2d,
+    "deconv2d": _deconv2d,
+    "space_to_depth": _space_to_depth,
+    "depth_to_space": _depth_to_space,
     # control-flow plumbing: a while_loop node's value is the carried tuple;
     # tuple_get projects one element out at the top level
     "tuple_get": lambda t, index=0: t[index],
@@ -375,16 +476,22 @@ class _Namespace:
             raise AttributeError(f"unknown op {item!r}; available: {sorted(self._ops)}")
 
         def call(*args, name=None, **kwargs):
-            # SDVariable args are graph inputs. A plain-scalar positional arg
-            # fills the op's declared positional attrs (e.g.
-            # nn.leaky_relu(x, 0.2)); ops without declared attrs lift scalars
-            # to constant inputs (e.g. math.maximum(x, 0.0)).
-            pos_attrs = list(self._attr_names.get(item, ()))
+            # SDVariable args are graph inputs. A plain-SCALAR positional
+            # arg fills the op's declared positional attrs (e.g.
+            # nn.leaky_relu(x, 0.2)); arrays/lists are always lifted to
+            # constant inputs (so gather(x, [2, 0], 0) binds [2, 0] as the
+            # indices INPUT and 0 as the axis attr), as are scalars of ops
+            # without declared attrs (math.maximum(x, 0.0)). An attr
+            # already given as a kwarg is never overwritten positionally.
+            import numbers
+            pos_attrs = [a for a in self._attr_names.get(item, ())
+                         if a not in kwargs]
             inputs, attrs, attr_i = [], dict(kwargs), 0
             for a in args:
                 if isinstance(a, SDVariable):
                     inputs.append(a)
-                elif attr_i < len(pos_attrs) and inputs:
+                elif (attr_i < len(pos_attrs) and inputs
+                      and isinstance(a, (numbers.Number, str))):
                     attrs[pos_attrs[attr_i]] = a
                     attr_i += 1
                 else:
@@ -401,11 +508,15 @@ _MATH_OPS = {n: n for n in (
     "gt gte lt lte eq neq where cast tanh "
     "cumsum cumprod sort logsumexp l2_normalize mod floor_div "
     "atan asin acos sinh cosh asinh acosh atanh atan2 isnan isinf "
-    "diag trace").split()}
+    "diag trace "
+    "gather gather_nd scatter_update scatter_add scatter_sub scatter_mul "
+    "scatter_div scatter_max scatter_min "
+    "segment_sum segment_mean segment_max segment_min segment_prod").split()}
 _NN_OPS = {n: n for n in (
     "relu relu6 elu selu gelu softplus softsign swish hard_sigmoid "
     "leaky_relu softmax log_softmax sigmoid tanh linear layer_norm dropout "
-    "conv2d max_pooling2d avg_pooling2d batch_mmul").split()}
+    "conv2d max_pooling2d avg_pooling2d batch_mmul "
+    "conv1d depthwise_conv2d deconv2d space_to_depth depth_to_space").split()}
 _LOSS_OPS = {
     "mean_squared_error": "loss_mse",
     "mse": "loss_mse",
@@ -423,6 +534,14 @@ _ATTRS = {
     "clip_by_value": ("clip_min", "clip_max"),
     "dropout": ("p",),
     "huber_loss": ("delta",),
+    "gather": ("axis",),
+    "segment_sum": ("num_segments",),
+    "segment_mean": ("num_segments",),
+    "segment_max": ("num_segments",),
+    "segment_min": ("num_segments",),
+    "segment_prod": ("num_segments",),
+    "space_to_depth": ("block_size",),
+    "depth_to_space": ("block_size",),
 }
 
 
