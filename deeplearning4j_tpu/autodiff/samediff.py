@@ -478,19 +478,21 @@ class _Namespace:
         def call(*args, name=None, **kwargs):
             # SDVariable args are graph inputs. A plain-SCALAR positional
             # arg fills the op's declared positional attrs (e.g.
-            # nn.leaky_relu(x, 0.2)); arrays/lists are always lifted to
-            # constant inputs (so gather(x, [2, 0], 0) binds [2, 0] as the
-            # indices INPUT and 0 as the axis attr), as are scalars of ops
-            # without declared attrs (math.maximum(x, 0.0)). An attr
-            # already given as a kwarg is never overwritten positionally.
+            # nn.leaky_relu(x, 0.2)) — but only once the op's required
+            # tensor inputs are satisfied (_MIN_INPUTS), so a scalar gather
+            # index (gather(x, 2, 0)) binds as the indices INPUT, not the
+            # axis. Arrays/lists always lift to constant inputs, as do
+            # scalars of ops without declared attrs (math.maximum(x, 0.0)).
+            # An attr already given as a kwarg is never overwritten.
             import numbers
             pos_attrs = [a for a in self._attr_names.get(item, ())
                          if a not in kwargs]
+            need = _MIN_INPUTS.get(item, 1)
             inputs, attrs, attr_i = [], dict(kwargs), 0
             for a in args:
                 if isinstance(a, SDVariable):
                     inputs.append(a)
-                elif (attr_i < len(pos_attrs) and inputs
+                elif (attr_i < len(pos_attrs) and len(inputs) >= need
                       and isinstance(a, (numbers.Number, str))):
                     attrs[pos_attrs[attr_i]] = a
                     attr_i += 1
@@ -500,6 +502,16 @@ class _Namespace:
 
         return call
 
+
+# ops whose leading positional args are TENSOR inputs even when spelled as
+# plain scalars/lists (a scalar after that still fills positional attrs)
+_MIN_INPUTS = {
+    "gather": 2, "gather_nd": 2,
+    "segment_sum": 2, "segment_mean": 2, "segment_max": 2,
+    "segment_min": 2, "segment_prod": 2,
+    "scatter_update": 3, "scatter_add": 3, "scatter_sub": 3,
+    "scatter_mul": 3, "scatter_div": 3, "scatter_max": 3, "scatter_min": 3,
+}
 
 _MATH_OPS = {n: n for n in (
     "abs exp log sqrt square sin cos tan floor ceil round sign erf "

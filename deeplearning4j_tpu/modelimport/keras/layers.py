@@ -287,8 +287,10 @@ def _one_constraint(spec, scope: str):
         UnitNormConstraint)
     if spec is None:
         return None
-    cls = spec.get("class_name", "")
-    c = spec.get("config", {})
+    # Keras 2 nests {"class_name": ..., "config": {...}}; Keras 1 is FLAT —
+    # {"name": "MaxNorm", "m": 2.0, "axis": 0} (constraints.py get_config)
+    cls = spec.get("class_name") or spec.get("name", "")
+    c = spec.get("config", spec if "class_name" not in spec else {})
     # keras.constraints' own default is axis=0, NOT this framework's
     # all-but-last: for conv kernels (HWIO) those differ ((0,) vs (0,1,2)),
     # so a config that omits the field must get Keras's default.
@@ -296,8 +298,10 @@ def _one_constraint(spec, scope: str):
     dims = None if ax is None else tuple(ax) if isinstance(ax, (list, tuple)) \
         else (int(ax),)
     if cls in ("MaxNorm", "max_norm", "maxnorm"):
-        return MaxNormConstraint(max_norm=float(c.get("max_value", 2.0)),
-                                 dimensions=dims, scope=scope)
+        # Keras 1 spells the bound "m", Keras 2 "max_value"
+        return MaxNormConstraint(
+            max_norm=float(c.get("max_value", c.get("m", 2.0))),
+            dimensions=dims, scope=scope)
     if cls in ("MinMaxNorm", "min_max_norm"):
         return MinMaxNormConstraint(min_norm=float(c.get("min_value", 0.0)),
                                     max_norm=float(c.get("max_value", 1.0)),
@@ -355,7 +359,10 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
     if class_name in ("InputLayer", "Flatten", "Masking"):
         return None, _no_weights
 
-    if class_name == "Dense":
+    if class_name in ("Dense", "TimeDistributedDense"):
+        # Keras-1 TimeDistributedDense == a position-wise Dense; our
+        # DenseLayer applies position-wise over [N,T,C] already (the DL4J
+        # mapping is Dense + rnn↔ff preprocessors — KerasDense.java:49)
         units = cfg.get("units", cfg.get("output_dim"))
         return DenseLayer(name=name, n_out=int(units), activation=act or "identity",
                           has_bias=cfg.get("use_bias", cfg.get("bias", True)),

@@ -31,10 +31,40 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex, PreprocessorVertex
 
 
-def _input_type_from_shape(shape) -> InputType:
+def _reshape_spec(conf: dict) -> str:
+    """Keras Reshape target_shape → ``reshape:`` preprocessor spec. A -1
+    wildcard dim needs the upstream element count to resolve, which the
+    import-time spec cannot carry — rejected loudly rather than emitting a
+    corrupt negative-size InputType."""
+    target = conf.get("target_shape") or ()
+    dims = [int(d) for d in target]
+    if any(d < 0 for d in dims):
+        raise UnsupportedKerasConfigurationException(
+            f"Reshape target_shape {tuple(target)} contains a -1 wildcard; "
+            "re-save the model with explicit dimensions")
+    return "reshape:" + ",".join(str(d) for d in dims)
+
+
+def _channels_first(layer_configs) -> bool:
+    """True when any layer declares theano dim ordering / channels_first —
+    then rank-3 input shapes are [C,H,W] and must be re-interpreted for
+    this framework's NHWC layout (KerasLayer.getDimOrder role)."""
+    for lc in layer_configs:
+        c = lc.get("config", {})
+        if (c.get("dim_ordering") or c.get("data_format")) in (
+                "th", "channels_first"):
+            return True
+    return False
+
+
+def _input_type_from_shape(shape, channels_first: bool = False) -> InputType:
     """Keras input_shape/batch_input_shape (batch dim stripped) → InputType.
-    Layout is channels_last (NHWC), the TPU-native layout."""
+    Layout is channels_last (NHWC), the TPU-native layout; a channels-first
+    model's [C,H,W] input shape maps to the equivalent NHWC type."""
     shape = tuple(shape)
+    if channels_first and len(shape) == 3:
+        c, h, w = shape
+        return InputType.convolutional(h, w, c)
     if len(shape) == 1:
         return InputType.feed_forward(shape[0])
     if len(shape) == 2:
@@ -86,21 +116,40 @@ class KerasSequentialModel:
     def _build(self):
         input_type: Optional[InputType] = None
         layers = []
+        explicit_pre: Dict[int, str] = {}
+        ch_first = _channels_first(self.cfg.layer_configs)
         for lc in self.cfg.layer_configs:
             cls = lc["class_name"]
             conf = dict(lc.get("config", {}))
             if input_type is None:
                 shape = conf.get("batch_input_shape") or conf.get("batch_shape")
-                if shape is not None:
-                    input_type = _input_type_from_shape(shape[1:])
+                if shape is not None and cls == "Embedding":
+                    # token-index sequence [N, T] (T may be None — the imdb
+                    # fixtures declare [None, None]); never a raw ff size
+                    input_type = InputType.recurrent(
+                        1, shape[1] if len(shape) > 1 else None)
+                elif shape is not None:
+                    input_type = _input_type_from_shape(shape[1:], ch_first)
                 elif "input_shape" in conf:
-                    input_type = _input_type_from_shape(conf["input_shape"])
+                    input_type = _input_type_from_shape(conf["input_shape"],
+                                                        ch_first)
                 elif "input_dim" in conf and cls in ("Dense", "Embedding"):
                     if cls == "Embedding":
                         input_type = InputType.recurrent(
                             1, conf.get("input_length"))
                     else:
                         input_type = InputType.feed_forward(int(conf["input_dim"]))
+            if cls == "Reshape":
+                # KerasReshape.java: a Reshape layer IS an input preprocessor
+                # on the next layer (raw row-major reshape after batch)
+                explicit_pre[len(layers)] = _reshape_spec(conf)
+                continue
+            if cls == "Flatten" and len(layers) in explicit_pre:
+                # Reshape→Flatten→Dense: the flatten normally rides the
+                # dense layer's AUTO preprocessor, but an explicit spec
+                # replaces auto inference — compose it in instead
+                explicit_pre[len(layers)] += "|cnn_to_ff"
+                continue
             layer, wf = map_keras_layer(cls, conf)
             if layer is None:
                 continue
@@ -132,6 +181,11 @@ class KerasSequentialModel:
         b = NeuralNetConfiguration.builder().list()
         for l in layers:
             b.layer(l)
+        for idx, spec in explicit_pre.items():
+            if idx >= len(layers):
+                raise UnsupportedKerasConfigurationException(
+                    "Reshape as the final layer has no consumer to attach to")
+            b.input_pre_processor(idx, spec)
         self.conf = b.set_input_type(input_type).build()
 
     def init(self) -> MultiLayerNetwork:
@@ -221,6 +275,7 @@ class KerasModel:
 
         g = NeuralNetConfiguration.builder().graph_builder()
         input_types: List[InputType] = []
+        ch_first = _channels_first(layer_confs)
         for lc in layer_confs:
             cls = lc["class_name"]
             c = dict(lc.get("config", {}))
@@ -228,7 +283,7 @@ class KerasModel:
             inputs = self._inbound(lc)
             if cls == "InputLayer":
                 shape = c.get("batch_input_shape") or c.get("batch_shape")
-                input_types.append(_input_type_from_shape(shape[1:]))
+                input_types.append(_input_type_from_shape(shape[1:], ch_first))
                 g.add_inputs(lname)
                 continue
             if cls in self.MERGE_LAYERS:
@@ -240,6 +295,11 @@ class KerasModel:
                 continue
             if cls == "Flatten":
                 g.add_vertex(lname, PreprocessorVertex(preprocessor="cnn_to_ff"),
+                             *inputs)
+                continue
+            if cls == "Reshape":
+                g.add_vertex(lname,
+                             PreprocessorVertex(preprocessor=_reshape_spec(c)),
                              *inputs)
                 continue
             if cls == "MultiHeadAttention":

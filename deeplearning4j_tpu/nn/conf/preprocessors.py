@@ -92,6 +92,9 @@ def apply(spec: str, x):
     if name == "rnn_to_cnn":         # [N,T,C] with C=H'*W'*C' → [N,H',W',C'] per step merged
         h, w, c = args
         return x.reshape(-1, h, w, c)
+    if name == "reshape":            # ReshapePreprocessor (Keras Reshape):
+        # raw row-major reshape of everything after the batch axis
+        return x.reshape((x.shape[0],) + args)
     raise ValueError(f"unknown preprocessor {spec!r}")
 
 
@@ -119,4 +122,14 @@ def output_type(spec: str, it: InputType) -> InputType:
     if name == "rnn_to_cnn":
         h, w, c = args
         return InputType.convolutional(h, w, c)
+    if name == "reshape":
+        # target rank decides the interpretation (channels-last, like the
+        # rest of the framework): 1→ff, 2→[T,C] recurrent, 3→[H,W,C] conv
+        if len(args) == 1:
+            return InputType.feed_forward(args[0])
+        if len(args) == 2:
+            return InputType.recurrent(args[1], args[0])
+        if len(args) == 3:
+            return InputType.convolutional(*args)
+        raise ValueError(f"reshape target rank {len(args)} unsupported")
     raise ValueError(f"unknown preprocessor {spec!r}")

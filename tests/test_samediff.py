@@ -721,3 +721,15 @@ def test_plain_array_indices_bind_as_inputs_not_attrs():
     np.testing.assert_allclose(out["g"], xv[[2, 0]])
     np.testing.assert_allclose(out["s"], [xv[0] + xv[1], xv[2]])
     np.testing.assert_allclose(out["g2"], xv[:, [0, 2]])
+
+
+def test_scalar_gather_index_binds_as_input():
+    """gather(x, 2, 0) — scalar index, positional axis — must treat 2 as
+    the indices INPUT and 0 as the axis attr (the op's required tensor
+    inputs are satisfied before scalars start filling attrs)."""
+    sd = SameDiff.create()
+    x = sd.place_holder("x", shape=(3, 4))
+    sd.math.gather(x, 2, 0, name="g")
+    xv = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = sd.output({"x": xv}, "g")["g"]
+    np.testing.assert_allclose(out, xv[2])
