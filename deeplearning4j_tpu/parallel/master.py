@@ -337,6 +337,98 @@ class SharedTrainingMaster(TrainingMaster):
             out_specs=(rep, rep, rep, shard0, rep, rep))
         return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
 
+    # -- compression-state checkpointing ---------------------------------
+    # A preemption checkpoint that carries only model + updater state
+    # resumes ALMOST exactly: the adaptive threshold re-warms and the
+    # un-transmitted residuals are lost (they re-accumulate, shifting a
+    # few low-order bits of every later update). Exact resume needs this
+    # state too — the reference has no analog (its accumulator dies with
+    # the worker; membership is fixed — SharedTrainingWrapper.java:131).
+
+    def save_state(self, path: str) -> None:
+        """Write this PROCESS's compression state (threshold machinery +
+        its local residual shard) as an npz. In a multi-process run every
+        process must save its own file — residual shards differ."""
+        scalars = {
+            "threshold": np.float64(self.threshold),
+            "steps_done": np.int64(self._steps_done),
+            "shake_restore": np.float64(
+                -1.0 if self._shake_restore is None else self._shake_restore),
+        }
+        arrays = {}
+        if self._residual is not None:
+            leaves = jax.tree_util.tree_leaves(self._residual)
+            for i, leaf in enumerate(leaves):
+                if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                    # ALL local shards, in worker order — a process usually
+                    # owns several devices, each holding one worker slice
+                    # of the worker-stacked residual (axis 0)
+                    shards = sorted(leaf.addressable_shards,
+                                    key=lambda s: s.index[0].start or 0)
+                    arrays[f"res{i}"] = np.concatenate(
+                        [np.asarray(s.data) for s in shards], axis=0)
+                else:
+                    arrays[f"res{i}"] = np.asarray(leaf)
+        np.savez(path, **scalars, **arrays)
+
+    def load_state(self, path: str) -> None:
+        """Restore state written by :meth:`save_state` (same process rank,
+        same mesh shape — residual shards are rank-local). The residual is
+        re-placed lazily on the next ``execute_training`` call."""
+        data = np.load(path)
+        self.threshold = float(data["threshold"])
+        self._steps_done = int(data["steps_done"])
+        sr = float(data["shake_restore"])
+        self._shake_restore = None if sr < 0 else sr
+        res = [data[k] for k in sorted(
+            (k for k in data.files if k.startswith("res")),
+            key=lambda k: int(k[3:]))]
+        self._residual_restore = res or None
+        if self._residual is not None and self._residual_restore is not None:
+            # master already bound to a network: place the residual NOW —
+            # deferring to the next step-fn rebuild would silently keep the
+            # current residual while the threshold scalars rolled back
+            self._residual = self._place_restored_residual(
+                self._residual, mp=is_multiprocess(self.mesh),
+                shard_spec=P(self.data_axis))
+
+    _residual_restore = None
+
+    def _place_restored_residual(self, zeros_tree, mp: bool, shard_spec):
+        leaves, treedef = jax.tree_util.tree_flatten(zeros_tree)
+        saved = self._residual_restore
+        self._residual_restore = None
+        if len(saved) != len(leaves):
+            raise ValueError(
+                f"restored residual has {len(saved)} leaves, model needs "
+                f"{len(leaves)} — was the checkpoint from this architecture?")
+        placed = []
+        for z, s in zip(leaves, saved):
+            if mp:
+                # validate BEFORE constructing the global array — the jax
+                # constructor's own mismatch error would bury the remedy
+                expect_local = (z.shape[0] // jax.process_count(),) + \
+                    tuple(z.shape[1:])
+                if tuple(s.shape) != expect_local:
+                    raise ValueError(
+                        f"restored residual shard {s.shape} does not tile "
+                        f"to {z.shape} over {jax.process_count()} processes "
+                        "— resuming on a different worker count drops "
+                        "residuals: skip load_state and re-accumulate")
+                sharding = jax.sharding.NamedSharding(
+                    self.mesh, shard_spec)
+                arr = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(s, z.dtype))
+            else:
+                if tuple(s.shape) != tuple(z.shape):
+                    raise ValueError(
+                        f"restored residual shape {s.shape} != {z.shape} — "
+                        "resuming on a different worker count drops "
+                        "residuals: skip load_state and re-accumulate")
+                arr = jnp.asarray(np.asarray(s, z.dtype))
+            placed.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, placed)
+
     def _adapt_threshold(self, sparsity: float) -> None:
         """EncodingHandler.java:69-94: decay threshold toward min when too few
         elements pass (residual starving), raise it when too many pass."""
@@ -376,14 +468,33 @@ class SharedTrainingMaster(TrainingMaster):
             if mp:
                 # cross-process run (jax.distributed): every host holds the
                 # same full values; lift them to GLOBAL arrays over the mesh
-                self._residual = make_global(self._residual, self.mesh, shard0)
+                if self._residual_restore is not None:
+                    self._residual = self._place_restored_residual(
+                        self._residual, mp=True, shard_spec=shard0)
+                else:
+                    self._residual = make_global(self._residual, self.mesh,
+                                                 shard0)
                 network.params = make_global(network.params, self.mesh, rep)
                 network.states = make_global(network.states, self.mesh, rep)
                 network.updater_states = make_global(
                     network.updater_states, self.mesh, rep)
             else:
-                self._residual = jax.tree_util.tree_map(jnp.asarray,
-                                                        self._residual)
+                if self._residual_restore is not None:
+                    self._residual = self._place_restored_residual(
+                        self._residual, mp=False, shard_spec=shard0)
+                else:
+                    self._residual = jax.tree_util.tree_map(jnp.asarray,
+                                                            self._residual)
+                # a restored model's params arrive COMMITTED to one device
+                # (orbax device_puts on load); the sharded step needs them
+                # replicated over the whole mesh — uncommitted fresh-init
+                # arrays pass through device_put for free
+                rep_sh = jax.sharding.NamedSharding(self.mesh, rep)
+                network.params = jax.device_put(network.params, rep_sh)
+                network.states = jax.device_put(network.states, rep_sh)
+                if network.updater_states is not None:
+                    network.updater_states = jax.device_put(
+                        network.updater_states, rep_sh)
         t0 = time.perf_counter()
         for ds in data_iterator:
             x = np.asarray(ds.features)

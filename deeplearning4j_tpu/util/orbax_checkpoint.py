@@ -77,13 +77,37 @@ def _build_model(directory: str):
     return model
 
 
+def _multiprocess_safe(tree):
+    """In a multi-process (``jax.distributed``) job, orbax refuses to
+    serialize HOST-LOCAL jax.Arrays (replicated lockstep state, like the
+    deterministic-broadcast training masters keep) — only numpy or global
+    sharded arrays. Convert fully-addressable arrays to host numpy;
+    genuinely global (multi-host sharded) arrays pass through to orbax's
+    proper sharded path."""
+    import jax
+    if jax.process_count() <= 1:
+        return tree
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            if x.is_fully_addressable:
+                return np.asarray(x)
+            if x.is_fully_replicated:
+                # global replicated array (e.g. params after training over
+                # a multi-process mesh): every process holds the full
+                # value in its local shard
+                return np.asarray(x.addressable_data(0))
+        return x
+    return jax.tree_util.tree_map(conv, tree)
+
+
 def _state_pytree(model, with_updater: bool) -> Dict[str, Any]:
     state: Dict[str, Any] = {"params": model.params, "states": model.states}
     if with_updater and model.updater_states is not None:
         state["updater_states"] = model.updater_states
     state["counters"] = {"iteration": np.asarray(model.iteration),
                          "epoch": np.asarray(model.epoch)}
-    return state
+    return _multiprocess_safe(state)
 
 
 def _template_for(model, metadata) -> Dict[str, Any]:
@@ -182,14 +206,36 @@ class OrbaxCheckpointManager:
     ``ocp.CheckpointManager``)."""
 
     def __init__(self, directory: str, *, max_to_keep: Optional[int] = 3,
-                 save_interval_steps: int = 1):
+                 save_interval_steps: int = 1,
+                 active_processes: Optional[set] = None,
+                 barrier_sync_key_prefix: Optional[str] = None):
+        """``active_processes`` restricts orbax's multihost coordination to
+        a subset of a ``jax.distributed`` job (e.g. ``{0}`` so only the
+        coordinator checkpoints replicated state) — without it, a save
+        from one process of a multi-process job hangs on a barrier the
+        other processes never enter. ``barrier_sync_key_prefix`` keeps
+        two concurrent managers' barriers from colliding."""
         import orbax.checkpoint as ocp
         from etils import epath
         self.directory = _canonical_dir(directory)
         epath.Path(self.directory).mkdir(parents=True, exist_ok=True)
+        mp_options = None
+        if active_processes is not None or barrier_sync_key_prefix is not None:
+            primary = (min(active_processes) if active_processes else 0)
+            mp_options = ocp.options.MultiprocessingOptions(
+                primary_host=primary,
+                active_processes=active_processes,
+                barrier_sync_key_prefix=barrier_sync_key_prefix)
+        extra = {}
+        if mp_options is not None:
+            # orbax treats an explicit None differently from the kwarg
+            # being absent, and refuses create=True with active_processes;
+            # the epath mkdir above has already made the root either way
+            extra = {"multiprocessing_options": mp_options, "create": False}
         self._options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
-            save_interval_steps=max(1, save_interval_steps))
+            save_interval_steps=max(1, save_interval_steps),
+            **extra)
         self._mgr = ocp.CheckpointManager(self.directory,
                                           options=self._options)
         self._meta_written = False

@@ -1,0 +1,156 @@
+"""Worker process for the worker-failure recovery test.
+
+Launched twice (process_id 0 and 1) by tests/test_multiprocess.py's
+failure-recovery test. Trains the same deterministic job as
+``distributed_worker.py`` but one EPOCH per fit() call, with process 0
+writing an orbax rotation checkpoint after every epoch (the preemption
+pattern: ``util/preemption.py`` + ``util/orbax_checkpoint.py``).
+
+Modes (argv[4]):
+- ``full``:   train all EPOCHS epochs uninterrupted, dump params.
+- ``victim``: train normally; the TEST kills this job mid-epoch-4 (after
+  the epoch-3 checkpoint marker appears). Nothing special in-process —
+  death arrives as SIGKILL, like a real preemption without grace.
+- ``resume``: restore the latest checkpoint (epoch 3), train the
+  remaining epochs, dump params.
+
+The recovery contract (beyond the reference, whose worker membership is
+fixed at job start — ``SharedTrainingWrapper.java:131-156``): resumed
+params must EQUAL the uninterrupted run's.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # exactly ONE local CPU device
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+EPOCHS = 6
+CKPT_EPOCH = 3  # the epoch whose checkpoint the resume restarts from
+
+
+def build_data():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    yc = rng.integers(0, 3, 256)
+    x = rng.normal(size=(256, 6)).astype(np.float32)
+    x[np.arange(256), yc] += 2.5
+    y = np.eye(3, dtype=np.float32)[yc]
+    return x, y
+
+
+def build_net():
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+    conf = (NeuralNetConfiguration.builder().seed(7).updater(Adam(0.01)).list()
+            .layer(DenseLayer(n_out=12, activation="tanh"))
+            .layer(OutputLayer(n_out=3))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def dump(net, out_path):
+    import numpy as np
+    flat = {}
+    for i, layer in enumerate(net.params):
+        for k, v in layer.items():
+            flat[f"{i}:{k}"] = np.asarray(v)
+    np.savez(out_path, **flat)
+
+
+def main():
+    coordinator, pid = sys.argv[1], int(sys.argv[2])
+    out_path, mode, workdir = sys.argv[3], sys.argv[4], sys.argv[5]
+    from deeplearning4j_tpu.parallel import (
+        DistributedMultiLayerNetwork,
+        SharedTrainingMaster,
+        init_distributed,
+    )
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+    from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
+    from deeplearning4j_tpu.util.orbax_checkpoint import OrbaxCheckpointManager
+
+    init_distributed(coordinator_address=coordinator, num_processes=2,
+                     process_id=pid)
+    assert jax.device_count() == 2
+
+    x, y = build_data()
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    if mode == "resume":
+        # every process restores the same checkpoint — identical state,
+        # like the deterministic broadcast at first start. Each process
+        # reads independently (active_processes={pid}) so no cross-process
+        # barrier is needed for the read-only restore.
+        with OrbaxCheckpointManager(
+                ckpt_dir, active_processes={pid},
+                barrier_sync_key_prefix=f"resume{pid}") as mgr:
+            start_epoch = mgr.latest_step()
+            net = mgr.restore()
+        assert start_epoch == CKPT_EPOCH, start_epoch
+    else:
+        start_epoch = 0
+        net = build_net()
+
+    mesh = make_mesh({"data": 2})
+    master = SharedTrainingMaster(batch_size_per_worker=16, threshold=1e-3,
+                                  mesh=mesh)
+    def master_state_path(p, epoch):
+        return os.path.join(workdir, f"master_state.{p}.epoch{epoch}.npz")
+
+    if mode == "resume":
+        # exact resume needs the compression state too (adaptive threshold
+        # + this process's residual shard) — rank-local, so each process
+        # loads its own file
+        master.load_state(master_state_path(pid, CKPT_EPOCH))
+    front = DistributedMultiLayerNetwork(net, master)
+
+    # only the coordinator writes the orbax model checkpoint (replicated
+    # state; active_processes keeps orbax's barriers inside that process);
+    # the compression state is rank-local, so EVERY process saves its own
+    mgr = OrbaxCheckpointManager(ckpt_dir, max_to_keep=2,
+                                 active_processes={0}) \
+        if (mode == "victim" and pid == 0) else None
+    for epoch in range(start_epoch, EPOCHS):
+        front.fit(ListDataSetIterator(DataSet(x, y), 32), epochs=1)
+        print(f"[{pid}] epoch {epoch + 1} done", flush=True)
+        if mode == "victim":
+            master.save_state(master_state_path(pid, epoch + 1))
+        if mgr is not None:
+            mgr.save(epoch + 1, net)
+            mgr.wait_until_finished()
+            if epoch + 1 == CKPT_EPOCH:
+                # marker the test watches for before killing this job —
+                # written only once the PEER's rank-local state for this
+                # epoch exists too, so the kill can't race its save
+                import time
+                deadline = time.time() + 120
+                while not os.path.exists(master_state_path(1, epoch + 1)):
+                    if time.time() > deadline:
+                        raise RuntimeError("peer master state never appeared")
+                    time.sleep(0.2)
+                with open(os.path.join(workdir, "epoch3_saved"), "w") as fh:
+                    fh.write("ok")
+                # hold here until the SIGKILL arrives: letting training race
+                # ahead could land a LATER checkpoint before the kill and
+                # make the resume start from the wrong epoch (flaky on fast
+                # machines). The peer blocks at its next collective.
+                import time
+                while True:
+                    time.sleep(1)
+    if mgr is not None:
+        mgr.close()
+
+    if pid == 0 and mode in ("full", "resume"):
+        dump(net, out_path)
+    print(f"WORKER{pid}_DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
