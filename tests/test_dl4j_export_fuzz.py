@@ -1,0 +1,171 @@
+"""DL4J export↔import fuzz: random architectures round-trip the wire.
+
+The reference locks its checkpoint format with fixed per-release zips
+(RegressionTest050..080 — external artifacts absent from the snapshot);
+with no ND4J runtime in this image, the exporter and importer can only be
+cross-checked against each other — so that check is FUZZED: a seeded
+generator sweeps the DL4J-expressible architecture space (dense / conv /
+recurrent stacks, heterogeneous updaters, bias updaters, distribution
+inits, scalar dropout, l1/l2), each sample trains, exports through
+``dl4j_export``, re-imports through ``dl4j``, and must reproduce outputs
+exactly AND keep training identically (updater-state round trip). A
+shared misreading of the ND4J layout stays undetectable by construction
+(documented in KNOWN_GAPS.md); what this tier rules out is any config
+field, param permutation, or updater-block boundary that only one side
+handles.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.dl4j import restore_multi_layer_network
+from deeplearning4j_tpu.modelimport.dl4j_export import (
+    export_multi_layer_network,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.network import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers.conv import ConvolutionLayer
+from deeplearning4j_tpu.nn.layers.core import DenseLayer
+from deeplearning4j_tpu.nn.layers.norm import BatchNormalizationLayer
+from deeplearning4j_tpu.nn.layers.output import OutputLayer, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.pooling import SubsamplingLayer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    GravesLSTMLayer,
+    LSTMLayer,
+    SimpleRnnLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updaters import (
+    Adam,
+    AdaGrad,
+    Nesterovs,
+    RmsProp,
+    Sgd,
+)
+from deeplearning4j_tpu.nn.weights import Distribution
+
+ACTS = ["relu", "tanh", "sigmoid", "softsign"]
+
+
+def rand_updater(rng):
+    return rng.choice([Sgd(0.05), Adam(1e-3), RmsProp(5e-3),
+                       Nesterovs(1e-2, momentum=0.9), AdaGrad(1e-2), None])
+
+
+def layer_extras(rng):
+    kw = {}
+    if rng.random() < 0.3:
+        kw["dropout"] = rng.choice([0.8, 0.9])
+    if rng.random() < 0.3:
+        kw["l2"] = 1e-4
+    if rng.random() < 0.2:
+        kw["l1"] = 1e-5
+    u = rand_updater(rng)
+    if u is not None:
+        kw["updater"] = u
+    if rng.random() < 0.2:
+        kw["weight_init"] = "distribution"
+        kw["distribution"] = Distribution(kind="normal", mean=0.0,
+                                          std=rng.choice([0.1, 0.5]))
+    return kw
+
+
+def rand_dense(rng):
+    nb = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
+          .updater(Adam(1e-3)))
+    if rng.random() < 0.3:
+        nb = nb.bias_updater(Sgd(0.1))
+    b = nb.list()
+    width = rng.choice([4, 6, 8])
+    b.layer(DenseLayer(n_in=5, n_out=width, activation=rng.choice(ACTS),
+                       **layer_extras(rng)))
+    for _ in range(rng.randint(0, 2)):
+        b.layer(DenseLayer(n_in=width, n_out=width,
+                           activation=rng.choice(ACTS), **layer_extras(rng)))
+    b.layer(OutputLayer(n_in=width, n_out=3, **layer_extras(rng)))
+    x = np.random.RandomState(rng.randint(0, 99)).randn(8, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[
+        np.random.RandomState(rng.randint(0, 99)).randint(0, 3, 8)]
+    return b.build(), x, y
+
+
+def rand_conv(rng):
+    b = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
+         .updater(Adam(1e-3)).list())
+    ch = rng.choice([3, 4])
+    b.layer(ConvolutionLayer(n_out=ch, kernel_size=(3, 3),
+                             convolution_mode="same",
+                             activation=rng.choice(ACTS),
+                             **layer_extras(rng)))
+    if rng.random() < 0.5:
+        b.layer(BatchNormalizationLayer())
+    if rng.random() < 0.5:
+        b.layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+    b.layer(DenseLayer(n_out=6, activation=rng.choice(ACTS)))
+    b.layer(OutputLayer(n_out=2, **layer_extras(rng)))
+    b.set_input_type(InputType.convolutional(8, 8, 1))
+    x = np.random.RandomState(rng.randint(0, 99)).rand(4, 8, 8, 1) \
+        .astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[
+        np.random.RandomState(rng.randint(0, 99)).randint(0, 2, 4)]
+    return b.build(), x, y
+
+
+def rand_recurrent(rng):
+    b = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
+         .updater(Adam(1e-3)).list())
+    width = rng.choice([4, 6])
+    cls = rng.choice([LSTMLayer, GravesLSTMLayer, SimpleRnnLayer])
+    b.layer(cls(n_in=3, n_out=width, **layer_extras(rng)))
+    if rng.random() < 0.4:
+        b.layer(SimpleRnnLayer(n_in=width, n_out=width))
+    b.layer(RnnOutputLayer(n_in=width, n_out=2, **layer_extras(rng)))
+    x = np.random.RandomState(rng.randint(0, 99)).randn(4, 7, 3) \
+        .astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[
+        np.random.RandomState(rng.randint(0, 99)).randint(0, 2, (4, 7))]
+    return b.build(), x, y
+
+
+FAMILIES = {"dense": rand_dense, "conv": rand_conv, "rnn": rand_recurrent}
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+@pytest.mark.parametrize("seed", range(6))
+def test_random_architecture_round_trips(family, seed, tmp_path):
+    # deterministic across processes (str hash is PYTHONHASHSEED-random)
+    rng = random.Random(1000 * sorted(FAMILIES).index(family) + seed)
+    conf, x, y = FAMILIES[family](rng)
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(3):
+        net.fit(x, y)
+    path = str(tmp_path / "rt.zip")
+    export_multi_layer_network(net, path)
+    again = restore_multi_layer_network(path)
+    np.testing.assert_allclose(np.asarray(again.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=2e-5, atol=1e-6)
+    # updater state round trip: continued training stays identical. The
+    # RNG stream is NOT part of the wire format (DL4J's isn't either), so
+    # dropout masks would legitimately differ post-restore — sync both
+    # nets to one key (and drop the cached device tick that holds the old
+    # one) to make the equality exact even for dropout configs.
+    import jax
+    net._rng_key = again._rng_key = jax.random.PRNGKey(7)
+    net._tick = again._tick = None
+    for _ in range(2):
+        net.fit(x, y)
+        again.fit(x, y)
+    # compare continued-training OUTPUTS, not raw params: across a cnn→ff
+    # boundary the reimported model keeps DL4J's NCHW weight indexing with
+    # an activation-side transpose (dl4j.py _convert_dl4j_preprocessor),
+    # so the dense W lives in a permuted basis — functionally identical,
+    # elementwise different
+    np.testing.assert_allclose(
+        np.asarray(again.output(x)), np.asarray(net.output(x)),
+        rtol=2e-4, atol=1e-5,
+        err_msg=f"{family}/{seed}: training diverged after restore")
+    np.testing.assert_allclose(float(again.score_), float(net.score_),
+                               rtol=2e-4, atol=1e-6)
