@@ -590,7 +590,8 @@ def import_dl4j_configuration(source: str):
 
     bp = d.get("backpropType")
     if bp == "TruncatedBPTT":
-        lb.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
+        fwd = int(d.get("tbpttFwdLength", 20))
+        lb.t_bptt_length(fwd, int(d.get("tbpttBackLength", fwd)))
     built = lb.build()
     # 1.0-era training counters (absent in 0.9.x zips): carried so a
     # resumed Adam/Nadam keeps its bias-correction step count
@@ -734,8 +735,14 @@ def import_dl4j_graph_configuration(source: str):
             g.add_vertex(name, obj, *srcs)
     g.set_outputs(*outputs)
     if d.get("backpropType") == "TruncatedBPTT":
-        g.t_bptt_length(int(d.get("tbpttFwdLength", 20)))
-    return g.build()
+        fwd = int(d.get("tbpttFwdLength", 20))
+        g.t_bptt_length(fwd, int(d.get("tbpttBackLength", fwd)))
+    built = g.build()
+    # 1.0-era training counters, like the MLN path: a resumed Adam/Nadam
+    # needs its bias-correction step count
+    built._dl4j_counters = (int(d.get("iterationCount", 0)),
+                            int(d.get("epochCount", 0)))
+    return built
 
 
 def _read_zip_configuration(z: "zipfile.ZipFile", path: str) -> dict:
@@ -1173,6 +1180,9 @@ def restore_computation_graph(path: str, load_params: bool = True,
                     "outputs against known activations", stacklevel=2)
             coeff = read_nd4j_array_from_bytes(z.read("coefficients.bin"))
             apply_coefficients(net, coeff)
+        counters = getattr(net.conf, "_dl4j_counters", None)
+        if counters is not None:
+            net.iteration, net.epoch = counters
         if (load_params and load_updater and "updaterState.bin" in names):
             upd = read_nd4j_array_from_bytes(z.read("updaterState.bin"))
             apply_updater_state(net, upd)

@@ -7,9 +7,12 @@ binary layout, and ``updaterState.bin`` for known updater classes
 (``ModelSerializer.java:51`` writeModel's file set) — so a model trained
 here can be handed back to a DL4J deployment and keep fine-tuning.
 
-Scope: MultiLayerNetworks over the common layer families (Dense, Output/
-RnnOutput, Convolution, Subsampling, BatchNormalization, Embedding,
-Activation, Dropout, LSTM/GravesLSTM, SimpleRnn, GlobalPooling, Loss).
+Scope: MultiLayerNetworks AND ComputationGraphs over the common layer
+families (Dense, Output/RnnOutput, Convolution, Subsampling,
+BatchNormalization, Embedding, Activation, Dropout, LSTM/GravesLSTM,
+SimpleRnn, GlobalPooling, Loss) and graph vertex types (Merge,
+ElementWise, Subset, Stack/Unstack, Scale/Shift, L2/L2Normalize,
+LastTimeStep/ReverseTimeSeries/DuplicateToTimeSeries).
 Anything the dialect cannot express raises loudly (IDropout objects,
 lr schedules, other layer types). The emitted dialect is exactly what
 ``import_dl4j_configuration`` parses, and the flattened parameter vector
@@ -36,11 +39,12 @@ from deeplearning4j_tpu.modelimport.dl4j import (
     _UPDATER_STATE_SLOTS,
     UnsupportedDl4jConfigurationException,
     _dl4j_param_specs,
+    _layer_seq,
     _updater_blocks,
 )
 from deeplearning4j_tpu.modelimport.nd4j_binary import nd4j_array_to_bytes
 
-__all__ = ["export_multi_layer_network"]
+__all__ = ["export_multi_layer_network", "export_computation_graph"]
 
 _ACT_CLASS = {
     "relu": "ActivationReLU", "relu6": "ActivationReLU6",
@@ -341,11 +345,12 @@ def _export_value(layer, i, name, order, container, permute) -> np.ndarray:
 
 def _updater_state_vector(net, permute) -> Optional[np.ndarray]:
     """updaterState.bin contents in DL4J's block/slot layout, or None
-    when some updater class has no known slot layout."""
+    when some updater class has no known slot layout. Works for both
+    network kinds — ``_layer_seq`` yields MLN layer indices or graph
+    vertex names as the container keys."""
     blocks = _updater_blocks(net.conf, net._updaters)
     segs: List[np.ndarray] = []
-    layers = {i: l for i, l in (enumerate(net.conf.layers)
-                                if hasattr(net.conf, "layers") else [])}
+    layers = dict(_layer_seq(net.conf))
     for u, block in blocks:
         slots = _UPDATER_STATE_SLOTS.get(type(u).__name__)
         if slots is None:
@@ -414,21 +419,30 @@ def export_multi_layer_network(net, path: str,
     if pre:
         doc["inputPreProcessors"] = pre
 
-    # flattened parameter vector in DL4J layout order
+    _write_model_zip(net, path, doc, permute, save_updater)
+
+
+def _flatten_params(net, permute) -> np.ndarray:
+    """Flattened parameter vector in DL4J layout order — ``_layer_seq``
+    yields MLN layer indices or graph vertex names as container keys."""
     segments: List[np.ndarray] = []
-    for i, layer in enumerate(conf.layers):
+    for key, layer in _layer_seq(net.conf):
         for name, _shape, order, _convert, target in _dl4j_param_specs(layer):
-            container = net.params[i] if target == "param" else net.states[i]
+            container = (net.params[key] if target == "param"
+                         else net.states[key])
             if name not in container:
                 raise UnsupportedDl4jConfigurationException(
-                    f"layer {i} has no value for expected param {name!r}")
-            segments.append(_export_value(layer, i, name, order,
+                    f"layer {key!r} has no value for expected param {name!r}")
+            segments.append(_export_value(layer, key, name, order,
                                           container, permute))
-    flat = (np.concatenate(segments) if segments
+    return (np.concatenate(segments) if segments
             else np.zeros(0, np.float32)).reshape(1, -1)
 
-    upd_flat = _updater_state_vector(net, permute) if save_updater else None
 
+def _write_model_zip(net, path, doc, permute, save_updater) -> None:
+    """Shared ModelSerializer-zip epilogue for both network kinds."""
+    flat = _flatten_params(net, permute)
+    upd_flat = _updater_state_vector(net, permute) if save_updater else None
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", json.dumps(doc, indent=1))
         z.writestr("coefficients.bin", nd4j_array_to_bytes(flat, order="c"))
@@ -436,3 +450,131 @@ def export_multi_layer_network(net, path: str,
             z.writestr("updaterState.bin",
                        nd4j_array_to_bytes(upd_flat.reshape(1, -1),
                                            order="c"))
+
+
+# ---------------------------------------------------------------------------
+# ComputationGraph export (ModelSerializer.writeModel's graph half)
+
+def _vertex_entry(v) -> Tuple[str, dict]:
+    """Inverse of ``dl4j._convert_dl4j_vertex``: our vertex object → the
+    DL4J WRAPPER_OBJECT (type name, cfg). Vertex kinds with no DL4J
+    spelling (non-identity PreprocessorVertex, MoE routing, …) raise."""
+    from deeplearning4j_tpu.nn import vertices as V
+
+    if isinstance(v, V.MergeVertex):
+        return "MergeVertex", {}
+    if isinstance(v, V.ElementWiseVertex):
+        # canonical DL4J Op enum names — the runtime also accepts aliases
+        # ('sum'/'mul'/…) that must not leak into the wire format
+        canon = {"add": "Add", "sum": "Add", "subtract": "Subtract",
+                 "sub": "Subtract", "product": "Product", "prod": "Product",
+                 "mul": "Product", "average": "Average", "avg": "Average",
+                 "max": "Max"}
+        op = canon.get(str(v.op).lower())
+        if op is None:
+            raise UnsupportedDl4jConfigurationException(
+                f"cannot express ElementWiseVertex op {v.op!r} in the DL4J "
+                "dialect")
+        return "ElementWiseVertex", {"op": op}
+    if isinstance(v, V.SubsetVertex):
+        return "SubsetVertex", {"from": int(v.from_index),
+                                "to": int(v.to_index)}
+    if isinstance(v, V.StackVertex):
+        return "StackVertex", {}
+    if isinstance(v, V.UnstackVertex):
+        return "UnstackVertex", {"from": int(v.from_index),
+                                 "stackSize": int(v.stack_size)}
+    if isinstance(v, V.ScaleVertex):
+        return "ScaleVertex", {"scaleFactor": float(v.scale_factor)}
+    if isinstance(v, V.ShiftVertex):
+        return "ShiftVertex", {"shiftFactor": float(v.shift_factor)}
+    if isinstance(v, V.L2NormalizeVertex):
+        return "L2NormalizeVertex", {}
+    if isinstance(v, V.L2Vertex):
+        return "L2Vertex", {}
+    if isinstance(v, V.LastTimeStepVertex):
+        return "LastTimeStepVertex", {"maskArrayInputName": v.mask_input}
+    if isinstance(v, V.ReverseTimeSeriesVertex):
+        return "ReverseTimeSeriesVertex", {"maskArrayInputName": v.mask_input}
+    if isinstance(v, V.DuplicateToTimeSeriesVertex):
+        return "DuplicateToTimeSeriesVertex", {"inputName": v.ts_input}
+    raise UnsupportedDl4jConfigurationException(
+        f"cannot express graph vertex {type(v).__name__} in the DL4J "
+        "dialect")
+
+
+def _graph_check_boundaries(conf) -> None:
+    """The graph import path carries NO per-layer input preprocessors
+    (``dl4j._convert_dl4j_vertex`` maps PreprocessorVertex to identity and
+    the graph dialect has no input types), so ANY graph whose build
+    registered an automatic layout preprocessor (conv→dense flatten,
+    cnn_seq reshapes, …) cannot round-trip — reject it loudly rather than
+    export a checkpoint the reader rebuilds without the reshape."""
+    if getattr(conf, "preprocessors", None):
+        names = sorted(conf.preprocessors)
+        raise UnsupportedDl4jConfigurationException(
+            f"graph vertices {names} carry input preprocessors (layout "
+            "boundaries like CnnToFeedForward), which the graph round-trip "
+            "dialect does not model — restructure with a "
+            "GlobalPoolingLayer, or export as MultiLayerNetwork")
+
+
+def export_computation_graph(net, path: str,
+                             save_updater: bool = True) -> None:
+    """Write a ComputationGraph as a DL4J-format zip (configuration.json
+    in the ComputationGraphConfiguration dialect + coefficients.bin in
+    DL4J's OWN topological parameter order + updaterState.bin);
+    re-importable via ``restore_computation_graph``
+    (``ModelSerializer.java:51`` writeModel, graph case —
+    ``ComputationGraphConfiguration.java:62-90`` vertices/vertexInputs/
+    networkInputs/networkOutputs).
+
+    The flattened parameter vector follows the same
+    ``topologicalSortOrder()`` emulation the reader uses
+    (``dl4j._dl4j_topological_order``), so branchy DAGs lay out
+    deterministically on both sides."""
+    conf = net.conf
+    g = conf.global_conf
+    _graph_check_boundaries(conf)
+
+    default_updater = _updater_entry(g.updater) or {
+        "@class": "org.nd4j.linalg.learning.config.Sgd",
+        "learningRate": 0.1}
+
+    vertices: Dict[str, dict] = {}
+    vertex_inputs: Dict[str, list] = {}
+    for name, vd in conf.vertices.items():
+        if vd.is_layer:
+            upd = _updater_entry(vd.obj.updater) or default_updater
+            t, cfg = _layer_entry(vd.obj, upd)
+            bias_u = getattr(vd.obj, "bias_updater", None) or g.bias_updater
+            if bias_u is not None:
+                bias_entry = _updater_entry(bias_u)
+                if bias_entry != upd:
+                    cfg["biasUpdater"] = bias_entry
+            vertices[name] = {"LayerVertex": {"layerConf": {"layer": {t: cfg}}}}
+        else:
+            vt, vc = _vertex_entry(vd.obj)
+            vertices[name] = {vt: vc}
+        vertex_inputs[name] = list(vd.inputs)
+
+    doc: Dict[str, object] = {
+        "vertices": vertices,
+        "vertexInputs": vertex_inputs,
+        "networkInputs": list(conf.inputs),
+        "networkOutputs": list(conf.outputs),
+        "iterationCount": int(net.iteration),
+        "epochCount": int(net.epoch),
+    }
+    from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
+    if normalize_backprop_type(conf.backprop_type) == "truncated_bptt":
+        doc["backpropType"] = "TruncatedBPTT"
+        doc["tbpttFwdLength"] = int(conf.tbptt_fwd_length)
+        doc["tbpttBackLength"] = int(conf.tbptt_bwd_length)
+    else:
+        doc["backpropType"] = "Standard"
+
+    # flattened params in DL4J's topological layer order (same walk the
+    # reader's _iter_param_slices does); no permutation map — layout
+    # boundaries were rejected above
+    _write_model_zip(net, path, doc, {}, save_updater)

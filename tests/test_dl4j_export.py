@@ -379,3 +379,105 @@ class TestHeterogeneousUpdaterMigration:
             for k in a:
                 np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]),
                                            rtol=2e-4, atol=1e-6)
+
+
+class TestComputationGraphExport:
+    """Reverse migration for graphs (ModelSerializer.writeModel, graph
+    case): export -> restore_computation_graph -> output equality AND
+    resumed-training equality; branchy DAGs exercise the shared
+    topologicalSortOrder() parameter layout on both sides."""
+
+    def _branchy_graph(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.vertices import (ElementWiseVertex,
+                                                    MergeVertex)
+        g = (NeuralNetConfiguration.builder().seed(7).updater(Adam(1e-3))
+             .graph_builder().add_inputs("in")
+             .set_input_types(InputType.feed_forward(6)))
+        g.add_layer("a", DenseLayer(n_out=8, activation="tanh"), "in")
+        g.add_layer("b", DenseLayer(n_out=8, activation="relu"), "in")
+        g.add_vertex("sum", ElementWiseVertex(op="add"), "a", "b")
+        g.add_vertex("cat", MergeVertex(), "sum", "a")
+        g.add_layer("head", DenseLayer(n_out=5, activation="tanh"), "cat")
+        g.add_layer("out", OutputLayer(n_out=3), "head")
+        net = ComputationGraph(g.set_outputs("out").build())
+        return net.init()
+
+    def test_branchy_graph_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_computation_graph)
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        net = self._branchy_graph()
+        rng = np.random.RandomState(0)
+        x = rng.randn(12, 6).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 12)]
+        for _ in range(4):
+            net.fit(x, y)
+        path = str(tmp_path / "graph.zip")
+        export_computation_graph(net, path)
+        import zipfile
+        names = set(zipfile.ZipFile(path).namelist())
+        assert {"configuration.json", "coefficients.bin",
+                "updaterState.bin"} <= names
+        again = restore_computation_graph(path)
+        out_a = np.asarray(net.output_single(x))
+        out_b = np.asarray(again.output_single(x))
+        np.testing.assert_allclose(out_b, out_a, rtol=2e-5, atol=1e-6)
+        # resumed training stays identical (updater state crossed the wire)
+        for _ in range(3):
+            net.fit(x, y)
+            again.fit(x, y)
+        np.testing.assert_allclose(np.asarray(again.output_single(x)),
+                                   np.asarray(net.output_single(x)),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_conv_globalpool_graph_round_trip(self, tmp_path):
+        """Conv graph WITHOUT a flatten boundary (GlobalPooling head) —
+        the supported conv spelling."""
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_computation_graph)
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
+        g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+             .graph_builder().add_inputs("img")
+             .set_input_types(InputType.convolutional(8, 8, 1)))
+        g.add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                             convolution_mode="same",
+                                             activation="relu"), "img")
+        g.add_layer("bn", BatchNormalizationLayer(), "conv")
+        g.add_layer("pool", GlobalPoolingLayer(pooling_type="avg"), "bn")
+        g.add_layer("out", OutputLayer(n_in=4, n_out=2), "pool")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        rng = np.random.RandomState(1)
+        x = rng.rand(6, 8, 8, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 6)]
+        for _ in range(3):
+            net.fit(x, y)
+        path = str(tmp_path / "convgraph.zip")
+        export_computation_graph(net, path)
+        again = restore_computation_graph(path)
+        np.testing.assert_allclose(np.asarray(again.output_single(x)),
+                                   np.asarray(net.output_single(x)),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_conv_dense_boundary_rejected_loudly(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+             .graph_builder().add_inputs("img")
+             .set_input_types(InputType.convolutional(8, 8, 1)))
+        g.add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                             convolution_mode="same"), "img")
+        g.add_layer("dense", DenseLayer(n_out=6), "conv")
+        g.add_layer("out", OutputLayer(n_out=2), "dense")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        with pytest.raises(UnsupportedDl4jConfigurationException,
+                           match="CnnToFeedForward"):
+            export_computation_graph(net, str(tmp_path / "x.zip"))

@@ -129,7 +129,38 @@ def rand_recurrent(rng):
     return b.build(), x, y
 
 
-FAMILIES = {"dense": rand_dense, "conv": rand_conv, "rnn": rand_recurrent}
+def rand_graph(rng):
+    """Branchy DAG (merge/elementwise vertices) — exercises the shared
+    topologicalSortOrder() parameter layout on both wire directions."""
+    from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+    g = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
+         .updater(Adam(1e-3)).graph_builder().add_inputs("in")
+         .set_input_types(InputType.feed_forward(5)))
+    width = rng.choice([4, 6])
+    g.add_layer("a", DenseLayer(n_out=width, activation=rng.choice(ACTS),
+                                **layer_extras(rng)), "in")
+    g.add_layer("b", DenseLayer(n_out=width, activation=rng.choice(ACTS)),
+                "in")
+    if rng.random() < 0.5:
+        g.add_vertex("join", ElementWiseVertex(
+            op=rng.choice(["add", "max", "average"])), "a", "b")
+        head_in = width
+    else:
+        g.add_vertex("join", MergeVertex(), "a", "b")
+        head_in = 2 * width
+    g.add_layer("head", DenseLayer(n_in=head_in, n_out=4,
+                                   activation=rng.choice(ACTS),
+                                   **layer_extras(rng)), "join")
+    g.add_layer("out", OutputLayer(n_in=4, n_out=3), "head")
+    conf = g.set_outputs("out").build()
+    x = np.random.RandomState(rng.randint(0, 99)).randn(8, 5).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[
+        np.random.RandomState(rng.randint(0, 99)).randint(0, 3, 8)]
+    return conf, x, y
+
+
+FAMILIES = {"dense": rand_dense, "conv": rand_conv, "rnn": rand_recurrent,
+            "graph": rand_graph}
 
 
 @pytest.mark.parametrize("family", sorted(FAMILIES))
@@ -138,14 +169,27 @@ def test_random_architecture_round_trips(family, seed, tmp_path):
     # deterministic across processes (str hash is PYTHONHASHSEED-random)
     rng = random.Random(1000 * sorted(FAMILIES).index(family) + seed)
     conf, x, y = FAMILIES[family](rng)
-    net = MultiLayerNetwork(conf).init()
+    if family == "graph":
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_computation_graph)
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        net = ComputationGraph(conf).init()
+        export_fn, restore_fn = export_computation_graph, \
+            restore_computation_graph
+        out_of = lambda n: np.asarray(n.output_single(x))
+    else:
+        net = MultiLayerNetwork(conf).init()
+        export_fn, restore_fn = export_multi_layer_network, \
+            restore_multi_layer_network
+        out_of = lambda n: np.asarray(n.output(x))
     for _ in range(3):
         net.fit(x, y)
     path = str(tmp_path / "rt.zip")
-    export_multi_layer_network(net, path)
-    again = restore_multi_layer_network(path)
-    np.testing.assert_allclose(np.asarray(again.output(x)),
-                               np.asarray(net.output(x)),
+    export_fn(net, path)
+    again = restore_fn(path)
+    np.testing.assert_allclose(out_of(again), out_of(net),
                                rtol=2e-5, atol=1e-6)
     # updater state round trip: continued training stays identical. The
     # RNG stream is NOT part of the wire format (DL4J's isn't either), so
@@ -164,8 +208,7 @@ def test_random_architecture_round_trips(family, seed, tmp_path):
     # so the dense W lives in a permuted basis — functionally identical,
     # elementwise different
     np.testing.assert_allclose(
-        np.asarray(again.output(x)), np.asarray(net.output(x)),
-        rtol=2e-4, atol=1e-5,
+        out_of(again), out_of(net), rtol=2e-4, atol=1e-5,
         err_msg=f"{family}/{seed}: training diverged after restore")
     np.testing.assert_allclose(float(again.score_), float(net.score_),
                                rtol=2e-4, atol=1e-6)
