@@ -723,13 +723,26 @@ def import_dl4j_graph_configuration(source: str):
 
     g = NeuralNetConfiguration.builder().graph_builder()
     g.add_inputs(*inputs)
+    layer_pre: Dict[str, object] = {}
+    layer_pre_raw: Dict[str, dict] = {}
     for name, entry in vertices.items():
         if not isinstance(entry, dict) or len(entry) != 1:
             raise InvalidDl4jConfigurationException(f"bad vertex {name!r}")
         vt, vc = next(iter(entry.items()))
-        obj = _convert_dl4j_vertex(vt, vc or {})
+        vc = vc or {}
+        obj = _convert_dl4j_vertex(vt, vc)
         srcs = vertex_inputs.get(name, [])
         if isinstance(obj, Layer):
+            # LayerVertex.java:45 carries an input preprocessor — dropping
+            # it would silently mis-shape e.g. a conv→dense flatten
+            pp = vc.get("preProcessor")
+            if pp is not None:
+                fn = _convert_dl4j_preprocessor(pp)
+                if fn is not None:
+                    layer_pre[name] = fn
+                    # kept verbatim so a restored graph RE-exports the same
+                    # boundary (its weights already index DL4J's order)
+                    layer_pre_raw[name] = pp
             g.add_layer(name, obj, *srcs)
         else:
             g.add_vertex(name, obj, *srcs)
@@ -738,6 +751,10 @@ def import_dl4j_graph_configuration(source: str):
         fwd = int(d.get("tbpttFwdLength", 20))
         g.t_bptt_length(fwd, int(d.get("tbpttBackLength", fwd)))
     built = g.build()
+    # LayerVertex preprocessors override/install AFTER build (no input
+    # types in the DL4J graph dialect, so build inferred none)
+    built.preprocessors.update(layer_pre)
+    built._dl4j_layer_preprocessors = layer_pre_raw
     # 1.0-era training counters, like the MLN path: a resumed Adam/Nadam
     # needs its bias-correction step count
     built._dl4j_counters = (int(d.get("iterationCount", 0)),

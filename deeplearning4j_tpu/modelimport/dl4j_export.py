@@ -294,10 +294,7 @@ def _walk_boundaries(conf):
                 if fed is None or fed.kind != "ff" or it is None:
                     raise UnsupportedDl4jConfigurationException(
                         f"unsupported cnn boundary into layer {i} ({cls})")
-                pre[str(i)] = {"cnnToFeedForward": {
-                    "inputHeight": it.height, "inputWidth": it.width,
-                    "numChannels": it.channels}}
-                permute[i] = (it.height, it.width, it.channels)
+                pre[str(i)], permute[i] = _cnn_to_ff_entry(it)
             elif nature == "rnn":
                 # time-distributed dense: DL4J flattens time around it
                 pre[str(i)] = {"rnnToFeedForward": {}}
@@ -503,20 +500,50 @@ def _vertex_entry(v) -> Tuple[str, dict]:
         "dialect")
 
 
-def _graph_check_boundaries(conf) -> None:
-    """The graph import path carries NO per-layer input preprocessors
-    (``dl4j._convert_dl4j_vertex`` maps PreprocessorVertex to identity and
-    the graph dialect has no input types), so ANY graph whose build
-    registered an automatic layout preprocessor (conv→dense flatten,
-    cnn_seq reshapes, …) cannot round-trip — reject it loudly rather than
-    export a checkpoint the reader rebuilds without the reshape."""
-    if getattr(conf, "preprocessors", None):
-        names = sorted(conf.preprocessors)
+def _cnn_to_ff_entry(it) -> Tuple[dict, tuple]:
+    """The ONE wire spelling of the conv→dense flatten boundary, shared by
+    the MLN (`_walk_boundaries`) and graph (`_graph_boundaries`) walkers:
+    (cnnToFeedForward entry, NHWC→NCHW dense-W permutation key)."""
+    return ({"cnnToFeedForward": {
+        "inputHeight": it.height, "inputWidth": it.width,
+        "numChannels": it.channels}},
+        (it.height, it.width, it.channels))
+
+
+def _graph_boundaries(conf) -> Tuple[Dict[str, dict], Dict[str, tuple]]:
+    """(LayerVertex ``preProcessor`` entries, dense-W permutation map) for
+    every automatic layout preprocessor the graph build registered — the
+    graph twin of ``_walk_boundaries``, carried INSIDE LayerVertex like
+    DL4J does (``LayerVertex.java:45``). A conv→dense flatten emits
+    ``cnnToFeedForward`` (with our NHWC rows re-indexed to its NCHW
+    feature order); any other registered boundary (cnn_flat inputs,
+    cnn_seq reshapes into recurrent layers, cnn3d, …) has no
+    round-trippable spelling and raises loudly.
+
+    A conf that came THROUGH the importer carries the original DL4J
+    entries instead of input types (``_dl4j_layer_preprocessors``); those
+    re-emit verbatim and WITHOUT the weight permutation — the imported
+    model's dense rows already index NCHW features."""
+    pre: Dict[str, dict] = {}
+    permute: Dict[str, tuple] = {}
+    imported = getattr(conf, "_dl4j_layer_preprocessors", {}) or {}
+    for name in getattr(conf, "preprocessors", {}) or {}:
+        if name in imported:
+            pre[name] = imported[name]
+            continue
+        vd = conf.vertices.get(name)
+        its = conf.vertex_input_types.get(name, [])
+        it = its[0] if its else None
+        cls = type(vd.obj).__name__ if vd is not None and vd.is_layer else None
+        if cls in _FF_NATURED and it is not None and it.kind == "cnn":
+            pre[name], permute[name] = _cnn_to_ff_entry(it)
+            continue
         raise UnsupportedDl4jConfigurationException(
-            f"graph vertices {names} carry input preprocessors (layout "
-            "boundaries like CnnToFeedForward), which the graph round-trip "
-            "dialect does not model — restructure with a "
+            f"graph vertex {name!r} carries an input preprocessor with no "
+            "DL4J round-trip spelling (only the conv→dense "
+            "CnnToFeedForward boundary is supported) — restructure with a "
             "GlobalPoolingLayer, or export as MultiLayerNetwork")
+    return pre, permute
 
 
 def export_computation_graph(net, path: str,
@@ -535,7 +562,7 @@ def export_computation_graph(net, path: str,
     deterministically on both sides."""
     conf = net.conf
     g = conf.global_conf
-    _graph_check_boundaries(conf)
+    pre_entries, permute = _graph_boundaries(conf)
 
     default_updater = _updater_entry(g.updater) or {
         "@class": "org.nd4j.linalg.learning.config.Sgd",
@@ -552,7 +579,10 @@ def export_computation_graph(net, path: str,
                 bias_entry = _updater_entry(bias_u)
                 if bias_entry != upd:
                     cfg["biasUpdater"] = bias_entry
-            vertices[name] = {"LayerVertex": {"layerConf": {"layer": {t: cfg}}}}
+            lv = {"layerConf": {"layer": {t: cfg}}}
+            if name in pre_entries:
+                lv["preProcessor"] = pre_entries[name]
+            vertices[name] = {"LayerVertex": lv}
         else:
             vt, vc = _vertex_entry(vd.obj)
             vertices[name] = {vt: vc}
@@ -575,6 +605,6 @@ def export_computation_graph(net, path: str,
         doc["backpropType"] = "Standard"
 
     # flattened params in DL4J's topological layer order (same walk the
-    # reader's _iter_param_slices does); no permutation map — layout
-    # boundaries were rejected above
-    _write_model_zip(net, path, doc, {}, save_updater)
+    # reader's _iter_param_slices does), with conv→dense boundary weights
+    # re-indexed to the NCHW feature order the emitted preprocessor implies
+    _write_model_zip(net, path, doc, permute, save_updater)

@@ -465,19 +465,112 @@ class TestComputationGraphExport:
                                    np.asarray(net.output_single(x)),
                                    rtol=2e-5, atol=1e-6)
 
-    def test_conv_dense_boundary_rejected_loudly(self, tmp_path):
+    def test_conv_dense_boundary_round_trips(self, tmp_path):
+        """Conv→dense flatten in a GRAPH: the exporter emits the
+        cnnToFeedForward preprocessor INSIDE the LayerVertex
+        (LayerVertex.java:45) with the NHWC→NCHW dense-weight row
+        permutation; the importer installs the matching activation
+        transpose — outputs and resumed training stay identical."""
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_computation_graph)
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+             .graph_builder().add_inputs("img")
+             .set_input_types(InputType.convolutional(8, 8, 1)))
+        g.add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                             convolution_mode="same",
+                                             activation="relu"), "img")
+        g.add_layer("dense", DenseLayer(n_out=6, activation="tanh"), "conv")
+        g.add_layer("out", OutputLayer(n_out=2), "dense")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        rng = np.random.RandomState(2)
+        x = rng.rand(5, 8, 8, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 5)]
+        for _ in range(3):
+            net.fit(x, y)
+        path = str(tmp_path / "convdense.zip")
+        export_computation_graph(net, path)
+        import json as _json
+        import zipfile
+        doc = _json.loads(zipfile.ZipFile(path).read("configuration.json"))
+        assert "preProcessor" in doc["vertices"]["dense"]["LayerVertex"]
+        again = restore_computation_graph(path)
+        np.testing.assert_allclose(np.asarray(again.output_single(x)),
+                                   np.asarray(net.output_single(x)),
+                                   rtol=2e-5, atol=1e-6)
+        for _ in range(3):
+            net.fit(x, y)
+            again.fit(x, y)
+        np.testing.assert_allclose(np.asarray(again.output_single(x)),
+                                   np.asarray(net.output_single(x)),
+                                   rtol=2e-4, atol=1e-5)
+
+    def test_unsupported_boundary_rejected_loudly(self, tmp_path):
+        """cnn_seq into a recurrent layer has no DL4J graph spelling."""
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
+             .graph_builder().add_inputs("seq")
+             .set_input_types(InputType.recurrent_convolutional(6, 6, 1, 4)))
+        g.add_layer("rnn", LSTMLayer(n_out=5), "seq")
+        g.add_layer("out", RnnOutputLayer(n_in=5, n_out=2), "rnn")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        with pytest.raises(UnsupportedDl4jConfigurationException,
+                           match="no DL4J round-trip spelling"):
+            export_computation_graph(net, str(tmp_path / "x.zip"))
+
+    def test_restored_graph_re_exports(self, tmp_path):
+        """restore → fine-tune → re-save (the natural handback loop): the
+        restored conf carries the original preProcessor entries, so the
+        second export emits them verbatim WITHOUT re-permuting weights."""
+        from deeplearning4j_tpu.modelimport.dl4j import (
+            restore_computation_graph)
+        from deeplearning4j_tpu.modelimport.dl4j_export import (
+            export_computation_graph)
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        g = (NeuralNetConfiguration.builder().seed(5).updater(Adam(1e-3))
+             .graph_builder().add_inputs("img")
+             .set_input_types(InputType.convolutional(8, 8, 1)))
+        g.add_layer("conv", ConvolutionLayer(n_out=3, kernel_size=(3, 3),
+                                             convolution_mode="same",
+                                             activation="relu"), "img")
+        g.add_layer("dense", DenseLayer(n_out=5, activation="tanh"), "conv")
+        g.add_layer("out", OutputLayer(n_out=2), "dense")
+        net = ComputationGraph(g.set_outputs("out").build()).init()
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 8, 8, 1).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.randint(0, 2, 4)]
+        net.fit(x, y)
+        p1 = str(tmp_path / "one.zip")
+        export_computation_graph(net, p1)
+        mid = restore_computation_graph(p1)
+        mid.fit(x, y)
+        p2 = str(tmp_path / "two.zip")
+        export_computation_graph(mid, p2)
+        final = restore_computation_graph(p2)
+        np.testing.assert_allclose(np.asarray(final.output_single(x)),
+                                   np.asarray(mid.output_single(x)),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_cnn_flat_graph_boundary_rejected(self, tmp_path):
+        """convolutional_flat inputs have no graph-dialect spelling (the
+        imported transpose would crash on 2-D activations) — rejected."""
         from deeplearning4j_tpu.modelimport.dl4j_export import (
             export_computation_graph)
         from deeplearning4j_tpu.nn.conf.inputs import InputType
         from deeplearning4j_tpu.nn.graph import ComputationGraph
         g = (NeuralNetConfiguration.builder().seed(5).updater("sgd")
              .graph_builder().add_inputs("img")
-             .set_input_types(InputType.convolutional(8, 8, 1)))
-        g.add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3),
-                                             convolution_mode="same"), "img")
-        g.add_layer("dense", DenseLayer(n_out=6), "conv")
+             .set_input_types(InputType.convolutional_flat(4, 4, 1)))
+        g.add_layer("dense", DenseLayer(n_out=5), "img")
         g.add_layer("out", OutputLayer(n_out=2), "dense")
         net = ComputationGraph(g.set_outputs("out").build()).init()
         with pytest.raises(UnsupportedDl4jConfigurationException,
-                           match="CnnToFeedForward"):
+                           match="no DL4J round-trip spelling"):
             export_computation_graph(net, str(tmp_path / "x.zip"))

@@ -131,8 +131,31 @@ def rand_recurrent(rng):
 
 def rand_graph(rng):
     """Branchy DAG (merge/elementwise vertices) — exercises the shared
-    topologicalSortOrder() parameter layout on both wire directions."""
+    topologicalSortOrder() parameter layout on both wire directions;
+    sometimes a conv input with a dense head (the LayerVertex
+    preProcessor + NHWC→NCHW permutation path)."""
     from deeplearning4j_tpu.nn.vertices import ElementWiseVertex, MergeVertex
+    if rng.random() < 0.4:
+        g = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
+             .updater(Adam(1e-3)).graph_builder().add_inputs("img")
+             .set_input_types(InputType.convolutional(6, 6, 1)))
+        g.add_layer("conv", ConvolutionLayer(
+            n_out=rng.choice([2, 3]), kernel_size=(3, 3),
+            convolution_mode="same", activation=rng.choice(ACTS)), "img")
+        if rng.random() < 0.5:
+            g.add_layer("bn", BatchNormalizationLayer(), "conv")
+            head_src = "bn"
+        else:
+            head_src = "conv"
+        g.add_layer("dense", DenseLayer(n_out=6, activation=rng.choice(ACTS),
+                                        **layer_extras(rng)), head_src)
+        g.add_layer("out", OutputLayer(n_in=6, n_out=3), "dense")
+        conf = g.set_outputs("out").build()
+        x = np.random.RandomState(rng.randint(0, 99)).rand(6, 6, 6, 1) \
+            .astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[
+            np.random.RandomState(rng.randint(0, 99)).randint(0, 3, 6)]
+        return conf, x, y
     g = (NeuralNetConfiguration.builder().seed(rng.randint(0, 9999))
          .updater(Adam(1e-3)).graph_builder().add_inputs("in")
          .set_input_types(InputType.feed_forward(5)))
