@@ -203,15 +203,39 @@ _POS_SUFFIX_RULES = [
     (re.compile(r".*s$"), "NNS"),
 ]
 _POS_CLOSED = {
+    # determiners / pronouns (incl. the possessive PRP$ set — a finite
+    # class the suffix rules cannot reach)
     "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
+    "these": "DT", "those": "DT", "each": "DT", "every": "DT",
     "he": "PRP", "she": "PRP", "it": "PRP", "they": "PRP", "we": "PRP",
-    "i": "PRP", "you": "PRP", "in": "IN", "on": "IN", "at": "IN",
-    "of": "IN", "for": "IN", "with": "IN", "by": "IN", "from": "IN",
-    "to": "TO", "and": "CC", "or": "CC", "but": "CC", "not": "RB",
+    "i": "PRP", "you": "PRP", "us": "PRP", "them": "PRP", "him": "PRP",
+    "me": "PRP",
+    "my": "PRP$", "your": "PRP$", "his": "PRP$", "her": "PRP$",
+    "its": "PRP$", "our": "PRP$", "their": "PRP$",
+    # prepositions / conjunctions
+    "in": "IN", "on": "IN", "at": "IN", "of": "IN", "for": "IN",
+    "with": "IN", "by": "IN", "from": "IN", "over": "IN", "under": "IN",
+    "about": "IN", "into": "IN", "through": "IN", "during": "IN",
+    "before": "IN", "after": "IN", "between": "IN", "against": "IN",
+    "across": "IN", "along": "IN", "as": "IN",
+    "to": "TO", "and": "CC", "or": "CC", "but": "CC", "nor": "CC",
+    "not": "RB",
+    # auxiliaries / modals (finite classes)
     "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD", "be": "VB",
-    "been": "VBN", "have": "VBP", "has": "VBZ", "had": "VBD",
-    "will": "MD", "would": "MD", "can": "MD", "could": "MD",
+    "been": "VBN", "being": "VBG", "am": "VBP",
+    "have": "VBP", "has": "VBZ", "had": "VBD", "do": "VBP", "does": "VBZ",
+    "did": "VBD",
+    "will": "MD", "would": "MD", "can": "MD", "could": "MD", "shall": "MD",
+    "should": "MD", "may": "MD", "might": "MD", "must": "MD",
+    # number words (cardinals are closed up to compounding)
+    "one": "CD", "two": "CD", "three": "CD", "four": "CD", "five": "CD",
+    "six": "CD", "seven": "CD", "eight": "CD", "nine": "CD", "ten": "CD",
+    "eleven": "CD", "twelve": "CD", "twenty": "CD", "hundred": "CD",
+    "thousand": "CD", "million": "CD",
     "very": "RB", "quickly": "RB",
+    # punctuation (PTB tags punctuation as itself)
+    ".": ".", "!": ".", "?": ".", ",": ",", ";": ":", ":": ":",
+    "(": "-LRB-", ")": "-RRB-", "\"": "''", "'": "''",
 }
 
 
@@ -220,12 +244,19 @@ def heuristic_pos_tagger(tokens: Sequence[str]) -> List[str]:
     the reference loads an OpenNLP model. Capitalized unknown words tag
     NNP, digits CD, everything else NN."""
     tags = []
-    for tok in tokens:
+    for i, tok in enumerate(tokens):
         low = tok.lower()
-        if low in _POS_CLOSED:
+        # the closed-class lookup is case-insensitive, but capitalization
+        # OVERRIDES it away from sentence-initial position: "US"/"IT"
+        # (acronyms) and mid-sentence "May"/"Will" (names, months) are
+        # proper nouns, not pronouns/modals. "I" is always the pronoun.
+        cap_override = (tok != low and tok != "I"
+                        and (i > 0 or (len(tok) > 1 and tok.isupper())))
+        if low in _POS_CLOSED and not cap_override:
             tags.append(_POS_CLOSED[low])
             continue
-        if re.fullmatch(r"[0-9.,]+", tok):
+        # needs a digit (bare "." is punctuation); ".5"-style decimals count
+        if re.fullmatch(r"\d[\d.,]*|\.\d+", tok):
             tags.append("CD")
             continue
         if tok[:1].isupper():

@@ -217,3 +217,55 @@ class TestPosTokenizer:
                                  tagger=lambda ts: ["KEEP" if t == "x" else "DROP"
                                                     for t in ts])
         assert tf.create("x y x").get_tokens() == ["x", "NONE", "x"]
+
+
+class TestPosTaggerMeasuredAccuracy:
+    """The measured number for the bundled suffix-heuristic tagger (the
+    pluggable default where the reference loads an OpenNLP MAXENT model):
+    token accuracy over a hand-tagged 238-token PTB fixture. The residual
+    errors are open-class JJ/NN ambiguity a lexicon-free heuristic cannot
+    resolve — documented in KNOWN_GAPS.md; a real tagger plugs in via
+    PosTokenizerFactory(tagger=...)."""
+
+    def test_accuracy_floor(self):
+        import os
+        from deeplearning4j_tpu.nlp.stemming import heuristic_pos_tagger
+        corpus = os.path.join(os.path.dirname(__file__), "fixtures",
+                              "en_pos_corpus.tsv")
+        total = correct = coarse_ok = 0
+        with open(corpus, encoding="utf-8") as f:
+            for line in f:
+                pairs = [p.rsplit("/", 1) for p in line.split()]
+                toks = [p[0] for p in pairs]
+                gold = [p[1] for p in pairs]
+                pred = heuristic_pos_tagger(toks)
+                for g, p in zip(gold, pred):
+                    total += 1
+                    correct += g == p
+                    gc = g[:2] if g[0] in "NV" else g
+                    pc = p[:2] if p and p[0] in "NV" else p
+                    coarse_ok += gc == pc
+        assert total == 238
+        # measured 2026-07: 0.832 exact / 0.861 coarse (closed classes
+        # complete; residual = open-class JJ/NN)
+        assert correct / total > 0.80
+        assert coarse_ok / total > 0.83
+
+    def test_closed_classes_exact(self):
+        """Punctuation, possessive pronouns, modals, number words are
+        FINITE classes — they must tag exactly."""
+        from deeplearning4j_tpu.nlp.stemming import heuristic_pos_tagger
+        toks = ["My", "brother", "must", "buy", "three", "eggs", "."]
+        tags = heuristic_pos_tagger(toks)
+        assert tags[0] == "PRP$" and tags[2] == "MD"
+        assert tags[4] == "CD" and tags[6] == "."
+
+    def test_capitalization_overrides_closed_classes(self):
+        """Acronyms and mid-sentence capitalized closed-class homographs
+        are proper nouns ("US" the country, "May" the month); sentence-
+        initial closed words and the pronoun "I" keep their tags."""
+        from deeplearning4j_tpu.nlp.stemming import heuristic_pos_tagger as t
+        assert t(["The", "US", "economy"]) == ["DT", "NNP", "NN"]
+        assert t(["In", "May", "we", "met"])[1] == "NNP"
+        assert t(["May", "I", "help"])[:2] == ["MD", "PRP"]
+        assert t(["It", "costs", ".5", "dollars"])[2] == "CD"
