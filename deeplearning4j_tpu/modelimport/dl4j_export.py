@@ -181,6 +181,15 @@ def _layer_entry(layer, updater_entry) -> Tuple[str, dict]:
         cfg["iUpdater"] = updater_entry
     drop = getattr(layer, "dropout", None)
     if drop is not None:
+        from deeplearning4j_tpu.nn.dropout import Dropout as _PlainDropout
+        if type(drop) is _PlainDropout:
+            if not isinstance(drop.p, (int, float)):
+                raise UnsupportedDl4jConfigurationException(
+                    "cannot express a SCHEDULED dropout probability "
+                    f"({type(drop.p).__name__}) in the DL4J dialect — "
+                    "plain Dropout objects export as scalar dropOut")
+            # a plain inverted-dropout object IS DL4J's scalar dropOut
+            drop = float(drop.p)
         if not isinstance(drop, (int, float)):
             raise UnsupportedDl4jConfigurationException(
                 f"cannot express dropout object {type(drop).__name__} in "

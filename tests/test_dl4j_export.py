@@ -574,3 +574,26 @@ class TestComputationGraphExport:
         with pytest.raises(UnsupportedDl4jConfigurationException,
                            match="no DL4J round-trip spelling"):
             export_computation_graph(net, str(tmp_path / "x.zip"))
+
+
+def test_plain_dropout_object_exports_as_scalar(tmp_path):
+    """Dropout(0.9) the OBJECT is the same thing as dropout=0.9 — it
+    exports as DL4J's scalar dropOut (scheduled/exotic IDropout still
+    rejects loudly)."""
+    from deeplearning4j_tpu.nn.dropout import Dropout
+    conf = (NeuralNetConfiguration.builder().seed(1).updater("sgd").list()
+            .layer(DenseLayer(n_in=3, n_out=4, dropout=Dropout(0.9)))
+            .layer(OutputLayer(n_in=4, n_out=2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    path = str(tmp_path / "d.zip")
+    export_multi_layer_network(net, path)
+    import json as _json
+    import zipfile
+    doc = _json.loads(zipfile.ZipFile(path).read("configuration.json"))
+    dense_cfg = doc["confs"][0]["layer"]["dense"]
+    assert dense_cfg["dropOut"] == 0.9
+    again = restore_multi_layer_network(path)
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(again.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-6)
