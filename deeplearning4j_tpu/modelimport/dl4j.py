@@ -773,7 +773,12 @@ def _read_zip_configuration(z: "zipfile.ZipFile", path: str) -> dict:
 
 def import_dl4j_zip(path: str):
     """ModelSerializer zip → (config, metadata). For parameter ingestion
-    use :func:`restore_multi_layer_network`."""
+    use :func:`restore_multi_layer_network`. When the zip carries a
+    ``normalizer.bin`` (``ModelSerializer.java:40``), the parsed normalizer
+    object rides along as ``meta["normalizer"]``."""
+    from deeplearning4j_tpu.modelimport.normalizer_serde import (
+        normalizer_from_bytes)
+
     with zipfile.ZipFile(path) as z:
         names = set(z.namelist())
         raw = _read_zip_configuration(z, path)
@@ -781,8 +786,46 @@ def import_dl4j_zip(path: str):
                 else import_dl4j_configuration(raw))
         meta = {"has_coefficients": "coefficients.bin" in names,
                 "has_updater_state": "updaterState.bin" in names,
-                "has_normalizer": "normalizer.bin" in names}
+                "has_normalizer": "normalizer.bin" in names,
+                "normalizer": None}
+        if meta["has_normalizer"]:
+            # a CUSTOM-strategy / pre-0.9 / corrupt normalizer must not
+            # fail the MODEL import — the reference's restore path never
+            # touches normalizer.bin either; record the reason instead
+            try:
+                meta["normalizer"] = normalizer_from_bytes(
+                    z.read("normalizer.bin"))
+            except Exception as e:  # incl. BadZipFile on a bit-rotted entry
+                meta["normalizer_error"] = f"{type(e).__name__}: {e}"
     return conf, meta
+
+
+def restore_normalizer(path: str):
+    """``ModelSerializer.restoreNormalizerFromFile`` parity
+    (``util/ModelSerializer.java:707``): parse the zip's ``normalizer.bin``
+    into a fitted :class:`~deeplearning4j_tpu.datasets.normalizers.Normalizer`.
+    Returns None when the zip has no normalizer entry (the reference returns
+    null there too)."""
+    from deeplearning4j_tpu.modelimport.normalizer_serde import (
+        normalizer_from_bytes)
+
+    with zipfile.ZipFile(path) as z:
+        if "normalizer.bin" not in set(z.namelist()):
+            return None
+        return normalizer_from_bytes(z.read("normalizer.bin"))
+
+
+def add_normalizer_to_model(path: str, normalizer) -> None:
+    """``ModelSerializer.addNormalizerToModel`` parity
+    (``util/ModelSerializer.java:654``): rewrite the zip with every entry
+    except any existing ``normalizer.bin`` (``:670`` skips it,
+    case-insensitively), then append the serialized normalizer as a fresh
+    entry (``:682-686``)."""
+    from deeplearning4j_tpu.modelimport.normalizer_serde import (
+        normalizer_to_bytes)
+    from deeplearning4j_tpu.util.model_serializer import replace_zip_entry
+
+    replace_zip_entry(path, "normalizer.bin", normalizer_to_bytes(normalizer))
 
 
 def restore_multi_layer_network_configuration(path: str):

@@ -374,11 +374,14 @@ def _updater_state_vector(net, permute) -> Optional[np.ndarray]:
 
 
 def export_multi_layer_network(net, path: str,
-                               save_updater: bool = True) -> None:
+                               save_updater: bool = True,
+                               normalizer=None) -> None:
     """Write ``net`` as a DL4J-format zip (configuration.json +
-    coefficients.bin + updaterState.bin); re-importable via
-    ``restore_multi_layer_network`` and structured for DL4J's own
-    ``ModelSerializer``."""
+    coefficients.bin + updaterState.bin + normalizer.bin when
+    ``normalizer`` is given, matching ``ModelSerializer.writeModel``'s
+    optional dataNormalization argument, ``ModelSerializer.java:106,
+    165-168``); re-importable via ``restore_multi_layer_network`` and
+    structured for DL4J's own ``ModelSerializer``."""
     conf = net.conf
     if conf.input_pre_processors:
         raise UnsupportedDl4jConfigurationException(
@@ -425,7 +428,7 @@ def export_multi_layer_network(net, path: str,
     if pre:
         doc["inputPreProcessors"] = pre
 
-    _write_model_zip(net, path, doc, permute, save_updater)
+    _write_model_zip(net, path, doc, permute, save_updater, normalizer)
 
 
 def _flatten_params(net, permute) -> np.ndarray:
@@ -445,10 +448,16 @@ def _flatten_params(net, permute) -> np.ndarray:
             else np.zeros(0, np.float32)).reshape(1, -1)
 
 
-def _write_model_zip(net, path, doc, permute, save_updater) -> None:
+def _write_model_zip(net, path, doc, permute, save_updater,
+                     normalizer=None) -> None:
     """Shared ModelSerializer-zip epilogue for both network kinds."""
     flat = _flatten_params(net, permute)
     upd_flat = _updater_state_vector(net, permute) if save_updater else None
+    norm_bytes = None
+    if normalizer is not None:
+        from deeplearning4j_tpu.modelimport.normalizer_serde import (
+            normalizer_to_bytes)
+        norm_bytes = normalizer_to_bytes(normalizer)
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
         z.writestr("configuration.json", json.dumps(doc, indent=1))
         z.writestr("coefficients.bin", nd4j_array_to_bytes(flat, order="c"))
@@ -456,6 +465,9 @@ def _write_model_zip(net, path, doc, permute, save_updater) -> None:
             z.writestr("updaterState.bin",
                        nd4j_array_to_bytes(upd_flat.reshape(1, -1),
                                            order="c"))
+        if norm_bytes is not None:
+            # ModelSerializer.java:165-168 — normalizer as additional entry
+            z.writestr("normalizer.bin", norm_bytes)
 
 
 # ---------------------------------------------------------------------------
@@ -556,7 +568,8 @@ def _graph_boundaries(conf) -> Tuple[Dict[str, dict], Dict[str, tuple]]:
 
 
 def export_computation_graph(net, path: str,
-                             save_updater: bool = True) -> None:
+                             save_updater: bool = True,
+                             normalizer=None) -> None:
     """Write a ComputationGraph as a DL4J-format zip (configuration.json
     in the ComputationGraphConfiguration dialect + coefficients.bin in
     DL4J's OWN topological parameter order + updaterState.bin);
@@ -616,4 +629,4 @@ def export_computation_graph(net, path: str,
     # flattened params in DL4J's topological layer order (same walk the
     # reader's _iter_param_slices does), with conv→dense boundary weights
     # re-indexed to the NCHW feature order the emitted preprocessor implies
-    _write_model_zip(net, path, doc, permute, save_updater)
+    _write_model_zip(net, path, doc, permute, save_updater, normalizer)

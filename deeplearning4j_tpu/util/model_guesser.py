@@ -83,6 +83,13 @@ def load_config_guess(path: str):
 
 
 def load_normalizer(path: str):
-    """Facade for ``ModelSerializer.restoreNormalizerFromFile``."""
+    """Facade for ``ModelSerializer.restoreNormalizerFromFile``
+    (``ModelGuesser.java:38``): our own ``normalizer.json`` zips first,
+    then the reference's binary ``normalizer.bin``
+    (NormalizerSerializer stream)."""
     from deeplearning4j_tpu.util import model_serializer as ms
-    return ms.restore_normalizer(path)
+    own = ms.restore_normalizer(path)
+    if own is not None:
+        return own
+    from deeplearning4j_tpu.modelimport import dl4j
+    return dl4j.restore_normalizer(path)

@@ -51,6 +51,35 @@ def _npz_bytes(arrays: dict) -> bytes:
     return buf.getvalue()
 
 
+def replace_zip_entry(path, entry_name: str, payload: bytes) -> None:
+    """Atomically rewrite the zip at ``path`` with every entry except
+    ``entry_name`` (matched case-insensitively, as ``ModelSerializer.java:
+    670`` does), then append ``payload`` under that name. Preserves the
+    original file's permissions and cleans up the temp file on error."""
+    import os
+    import tempfile
+
+    path = str(path)
+    mode = os.stat(path).st_mode & 0o7777
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(os.path.abspath(path)),
+                               suffix=".zip")
+    os.close(fd)
+    try:
+        with zipfile.ZipFile(path) as zin, \
+                zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zout:
+            for entry in zin.namelist():
+                if entry.lower() == entry_name.lower():
+                    continue
+                zout.writestr(entry, zin.read(entry))
+            zout.writestr(entry_name, payload)
+        os.chmod(tmp, mode)
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        raise
+
+
 def write_model(model, path: Union[str, Path], *, save_updater: bool = True,
                 normalizer=None) -> None:
     """ModelSerializer.writeModel parity."""
@@ -168,15 +197,8 @@ def restore_model(path, *, load_updater: bool = True):
 
 def add_normalizer_to_model(path, normalizer) -> None:
     """ModelSerializer.addNormalizerToModel:654 parity (rewrites the zip)."""
-    path = Path(path)
-    tmp = path.with_suffix(path.suffix + ".tmp")
-    with zipfile.ZipFile(path, "r") as zin, \
-            zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zout:
-        for item in zin.namelist():
-            if item != NORMALIZER_NAME:
-                zout.writestr(item, zin.read(item))
-        zout.writestr(NORMALIZER_NAME, normalizer.to_json())
-    tmp.replace(path)
+    replace_zip_entry(path, NORMALIZER_NAME,
+                      normalizer.to_json().encode("utf-8"))
 
 
 def restore_normalizer(path):

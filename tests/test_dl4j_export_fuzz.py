@@ -235,3 +235,37 @@ def test_random_architecture_round_trips(family, seed, tmp_path):
         err_msg=f"{family}/{seed}: training diverged after restore")
     np.testing.assert_allclose(float(again.score_), float(net.score_),
                                rtol=2e-4, atol=1e-6)
+
+    # normalizer.bin rides the same zip (r5): fuzz a random strategy into
+    # the exported checkpoint and require the restored normalizer to
+    # transform identically (ModelSerializer.java:654/707)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.datasets.normalizers import (
+        ImagePreProcessingScaler, NormalizerMinMaxScaler,
+        NormalizerStandardize)
+    from deeplearning4j_tpu.modelimport.dl4j import (
+        add_normalizer_to_model, restore_normalizer)
+
+    ds = DataSet(x, y)
+    kind = rng.randrange(3)
+    if kind == 0:
+        norm = NormalizerStandardize()
+        norm.fit_label = rng.random() < 0.5
+        norm.fit(ds)
+    elif kind == 1:
+        norm = NormalizerMinMaxScaler(rng.uniform(-2, 0), rng.uniform(1, 3))
+        norm.fit_label = rng.random() < 0.5
+        norm.fit(ds)
+    else:
+        norm = ImagePreProcessingScaler(0.0, 1.0, rng.choice([1.0, 255.0]))
+    add_normalizer_to_model(path, norm)
+    back = restore_normalizer(path)
+    assert type(back) is type(norm)
+    t_ours, t_back = norm.transform(ds), back.transform(ds)
+    np.testing.assert_allclose(np.asarray(t_back.features),
+                               np.asarray(t_ours.features), rtol=1e-6,
+                               atol=1e-6)
+    if getattr(norm, "fit_label", False):
+        np.testing.assert_allclose(np.asarray(t_back.labels),
+                                   np.asarray(t_ours.labels), rtol=1e-6,
+                                   atol=1e-6)
