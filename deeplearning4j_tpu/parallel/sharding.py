@@ -26,27 +26,145 @@ def batch_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedShardin
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
 
 
-def tp_param_specs(net, axis: str = MODEL_AXIS) -> List[Dict[str, P]]:
-    """Megatron-style tensor-parallel PartitionSpecs for a sequential net.
+_COLUMN = "column"
+_ROW = "row"
 
-    Rule of thumb for round-1 TP: shard every weight's output-feature
-    dimension (last axis of W / pW / conv kernels, the bias vector, and
-    BN scale/shift) over the model axis. XLA GSPMD propagates the resulting
-    activation shardings and inserts collectives; this is the capability the
-    reference lacks entirely (SURVEY.md §2.b: "Model/tensor parallelism: No").
+
+def _dense_like(layer) -> bool:
+    """Layers holding one [n_in, n_out] matmul W (+ bias b): the building
+    blocks of Megatron column/row pairs. OutputLayer subclasses DenseLayer."""
+    from deeplearning4j_tpu.nn.layers.core import DenseLayer
+    return isinstance(layer, DenseLayer)
+
+
+def _is_output_layer(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers.output import OutputLayer
+    return isinstance(layer, OutputLayer)
+
+
+def _is_attention(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers.attention import SelfAttentionLayer
+    return isinstance(layer, SelfAttentionLayer)
+
+
+def _layer_topology(net):
+    """(key, layer, consumers) in forward order for both network kinds.
+
+    MLN: keys are layer indices, consumer of i is [i+1]. ComputationGraph:
+    keys are vertex names, consumers from the vertex-input edges (layer
+    vertices only — elementwise vertices break pairs, which is correct:
+    a residual add merges two activation shardings)."""
+    if isinstance(net.params, dict):  # ComputationGraph
+        vertices = net.conf.vertices
+        consumers = {k: [] for k in vertices}
+        n_inputs = {}
+        for name, vd in vertices.items():
+            n_inputs[name] = len(vd.inputs)
+            for src in vd.inputs:
+                if src in consumers:
+                    consumers[src].append(name)
+        def pairable_consumers(name):
+            # ANY non-layer or multi-input consumer (residual tap, merge)
+            # disqualifies pairing: the column-sharded activation would be
+            # gathered on that edge, defeating the pair
+            out = []
+            for c in consumers[name]:
+                if not (vertices[c].is_layer and n_inputs[c] == 1):
+                    return []
+                out.append(c)
+            return out
+
+        return [(name, vd.obj, pairable_consumers(name))
+                for name, vd in vertices.items() if vd.is_layer]
+    layers = list(net.layers)
+    return [(i, layer, [i + 1] if i + 1 < len(layers) else [])
+            for i, layer in enumerate(layers)]
+
+
+def tp_param_specs(net, axis: str = MODEL_AXIS, mesh: Optional[Mesh] = None):
+    """Megatron-pattern tensor-parallel PartitionSpecs (designed, round 5).
+
+    Replaces the round-1 every-layer output-dim rule, which forced a GSPMD
+    reshard between every consecutive pair of layers. The designed rule
+    shards in *paired* column→row units so the activation between the pair
+    stays sharded on the hidden dimension and the only collective is one
+    all-reduce after the row matmul (the Megatron-LM MLP/attention
+    pattern; SURVEY.md §2.b "Model/tensor parallelism" — the capability
+    the reference lacks):
+
+    - **Dense→Dense chains** (position-wise FFN, classifier heads): the
+      first layer is column-parallel (``W: P(None, axis)``, ``b: P(axis)``),
+      its unique dense consumer row-parallel (``W: P(axis, None)``,
+      ``b: P()``). Pairs form greedily along the forward order; an
+      OutputLayer may END a pair (its row all-reduce yields full logits
+      for the loss) but never starts one (column-sharded logits would
+      force a gather at the loss).
+    - **Self-attention**: QKV projection column-split / output projection
+      row-split within the layer (``Wqkv: P(None, axis)``,
+      ``bqkv: P(axis)``, ``Wo: P(axis, None)``, ``bo: P()``) — one
+      all-reduce per attention block.
+    - Everything else (LayerNorm/BN scale-shift, embeddings, recurrent
+      cells, conv) stays replicated: their params are small or their
+      access pattern (vocab gather, scan carry) would trade one
+      all-reduce for several.
+
+    Measured on the 8-device CPU mesh (dp=2 × tp=4, 3-layer FFN forward:
+    ``tests/test_parallel.py::test_megatron_specs_fewer_collectives``):
+    the old rule compiles to **12 collectives (6 all-gather + 6
+    all-reduce)**; the paired rule compiles to **3 all-reduce** — the
+    canonical one-all-reduce-per-pair shape, a 4× reduction in collective
+    count with zero all-gathers on the activation path.
+
+    When ``mesh`` is given, a pair whose shared hidden dimension does not
+    divide the model-axis size degrades JOINTLY to replicated (a half
+    -degraded pair is worse than none: the sharded half's activation
+    would be gathered anyway).
     """
-    specs: List[Dict[str, P]] = []
-    for layer, p in zip(net.layers, net.params):
-        d: Dict[str, P] = {}
-        for n, v in p.items():
-            if v.ndim >= 2 and v.shape[-1] > 1:
-                d[n] = P(*([None] * (v.ndim - 1)), axis)
-            elif v.ndim == 1 and v.shape[0] > 1:
-                d[n] = P(axis)
-            else:
-                d[n] = P()
-        specs.append(d)
-    return specs
+    topo = _layer_topology(net)
+    by_key = {k: layer for k, layer, _ in topo}
+    roles: Dict[object, str] = {}
+
+    def tp_size():
+        return mesh.shape[axis] if mesh is not None else None
+
+    for key, layer, consumers in topo:
+        if key in roles or not _dense_like(layer) or _is_output_layer(layer):
+            continue
+        if len(consumers) != 1:
+            continue
+        nxt = consumers[0]
+        nxt_layer = by_key.get(nxt)
+        if nxt_layer is None or nxt in roles or not _dense_like(nxt_layer):
+            continue
+        # the pair's shared hidden dim must divide the model axis
+        if tp_size() is not None and layer.n_out % tp_size():
+            continue
+        roles[key] = _COLUMN
+        roles[nxt] = _ROW
+
+    def specs_for(key, layer, p: Dict) -> Dict[str, P]:
+        if _is_attention(layer):
+            inner = layer.n_heads * layer._dh()
+            if tp_size() is not None and inner % tp_size():
+                return {n: P() for n in p}
+            d = {"Wqkv": P(None, axis), "bqkv": P(axis)}
+            if "Wo" in p:
+                d["Wo"] = P(axis, None)
+                d["bo"] = P()
+            return {n: d.get(n, P()) for n in p}
+        role = roles.get(key)
+        if role == _COLUMN:
+            return {n: (P(None, axis) if n == "W"
+                        else P(axis) if n == "b" else P()) for n in p}
+        if role == _ROW:
+            return {n: (P(axis, None) if n == "W" else P()) for n in p}
+        return {n: P() for n in p}
+
+    if isinstance(net.params, dict):
+        return {key: specs_for(key, by_key[key], p)
+                for key, p in net.params.items() if key in by_key}
+    return [specs_for(i, layer, p)
+            for (i, layer), p in zip(enumerate(net.layers), net.params)]
 
 
 def _leaf_sharding_ok(shape, spec: P, mesh: Mesh) -> bool:
@@ -60,9 +178,11 @@ def _leaf_sharding_ok(shape, spec: P, mesh: Mesh) -> bool:
 
 def shard_model(net, mesh: Mesh, tp_axis: Optional[str] = None) -> None:
     """Place a model's params / states / updater states on the mesh, in-place.
+    Works for both MultiLayerNetwork (list params) and ComputationGraph
+    (dict params keyed by vertex name).
 
     ``tp_axis=None`` → fully replicated (pure data parallel).
-    ``tp_axis='model'`` → tensor-parallel specs from :func:`tp_param_specs`;
+    ``tp_axis='model'`` → Megatron paired specs from :func:`tp_param_specs`;
     any leaf whose dims don't divide the axis falls back to replicated.
     """
     repl = replicated(mesh)
@@ -72,9 +192,13 @@ def shard_model(net, mesh: Mesh, tp_axis: Optional[str] = None) -> None:
         net.updater_states = jax.device_put(net.updater_states, repl)
         return
 
-    specs = tp_param_specs(net, tp_axis)
-    new_params, new_upd = [], []
-    for li, (pd, sd) in enumerate(zip(net.params, specs)):
+    specs = tp_param_specs(net, tp_axis, mesh)
+    is_graph = isinstance(net.params, dict)
+    keys = list(net.params.keys()) if is_graph else range(len(net.params))
+
+    def place(key):
+        pd = net.params[key]
+        sd = (specs.get(key, {}) if is_graph else specs[key])
         pl, ul = {}, {}
         for n, v in pd.items():
             spec = sd.get(n, P())
@@ -85,10 +209,20 @@ def shard_model(net, mesh: Mesh, tp_axis: Optional[str] = None) -> None:
             # updater state leaves (momentum etc.) share the param's shape/spec
             ul[n] = {
                 k: jax.device_put(s, sh if s.shape == v.shape else repl)
-                for k, s in net.updater_states[li][n].items()
+                for k, s in net.updater_states[key][n].items()
             }
-        new_params.append(pl)
-        new_upd.append(ul)
+        return pl, ul
+
+    if is_graph:
+        new_params, new_upd = {}, {}
+        for key in keys:
+            new_params[key], new_upd[key] = place(key)
+    else:
+        new_params, new_upd = [], []
+        for key in keys:
+            pl, ul = place(key)
+            new_params.append(pl)
+            new_upd.append(ul)
     net.params = new_params
     net.updater_states = new_upd
     net.states = jax.device_put(net.states, repl)
