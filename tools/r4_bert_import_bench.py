@@ -28,7 +28,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-H5 = "/tmp/bert_base_import.h5"
+H5 = os.environ.get("DL4J_TPU_BERT_H5", "/tmp/bert_base_import.h5")
 T, V, D, NH, FF, L = 128, 30522, 768, 12, 3072, 12
 BATCH = 32
 
