@@ -733,23 +733,29 @@ def map_keras_layer(class_name: str, cfg: dict) -> Tuple[Optional[Layer], Weight
         def mha_weights(raw):
             # keras MHA: query/key/value kernels [d_model, H, Dh] + biases
             # [H, Dh]; attention_output kernel [H, Dh, d_model] + bias
-            # [d_model]. Pack into the fused layout: Wqkv [d_model, 3*H*Dh]
-            # (q|k|v blocks, each H-major), Wo [H*Dh, d_model].
+            # [d_model]. Pack into SelfAttentionLayer's HEAD-MAJOR fused
+            # layout: Wqkv [d_model, H*3*Dh] with each head's q|k|v block
+            # contiguous (attention.py param_shapes — the layout that lets
+            # tensor-parallel column sharding propagate), Wo [H*Dh, d_model].
             wq = np.asarray(raw["query_kernel"])
             d_model = wq.shape[0]
             inner = wq.shape[1] * wq.shape[2]
-            packs = [np.asarray(raw[f"{p}_kernel"]).reshape(d_model, inner)
-                     for p in ("query", "key", "value")]
+            h, dh = wq.shape[1], wq.shape[2]
+            kernels = [np.asarray(raw[f"{p}_kernel"])
+                       for p in ("query", "key", "value")]     # [D,H,Dh] x3
             # use_bias=False stores no bias datasets: zero bias == no bias
-            biases = [np.asarray(raw[f"{p}_bias"]).reshape(inner)
-                      if f"{p}_bias" in raw else np.zeros(inner, np.float32)
-                      for p in ("query", "key", "value")]
+            biases = [np.asarray(raw[f"{p}_bias"])
+                      if f"{p}_bias" in raw else np.zeros((h, dh), np.float32)
+                      for p in ("query", "key", "value")]      # [H,Dh] x3
+            wqkv = np.stack(kernels, axis=2)                   # [D,H,3,Dh]
+            bqkv = np.stack([b.reshape(h, dh) for b in biases],
+                            axis=1)                            # [H,3,Dh]
             wo = np.asarray(raw["attention_output_kernel"]).reshape(inner, -1)
             bo = (np.asarray(raw["attention_output_bias"])
                   if "attention_output_bias" in raw
                   else np.zeros(wo.shape[1], np.float32))
-            return ({"Wqkv": np.concatenate(packs, axis=1),
-                     "bqkv": np.concatenate(biases),
+            return ({"Wqkv": wqkv.reshape(d_model, 3 * inner),
+                     "bqkv": bqkv.reshape(3 * inner),
                      "Wo": wo,
                      "bo": bo}, {})
 

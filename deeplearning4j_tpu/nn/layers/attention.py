@@ -109,6 +109,12 @@ class SelfAttentionLayer(Layer):
         return self.head_size or self.n_out // self.n_heads
 
     def param_shapes(self):
+        # Wqkv columns are HEAD-MAJOR [H, 3, Dh] (each head's q|k|v block
+        # contiguous), NOT [3, H, Dh]: a column-sharded Wqkv then propagates
+        # through the (n,t,h,3,dh) reshape under GSPMD whenever tp divides
+        # n_heads, keeping tensor-parallel attention at one all-reduce per
+        # block. The [3,H,Dh] order measured 5 extra qkv all-gathers on a
+        # tp=4 mesh (tests/test_parallel.py::test_attention_collectives).
         dh = self._dh()
         inner = self.n_heads * dh
         shapes = {"Wqkv": (self.n_in, 3 * inner), "bqkv": (3 * inner,)}
@@ -137,8 +143,8 @@ class SelfAttentionLayer(Layer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         n, t, _ = x.shape
         h, dh = self.n_heads, self._dh()
-        qkv = x @ params["Wqkv"] + params["bqkv"]              # [N,T,3*H*Dh]
-        qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)  # [3,N,H,T,Dh]
+        qkv = x @ params["Wqkv"] + params["bqkv"]              # [N,T,H*3*Dh]
+        qkv = qkv.reshape(n, t, h, 3, dh).transpose(3, 0, 2, 1, 4)  # [3,N,H,T,Dh]
         q, k, v = qkv[0], qkv[1], qkv[2]
         out = dot_product_attention(q, k, v, mask=mask, causal=self.causal,
                                     dropout_rate=self.attn_dropout,
@@ -200,7 +206,7 @@ class CausalSelfAttentionLayer(SelfAttentionLayer, BaseRecurrentLayer):
                 f"{int(pos)} exceeds max_cache={tc}; raise max_cache or "
                 f"rnn_clear_previous_state() first")
         qkv = x @ params["Wqkv"] + params["bqkv"]
-        qkv = qkv.reshape(n, t, 3, h, dh).transpose(2, 0, 3, 1, 4)
+        qkv = qkv.reshape(n, t, h, 3, dh).transpose(3, 0, 2, 1, 4)
         q, k, v = qkv[0], qkv[1], qkv[2]
         zero = jnp.zeros((), pos.dtype)  # match pos dtype (x64 mode safe)
         kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
@@ -228,6 +234,64 @@ class CausalSelfAttentionLayer(SelfAttentionLayer, BaseRecurrentLayer):
         if self.project_input:
             y = y @ params["Wo"] + params["bo"]
         return self.act_fn()(y), (kc, vc, valid, pos + t)
+
+
+#: stamped into checkpoint metadata by the serializers; its absence marks a
+#: pre-round-5 checkpoint whose fused attention weights use the legacy
+#: [3|2, H, Dh] block-major column order and need repacking on load
+QKV_LAYOUT = "head_major"
+
+_FUSED_PARTS = {"Wqkv": 3, "bqkv": 3, "Wkv": 2, "bkv": 2}
+
+
+def repack_legacy_fused_qkv(model) -> int:
+    """Migrate a model whose attention params were saved in the pre-round-5
+    block-major fused order ([3,H,Dh] / [2,H,Dh] columns) to the current
+    head-major order ([H,3,Dh] / [H,2,Dh] — the layout that lets a
+    column-sharded Wqkv propagate through the qkv reshape under GSPMD).
+    Repacks params AND matching updater-state slots in place; returns the
+    number of arrays repacked. Called by the checkpoint restorers when the
+    checkpoint metadata carries no ``qkv_layout`` stamp."""
+    import numpy as np
+
+    def layer_items():
+        if isinstance(model.params, dict):
+            for name, vd in model.conf.vertices.items():
+                if vd.is_layer and name in model.params:
+                    yield name, vd.obj
+        else:
+            for i, layer in enumerate(model.layers):
+                yield i, layer
+
+    def repack(arr, parts, h, dh):
+        a = np.asarray(arr)
+        if a.ndim == 1:
+            return jnp.asarray(
+                a.reshape(parts, h, dh).transpose(1, 0, 2).reshape(-1))
+        d = a.shape[0]
+        return jnp.asarray(
+            a.reshape(d, parts, h, dh).transpose(0, 2, 1, 3).reshape(d, -1))
+
+    n_repacked = 0
+    for key, layer in layer_items():
+        if not isinstance(layer, SelfAttentionLayer):
+            continue
+        h, dh = layer.n_heads, layer._dh()
+        if h <= 1:
+            continue  # single head: both layouts are identical
+        pd = model.params[key]
+        for pn, parts in _FUSED_PARTS.items():
+            if pn not in pd:
+                continue
+            pd[pn] = repack(pd[pn], parts, h, dh)
+            n_repacked += 1
+            upd = model.updater_states[key].get(pn, {}) \
+                if model.updater_states is not None else {}
+            for slot, arr in upd.items():
+                if np.asarray(arr).shape == np.asarray(pd[pn]).shape:
+                    upd[slot] = repack(arr, parts, h, dh)
+                    n_repacked += 1
+    return n_repacked
 
 
 @register_layer
@@ -263,8 +327,8 @@ class LearnedSelfAttentionLayer(SelfAttentionLayer):
     def forward(self, params, x, *, state=None, train=False, rng=None, mask=None):
         n, t, _ = x.shape
         h, dh = self.n_heads, self._dh()
-        kv = x @ params["Wkv"] + params["bkv"]
-        kv = kv.reshape(n, t, 2, h, dh).transpose(2, 0, 3, 1, 4)
+        kv = x @ params["Wkv"] + params["bkv"]  # head-major [H,2,Dh] columns
+        kv = kv.reshape(n, t, h, 2, dh).transpose(3, 0, 2, 1, 4)
         k, v = kv[0], kv[1]
         q = jnp.broadcast_to(params["Q"].transpose(1, 0, 2)[None], (n, h, self.n_queries, dh))
         out = dot_product_attention(q, k, v, mask=mask, dropout_rate=self.attn_dropout,

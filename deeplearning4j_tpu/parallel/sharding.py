@@ -9,7 +9,7 @@ matching collectives (all-gather / reduce-scatter) around the matmuls.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -63,13 +63,18 @@ def _layer_topology(net):
             for src in vd.inputs:
                 if src in consumers:
                     consumers[src].append(name)
+        # like the MLN branch: a per-vertex input preprocessor reshapes the
+        # activation between the pair and would gather the column sharding
+        graph_pre = set(getattr(net.conf, "preprocessors", {}) or {})
+
         def pairable_consumers(name):
             # ANY non-layer or multi-input consumer (residual tap, merge)
             # disqualifies pairing: the column-sharded activation would be
             # gathered on that edge, defeating the pair
             out = []
             for c in consumers[name]:
-                if not (vertices[c].is_layer and n_inputs[c] == 1):
+                if not (vertices[c].is_layer and n_inputs[c] == 1
+                        and c not in graph_pre):
                     return []
                 out.append(c)
             return out
@@ -77,7 +82,13 @@ def _layer_topology(net):
         return [(name, vd.obj, pairable_consumers(name))
                 for name, vd in vertices.items() if vd.is_layer]
     layers = list(net.layers)
-    return [(i, layer, [i + 1] if i + 1 < len(layers) else [])
+    # an input preprocessor (explicit spec or inferred reshape) between two
+    # layers breaks the pair, like a non-layer vertex does in a graph: the
+    # column-sharded activation would be gathered at the reshape
+    pre = set(getattr(net.conf, "preprocessors", {}) or {})
+    pre |= set(getattr(net.conf, "input_pre_processors", {}) or {})
+    return [(i, layer,
+             [i + 1] if i + 1 < len(layers) and (i + 1) not in pre else [])
             for i, layer in enumerate(layers)]
 
 
@@ -144,8 +155,9 @@ def tp_param_specs(net, axis: str = MODEL_AXIS, mesh: Optional[Mesh] = None):
 
     def specs_for(key, layer, p: Dict) -> Dict[str, P]:
         if _is_attention(layer):
-            inner = layer.n_heads * layer._dh()
-            if tp_size() is not None and inner % tp_size():
+            # head-major Wqkv propagates through the (n,t,h,3,dh) reshape
+            # iff tp divides n_heads (attention.py param_shapes)
+            if tp_size() is not None and layer.n_heads % tp_size():
                 return {n: P() for n in p}
             d = {"Wqkv": P(None, axis), "bqkv": P(axis)}
             if "Wo" in p:

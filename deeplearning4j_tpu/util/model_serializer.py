@@ -115,9 +115,12 @@ def write_model(model, path: Union[str, Path], *, save_updater: bool = True,
             z.writestr(STATES_NAME, _npz_bytes(states))
         if normalizer is not None:
             z.writestr(NORMALIZER_NAME, normalizer.to_json())
+        from deeplearning4j_tpu.nn.layers.attention import QKV_LAYOUT
         z.writestr(META_NAME, json.dumps(
             {"iteration": model.iteration, "epoch": model.epoch,
-             "framework": "deeplearning4j_tpu"}))
+             "framework": "deeplearning4j_tpu",
+             # round-5 layout stamp: fused attention columns are head-major
+             "qkv_layout": QKV_LAYOUT}))
 
 
 def _load_npz(z: zipfile.ZipFile, name: str) -> Optional[dict]:
@@ -169,6 +172,12 @@ def _restore(path: Union[str, Path], *, load_updater: bool = True):
             tgt[pn][sn] = jnp.asarray(arr)
     model.iteration = int(meta.get("iteration", 0))
     model.epoch = int(meta.get("epoch", 0))
+    from deeplearning4j_tpu.nn.layers.attention import (QKV_LAYOUT,
+                                                        repack_legacy_fused_qkv)
+    if meta.get("qkv_layout") != QKV_LAYOUT:
+        # pre-round-5 checkpoint: fused attention weights were saved in the
+        # [3,H,Dh] block-major column order — repack to head-major
+        repack_legacy_fused_qkv(model)
     return model
 
 

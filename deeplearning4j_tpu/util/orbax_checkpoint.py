@@ -51,11 +51,13 @@ def _write_meta(model, directory: str) -> None:
         return
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
     from etils import epath
+    from deeplearning4j_tpu.nn.layers.attention import QKV_LAYOUT
     kind = "mln" if isinstance(model, MultiLayerNetwork) else "graph"
     d = epath.Path(directory)
     d.mkdir(parents=True, exist_ok=True)
     (d / _CONFIG_FILE).write_text(
-        json.dumps({"kind": kind, "conf": json.loads(model.conf.to_json())}))
+        json.dumps({"kind": kind, "conf": json.loads(model.conf.to_json()),
+                    "qkv_layout": QKV_LAYOUT}))
 
 
 def _build_model(directory: str):
@@ -74,6 +76,11 @@ def _build_model(directory: str):
         model = ComputationGraph(
             ComputationGraphConfiguration.from_dict(meta["conf"]))
     model.init()  # allocates the target pytree structure + updaters
+    # pre-round-5 checkpoints carry no qkv_layout stamp: their fused
+    # attention columns are block-major and must be repacked after the
+    # state is applied (_apply_state reads this flag)
+    from deeplearning4j_tpu.nn.layers.attention import QKV_LAYOUT
+    model._legacy_qkv_checkpoint = meta.get("qkv_layout") != QKV_LAYOUT
     return model
 
 
@@ -132,6 +139,11 @@ def _apply_state(model, state: Dict[str, Any], load_updater: bool):
     counters = state.get("counters", {})
     model.iteration = int(np.asarray(counters.get("iteration", 0)))
     model.epoch = int(np.asarray(counters.get("epoch", 0)))
+    if getattr(model, "_legacy_qkv_checkpoint", False):
+        from deeplearning4j_tpu.nn.layers.attention import (
+            repack_legacy_fused_qkv)
+        repack_legacy_fused_qkv(model)
+        model._legacy_qkv_checkpoint = False
     return model
 
 

@@ -300,6 +300,107 @@ class TestModelSerializer:
         np.testing.assert_allclose(n.mean, n2.mean)
 
 
+class TestQkvLayoutMigration:
+    """Round-5 breaking-change coverage: fused attention weights moved to
+    HEAD-MAJOR column order. A checkpoint saved before the change (no
+    ``qkv_layout`` stamp, block-major [3,H,Dh] columns) must repack on
+    restore and reproduce the producer's outputs exactly."""
+
+    def _attn_net(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import (RnnOutputLayer,
+                                                  SelfAttentionLayer)
+        conf = (NeuralNetConfiguration.builder().seed(9).updater(Adam(0.01))
+                .list()
+                .layer(SelfAttentionLayer(n_out=8, n_heads=2, head_size=4))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax",
+                                      loss="mcxent"))
+                .set_input_type(InputType.recurrent(8, 5))
+                .build())
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        return MultiLayerNetwork(conf).init()
+
+    @staticmethod
+    def _to_legacy(arr, parts, h, dh):
+        """Inverse of the head-major repack: what an old save contains."""
+        a = np.asarray(arr)
+        if a.ndim == 1:
+            return a.reshape(h, parts, dh).transpose(1, 0, 2).reshape(-1)
+        d = a.shape[0]
+        return a.reshape(d, h, parts, dh).transpose(0, 2, 1, 3).reshape(d, -1)
+
+    def test_unstamped_checkpoint_repacks_to_same_outputs(self, rng,
+                                                          tmp_path):
+        import io
+        import json
+        import zipfile
+
+        net = self._attn_net()
+        x = rng.normal(size=(4, 5, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 5))]
+        net.fit(x, y)
+        ref_out = np.asarray(net.output(x))
+
+        p = tmp_path / "legacy.zip"
+        write_model(net, p)
+        # forge a pre-round-5 checkpoint: params in block-major order,
+        # meta without the qkv_layout stamp
+        with zipfile.ZipFile(p) as z:
+            entries = {n: z.read(n) for n in z.namelist()}
+        params = dict(np.load(io.BytesIO(entries["params.npz"])))
+        params["0/Wqkv"] = self._to_legacy(params["0/Wqkv"], 3, 2, 4)
+        params["0/bqkv"] = self._to_legacy(params["0/bqkv"], 3, 2, 4)
+        buf = io.BytesIO()
+        np.savez(buf, **params)
+        entries["params.npz"] = buf.getvalue()
+        meta = json.loads(entries["meta.json"])
+        del meta["qkv_layout"]
+        entries["meta.json"] = json.dumps(meta).encode()
+        with zipfile.ZipFile(p, "w") as z:
+            for n, b in entries.items():
+                z.writestr(n, b)
+
+        again = restore_multi_layer_network(p)
+        np.testing.assert_allclose(np.asarray(again.output(x)), ref_out,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_stamped_checkpoint_not_repacked(self, rng, tmp_path):
+        net = self._attn_net()
+        x = rng.normal(size=(4, 5, 8)).astype(np.float32)
+        p = tmp_path / "new.zip"
+        write_model(net, p)
+        again = restore_multi_layer_network(p)
+        np.testing.assert_allclose(np.asarray(again.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_orbax_unstamped_checkpoint_repacks(self, rng, tmp_path):
+        import json
+        import os
+
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.util import orbax_checkpoint as orx
+
+        net = self._attn_net()
+        x = rng.normal(size=(4, 5, 8)).astype(np.float32)
+        ref_out = np.asarray(net.output(x))
+        d = str(tmp_path / "ckpt")
+        # forge legacy: swap the params to block-major BEFORE saving, then
+        # strip the stamp from the meta file
+        net.params[0]["Wqkv"] = jnp.asarray(
+            self._to_legacy(net.params[0]["Wqkv"], 3, 2, 4))
+        net.params[0]["bqkv"] = jnp.asarray(
+            self._to_legacy(net.params[0]["bqkv"], 3, 2, 4))
+        orx.save_model(net, d)
+        cfg_path = os.path.join(d, orx._CONFIG_FILE)
+        meta = json.loads(open(cfg_path).read())
+        del meta["qkv_layout"]
+        open(cfg_path, "w").write(json.dumps(meta))
+        again = orx.restore_model(d)
+        np.testing.assert_allclose(np.asarray(again.output(x)), ref_out,
+                                   rtol=1e-5, atol=1e-6)
+
+
 class TestCheckpointListener:
     def test_rotation_keep_last(self, rng, tmp_path):
         net = small_net()

@@ -603,7 +603,10 @@ class TestCausalAttentionGradients:
         l = CausalSelfAttentionLayer(n_in=4, n_out=4, n_heads=2, head_size=2,
                                      max_cache=8)
         params = l.init_params(jax.random.PRNGKey(0))
-        x_np = RNG.normal(size=(2, 3, 4))
+        # hermetic rng: with the shared module RNG this check's input (and
+        # so its finite-difference conditioning) depended on which tests
+        # ran before it — near the 1e-5 threshold that made it flaky
+        x_np = np.random.default_rng(1234).normal(size=(2, 3, 4))
 
         def loss(p):
             # f64 carry/input: the checker runs in x64 and an f32 cache
