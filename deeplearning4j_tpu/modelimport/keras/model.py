@@ -46,15 +46,27 @@ def _reshape_spec(conf: dict) -> str:
 
 
 def _channels_first(layer_configs) -> bool:
-    """True when any layer declares theano dim ordering / channels_first —
+    """True when the model declares theano dim ordering / channels_first —
     then rank-3 input shapes are [C,H,W] and must be re-interpreted for
-    this framework's NHWC layout (KerasLayer.getDimOrder role)."""
+    this framework's NHWC layout (KerasLayer.getDimOrder role).
+
+    A model MIXING both orderings is rejected loudly: one whole-model flag
+    cannot honestly re-interpret per-branch input shapes, and silently
+    picking either ordering would mis-map the other branch's [H,W,C]/
+    [C,H,W] inputs."""
+    seen = set()
     for lc in layer_configs:
         c = lc.get("config", {})
-        if (c.get("dim_ordering") or c.get("data_format")) in (
-                "th", "channels_first"):
-            return True
-    return False
+        fmt = c.get("dim_ordering") or c.get("data_format")
+        if fmt in ("th", "channels_first"):
+            seen.add("channels_first")
+        elif fmt in ("tf", "channels_last"):
+            seen.add("channels_last")
+    if len(seen) > 1:
+        raise UnsupportedKerasConfigurationException(
+            "model mixes channels_first and channels_last layers; "
+            "re-save with a single data_format")
+    return seen == {"channels_first"}
 
 
 def _input_type_from_shape(shape, channels_first: bool = False) -> InputType:
@@ -147,8 +159,30 @@ class KerasSequentialModel:
             if cls == "Flatten" and len(layers) in explicit_pre:
                 # Reshape→Flatten→Dense: the flatten normally rides the
                 # dense layer's AUTO preprocessor, but an explicit spec
-                # replaces auto inference — compose it in instead
-                explicit_pre[len(layers)] += "|cnn_to_ff"
+                # replaces auto inference — compose the flatten matching
+                # the reshape target's RANK. Keras Flatten is a row-major
+                # collapse of the per-example dims: rank-3 [H,W,C] →
+                # cnn_to_ff ([N,H*W*C], same memory order); rank-2 [T,C] →
+                # a raw reshape to [N, T*C] (NOT rnn_to_ff, which is the
+                # per-timestep [N*T,C] view and changes the batch size);
+                # rank-1 is already flat.
+                spec = explicit_pre[len(layers)]
+                tail = spec.rsplit("|", 1)[-1]
+                if not tail.startswith("reshape:"):
+                    # spec already ends in a flatten (Flatten→Flatten):
+                    # the input is flat, a second flatten is a no-op
+                    continue
+                dims = [int(d) for d in
+                        tail[len("reshape:"):].split(",")]
+                if len(dims) == 3:
+                    explicit_pre[len(layers)] += "|cnn_to_ff"
+                elif len(dims) == 2:
+                    explicit_pre[len(layers)] += (
+                        f"|reshape:{dims[0] * dims[1]}")
+                elif len(dims) != 1:
+                    raise UnsupportedKerasConfigurationException(
+                        f"Flatten after a rank-{len(dims)} Reshape "
+                        f"({spec!r}) has no preprocessor spelling")
                 continue
             layer, wf = map_keras_layer(cls, conf)
             if layer is None:

@@ -97,11 +97,23 @@ class ZooModel:
         return self.PRETRAINED_URLS.get(pretrained_type)
 
     #: subclasses/users may register expected Adler32 checksums per
-    #: pretrained type (``ZooModel.pretrainedChecksum``; 0 = don't verify)
+    #: pretrained type (``ZooModel.pretrainedChecksum``; 0 = don't verify).
+    #: NOTE the integrity limitation inherited from the reference: its blob
+    #: store is plain http and Adler32 is not cryptographic, so this check
+    #: catches corruption, not tampering. Register a SHA-256 in
+    #: :attr:`PRETRAINED_SHA256` for tamper-evident verification.
     PRETRAINED_CHECKSUMS: Dict[str, int] = {}
 
     def pretrained_checksum(self, pretrained_type: str) -> int:
         return int(self.PRETRAINED_CHECKSUMS.get(pretrained_type, 0))
+
+    #: optional cryptographic digests per pretrained type (hex SHA-256;
+    #: beyond the reference, which verifies Adler32 only). Verified under
+    #: the same provenance rule as the Adler32 registry.
+    PRETRAINED_SHA256: Dict[str, str] = {}
+
+    def pretrained_sha256(self, pretrained_type: str) -> str:
+        return str(self.PRETRAINED_SHA256.get(pretrained_type, ""))
 
     def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET,
                         expected_checksum: Optional[int] = None):
@@ -144,35 +156,48 @@ class ZooModel:
             expected = int(expected_checksum)
         else:
             expected = self.pretrained_checksum(pretrained_type) if fetched else 0
-        if expected != 0:
+        expected_sha = self.pretrained_sha256(pretrained_type) if fetched else ""
+
+        def fail(kind, got, want):
+            if downloaded:
+                # ZooModel.java:75-81: a corrupt download is removed so
+                # the next attempt re-fetches instead of failing forever.
+                # Only a file THIS call wrote is ever deleted — a slot
+                # the user may have touched since a past fetch is not.
+                os.remove(path)
+                if os.path.exists(path + ".src"):
+                    os.remove(path + ".src")
+                raise ValueError(
+                    f"Pretrained model file failed checksum: fetched "
+                    f"{kind} {got}, expecting {want} ({path}); "
+                    "the corrupt download was deleted — retry.")
+            if fetched:
+                raise ValueError(
+                    f"Pretrained model file failed checksum: cached "
+                    f"{kind} {got}, expecting {want} ({path}). "
+                    "If the cache rotted, delete the file and its .src "
+                    "marker to re-fetch; if you placed your own weights "
+                    "in this slot, delete just the .src marker.")
+            raise ValueError(
+                f"Pretrained model file failed checksum: local {kind} "
+                f"{got}, expecting {want} ({path}); the file is "
+                "left in place — replace it with an intact copy.")
+
+        if expected != 0 or expected_sha:
+            import hashlib
             adler = 1  # zlib.adler32 seed, matches java.util.zip.Adler32
+            sha = hashlib.sha256()
             with open(path, "rb") as fh:
                 for chunk in iter(lambda: fh.read(1 << 20), b""):
                     adler = zlib.adler32(chunk, adler)
-            if adler != expected:
-                if downloaded:
-                    # ZooModel.java:75-81: a corrupt download is removed so
-                    # the next attempt re-fetches instead of failing forever.
-                    # Only a file THIS call wrote is ever deleted — a slot
-                    # the user may have touched since a past fetch is not.
-                    os.remove(path)
-                    if os.path.exists(path + ".src"):
-                        os.remove(path + ".src")
-                    raise ValueError(
-                        f"Pretrained model file failed checksum: fetched "
-                        f"Adler32 {adler}, expecting {expected} ({path}); "
-                        "the corrupt download was deleted — retry.")
-                if fetched:
-                    raise ValueError(
-                        f"Pretrained model file failed checksum: cached "
-                        f"Adler32 {adler}, expecting {expected} ({path}). "
-                        "If the cache rotted, delete the file and its .src "
-                        "marker to re-fetch; if you placed your own weights "
-                        "in this slot, delete just the .src marker.")
-                raise ValueError(
-                    f"Pretrained model file failed checksum: local Adler32 "
-                    f"{adler}, expecting {expected} ({path}); the file is "
-                    "left in place — replace it with an intact copy.")
+                    sha.update(chunk)
+            if expected != 0 and adler != expected:
+                fail("Adler32", adler, expected)
+            # the cryptographic check (when a digest is registered): the
+            # Adler32-over-http path alone is corruption detection, not
+            # tamper evidence
+            if expected_sha and sha.hexdigest() != expected_sha.lower():
+                fail("SHA-256", sha.hexdigest(), expected_sha.lower())
         with zipfile.ZipFile(path) as z:
             names = set(z.namelist())
         if "coefficients.bin" in names:  # reference DL4J ModelSerializer zip

@@ -232,6 +232,91 @@ class TestFunctionalImport:
         _assert_close(got, expected)
 
 
+class TestReshapeFlattenRanks:
+    """ADVICE r4: Reshape→Flatten must compose by the reshape target's
+    rank, with Keras Flatten semantics ([N, prod(dims)]), not a
+    hard-coded cnn_to_ff."""
+
+    def test_rank2_reshape_then_flatten(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((12,)),
+            kl.Reshape((3, 4), name="rs"),
+            kl.Flatten(name="fl"),
+            kl.Dense(5, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "r2flat.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(3).rand(4, 12).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_rank1_reshape_then_flatten(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((6,)),
+            kl.Reshape((6,), name="rs"),
+            kl.Flatten(name="fl"),
+            kl.Dense(2, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "r1flat.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(4).rand(3, 6).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_double_flatten_after_reshape(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((24,)),
+            kl.Reshape((2, 3, 4), name="rs"),
+            kl.Flatten(name="f1"),
+            kl.Flatten(name="f2"),  # no-op on flat input
+            kl.Dense(3, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "dflat.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(6).rand(4, 24).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+    def test_rank3_reshape_then_flatten_still_works(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((24,)),
+            kl.Reshape((2, 3, 4), name="rs"),
+            kl.Flatten(name="fl"),
+            kl.Dense(3, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "r3flat.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(5).rand(4, 24).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
+
+class TestMixedDataFormatRejected:
+    def test_mixed_channels_orders_raise(self):
+        from deeplearning4j_tpu.modelimport.keras.layers import (
+            UnsupportedKerasConfigurationException)
+        from deeplearning4j_tpu.modelimport.keras.model import (
+            _channels_first)
+
+        mixed = [
+            {"class_name": "Conv2D",
+             "config": {"data_format": "channels_first"}},
+            {"class_name": "Conv2D",
+             "config": {"data_format": "channels_last"}},
+        ]
+        with pytest.raises(UnsupportedKerasConfigurationException,
+                           match="mixes"):
+            _channels_first(mixed)
+        # uniform declarations still resolve
+        assert _channels_first(mixed[:1]) is True
+        assert _channels_first(mixed[1:]) is False
+        assert _channels_first([]) is False
+
+
 class TestConfigOnlyImport:
     def test_json_config_roundtrip(self, tmp_path):
         kl = keras.layers

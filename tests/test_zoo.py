@@ -352,6 +352,42 @@ class TestPretrainedTransport:
             .init_pretrained(PretrainedType.CIFAR10)
         assert net.params is not None
 
+    def test_sha256_verified_when_registered(self, tmp_path, monkeypatch):
+        """ADVICE r4: Adler32 over plain http is corruption detection only;
+        a registered SHA-256 adds tamper-evident verification with the
+        same download-deletion semantics."""
+        import hashlib
+        from deeplearning4j_tpu.zoo.zoo_model import PretrainedType
+        from deeplearning4j_tpu.zoo.models import SimpleCNN
+        blob, good, cache, ref = self._serve(tmp_path, monkeypatch)
+        with open(blob, "rb") as fh:
+            digest = hashlib.sha256(fh.read()).hexdigest()
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_URLS",
+            {PretrainedType.CIFAR10: blob.as_uri()}, raising=False)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_CHECKSUMS",
+            {PretrainedType.CIFAR10: good}, raising=False)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_SHA256",
+            {PretrainedType.CIFAR10: digest.upper()},  # case-insensitive
+            raising=False)
+        net = SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+            .init_pretrained(PretrainedType.CIFAR10)
+        assert net.params is not None
+
+        # wrong digest: the forged blob passes Adler32 registration (an
+        # attacker can match Adler32) but fails SHA-256 — download deleted
+        import shutil
+        shutil.rmtree(cache)
+        monkeypatch.setattr(
+            SimpleCNN, "PRETRAINED_SHA256",
+            {PretrainedType.CIFAR10: "0" * 64}, raising=False)
+        with pytest.raises(ValueError, match="SHA-256"):
+            SimpleCNN(num_labels=4, input_shape=(3, 32, 32)) \
+                .init_pretrained(PretrainedType.CIFAR10)
+        assert not (cache / "simplecnn_cifar10.zip").exists()
+
     def test_fetched_cache_reverified_user_files_trusted(
             self, tmp_path, monkeypatch):
         """A fetched artifact re-verifies against the registry checksum on
