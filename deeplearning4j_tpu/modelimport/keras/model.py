@@ -50,23 +50,35 @@ def _channels_first(layer_configs) -> bool:
     then rank-3 input shapes are [C,H,W] and must be re-interpreted for
     this framework's NHWC layout (KerasLayer.getDimOrder role).
 
-    A model MIXING both orderings is rejected loudly: one whole-model flag
-    cannot honestly re-interpret per-branch input shapes, and silently
-    picking either ordering would mis-map the other branch's [H,W,C]/
-    [C,H,W] inputs."""
-    seen = set()
+    A model whose LAYOUT-BEARING layers (conv/pooling — the ones whose
+    data_format decides how spatial inputs are interpreted) mix both
+    orderings is rejected loudly: one whole-model flag cannot honestly
+    re-interpret per-branch input shapes, and silently picking either
+    ordering would mis-map the other branch's [H,W,C]/[C,H,W] inputs.
+    Pass-through layers that merely serialize a data_format field
+    (Flatten, a lone default-format pooling after channels_first convs…)
+    follow the conv layers' ordering and do not create a conflict."""
+    bearing, other = set(), set()
     for lc in layer_configs:
+        cls = lc.get("class_name") or ""
         c = lc.get("config", {})
         fmt = c.get("dim_ordering") or c.get("data_format")
         if fmt in ("th", "channels_first"):
-            seen.add("channels_first")
+            fmt = "channels_first"
         elif fmt in ("tf", "channels_last"):
-            seen.add("channels_last")
-    if len(seen) > 1:
+            fmt = "channels_last"
+        else:
+            continue
+        if "Conv" in cls or "Pooling" in cls:
+            bearing.add(fmt)
+        else:
+            other.add(fmt)
+    if len(bearing) > 1:
         raise UnsupportedKerasConfigurationException(
-            "model mixes channels_first and channels_last layers; "
-            "re-save with a single data_format")
-    return seen == {"channels_first"}
+            "model mixes channels_first and channels_last conv/pooling "
+            "layers; re-save with a single data_format")
+    decisive = bearing or other
+    return "channels_first" in decisive and len(decisive) == 1
 
 
 def _input_type_from_shape(shape, channels_first: bool = False) -> InputType:
@@ -174,15 +186,14 @@ class KerasSequentialModel:
                     continue
                 dims = [int(d) for d in
                         tail[len("reshape:"):].split(",")]
-                if len(dims) == 3:
-                    explicit_pre[len(layers)] += "|cnn_to_ff"
-                elif len(dims) == 2:
-                    explicit_pre[len(layers)] += (
-                        f"|reshape:{dims[0] * dims[1]}")
-                elif len(dims) != 1:
-                    raise UnsupportedKerasConfigurationException(
-                        f"Flatten after a rank-{len(dims)} Reshape "
-                        f"({spec!r}) has no preprocessor spelling")
+                if len(dims) > 1:
+                    # Keras Flatten = row-major collapse of the per-example
+                    # dims, i.e. a raw reshape to prod(dims) for ANY rank
+                    # (identical to cnn_to_ff at rank 3)
+                    total = 1
+                    for d in dims:
+                        total *= d
+                    explicit_pre[len(layers)] += f"|reshape:{total}"
                 continue
             layer, wf = map_keras_layer(cls, conf)
             if layer is None:

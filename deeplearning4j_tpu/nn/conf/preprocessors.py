@@ -124,12 +124,16 @@ def output_type(spec: str, it: InputType) -> InputType:
         return InputType.convolutional(h, w, c)
     if name == "reshape":
         # target rank decides the interpretation (channels-last, like the
-        # rest of the framework): 1→ff, 2→[T,C] recurrent, 3→[H,W,C] conv
+        # rest of the framework): 1→ff, 2→[T,C] recurrent, 3→[H,W,C] conv,
+        # 4→[T,H,W,C] image sequence
         if len(args) == 1:
             return InputType.feed_forward(args[0])
         if len(args) == 2:
             return InputType.recurrent(args[1], args[0])
         if len(args) == 3:
             return InputType.convolutional(*args)
+        if len(args) == 4:
+            t, h, w, c = args
+            return InputType.recurrent_convolutional(h, w, c, t)
         raise ValueError(f"reshape target rank {len(args)} unsupported")
     raise ValueError(f"unknown preprocessor {spec!r}")

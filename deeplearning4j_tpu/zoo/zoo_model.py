@@ -186,17 +186,20 @@ class ZooModel:
         if expected != 0 or expected_sha:
             import hashlib
             adler = 1  # zlib.adler32 seed, matches java.util.zip.Adler32
-            sha = hashlib.sha256()
+            # hash only when a digest is registered — the Adler-only common
+            # case must not pay a discarded SHA-256 pass per load
+            sha = hashlib.sha256() if expected_sha else None
             with open(path, "rb") as fh:
                 for chunk in iter(lambda: fh.read(1 << 20), b""):
                     adler = zlib.adler32(chunk, adler)
-                    sha.update(chunk)
+                    if sha is not None:
+                        sha.update(chunk)
             if expected != 0 and adler != expected:
                 fail("Adler32", adler, expected)
             # the cryptographic check (when a digest is registered): the
             # Adler32-over-http path alone is corruption detection, not
             # tamper evidence
-            if expected_sha and sha.hexdigest() != expected_sha.lower():
+            if sha is not None and sha.hexdigest() != expected_sha.lower():
                 fail("SHA-256", sha.hexdigest(), expected_sha.lower())
         with zipfile.ZipFile(path) as z:
             names = set(z.namelist())

@@ -265,6 +265,20 @@ class TestReshapeFlattenRanks:
         net = KerasModelImport.import_keras_model_and_weights(p)
         _assert_close(net.output(x), expected)
 
+    def test_rank4_reshape_then_flatten(self, tmp_path):
+        kl = keras.layers
+        m = keras.Sequential([
+            kl.Input((48,)),
+            kl.Reshape((2, 2, 4, 3), name="rs"),
+            kl.Flatten(name="fl"),
+            kl.Dense(3, activation="softmax", name="d"),
+        ])
+        p = _save(m, tmp_path, "r4flat.h5", loss="categorical_crossentropy")
+        x = np.random.RandomState(7).rand(4, 48).astype(np.float32)
+        expected = m.predict(x, verbose=0)
+        net = KerasModelImport.import_keras_model_and_weights(p)
+        _assert_close(net.output(x), expected)
+
     def test_double_flatten_after_reshape(self, tmp_path):
         kl = keras.layers
         m = keras.Sequential([
@@ -315,6 +329,12 @@ class TestMixedDataFormatRejected:
         assert _channels_first(mixed[:1]) is True
         assert _channels_first(mixed[1:]) is False
         assert _channels_first([]) is False
+        # benign mix: a pass-through layer's default data_format does not
+        # conflict with the convs that actually bear the layout
+        benign = [mixed[0],
+                  {"class_name": "Flatten",
+                   "config": {"data_format": "channels_last"}}]
+        assert _channels_first(benign) is True
 
 
 class TestConfigOnlyImport:
