@@ -242,7 +242,11 @@ _POS_CLOSED = {
 def heuristic_pos_tagger(tokens: Sequence[str]) -> List[str]:
     """Suffix/lexicon English POS heuristic — the pluggable default where
     the reference loads an OpenNLP model. Capitalized unknown words tag
-    NNP, digits CD, everything else NN."""
+    NNP, digits CD, everything else NN; two textbook Brill-style context
+    rules (the canonical first transformations learned on any corpus)
+    repair the commonest suffix-rule errors: an *-ed* form after a
+    have/be auxiliary is the participle VBN, and a bare form after
+    ``to``/a modal is the infinitive VB."""
     tags = []
     for i, tok in enumerate(tokens):
         low = tok.lower()
@@ -252,6 +256,7 @@ def heuristic_pos_tagger(tokens: Sequence[str]) -> List[str]:
         # proper nouns, not pronouns/modals. "I" is always the pronoun.
         cap_override = (tok != low and tok != "I"
                         and (i > 0 or (len(tok) > 1 and tok.isupper())))
+        prev = tags[-1] if tags else None
         if low in _POS_CLOSED and not cap_override:
             tags.append(_POS_CLOSED[low])
             continue
@@ -262,8 +267,19 @@ def heuristic_pos_tagger(tokens: Sequence[str]) -> List[str]:
         if tok[:1].isupper():
             tags.append("NNP")
             continue
+        # context rule: to/modal + unknown bare form → infinitive VB
+        # ("to buy", "must leave"); suffix rules would call these NN.
+        # -ly stays with the adverb rule ("will probably win")
+        if prev in ("TO", "MD") and not low.endswith(("ing", "ed", "s",
+                                                      "ly")):
+            tags.append("VB")
+            continue
         for pat, tag in _POS_SUFFIX_RULES:
             if pat.match(low):
+                # context rule: aux(have/be) + -ed → past participle VBN
+                if tag == "VBD" and prev in ("VBZ", "VBP", "VBD", "VB",
+                                             "VBN"):
+                    tag = "VBN"
                 tags.append(tag)
                 break
         else:

@@ -3,4 +3,4 @@
 # virtual 8-device CPU mesh, then the benchmark if a device is available.
 set -euo pipefail
 cd "$(dirname "$0")"
-python -m pytest tests/ -q "$@"  # incl. the 22-example smoke tier (DL4J_TPU_SKIP_EXAMPLES=1 to skip)
+python -m pytest tests/ -q "$@"  # incl. the examples smoke tier (DL4J_TPU_SKIP_EXAMPLES=1 to skip)

@@ -246,10 +246,27 @@ class TestPosTaggerMeasuredAccuracy:
                     pc = p[:2] if p and p[0] in "NV" else p
                     coarse_ok += gc == pc
         assert total == 238
-        # measured 2026-07: 0.832 exact / 0.861 coarse (closed classes
-        # complete; residual = open-class JJ/NN)
-        assert correct / total > 0.80
-        assert coarse_ok / total > 0.83
+        # measured 2026-07 (r5, with the two Brill-style context rules):
+        # 0.845 exact / 0.870 coarse — up from 0.832/0.861; residual =
+        # open-class JJ/NN ambiguity a lexicon would resolve
+        assert correct / total > 0.83
+        assert coarse_ok / total > 0.85
+
+    def test_context_rules(self):
+        """r5 Brill-style transformations: aux + -ed → VBN participle,
+        to/modal + bare form → VB infinitive — and -ly adverbs keep the
+        RB rule even after a modal."""
+        from deeplearning4j_tpu.nlp.stemming import heuristic_pos_tagger
+        tags = heuristic_pos_tagger(["they", "have", "walked", "home"])
+        assert tags[2] == "VBN"
+        tags = heuristic_pos_tagger(["she", "walked", "home"])
+        assert tags[1] == "VBD"  # no auxiliary → simple past stays
+        tags = heuristic_pos_tagger(["to", "buy", "milk"])
+        assert tags[1] == "VB"
+        tags = heuristic_pos_tagger(["must", "leave", "now"])
+        assert tags[1] == "VB"
+        tags = heuristic_pos_tagger(["will", "probably", "win"])
+        assert tags[1] == "RB"  # -ly exclusion
 
     def test_closed_classes_exact(self):
         """Punctuation, possessive pronouns, modals, number words are
