@@ -135,6 +135,28 @@ class TestSWN3:
         assert swn.class_for_score(-0.8) == "strong_negative"
         assert swn.class_for_score(0.0) == "neutral"
 
+    def test_expanded_lexicon_semantics(self):
+        """r5 lexicon expansion regression locks: degree-adverb 'pretty'
+        and politeness 'please' carry no polarity, hardly/barely negate
+        through the negation mechanism, and single-POS effective scores
+        respect the strong/plain/weak convention."""
+        swn = SWN3()
+        assert swn.extract("pretty") == 0.0
+        assert swn.extract("please") == 0.0
+        assert swn.classify("pretty bad") in ("negative", "weak_negative")
+        assert swn.score("hardly a good movie") < 0
+        assert swn.score("barely acceptable") < 0
+        # no NEW word outranks the strongest single-POS entries via
+        # POS summation (love/hate keep their historical v+n pairs)
+        for w in ("praise", "delight", "waste", "damage", "anger"):
+            assert abs(swn.extract(w)) <= 0.875, w
+        # breadth: common review vocabulary scores sensibly
+        assert swn.classify(
+            "an outstanding and memorable masterpiece") == "strong_positive"
+        assert swn.classify(
+            "a dreadful waste of time , confusing and dull"
+        ) == "strong_negative"
+
     def test_load_swn_format(self, tmp_path):
         p = tmp_path / "swn.txt"
         p.write_text(
