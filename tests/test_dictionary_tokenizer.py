@@ -301,6 +301,21 @@ class TestUnknownWordHandling:
         assert surfaces == ["子供", "は", "牛乳", "を", "飲み", "ました",
                             "。"]
 
+    def test_entirely_oov_text_never_dead_ends(self):
+        """A sentence with ZERO lexicon coverage must still segment (the
+        lattice always has unknown candidates at every position), with
+        category-grouped runs."""
+        from deeplearning4j_tpu.nlp.dictionary_tokenizer import (
+            MorphologicalDictionary, viterbi_segment)
+        empty = MorphologicalDictionary([])
+        segs = viterbi_segment("カメラ2024ABCで写真を撮る!", empty)
+        assert "".join(e.surface for e in segs) == "カメラ2024ABCで写真を撮る!"
+        surfaces = [e.surface for e in segs]
+        assert "カメラ" in surfaces      # grouped katakana
+        assert "2024" in surfaces        # grouped numerals
+        assert "ABC" in surfaces         # grouped latin
+        assert all(e.features[:1] == ("UNK",) for e in segs)
+
     def test_unknown_handling_improves_f1_on_depleted_lexicon(self):
         """The measurable claim: delete lexicon entries, F1 with
         category-grouped unknowns beats F1 with the old single-char

@@ -165,6 +165,26 @@ def test_multi_hybrid_rejected_loudly():
         normalizer_from_bytes(_header("MULTI_HYBRID"))
 
 
+def test_truncated_stream_rejected_clearly():
+    full = normalizer_to_bytes(NormalizerStandardize().fit(_ds()))
+    # cut inside the LAST record (the std vector's data buffer): must
+    # fail loudly, not return a silently short normalizer
+    with pytest.raises(ValueError, match="truncated"):
+        normalizer_from_bytes(full[: len(full) - 7])
+    # cut inside the header
+    with pytest.raises(ValueError):
+        normalizer_from_bytes(full[:8])
+
+
+def test_implausible_multi_count_rejected():
+    payload = _header("MULTI_STANDARDIZE",
+                      b"\x00"                       # fitLabel false
+                      + struct.pack(">i", 1 << 20)  # absurd input count
+                      + struct.pack(">i", -1))
+    with pytest.raises(ValueError, match="implausible"):
+        normalizer_from_bytes(payload)
+
+
 def test_bad_magic_rejected():
     payload = _header("STANDARDIZE").replace(b"NORMALIZER", b"NORMALIZED", 1)
     with pytest.raises(ValueError, match="NormalizerSerializer"):
