@@ -232,13 +232,68 @@ def evaluate_main(argv: Optional[List[str]] = None):
     return e
 
 
+def serve_main(argv: Optional[List[str]] = None, block: bool = True):
+    """``serve`` subcommand: stand up the production serving tier from the
+    shell — register one or more model artifacts (ModelGuesser chain:
+    own/DL4J zips, Keras h5) under names and serve them over HTTP with
+    admission control and ``/metrics``."""
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu serve")
+    p.add_argument("--model", action="append", required=True,
+                   metavar="NAME=PATH",
+                   help="model to register (repeatable); NAME=PATH, or a "
+                        "bare PATH served under its file stem")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8500,
+                   help="listen port (0 → ephemeral)")
+    p.add_argument("--max-batch-size", type=int, default=32)
+    p.add_argument("--wait-ms", type=float, default=2.0,
+                   help="batching window measured from the oldest request")
+    p.add_argument("--max-inflight", type=int, default=64,
+                   help="admission limit before requests shed as 429")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline (504 past expiry)")
+    args = p.parse_args(argv)
+
+    import os
+
+    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                            default_registry)
+
+    registry = ModelRegistry(metrics=default_registry(),
+                             max_batch_size=args.max_batch_size,
+                             wait_ms=args.wait_ms)
+    for spec in args.model:
+        name, sep, path = spec.partition("=")
+        if not sep:
+            name, path = os.path.splitext(os.path.basename(spec))[0], spec
+        version = registry.register(name, path=path)
+        print(f"registered {name!r} v{version} from {path}")
+    server = ModelServer(
+        registry, host=args.host, port=args.port, metrics=default_registry(),
+        max_inflight=args.max_inflight,
+        default_deadline_s=(args.deadline_ms / 1e3
+                            if args.deadline_ms is not None else None))
+    port = server.start()
+    print(f"model server listening on {server.url} "
+          f"(models: {', '.join(registry.names())}); port {port}")
+    if block:
+        try:
+            server._thread.join()
+        except KeyboardInterrupt:
+            server.stop(drain=True, shutdown_registry=True)
+    return server
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m deeplearning4j_tpu.cli "
-              "{train,evaluate,nn-server,cloud-setup,profile} ...")
+              "{train,evaluate,serve,nn-server,cloud-setup,profile} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
+    if cmd == "serve":
+        serve_main(rest)
+        return 0
     if cmd == "train":
         parallel_wrapper_main(rest)
         return 0
@@ -261,7 +316,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cluster_setup_main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected 'train', 'evaluate', "
-          "'nn-server', 'cloud-setup', or 'profile'")
+          "'serve', 'nn-server', 'cloud-setup', or 'profile'")
     return 2
 
 

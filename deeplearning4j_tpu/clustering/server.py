@@ -46,7 +46,7 @@ class NearestNeighborsServer:
 
     def __init__(self, points, labels: Optional[List[str]] = None,
                  similarity_function: str = "euclidean", invert: bool = False,
-                 port: int = 9200, use_device: bool = True):
+                 port: int = 9200, use_device: bool = True, metrics=None):
         self.points = np.asarray(points, np.float32)
         self.labels = labels
         self.similarity_function = similarity_function
@@ -54,6 +54,11 @@ class NearestNeighborsServer:
         self.port = port
         self._httpd = None
         self._thread = None
+        # optional shared observability core (serving.metrics registry)
+        self._observe = None
+        if metrics is not None:
+            from deeplearning4j_tpu.serving.metrics import instrument_http
+            self._observe = instrument_http(metrics, "knn")
         if use_device:
             from deeplearning4j_tpu.clustering.bruteforce import (
                 BruteForceNearestNeighbors)
@@ -96,7 +101,11 @@ class NearestNeighborsServer:
     def start(self) -> int:
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        from deeplearning4j_tpu.serving.metrics import HTTPObserverMixin
+
+        class Handler(HTTPObserverMixin, BaseHTTPRequestHandler):
+            observe = server._observe
+
             def log_message(self, *a):
                 pass
 

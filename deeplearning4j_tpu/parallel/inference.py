@@ -9,12 +9,24 @@ TPU-native design: one jitted forward specialized per bucketed batch size
 (powers of two, to bound recompilation), requests coalesced by a single
 dispatcher thread; multi-device throughput comes from sharding the coalesced
 batch over the mesh 'data' axis rather than from model replicas.
+
+Serving-tier contract (the guarantees ``serving/server.py`` maps to HTTP
+status codes):
+- a request carries an optional absolute deadline; a request whose deadline
+  has passed is NEVER dispatched to the device — it fails with
+  ``InferenceDeadlineExceeded`` (the 504 path) and wastes no device time;
+- a dispatcher-thread crash fails every queued AND future request with
+  ``DispatcherCrashed`` instead of stranding waiters forever (the 503 path);
+  ``healthy`` / ``dispatcher_error`` surface the state;
+- an optional duck-typed metrics registry (``serving.metrics``-shaped)
+  records the batch-size distribution and live queue depth.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import List, Optional
 
 import jax
@@ -25,14 +37,70 @@ from jax.sharding import Mesh
 from deeplearning4j_tpu.parallel.sharding import batch_sharding
 
 
-class _Request:
-    __slots__ = ("x", "event", "result", "error")
+class InferenceDeadlineExceeded(TimeoutError):
+    """The request's deadline expired before a result was produced."""
 
-    def __init__(self, x):
+
+class DispatcherCrashed(RuntimeError):
+    """The batching dispatcher thread died; the instance serves no more."""
+
+
+# _Request lifecycle: PENDING -(dispatcher)-> CLAIMED, or
+#                     PENDING -(client timeout)-> CANCELLED.
+# The tiny per-request lock arbitrates the race between the dispatcher
+# claiming a queued request and its client giving up on the deadline.
+_PENDING, _CLAIMED, _CANCELLED = 0, 1, 2
+
+
+class _Request:
+    __slots__ = ("x", "event", "result", "error", "deadline", "_state",
+                 "_lock", "served_model")
+
+    def __init__(self, x, deadline: Optional[float] = None):
         self.x = x
         self.event = threading.Event()
         self.result = None
         self.error = None
+        self.served_model = None  # the model object that actually served
+        self.deadline = deadline  # absolute time.monotonic() stamp
+        self._state = _PENDING
+        self._lock = threading.Lock()
+
+    def claim(self) -> bool:
+        """Dispatcher-side: take ownership for dispatch. Returns False if
+        the client cancelled OR the deadline already passed — in the latter
+        case the error is delivered here so the waiter unblocks."""
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            if self.deadline is not None and time.monotonic() >= self.deadline:
+                self._state = _CANCELLED
+                self.error = InferenceDeadlineExceeded(
+                    "deadline expired while queued")
+                self.event.set()
+                return False
+            self._state = _CLAIMED
+            return True
+
+    def cancel(self, error: Exception) -> bool:
+        """Client-side: abandon a still-queued request."""
+        with self._lock:
+            if self._state != _PENDING:
+                return False
+            self._state = _CANCELLED
+            self.error = error
+            self.event.set()
+            return True
+
+    def fail_unclaimed(self, error: Exception) -> bool:
+        """Fail the request if nobody owns it yet (shutdown/crash paths)."""
+        with self._lock:
+            if self._state == _CLAIMED:
+                return False
+            self._state = _CANCELLED
+            self.error = error
+            self.event.set()
+            return True
 
 
 def _bucket(n: int) -> int:
@@ -54,11 +122,17 @@ class ParallelInference:
     - 'batched': requests are coalesced by a dispatcher thread up to
       ``max_batch_size`` within a ``wait_ms`` TTL window measured from the
       oldest queued request (the ObservablesProvider nanos-TTL semantics).
+
+    ``metrics``: optional duck-typed registry (``serving.metrics``
+    interface). When provided, records ``inference_batch_size`` (histogram,
+    label ``model``), ``inference_queue_depth`` (gauge) and
+    ``inference_dispatcher_up`` (gauge).
     """
 
     def __init__(self, model, *, mode: str = "batched", max_batch_size: int = 32,
                  queue_limit: int = 64, wait_ms: float = 2.0,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, metrics=None,
+                 metrics_name: str = "default"):
         if mode not in ("sequential", "inplace", "batched"):
             raise ValueError(f"unknown mode {mode!r} (inplace|sequential|batched)")
         self.model = model
@@ -70,23 +144,92 @@ class ParallelInference:
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
         self._worker = None
+        self.dispatcher_error: Optional[BaseException] = None
+        self.batches_dispatched = 0
+        self._inflight_batch: List[_Request] = []
+        self._metrics_name = metrics_name
+        self._m_batch = self._m_depth = self._m_up = None
+        if metrics is not None:
+            self._m_batch = metrics.histogram(
+                "inference_batch_size",
+                "Coalesced rows per dispatched device batch", ("model",),
+                buckets=[2 ** i for i in range(0, 11)])
+            self._m_depth = metrics.gauge(
+                "inference_queue_depth", "Requests waiting for dispatch",
+                ("model",))
+            self._m_up = metrics.gauge(
+                "inference_dispatcher_up",
+                "1 while the batching dispatcher thread is alive", ("model",))
+            self._m_up.set(1, model=metrics_name)
         if mode == "batched":
             self._worker = threading.Thread(target=self._run, daemon=True)
             self._worker.start()
 
     # ----------------------------------------------------------- client API
-    def output(self, x) -> np.ndarray:
-        x = np.asarray(x)
+    @property
+    def healthy(self) -> bool:
+        """False once the dispatcher thread has crashed or after shutdown."""
         if self.mode in ("sequential", "inplace"):
-            return np.asarray(self._model().output(x))
+            return not self._shutdown
+        return (not self._shutdown and self.dispatcher_error is None)
+
+    def output(self, x, *, deadline_s: Optional[float] = None,
+               return_model: bool = False) -> np.ndarray:
+        """Predict; ``deadline_s`` is a relative per-request deadline.
+
+        Raises ``InferenceDeadlineExceeded`` past the deadline — whether the
+        request was still queued (it will never be dispatched) or its batch
+        simply finished too late — and ``DispatcherCrashed`` when the
+        batching thread is gone.
+
+        ``return_model=True`` returns ``(result, model)`` where ``model`` is
+        the object that actually served the batch — the only truthful
+        attribution under concurrent hot-swaps (in-flight batches finish on
+        the OLD model).
+        """
+        x = np.asarray(x)
+        if x.ndim == 0:
+            # a 0-d request would crash the shared dispatcher on shape[0]
+            raise ValueError("request must be at least 1-d (a batch of rows)")
+        if self.mode in ("sequential", "inplace"):
+            model = self._model()
+            res = np.asarray(model.output(x))
+            return (res, model) if return_model else res
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
-        req = _Request(x)
+        if self.dispatcher_error is not None:
+            raise DispatcherCrashed(
+                "inference dispatcher died") from self.dispatcher_error
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        req = _Request(x, deadline=deadline)
         self._q.put(req)
-        req.event.wait()
+        # re-check AFTER the put: a crash/shutdown that drained the queue
+        # concurrently with this enqueue would otherwise strand the request
+        # (nobody will ever claim it from the dead queue)
+        if self.dispatcher_error is not None:
+            req.fail_unclaimed(DispatcherCrashed("inference dispatcher died"))
+        elif self._shutdown:
+            req.fail_unclaimed(RuntimeError("ParallelInference shut down"))
+        if self._m_depth is not None:
+            self._m_depth.set(self._q.qsize(), model=self._metrics_name)
+        if deadline is None:
+            req.event.wait()
+        else:
+            remaining = deadline - time.monotonic()
+            if not req.event.wait(max(remaining, 0.0)):
+                # still queued → cancel so the dispatcher skips it; already
+                # claimed → the batch is in flight, await it but report the
+                # deadline anyway (the result is past its SLO either way)
+                req.cancel(InferenceDeadlineExceeded(
+                    f"deadline of {deadline_s}s expired"))
+                req.event.wait()
+                if req.error is None:
+                    raise InferenceDeadlineExceeded(
+                        f"deadline of {deadline_s}s expired (late batch)")
         if req.error is not None:
             raise req.error
-        return req.result
+        return (req.result, req.served_model) if return_model else req.result
 
     def update_model(self, model) -> None:
         """Atomically swap the served model (``ParallelInference.updateModel``)
@@ -104,25 +247,55 @@ class ParallelInference:
         if self._worker is not None:
             self._worker.join(timeout=1.0)
         # fail any requests still queued so no client blocks forever
+        self._fail_queued(RuntimeError("ParallelInference shut down"))
+        if self._m_up is not None:
+            self._m_up.set(0, model=self._metrics_name)
+
+    def _fail_queued(self, error: Exception) -> None:
         while True:
             try:
                 r = self._q.get_nowait()
             except queue.Empty:
                 break
-            r.error = RuntimeError("ParallelInference shut down")
-            r.event.set()
+            r.fail_unclaimed(error)
+        if self._m_depth is not None:
+            self._m_depth.set(0, model=self._metrics_name)
 
     # ------------------------------------------------------------ dispatcher
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except BaseException as e:  # noqa: BLE001 — containment seam
+            # the crash must not strand waiters: record it, fail everything
+            # queued, and let output() fail fast from now on (the serving
+            # layer turns this into 503s instead of hung connections)
+            self.dispatcher_error = e
+            if self._m_up is not None:
+                self._m_up.set(0, model=self._metrics_name)
+            crash = DispatcherCrashed(f"inference dispatcher died: {e!r}")
+            # requests already claimed into the dying batch are no longer in
+            # the queue — unblock them too (the thread is dead, no race)
+            for r in self._inflight_batch:
+                if not r.event.is_set():
+                    r.error = crash
+                    r.event.set()
+            self._fail_queued(crash)
+
+    def _run_loop(self) -> None:
         while not self._shutdown:
             try:
                 first = self._q.get(timeout=0.05)
             except queue.Empty:
                 continue
+            if not first.claim():  # cancelled or expired while queued
+                continue
             batch: List[_Request] = [first]
+            # publish the batch list BEFORE coalescing: a crash anywhere
+            # past the first claim must be able to fail these waiters
+            # (appends below mutate this same list)
+            self._inflight_batch = batch
             n = first.x.shape[0]
             deadline = self.wait_s
-            import time
             t0 = time.monotonic()
             while n < self.max_batch_size:
                 remaining = deadline - (time.monotonic() - t0)
@@ -132,9 +305,14 @@ class ParallelInference:
                     r = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                if not r.claim():
+                    continue
                 batch.append(r)
                 n += r.x.shape[0]
+            if self._m_depth is not None:
+                self._m_depth.set(self._q.qsize(), model=self._metrics_name)
             self._dispatch(batch, n)
+            self._inflight_batch = []
 
     def _dispatch(self, batch: List[_Request], n: int) -> None:
         try:
@@ -150,11 +328,16 @@ class ParallelInference:
             xj = jnp.asarray(x)
             if self.mesh is not None:
                 xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
-            out = np.asarray(self._model().output(xj))
+            model = self._model()
+            out = np.asarray(model.output(xj))
+            self.batches_dispatched += 1
+            if self._m_batch is not None:
+                self._m_batch.observe(n, model=self._metrics_name)
             off = 0
             for r in batch:
                 k = r.x.shape[0]
                 r.result = out[off:off + k]
+                r.served_model = model
                 off += k
                 r.event.set()
         except Exception as e:  # deliver errors to waiting clients

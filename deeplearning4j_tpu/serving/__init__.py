@@ -1,0 +1,49 @@
+"""Production model-serving subsystem (L6 of the stack).
+
+Turns any trained or imported model into a network service:
+
+- ``metrics``   — dependency-free counters/gauges/histograms + Prometheus
+  text exposition, shared by ``ParallelInference``, the KNN server and the
+  UI server;
+- ``registry``  — versioned model registry with atomic hot-swap (built on
+  ``ParallelInference.update_model``) and rollback; loads from
+  ModelSerializer zips, DL4J checkpoints, Keras h5 or live objects;
+- ``admission`` — bounded in-flight admission (429 + Retry-After), graceful
+  drain;
+- ``server``    — threaded HTTP front-end: ``/v1/models/.../predict``
+  (JSON or binary codec), ``/v1/models``, ``/healthz``, ``/readyz``,
+  ``/metrics``; deadlines propagate into the batching dispatcher (504,
+  expired work never reaches the device), dispatcher crashes contained as
+  503s;
+- ``client``    — typed client incl. a parsing ``/metrics`` scrape.
+
+The role of the reference ecosystem's serving deployments around
+``ParallelInference.java`` + the dl4j-streaming routes, made a first-class
+subsystem.
+"""
+
+from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    instrument_http,
+    parse_prometheus_text,
+)
+from deeplearning4j_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+    AdmissionRejected,
+    Draining,
+)
+from deeplearning4j_tpu.serving.registry import (  # noqa: F401
+    ModelNotFound,
+    ModelRegistry,
+    ModelVersion,
+    ServedModel,
+)
+from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
+from deeplearning4j_tpu.serving.client import (  # noqa: F401
+    ModelServingClient,
+    ServingError,
+)

@@ -1,0 +1,112 @@
+"""Serving client — typed access to the ModelServer HTTP surface.
+
+JSON or the ``streaming/codec.py`` binary frame on the predict path (binary
+skips float→text→float for large tensors), plus listing, health probes and
+a ``/metrics`` scrape that parses back into numbers. Raises ``ServingError``
+carrying the HTTP status and the server's ``Retry-After`` hint so callers
+can implement backoff.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import parse_prometheus_text
+from deeplearning4j_tpu.serving.server import BINARY_CONTENT_TYPE
+from deeplearning4j_tpu.streaming.codec import (deserialize_array,
+                                                serialize_array)
+
+
+class ServingError(RuntimeError):
+    """Non-2xx response; carries ``status``, ``message``, ``retry_after_s``."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.message = message
+        self.retry_after_s = retry_after_s
+
+
+class ModelServingClient:
+    def __init__(self, url: str, timeout: float = 10.0):
+        self.url = url.rstrip("/")
+        self.timeout = timeout
+
+    # -------------------------------------------------------------- plumbing
+    def _request(self, path: str, data: Optional[bytes] = None,
+                 headers: Optional[dict] = None):
+        req = urllib.request.Request(self.url + path, data=data,
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            try:
+                message = json.loads(body.decode()).get("error", "")
+            except Exception:  # noqa: BLE001 - body may not be JSON
+                message = body.decode(errors="replace")
+            retry = e.headers.get("Retry-After")
+            raise ServingError(
+                e.code, message,
+                float(retry) if retry is not None else None) from None
+
+    # -------------------------------------------------------------- predict
+    def predict(self, model: str, inputs, *, version: Optional[int] = None,
+                binary: bool = False,
+                deadline_ms: Optional[float] = None) -> np.ndarray:
+        ref = model if version is None else f"{model}:{version}"
+        path = f"/v1/models/{ref}/predict"
+        headers = {}
+        if deadline_ms is not None:
+            headers["X-Deadline-Ms"] = str(deadline_ms)
+        if binary:
+            headers["Content-Type"] = BINARY_CONTENT_TYPE
+            _, body, _ = self._request(
+                path, serialize_array(np.asarray(inputs)), headers)
+            return deserialize_array(body)
+        headers["Content-Type"] = "application/json"
+        payload = {"inputs": np.asarray(inputs).tolist()}
+        _, body, _ = self._request(path, json.dumps(payload).encode(),
+                                   headers)
+        return np.asarray(json.loads(body.decode())["outputs"])
+
+    # ------------------------------------------------------------ inspection
+    def models(self) -> list:
+        _, body, _ = self._request("/v1/models")
+        return json.loads(body.decode())["models"]
+
+    def model(self, name: str) -> dict:
+        _, body, _ = self._request(f"/v1/models/{name}")
+        return json.loads(body.decode())
+
+    def healthy(self) -> bool:
+        try:
+            status, _, _ = self._request("/healthz")
+            return status == 200
+        except (ServingError, OSError):
+            return False
+
+    def ready(self) -> bool:
+        try:
+            status, _, _ = self._request("/readyz")
+            return status == 200
+        except ServingError:
+            return False
+        except OSError:
+            return False
+
+    # --------------------------------------------------------------- metrics
+    def metrics_text(self) -> str:
+        _, body, _ = self._request("/metrics")
+        return body.decode()
+
+    def metrics(self) -> dict:
+        """Scrape and parse: ``{series: {sorted label pairs: value}}``."""
+        return parse_prometheus_text(self.metrics_text())

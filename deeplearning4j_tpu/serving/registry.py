@@ -1,0 +1,257 @@
+"""Versioned model registry — the control plane of the serving tier.
+
+Each registered name owns a monotonically-versioned history and ONE
+``ParallelInference`` dispatcher; activating a version is an atomic
+``ParallelInference.update_model`` hot-swap (in-flight batches finish on the
+old weights, the next coalesced batch runs the new ones — no request ever
+sees a torn model), and ``rollback`` re-activates the previously live
+version. Models load from every source the framework already speaks:
+
+- a live model object (trained in-process, zoo-built, Keras-imported);
+- a path, routed through ``util.model_guesser.load_model_guess`` — own
+  ModelSerializer zips, reference DL4J checkpoints, Keras HDF5.
+
+This is the role of the reference's model-server deployments around
+``ParallelInference.java`` (dl4j-streaming pumping fresh checkpoints into a
+running model), made explicit as an API.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+
+class ModelNotFound(KeyError):
+    """Unknown model name or version (the HTTP 404 path)."""
+
+
+class ModelVersion:
+    """One immutable registry entry."""
+
+    __slots__ = ("version", "model", "source", "registered_at")
+
+    def __init__(self, version: int, model, source: str):
+        self.version = version
+        self.model = model
+        self.source = source
+        self.registered_at = time.time()
+
+
+class ServedModel:
+    """A name + its version history + the live batching dispatcher."""
+
+    def __init__(self, name: str, inference: ParallelInference):
+        self.name = name
+        self.inference = inference
+        self.versions: Dict[int, ModelVersion] = {}
+        self.current_version: Optional[int] = None
+        self.previous_version: Optional[int] = None
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "current_version": self.current_version,
+            "previous_version": self.previous_version,
+            "healthy": self.inference.healthy,
+            "versions": [
+                {"version": v.version, "source": v.source,
+                 "registered_at": v.registered_at}
+                for v in sorted(self.versions.values(),
+                                key=lambda m: m.version)],
+        }
+
+
+class ModelRegistry:
+    """Thread-safe registry; one ``ParallelInference`` per model name.
+
+    ``metrics`` is a ``serving.metrics.MetricsRegistry`` (duck-typed) shared
+    with the dispatchers — swap/rollback events and per-model live-version
+    gauges land next to the batch/queue series the dispatchers emit.
+    """
+
+    def __init__(self, *, metrics=None, max_batch_size: int = 32,
+                 queue_limit: int = 64, wait_ms: float = 2.0, mesh=None):
+        self._models: Dict[str, ServedModel] = {}
+        self._lock = threading.RLock()
+        self._swap_lock = threading.Lock()  # serializes hot-swaps
+        self._metrics = metrics
+        self._pi_kw = dict(max_batch_size=max_batch_size,
+                           queue_limit=queue_limit, wait_ms=wait_ms,
+                           mesh=mesh)
+        self._swapping = 0  # >0 while a hot-swap is in progress (readiness)
+        self._m_swaps = self._m_version = None
+        if metrics is not None:
+            self._m_swaps = metrics.counter(
+                "serving_model_swaps_total",
+                "Hot-swap activations (including rollbacks)",
+                ("model", "kind"))
+            self._m_version = metrics.gauge(
+                "serving_model_version", "Currently live version", ("model",))
+
+    # ------------------------------------------------------------- loading
+    @staticmethod
+    def load(path: str):
+        """Load a model of unknown provenance (ModelGuesser order: own MLN
+        zip → own CG zip → DL4J MLN/CG checkpoint → Keras h5)."""
+        from deeplearning4j_tpu.util.model_guesser import load_model_guess
+        return load_model_guess(str(path))
+
+    # ------------------------------------------------------------ mutation
+    def register(self, name: str, model=None, *, path: Optional[str] = None,
+                 activate: bool = True) -> int:
+        """Register a new version of ``name``; returns the version number.
+
+        Exactly one of ``model`` (a live object) or ``path`` (anything
+        ``load_model_guess`` accepts) must be given. The first version of a
+        name activates unconditionally; later ones only when ``activate``.
+        """
+        if (model is None) == (path is None):
+            raise ValueError("register() needs exactly one of model=/path=")
+        source = "object"
+        if path is not None:
+            model = self.load(path)
+            source = str(path)
+        with self._lock:
+            served = self._models.get(name)
+            if served is None:
+                served = ServedModel(
+                    name, ParallelInference(
+                        model, mode="batched", metrics=self._metrics,
+                        metrics_name=name, **self._pi_kw))
+                self._models[name] = served
+                version = 1
+                served.versions[version] = ModelVersion(version, model, source)
+                served.current_version = version
+                self._note_swap(name, version, "register")
+                return version
+            version = max(served.versions) + 1
+            served.versions[version] = ModelVersion(version, model, source)
+        if activate:
+            self.activate(name, version)
+        return version
+
+    def activate(self, name: str, version: int, *,
+                 _kind: str = "activate") -> None:
+        """Atomic hot-swap of the live version (rollback's forward twin).
+        Activations are serialized by ``_swap_lock`` so the dispatcher's
+        live model can never disagree with ``current_version`` when two
+        publishers race."""
+        with self._swap_lock:
+            with self._lock:
+                served = self._get(name)
+                if version not in served.versions:
+                    raise ModelNotFound(f"{name} has no version {version}")
+                if version == served.current_version:
+                    return
+                self._swapping += 1
+            try:
+                # the swap itself is atomic inside ParallelInference; the
+                # _swapping counter only widens the readiness signal around it
+                served.inference.update_model(served.versions[version].model)
+                with self._lock:
+                    served.previous_version = served.current_version
+                    served.current_version = version
+                    self._note_swap(name, version, _kind)
+            finally:
+                with self._lock:
+                    self._swapping -= 1
+
+    def rollback(self, name: str) -> int:
+        """Re-activate the previously live version; returns it. Counts as
+        ONE swap event (kind=rollback) — summing the swap counter over
+        kinds must equal the number of swaps."""
+        with self._lock:
+            served = self._get(name)
+            prev = served.previous_version
+            if prev is None:
+                raise ModelNotFound(f"{name} has no previous version")
+        self.activate(name, prev, _kind="rollback")
+        return prev
+
+    def _note_swap(self, name: str, version: int, kind: str) -> None:
+        if self._m_swaps is not None:
+            self._m_swaps.inc(model=name, kind=kind)
+        if self._m_version is not None:
+            self._m_version.set(version, model=name)
+
+    # ------------------------------------------------------------- queries
+    def _get(self, name: str) -> ServedModel:
+        served = self._models.get(name)
+        if served is None:
+            raise ModelNotFound(f"no model named {name!r}")
+        return served
+
+    def get(self, name: str) -> ServedModel:
+        with self._lock:
+            return self._get(name)
+
+    def has(self, name: str) -> bool:
+        with self._lock:
+            return name in self._models
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def list_models(self) -> List[dict]:
+        with self._lock:
+            return [self._models[n].describe() for n in sorted(self._models)]
+
+    @property
+    def swapping(self) -> bool:
+        with self._lock:
+            return self._swapping > 0
+
+    def healthy(self) -> bool:
+        """Every dispatcher alive (readiness ingredient)."""
+        with self._lock:
+            return all(m.inference.healthy for m in self._models.values())
+
+    # ------------------------------------------------------------ data path
+    def predict(self, name: str, x, *, version: Optional[int] = None,
+                deadline_s: Optional[float] = None):
+        """Predict through the live dispatcher; see ``predict_versioned``."""
+        return self.predict_versioned(name, x, version=version,
+                                      deadline_s=deadline_s)[0]
+
+    def predict_versioned(self, name: str, x, *,
+                          version: Optional[int] = None,
+                          deadline_s: Optional[float] = None):
+        """Predict; returns ``(outputs, version_served)``.
+
+        A pinned ``version`` that is not the live one runs synchronously on
+        that version's model (no batching) — the escape hatch for canarying
+        an old/new version side by side; the live version always goes
+        through the coalescing dispatcher. ``version_served`` is attributed
+        from the model object that ACTUALLY served the batch, so a hot-swap
+        landing mid-request can never mislabel an old model's output with
+        the new version number.
+        """
+        served = self.get(name)
+        with self._lock:
+            current = served.current_version
+            if version is not None and version not in served.versions:
+                raise ModelNotFound(f"{name} has no version {version}")
+            pinned = (served.versions[version].model
+                      if version is not None and version != current else None)
+        if pinned is not None:
+            import numpy as np
+            return np.asarray(pinned.output(np.asarray(x))), version
+        out, model = served.inference.output(x, deadline_s=deadline_s,
+                                             return_model=True)
+        with self._lock:
+            ver = next((mv.version for mv in served.versions.values()
+                        if mv.model is model), served.current_version)
+        return out, ver
+
+    # ----------------------------------------------------------- lifecycle
+    def shutdown(self) -> None:
+        """Stop every dispatcher (flushes in-flight batches first)."""
+        with self._lock:
+            models = list(self._models.values())
+        for m in models:
+            m.inference.shutdown()

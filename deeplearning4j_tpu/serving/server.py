@@ -1,0 +1,277 @@
+"""HTTP front-end: the network surface of the model-serving subsystem.
+
+stdlib ``http.server`` (the same Play→stdlib translation as the KNN and UI
+servers), one OS thread per connection, composing registry + admission +
+metrics into the production request path:
+
+==============================================  ==================================
+endpoint                                        behavior
+==============================================  ==================================
+``POST /v1/models/<name>[:<version>]/predict``  JSON ``{"inputs": [...]}`` or the
+                                                ``streaming/codec.py`` binary array
+                                                frame (``application/octet-stream``);
+                                                response mirrors the request type
+``GET /v1/models``                              registry listing (versions, health)
+``GET /v1/models/<name>``                       one model's description
+``GET /healthz``                                process liveness (always 200)
+``GET /readyz``                                 readiness — 503 while draining, mid
+                                                hot-swap, empty, or dispatcher-dead
+``GET /metrics``                                Prometheus text exposition
+==============================================  ==================================
+
+Status mapping (the contract the tests reconcile against the metrics):
+200 served · 400 malformed · 404 unknown model/version · 429 + ``Retry-After``
+admission overflow · 500 model error · 503 draining/dispatcher-dead ·
+504 deadline exceeded (expired requests are never dispatched to the device).
+
+Per-request deadlines ride the ``X-Deadline-Ms`` header (or ``deadline_ms``
+in a JSON body) and propagate into the batching dispatcher.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.inference import (DispatcherCrashed,
+                                                   InferenceDeadlineExceeded)
+from deeplearning4j_tpu.serving.admission import (AdmissionController,
+                                                  AdmissionRejected, Draining)
+from deeplearning4j_tpu.serving.metrics import (MetricsRegistry,
+                                                default_registry)
+from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
+from deeplearning4j_tpu.streaming.codec import (deserialize_array,
+                                                serialize_array)
+
+BINARY_CONTENT_TYPE = "application/octet-stream"
+
+
+class ModelServer:
+    """Production inference front-end over a ``ModelRegistry``."""
+
+    def __init__(self, registry: ModelRegistry, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 metrics: Optional[MetricsRegistry] = None,
+                 max_inflight: int = 64, retry_after_s: float = 0.05,
+                 default_deadline_s: Optional[float] = None):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.metrics = metrics if metrics is not None else default_registry()
+        self.default_deadline_s = default_deadline_s
+        self.admission = AdmissionController(
+            max_inflight, retry_after_s=retry_after_s, metrics=self.metrics)
+        self._m_requests = self.metrics.counter(
+            "serving_requests_total",
+            "Predict requests by model and HTTP status", ("model", "status"))
+        self._m_latency = self.metrics.histogram(
+            "serving_request_latency_seconds",
+            "Predict latency (admission to response)", ("model",))
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> int:
+        """Bind (port 0 → ephemeral) and serve on a background thread;
+        returns the bound port."""
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence
+                pass
+
+            # -------------------------------------------------- responders
+            def _respond(self, code: int, body: bytes, content_type: str,
+                         headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _json(self, obj, code: int = 200,
+                      headers: Tuple[Tuple[str, str], ...] = ()) -> None:
+                self._respond(code, json.dumps(obj).encode(),
+                              "application/json", headers)
+
+            # ------------------------------------------------------- GETs
+            def do_GET(self):
+                path = urlparse(self.path).path
+                if path == "/healthz":
+                    self._json({"status": "ok"})
+                elif path == "/readyz":
+                    ready, why = server.readiness()
+                    self._json({"ready": ready, "reason": why},
+                               200 if ready else 503)
+                elif path == "/metrics":
+                    self._respond(200, server.metrics.exposition().encode(),
+                                  "text/plain; version=0.0.4")
+                elif path == "/v1/models":
+                    self._json({"models": server.registry.list_models()})
+                elif path.startswith("/v1/models/"):
+                    name = path[len("/v1/models/"):]
+                    try:
+                        self._json(server.registry.get(name).describe())
+                    except ModelNotFound as e:
+                        self._json({"error": str(e)}, 404)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            # ------------------------------------------------------ predict
+            def do_POST(self):
+                # drain the body FIRST, on every path: with HTTP/1.1
+                # keep-alive, an unread body on a reject (404/429/503)
+                # would desync the connection for the client's next request
+                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(n)
+                path = urlparse(self.path).path
+                if not (path.startswith("/v1/models/")
+                        and path.endswith("/predict")):
+                    self._json({"error": "not found"}, 404)
+                    return
+                ref = path[len("/v1/models/"):-len("/predict")]
+                name, version = server._parse_model_ref(ref)
+                server._predict(self, name, version, raw)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self, *, drain: bool = True, drain_timeout_s: float = 5.0,
+             shutdown_registry: bool = False) -> None:
+        """Graceful shutdown: stop admitting, let in-flight requests finish,
+        then close the listener (and optionally the dispatchers)."""
+        if drain:
+            self.admission.begin_drain()
+            self.admission.wait_idle(drain_timeout_s)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if shutdown_registry:
+            self.registry.shutdown()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # ------------------------------------------------------------ internals
+    def readiness(self) -> Tuple[bool, str]:
+        if self.admission.draining:
+            return False, "draining"
+        if not self.registry.names():
+            return False, "no models registered"
+        if self.registry.swapping:
+            return False, "hot-swap in progress"
+        if not self.registry.healthy():
+            return False, "inference dispatcher down"
+        return True, "ok"
+
+    @staticmethod
+    def _parse_model_ref(ref: str) -> Tuple[str, Optional[int]]:
+        """``name`` or ``name:version`` (non-numeric suffix = part of the
+        name, so names with colons still resolve)."""
+        if ":" in ref:
+            name, _, tail = ref.rpartition(":")
+            try:
+                return name, int(tail)
+            except ValueError:
+                pass
+        return ref, None
+
+    def _predict(self, handler, name: str, version: Optional[int],
+                 raw: bytes) -> None:
+        t0 = time.perf_counter()
+        status = 500
+        try:
+            try:
+                slot = self.admission.admit()
+            except AdmissionRejected as e:
+                status = 429
+                handler._json(
+                    {"error": str(e)}, 429,
+                    headers=(("Retry-After",
+                              f"{max(e.retry_after_s, 0.001):.3f}"),))
+                return
+            except Draining:
+                status = 503
+                handler._json({"error": "server is draining"}, 503)
+                return
+            with slot:
+                status = self._predict_admitted(handler, name, version, raw)
+        finally:
+            # unknown names collapse to one sentinel label — URL probes must
+            # not grow the metric registry without bound (same bounded-
+            # cardinality rule as the UI server's route labels)
+            label = name if self.registry.has(name) else "_unknown"
+            self._m_requests.inc(model=label, status=str(status))
+            self._m_latency.observe(time.perf_counter() - t0, model=label)
+
+    def _predict_admitted(self, handler, name: str, version: Optional[int],
+                          raw: bytes) -> int:
+        binary = False
+        try:
+            content_type = (handler.headers.get("Content-Type") or "").split(
+                ";")[0].strip().lower()
+            deadline_s = self.default_deadline_s
+            hdr = handler.headers.get("X-Deadline-Ms")
+            if hdr is not None:
+                deadline_s = float(hdr) / 1e3
+            if content_type == BINARY_CONTENT_TYPE:
+                binary = True
+                x = deserialize_array(raw)
+            else:
+                body = json.loads(raw.decode() or "{}")
+                if "inputs" not in body:
+                    handler._json({"error": "body needs 'inputs'"}, 400)
+                    return 400
+                x = np.asarray(body["inputs"], dtype=np.float32)
+                if "deadline_ms" in body:
+                    deadline_s = float(body["deadline_ms"]) / 1e3
+            if x.ndim == 0:
+                handler._json({"error": "inputs must be at least 1-d"}, 400)
+                return 400
+            # version attributed from the model that ACTUALLY served the
+            # batch — a hot-swap landing mid-request must not mislabel
+            out, v = self.registry.predict_versioned(
+                name, x, version=version, deadline_s=deadline_s)
+            if binary:
+                handler._respond(200, serialize_array(out),
+                                 BINARY_CONTENT_TYPE,
+                                 headers=(("X-Model-Version", str(v)),))
+            else:
+                handler._json({"model": name, "version": v,
+                               "outputs": np.asarray(out).tolist()})
+            return 200
+        except ModelNotFound as e:
+            handler._json({"error": str(e)}, 404)
+            return 404
+        except InferenceDeadlineExceeded as e:
+            handler._json({"error": str(e)}, 504)
+            return 504
+        except DispatcherCrashed as e:
+            handler._json({"error": str(e)}, 503)
+            return 503
+        except (ValueError, KeyError, json.JSONDecodeError,
+                UnicodeDecodeError, struct.error) as e:
+            # struct.error: a truncated binary frame is client garbage, not
+            # a model fault — it must land in the 400 bucket
+            handler._json({"error": str(e)}, 400)
+            return 400
+        except Exception as e:  # model raised — contained per request
+            handler._json({"error": f"{type(e).__name__}: {e}"}, 500)
+            return 500

@@ -23,4 +23,4 @@ from deeplearning4j_tpu.streaming.broker import (  # noqa: F401
     StreamingDataSetIterator,
 )
 from deeplearning4j_tpu.streaming.kafka import NDArrayKafkaClient  # noqa: F401
-from deeplearning4j_tpu.streaming.route import Route  # noqa: F401
+from deeplearning4j_tpu.streaming.route import Route, RouteError  # noqa: F401

@@ -9,17 +9,40 @@ optionally on a background thread.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Iterable, List, Optional
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+
+class RouteError(RuntimeError):
+    """A transform/sink raised under the ``stop`` policy; chains the cause
+    and carries the offending ``item``."""
+
+    def __init__(self, item: Any, cause: Exception):
+        super().__init__(f"route failed on item {item!r}: {cause!r}")
+        self.item = item
 
 
 class Route:
-    """``Route().from_source(it).transform(f).to_topic(broker, "t").start()``"""
+    """``Route().from_source(it).transform(f).to_topic(broker, "t").start()``
+
+    Error policy (``on_error``): what a throwing transform/sink does —
+    - ``'stop'`` (default): processing stops and the error SURFACES — a
+      synchronous ``run()`` raises ``RouteError``; a background ``start()``
+      records it in ``route.error`` (a route thread never dies silently);
+    - ``'skip'``: the item is dropped, the (item, exception) pair appended
+      to ``route.errors``, and the route continues (Camel's
+      dead-letter-channel role);
+    - a callable ``fn(item, exc)``: invoked per failure, route continues;
+      if the handler itself raises, that escalates as ``stop`` would.
+    """
 
     def __init__(self):
         self._source: Optional[Iterable] = None
         self._transforms: List[Callable[[Any], Any]] = []
         self._sink: Optional[Callable[[Any], None]] = None
         self._thread: Optional[threading.Thread] = None
+        self._on_error: Any = "stop"
+        self.error: Optional[Exception] = None
+        self.errors: List[Tuple[Any, Exception]] = []
 
     def from_source(self, iterable: Iterable) -> "Route":
         self._source = iterable
@@ -48,28 +71,61 @@ class Route:
         self._sink = out.append
         return self
 
+    def on_error(self, policy) -> "Route":
+        """``'stop'`` | ``'skip'`` | ``fn(item, exc)`` — see class docs."""
+        if policy not in ("stop", "skip") and not callable(policy):
+            raise ValueError(
+                f"on_error must be 'stop', 'skip' or a callable, "
+                f"got {policy!r}")
+        self._on_error = policy
+        return self
+
     def run(self) -> int:
         """Drain the source synchronously; returns items delivered."""
         if self._source is None or self._sink is None:
             raise ValueError("route needs from_source(...) and a to_*(...) sink")
         n = 0
         for item in self._source:
-            dropped = False
-            for kind, fn in self._transforms:
-                if kind == "map":
-                    item = fn(item)
-                elif not fn(item):  # filter
-                    dropped = True
-                    break
-            if dropped:
-                continue
-            self._sink(item)
+            original = item
+            try:
+                dropped = False
+                for kind, fn in self._transforms:
+                    if kind == "map":
+                        item = fn(item)
+                    elif not fn(item):  # filter
+                        dropped = True
+                        break
+                if dropped:
+                    continue
+                self._sink(item)
+            except Exception as e:  # noqa: BLE001 - policy decides
+                if self._on_error == "skip":
+                    self.errors.append((original, e))
+                    continue
+                if callable(self._on_error):
+                    try:
+                        self._on_error(original, e)
+                    except Exception as handler_exc:  # noqa: BLE001
+                        # handler failure escalates like 'stop' — same
+                        # RouteError contract, carrying the offending item
+                        raise RouteError(original, handler_exc) from handler_exc
+                    self.errors.append((original, e))
+                    continue
+                raise RouteError(original, e) from e
             n += 1
         return n
 
     def start(self) -> "Route":
-        """Run on a background thread (Camel's async route start)."""
-        self._thread = threading.Thread(target=self.run, daemon=True)
+        """Run on a background thread (Camel's async route start). A
+        failure under the ``stop`` policy lands in ``self.error`` instead
+        of vanishing with the thread."""
+        def guarded():
+            try:
+                self.run()
+            except Exception as e:  # noqa: BLE001 - surfaced via .error
+                self.error = e
+
+        self._thread = threading.Thread(target=guarded, daemon=True)
         self._thread.start()
         return self
 

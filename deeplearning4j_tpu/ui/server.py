@@ -194,12 +194,18 @@ class UIServer:
 
     _instance: Optional["UIServer"] = None
 
-    def __init__(self, port: int = 9000):
+    def __init__(self, port: int = 9000, metrics=None):
         self.port = port
         self._storages: List[StatsStorage] = []
         self.remote = RemoteReceiverModule(router=None, enabled=False)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # optional shared observability core (serving.metrics registry):
+        # request count/latency land beside the model-serving series
+        self._observe = None
+        if metrics is not None:
+            from deeplearning4j_tpu.serving.metrics import instrument_http
+            self._observe = instrument_http(metrics, "ui")
 
     @classmethod
     def get_instance(cls, port: int = 9000) -> "UIServer":
@@ -326,9 +332,16 @@ class UIServer:
         """Start serving on self.port (0 → ephemeral); returns the bound port."""
         ui = self
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # silence
-                pass
+        from deeplearning4j_tpu.serving.metrics import HTTPObserverMixin
+
+        class Handler(HTTPObserverMixin, BaseHTTPRequestHandler):
+            observe = ui._observe
+
+            @staticmethod
+            def route_label(path):
+                # first two path segments only (bounded cardinality:
+                # session/layer ids stay out of labels)
+                return "/" + "/".join([p for p in path.split("/") if p][:2])
 
             def _json(self, obj, code=200):
                 body = json.dumps(obj).encode("utf-8")

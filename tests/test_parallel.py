@@ -467,6 +467,94 @@ class TestParallelInferenceModes:
             pi.shutdown()
 
 
+class TestParallelInferenceRobustness:
+    """The serving tier's containment contract (round-6 fixes): a dispatcher
+    crash must never strand waiters, deadlines must keep expired work off
+    the device, and degenerate requests are rejected client-side."""
+
+    def test_dispatcher_crash_fails_waiters_and_future_requests(self, rng):
+        import threading
+        from deeplearning4j_tpu.parallel.inference import DispatcherCrashed
+        net = small_net()
+        pi = ParallelInference(net, mode="batched", max_batch_size=4)
+        try:
+            def boom(batch, n):
+                raise RuntimeError("kaboom")
+
+            pi._dispatch = boom
+            errors = []
+
+            def call():
+                try:
+                    pi.output(rng.normal(size=(2, 12)).astype(np.float32))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+            threads = [threading.Thread(target=call) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)          # pre-fix these hung forever
+            assert len(errors) == 4
+            assert all(isinstance(e, DispatcherCrashed) for e in errors)
+            assert not pi.healthy
+            assert isinstance(pi.dispatcher_error, RuntimeError)
+            with pytest.raises(DispatcherCrashed):   # fast-fail from now on
+                pi.output(np.zeros((1, 12), np.float32))
+        finally:
+            pi.shutdown()
+
+    def test_deadline_expired_request_never_dispatched(self):
+        import threading
+
+        class Gate:
+            def __init__(self):
+                self.gate = threading.Event()
+                self.entered = threading.Event()
+                self.calls = 0
+
+            def output(self, x):
+                self.calls += 1
+                self.entered.set()
+                assert self.gate.wait(10.0)
+                return np.zeros((np.asarray(x).shape[0], 2), np.float32)
+
+        from deeplearning4j_tpu.parallel.inference import (
+            InferenceDeadlineExceeded)
+        gate = Gate()
+        pi = ParallelInference(gate, mode="batched", max_batch_size=4)
+        try:
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.setdefault(
+                    "a", pi.output(np.zeros((1, 3), np.float32))))
+            t.start()
+            assert gate.entered.wait(5.0)    # dispatcher stuck in batch 1
+            with pytest.raises(InferenceDeadlineExceeded):
+                pi.output(np.zeros((1, 3), np.float32), deadline_s=0.05)
+            gate.gate.set()
+            t.join(timeout=10)
+            assert got["a"].shape == (1, 2)
+            # the expired request was skipped; a fresh one forms batch 2
+            assert pi.output(np.zeros((1, 3), np.float32)).shape == (1, 2)
+            assert gate.calls == 2
+        finally:
+            gate.gate.set()
+            pi.shutdown()
+
+    def test_zero_dim_request_rejected_client_side(self):
+        net = small_net()
+        pi = ParallelInference(net, mode="batched")
+        try:
+            with pytest.raises(ValueError, match="at least 1-d"):
+                pi.output(np.float32(3.0))
+            # the dispatcher survived — normal requests still serve
+            assert pi.healthy
+            assert pi.output(np.zeros((1, 12), np.float32)).shape == (1, 4)
+        finally:
+            pi.shutdown()
+
+
 def conv_bn_net(seed=3, lr=0.05):
     """Small VGG-style conv block WITH BatchNorm — BN's batch statistics
     under data parallelism are the classic silent-divergence trap

@@ -1,0 +1,557 @@
+"""Production serving subsystem tests (`serving/`): metrics core, versioned
+registry, admission control, and the HTTP front-end driven end to end over
+ephemeral ports — concurrent load with metric reconciliation, hot-swap under
+load with a no-torn-responses oracle, deadline expiry (504, never
+dispatched), queue overflow (429 + Retry-After), dispatcher-crash
+containment (503), and graceful drain. Everything runs on CPU with port-0
+binds and no sleeps beyond the ~50 ms deadline windows under test.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.serving import (AdmissionController,
+                                        AdmissionRejected, Draining,
+                                        MetricsRegistry, ModelNotFound,
+                                        ModelRegistry, ModelServer,
+                                        ModelServingClient, ServingError,
+                                        parse_prometheus_text)
+
+
+def small_net(seed=7, n_in=12, n_out=4):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=n_out, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+class _GateModel:
+    """Stub model whose forward blocks until released — deterministic
+    control over dispatcher timing without sleeps. Duck-types the only
+    method ParallelInference calls."""
+
+    def __init__(self, n_out=2):
+        self.gate = threading.Event()
+        self.entered = threading.Event()
+        self.calls = 0
+        self._lock = threading.Lock()
+        self.n_out = n_out
+
+    def output(self, x):
+        with self._lock:
+            self.calls += 1
+        self.entered.set()
+        assert self.gate.wait(10.0), "test forgot to release the gate"
+        x = np.asarray(x)
+        return np.zeros((x.shape[0], self.n_out), np.float32)
+
+
+@pytest.fixture
+def stack():
+    """(metrics, registry, server, client) with everything torn down."""
+    metrics = MetricsRegistry()
+    registry = ModelRegistry(metrics=metrics)
+    server = ModelServer(registry, metrics=metrics, max_inflight=32)
+    server.start()
+    client = ModelServingClient(server.url)
+    yield metrics, registry, server, client
+    server.stop(drain=False)
+    registry.shutdown()
+
+
+# --------------------------------------------------------------- metrics core
+class TestMetricsCore:
+    def test_counter_gauge_labels_and_exposition(self):
+        m = MetricsRegistry()
+        c = m.counter("reqs_total", "requests", ("model", "status"))
+        c.inc(model="a", status="200")
+        c.inc(2, model="a", status="500")
+        g = m.gauge("depth", "queue depth")
+        g.set(3)
+        g.dec()
+        text = m.exposition()
+        parsed = parse_prometheus_text(text)
+        assert parsed["reqs_total"][
+            (("model", "a"), ("status", "200"))] == 1
+        assert parsed["reqs_total"][
+            (("model", "a"), ("status", "500"))] == 2
+        assert parsed["depth"][()] == 2
+        assert "# TYPE reqs_total counter" in text
+        assert "# TYPE depth gauge" in text
+
+    def test_histogram_cumulative_buckets(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", buckets=[0.1, 1.0])
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        parsed = parse_prometheus_text(m.exposition())
+        assert parsed["lat_bucket"][(("le", "0.1"),)] == 1
+        assert parsed["lat_bucket"][(("le", "1"),)] == 2
+        assert parsed["lat_bucket"][(("le", "+Inf"),)] == 3
+        assert parsed["lat_count"][()] == 3
+        assert parsed["lat_sum"][()] == pytest.approx(5.55)
+        assert h.count() == 3
+
+    def test_get_or_create_identity_and_mismatch(self):
+        m = MetricsRegistry()
+        a = m.counter("x_total", label_names=("k",))
+        assert m.counter("x_total", label_names=("k",)) is a
+        with pytest.raises(ValueError):
+            m.counter("x_total", label_names=("other",))
+        with pytest.raises(ValueError):
+            m.gauge("x_total")
+        with pytest.raises(ValueError):
+            a.inc(wrong="label")
+        with pytest.raises(ValueError):
+            a.inc(-1, k="v")
+
+    def test_label_escaping_round_trip(self):
+        m = MetricsRegistry()
+        c = m.counter("esc_total", label_names=("p",))
+        weird = 'a"b\\c\nd'
+        c.inc(p=weird)
+        parsed = parse_prometheus_text(m.exposition())
+        assert parsed["esc_total"][(("p", weird),)] == 1
+
+
+# ------------------------------------------------------------------- registry
+class TestModelRegistry:
+    def test_register_from_zip_path_and_object(self, tmp_path):
+        from deeplearning4j_tpu.util.model_serializer import write_model
+        net = small_net(seed=3)
+        zip_path = tmp_path / "m.zip"
+        write_model(net, zip_path)
+        reg = ModelRegistry()
+        try:
+            v1 = reg.register("m", path=str(zip_path))
+            assert v1 == 1
+            v2 = reg.register("m", small_net(seed=4))
+            assert v2 == 2
+            assert reg.get("m").current_version == 2
+            listing = reg.list_models()
+            assert listing[0]["name"] == "m"
+            assert [v["version"] for v in listing[0]["versions"]] == [1, 2]
+            assert listing[0]["versions"][0]["source"] == str(zip_path)
+            # the zip-restored v1 still serves, pinned
+            x = np.zeros((2, 12), np.float32)
+            pinned = reg.predict("m", x, version=1)
+            np.testing.assert_allclose(pinned, np.asarray(net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            reg.shutdown()
+
+    def test_activate_rollback_and_swap_metrics(self):
+        metrics = MetricsRegistry()
+        reg = ModelRegistry(metrics=metrics)
+        try:
+            a, b = small_net(seed=1), small_net(seed=2)
+            reg.register("m", a)
+            reg.register("m", b)          # auto-activates v2
+            x = np.ones((2, 12), np.float32)
+            np.testing.assert_allclose(reg.predict("m", x),
+                                       np.asarray(b.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            assert reg.rollback("m") == 1
+            np.testing.assert_allclose(reg.predict("m", x),
+                                       np.asarray(a.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            assert reg.get("m").current_version == 1
+            # one counter increment per swap EVENT: register v1, activate
+            # v2, rollback — summing over kinds == number of swaps
+            swaps = metrics.get("serving_model_swaps_total")
+            assert swaps.value(model="m", kind="register") == 1
+            assert swaps.value(model="m", kind="activate") == 1
+            assert swaps.value(model="m", kind="rollback") == 1
+            assert swaps.total() == 3
+            assert metrics.get("serving_model_version").value(model="m") == 1
+        finally:
+            reg.shutdown()
+
+    def test_unknowns_raise(self):
+        reg = ModelRegistry()
+        try:
+            with pytest.raises(ModelNotFound):
+                reg.get("ghost")
+            reg.register("m", small_net())
+            with pytest.raises(ModelNotFound):
+                reg.activate("m", 9)
+            with pytest.raises(ModelNotFound):
+                reg.rollback("m")  # no previous version yet
+            with pytest.raises(ValueError):
+                reg.register("m")  # neither model nor path
+        finally:
+            reg.shutdown()
+
+
+# ------------------------------------------------------------------ admission
+class TestAdmission:
+    def test_overflow_and_release(self):
+        ctrl = AdmissionController(2, retry_after_s=0.25)
+        s1, s2 = ctrl.admit(), ctrl.admit()
+        with pytest.raises(AdmissionRejected) as ei:
+            ctrl.admit()
+        assert ei.value.retry_after_s == 0.25
+        s1.release()
+        with ctrl.admit():
+            pass
+        s2.release()
+        assert ctrl.inflight == 0
+
+    def test_drain(self):
+        ctrl = AdmissionController(4)
+        slot = ctrl.admit()
+        ctrl.begin_drain()
+        with pytest.raises(Draining):
+            ctrl.admit()
+        assert not ctrl.wait_idle(timeout=0.05)
+        slot.release()
+        assert ctrl.wait_idle(timeout=1.0)
+
+
+# ----------------------------------------------------------------- HTTP tier
+class TestModelServerEndpoints:
+    def test_health_ready_listing_and_404(self, stack):
+        metrics, registry, server, client = stack
+        assert client.healthy()
+        assert not client.ready()          # empty registry → not ready
+        registry.register("m", small_net())
+        assert client.ready()
+        assert [m["name"] for m in client.models()] == ["m"]
+        assert client.model("m")["current_version"] == 1
+        with pytest.raises(ServingError) as ei:
+            client.predict("ghost", np.zeros((1, 12), np.float32))
+        assert ei.value.status == 404
+        with pytest.raises(ServingError) as ei:
+            client.predict("m", np.zeros((1, 12), np.float32), version=9)
+        assert ei.value.status == 404
+
+    def test_json_and_binary_predict_agree(self, stack):
+        metrics, registry, server, client = stack
+        net = small_net(seed=5)
+        registry.register("m", net)
+        x = np.random.default_rng(0).normal(size=(6, 12)).astype(np.float32)
+        want = np.asarray(net.output(x))
+        got_json = client.predict("m", x)
+        got_bin = client.predict("m", x, binary=True)
+        np.testing.assert_allclose(got_json, want, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got_bin, want, rtol=1e-5, atol=1e-6)
+        # binary response is the exact codec frame (float32, no JSON loss)
+        assert got_bin.dtype == want.dtype
+
+    def test_bad_requests_400(self, stack):
+        metrics, registry, server, client = stack
+        registry.register("m", small_net())
+        url = f"{server.url}/v1/models/m/predict"
+
+        def post(body, ctype="application/json"):
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": ctype})
+            try:
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert post(b"not json") == 400
+        assert post(json.dumps({"nope": 1}).encode()) == 400
+        assert post(json.dumps({"inputs": 3.0}).encode()) == 400  # 0-d
+        assert post(b"\x00\x00\x00\xffgarbage",
+                    "application/octet-stream") == 400
+        # truncated binary frame (< 4-byte header → struct.error, not 500)
+        assert post(b"\x00", "application/octet-stream") == 400
+
+    def test_concurrent_load_metrics_reconcile(self, stack):
+        """N client threads × M models; every per-status counter must
+        reconcile with what the clients observed, and the batch-size
+        histogram count must equal the number of dispatched batches."""
+        metrics, registry, server, client = stack
+        nets = {"alpha": small_net(seed=1), "beta": small_net(seed=2)}
+        for name, net in nets.items():
+            registry.register(name, net)
+        x = np.random.default_rng(1).normal(size=(3, 12)).astype(np.float32)
+        want = {n: np.asarray(net.output(x)) for n, net in nets.items()}
+        observed = []   # (model, status) per request, client-side
+        obs_lock = threading.Lock()
+
+        def worker(name, reps):
+            local = []
+            for i in range(reps):
+                target = name if i % 5 else "ghost"   # sprinkle 404s
+                try:
+                    out = client.predict(target, x)
+                    np.testing.assert_allclose(out, want[target],
+                                               rtol=1e-4, atol=1e-5)
+                    local.append((target, "200"))
+                except ServingError as e:
+                    local.append((target, str(e.status)))
+            with obs_lock:
+                observed.extend(local)
+
+        threads = [threading.Thread(target=worker, args=(name, 10))
+                   for name in nets for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(observed) == 60
+        parsed = parse_prometheus_text(client.metrics_text())
+        series = parsed["serving_requests_total"]
+        # per-(model,status) totals reconcile exactly with the client view;
+        # unknown names land under the bounded "_unknown" sentinel label
+        from collections import Counter as C
+        client_view = C((m if m in nets else "_unknown", s)
+                        for m, s in observed)
+        server_view = {(k[0][1], k[1][1]): int(v) for k, v in series.items()}
+        assert server_view == dict(client_view)
+        assert sum(series.values()) == 60
+        # batch-size histogram count == dispatched batches, per model
+        for name in nets:
+            dispatched = registry.get(name).inference.batches_dispatched
+            assert parsed["inference_batch_size_count"][
+                (("model", name),)] == dispatched
+            # every request row is accounted for inside the batches
+            assert parsed["inference_batch_size_sum"][
+                (("model", name),)] == sum(
+                    3 for m, s in observed if m == name and s == "200")
+
+    def test_hot_swap_under_load_no_torn_responses(self, stack):
+        """Serve concurrently while v2 activates and then rolls back: every
+        successful response equals EITHER version's output exactly — never a
+        mixture — and the swap counter records the events."""
+        metrics, registry, server, client = stack
+        a, b = small_net(seed=11), small_net(seed=22)
+        registry.register("m", a)
+        x = np.random.default_rng(2).normal(size=(4, 12)).astype(np.float32)
+        want_a = np.asarray(a.output(x))
+        want_b = np.asarray(b.output(x))
+        assert np.abs(want_a - want_b).max() > 1e-2  # distinguishable
+        failures = []
+
+        def worker(reps):
+            for _ in range(reps):
+                out = client.predict("m", x)
+                da = np.abs(out - want_a).max()
+                db = np.abs(out - want_b).max()
+                if min(da, db) > 1e-4:
+                    failures.append((da, db))
+
+        threads = [threading.Thread(target=worker, args=(25,))
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        registry.register("m", b)            # hot-swap to v2 mid-load
+        registry.rollback("m")               # and back, still under load
+        for t in threads:
+            t.join(timeout=60)
+        assert not failures, failures
+        swaps = metrics.get("serving_model_swaps_total")
+        assert swaps.value(model="m", kind="activate") >= 1
+        assert swaps.value(model="m", kind="rollback") == 1
+        assert registry.get("m").current_version == 1
+
+    def test_deadline_expiry_504_and_never_dispatched(self, stack):
+        metrics, registry, server, client = stack
+        gate = _GateModel()
+        registry.register("slow", gate)
+        results = {}
+
+        def blocked():
+            results["a"] = client.predict("slow", np.zeros((1, 3)))
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        assert gate.entered.wait(5.0)        # dispatcher now stuck in batch 1
+        t0 = time.perf_counter()
+        with pytest.raises(ServingError) as ei:
+            client.predict("slow", np.zeros((1, 3)), deadline_ms=50)
+        elapsed = time.perf_counter() - t0
+        assert ei.value.status == 504
+        assert elapsed < 5.0                 # failed at the deadline, not the gate
+        gate.gate.set()                      # release batch 1
+        t.join(timeout=10)
+        assert results["a"].shape == (1, 2)
+        # the expired request was never dispatched: a fresh request lands in
+        # batch 2, so the gate saw exactly 2 forward calls in total
+        client.predict("slow", np.zeros((1, 3)))
+        assert gate.calls == 2
+        assert metrics.get("serving_requests_total").value(
+            model="slow", status="504") == 1
+
+    def test_queue_overflow_429_with_retry_after(self):
+        metrics = MetricsRegistry()
+        registry = ModelRegistry(metrics=metrics)
+        server = ModelServer(registry, metrics=metrics, max_inflight=2,
+                             retry_after_s=0.125)
+        server.start()
+        client = ModelServingClient(server.url)
+        gate = _GateModel()
+        registry.register("slow", gate)
+        done = []
+        try:
+            def worker():
+                done.append(client.predict("slow", np.zeros((1, 3))).shape)
+
+            threads = [threading.Thread(target=worker) for _ in range(2)]
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 5.0
+            while (server.admission.inflight < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.002)
+            assert server.admission.inflight == 2
+            with pytest.raises(ServingError) as ei:
+                client.predict("slow", np.zeros((1, 3)))
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s == pytest.approx(0.125)
+            gate.gate.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert done == [(1, 2), (1, 2)]
+            assert metrics.get("serving_admission_rejections_total").value(
+                reason="overflow") == 1
+            assert metrics.get("serving_requests_total").value(
+                model="slow", status="429") == 1
+        finally:
+            gate.gate.set()
+            server.stop(drain=False)
+            registry.shutdown()
+
+    def test_dispatcher_crash_contained_as_503(self, stack):
+        """A dispatcher-thread crash must fail in-flight AND future requests
+        with 503 — no hung clients — and flip /readyz."""
+        metrics, registry, server, client = stack
+        registry.register("m", small_net())
+        pi = registry.get("m").inference
+
+        def boom(batch, n):
+            raise RuntimeError("device fell over")
+
+        pi._dispatch = boom
+        with pytest.raises(ServingError) as ei:
+            client.predict("m", np.zeros((2, 12), np.float32))
+        assert ei.value.status == 503        # in-flight request unblocked
+        with pytest.raises(ServingError) as ei:
+            client.predict("m", np.zeros((2, 12), np.float32))
+        assert ei.value.status == 503        # fast-fail, dispatcher is gone
+        assert not pi.healthy
+        assert not client.ready()
+        assert not registry.healthy()
+        assert metrics.get("inference_dispatcher_up").value(model="m") == 0
+
+    def test_keep_alive_connection_survives_reject_paths(self, stack):
+        """HTTP/1.1 keep-alive: a rejected POST (404) must still drain the
+        request body, or the next request on the same socket would parse
+        the stale body as its request line."""
+        import http.client
+        metrics, registry, server, client = stack
+        registry.register("m", small_net())
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        try:
+            body = json.dumps(
+                {"inputs": np.zeros((2, 12)).tolist()}).encode()
+            conn.request("POST", "/v1/models/ghost/predict", body,
+                         {"Content-Type": "application/json"})
+            r1 = conn.getresponse()
+            assert r1.status == 404
+            r1.read()
+            # SAME socket: must parse cleanly after the rejected request
+            conn.request("POST", "/v1/models/m/predict", body,
+                         {"Content-Type": "application/json"})
+            r2 = conn.getresponse()
+            assert r2.status == 200
+            assert json.loads(r2.read())["version"] == 1
+        finally:
+            conn.close()
+
+    def test_predict_reports_the_version_that_served(self, stack):
+        """The response's version field comes from the model object that
+        actually served the batch — not a post-hoc registry read."""
+        metrics, registry, server, client = stack
+        a, b = small_net(seed=1), small_net(seed=2)
+        registry.register("m", a)
+        registry.register("m", b)
+        out, ver = registry.predict_versioned(
+            "m", np.zeros((2, 12), np.float32))
+        np.testing.assert_allclose(
+            out, np.asarray(b.output(np.zeros((2, 12), np.float32))),
+            rtol=1e-5, atol=1e-6)
+        assert ver == 2
+        out1, ver1 = registry.predict_versioned(
+            "m", np.zeros((2, 12), np.float32), version=1)
+        assert ver1 == 1
+
+    def test_graceful_drain_shutdown(self):
+        registry = ModelRegistry()
+        server = ModelServer(registry)
+        server.start()
+        client = ModelServingClient(server.url)
+        registry.register("m", small_net())
+        assert client.predict("m", np.zeros((1, 12), np.float32)).shape == (1, 4)
+        server.stop(drain=True, shutdown_registry=True)
+        assert not client.ready()            # listener closed → not ready
+        assert not client.healthy()
+        with pytest.raises(RuntimeError):
+            registry.predict("m", np.zeros((1, 12), np.float32))
+
+
+# ------------------------------------------------- shared observability core
+class TestSharedMetricsCore:
+    def test_knn_server_reports_through_shared_registry(self, rng):
+        from deeplearning4j_tpu.clustering.server import (
+            NearestNeighborsClient, NearestNeighborsServer)
+        metrics = MetricsRegistry()
+        srv = NearestNeighborsServer(
+            rng.normal(size=(16, 4)).astype(np.float32), port=0,
+            metrics=metrics)
+        port = srv.start()
+        try:
+            c = NearestNeighborsClient(f"http://127.0.0.1:{port}")
+            c.knn(0, 3)
+            c.knn_new(np.zeros(4, np.float32), 2)
+            reqs = metrics.get("http_requests_total")
+            assert reqs.value(server="knn", path="/knn", status="200") == 1
+            assert reqs.value(server="knn", path="/knnnew", status="200") == 1
+            assert metrics.get("http_request_latency_seconds").count(
+                server="knn", path="/knn") == 1
+            # a malformed request line (rejected before self.path is set)
+            # must not crash the instrumented handler...
+            import socket
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(b"GET /x HTTP/garbage\r\n\r\n")
+                assert s.recv(64)  # error reply, not a dropped connection
+            # ...and the server keeps serving afterwards
+            c.knn(0, 1)
+            assert reqs.value(server="knn", path="/knn", status="200") == 2
+        finally:
+            srv.stop()
+
+    def test_ui_server_reports_through_shared_registry(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        metrics = MetricsRegistry()
+        ui = UIServer(port=0, metrics=metrics)
+        ui.attach(InMemoryStatsStorage())
+        port = ui.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/train/sessions",
+                    timeout=5) as r:
+                assert r.status == 200
+            reqs = metrics.get("http_requests_total")
+            assert reqs.value(server="ui", path="/train/sessions",
+                              status="200") == 1
+        finally:
+            ui.stop()

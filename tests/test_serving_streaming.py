@@ -239,6 +239,98 @@ class TestStreaming:
              .transform(lambda x: x * 10).to_list(out).run())
         assert n == 5 and out == [0, 20, 40, 60, 80]
 
+    def test_route_on_error_skip_drops_and_records(self):
+        from deeplearning4j_tpu.streaming import Route
+        out = []
+        bad = lambda x: 10 // x  # raises on 0
+        r = (Route().from_source([5, 0, 2, 0, 1]).transform(bad)
+             .to_list(out).on_error("skip"))
+        assert r.run() == 3
+        assert out == [2, 5, 10]
+        assert [item for item, _ in r.errors] == [0, 0]
+        assert all(isinstance(e, ZeroDivisionError) for _, e in r.errors)
+
+    def test_route_on_error_stop_surfaces_sync_and_async(self):
+        from deeplearning4j_tpu.streaming import Route, RouteError
+        out = []
+        bad = lambda x: 10 // x
+        # synchronous: raises with the offending item attached
+        r = Route().from_source([5, 0, 2]).transform(bad).to_list(out)
+        with pytest.raises(RouteError) as ei:
+            r.run()
+        assert ei.value.item == 0
+        assert out == [2]
+        # background: the thread must not die silently — error is captured
+        out2 = []
+        r2 = (Route().from_source([5, 0, 2]).transform(bad)
+              .to_list(out2).start())
+        r2.join(timeout=5)
+        assert isinstance(r2.error, RouteError)
+        assert out2 == [2]  # stopped at the failure, items after dropped
+
+    def test_route_on_error_callback_continues(self):
+        from deeplearning4j_tpu.streaming import Route
+        out, seen = [], []
+        r = (Route().from_source([1, 0, 4]).transform(lambda x: 10 // x)
+             .to_list(out)
+             .on_error(lambda item, exc: seen.append((item, type(exc)))))
+        assert r.run() == 2
+        assert out == [10, 2]
+        assert seen == [(0, ZeroDivisionError)]
+        assert len(r.errors) == 1
+
+    def test_route_on_error_raising_callback_escalates_as_route_error(self):
+        from deeplearning4j_tpu.streaming import Route, RouteError
+
+        def bad_handler(item, exc):
+            raise TypeError("handler itself is broken")
+
+        r = (Route().from_source([1, 0, 4]).transform(lambda x: 10 // x)
+             .to_list([]).on_error(bad_handler))
+        with pytest.raises(RouteError) as ei:   # documented 'stop' contract
+            r.run()
+        assert ei.value.item == 0
+        assert isinstance(ei.value.__cause__, TypeError)
+
+    def test_route_on_error_rejects_unknown_policy(self):
+        from deeplearning4j_tpu.streaming import Route
+        with pytest.raises(ValueError):
+            Route().on_error("explode")
+
+
+class TestServeCli:
+    def test_serve_round_trip(self, tmp_path, capsys):
+        """``serve`` subcommand: register a checkpoint zip, predict over
+        HTTP, scrape /metrics, drain."""
+        from deeplearning4j_tpu.cli import serve_main
+        from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.serving import ModelServingClient
+        from deeplearning4j_tpu.util.model_serializer import write_model
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                                   loss="negativeloglikelihood")).build())
+        net = MultiLayerNetwork(conf).init()
+        path = tmp_path / "clf.zip"
+        write_model(net, path)
+        server = serve_main(["--model", f"clf={path}", "--port", "0"],
+                            block=False)
+        try:
+            client = ModelServingClient(server.url)
+            x = np.zeros((2, 4), np.float32)
+            out = client.predict("clf", x)
+            np.testing.assert_allclose(out, np.asarray(net.output(x)),
+                                       rtol=1e-5, atol=1e-6)
+            # bare-path registration uses the file stem as the name
+            assert [m["name"] for m in client.models()] == ["clf"]
+            assert "serving_requests_total" in client.metrics()
+            assert "registered 'clf' v1" in capsys.readouterr().out
+        finally:
+            server.stop(drain=True, shutdown_registry=True)
+
 
 class TestCloud:
     def test_gcloud_command_builders(self):
