@@ -32,6 +32,11 @@ headline suite, VERDICT r4 Weak #1), each with wall AND profiled device time
 
 Each suite entry is individually guarded: a failure records ``error`` for
 that entry and never blocks the headline line.
+
+``--trace DIR`` (or ``DL4J_TPU_BENCH_TRACE_DIR``) records each config —
+headline included — with the observe tracer and writes one Chrome-trace
+JSON per config into DIR (``<name>.trace.json``): per-step spans with the
+XLA compile spans attributed to the steps that paid for them.
 """
 
 import json
@@ -47,6 +52,33 @@ import numpy as np
 BASELINE_IMAGES_PER_SEC = 2035.4
 
 BERT_H5 = "/tmp/bert_base_import.h5"
+
+
+def _trace_dir():
+    if "--trace" in sys.argv:
+        i = sys.argv.index("--trace")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return os.environ.get("DL4J_TPU_BENCH_TRACE_DIR") or None
+
+
+def _with_trace(name, fn):
+    """Run one bench config, optionally recording it as its own trace."""
+    out_dir = _trace_dir()
+    if not out_dir:
+        return fn()
+    from deeplearning4j_tpu.observe import (Tracer, disable_tracing,
+                                            enable_tracing)
+    os.makedirs(out_dir, exist_ok=True)
+    tracer = enable_tracing(Tracer())  # fresh recorder per config
+    try:
+        with tracer.span(f"bench:{name}", category="bench"):
+            return fn()
+    finally:
+        disable_tracing()
+        path = os.path.join(out_dir, f"{name}.trace.json")
+        print(f"bench trace: {path} ({tracer.flush(path)} spans)",
+              file=sys.stderr)
 
 
 def _profiled_device_ms(net, ds):
@@ -68,13 +100,16 @@ def _profiled_device_ms(net, ds):
 
 def _measure(net, ds, items_per_batch, steps=8, warmup=3):
     """Wall + device per-step timings for one config; items/s from both."""
-    for _ in range(warmup):
-        net._fit_batch(ds)
-    float(net.score_)  # materialize: a data read is the only reliable sync
+    from deeplearning4j_tpu.observe import trace as _trace
+    with _trace.span("warmup", attrs={"steps": warmup}):
+        for _ in range(warmup):
+            net._fit_batch(ds)
+        float(net.score_)  # materialize: a data read is the only reliable sync
     t0 = time.perf_counter()
-    for _ in range(steps):
-        net._fit_batch(ds)
-    float(net.score_)  # drain the whole queue before stopping the clock
+    with _trace.span("measure", attrs={"steps": steps}):
+        for _ in range(steps):
+            net._fit_batch(ds)
+        float(net.score_)  # drain the whole queue before stopping the clock
     wall_ms = (time.perf_counter() - t0) / steps * 1e3
     rec = {"wall_ms_per_step": round(wall_ms, 2),
            "wall_items_per_sec": round(items_per_batch / wall_ms * 1e3, 1)}
@@ -107,14 +142,17 @@ def _resnet50_headline():
         np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, size=batch)])
     ds = DataSet(x, y)  # resident on device for the whole run
 
-    for _ in range(warmup):
-        net._fit_batch(ds)
-    float(net.score_)
+    from deeplearning4j_tpu.observe import trace as _trace
+    with _trace.span("warmup", attrs={"steps": warmup}):
+        for _ in range(warmup):
+            net._fit_batch(ds)
+        float(net.score_)
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        net._fit_batch(ds)
-    float(net.score_)
+    with _trace.span("measure", attrs={"steps": steps}):
+        for _ in range(steps):
+            net._fit_batch(ds)
+        float(net.score_)
     dt = time.perf_counter() - t0
 
     ips = batch * steps / dt
@@ -288,12 +326,12 @@ SUITE = {
 
 
 def main():
-    record = _resnet50_headline()
+    record = _with_trace("resnet50_headline", _resnet50_headline)
     if os.environ.get("DL4J_TPU_BENCH_HEADLINE_ONLY") != "1":
         suite = {}
         for name, fn in SUITE.items():
             try:
-                suite[name] = fn()
+                suite[name] = _with_trace(name, fn)
             except Exception as e:  # noqa: BLE001 - isolate per-config failures
                 suite[name] = {"error": f"{type(e).__name__}: {e}"}
         record["suite"] = suite

@@ -40,6 +40,9 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                     help="async prefetch buffer (AsyncDataSetIterator)")
     ap.add_argument("--uiUrl", default=None,
                     help="remote UI /remote endpoint to report stats to")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="record the run with the observe tracer and write "
+                         "a Chrome trace (chrome://tracing / Perfetto) here")
     args = ap.parse_args(argv)
 
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
@@ -59,12 +62,27 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
         from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
         net.listeners.append(
             StatsListener(RemoteUIStatsStorageRouter(args.uiUrl)))
+    tracer = None
+    if args.trace:
+        from deeplearning4j_tpu.observe import (TraceListener, default_registry,
+                                                enable_tracing)
+        tracer = enable_tracing(metrics=default_registry())
+        net.listeners.append(TraceListener(tracer))
     mesh = None
     if args.workers:
         mesh = make_mesh({"data": args.workers})
     pw = ParallelWrapper(net, mesh, mode=args.mode,
-                         averaging_frequency=args.averagingFrequency)
-    pw.fit(it, epochs=args.epochs)
+                         averaging_frequency=args.averagingFrequency,
+                         metrics=(None if tracer is None else tracer.metrics))
+    try:
+        pw.fit(it, epochs=args.epochs)
+    finally:
+        if tracer is not None:
+            from deeplearning4j_tpu.observe import disable_tracing
+            n = tracer.flush(args.trace)
+            print(f"wrote Chrome trace ({n} spans) to {args.trace}")
+            print(tracer.timeline(limit=40))
+            disable_tracing()
     model_serializer.write_model(net, args.modelOutputPath)
     return net
 
@@ -252,12 +270,20 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
                    help="admission limit before requests shed as 429")
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="default per-request deadline (504 past expiry)")
+    p.add_argument("--trace", default=None, metavar="OUT.json",
+                   help="trace requests (spans across HTTP, dispatcher and "
+                        "device) and write a Chrome trace here on shutdown")
     args = p.parse_args(argv)
 
     import os
 
     from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
                                             default_registry)
+
+    tracer = None
+    if args.trace:
+        from deeplearning4j_tpu.observe import enable_tracing
+        tracer = enable_tracing(metrics=default_registry())
 
     registry = ModelRegistry(metrics=default_registry(),
                              max_batch_size=args.max_batch_size,
@@ -276,6 +302,22 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     port = server.start()
     print(f"model server listening on {server.url} "
           f"(models: {', '.join(registry.names())}); port {port}")
+    if tracer is not None:
+        # the trace flushes when the server stops, however it is stopped —
+        # the blocking KeyboardInterrupt path AND block=False callers
+        server.tracer = tracer
+        orig_stop = server.stop
+
+        def _stop_and_flush(*a, **kw):
+            from deeplearning4j_tpu.observe import disable_tracing
+            try:
+                return orig_stop(*a, **kw)
+            finally:
+                n = tracer.flush(args.trace)
+                print(f"wrote Chrome trace ({n} spans) to {args.trace}")
+                disable_tracing()
+
+        server.stop = _stop_and_flush
     if block:
         try:
             server._thread.join()

@@ -54,10 +54,10 @@ class NearestNeighborsServer:
         self.port = port
         self._httpd = None
         self._thread = None
-        # optional shared observability core (serving.metrics registry)
+        # optional shared observability core (observe.metrics registry)
         self._observe = None
         if metrics is not None:
-            from deeplearning4j_tpu.serving.metrics import instrument_http
+            from deeplearning4j_tpu.observe.metrics import instrument_http
             self._observe = instrument_http(metrics, "knn")
         if use_device:
             from deeplearning4j_tpu.clustering.bruteforce import (
@@ -101,7 +101,7 @@ class NearestNeighborsServer:
     def start(self) -> int:
         server = self
 
-        from deeplearning4j_tpu.serving.metrics import HTTPObserverMixin
+        from deeplearning4j_tpu.observe.metrics import HTTPObserverMixin
 
         class Handler(HTTPObserverMixin, BaseHTTPRequestHandler):
             observe = server._observe

@@ -1,0 +1,52 @@
+"""Unified observability layer: tracing + metrics across train and serve.
+
+One subsystem answers "where did this millisecond go" end to end
+(the role of DL4J's listener/StatsListener/training-UI stack plus the
+Dapper-style request tracing the reference never had):
+
+- ``trace``    — ``Span``/``Tracer``/``TraceRecorder``: contextvar-nested
+  spans, explicit cross-thread handoff, W3C ``traceparent`` in/out,
+  bounded ring buffer; ``enable_tracing()`` flips every instrumented hot
+  path (ParallelWrapper steps, the ParallelInference dispatcher, the
+  ModelServer request path, streaming routes) from no-op to recording;
+- ``jaxhook``  — JAX compile/lowering attribution: ``jax.monitoring``
+  events become ``xla_compile``/``jax_lowering`` spans nested under
+  whatever span triggered them, so recompiles show up loudly;
+- ``export``   — Chrome trace-event JSON (``chrome://tracing``/Perfetto)
+  with flow arrows across threads, plus a terminal text timeline;
+- ``metrics``  — the Prometheus registry core (promoted from
+  ``serving.metrics``; that path remains as a deprecation re-export);
+- ``listener`` — ``TraceListener``: the TrainingListener bridge that makes
+  any ``fit()`` record spans and export ``training_*`` series through the
+  same ``/metrics`` the serving tier already exposes.
+"""
+
+from deeplearning4j_tpu.observe.metrics import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    HTTPObserverMixin,
+    MetricsRegistry,
+    default_registry,
+    instrument_http,
+    parse_prometheus_text,
+)
+from deeplearning4j_tpu.observe.trace import (  # noqa: F401
+    Span,
+    SpanContext,
+    TraceRecorder,
+    Tracer,
+    current_traceparent,
+    disable_tracing,
+    enable_tracing,
+    get_active_tracer,
+    parse_traceparent,
+    span,
+)
+from deeplearning4j_tpu.observe.export import (  # noqa: F401
+    text_timeline,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+from deeplearning4j_tpu.observe.listener import TraceListener  # noqa: F401
+from deeplearning4j_tpu.observe.jaxhook import install_jax_hook  # noqa: F401

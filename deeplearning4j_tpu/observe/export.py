@@ -1,0 +1,138 @@
+"""Trace exporters: Chrome trace-event JSON and a terminal text timeline.
+
+The JSON form follows the Trace Event Format (the ``chrome://tracing`` /
+Perfetto input): one complete (``"ph": "X"``) event per span with
+microsecond timestamps normalized to the earliest span, metadata events
+naming the process and threads, and flow arrows (``"s"``/``"f"``) drawn
+for span links — e.g. from an HTTP request span to the device batch that
+served it on the dispatcher thread.
+
+The text form is the same data for people without a browser: a
+time-ordered, nesting-indented listing with durations, suitable for
+dumping at the end of a CLI run.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.observe.trace import Span
+
+
+def _zlib_flow_id(src: str, dst: str) -> int:
+    """Stable positive flow id from the two span ids (ids are hex strings;
+    fold them — collisions across a single trace are practically nil)."""
+    return (int(src, 16) ^ (int(dst, 16) << 1)) & 0x7FFFFFFF
+
+
+def to_chrome_trace(spans: Sequence[Span], *,
+                    service: str = "deeplearning4j_tpu") -> dict:
+    """Render completed spans as a Trace Event Format object."""
+    pid = os.getpid()
+    events: List[dict] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": service},
+    }]
+    done = [s for s in spans if s.end_ns is not None]
+    if not done:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    base = min(s.start_ns for s in done)
+    by_id = {s.span_id: s for s in done}
+
+    named_threads = set()
+    for s in done:
+        if s.thread_id not in named_threads:
+            named_threads.add(s.thread_id)
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid,
+                "tid": s.thread_id, "args": {"name": s.thread_name},
+            })
+
+    for s in sorted(done, key=lambda sp: sp.start_ns):
+        ts = (s.start_ns - base) / 1e3
+        args = {"trace_id": s.trace_id, "span_id": s.span_id}
+        if s.parent_id:
+            args["parent_id"] = s.parent_id
+        if s.error:
+            args["error"] = s.error
+        for k, v in s.attrs.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                v = str(v)  # bare NaN/Infinity tokens are not JSON —
+                # chrome://tracing would reject the whole file
+            elif not isinstance(v, (int, float, bool, str, type(None))):
+                v = str(v)
+            args[str(k)] = v
+        events.append({
+            "name": s.name, "cat": s.category, "ph": "X",
+            "ts": ts, "dur": max((s.end_ns - s.start_ns) / 1e3, 0.0),
+            "pid": pid, "tid": s.thread_id, "args": args,
+        })
+        # flow arrows: linked span → this span (only when the source is
+        # still in the ring buffer; a dropped source just loses its arrow)
+        for link in s.links:
+            src = by_id.get(link.span_id)
+            if src is None:
+                continue
+            fid = _zlib_flow_id(src.span_id, s.span_id)
+            events.append({
+                "name": "link", "cat": "flow", "ph": "s", "id": fid,
+                "ts": (src.start_ns - base) / 1e3, "pid": pid,
+                "tid": src.thread_id,
+            })
+            events.append({
+                "name": "link", "cat": "flow", "ph": "f", "bp": "e",
+                "id": fid, "ts": ts, "pid": pid, "tid": s.thread_id,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, spans: Sequence[Span], *,
+                       service: str = "deeplearning4j_tpu") -> dict:
+    """Write the Chrome trace JSON; returns the object written."""
+    obj = to_chrome_trace(spans, service=service)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(obj, fh)
+    return obj
+
+
+def text_timeline(spans: Sequence[Span], *, limit: Optional[int] = None,
+                  attrs: bool = True) -> str:
+    """Compact terminal rendering: start offset, duration, nesting depth.
+
+    ::
+
+        [+     0.000ms    12.40ms] train_step  iteration=1 batch=32
+        [+     0.312ms     9.80ms]   xla_compile
+    """
+    done = sorted((s for s in spans if s.end_ns is not None),
+                  key=lambda sp: sp.start_ns)
+    if limit is not None:
+        done = done[-limit:]
+    if not done:
+        return "(no spans recorded)"
+    base = done[0].start_ns
+    by_id: Dict[str, Span] = {s.span_id: s for s in done}
+
+    def depth(s: Span) -> int:
+        d, seen = 0, set()
+        while s.parent_id and s.parent_id in by_id and s.span_id not in seen:
+            seen.add(s.span_id)
+            s = by_id[s.parent_id]
+            d += 1
+        return d
+
+    lines = []
+    for s in done:
+        off = (s.start_ns - base) / 1e6
+        dur = (s.end_ns - s.start_ns) / 1e6
+        line = (f"[+{off:10.3f}ms {dur:9.3f}ms] "
+                f"{'  ' * depth(s)}{s.name}")
+        if s.error:
+            line += f"  !{s.error}"
+        if attrs and s.attrs:
+            line += "  " + " ".join(f"{k}={v}" for k, v in s.attrs.items())
+        lines.append(line)
+    return "\n".join(lines)

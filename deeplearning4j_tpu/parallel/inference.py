@@ -18,8 +18,15 @@ status codes):
 - a dispatcher-thread crash fails every queued AND future request with
   ``DispatcherCrashed`` instead of stranding waiters forever (the 503 path);
   ``healthy`` / ``dispatcher_error`` surface the state;
-- an optional duck-typed metrics registry (``serving.metrics``-shaped)
+- an optional duck-typed metrics registry (``observe.metrics``-shaped)
   records the batch-size distribution and live queue depth.
+
+Tracing (``observe.trace``): when a tracer is active, every batched
+request runs inside an ``inference_request`` span; the dispatcher records
+a ``queue_wait`` span per request (parented to the REQUEST's context — the
+explicit cross-thread handoff) and a ``batch_execute`` span around the
+device call, flow-linked to every request it served, so an XLA compile of
+a new batch bucket nests visibly under the batch that paid for it.
 """
 
 from __future__ import annotations
@@ -34,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from deeplearning4j_tpu.observe import trace as _trace
 from deeplearning4j_tpu.parallel.sharding import batch_sharding
 
 
@@ -54,9 +62,10 @@ _PENDING, _CLAIMED, _CANCELLED = 0, 1, 2
 
 class _Request:
     __slots__ = ("x", "event", "result", "error", "deadline", "_state",
-                 "_lock", "served_model")
+                 "_lock", "served_model", "ctx", "t_enqueue", "t_claim")
 
-    def __init__(self, x, deadline: Optional[float] = None):
+    def __init__(self, x, deadline: Optional[float] = None,
+                 ctx: Optional[_trace.SpanContext] = None):
         self.x = x
         self.event = threading.Event()
         self.result = None
@@ -65,6 +74,11 @@ class _Request:
         self.deadline = deadline  # absolute time.monotonic() stamp
         self._state = _PENDING
         self._lock = threading.Lock()
+        self.ctx = ctx  # trace context handed across the dispatcher hop
+        # timestamps exist only for traced requests: the untraced hot path
+        # must stay a bare `is None` check, paying nothing
+        self.t_enqueue = time.perf_counter_ns() if ctx is not None else None
+        self.t_claim: Optional[int] = None
 
     def claim(self) -> bool:
         """Dispatcher-side: take ownership for dispatch. Returns False if
@@ -123,7 +137,7 @@ class ParallelInference:
       ``max_batch_size`` within a ``wait_ms`` TTL window measured from the
       oldest queued request (the ObservablesProvider nanos-TTL semantics).
 
-    ``metrics``: optional duck-typed registry (``serving.metrics``
+    ``metrics``: optional duck-typed registry (``observe.metrics``
     interface). When provided, records ``inference_batch_size`` (histogram,
     label ``model``), ``inference_queue_depth`` (gauge) and
     ``inference_dispatcher_up`` (gauge).
@@ -193,7 +207,11 @@ class ParallelInference:
             raise ValueError("request must be at least 1-d (a batch of rows)")
         if self.mode in ("sequential", "inplace"):
             model = self._model()
-            res = np.asarray(model.output(x))
+            with _trace.span("inference_request", category="serve",
+                             attrs={"model": self._metrics_name,
+                                    "rows": int(x.shape[0]),
+                                    "mode": self.mode}):
+                res = np.asarray(model.output(x))
             return (res, model) if return_model else res
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
@@ -202,7 +220,21 @@ class ParallelInference:
                 "inference dispatcher died") from self.dispatcher_error
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
-        req = _Request(x, deadline=deadline)
+        tracer = _trace.get_active_tracer()
+        if tracer is None:
+            return self._output_batched(x, deadline, deadline_s,
+                                        return_model, None)
+        # per-request span: covers enqueue → wait → result; its context
+        # rides the _Request across the dispatcher thread
+        with tracer.span("inference_request", category="serve",
+                         attrs={"model": self._metrics_name,
+                                "rows": int(x.shape[0]),
+                                "mode": "batched"}) as sp:
+            return self._output_batched(x, deadline, deadline_s,
+                                        return_model, sp.context)
+
+    def _output_batched(self, x, deadline, deadline_s, return_model, ctx):
+        req = _Request(x, deadline=deadline, ctx=ctx)
         self._q.put(req)
         # re-check AFTER the put: a crash/shutdown that drained the queue
         # concurrently with this enqueue would otherwise strand the request
@@ -289,6 +321,8 @@ class ParallelInference:
                 continue
             if not first.claim():  # cancelled or expired while queued
                 continue
+            if first.ctx is not None:
+                first.t_claim = time.perf_counter_ns()
             batch: List[_Request] = [first]
             # publish the batch list BEFORE coalescing: a crash anywhere
             # past the first claim must be able to fail these waiters
@@ -307,6 +341,8 @@ class ParallelInference:
                     break
                 if not r.claim():
                     continue
+                if r.ctx is not None:
+                    r.t_claim = time.perf_counter_ns()
                 batch.append(r)
                 n += r.x.shape[0]
             if self._m_depth is not None:
@@ -315,6 +351,26 @@ class ParallelInference:
             self._inflight_batch = []
 
     def _dispatch(self, batch: List[_Request], n: int) -> None:
+        tracer = _trace.get_active_tracer()
+        if tracer is None:
+            return self._dispatch_batch(batch, n, None)
+        # queue-wait attribution first: parented to each REQUEST's span
+        # (the explicit handoff — contextvars never cross the thread hop)
+        for r in batch:
+            if r.ctx is not None and r.t_claim is not None:
+                tracer.record("queue_wait", r.t_enqueue, r.t_claim,
+                              parent=r.ctx, category="serve",
+                              attrs={"model": self._metrics_name})
+        # the device call runs INSIDE this span on the dispatcher thread, so
+        # a compile of a new batch bucket nests under the batch that paid
+        with tracer.span("batch_execute", category="serve",
+                         attrs={"model": self._metrics_name, "rows": n,
+                                "requests": len(batch)}) as sp:
+            for r in batch:
+                sp.add_link(r.ctx)
+            self._dispatch_batch(batch, n, sp)
+
+    def _dispatch_batch(self, batch: List[_Request], n: int, sp) -> None:
         try:
             x = np.concatenate([r.x for r in batch], axis=0)
             # pad to bucket size → bounded set of compiled shapes
@@ -325,6 +381,8 @@ class ParallelInference:
             if target > n:
                 pad = np.zeros((target - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+            if sp is not None:
+                sp.set_attribute("padded_to", int(target))
             xj = jnp.asarray(x)
             if self.mesh is not None:
                 xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
@@ -341,6 +399,8 @@ class ParallelInference:
                 off += k
                 r.event.set()
         except Exception as e:  # deliver errors to waiting clients
+            if sp is not None:
+                sp.error = f"{type(e).__name__}: {e}"
             for r in batch:
                 r.error = e
                 r.event.set()

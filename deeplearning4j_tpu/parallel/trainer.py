@@ -33,8 +33,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from deeplearning4j_tpu.observe import trace as _trace
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
 from deeplearning4j_tpu.parallel.sharding import batch_sharding, shard_model
+
+
+def _batch_nbytes(ds) -> int:
+    """Host→device payload of one DataSet (features/labels/masks)."""
+    total = 0
+    for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask):
+        if a is not None:
+            total += int(getattr(a, "nbytes", 0))
+    return total
 
 
 def make_pure_step(net, train: bool = True):
@@ -69,7 +79,8 @@ class ParallelWrapper:
                  mode: str = "shared_gradients",
                  averaging_frequency: int = 5,
                  tp_axis: Optional[str] = None,
-                 data_axis: str = DATA_AXIS):
+                 data_axis: str = DATA_AXIS,
+                 metrics=None, metrics_name: str = "default"):
         if mode not in ("shared_gradients", "averaging"):
             raise ValueError(f"unknown mode {mode!r}")
         if mode == "averaging" and tp_axis is not None:
@@ -86,6 +97,15 @@ class ParallelWrapper:
             model.init()
         shard_model(model, self.mesh, tp_axis=tp_axis)
         self.n_workers = self.mesh.shape[data_axis]
+        # optional duck-typed registry (observe.metrics): training-side
+        # host→device transfer accounting next to the listener's series
+        self._metrics_name = metrics_name
+        self._m_transfer = None
+        if metrics is not None:
+            self._m_transfer = metrics.counter(
+                "training_transfer_bytes_total",
+                "Host to device bytes shipped with training batches",
+                ("model",))
 
     # ------------------------------------------------------------- evaluate
     def evaluate(self, iterator, top_n: int = 1):
@@ -137,22 +157,46 @@ class ParallelWrapper:
         else:
             iterator = data
 
-        for _ in range(epochs):
-            for listener in self.model.listeners:
-                if hasattr(listener, "on_epoch_start"):
-                    listener.on_epoch_start(self.model)
-            if hasattr(iterator, "reset"):
-                iterator.reset()
-            if self.mode == "shared_gradients":
-                for ds in iterator:
-                    self._fit_batch_sync(ds)
-            else:
-                self._fit_averaging(iterator)
-            self.model.epoch += 1
-            for listener in self.model.listeners:
-                if hasattr(listener, "on_epoch_end"):
-                    listener.on_epoch_end(self.model)
+        with _trace.span("parallel_fit", category="train",
+                         attrs={"mode": self.mode, "workers": self.n_workers,
+                                "epochs": epochs}):
+            for _ in range(epochs):
+                for listener in self.model.listeners:
+                    if hasattr(listener, "on_epoch_start"):
+                        listener.on_epoch_start(self.model)
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+                if self.mode == "shared_gradients":
+                    for ds in iterator:
+                        self._fit_step_traced(ds)
+                else:
+                    self._fit_averaging(iterator)
+                self.model.epoch += 1
+                for listener in self.model.listeners:
+                    if hasattr(listener, "on_epoch_end"):
+                        listener.on_epoch_end(self.model)
         return self
+
+    def _fit_step_traced(self, ds) -> None:
+        """One step, wrapped in a ``train_step`` span when tracing is on.
+        The traced path syncs on the loss so the span covers the DEVICE
+        time of the step (and any compile nests under it — step 0's
+        compile shows up loudly); untraced runs keep async dispatch."""
+        tracer = _trace.get_active_tracer()
+        if tracer is None:
+            self._fit_batch_sync(ds)
+            return
+        net = self.model
+        with tracer.span("train_step", category="train",
+                         attrs={"mode": self.mode}) as sp:
+            self._fit_batch_sync(ds)
+            try:
+                sp.set_attribute("loss", float(net.score_))  # device sync
+            except Exception:  # noqa: BLE001 - score may be deferred
+                pass
+            sp.set_attribute("iteration", int(net.iteration))
+            sp.set_attribute("batch", int(getattr(net, "last_batch_size", 0)
+                                          or 0))
 
     # ------------------------------------------- shared-gradients (per step)
     def _fit_batch_sync(self, ds) -> None:
@@ -163,6 +207,8 @@ class ParallelWrapper:
         unsharded — same math, no DP speedup for that one step (the reference
         ParallelWrapper likewise handles arbitrary tail batches)."""
         net = self.model
+        if self._m_transfer is not None:
+            self._m_transfer.inc(_batch_nbytes(ds), model=self._metrics_name)
         n = int(np.asarray(ds.features).shape[0])
         if n % self.n_workers:
             net._fit_batch(ds)
@@ -241,7 +287,25 @@ class ParallelWrapper:
         def flush():
             if not pending:
                 return
+            tracer = _trace.get_active_tracer()
+            if tracer is None:
+                _flush_inner()
+                return
+            with tracer.span("train_step", category="train",
+                             attrs={"mode": "averaging",
+                                    "local_steps": len(pending)}) as sp:
+                _flush_inner()
+                try:
+                    sp.set_attribute("loss", float(net.score_))  # sync
+                except Exception:  # noqa: BLE001
+                    pass
+                sp.set_attribute("iteration", int(net.iteration))
+
+        def _flush_inner():
             kk = len(pending)
+            if self._m_transfer is not None:
+                self._m_transfer.inc(sum(_batch_nbytes(d) for d in pending),
+                                     model=self._metrics_name)
             xs = jnp.stack([jnp.asarray(d.features, dtype) for d in pending])
             ys = jnp.stack([jnp.asarray(d.labels, dtype) for d in pending])
             fms = stack_masks([d.features_mask for d in pending],
@@ -273,6 +337,11 @@ class ParallelWrapper:
         for ds in iterator:
             if int(np.asarray(ds.features).shape[0]) % self.n_workers:
                 flush()
+                # ragged tail still crosses the host-device boundary: count
+                # it (same accounting as the shared_gradients path)
+                if self._m_transfer is not None:
+                    self._m_transfer.inc(_batch_nbytes(ds),
+                                         model=self._metrics_name)
                 net._fit_batch(ds)  # ragged tail batch: unsharded
                 continue
             if pending and np.asarray(ds.features).shape != np.asarray(

@@ -2,9 +2,9 @@
 
 Turns any trained or imported model into a network service:
 
-- ``metrics``   — dependency-free counters/gauges/histograms + Prometheus
-  text exposition, shared by ``ParallelInference``, the KNN server and the
-  UI server;
+- ``metrics``   — now ``deeplearning4j_tpu.observe.metrics`` (the shared
+  train+serve observability core; ``serving.metrics`` remains a deprecation
+  re-export), surfaced here for compatibility;
 - ``registry``  — versioned model registry with atomic hot-swap (built on
   ``ParallelInference.update_model``) and rollback; loads from
   ModelSerializer zips, DL4J checkpoints, Keras h5 or live objects;
@@ -22,7 +22,7 @@ The role of the reference ecosystem's serving deployments around
 subsystem.
 """
 
-from deeplearning4j_tpu.serving.metrics import (  # noqa: F401
+from deeplearning4j_tpu.observe.metrics import (  # noqa: F401
     Counter,
     Gauge,
     Histogram,

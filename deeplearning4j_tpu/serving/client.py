@@ -5,6 +5,12 @@ skips float→text→float for large tensors), plus listing, health probes and
 a ``/metrics`` scrape that parses back into numbers. Raises ``ServingError``
 carrying the HTTP status and the server's ``Retry-After`` hint so callers
 can implement backoff.
+
+Tracing: ``predict`` runs inside a ``client_predict`` span when a tracer is
+active and ALWAYS ships a W3C ``traceparent`` header for it (creating a
+fresh trace when no span is open), so the server's ``http_request`` span —
+and everything under it — lands in the same timeline. The trace id the
+server echoes back is kept on ``client.last_trace_id`` for correlation.
 """
 
 from __future__ import annotations
@@ -16,14 +22,16 @@ from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.serving.metrics import parse_prometheus_text
+from deeplearning4j_tpu.observe import trace as _trace
+from deeplearning4j_tpu.observe.metrics import parse_prometheus_text
 from deeplearning4j_tpu.serving.server import BINARY_CONTENT_TYPE
 from deeplearning4j_tpu.streaming.codec import (deserialize_array,
                                                 serialize_array)
 
 
 class ServingError(RuntimeError):
-    """Non-2xx response; carries ``status``, ``message``, ``retry_after_s``."""
+    """Non-2xx response; carries ``status``, ``message``, ``retry_after_s``
+    and ``trace_id`` (the server's ``X-Trace-Id`` echo, when present)."""
 
     def __init__(self, status: int, message: str,
                  retry_after_s: Optional[float] = None):
@@ -31,12 +39,14 @@ class ServingError(RuntimeError):
         self.status = status
         self.message = message
         self.retry_after_s = retry_after_s
+        self.trace_id: Optional[str] = None
 
 
 class ModelServingClient:
     def __init__(self, url: str, timeout: float = 10.0):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.last_trace_id: Optional[str] = None  # server's X-Trace-Id echo
 
     # -------------------------------------------------------------- plumbing
     def _request(self, path: str, data: Optional[bytes] = None,
@@ -45,6 +55,9 @@ class ModelServingClient:
                                      headers=headers or {})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                echoed = resp.headers.get("X-Trace-Id")
+                if echoed:
+                    self.last_trace_id = echoed
                 return resp.status, resp.read(), dict(resp.headers)
         except urllib.error.HTTPError as e:
             body = e.read()
@@ -53,9 +66,16 @@ class ModelServingClient:
             except Exception:  # noqa: BLE001 - body may not be JSON
                 message = body.decode(errors="replace")
             retry = e.headers.get("Retry-After")
-            raise ServingError(
+            # error responses echo X-Trace-Id too — correlation matters
+            # MOST for failures, so capture it before raising
+            echoed = e.headers.get("X-Trace-Id")
+            if echoed:
+                self.last_trace_id = echoed
+            err = ServingError(
                 e.code, message,
-                float(retry) if retry is not None else None) from None
+                float(retry) if retry is not None else None)
+            err.trace_id = echoed
+            raise err from None
 
     # -------------------------------------------------------------- predict
     def predict(self, model: str, inputs, *, version: Optional[int] = None,
@@ -66,16 +86,33 @@ class ModelServingClient:
         headers = {}
         if deadline_ms is not None:
             headers["X-Deadline-Ms"] = str(deadline_ms)
+        tracer = _trace.get_active_tracer()
+        if tracer is None:
+            return self._predict_send(path, inputs, binary, headers)[0]
+        with tracer.span("client_predict", category="serve",
+                         attrs={"model": model, "url": self.url}) as sp:
+            # the span's own context crosses the wire; the server parents
+            # its http_request span to it
+            headers["traceparent"] = sp.context.traceparent()
+            out, echoed = self._predict_send(path, inputs, binary, headers)
+            if echoed:  # THIS response's echo only — a shared client may
+                sp.set_attribute("server_trace_id", echoed)  # serve threads
+            return out
+
+    def _predict_send(self, path: str, inputs, binary: bool, headers: dict):
+        """Returns ``(outputs, x_trace_id_or_None)`` — the echo is threaded
+        back per call, never through shared client state."""
         if binary:
             headers["Content-Type"] = BINARY_CONTENT_TYPE
-            _, body, _ = self._request(
+            _, body, resp_headers = self._request(
                 path, serialize_array(np.asarray(inputs)), headers)
-            return deserialize_array(body)
+            return deserialize_array(body), resp_headers.get("X-Trace-Id")
         headers["Content-Type"] = "application/json"
         payload = {"inputs": np.asarray(inputs).tolist()}
-        _, body, _ = self._request(path, json.dumps(payload).encode(),
-                                   headers)
-        return np.asarray(json.loads(body.decode())["outputs"])
+        _, body, resp_headers = self._request(
+            path, json.dumps(payload).encode(), headers)
+        return (np.asarray(json.loads(body.decode())["outputs"]),
+                resp_headers.get("X-Trace-Id"))
 
     # ------------------------------------------------------------ inspection
     def models(self) -> list:

@@ -68,7 +68,7 @@ class ServedModel:
 class ModelRegistry:
     """Thread-safe registry; one ``ParallelInference`` per model name.
 
-    ``metrics`` is a ``serving.metrics.MetricsRegistry`` (duck-typed) shared
+    ``metrics`` is an ``observe.metrics.MetricsRegistry`` (duck-typed) shared
     with the dispatchers — swap/rollback events and per-model live-version
     gauges land next to the batch/queue series the dispatchers emit.
     """

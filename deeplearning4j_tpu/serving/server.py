@@ -26,6 +26,13 @@ admission overflow · 500 model error · 503 draining/dispatcher-dead ·
 
 Per-request deadlines ride the ``X-Deadline-Ms`` header (or ``deadline_ms``
 in a JSON body) and propagate into the batching dispatcher.
+
+Distributed tracing: a W3C ``traceparent`` request header joins the
+caller's trace — the predict path runs inside an ``http_request`` span
+parented to it (handler threads nest the dispatcher's ``queue_wait`` /
+``batch_execute`` spans under the same trace via the request context), and
+every predict response echoes ``X-Trace-Id`` (plus a ``traceparent`` of the
+server's own span while tracing is active) so callers can correlate.
 """
 
 from __future__ import annotations
@@ -40,12 +47,13 @@ from urllib.parse import urlparse
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import trace as _trace
+from deeplearning4j_tpu.observe.metrics import (MetricsRegistry,
+                                                default_registry)
 from deeplearning4j_tpu.parallel.inference import (DispatcherCrashed,
                                                    InferenceDeadlineExceeded)
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   AdmissionRejected, Draining)
-from deeplearning4j_tpu.serving.metrics import (MetricsRegistry,
-                                                default_registry)
 from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
 from deeplearning4j_tpu.streaming.codec import (deserialize_array,
                                                 serialize_array)
@@ -97,6 +105,10 @@ class ModelServer:
                 self.send_header("Content-Length", str(len(body)))
                 for k, v in headers:
                     self.send_header(k, v)
+                # trace correlation headers ride EVERY response of a traced
+                # request, whichever branch answered it
+                for k, v in getattr(self, "_trace_headers", ()):
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -107,6 +119,9 @@ class ModelServer:
 
             # ------------------------------------------------------- GETs
             def do_GET(self):
+                # the handler instance persists across keep-alive requests:
+                # correlation headers must never leak onto the next response
+                self._trace_headers = ()
                 path = urlparse(self.path).path
                 if path == "/healthz":
                     self._json({"status": "ok"})
@@ -130,6 +145,7 @@ class ModelServer:
 
             # ------------------------------------------------------ predict
             def do_POST(self):
+                self._trace_headers = ()  # no stale keep-alive correlation
                 # drain the body FIRST, on every path: with HTTP/1.1
                 # keep-alive, an unread body on a reject (404/429/503)
                 # would desync the connection for the client's next request
@@ -195,6 +211,25 @@ class ModelServer:
 
     def _predict(self, handler, name: str, version: Optional[int],
                  raw: bytes) -> None:
+        # join the caller's trace when a traceparent header arrives; echo
+        # the trace id either way so the client can correlate
+        parent = _trace.parse_traceparent(handler.headers.get("traceparent"))
+        tracer = _trace.get_active_tracer()
+        if tracer is None:
+            if parent is not None:
+                handler._trace_headers = (("X-Trace-Id", parent.trace_id),)
+            self._predict_timed(handler, name, version, raw)
+            return
+        with tracer.span("http_request", parent=parent, category="serve",
+                         attrs={"model": name}) as sp:
+            handler._trace_headers = (
+                ("traceparent", sp.context.traceparent()),
+                ("X-Trace-Id", sp.trace_id))
+            sp.set_attribute(
+                "status", self._predict_timed(handler, name, version, raw))
+
+    def _predict_timed(self, handler, name: str, version: Optional[int],
+                       raw: bytes) -> int:
         t0 = time.perf_counter()
         status = 500
         try:
@@ -206,13 +241,14 @@ class ModelServer:
                     {"error": str(e)}, 429,
                     headers=(("Retry-After",
                               f"{max(e.retry_after_s, 0.001):.3f}"),))
-                return
+                return status
             except Draining:
                 status = 503
                 handler._json({"error": "server is draining"}, 503)
-                return
+                return status
             with slot:
                 status = self._predict_admitted(handler, name, version, raw)
+            return status
         finally:
             # unknown names collapse to one sentinel label — URL probes must
             # not grow the metric registry without bound (same bounded-

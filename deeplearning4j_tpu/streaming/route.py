@@ -11,6 +11,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Iterable, List, Optional, Tuple
 
+from deeplearning4j_tpu.observe import trace as _trace
+
 
 class RouteError(RuntimeError):
     """A transform/sink raised under the ``stop`` policy; chains the cause
@@ -19,6 +21,9 @@ class RouteError(RuntimeError):
     def __init__(self, item: Any, cause: Exception):
         super().__init__(f"route failed on item {item!r}: {cause!r}")
         self.item = item
+
+
+_DROPPED = object()  # sentinel: a filter rejected the item
 
 
 class Route:
@@ -81,23 +86,27 @@ class Route:
         return self
 
     def run(self) -> int:
-        """Drain the source synchronously; returns items delivered."""
+        """Drain the source synchronously; returns items delivered.
+
+        When a tracer is active (``observe.enable_tracing``), the drain
+        runs inside a ``route.run`` span with one ``route.item`` span per
+        item and a child span per transform/sink stage — a failing or slow
+        stage is visible in the same timeline as the training steps and
+        serving requests it feeds."""
         if self._source is None or self._sink is None:
             raise ValueError("route needs from_source(...) and a to_*(...) sink")
+        tracer = _trace.get_active_tracer()
+        with _trace.span("route.run", category="stream"):
+            return self._run_items(tracer)
+
+    def _run_items(self, tracer) -> int:
         n = 0
-        for item in self._source:
+        for index, item in enumerate(self._source):
             original = item
             try:
-                dropped = False
-                for kind, fn in self._transforms:
-                    if kind == "map":
-                        item = fn(item)
-                    elif not fn(item):  # filter
-                        dropped = True
-                        break
-                if dropped:
+                item = self._process_item(tracer, item, index)
+                if item is _DROPPED:
                     continue
-                self._sink(item)
             except Exception as e:  # noqa: BLE001 - policy decides
                 if self._on_error == "skip":
                     self.errors.append((original, e))
@@ -114,6 +123,30 @@ class Route:
                 raise RouteError(original, e) from e
             n += 1
         return n
+
+    def _process_item(self, tracer, item, index):
+        """Transforms + sink for one item; returns ``_DROPPED`` when a
+        filter rejects it. Stage spans only exist while tracing is on."""
+        if tracer is None:
+            for kind, fn in self._transforms:
+                if kind == "map":
+                    item = fn(item)
+                elif not fn(item):  # filter
+                    return _DROPPED
+            self._sink(item)
+            return item
+        with tracer.span("route.item", category="stream",
+                         attrs={"index": index}):
+            for kind, fn in self._transforms:
+                stage = getattr(fn, "__name__", None) or type(fn).__name__
+                with tracer.span(f"{kind}:{stage}", category="stream"):
+                    if kind == "map":
+                        item = fn(item)
+                    elif not fn(item):  # filter
+                        return _DROPPED
+            with tracer.span("sink", category="stream"):
+                self._sink(item)
+        return item
 
     def start(self) -> "Route":
         """Run on a background thread (Camel's async route start). A

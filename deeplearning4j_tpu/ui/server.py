@@ -200,11 +200,11 @@ class UIServer:
         self.remote = RemoteReceiverModule(router=None, enabled=False)
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
-        # optional shared observability core (serving.metrics registry):
+        # optional shared observability core (observe.metrics registry):
         # request count/latency land beside the model-serving series
         self._observe = None
         if metrics is not None:
-            from deeplearning4j_tpu.serving.metrics import instrument_http
+            from deeplearning4j_tpu.observe.metrics import instrument_http
             self._observe = instrument_http(metrics, "ui")
 
     @classmethod
@@ -332,7 +332,7 @@ class UIServer:
         """Start serving on self.port (0 → ephemeral); returns the bound port."""
         ui = self
 
-        from deeplearning4j_tpu.serving.metrics import HTTPObserverMixin
+        from deeplearning4j_tpu.observe.metrics import HTTPObserverMixin
 
         class Handler(HTTPObserverMixin, BaseHTTPRequestHandler):
             observe = ui._observe

@@ -139,6 +139,13 @@ class StatsListener(TrainingListener):
         return out
 
     def _memory_info(self) -> Dict[str, Any]:
+        """Host RSS plus JAX device memory when the backend exposes it.
+
+        Device stats aggregate over ALL local devices (the reference's
+        per-worker memory report covered every GPU) with a per-device
+        breakdown; every probe is guarded per device, so CPU-only CI —
+        where ``memory_stats()`` is None or unsupported — reports host
+        memory exactly as before."""
         info: Dict[str, Any] = {}
         try:
             import resource
@@ -147,13 +154,32 @@ class StatsListener(TrainingListener):
             pass
         try:
             import jax
-            d = jax.devices()[0]
-            ms = d.memory_stats()
-            if ms:
-                info["device_bytes_in_use"] = int(ms.get("bytes_in_use", 0))
-                info["device_bytes_limit"] = int(ms.get("bytes_limit", 0))
+            devices = jax.local_devices()
         except Exception:
-            pass
+            return info
+        per_device = []
+        total_in_use = total_limit = 0
+        for d in devices:
+            try:
+                ms = d.memory_stats()
+            except Exception:  # backend without memory introspection
+                ms = None
+            if not ms:
+                continue
+            in_use = int(ms.get("bytes_in_use", 0))
+            limit = int(ms.get("bytes_limit", 0))
+            total_in_use += in_use
+            total_limit += limit
+            entry = {"device": str(d), "bytes_in_use": in_use,
+                     "bytes_limit": limit}
+            if "peak_bytes_in_use" in ms:
+                entry["peak_bytes_in_use"] = int(ms["peak_bytes_in_use"])
+            per_device.append(entry)
+        if per_device:
+            info["device_bytes_in_use"] = total_in_use
+            info["device_bytes_limit"] = total_limit
+            info["device_count"] = len(per_device)
+            info["devices"] = per_device
         return info
 
     # -- listener hooks --------------------------------------------------

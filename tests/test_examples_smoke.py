@@ -32,7 +32,7 @@ pytestmark = pytest.mark.skipif(
 
 
 def test_all_examples_present():
-    assert len(EXAMPLES) >= 24, EXAMPLES
+    assert len(EXAMPLES) >= 25, EXAMPLES
 
 
 @pytest.mark.parametrize("script", EXAMPLES)

@@ -1,0 +1,664 @@
+"""Unified observability layer: span correctness, exporters, metrics.
+
+Covers the ISSUE-4 satellite checklist: nesting across threads (the
+dispatcher handoff), Chrome trace-event schema validation of the exporter
+output (via ``tools/validate_trace.py`` — the same checker the smoke
+example runs), ``/metrics`` round-tripping the new ``training_*`` series
+through ``parse_prometheus_text``, ``TraceListener`` surviving a throwing
+peer listener, and the ``serving.metrics`` deprecation re-export.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe import (MetricsRegistry, Span, TraceListener,
+                                        TraceRecorder, Tracer,
+                                        disable_tracing, enable_tracing,
+                                        get_active_tracer,
+                                        parse_prometheus_text,
+                                        parse_traceparent, text_timeline,
+                                        to_chrome_trace, write_chrome_trace)
+from deeplearning4j_tpu.observe import trace as trace_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+from validate_trace import validate_events, validate_file  # noqa: E402
+
+
+@pytest.fixture
+def tracer():
+    tr = enable_tracing(Tracer(TraceRecorder(capacity=4096)), jax_hook=False)
+    yield tr
+    disable_tracing()
+
+
+def _by_name(tr):
+    out = {}
+    for s in tr.recorder.spans():
+        out.setdefault(s.name, []).append(s)
+    return out
+
+
+def _await_span(tr, name, timeout=5.0):
+    """Spans record at span EXIT; a server may still be closing its span
+    when the client already has the response — poll briefly."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        spans = _by_name(tr)
+        if name in spans:
+            return spans
+        time.sleep(0.005)
+    raise AssertionError(f"span {name!r} never recorded; "
+                         f"saw {sorted(_by_name(tr))}")
+
+
+# ---------------------------------------------------------------- span core
+class TestSpanCore:
+    def test_nesting_same_thread(self, tracer):
+        with tracer.span("outer") as outer:
+            with tracer.span("inner") as inner:
+                assert inner.trace_id == outer.trace_id
+                assert inner.parent_id == outer.span_id
+            with tracer.span("inner2") as inner2:
+                assert inner2.parent_id == outer.span_id
+        assert outer.parent_id is None
+        spans = tracer.recorder.spans()
+        assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+        assert all(s.end_ns is not None and s.end_ns >= s.start_ns
+                   for s in spans)
+
+    def test_exception_closes_and_marks(self, tracer):
+        with pytest.raises(ValueError):
+            with tracer.span("bad"):
+                raise ValueError("boom")
+        (sp,) = tracer.recorder.spans()
+        assert sp.end_ns is not None
+        assert "boom" in sp.error
+        # context is restored after the failed span
+        assert tracer.current_context() is None
+
+    def test_record_after_the_fact(self, tracer):
+        t1 = time.perf_counter_ns()
+        sp = tracer.record("window", t1 - 1000, t1, attrs={"k": 1})
+        assert sp.end_ns - sp.start_ns == 1000
+        assert tracer.recorder.spans()[0] is sp
+
+    def test_ring_buffer_drops_oldest(self):
+        rec = TraceRecorder(capacity=8)
+        tr = Tracer(rec)
+        for i in range(20):
+            now = time.perf_counter_ns()
+            tr.record(f"s{i}", now - 10, now)
+        assert len(rec) == 8
+        assert rec.total_recorded == 20
+        assert rec.dropped == 12
+        assert [s.name for s in rec.spans()] == [f"s{i}" for i in range(12, 20)]
+
+    def test_inactive_module_span_is_noop(self):
+        assert get_active_tracer() is None
+        with trace_mod.span("nothing") as sp:
+            assert sp is None
+
+
+# ----------------------------------------------------------- W3C traceparent
+class TestTraceparent:
+    def test_round_trip(self, tracer):
+        with tracer.span("a") as sp:
+            header = tracer.current_traceparent()
+            ctx = parse_traceparent(header)
+            assert ctx.trace_id == sp.trace_id
+            assert ctx.span_id == sp.span_id
+            assert header == f"00-{sp.trace_id}-{sp.span_id}-01"
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-abc-def-01",
+        "00-" + "0" * 32 + "-" + "1" * 16 + "-01",   # all-zero trace id
+        "00-" + "1" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+        "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",   # forbidden version
+        "00-" + "x" * 32 + "-" + "2" * 16 + "-01",   # non-hex
+        "00-" + "1" * 31 + "-" + "2" * 16 + "-01",   # short trace id
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_remote_parent_adopted(self, tracer):
+        remote = parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+        with tracer.span("server_side", parent=remote) as sp:
+            assert sp.trace_id == "ab" * 16
+            assert sp.parent_id == "cd" * 8
+
+
+# ------------------------------------------------------- cross-thread handoff
+class TestThreadHandoff:
+    def test_explicit_handoff_parents_correctly(self, tracer):
+        handed = {}
+
+        def worker(ctx):
+            # a fresh thread has NO inherited context...
+            assert tracer.current_context() is None
+            # ...until the handed-off parent is used explicitly
+            with tracer.span("worker_task", parent=ctx) as sp:
+                handed["span"] = sp
+
+        with tracer.span("producer") as prod:
+            t = threading.Thread(target=worker, args=(prod.context,))
+            t.start()
+            t.join()
+        assert handed["span"].trace_id == prod.trace_id
+        assert handed["span"].parent_id == prod.span_id
+
+    def test_threads_do_not_leak_context(self, tracer):
+        seen = []
+
+        def worker():
+            seen.append(tracer.current_context())
+            with tracer.span("rooted") as sp:
+                seen.append(sp.parent_id)
+
+        with tracer.span("main_span"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen == [None, None]  # new root, not a stolen parent
+
+
+# ------------------------------------------------------------------ exporter
+class TestChromeExporter:
+    def _sample_spans(self, tracer):
+        with tracer.span("root", attrs={"answer": 42, "obj": object()}):
+            with tracer.span("child"):
+                pass
+        # a linked pair (the request → batch shape)
+        with tracer.span("request") as req:
+            pass
+        sp = tracer.start_span("batch", category="serve")
+        sp.add_link(req.context)
+        tracer.end_span(sp)
+        return tracer.recorder.spans()
+
+    def test_schema_valid(self, tracer, tmp_path):
+        spans = self._sample_spans(tracer)
+        path = tmp_path / "t.json"
+        obj = write_chrome_trace(path, spans)
+        assert validate_file(str(path)) == []
+        assert json.load(open(path)) == obj
+
+    def test_event_contents(self, tracer):
+        spans = self._sample_spans(tracer)
+        obj = to_chrome_trace(spans)
+        events = obj["traceEvents"]
+        assert validate_events(obj) == []
+        x = [e for e in events if e["ph"] == "X"]
+        names = {e["name"] for e in x}
+        assert {"root", "child", "request", "batch"} <= names
+        child = next(e for e in x if e["name"] == "child")
+        root = next(e for e in x if e["name"] == "root")
+        assert child["args"]["parent_id"] == root["args"]["span_id"]
+        # non-serializable attr values are stringified, never dropped
+        assert isinstance(root["args"]["obj"], str)
+        assert root["args"]["answer"] == 42
+        # the link became one flow start + one flow finish with the same id
+        flows = [e for e in events if e["ph"] in ("s", "f")]
+        assert len(flows) == 2
+        assert flows[0]["id"] == flows[1]["id"]
+        # metadata names the process and each thread
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in events)
+        assert any(e["ph"] == "M" and e["name"] == "thread_name"
+                   for e in events)
+
+    def test_timestamps_normalized_microseconds(self, tracer):
+        with tracer.span("a"):
+            time.sleep(0.01)
+        obj = to_chrome_trace(tracer.recorder.spans())
+        (x,) = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        assert x["ts"] == 0.0
+        assert 5_000 < x["dur"] < 1_000_000  # ~10ms in us
+
+    def test_empty_trace_still_valid(self):
+        obj = to_chrome_trace([])
+        assert validate_events(obj) == []
+
+    def test_non_finite_attrs_stay_strict_json(self, tracer, tmp_path):
+        # a diverged run's loss=NaN must not make the trace unloadable
+        with tracer.span("diverged", attrs={"loss": float("nan"),
+                                            "lr": float("inf")}):
+            pass
+        path = tmp_path / "nan.json"
+        write_chrome_trace(path, tracer.recorder.spans())
+        text = open(path).read()
+        json.loads(text)  # and no bare NaN/Infinity tokens in the payload
+        assert "NaN" not in text.replace('"nan"', "")
+        assert validate_file(str(path)) == []
+        x = next(e for e in json.load(open(path))["traceEvents"]
+                 if e["ph"] == "X")
+        assert x["args"]["loss"] == "nan"
+        assert validate_events(  # the validator itself flags raw NaN
+            {"traceEvents": [{"ph": "X", "name": "a", "ts": 0, "dur": 1,
+                              "pid": 1, "tid": 1,
+                              "args": {"v": float("nan")}}]})
+
+    def test_text_timeline(self, tracer):
+        with tracer.span("outer", attrs={"k": "v"}):
+            with tracer.span("inner"):
+                pass
+        text = text_timeline(tracer.recorder.spans())
+        lines = text.splitlines()  # time-ordered: outer first
+        assert len(lines) == 2
+        assert "outer" in lines[0] and "inner" in lines[1]
+        assert lines[1].index("inner") > lines[0].index("outer")  # indent
+        assert "k=v" in lines[0]
+
+    def test_validator_flags_garbage(self):
+        assert validate_events({"nope": []})
+        assert validate_events({"traceEvents": [{"ph": "X", "name": "a"}]})
+        assert validate_events(
+            {"traceEvents": [{"ph": "Q", "pid": 1, "tid": 1, "ts": 0}]})
+        assert validate_events(
+            {"traceEvents": [{"ph": "f", "name": "l", "pid": 1, "tid": 1,
+                              "ts": 0, "id": 7}]})  # flow end w/o start
+
+
+# ------------------------------------------------------------ compile hook
+class TestJaxCompileHook:
+    def test_compile_becomes_span_and_metric(self):
+        import jax
+        import jax.numpy as jnp
+        metrics = MetricsRegistry()
+        tr = enable_tracing(Tracer(metrics=metrics))
+        try:
+            with tr.span("step"):
+                # a distinctive shape/closure → guaranteed fresh compile
+                jax.jit(lambda v: v * 1.7183 + 0.5772)(
+                    jnp.ones((3, 5, 7))).block_until_ready()
+            spans = _by_name(tr)
+            assert tr.compile_count >= 1
+            assert "xla_compile" in spans
+            # nested under the span that triggered it (same thread context)
+            step = spans["step"][0]
+            assert any(s.trace_id == step.trace_id
+                       for s in spans["xla_compile"])
+            assert metrics.counter("jax_compiles_total").value() >= 1
+            assert metrics.counter("jax_compile_seconds_total").value() > 0
+            # attribution is per thread: this thread paid, others did not
+            assert tr.thread_compile_count() >= 1
+            assert tr.thread_compile_count(thread_id=-1) == 0
+        finally:
+            disable_tracing()
+
+    def test_other_threads_compiles_not_attributed_here(self):
+        import jax
+        import jax.numpy as jnp
+        tr = enable_tracing(Tracer())
+        try:
+            before = tr.thread_compile_count()
+
+            def compile_elsewhere():
+                jax.jit(lambda v: v * 2.71828 - 1.0)(
+                    jnp.ones((2, 9))).block_until_ready()
+
+            t = threading.Thread(target=compile_elsewhere)
+            t.start()
+            t.join()
+            assert tr.compile_count >= 1          # globally visible...
+            assert tr.thread_compile_count() == before  # ...not charged here
+            assert tr.thread_compile_count(thread_id=t.ident) >= 1
+        finally:
+            disable_tracing()
+
+    def test_enable_tracing_attaches_metrics_to_explicit_tracer(self):
+        metrics = MetricsRegistry()
+        tr = enable_tracing(Tracer(TraceRecorder(128)), metrics=metrics,
+                            jax_hook=False)
+        try:
+            assert tr.metrics is metrics
+        finally:
+            disable_tracing()
+
+
+# ------------------------------------------------------------- TraceListener
+def _tiny_net(seed=1, n_in=9):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=n_in, n_out=8, activation="relu"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _tiny_data(n=32, n_in=9):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x.sum(axis=1) > 0).astype(int)]
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    return DataSet(x, y)
+
+
+class TestTraceListener:
+    def test_metrics_round_trip_through_exposition(self, tracer):
+        metrics = MetricsRegistry()
+        net = _tiny_net()
+        net.add_listeners(TraceListener(tracer, metrics, model_name="t"))
+        net.fit(_tiny_data(), epochs=3)
+        series = parse_prometheus_text(metrics.exposition())
+        key = (("model", "t"),)
+        assert series["training_steps_total"][key] == 3.0
+        assert series["training_examples_total"][key] == 96.0
+        assert series["training_epochs_total"][key] == 3.0
+        assert series["training_step_seconds_count"][key] >= 1.0
+        assert series["training_step_seconds_sum"][key] > 0.0
+        assert ("training_score" in series)
+        buckets = {k: v for k, v in
+                   series["training_step_seconds_bucket"].items()}
+        inf_key = (("le", "+Inf"), ("model", "t"))
+        assert buckets[inf_key] == series["training_step_seconds_count"][key]
+
+    def test_records_iteration_spans(self, tracer):
+        net = _tiny_net(seed=2)
+        net.add_listeners(TraceListener(tracer, MetricsRegistry()))
+        net.fit(_tiny_data(), epochs=2)
+        spans = _by_name(tracer)["train_iteration"]
+        assert len(spans) >= 1  # first window of each epoch anchors only
+        assert all(s.end_ns is not None for s in spans)
+        assert all(s.attrs["batch"] == 32 for s in spans)
+
+    def test_survives_throwing_peer_listener(self, tracer):
+        class Bomb:
+            def iteration_done(self, model, iteration, epoch):
+                raise RuntimeError("peer exploded")
+
+        metrics = MetricsRegistry()
+        net = _tiny_net(seed=3)
+        tl = TraceListener(tracer, metrics, model_name="t")
+        net.add_listeners(tl, Bomb())
+        with pytest.raises(RuntimeError, match="peer exploded"):
+            net.fit(_tiny_data(), epochs=1)
+        # the listener owns no open span state: nothing dangles, metrics
+        # are consistent, and the next fit keeps working
+        assert all(s.end_ns is not None for s in tracer.recorder.spans())
+        assert metrics.counter("training_steps_total",
+                               label_names=("model",)).value(model="t") == 1
+        net.listeners = [tl]
+        net.fit(_tiny_data(), epochs=1)
+        assert metrics.counter("training_steps_total",
+                               label_names=("model",)).value(model="t") == 2
+
+    def test_step0_compile_counts_as_training(self):
+        # the baseline anchors at on_epoch_start, BEFORE the first step,
+        # so the first iteration's compile lands in training_compile_total
+        metrics = MetricsRegistry()
+        tr = enable_tracing(Tracer())
+        try:
+            net = _tiny_net(seed=11, n_in=13)  # distinct shape → compiles
+            net.add_listeners(TraceListener(tr, metrics, model_name="c0"))
+            net.fit(_tiny_data(n_in=13), epochs=1)
+            assert metrics.counter(
+                "training_compile_total",
+                label_names=("model",)).value(model="c0") >= 1
+        finally:
+            disable_tracing()
+
+    def test_without_tracer_still_exports_metrics(self):
+        assert get_active_tracer() is None
+        metrics = MetricsRegistry()
+        net = _tiny_net(seed=4)
+        net.add_listeners(TraceListener(None, metrics, model_name="m"))
+        net.fit(_tiny_data(), epochs=1)
+        assert metrics.counter("training_steps_total",
+                               label_names=("model",)).value(model="m") == 1
+
+
+# --------------------------------------------------- ParallelWrapper tracing
+class TestParallelWrapperTracing:
+    def test_step_spans_and_transfer_bytes(self, tracer):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        metrics = MetricsRegistry()
+        net = _tiny_net(seed=5)
+        pw = ParallelWrapper(net, metrics=metrics, metrics_name="pw")
+        ds = _tiny_data(n=32)
+        pw.fit([ds], epochs=2)
+        spans = _by_name(tracer)
+        assert len(spans["parallel_fit"]) == 1
+        steps = spans["train_step"]
+        assert len(steps) == 2
+        fit_span = spans["parallel_fit"][0]
+        assert all(s.parent_id == fit_span.span_id for s in steps)
+        assert all("loss" in s.attrs for s in steps)
+        expected = 2 * (ds.features.nbytes + ds.labels.nbytes)
+        assert metrics.counter(
+            "training_transfer_bytes_total",
+            label_names=("model",)).value(model="pw") == expected
+
+    def test_untraced_fit_unchanged(self):
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        assert get_active_tracer() is None
+        net = _tiny_net(seed=6)
+        ParallelWrapper(net).fit([_tiny_data()], epochs=1)
+        assert net.iteration == 1
+
+
+# ------------------------------------------------ dispatcher (handoff) spans
+class TestInferenceTracing:
+    class Model:
+        def output(self, x):
+            return np.asarray(x) * 3.0
+
+    def test_queue_wait_and_batch_execute_linked(self, tracer):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        pi = ParallelInference(self.Model(), mode="batched", wait_ms=1.0)
+        try:
+            with tracer.span("caller") as caller:
+                out = pi.output(np.ones((4, 3)))
+            assert out.shape == (4, 3)
+        finally:
+            pi.shutdown()
+        spans = _by_name(tracer)
+        (req,) = spans["inference_request"]
+        (qw,) = spans["queue_wait"]
+        (be,) = spans["batch_execute"]
+        # request nests under the caller; queue_wait was recorded on the
+        # DISPATCHER thread yet parents to the request span (the handoff)
+        assert req.parent_id == spans["caller"][0].span_id
+        assert qw.parent_id == req.span_id
+        assert qw.trace_id == caller.trace_id
+        assert qw.thread_id != req.thread_id
+        assert qw.start_ns <= be.start_ns
+        # batch links back to the request it served
+        assert [l.span_id for l in be.links] == [req.span_id]
+        assert be.attrs["requests"] == 1 and be.attrs["rows"] == 4
+
+    def test_inplace_mode_span(self, tracer):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+        pi = ParallelInference(self.Model(), mode="inplace")
+        pi.output(np.ones((2, 3)))
+        (sp,) = _by_name(tracer)["inference_request"]
+        assert sp.attrs["mode"] == "inplace"
+
+    def test_model_error_marks_batch_span(self, tracer):
+        from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+        class Broken:
+            def output(self, x):
+                raise RuntimeError("device on fire")
+
+        pi = ParallelInference(Broken(), mode="batched", wait_ms=1.0)
+        try:
+            with pytest.raises(RuntimeError, match="device on fire"):
+                pi.output(np.ones((2, 2)))
+        finally:
+            pi.shutdown()
+        (be,) = _by_name(tracer)["batch_execute"]
+        assert "device on fire" in be.error
+
+
+# ------------------------------------------------------- serving traceparent
+class TestServingTraceparent:
+    @pytest.fixture
+    def served(self):
+        from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                                ModelServingClient)
+        metrics = MetricsRegistry()
+        registry = ModelRegistry(metrics=metrics, wait_ms=1.0)
+        registry.register("m", model=TestInferenceTracing.Model())
+        server = ModelServer(registry, metrics=metrics)
+        server.start()
+        client = ModelServingClient(server.url)
+        yield server, client, metrics
+        server.stop(drain=False, shutdown_registry=True)
+
+    def test_client_sends_server_joins_and_echoes(self, served, tracer):
+        server, client, _ = served
+        with tracer.span("user") as user:
+            out = client.predict("m", np.ones((3, 2)))
+        assert out.shape == (3, 2)
+        spans = _await_span(tracer, "http_request")
+        (cp,) = spans["client_predict"]
+        (hr,) = spans["http_request"]
+        # ONE trace across the wire: client span parents the server span
+        assert hr.trace_id == user.trace_id
+        assert hr.parent_id == cp.span_id
+        assert hr.attrs["status"] == 200
+        # dispatcher spans joined the same trace through the request ctx
+        assert spans["queue_wait"][0].trace_id == user.trace_id
+        assert spans["batch_execute"][0].links
+        # the echo carried the trace id back
+        assert client.last_trace_id == user.trace_id
+        assert cp.attrs["server_trace_id"] == user.trace_id
+
+    def test_server_echoes_trace_id_even_untraced(self, served):
+        server, client, _ = served
+        disable_tracing()
+        import urllib.request
+        tid = "ab" * 16
+        req = urllib.request.Request(
+            server.url + "/v1/models/m/predict",
+            data=json.dumps({"inputs": [[1.0, 2.0]]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": f"00-{tid}-{'cd' * 8}-01"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["X-Trace-Id"] == tid
+
+    def test_no_stale_trace_headers_on_keepalive(self, served):
+        # one handler instance serves MANY requests on an HTTP/1.1
+        # connection: correlation headers must not leak between them
+        server, _, _ = served
+        import http.client
+        body = json.dumps({"inputs": [[1.0, 2.0]]})
+        tid = "ab" * 16
+        conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                          timeout=10)
+        try:
+            conn.request("POST", "/v1/models/m/predict", body=body,
+                         headers={"Content-Type": "application/json",
+                                  "traceparent": f"00-{tid}-{'cd' * 8}-01"})
+            r1 = conn.getresponse()
+            r1.read()
+            assert r1.getheader("X-Trace-Id") == tid
+            conn.request("POST", "/v1/models/m/predict", body=body,
+                         headers={"Content-Type": "application/json"})
+            r2 = conn.getresponse()
+            r2.read()
+            assert r2.getheader("X-Trace-Id") is None
+            conn.request("GET", "/v1/models")
+            r3 = conn.getresponse()
+            r3.read()
+            assert r3.getheader("X-Trace-Id") is None
+        finally:
+            conn.close()
+
+    def test_malformed_traceparent_is_harmless(self, served, tracer):
+        server, client, _ = served
+        import urllib.request
+        req = urllib.request.Request(
+            server.url + "/v1/models/m/predict",
+            data=json.dumps({"inputs": [[1.0, 2.0]]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "traceparent": "utter-garbage"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200  # bad header never fails the request
+        (hr,) = _await_span(tracer, "http_request")["http_request"]
+        assert hr.parent_id is None  # fresh root, not a bogus parent
+
+    def test_metrics_endpoint_serves_training_series(self, served, tracer):
+        server, client, metrics = served
+        net = _tiny_net(seed=7)
+        net.add_listeners(TraceListener(tracer, metrics, model_name="co"))
+        net.fit(_tiny_data(), epochs=1)
+        series = client.metrics()  # scrape + parse round trip
+        assert series["training_steps_total"][(("model", "co"),)] == 1.0
+        assert "training_step_seconds_bucket" in series
+        assert "inference_dispatcher_up" in series  # serve + train, 1 scrape
+
+
+# --------------------------------------------------------- deprecation shim
+class TestServingMetricsShim:
+    def test_reexport_warns_and_aliases(self):
+        for mod in list(sys.modules):
+            if mod == "deeplearning4j_tpu.serving.metrics":
+                del sys.modules[mod]
+        with pytest.warns(DeprecationWarning, match="observe.metrics"):
+            import deeplearning4j_tpu.serving.metrics as shim
+        import deeplearning4j_tpu.observe.metrics as real
+        assert shim.MetricsRegistry is real.MetricsRegistry
+        assert shim.default_registry() is real.default_registry()
+        assert shim.parse_prometheus_text is real.parse_prometheus_text
+        assert shim.instrument_http is real.instrument_http
+        assert shim.HTTPObserverMixin is real.HTTPObserverMixin
+
+    def test_serving_package_surface_unchanged(self):
+        from deeplearning4j_tpu.serving import (Counter, Gauge, Histogram,
+                                                MetricsRegistry,
+                                                default_registry,
+                                                parse_prometheus_text)
+        assert MetricsRegistry is not None
+        assert callable(default_registry) and callable(parse_prometheus_text)
+        assert Counter and Gauge and Histogram
+
+
+# --------------------------------------------------------- stats listener fix
+class TestStatsListenerDeviceMemory:
+    def test_aggregates_device_stats_when_exposed(self, monkeypatch):
+        from deeplearning4j_tpu.ui.stats import StatsListener
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+
+        class FakeDev:
+            def __init__(self, i):
+                self.i = i
+
+            def __str__(self):
+                return f"FakeTPU({self.i})"
+
+            def memory_stats(self):
+                return {"bytes_in_use": 100 * (self.i + 1),
+                        "bytes_limit": 1000, "peak_bytes_in_use": 500}
+
+        import jax
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: [FakeDev(0), FakeDev(1)])
+        info = StatsListener(InMemoryStatsStorage())._memory_info()
+        assert info["device_bytes_in_use"] == 300
+        assert info["device_bytes_limit"] == 2000
+        assert info["device_count"] == 2
+        assert info["devices"][1]["peak_bytes_in_use"] == 500
+
+    def test_cpu_only_backend_stays_host_only(self):
+        from deeplearning4j_tpu.ui.stats import StatsListener
+        from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+        info = StatsListener(InMemoryStatsStorage())._memory_info()
+        # CPU devices expose no memory_stats: no device keys, no crash
+        assert "max_rss_kb" in info
+        assert "device_count" not in info or info["device_count"] > 0

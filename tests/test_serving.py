@@ -551,6 +551,13 @@ class TestSharedMetricsCore:
                     timeout=5) as r:
                 assert r.status == 200
             reqs = metrics.get("http_requests_total")
+            # the mixin records AFTER the response bytes are written; the
+            # client can observe the body first — poll briefly
+            for _ in range(200):
+                if reqs.value(server="ui", path="/train/sessions",
+                              status="200") == 1:
+                    break
+                time.sleep(0.005)
             assert reqs.value(server="ui", path="/train/sessions",
                               status="200") == 1
         finally:

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Chrome trace-event schema validator.
+
+Checks a trace JSON (the ``observe.export`` output, or any Trace Event
+Format file) against the rules ``chrome://tracing`` / Perfetto actually
+enforce, so a trace that passes here loads there:
+
+- top level: an object with a ``traceEvents`` list (the "JSON Object
+  Format"), or a bare event list (the "JSON Array Format");
+- every event: a dict with a string ``ph`` from the known phase set and
+  integer-like ``pid``/``tid``;
+- timed phases (everything except metadata ``M``): a finite, non-negative
+  numeric ``ts`` in microseconds;
+- complete events (``X``): a finite, non-negative ``dur``;
+- duration events: ``B``/``E`` balanced per (pid, tid), never negative
+  nesting;
+- flow events (``s``/``t``/``f``): an ``id``; every flow has a start;
+- ``args``, when present, a JSON object.
+
+Used three ways: ``python tools/validate_trace.py trace.json [...]`` by
+humans/CI, ``validate_file``/``validate_events`` by the tests, and by
+``examples/25_tracing_and_profiling.py`` on its own output.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict, List
+
+# the trace-event format's phase table
+KNOWN_PHASES = {"B", "E", "X", "I", "i", "C", "b", "n", "e", "s", "t", "f",
+                "P", "N", "O", "D", "M", "S", "T", "p", "F", "v", "V", "R",
+                "c", "a"}
+
+
+def _is_int_like(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _is_finite_number(v: Any) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v))
+
+
+def validate_events(obj: Any) -> List[str]:
+    """Return a list of schema violations (empty = valid)."""
+    errors: List[str] = []
+    if isinstance(obj, dict):
+        events = obj.get("traceEvents")
+        if not isinstance(events, list):
+            return ["top-level object has no 'traceEvents' list"]
+    elif isinstance(obj, list):
+        events = obj
+    else:
+        return [f"top level must be an object or list, got {type(obj).__name__}"]
+
+    open_durations: Dict[tuple, int] = {}
+    flow_starts = set()
+    flow_ends = []
+    for i, ev in enumerate(events):
+        where = f"event[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        ph = ev.get("ph")
+        if not isinstance(ph, str) or ph not in KNOWN_PHASES:
+            errors.append(f"{where}: bad phase {ph!r}")
+            continue
+        where = f"event[{i}] ({ph} {ev.get('name', '?')!r})"
+        for key in ("pid", "tid"):
+            if not _is_int_like(ev.get(key)):
+                errors.append(f"{where}: missing/non-integer {key!r}")
+        if ph != "M":
+            ts = ev.get("ts")
+            if not _is_finite_number(ts) or ts < 0:
+                errors.append(f"{where}: bad ts {ts!r}")
+        if ph in ("X", "B", "E", "I", "i", "M", "C", "s", "t", "f") \
+                and not isinstance(ev.get("name"), str):
+            errors.append(f"{where}: missing name")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not _is_finite_number(dur) or dur < 0:
+                errors.append(f"{where}: bad dur {dur!r}")
+        if ph in ("B", "E"):
+            key = (ev.get("pid"), ev.get("tid"))
+            depth = open_durations.get(key, 0) + (1 if ph == "B" else -1)
+            if depth < 0:
+                errors.append(f"{where}: E without matching B on {key}")
+                depth = 0
+            open_durations[key] = depth
+        if ph in ("s", "t", "f"):
+            if "id" not in ev:
+                errors.append(f"{where}: flow event without id")
+            elif ph == "s":
+                flow_starts.add(ev["id"])
+            else:
+                flow_ends.append((where, ev["id"]))
+        if "args" in ev:
+            if not isinstance(ev["args"], dict):
+                errors.append(f"{where}: args is not an object")
+            else:
+                for k, v in ev["args"].items():
+                    # Python's json tolerates NaN/Infinity; strict JSON
+                    # (and chrome://tracing) does not
+                    if isinstance(v, float) and not math.isfinite(v):
+                        errors.append(
+                            f"{where}: non-finite args[{k!r}] "
+                            f"(not strict JSON)")
+    for key, depth in open_durations.items():
+        if depth:
+            errors.append(f"{depth} unclosed B event(s) on pid/tid {key}")
+    for where, fid in flow_ends:
+        if fid not in flow_starts:
+            errors.append(f"{where}: flow end id {fid!r} has no start")
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            obj = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable trace: {e}"]
+    return validate_events(obj)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print("usage: validate_trace.py TRACE.json [TRACE.json ...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            with open(path, "r", encoding="utf-8") as fh:
+                obj = json.load(fh)
+            n = len(obj["traceEvents"] if isinstance(obj, dict) else obj)
+            print(f"OK   {path}: {n} events")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
