@@ -43,6 +43,18 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="record the run with the observe tracer and write "
                          "a Chrome trace (chrome://tracing / Perfetto) here")
+    ap.add_argument("--log-json", default=None, metavar="OUT.jsonl",
+                    dest="log_json",
+                    help="structured JSON-lines logging with trace "
+                         "correlation to this file ('-' for stderr)")
+    ap.add_argument("--watchdog", choices=("off", "log", "raise"),
+                    default="off",
+                    help="training health watchdog (NaN loss/params, "
+                         "gradient explosion, divergence, stalls) with "
+                         "this action policy")
+    ap.add_argument("--alerts", default=None, metavar="RULES.json",
+                    help="evaluate these alert rules against the metrics "
+                         "registry in the background during training")
     args = ap.parse_args(argv)
 
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
@@ -63,11 +75,34 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
         net.listeners.append(
             StatsListener(RemoteUIStatsStorageRouter(args.uiUrl)))
     tracer = None
+    if args.log_json:
+        from deeplearning4j_tpu.observe import enable_structured_logging
+        if args.log_json == "-":
+            enable_structured_logging(stream=sys.stderr)
+        else:
+            enable_structured_logging(path=args.log_json)
     if args.trace:
-        from deeplearning4j_tpu.observe import (TraceListener, default_registry,
-                                                enable_tracing)
+        from deeplearning4j_tpu.observe import default_registry, enable_tracing
         tracer = enable_tracing(metrics=default_registry())
-        net.listeners.append(TraceListener(tracer))
+    if args.trace or args.watchdog != "off" or args.alerts:
+        # one attachment path for TraceListener AND the watchdog. With
+        # --alerts the TraceListener is attached even without --trace:
+        # it is what exports the training_* series into the registry the
+        # rules evaluate (spans stay off while tracing is not enabled)
+        from deeplearning4j_tpu.observe import (attach_observability,
+                                                default_registry)
+        attach_observability(
+            net, tracer=tracer, metrics=default_registry(),
+            trace=bool(args.trace) or bool(args.alerts),
+            watchdog=(None if args.watchdog == "off"
+                      else {"action": args.watchdog}))
+    alert_mgr = None
+    if args.alerts:
+        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
+                                                default_registry, load_rules)
+        alert_mgr = AlertManager(default_registry(),
+                                 load_rules(args.alerts), [LogSink()],
+                                 interval_s=5.0).start()
     mesh = None
     if args.workers:
         mesh = make_mesh({"data": args.workers})
@@ -77,12 +112,20 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     try:
         pw.fit(it, epochs=args.epochs)
     finally:
+        if alert_mgr is not None:
+            alert_mgr.evaluate_once()  # final round so late series count
+            alert_mgr.stop()
+            firing = alert_mgr.firing()
+            print(f"alerts firing at exit: {firing if firing else 'none'}")
         if tracer is not None:
             from deeplearning4j_tpu.observe import disable_tracing
             n = tracer.flush(args.trace)
             print(f"wrote Chrome trace ({n} spans) to {args.trace}")
             print(tracer.timeline(limit=40))
             disable_tracing()
+        if args.log_json:
+            from deeplearning4j_tpu.observe import disable_structured_logging
+            disable_structured_logging()
     model_serializer.write_model(net, args.modelOutputPath)
     return net
 
@@ -273,6 +316,15 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="trace requests (spans across HTTP, dispatcher and "
                         "device) and write a Chrome trace here on shutdown")
+    p.add_argument("--log-json", default=None, metavar="OUT.jsonl",
+                   dest="log_json",
+                   help="structured JSON-lines logging with trace "
+                        "correlation to this file ('-' for stderr)")
+    p.add_argument("--alerts", default=None, metavar="RULES.json",
+                   help="alert rules evaluated against /metrics in the "
+                        "background; state served at /alerts")
+    p.add_argument("--alert-interval", type=float, default=15.0,
+                   help="seconds between alert evaluation rounds")
     args = p.parse_args(argv)
 
     import os
@@ -284,6 +336,21 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     if args.trace:
         from deeplearning4j_tpu.observe import enable_tracing
         tracer = enable_tracing(metrics=default_registry())
+    if args.log_json:
+        from deeplearning4j_tpu.observe import enable_structured_logging
+        if args.log_json == "-":
+            enable_structured_logging(stream=sys.stderr)
+        else:
+            enable_structured_logging(path=args.log_json)
+    alert_mgr = None
+    if args.alerts:
+        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
+                                                load_rules)
+        alert_mgr = AlertManager(default_registry(),
+                                 load_rules(args.alerts), [LogSink()],
+                                 interval_s=args.alert_interval).start()
+        print(f"alerting on {len(alert_mgr.rules)} rule(s) from "
+              f"{args.alerts} (state at /alerts)")
 
     registry = ModelRegistry(metrics=default_registry(),
                              max_batch_size=args.max_batch_size,
@@ -298,7 +365,8 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
         registry, host=args.host, port=args.port, metrics=default_registry(),
         max_inflight=args.max_inflight,
         default_deadline_s=(args.deadline_ms / 1e3
-                            if args.deadline_ms is not None else None))
+                            if args.deadline_ms is not None else None),
+        alerts=alert_mgr)
     port = server.start()
     print(f"model server listening on {server.url} "
           f"(models: {', '.join(registry.names())}); port {port}")

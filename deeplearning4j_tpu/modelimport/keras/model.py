@@ -181,9 +181,17 @@ class KerasSequentialModel:
                 spec = explicit_pre[len(layers)]
                 tail = spec.rsplit("|", 1)[-1]
                 if not tail.startswith("reshape:"):
-                    # spec already ends in a flatten (Flatten→Flatten):
-                    # the input is flat, a second flatten is a no-op
-                    continue
+                    # only tails KNOWN to produce flat per-example output
+                    # make the Flatten a no-op (cnn_to_ff/rnn_to_ff collapse
+                    # to [*, C]); a rank-raising or unknown tail silently
+                    # dropping the Flatten would corrupt the topology
+                    if tail in ("cnn_to_ff", "rnn_to_ff"):
+                        continue
+                    raise UnsupportedKerasConfigurationException(
+                        f"Flatten after explicit preprocessor {spec!r}: "
+                        f"tail {tail!r} is not known to produce flat "
+                        "output, so the Flatten cannot be composed or "
+                        "skipped safely")
                 dims = [int(d) for d in
                         tail[len("reshape:"):].split(",")]
                 if len(dims) > 1:

@@ -270,6 +270,11 @@ def _read_multi(f: BinaryIO, ntype: str):
     n_outputs = _read_i32(f)
     if n_inputs < 0 or n_inputs > 10_000:
         raise ValueError(f"implausible normalizer input count {n_inputs}")
+    # n_outputs is a -1 sentinel when fitLabel is false, so the bound is
+    # conditional; a corrupt fitLabel stream must fail fast here rather
+    # than loop reading label children until a truncation error
+    if fit_label and (n_outputs < 0 or n_outputs > 10_000):
+        raise ValueError(f"implausible normalizer output count {n_outputs}")
     kwargs = {}
     if not standardize:
         kwargs = {"min_range": _read_f64(f), "max_range": _read_f64(f)}
@@ -287,8 +292,6 @@ def _read_multi(f: BinaryIO, ntype: str):
 
     m.children = [read_child() for _ in range(n_inputs)]
     if fit_label:
-        if n_outputs < 0:
-            raise ValueError("fitLabel normalizer with negative output count")
         m.fit_label = True
         m.label_children = [read_child() for _ in range(n_outputs)]
     return m

@@ -243,6 +243,15 @@ QKV_LAYOUT = "head_major"
 
 _FUSED_PARTS = {"Wqkv": 3, "bqkv": 3, "Wkv": 2, "bkv": 2}
 
+#: updater-state slots that are elementwise per-parameter accumulators and
+#: therefore share the param's fused-column indexing (every slot the
+#: nn/updaters.py registry defines: momentum/velocity and the various
+#: squared-gradient accumulators). Only these repack with the param — a
+#: future same-shaped slot that is NOT column-indexed must be added here
+#: explicitly, never permuted by a shape match.
+_COLUMN_INDEXED_SLOTS = frozenset(
+    {"v", "m", "u", "h", "v_hat", "eg2", "edx2", "g2"})
+
 
 def repack_legacy_fused_qkv(model) -> int:
     """Migrate a model whose attention params were saved in the pre-round-5
@@ -288,7 +297,9 @@ def repack_legacy_fused_qkv(model) -> int:
             upd = model.updater_states[key].get(pn, {}) \
                 if model.updater_states is not None else {}
             for slot, arr in upd.items():
-                if np.asarray(arr).shape == np.asarray(pd[pn]).shape:
+                if (slot in _COLUMN_INDEXED_SLOTS
+                        and np.asarray(arr).shape
+                        == np.asarray(pd[pn]).shape):
                     upd[slot] = repack(arr, parts, h, dh)
                     n_repacked += 1
     return n_repacked

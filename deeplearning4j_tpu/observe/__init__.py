@@ -18,7 +18,18 @@ Dapper-style request tracing the reference never had):
   ``serving.metrics``; that path remains as a deprecation re-export);
 - ``listener`` — ``TraceListener``: the TrainingListener bridge that makes
   any ``fit()`` record spans and export ``training_*`` series through the
-  same ``/metrics`` the serving tier already exposes.
+  same ``/metrics`` the serving tier already exposes;
+- ``log``      — structured JSON-lines logging with automatic
+  ``trace_id``/``span_id`` correlation from the active span, a bounded
+  ring with drop accounting, a stdlib-``logging`` bridge and rate-limit
+  gates (``enable_structured_logging()`` flips it on process-wide);
+- ``health``   — ``TrainingWatchdog`` (NaN/Inf loss+params, gradient-norm
+  EWMA, loss divergence, step stalls — with log/raise/callback action
+  policies) and the serving ``HealthReport`` probes behind ``/livez``;
+- ``alerts``   — threshold/absence/rate-of-change/multiwindow burn-rate
+  rules evaluated over any registry's Prometheus exposition, with a
+  deduping firing/resolved state machine, pluggable sinks and the
+  ``AlertManager`` background evaluator (injectable clock).
 """
 
 from deeplearning4j_tpu.observe.metrics import (  # noqa: F401
@@ -50,3 +61,37 @@ from deeplearning4j_tpu.observe.export import (  # noqa: F401
 )
 from deeplearning4j_tpu.observe.listener import TraceListener  # noqa: F401
 from deeplearning4j_tpu.observe.jaxhook import install_jax_hook  # noqa: F401
+from deeplearning4j_tpu.observe.log import (  # noqa: F401
+    LogHub,
+    LogRecord,
+    LogRing,
+    StructuredLogger,
+    at_most_every,
+    disable_structured_logging,
+    enable_structured_logging,
+    every_n,
+    get_active_hub,
+    get_logger,
+)
+from deeplearning4j_tpu.observe.health import (  # noqa: F401
+    HealthCheck,
+    HealthEvent,
+    HealthReport,
+    ServingHealth,
+    TrainingWatchdog,
+    WatchdogAlarm,
+    attach_observability,
+)
+from deeplearning4j_tpu.observe.alerts import (  # noqa: F401
+    AbsenceRule,
+    AlertManager,
+    BurnRateRule,
+    CallbackSink,
+    LogSink,
+    Notification,
+    RateOfChangeRule,
+    SLOSpec,
+    ThresholdRule,
+    WebhookSink,
+    load_rules,
+)

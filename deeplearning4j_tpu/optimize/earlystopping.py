@@ -452,7 +452,19 @@ class EarlyStoppingTrainer:
         last_eval = float("nan")
         reason, details = "EpochTerminationCondition", "max epochs"
         while True:
-            self._fit_epoch()
+            try:
+                self._fit_epoch()
+            except Exception as e:
+                # a raise-policy TrainingWatchdog (observe/health.py) firing
+                # mid-fit ends the run as an Error termination with the best
+                # model so far — the reference's BaseEarlyStoppingTrainer
+                # "Error" reason, wired to real divergence detection
+                from deeplearning4j_tpu.observe.health import WatchdogAlarm
+                if not isinstance(e, WatchdogAlarm):
+                    raise
+                reason, details = "Error", str(e)
+                epoch += 1
+                break
             last = self.model.score_
             stop_iter = next((c for c in cfg.iteration_conditions if c.terminate(last)), None)
             if stop_iter is not None:

@@ -153,6 +153,30 @@ class NTPTimeSource(TimeSource):
         return int(now * 1000 + self._offset_ms)
 
 
+class ManualTimeSource(TimeSource):
+    """A clock that only moves when told to — the injectable time source
+    the alert engine's state machine and the watchdog tests run on, so
+    every window/transition is exercised deterministically (no sleeps)."""
+
+    def __init__(self, start_ms: int = 0):
+        self._ms = float(start_ms)
+        self._lock = threading.Lock()
+
+    def current_time_millis(self) -> int:
+        with self._lock:
+            return int(self._ms)
+
+    def advance(self, seconds: float = 0.0, millis: float = 0.0) -> int:
+        """Move the clock forward; returns the new time in millis."""
+        with self._lock:
+            self._ms += seconds * 1000.0 + millis
+            return int(self._ms)
+
+    def set_millis(self, ms: float) -> None:
+        with self._lock:
+            self._ms = float(ms)
+
+
 _DEFAULT: TimeSource = SystemClockTimeSource()
 
 

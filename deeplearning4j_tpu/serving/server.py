@@ -16,6 +16,12 @@ endpoint                                        behavior
 ``GET /healthz``                                process liveness (always 200)
 ``GET /readyz``                                 readiness — 503 while draining, mid
                                                 hot-swap, empty, or dispatcher-dead
+``GET /livez``                                  condensed ``HealthReport`` status
+                                                (``?verbose=1`` → full check list);
+                                                503 only when a critical probe
+                                                fails (dead dispatcher)
+``GET /alerts``                                 the attached ``AlertManager``'s
+                                                rule states + firing set
 ``GET /metrics``                                Prometheus text exposition
 ==============================================  ==================================
 
@@ -43,7 +49,7 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
@@ -68,7 +74,8 @@ class ModelServer:
                  host: str = "127.0.0.1", port: int = 0,
                  metrics: Optional[MetricsRegistry] = None,
                  max_inflight: int = 64, retry_after_s: float = 0.05,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 alerts=None):
         self.registry = registry
         self.host = host
         self.port = port
@@ -76,6 +83,10 @@ class ModelServer:
         self.default_deadline_s = default_deadline_s
         self.admission = AdmissionController(
             max_inflight, retry_after_s=retry_after_s, metrics=self.metrics)
+        from deeplearning4j_tpu.observe.health import ServingHealth
+        self.health = ServingHealth(registry=registry,
+                                    admission=self.admission)
+        self.alerts = alerts  # an observe.alerts.AlertManager, or None
         self._m_requests = self.metrics.counter(
             "serving_requests_total",
             "Predict requests by model and HTTP status", ("model", "status"))
@@ -122,9 +133,27 @@ class ModelServer:
                 # the handler instance persists across keep-alive requests:
                 # correlation headers must never leak onto the next response
                 self._trace_headers = ()
-                path = urlparse(self.path).path
+                parsed = urlparse(self.path)
+                path = parsed.path
                 if path == "/healthz":
                     self._json({"status": "ok"})
+                elif path == "/livez":
+                    report = server.health.report()
+                    verbose = parse_qs(parsed.query).get("verbose",
+                                                         ["0"])[0]
+                    body = (report.to_dict()
+                            if verbose not in ("0", "", "false")
+                            else {"status": report.status})
+                    # liveness only fails on a CRITICAL probe (a dead
+                    # dispatcher never recovers in-process); degraded
+                    # states report 200 with the status in the body
+                    self._json(body, 200 if report.healthy else 503)
+                elif path == "/alerts":
+                    if server.alerts is None:
+                        self._json({"error": "no alert manager attached"},
+                                   404)
+                    else:
+                        self._json(server.alerts.describe())
                 elif path == "/readyz":
                     ready, why = server.readiness()
                     self._json({"ready": ready, "reason": why},
@@ -174,6 +203,8 @@ class ModelServer:
         if drain:
             self.admission.begin_drain()
             self.admission.wait_idle(drain_timeout_s)
+        if self.alerts is not None:
+            self.alerts.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -123,6 +123,25 @@ class PreemptionHandler:
         model.epoch = int(state.get("epoch", 0))
         return model, state
 
+    def rollback(self):
+        """Restore the last good checkpoint this handler wrote — the
+        watchdog-recovery flow: a raise-policy ``TrainingWatchdog``
+        (``observe/health.py``) aborts a diverging ``fit()`` with
+        ``WatchdogAlarm``, the caller catches it and rolls the model back
+        to the pre-divergence snapshot. Returns ``(model, state)`` like
+        :meth:`resume`.
+
+        Strict about provenance: only a checkpoint THIS handler wrote
+        qualifies — a file left at the same path by an earlier process is
+        not a known-good snapshot of the current run (restore those
+        explicitly with :meth:`resume`)."""
+        if not self.saved.is_set():
+            raise RuntimeError(
+                f"this handler has not written a checkpoint to "
+                f"{self.checkpoint_path}; rollback() only restores its own "
+                f"snapshot — use resume() for a pre-existing file")
+        return self.resume(self.checkpoint_path)
+
     # -- signal plumbing -------------------------------------------------
     def _handle(self, signum, frame):
         log.warning("Preemption signal %s: checkpointing to %s",

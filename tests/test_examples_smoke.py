@@ -32,7 +32,22 @@ pytestmark = pytest.mark.skipif(
 
 
 def test_all_examples_present():
-    assert len(EXAMPLES) >= 25, EXAMPLES
+    assert len(EXAMPLES) >= 26, EXAMPLES
+
+
+def test_shipped_alert_rules_lint_clean():
+    """The smoke tier lints the shipped ``--alerts`` rules file with the
+    real validator CLI (schema + dry-run against empty and sampled
+    registries), exactly as a user would before deploying it."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_alert_rules.py"),
+         os.path.join(EXAMPLES_DIR, "alert_rules.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
 
 
 @pytest.mark.parametrize("script", EXAMPLES)

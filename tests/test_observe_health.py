@@ -1,0 +1,866 @@
+"""The observability loop closed: structured logs, watchdogs, alerts.
+
+ISSUE-5 acceptance coverage, all deterministic (injected clocks, no
+sleeps in assertions):
+
+- a log record emitted inside an active span carries that span's
+  ``trace_id``/``span_id`` — directly AND through the stdlib-``logging``
+  bridge;
+- an injected NaN loss fires the ``TrainingWatchdog`` with the
+  configured action policy (log / raise / callback), the raise path
+  propagates out of a REAL ``fit()``, ``EarlyStoppingTrainer`` converts
+  it into an ``Error`` termination, and ``PreemptionHandler.rollback``
+  restores the pre-divergence checkpoint;
+- a synthetic error-ratio series crosses a multiwindow burn-rate rule →
+  the alert fires, notifies a sink exactly once, then resolves;
+- serving health folds dispatcher/admission/registry state into one
+  report served on ``/livez``, and ``/alerts`` exposes the manager.
+"""
+
+import json
+import logging
+import math
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe import (disable_structured_logging,
+                                        disable_tracing,
+                                        enable_structured_logging,
+                                        enable_tracing)
+from deeplearning4j_tpu.observe import log as slog
+from deeplearning4j_tpu.observe.alerts import (AbsenceRule, AlertManager,
+                                               BurnRateRule, CallbackSink,
+                                               Notification,
+                                               RateOfChangeRule, SLOSpec,
+                                               ThresholdRule, WebhookSink,
+                                               load_rules, series_sum)
+from deeplearning4j_tpu.observe.health import (HealthCheck, HealthReport,
+                                               ServingHealth,
+                                               TrainingWatchdog,
+                                               WatchdogAlarm,
+                                               attach_observability)
+from deeplearning4j_tpu.observe.metrics import (MetricsRegistry,
+                                                parse_prometheus_text)
+from deeplearning4j_tpu.observe.trace import TraceRecorder, Tracer
+from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+if TOOLS not in sys.path:
+    sys.path.insert(0, TOOLS)
+from validate_alert_rules import validate_file, validate_rules  # noqa: E402
+
+
+@pytest.fixture
+def hub():
+    h = enable_structured_logging(capacity=256)
+    yield h
+    disable_structured_logging()
+
+
+@pytest.fixture
+def tracer():
+    tr = enable_tracing(Tracer(TraceRecorder(capacity=1024)), jax_hook=False)
+    yield tr
+    disable_tracing()
+
+
+# ------------------------------------------------------------ structured log
+
+class TestStructuredLog:
+    def test_record_in_span_carries_trace_ids(self, hub, tracer):
+        log = slog.get_logger("t")
+        with tracer.span("op") as sp:
+            rec = log.info("inside", k=1)
+        assert rec.trace_id == sp.trace_id
+        assert rec.span_id == sp.span_id
+        out = hub.ring.records()[-1]
+        assert out is rec and out.fields == {"k": 1}
+
+    def test_record_outside_span_has_no_ids(self, hub, tracer):
+        rec = slog.get_logger("t").info("outside")
+        assert rec.trace_id is None
+        assert "trace_id" not in rec.to_dict()
+
+    def test_stdlib_bridge_correlates(self, hub, tracer):
+        stdlog = logging.getLogger("some.existing.module")
+        with tracer.span("op") as sp:
+            stdlog.warning("legacy %s call", "logging")
+        recs = [r for r in hub.ring.records()
+                if r.logger == "some.existing.module"]
+        assert len(recs) == 1
+        assert recs[0].message == "legacy logging call"
+        assert recs[0].trace_id == sp.trace_id
+        assert recs[0].span_id == sp.span_id
+        assert recs[0].level == "warning"
+
+    def test_bridge_removed_on_disable(self, tracer):
+        enable_structured_logging(capacity=16)
+        first = slog.get_active_hub()
+        disable_structured_logging()
+        logging.getLogger("x").warning("after disable")
+        assert slog.get_active_hub() is None
+        assert all(r.message != "after disable"
+                   for r in first.ring.records())
+
+    def test_ring_drop_accounting(self):
+        ring = slog.LogRing(capacity=4)
+        for i in range(10):
+            ring.add(slog.LogRecord(0.0, 20, "l", str(i), None, None,
+                                    "t", {}))
+        assert len(ring) == 4
+        assert ring.total_recorded == 10
+        assert ring.dropped == 6
+        assert [r.message for r in ring.records()] == ["6", "7", "8", "9"]
+
+    def test_json_line_strict_and_round_trips(self, hub):
+        rec = slog.get_logger("j").warning(
+            "nan loss", score=float("nan"), arr=np.float32(2.5),
+            nested={"a": [1, float("inf")]})
+        d = json.loads(rec.to_json())  # one strict-JSON line
+        assert d["score"] == "nan"
+        assert d["arr"] == 2.5
+        assert d["nested"]["a"] == [1, "inf"]
+        assert d["level"] == "warning" and d["logger"] == "j"
+
+    def test_reserved_keys_win_over_fields(self, hub):
+        rec = slog.get_logger("j").info("msg", message="spoof", level="x")
+        d = rec.to_dict()
+        assert d["message"] == "msg" and d["level"] == "info"
+
+    def test_level_filtering(self):
+        hub = enable_structured_logging(capacity=16, level="warning")
+        try:
+            log = slog.get_logger("lvl")
+            assert log.debug("quiet") is None
+            assert log.info("quiet") is None
+            assert log.error("loud") is not None
+            assert [r.message for r in hub.ring.records()] == ["loud"]
+        finally:
+            disable_structured_logging()
+
+    def test_path_stream_writes_json_lines(self, tmp_path, tracer):
+        p = tmp_path / "log.jsonl"
+        enable_structured_logging(path=str(p))
+        try:
+            log = slog.get_logger("f")
+            with tracer.span("op") as sp:
+                log.info("one", i=1)
+            log.info("two")
+        finally:
+            disable_structured_logging()
+        lines = [json.loads(l) for l in p.read_text().splitlines()]
+        assert len(lines) == 2
+        assert lines[0]["trace_id"] == sp.trace_id
+        assert "trace_id" not in lines[1]
+
+    def test_noop_without_hub(self):
+        assert slog.get_active_hub() is None
+        assert slog.get_logger("n").info("dropped") is None
+
+    def test_every_n_gate(self):
+        gate = slog.every_n(3)
+        assert [gate() for _ in range(7)] == [True, False, False, True,
+                                              False, False, True]
+
+    def test_at_most_every_gate_injected_clock(self):
+        t = [0.0]
+        gate = slog.at_most_every(10.0, clock=lambda: t[0])
+        assert gate() is True
+        assert gate() is False
+        t[0] = 9.999
+        assert gate() is False
+        t[0] = 10.0
+        assert gate() is True
+        assert gate() is False
+
+
+# ----------------------------------------------------------------- watchdog
+
+class _StubModel:
+    def __init__(self):
+        self.score_ = 1.0
+        self.params = [{"W": np.ones((2, 2), np.float32)}]
+        self.listeners = []
+
+
+class TestTrainingWatchdog:
+    def _drive(self, wd, model, scores, start_iter=0):
+        for i, s in enumerate(scores):
+            model.score_ = s
+            wd.iteration_done(model, start_iter + i, 0)
+
+    def test_nan_loss_log_action_records_event(self):
+        reg = MetricsRegistry()
+        wd = TrainingWatchdog(action="log", metrics=reg, model_name="m")
+        self._drive(wd, _StubModel(), [1.0, float("nan")])
+        assert [e.check for e in wd.events] == ["nan_loss"]
+        assert reg.get("watchdog_events_total").value(
+            model="m", check="nan_loss") == 1
+
+    def test_nan_loss_raise_action(self):
+        wd = TrainingWatchdog(action="raise")
+        with pytest.raises(WatchdogAlarm, match="nan_loss"):
+            self._drive(wd, _StubModel(), [float("inf")])
+
+    def test_nan_loss_callback_action(self):
+        seen = []
+        wd = TrainingWatchdog(action=seen.append)
+        self._drive(wd, _StubModel(), [float("nan")])
+        assert len(seen) == 1 and seen[0].check == "nan_loss"
+
+    def test_per_check_action_override(self):
+        wd = TrainingWatchdog(action="log", actions={"nan_loss": "raise"})
+        with pytest.raises(WatchdogAlarm):
+            self._drive(wd, _StubModel(), [float("nan")])
+
+    def test_unknown_check_name_rejected(self):
+        with pytest.raises(ValueError, match="unknown watchdog checks"):
+            TrainingWatchdog(actions={"nan_losss": "raise"})
+
+    def test_loss_divergence_after_k_windows(self):
+        wd = TrainingWatchdog(divergence_windows=3)
+        # dip resets the streak; then 3 consecutive rises fire
+        self._drive(wd, _StubModel(), [5.0, 4.0, 4.5, 4.2, 4.4, 4.6, 4.8])
+        assert [e.check for e in wd.events] == ["loss_divergence"]
+        assert wd.events[0].iteration == 6
+
+    def test_steady_loss_never_fires(self):
+        wd = TrainingWatchdog(divergence_windows=2)
+        self._drive(wd, _StubModel(), [3.0, 2.0, 2.0, 1.5, 1.2])
+        assert wd.events == []
+
+    def test_stall_detection_injected_clock(self):
+        t = [0.0]
+        wd = TrainingWatchdog(stall_factor=10.0, stall_min_history=5,
+                              clock=lambda: t[0])
+        model = _StubModel()
+        wd.on_epoch_start(model)
+        for i in range(6):  # steady 1s steps build the median baseline
+            t[0] += 1.0
+            wd.iteration_done(model, i, 0)
+        t[0] += 30.0  # one 30s step vs median 1s
+        wd.iteration_done(model, 6, 0)
+        assert [e.check for e in wd.events] == ["step_stall"]
+        assert wd.events[0].value == pytest.approx(30.0)
+
+    def test_epoch_boundary_not_a_stall(self):
+        t = [0.0]
+        wd = TrainingWatchdog(stall_factor=2.0, stall_min_history=3,
+                              clock=lambda: t[0])
+        model = _StubModel()
+        wd.on_epoch_start(model)
+        for i in range(5):
+            t[0] += 1.0
+            wd.iteration_done(model, i, 0)
+        wd.on_epoch_end(model)
+        t[0] += 500.0  # between-epoch work (eval, checkpointing)
+        wd.on_epoch_start(model)
+        t[0] += 1.0
+        wd.iteration_done(model, 5, 1)
+        assert wd.events == []
+
+    def test_gradient_explosion_and_vanishing_ewma(self):
+        wd = TrainingWatchdog(grad_warmup=3, grad_explode_factor=10.0,
+                              grad_vanish_factor=1e-3)
+        for _ in range(4):
+            wd.observe_gradient_norm(1.0)
+        wd.observe_gradient_norm(100.0)  # 100x baseline
+        assert [e.check for e in wd.events] == ["gradient_explosion"]
+        # the spike did not poison the EWMA baseline
+        wd.observe_gradient_norm(1e-5)
+        assert [e.check for e in wd.events] == ["gradient_explosion",
+                                                "gradient_vanishing"]
+        wd.observe_gradient_norm(float("nan"))
+        assert wd.events[-1].check == "nan_gradient"
+
+    def test_nan_params_scan(self):
+        wd = TrainingWatchdog(check_params_every=2)
+        model = _StubModel()
+        model.params = [{"W": np.array([[1.0, np.nan]], np.float32)}]
+        model.score_ = 0.5
+        wd.iteration_done(model, 1, 0)  # not a scan iteration
+        assert wd.events == []
+        wd.iteration_done(model, 2, 0)
+        assert [e.check for e in wd.events] == ["nan_params"]
+
+    def test_injected_nan_loss_fires_through_real_fit(self):
+        """Acceptance: an injected NaN loss fires the watchdog with the
+        configured action inside an actual fit loop."""
+        net = _tiny_net()
+        x = np.ones((8, 4), np.float32)
+        x[0, 0] = np.nan  # poisons the loss on the first step
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        attach_observability(net, trace=False,
+                             watchdog={"action": "raise"})
+        with pytest.raises(WatchdogAlarm, match="nan_loss"):
+            net.fit(x, y, epochs=1)
+
+    def test_early_stopping_converts_alarm_to_error_termination(self):
+        from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                         ListDataSetIterator)
+        from deeplearning4j_tpu.optimize.earlystopping import (
+            DataSetLossCalculator, EarlyStoppingConfiguration,
+            EarlyStoppingTrainer, MaxEpochsTerminationCondition)
+        net = _tiny_net()
+        x = np.ones((8, 4), np.float32)
+        x[0, 0] = np.nan
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        it = ListDataSetIterator(DataSet(x, y), 4)
+        attach_observability(net, trace=False,
+                             watchdog={"action": "raise"})
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(it),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(5)])
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == "Error"
+        assert "nan_loss" in result.termination_details
+
+    def test_rollback_restores_pre_divergence_checkpoint(self, tmp_path):
+        from deeplearning4j_tpu.util.preemption import PreemptionHandler
+        net = _tiny_net()
+        x = np.ones((8, 4), np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        handler = PreemptionHandler(net, str(tmp_path / "good.zip"))
+        handler.save()
+        x[0, 0] = np.nan
+        attach_observability(net, trace=False,
+                             watchdog={"action": "raise"})
+        with pytest.raises(WatchdogAlarm):
+            net.fit(x, y, epochs=1)
+        restored, state = handler.rollback()
+        for group in restored.params:
+            for name, arr in group.items():
+                assert np.all(np.isfinite(np.asarray(arr))), name
+
+    def test_attach_observability_single_path(self, tracer):
+        from deeplearning4j_tpu.observe.listener import TraceListener
+        net = _tiny_net()
+        attached = attach_observability(net, tracer=tracer,
+                                        metrics=MetricsRegistry(),
+                                        watchdog=True)
+        assert [type(l).__name__ for l in attached] == \
+            ["TraceListener", "TrainingWatchdog"]
+        assert all(l in net.listeners for l in attached)
+
+    def test_watchdog_logs_structured_with_trace_ids(self, hub, tracer):
+        wd = TrainingWatchdog(action="log")
+        model = _StubModel()
+        with tracer.span("train") as sp:
+            model.score_ = float("nan")
+            wd.iteration_done(model, 3, 1)
+        recs = [r for r in hub.ring.records()
+                if r.fields.get("check") == "nan_loss"]
+        assert len(recs) == 1
+        assert recs[0].trace_id == sp.trace_id
+        assert recs[0].fields["iteration"] == 3
+
+
+def _tiny_net(seed=7):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=2, activation="softmax",
+                               loss="mcxent"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+# ------------------------------------------------------------ serving health
+
+class TestServingHealth:
+    def test_report_status_lattice(self):
+        ok = HealthReport([HealthCheck("a", True)])
+        degraded = HealthReport([HealthCheck("a", True),
+                                 HealthCheck("b", False)])
+        down = HealthReport([HealthCheck("a", False, critical=True)])
+        assert (ok.status, degraded.status, down.status) == \
+            ("ok", "degraded", "down")
+        assert down.healthy is False and degraded.healthy is True
+        assert degraded.to_dict()["checks"][1]["healthy"] is False
+
+    def test_admission_saturation_and_drain(self):
+        from deeplearning4j_tpu.serving.admission import AdmissionController
+        adm = AdmissionController(2)
+        health = ServingHealth(admission=adm)
+        assert health.report().status == "ok"
+        s1, s2 = adm.admit(), adm.admit()
+        rep = health.report()
+        assert rep.status == "degraded"
+        assert any(c.name == "admission_saturation" and not c.healthy
+                   for c in rep.checks)
+        s1.release(), s2.release()
+        adm.begin_drain()
+        assert any(c.name == "admission_drain"
+                   for c in health.report().checks)
+
+    def test_registry_dispatcher_death_is_down(self):
+        from deeplearning4j_tpu.serving.registry import ModelRegistry
+
+        class M:
+            def output(self, x):
+                return np.asarray(x)
+
+        registry = ModelRegistry()
+        health = ServingHealth(registry=registry)
+        assert health.report().status == "degraded"  # no models yet
+        registry.register("m", model=M())
+        assert health.report().status == "ok"
+        registry.get("m").inference.shutdown()
+        rep = health.report()
+        assert rep.status == "down"
+        assert any(c.name == "dispatcher:m" and c.critical
+                   and not c.healthy for c in rep.checks)
+
+    def test_extra_probe_plugs_in(self):
+        health = ServingHealth(extra_probes=[
+            lambda: HealthCheck("custom", False, "broken")])
+        rep = health.report()
+        assert rep.status == "degraded"
+        assert rep.checks[-1].detail == "broken"
+
+
+class TestServerEndpoints:
+    @pytest.fixture
+    def served(self):
+        from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+
+        class M:
+            def output(self, x):
+                return np.asarray(x).sum(axis=-1, keepdims=True)
+
+        metrics = MetricsRegistry()
+        registry = ModelRegistry(metrics=metrics)
+        registry.register("m", model=M())
+        # an absence rule on a metric nothing exports fires on the first
+        # evaluation — a deterministic "firing" state for the endpoint
+        rules = [AbsenceRule("always", "never_exported_total",
+                             severity="info")]
+        mgr = AlertManager(metrics, rules, sinks=[],
+                           time_source=ManualTimeSource(0))
+        server = ModelServer(registry, metrics=metrics, alerts=mgr)
+        server.start()
+        try:
+            yield server, mgr
+        finally:
+            server.stop(drain=False, shutdown_registry=True)
+
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return r.status, json.load(r)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_livez_ok_and_verbose(self, served):
+        server, _ = served
+        code, body = self._get(f"{server.url}/livez")
+        assert code == 200 and body == {"status": "ok"}
+        code, body = self._get(f"{server.url}/livez?verbose=1")
+        assert code == 200 and body["status"] == "ok"
+        names = [c["name"] for c in body["checks"]]
+        assert "dispatcher:m" in names and "admission_saturation" in names
+
+    def test_livez_503_on_dead_dispatcher(self, served):
+        server, _ = served
+        server.registry.get("m").inference.shutdown()
+        code, body = self._get(f"{server.url}/livez?verbose=1")
+        assert code == 503 and body["status"] == "down"
+
+    def test_alerts_endpoint_serves_manager_state(self, served):
+        server, mgr = served
+        mgr.evaluate_once(now=1.0)
+        code, body = self._get(f"{server.url}/alerts")
+        assert code == 200
+        assert body["firing"] == ["always"]
+        assert body["rules"][0]["state"] == "firing"
+        assert body["evaluations"] == 1
+
+    def test_alerts_404_without_manager(self):
+        from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+        server = ModelServer(ModelRegistry(), metrics=MetricsRegistry())
+        server.start()
+        try:
+            code, _ = self._get(f"{server.url}/alerts")
+            assert code == 404
+        finally:
+            server.stop(drain=False)
+
+
+# ------------------------------------------------------- exposition contract
+
+class TestExpositionFormat:
+    """The alert engine reads metrics THROUGH the Prometheus text
+    exposition (`parse_prometheus_text(registry.exposition())`), so the
+    round trip through escaping and the `+Inf` conventions IS the
+    contract between the metrics core and the rules."""
+
+    def test_escaped_label_values_round_trip(self):
+        reg = MetricsRegistry()
+        c = reg.counter("esc_total", "escape torture", ("path",))
+        nasty = ['back\\slash', 'quo"te', 'new\nline', 'trail\\',
+                 '\\n-literal', 'mix\\"\nall', '{brace}', 'a=b,c', '']
+        for i, v in enumerate(nasty):
+            c.inc(i + 1, path=v)
+        series = parse_prometheus_text(reg.exposition())["esc_total"]
+        assert len(series) == len(nasty)
+        for i, v in enumerate(nasty):
+            assert series[(("path", v),)] == i + 1
+
+    def test_escaped_newline_keeps_one_line_per_series(self):
+        reg = MetricsRegistry()
+        reg.counter("nl_total", "", ("k",)).inc(k="a\nb")
+        lines = [l for l in reg.exposition().splitlines()
+                 if l.startswith("nl_total{")]
+        assert len(lines) == 1
+        assert '\\n' in lines[0] and "\n" not in lines[0]
+
+    def test_histogram_inf_bucket_synthesized_and_parsed(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "", buckets=(0.1, 1.0))  # no +Inf given
+        h.observe(0.05)
+        h.observe(5.0)  # beyond every finite bound
+        parsed = parse_prometheus_text(reg.exposition())
+        assert parsed["lat_bucket"][(("le", "0.1"),)] == 1
+        assert parsed["lat_bucket"][(("le", "1"),)] == 1
+        assert parsed["lat_bucket"][(("le", "+Inf"),)] == 2
+        assert parsed["lat_count"][()] == 2
+
+    def test_histogram_explicit_inf_bucket_not_duplicated(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat2", "", buckets=(0.5, math.inf))
+        assert h.buckets == (0.5, math.inf)
+        h.observe(0.2)
+        inf_lines = [l for l in reg.exposition().splitlines()
+                     if 'le="+Inf"' in l]
+        assert len(inf_lines) == 1
+
+    def test_histogram_boundary_lands_in_finite_bucket(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat3", "", buckets=(1.0, 2.0))
+        h.observe(1.0)  # le is INCLUSIVE (the Prometheus convention)
+        parsed = parse_prometheus_text(reg.exposition())
+        assert parsed["lat3_bucket"][(("le", "1"),)] == 1
+        assert parsed["lat3_bucket"][(("le", "+Inf"),)] == 1
+
+    def test_histogram_inf_observation_round_trips(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h_inf", "", buckets=(1.0,))
+        h.observe(math.inf)
+        parsed = parse_prometheus_text(reg.exposition())
+        assert parsed["h_inf_bucket"][(("le", "1"),)] == 0
+        assert parsed["h_inf_bucket"][(("le", "+Inf"),)] == 1
+        assert parsed["h_inf_sum"][()] == math.inf  # "+Inf" in the text
+        assert parsed["h_inf_count"][()] == 1
+        h.observe(-math.inf)  # -Inf lands in the lowest bucket, sum -> nan
+        parsed = parse_prometheus_text(reg.exposition())
+        assert parsed["h_inf_bucket"][(("le", "1"),)] == 1
+        assert parsed["h_inf_count"][()] == 2
+
+    def test_alert_rule_matches_escaped_series(self):
+        # the satellite's point: a rule selecting on a label value that
+        # needs escaping must still see the series after the round trip
+        path = 'v1/models/we"ird\\name\n'
+        reg = MetricsRegistry()
+        reg.counter("esc_req_total", "", ("path",)).inc(9, path=path)
+        clock = ManualTimeSource(0)
+        seen = []
+        mgr = AlertManager(
+            reg, [ThresholdRule("esc", "esc_req_total", ">", 5,
+                                labels={"path": path})],
+            [CallbackSink(seen.append)], time_source=clock)
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing"]
+
+
+# -------------------------------------------------------------------- alerts
+
+class TestAlertRules:
+    def test_series_sum_subset_match(self):
+        reg = MetricsRegistry()
+        c = reg.counter("req", "", ("server", "status"))
+        c.inc(3, server="a", status="200")
+        c.inc(2, server="a", status="500")
+        c.inc(7, server="b", status="200")
+        from deeplearning4j_tpu.observe.metrics import parse_prometheus_text
+        sample = parse_prometheus_text(reg.exposition())
+        assert series_sum(sample, "req") == 12
+        assert series_sum(sample, "req", {"server": "a"}) == 5
+        assert series_sum(sample, "req", {"status": "500"}) == 2
+        assert series_sum(sample, "req", {"status": "404"}) is None
+        assert series_sum(sample, "missing") is None
+
+    def _mgr(self, rules, reg=None, **kw):
+        reg = reg if reg is not None else MetricsRegistry()
+        seen = []
+        clock = ManualTimeSource(0)
+        mgr = AlertManager(reg, rules, [CallbackSink(seen.append)],
+                           time_source=clock, **kw)
+        return mgr, reg, seen, clock
+
+    def test_threshold_fire_dedup_resolve(self):
+        mgr, reg, seen, clock = self._mgr(
+            [ThresholdRule("hot", "depth", ">", 5)])
+        g = reg.gauge("depth", "")
+        g.set(3)
+        mgr.evaluate_once()
+        assert seen == [] and mgr.firing() == []
+        g.set(9)
+        clock.advance(seconds=10)
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing"]
+        assert mgr.firing() == ["hot"]
+        clock.advance(seconds=10)
+        mgr.evaluate_once()  # still hot: deduped, no second notification
+        assert [n.state for n in seen] == ["firing"]
+        g.set(1)
+        clock.advance(seconds=10)
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing", "resolved"]
+        assert mgr.firing() == []
+        assert reg.get("alerts_firing").value(rule="hot") == 0
+
+    def test_threshold_for_s_pending(self):
+        mgr, reg, seen, clock = self._mgr(
+            [ThresholdRule("slow", "depth", ">", 5, for_s=30)])
+        g = reg.gauge("depth", "")
+        g.set(9)
+        mgr.evaluate_once()
+        assert seen == []  # pending, not firing
+        clock.advance(seconds=10)
+        mgr.evaluate_once()
+        assert seen == []
+        clock.advance(seconds=25)  # 35s > for_s
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing"]
+        # a dip mid-pending resets the timer
+        mgr2, reg2, seen2, clock2 = self._mgr(
+            [ThresholdRule("slow", "depth", ">", 5, for_s=30)])
+        g2 = reg2.gauge("depth", "")
+        g2.set(9)
+        mgr2.evaluate_once()
+        g2.set(1)
+        clock2.advance(seconds=10)
+        mgr2.evaluate_once()
+        g2.set(9)
+        clock2.advance(seconds=25)
+        mgr2.evaluate_once()  # only 0s into the NEW pending window
+        assert seen2 == []
+
+    def test_absence_rule(self):
+        mgr, reg, seen, clock = self._mgr(
+            [AbsenceRule("gone", "heartbeat_total")])
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing"]
+        reg.counter("heartbeat_total", "").inc()
+        clock.advance(seconds=5)
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing", "resolved"]
+
+    def test_rate_of_change_rule(self):
+        mgr, reg, seen, clock = self._mgr(
+            [RateOfChangeRule("surge", "req_total", ">", 10.0, 60.0)])
+        c = reg.counter("req_total", "")
+        mgr.evaluate_once()          # t=0, v=0 — no window yet
+        c.inc(100)
+        clock.advance(seconds=30)
+        mgr.evaluate_once()          # history spans only 30s < 60s
+        assert seen == []
+        c.inc(2000)
+        clock.advance(seconds=40)    # t=70; sample at t=0 is in window
+        mgr.evaluate_once()          # rate = 2100/70 = 30/s > 10
+        assert [n.state for n in seen] == ["firing"]
+        clock.advance(seconds=3600)  # no new increments → rate 0
+        mgr.evaluate_once()
+        assert [n.state for n in seen] == ["firing", "resolved"]
+
+    def test_burn_rate_multiwindow_fire_once_resolve(self):
+        """Acceptance: synthetic error-ratio series crosses a multiwindow
+        burn-rate rule → fires, notifies exactly once, then resolves."""
+        slo = SLOSpec("http_requests_total", {"status": "500"},
+                      objective=0.99)
+        rule = BurnRateRule("burn", slo,
+                            [(3600.0, 300.0, 14.4)], severity="page")
+        mgr, reg, seen, clock = self._mgr([rule])
+        c = reg.counter("http_requests_total", "", ("status",))
+        c.inc(1000, status="200")
+        mgr.evaluate_once()          # baseline at t=0
+        # 50% errors over the next minute: burn = 0.5/0.01 = 50x >= 14.4x
+        c.inc(100, status="200")
+        c.inc(100, status="500")
+        clock.advance(seconds=60)
+        fired = mgr.evaluate_once()
+        assert [n.state for n in fired] == ["firing"]
+        assert fired[0].severity == "page"
+        assert fired[0].value >= 14.4
+        # still elevated long-window, but the SHORT window goes clean:
+        # healthy traffic only, clock past the short window
+        c.inc(500, status="200")
+        clock.advance(seconds=301)
+        resolved = mgr.evaluate_once()
+        assert [n.state for n in resolved] == ["resolved"]
+        assert [n.state for n in seen] == ["firing", "resolved"]
+
+    def test_burn_rate_ignores_quiet_total(self):
+        slo = SLOSpec("req_total", {"status": "500"}, objective=0.9)
+        mgr, reg, seen, clock = self._mgr(
+            [BurnRateRule("b", slo, [(600.0, 60.0, 2.0)])])
+        mgr.evaluate_once()  # metric absent, zero traffic: burn 0, no fire
+        clock.advance(seconds=120)
+        mgr.evaluate_once()
+        assert seen == [] and mgr.firing() == []
+
+    def test_slo_spec_validation(self):
+        with pytest.raises(ValueError, match="objective"):
+            SLOSpec("m", {"status": "500"}, objective=1.0)
+        with pytest.raises(ValueError, match="error_labels"):
+            SLOSpec("m", {})
+        with pytest.raises(ValueError, match="short window"):
+            BurnRateRule("b", SLOSpec("m", {"s": "1"}),
+                         [(60.0, 600.0, 2.0)])
+
+    def test_duplicate_rule_names_rejected(self):
+        rules = [ThresholdRule("x", "m", ">", 1),
+                 AbsenceRule("x", "m")]
+        with pytest.raises(ValueError, match="duplicate"):
+            AlertManager(MetricsRegistry(), rules)
+
+    def test_bad_rule_contained_others_still_evaluate(self):
+        class Broken(ThresholdRule):
+            def evaluate(self, history, now):
+                raise RuntimeError("boom")
+
+        mgr, reg, seen, clock = self._mgr(
+            [Broken("bad", "m", ">", 1), AbsenceRule("gone", "nope")])
+        mgr.evaluate_once()
+        assert [n.rule for n in seen] == ["gone"]
+
+    def test_background_evaluator_start_stop(self):
+        import time as _time
+        mgr, reg, seen, clock = self._mgr(
+            [AbsenceRule("gone", "nope")], interval_s=0.01)
+        mgr.start()
+        deadline = _time.time() + 5.0
+        while mgr.evaluations < 2 and _time.time() < deadline:
+            _time.sleep(0.01)
+        mgr.stop()
+        assert mgr.evaluations >= 2
+        assert mgr.firing() == ["gone"]
+
+
+class TestSinks:
+    def _note(self):
+        return Notification("r", "warning", "firing", 1.0, "d", 0.0)
+
+    def test_webhook_retries_with_backoff_then_delivers(self):
+        calls, sleeps = [], []
+
+        def post(url, body):
+            calls.append(json.loads(body))
+            return 503 if len(calls) < 3 else 200
+
+        sink = WebhookSink("http://x/hook", retries=3, backoff_s=0.5,
+                           post=post, sleep=sleeps.append)
+        sink.notify(self._note())
+        assert len(calls) == 3 and sink.delivered == 1 and sink.failed == 0
+        assert sleeps == [0.5, 1.0]  # exponential backoff
+        assert calls[0]["rule"] == "r" and calls[0]["state"] == "firing"
+
+    def test_webhook_gives_up_after_retries(self):
+        def post(url, body):
+            raise OSError("connection refused")
+
+        sink = WebhookSink("http://x/hook", retries=2, backoff_s=0.1,
+                           post=post, sleep=lambda s: None)
+        sink.notify(self._note())  # never raises into the evaluator
+        assert sink.failed == 1 and sink.delivered == 0
+        assert "connection refused" in sink.last_error
+
+    def test_failing_sink_contained_by_manager(self):
+        class Bomb:
+            def notify(self, n):
+                raise RuntimeError("sink down")
+
+        seen = []
+        reg = MetricsRegistry()
+        mgr = AlertManager(reg, [AbsenceRule("gone", "nope")],
+                           [Bomb(), CallbackSink(seen.append)],
+                           time_source=ManualTimeSource(0))
+        mgr.evaluate_once()
+        assert [n.rule for n in seen] == ["gone"]
+        assert reg.get("alert_notifications_total").value(
+            rule="gone", state="firing") == 1
+
+
+# ------------------------------------------------------------- rule loading
+
+class TestRuleLoading:
+    GOOD = {"rules": [
+        {"type": "threshold", "name": "t", "metric": "m", "op": ">",
+         "value": 5, "labels": {"server": "a"}, "for_s": 10,
+         "severity": "critical"},
+        {"type": "absence", "name": "a", "metric": "m2"},
+        {"type": "rate_of_change", "name": "r", "metric": "m3",
+         "op": ">=", "value": 1.5, "window_s": 60},
+        {"type": "burn_rate", "name": "b",
+         "slo": {"metric": "req", "error_labels": {"status": "500"},
+                 "objective": 0.999},
+         "windows": [{"long_s": 3600, "short_s": 300, "factor": 14.4},
+                     {"long_s": 21600, "short_s": 1800, "factor": 6.0}]},
+    ]}
+
+    def test_load_all_types(self):
+        rules = load_rules(self.GOOD)
+        assert [type(r).__name__ for r in rules] == \
+            ["ThresholdRule", "AbsenceRule", "RateOfChangeRule",
+             "BurnRateRule"]
+        assert rules[0].for_s == 10 and rules[0].severity == "critical"
+        assert rules[3].slo.objective == 0.999
+        assert len(rules[3].windows) == 2
+
+    def test_load_from_file_and_json_string(self, tmp_path):
+        p = tmp_path / "rules.json"
+        p.write_text(json.dumps(self.GOOD))
+        assert len(load_rules(str(p))) == 4
+        assert len(load_rules(json.dumps(self.GOOD))) == 4
+
+    def test_schema_errors_carry_rule_index(self):
+        with pytest.raises(ValueError, match=r"rules\[0\].*unknown type"):
+            load_rules({"rules": [{"type": "nope", "name": "x"}]})
+        with pytest.raises(ValueError, match=r"rules\[0\].*missing field"):
+            load_rules({"rules": [{"type": "threshold", "name": "x"}]})
+        with pytest.raises(ValueError, match="duplicate"):
+            load_rules({"rules": [
+                {"type": "absence", "name": "x", "metric": "m"},
+                {"type": "absence", "name": "x", "metric": "m2"}]})
+        with pytest.raises(ValueError, match="rules"):
+            load_rules({"not_rules": []})
+
+    def test_validator_tool_ok_and_fail(self, tmp_path):
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(self.GOOD))
+        assert validate_file(str(good)) == []
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(
+            {"rules": [{"type": "threshold", "name": "x", "metric": "m",
+                        "op": "~", "value": 1}]}))
+        errs = validate_file(str(bad))
+        assert errs and "unknown op" in errs[0]
+        unreadable = validate_file(str(tmp_path / "missing.json"))
+        assert unreadable and "unreadable" in unreadable[0]
+        assert validate_rules({"rules": []}) == ["schema: no rules defined"]
+
+    def test_shipped_example_rules_validate(self):
+        """The smoke-tier lint: the example's shipped rules file must pass
+        the validator (schema + dry-run) forever."""
+        rules_path = os.path.join(REPO, "examples", "alert_rules.json")
+        assert validate_file(rules_path) == []
