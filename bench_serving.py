@@ -1,0 +1,457 @@
+"""Serving latency benchmark — ONE JSON line, the BENCH_SERVING series.
+
+The serving counterpart of ``bench.py``'s training suite: drives a real
+``ModelServer`` over HTTP with the keep-alive client and reports what a
+caller actually feels —
+
+- **cold vs warm first request**: the same model registered with
+  ``warmup="off"`` vs ``warmup="sync"`` — the XLA compile spike the AOT
+  bucket warmup removes from the request path, and what it cost at
+  registration instead (``warmup_seconds``);
+- **closed loop**: N worker threads in lockstep request/response —
+  p50/p95/p99 latency and saturated throughput;
+- **open loop**: fixed arrival rate (latency-independent, the
+  coordinated-omission-free number) — achieved rate, SLO hit rate, and
+  goodput (completed-within-SLO per second);
+- **steady_state_compiles**: XLA compiles observed while the measured
+  traffic ran. The fast path's invariant is that this is ZERO; it is also
+  the deterministic regression oracle ``--check`` enforces (wall-clock
+  latency on shared CI flakes; "did a compile hit the hot path" does not);
+- **dispatch_micro**: the host-side coalesce+pad step timed in isolation,
+  preallocated pad buffer vs the old concatenate-then-pad path, plus one
+  in-process ``ParallelInference`` round-trip time for context;
+- **int8**: the quantized-serving config — same measurements through a
+  ``dtype_policy="int8"`` version plus calibration error and weight bytes.
+
+Comparator discipline (same as bench.py): latencies through a loopback
+HTTP stack on a shared host drift session to session; ``cold - warm``
+first-request delta, ``steady_state_compiles``, compile/bucket counts and
+byte ratios are the stable comparators. BENCH_SERVING_r01.json is the
+committed r01 of this series.
+
+Usage:
+    python bench_serving.py                       # full run, prints JSON
+    python bench_serving.py --out FILE            # also write FILE
+    python bench_serving.py --check BENCH_SERVING_r01.json
+        # regression mode: tiny config, deterministic oracles only —
+        # exercised by the smoke tier on every CI run
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+SCHEMA_CONFIG_KEYS = ("config", "buckets", "warmup_seconds",
+                      "cold_first_request_ms", "warm_first_request_ms",
+                      "steady_state_compiles", "closed_loop", "open_loop")
+
+
+# --------------------------------------------------------------------- models
+def _mlp(seed=7):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=64, n_out=256, activation="relu"))
+            .layer(DenseLayer(n_in=256, n_out=256, activation="relu"))
+            .layer(OutputLayer(n_in=256, n_out=16, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _lenet(seed=7):
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.zoo.models import LeNet
+    net = MultiLayerNetwork(LeNet(num_labels=10, seed=seed).conf())
+    return net.init()
+
+
+def _tiny(seed=7):
+    from deeplearning4j_tpu.nn.conf import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_in=8, n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_in=16, n_out=4, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+CONFIGS = {
+    "mlp_ff": dict(
+        make=_mlp, row_shape=(64,), buckets=[1, 2, 4, 8, 16, 32],
+        desc="3-layer MLP 64-256-256-16, f32", slo_ms=50.0,
+        closed_threads=4, closed_reps=60, open_rps=60.0, open_s=3.0),
+    "lenet_cnn": dict(
+        make=_lenet, row_shape=(28, 28, 1), buckets=[1, 4, 16],
+        desc="zoo LeNet 28x28x1, f32", slo_ms=150.0,
+        closed_threads=4, closed_reps=30, open_rps=40.0, open_s=3.0),
+}
+
+
+# ---------------------------------------------------------------- measurement
+def _percentiles(lat_ms):
+    lat = np.asarray(sorted(lat_ms))
+    return {"p50_ms": round(float(np.percentile(lat, 50)), 3),
+            "p95_ms": round(float(np.percentile(lat, 95)), 3),
+            "p99_ms": round(float(np.percentile(lat, 99)), 3)}
+
+
+def _stack(model, buckets, *, warmup, metrics=None):
+    from deeplearning4j_tpu.serving import (MetricsRegistry, ModelRegistry,
+                                            ModelServer, ModelServingClient)
+    m = metrics if metrics is not None else MetricsRegistry()
+    registry = ModelRegistry(metrics=m, buckets=buckets, warmup=warmup,
+                             max_batch_size=max(buckets))
+    registry.register("bench", model)
+    server = ModelServer(registry, metrics=m, max_inflight=256)
+    server.start()
+    return registry, server, ModelServingClient(server.url)
+
+
+def _teardown(registry, server, client):
+    client.close()
+    server.stop(drain=False)
+    registry.shutdown()
+
+
+def _first_request_ms(client, rows, row_shape):
+    x = np.random.default_rng(0).normal(size=(rows,) + row_shape)
+    x = x.astype(np.float32)
+    t0 = time.perf_counter()
+    client.predict("bench", x, binary=True)
+    return (time.perf_counter() - t0) * 1e3
+
+
+def _closed_loop(client, row_shape, *, threads, reps, max_rows):
+    """Lockstep request/response workers — saturated-latency numbers."""
+    lat, errors = [], []
+    lock = threading.Lock()
+    rows_cycle = [1, 2, max(1, max_rows // 2), max_rows]
+
+    def worker(wid):
+        rng = np.random.default_rng(wid)
+        mine = []
+        for i in range(reps):
+            x = rng.normal(size=(rows_cycle[i % len(rows_cycle)],)
+                           + row_shape).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                client.predict("bench", x, binary=True)
+                mine.append((time.perf_counter() - t0) * 1e3)
+            except Exception as e:  # noqa: BLE001 — count, keep measuring
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+        with lock:
+            lat.extend(mine)
+
+    ts = [threading.Thread(target=worker, args=(w,)) for w in range(threads)]
+    t0 = time.perf_counter()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    rec = {"threads": threads, "requests": len(lat),
+           "throughput_rps": round(len(lat) / elapsed, 1), **_percentiles(lat)}
+    if errors:
+        rec["errors"] = len(errors)
+        rec["first_error"] = errors[0]
+    return rec
+
+
+def _open_loop(client, row_shape, *, target_rps, duration_s, slo_ms):
+    """Fixed arrival rate, unbounded concurrency — requests are launched on
+    schedule whether or not earlier ones returned, so slow responses can't
+    slow the arrival process (no coordinated omission)."""
+    lat, errors = [], []
+    lock = threading.Lock()
+    threads = []
+    rng = np.random.default_rng(42)
+    n = int(target_rps * duration_s)
+    xs = [rng.normal(size=(1,) + row_shape).astype(np.float32)
+          for _ in range(min(n, 16))]
+
+    def fire(i):
+        t0 = time.perf_counter()
+        try:
+            client.predict("bench", xs[i % len(xs)], binary=True)
+            with lock:
+                lat.append((time.perf_counter() - t0) * 1e3)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+    interval = 1.0 / target_rps
+    start = time.perf_counter()
+    for i in range(n):
+        due = start + i * interval
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t = threading.Thread(target=fire, args=(i,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+    done = len(lat)
+    within = sum(1 for x in lat if x <= slo_ms)
+    rec = {"target_rps": target_rps,
+           "achieved_rps": round(done / elapsed, 1),
+           "slo_ms": slo_ms,
+           "slo_hit_rate": round(within / n, 4) if n else 0.0,
+           "goodput_rps": round(within / elapsed, 1)}
+    if lat:
+        rec.update(_percentiles(lat))
+    if errors:
+        rec["errors"] = len(errors)
+    return rec
+
+
+def _dispatch_micro(row_shape=(2048,), reps=2000):
+    """The host-side coalesce+pad tax, isolated: four 6-row requests
+    assembled into a 32-bucket batch, preallocated pad buffer vs the old
+    concatenate-then-pad-concatenate (which allocates AND copies the full
+    padded batch twice). ``_assemble`` is timed directly because the full
+    ``output()`` round-trip (queue handoff, device transfer, forward,
+    result materialization) is ~0.5 ms of fixed cost that swamps the
+    ~30 µs copy delta into run-to-run noise; ``roundtrip_ms_per_req`` is
+    reported once as that context."""
+    from deeplearning4j_tpu.parallel.inference import ParallelInference
+
+    class _Identity:
+        def output(self, x):
+            return np.asarray(x)
+
+    class _Rows:
+        def __init__(self, x):
+            self.x = x
+
+    pi = ParallelInference(_Identity(), max_batch_size=32, buckets=[32],
+                           mode="sequential")
+    rng = np.random.default_rng(9)
+    batch = [_Rows(rng.normal(size=(6,) + row_shape).astype(np.float32))
+             for _ in range(4)]
+    out = {"rows": 24, "bucket": 32,
+           "row_floats": int(np.prod(row_shape))}
+    for label, reuse in (("assemble_reuse_us", True),
+                         ("assemble_concat_us", False)):
+        pi.reuse_pad_buffer = reuse
+        for _ in range(max(50, reps // 10)):  # warm the path
+            pi._assemble(batch, 24, 32)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            pi._assemble(batch, 24, 32)
+        out[label] = round((time.perf_counter() - t0) / reps * 1e6, 2)
+    pi.shutdown()
+
+    bpi = ParallelInference(_Identity(), max_batch_size=32, buckets=[32],
+                            wait_ms=0.0)
+    x = np.concatenate([r.x for r in batch], axis=0)
+    for _ in range(20):
+        bpi.output(x)
+    t0 = time.perf_counter()
+    for _ in range(200):
+        bpi.output(x)
+    out["roundtrip_ms_per_req"] = round((time.perf_counter() - t0) / 200
+                                        * 1e3, 4)
+    bpi.shutdown()
+    return out
+
+
+def _compile_count():
+    from deeplearning4j_tpu.observe import trace as _trace
+    tracer = _trace.get_active_tracer()
+    return tracer.compile_count if tracer is not None else 0
+
+
+def _bench_config(name, spec, *, int8=False):
+    buckets = spec["buckets"]
+    rec = {"config": spec["desc"] + (" + int8 weights" if int8 else ""),
+           "buckets": buckets}
+
+    # cold: no warmup — the first request pays the compile spike
+    model = spec["make"](seed=3)
+    registry, server, client = _stack(model, buckets, warmup="off")
+    rec["cold_first_request_ms"] = round(
+        _first_request_ms(client, max(buckets), spec["row_shape"]), 2)
+    _teardown(registry, server, client)
+
+    # warm: AOT bucket warmup at registration; fresh model object so its
+    # jit cache is genuinely cold at register time
+    model = spec["make"](seed=3)
+    kw = {}
+    if int8:
+        sample = np.random.default_rng(5).normal(
+            size=(max(buckets),) + spec["row_shape"]).astype(np.float32)
+        kw = dict(dtype_policy="int8", sample_input=sample)
+    from deeplearning4j_tpu.serving import MetricsRegistry, ModelRegistry
+    from deeplearning4j_tpu.serving import ModelServer, ModelServingClient
+    m = MetricsRegistry()
+    registry = ModelRegistry(metrics=m, buckets=buckets, warmup="sync",
+                             max_batch_size=max(buckets))
+    registry.register("bench", model, **kw)
+    state = registry.warmup_state("bench")
+    rec["warmup_seconds"] = state["seconds"]
+    assert state["status"] == "warm", state
+    server = ModelServer(registry, metrics=m, max_inflight=256)
+    server.start()
+    client = ModelServingClient(server.url)
+
+    c0 = _compile_count()
+    rec["warm_first_request_ms"] = round(
+        _first_request_ms(client, max(buckets), spec["row_shape"]), 2)
+    rec["closed_loop"] = _closed_loop(
+        client, spec["row_shape"], threads=spec["closed_threads"],
+        reps=spec["closed_reps"], max_rows=max(buckets))
+    rec["open_loop"] = _open_loop(
+        client, spec["row_shape"], target_rps=spec["open_rps"],
+        duration_s=spec["open_s"], slo_ms=spec["slo_ms"])
+    rec["steady_state_compiles"] = _compile_count() - c0
+
+    if int8:
+        from deeplearning4j_tpu.serving.quantize import param_nbytes
+        served = registry.get("bench")
+        mv = served.versions[served.current_version]
+        rec["quant_error"] = mv.quant_error
+        rec["param_bytes_float32"] = param_nbytes(model.params)
+        rec["param_bytes_int8"] = mv.model.param_nbytes
+    _teardown(registry, server, client)
+    return rec
+
+
+def run_full():
+    import jax
+    from deeplearning4j_tpu.observe import (Tracer, disable_tracing,
+                                            enable_tracing)
+    enable_tracing(Tracer())  # compile counting only; ring buffer bounded
+    try:
+        record = {"series": "BENCH_SERVING", "round": 1,
+                  "backend": jax.default_backend(),
+                  "devices": len(jax.devices())}
+        configs = {}
+        for name, spec in CONFIGS.items():
+            try:
+                configs[name] = _bench_config(name, spec)
+            except Exception as e:  # noqa: BLE001 — isolate per config
+                configs[name] = {"error": f"{type(e).__name__}: {e}"}
+        try:
+            # int8 dequantizes per forward — on the CPU bench host that is
+            # pure overhead (the byte win pays off on HBM-bound devices),
+            # so drive it at a rate it can absorb; the stable comparators
+            # are quant_error and the 3.8x weight-byte cut
+            int8_spec = dict(CONFIGS["mlp_ff"], open_rps=30.0)
+            configs["mlp_ff_int8"] = _bench_config(
+                "mlp_ff_int8", int8_spec, int8=True)
+        except Exception as e:  # noqa: BLE001
+            configs["mlp_ff_int8"] = {"error": f"{type(e).__name__}: {e}"}
+        record["configs"] = configs
+        try:
+            record["dispatch_micro"] = _dispatch_micro()
+        except Exception as e:  # noqa: BLE001
+            record["dispatch_micro"] = {"error": f"{type(e).__name__}: {e}"}
+        return record
+    finally:
+        disable_tracing()
+
+
+# -------------------------------------------------------------------- --check
+def run_check(committed_path):
+    """Deterministic regression oracles, cheap enough for the smoke tier:
+
+    1. the committed series file parses and carries the full schema;
+    2. a tiny model registered with warmup covers every declared bucket;
+    3. ZERO XLA compiles while steady-state traffic spans those buckets;
+    4. the keep-alive client holds one connection across requests.
+
+    Latency numbers are deliberately NOT gated — on shared CI they flake;
+    a compile leaking into the hot path is the regression that matters.
+    """
+    failures = []
+    with open(committed_path) as f:
+        committed = json.load(f)
+    if committed.get("series") != "BENCH_SERVING":
+        failures.append(f"{committed_path}: series != BENCH_SERVING")
+    for cname, crec in committed.get("configs", {}).items():
+        if "error" in crec:
+            failures.append(f"{committed_path}: config {cname} recorded an "
+                            f"error: {crec['error']}")
+            continue
+        for key in SCHEMA_CONFIG_KEYS:
+            if key not in crec:
+                failures.append(f"{committed_path}: {cname} missing {key!r}")
+        if crec.get("steady_state_compiles", 1) != 0:
+            failures.append(f"{committed_path}: {cname} recorded "
+                            f"steady_state_compiles != 0")
+
+    from deeplearning4j_tpu.observe import (Tracer, disable_tracing,
+                                            enable_tracing)
+    from deeplearning4j_tpu.serving import ModelServingClient
+    tracer = enable_tracing(Tracer())
+    try:
+        buckets = [2, 4]
+        registry, server, client = _stack(_tiny(), buckets, warmup="sync")
+        try:
+            state = registry.warmup_state("bench")
+            if state["status"] != "warm" or state["warm"] != buckets:
+                failures.append(f"warmup did not cover buckets: {state}")
+            c0 = tracer.compile_count
+            rng = np.random.default_rng(0)
+            for rows in (1, 2, 3, 4, 1, 4):
+                client.predict(
+                    "bench", rng.normal(size=(rows, 8)).astype(np.float32),
+                    binary=True)
+            leaked = tracer.compile_count - c0
+            if leaked:
+                failures.append(
+                    f"{leaked} XLA compile(s) leaked into steady-state "
+                    f"serving across declared buckets")
+            conn = client._connection()
+            client.predict("bench", np.zeros((1, 8), np.float32),
+                           binary=True)
+            if client._connection() is not conn:
+                failures.append("keep-alive client did not reuse its "
+                                "connection")
+            assert isinstance(client, ModelServingClient)
+        finally:
+            _teardown(registry, server, client)
+    finally:
+        disable_tracing()
+
+    if failures:
+        for f_ in failures:
+            print(f"CHECK FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"bench_serving check OK against {committed_path} "
+          f"(warm buckets, zero steady-state compiles, keep-alive)")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="bench_serving.py")
+    p.add_argument("--check", metavar="BENCH_SERVING_rNN.json", default=None,
+                   help="regression mode: verify the committed series file "
+                        "and the deterministic fast-path invariants")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON record here")
+    args = p.parse_args(argv)
+    if args.check:
+        return run_check(args.check)
+    record = run_full()
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -309,6 +309,28 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--wait-ms", type=float, default=2.0,
                    help="batching window measured from the oldest request")
+    p.add_argument("--buckets", default=None, metavar="N,N,...",
+                   help="declared batch buckets (default: powers of two up "
+                        "to --max-batch-size); these are pre-compiled at "
+                        "registration and the dispatcher pads to them")
+    p.add_argument("--warmup", choices=("sync", "async", "off"),
+                   default="sync",
+                   help="AOT bucket warmup at registration: sync blocks "
+                        "until every bucket is compiled, async warms in "
+                        "the background (/readyz lists cold buckets), off "
+                        "restores lazy first-request compilation")
+    p.add_argument("--compile-cache-dir", default=None, metavar="DIR",
+                   help="persistent XLA compilation cache: restarts and "
+                        "rollbacks re-warm from disk instead of compiling")
+    p.add_argument("--dtype-policy", action="append", default=[],
+                   metavar="NAME=POLICY",
+                   help="serve NAME quantized: POLICY is int8, bf16 or "
+                        "float32 (repeatable)")
+    p.add_argument("--input-shape", action="append", default=[],
+                   metavar="NAME=DIMS",
+                   help="per-row input shape for warmup when the model "
+                        "conf does not declare one, e.g. lenet=28x28x1 "
+                        "(repeatable)")
     p.add_argument("--max-inflight", type=int, default=64,
                    help="admission limit before requests shed as 429")
     p.add_argument("--deadline-ms", type=float, default=None,
@@ -329,7 +351,8 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
 
     import os
 
-    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+    from deeplearning4j_tpu.serving import (DTYPE_POLICIES,
+                                            ModelRegistry, ModelServer,
                                             default_registry)
 
     tracer = None
@@ -352,15 +375,73 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
         print(f"alerting on {len(alert_mgr.rules)} rule(s) from "
               f"{args.alerts} (state at /alerts)")
 
+    buckets = None
+    if args.buckets:
+        try:
+            buckets = [int(b) for b in args.buckets.split(",") if b.strip()]
+        except ValueError:
+            p.error(f"--buckets needs comma-separated batch sizes, "
+                    f"got {args.buckets!r}")
+        if not buckets or min(buckets) < 1:
+            p.error(f"--buckets needs positive batch sizes, "
+                    f"got {args.buckets!r}")
+    policies = {}
+    for spec in args.dtype_policy:
+        name, sep, policy = spec.partition("=")
+        if not sep:
+            p.error(f"--dtype-policy needs NAME=POLICY, got {spec!r}")
+        if policy not in DTYPE_POLICIES:
+            p.error(f"--dtype-policy {name}={policy!r}: unknown policy "
+                    f"(one of {', '.join(DTYPE_POLICIES)})")
+        policies[name] = policy
+    shapes = {}
+    for spec in args.input_shape:
+        name, sep, dims = spec.partition("=")
+        if not sep:
+            p.error(f"--input-shape needs NAME=DIMS, got {spec!r}")
+        try:
+            shapes[name] = tuple(int(d) for d in dims.lower().split("x"))
+        except ValueError:
+            p.error(f"--input-shape needs DIMS like 28x28x1, got {dims!r}")
+        if not shapes[name] or min(shapes[name]) < 1:
+            p.error(f"--input-shape needs positive DIMS, got {dims!r}")
     registry = ModelRegistry(metrics=default_registry(),
                              max_batch_size=args.max_batch_size,
-                             wait_ms=args.wait_ms)
+                             wait_ms=args.wait_ms, buckets=buckets,
+                             warmup=args.warmup,
+                             compile_cache_dir=args.compile_cache_dir)
+    models = []
     for spec in args.model:
         name, sep, path = spec.partition("=")
         if not sep:
             name, path = os.path.splitext(os.path.basename(spec))[0], spec
-        version = registry.register(name, path=path)
-        print(f"registered {name!r} v{version} from {path}")
+        models.append((name, path))
+    model_names = [n for n, _ in models]
+    # a typo'd NAME in a per-model flag must not silently serve the model
+    # unquantized / unwarmed
+    for flag, mapping in (("--dtype-policy", policies),
+                          ("--input-shape", shapes)):
+        unknown = sorted(set(mapping) - set(model_names))
+        if unknown:
+            p.error(f"{flag} names no registered --model: "
+                    f"{', '.join(unknown)} (models: "
+                    f"{', '.join(model_names)})")
+    for name, path in models:
+        version = registry.register(
+            name, path=path, dtype_policy=policies.get(name, "float32"),
+            input_shape=shapes.get(name))
+        state = registry.warmup_state(name, version)
+        extra = ""
+        if state["status"] == "warm":
+            extra = (f" (warmed {len(state['warm'])} bucket(s) in "
+                     f"{state['seconds']:.2f}s)")
+        elif state["status"] in ("pending", "warming"):
+            extra = " (warming in background)"
+        elif state["status"] == "skipped":
+            extra = f" (warmup skipped: {state['reason']})"
+        elif state["status"] == "error":
+            extra = f" (warmup FAILED: {state['reason']})"
+        print(f"registered {name!r} v{version} from {path}{extra}")
     server = ModelServer(
         registry, host=args.host, port=args.port, metrics=default_registry(),
         max_inflight=args.max_inflight,

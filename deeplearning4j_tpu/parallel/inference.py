@@ -6,9 +6,27 @@ are queued, a background worker coalesces them into batches
 replicas.
 
 TPU-native design: one jitted forward specialized per bucketed batch size
-(powers of two, to bound recompilation), requests coalesced by a single
-dispatcher thread; multi-device throughput comes from sharding the coalesced
-batch over the mesh 'data' axis rather than from model replicas.
+(powers of two by default, or an explicit declared ``buckets`` list, to
+bound recompilation), requests coalesced by a single dispatcher thread;
+multi-device throughput comes from sharding the coalesced batch over the
+mesh 'data' axis rather than from model replicas.
+
+Serving fast path (the round-9 perf campaign):
+- ``warmup`` executes the forward for every declared bucket through the
+  EXACT dispatch path (same host dtype, same ``jnp.asarray`` conversion,
+  same mesh sharding) so steady-state serving never pays an XLA compile —
+  ``jit(...).lower().compile()`` AOT executables do NOT seed the jit call
+  cache (verified on jax 0.4.37), so warmup executes the real jitted
+  callable instead;
+- the coalesce-and-pad hot path writes request rows straight into ONE
+  preallocated per-bucket host buffer (``reuse_pad_buffer``) instead of a
+  concatenate + pad-concatenate pair — two fewer full-batch host copies
+  per dispatch (safe because the dispatcher is serial and the device
+  result is materialized before the buffer is reused);
+- a dispatch that lands on an UNDECLARED bucket (cold: a client batch
+  larger than anything warmed) is counted in
+  ``inference_cold_dispatches_total`` — the alarm that a compile spike hit
+  a live request.
 
 Serving-tier contract (the guarantees ``serving/server.py`` maps to HTTP
 status codes):
@@ -34,7 +52,8 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from typing import List, Optional
+import weakref
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -146,7 +165,9 @@ class ParallelInference:
     def __init__(self, model, *, mode: str = "batched", max_batch_size: int = 32,
                  queue_limit: int = 64, wait_ms: float = 2.0,
                  mesh: Optional[Mesh] = None, metrics=None,
-                 metrics_name: str = "default"):
+                 metrics_name: str = "default",
+                 buckets: Optional[Sequence[int]] = None,
+                 reuse_pad_buffer: bool = True):
         if mode not in ("sequential", "inplace", "batched"):
             raise ValueError(f"unknown mode {mode!r} (inplace|sequential|batched)")
         self.model = model
@@ -154,6 +175,37 @@ class ParallelInference:
         self.max_batch_size = int(max_batch_size)
         self.wait_s = wait_ms / 1e3
         self.mesh = mesh
+        self.reuse_pad_buffer = bool(reuse_pad_buffer)
+        # declared buckets: the batch shapes warmup compiles ahead of time
+        # and the dispatcher pads to. Default: powers of two up to
+        # max_batch_size. Every bucket is rounded up to a multiple of the
+        # mesh data-axis size so the padded batch always shards evenly.
+        d = 1 if mesh is None else mesh.shape.get("data", 1)
+        if buckets is None:
+            raw = []
+            b = 1
+            while b < self.max_batch_size:
+                raw.append(b)
+                b <<= 1
+            raw.append(_bucket(self.max_batch_size))
+        else:
+            raw = [int(b) for b in buckets]
+            if not raw or min(raw) < 1:
+                raise ValueError("buckets must be positive batch sizes")
+        self.buckets: Tuple[int, ...] = tuple(sorted(
+            {-(-b // d) * d for b in raw}))
+        # bounded: clients choose row shape/dtype on the binary path, so
+        # unchecked growth here would be a dispatcher memory leak
+        self._pad_buffers: Dict[tuple, np.ndarray] = {}
+        self._max_pad_buffers = max(16, 2 * len(self.buckets))
+        # PER MODEL: (bucket, row_shape, dtype) signatures warmup() has
+        # executed — a declared bucket hit with a never-warmed dtype still
+        # compiles, and so does a model swapped in via update_model()
+        # without its own warmup (each model object owns a fresh jit call
+        # cache, so warm state cannot transfer across a swap). Weak keys:
+        # retired versions must not be pinned by their signature sets.
+        self._warmed_keys: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
         self._model_lock = threading.Lock()
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=queue_limit)
         self._shutdown = False
@@ -161,8 +213,9 @@ class ParallelInference:
         self.dispatcher_error: Optional[BaseException] = None
         self.batches_dispatched = 0
         self._inflight_batch: List[_Request] = []
+        self._carry: Optional[_Request] = None  # claimed, awaiting next batch
         self._metrics_name = metrics_name
-        self._m_batch = self._m_depth = self._m_up = None
+        self._m_batch = self._m_depth = self._m_up = self._m_cold = None
         if metrics is not None:
             self._m_batch = metrics.histogram(
                 "inference_batch_size",
@@ -175,6 +228,10 @@ class ParallelInference:
                 "inference_dispatcher_up",
                 "1 while the batching dispatcher thread is alive", ("model",))
             self._m_up.set(1, model=metrics_name)
+            self._m_cold = metrics.counter(
+                "inference_cold_dispatches_total",
+                "Dispatches padded to an UNDECLARED (never-warmed) bucket — "
+                "each one may pay a live XLA compile", ("model",))
         if mode == "batched":
             self._worker = threading.Thread(target=self._run, daemon=True)
             self._worker.start()
@@ -274,6 +331,58 @@ class ParallelInference:
         with self._model_lock:
             return self.model
 
+    # ------------------------------------------------------------ fast path
+    def _bucket_for(self, n: int) -> Tuple[int, bool]:
+        """Smallest declared bucket holding ``n`` rows, or (cold) the
+        power-of-two fallback when ``n`` exceeds every declared bucket.
+        Returns ``(target_rows, declared)``."""
+        for b in self.buckets:
+            if b >= n:
+                return b, True
+        target = _bucket(n)
+        if self.mesh is not None:
+            d = self.mesh.shape.get("data", 1)
+            target = -(-target // d) * d
+        return target, False
+
+    def _to_device(self, x: np.ndarray):
+        """Host batch → device array, exactly as the dispatcher ships it
+        (shared by the dispatch hot path and warmup so the compiled shapes
+        and shardings are identical)."""
+        xj = jnp.asarray(x)
+        if self.mesh is not None:
+            xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
+        return xj
+
+    def warmup(self, row_shape: Sequence[int], *, dtype=np.float32,
+               model=None, buckets: Optional[Sequence[int]] = None) -> dict:
+        """Execute the forward for every declared bucket ahead of time.
+
+        ``row_shape`` is the per-row feature shape (no batch dim); ``model``
+        defaults to the live model but a NOT-yet-activated version can be
+        warmed before its hot-swap (the registry does exactly that, so a
+        swap lands on an already-compiled forward). Runs the real jitted
+        callable through the real transfer path — an AOT
+        ``lower().compile()`` would leave the jit call cache cold and the
+        first live request would compile anyway.
+
+        Returns ``{bucket: seconds}`` for the buckets warmed by THIS call.
+        """
+        model = self._model() if model is None else model
+        report = {}
+        for b in (self.buckets if buckets is None else
+                  [self._bucket_for(int(x))[0] for x in buckets]):
+            x = np.zeros((b,) + tuple(row_shape), dtype)
+            t0 = time.perf_counter()
+            np.asarray(model.output(self._to_device(x)))
+            report[b] = time.perf_counter() - t0
+            try:
+                self._warmed_keys.setdefault(model, set()).add(
+                    (b, tuple(row_shape), np.dtype(dtype).str))
+            except TypeError:  # non-weakref-able duck-typed model: its
+                pass           # dispatches conservatively count cold
+        return report
+
     def shutdown(self) -> None:
         self._shutdown = True
         if self._worker is not None:
@@ -306,23 +415,36 @@ class ParallelInference:
                 self._m_up.set(0, model=self._metrics_name)
             crash = DispatcherCrashed(f"inference dispatcher died: {e!r}")
             # requests already claimed into the dying batch are no longer in
-            # the queue — unblock them too (the thread is dead, no race)
+            # the queue — unblock them too (the thread is dead, no race);
+            # same for a claimed carry request awaiting the next batch
             for r in self._inflight_batch:
                 if not r.event.is_set():
                     r.error = crash
                     r.event.set()
+            if self._carry is not None and not self._carry.event.is_set():
+                self._carry.error = crash
+                self._carry.event.set()
+                self._carry = None
             self._fail_queued(crash)
 
     def _run_loop(self) -> None:
+        # a claimed request that would overflow the largest declared bucket
+        # is carried into the NEXT batch instead of forcing a cold shape
+        # (held on self so the crash handler can fail it — it is neither
+        # queued nor in the in-flight batch while it waits)
+        cap = self.buckets[-1]
         while not self._shutdown:
-            try:
-                first = self._q.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            if not first.claim():  # cancelled or expired while queued
-                continue
-            if first.ctx is not None:
-                first.t_claim = time.perf_counter_ns()
+            if self._carry is not None:
+                first, self._carry = self._carry, None
+            else:
+                try:
+                    first = self._q.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                if not first.claim():  # cancelled or expired while queued
+                    continue
+                if first.ctx is not None:
+                    first.t_claim = time.perf_counter_ns()
             batch: List[_Request] = [first]
             # publish the batch list BEFORE coalescing: a crash anywhere
             # past the first claim must be able to fail these waiters
@@ -343,12 +465,21 @@ class ParallelInference:
                     continue
                 if r.ctx is not None:
                     r.t_claim = time.perf_counter_ns()
+                if n + r.x.shape[0] > cap:
+                    # keep every dispatched shape inside the declared
+                    # bucket set: this request opens the next batch
+                    self._carry = r
+                    break
                 batch.append(r)
                 n += r.x.shape[0]
             if self._m_depth is not None:
                 self._m_depth.set(self._q.qsize(), model=self._metrics_name)
             self._dispatch(batch, n)
             self._inflight_batch = []
+        if self._carry is not None and not self._carry.event.is_set():
+            self._carry.error = RuntimeError("ParallelInference shut down")
+            self._carry.event.set()
+            self._carry = None
 
     def _dispatch(self, batch: List[_Request], n: int) -> None:
         tracer = _trace.get_active_tracer()
@@ -370,24 +501,73 @@ class ParallelInference:
                 sp.add_link(r.ctx)
             self._dispatch_batch(batch, n, sp)
 
-    def _dispatch_batch(self, batch: List[_Request], n: int, sp) -> None:
-        try:
-            x = np.concatenate([r.x for r in batch], axis=0)
-            # pad to bucket size → bounded set of compiled shapes
-            target = _bucket(n)
-            if self.mesh is not None:
-                d = self.mesh.shape.get("data", 1)
-                target = -(-target // d) * d
+    def _assemble(self, batch: List[_Request], n: int,
+                  target: int) -> np.ndarray:
+        """Coalesce request rows into ONE padded host batch.
+
+        Hot path: rows are written straight into a preallocated per-bucket
+        buffer (one host copy per row) instead of the old
+        concatenate-then-pad-concatenate (three full-batch copies). Reuse
+        is safe because the dispatcher is serial and ``_dispatch_batch``
+        materializes the device result (``np.asarray``) before returning —
+        by the time the buffer is rewritten, nothing reads the old batch.
+        """
+        first = batch[0].x
+        row_shape, dtype = first.shape[1:], first.dtype
+        homogeneous = all(r.x.shape[1:] == row_shape and r.x.dtype == dtype
+                          for r in batch[1:])
+        if not (self.reuse_pad_buffer and homogeneous):
+            x = np.concatenate([np.asarray(r.x) for r in batch], axis=0)
             if target > n:
                 pad = np.zeros((target - n,) + x.shape[1:], x.dtype)
                 x = np.concatenate([x, pad], axis=0)
+            return x
+        key = (target, row_shape, dtype.str)
+        buf = self._pad_buffers.get(key)
+        if buf is None:
+            while len(self._pad_buffers) >= self._max_pad_buffers:
+                self._pad_buffers.pop(next(iter(self._pad_buffers)))
+            buf = np.zeros((target,) + tuple(row_shape), dtype)
+            self._pad_buffers[key] = buf
+        off = 0
+        for r in batch:
+            k = r.x.shape[0]
+            buf[off:off + k] = r.x
+            off += k
+        if off < target:
+            buf[off:] = 0  # stale rows from the last batch must not leak
+        return buf
+
+    def _dispatch_batch(self, batch: List[_Request], n: int, sp) -> None:
+        try:
+            # pad to a declared bucket → bounded, pre-warmed compiled shapes
+            target, declared = self._bucket_for(n)
+            x = self._assemble(batch, n, target)
+            model = self._model()
+            # cold = off-bucket, OR a declared bucket whose (shape, dtype)
+            # signature warmup never executed FOR THIS MODEL (an int batch
+            # against a float-warmed model, or a model published through
+            # update_model() without its own warmup — either way a new jit
+            # signature, a live compile). Lazy mode (no warmup ever ran)
+            # keeps declared buckets uncounted.
+            keys = None
+            any_warmed = len(self._warmed_keys) > 0
+            if any_warmed:
+                try:
+                    keys = self._warmed_keys.get(model)
+                except TypeError:
+                    keys = None
+            cold = not declared or (
+                any_warmed and
+                (keys is None or
+                 (target, x.shape[1:], x.dtype.str) not in keys))
+            if cold and self._m_cold is not None:
+                self._m_cold.inc(model=self._metrics_name)
             if sp is not None:
                 sp.set_attribute("padded_to", int(target))
-            xj = jnp.asarray(x)
-            if self.mesh is not None:
-                xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
-            model = self._model()
-            out = np.asarray(model.output(xj))
+                if cold:
+                    sp.set_attribute("cold_bucket", True)
+            out = np.asarray(model.output(self._to_device(x)))
             self.batches_dispatched += 1
             if self._m_batch is not None:
                 self._m_batch.observe(n, model=self._metrics_name)

@@ -42,6 +42,11 @@ from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelVersion,
     ServedModel,
 )
+from deeplearning4j_tpu.serving.quantize import (  # noqa: F401
+    DTYPE_POLICIES,
+    QuantizedModel,
+    quantize_model,
+)
 from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
 from deeplearning4j_tpu.serving.client import (  # noqa: F401
     ModelServingClient,

@@ -6,6 +6,13 @@ a ``/metrics`` scrape that parses back into numbers. Raises ``ServingError``
 carrying the HTTP status and the server's ``Retry-After`` hint so callers
 can implement backoff.
 
+Connections are PERSISTENT (HTTP/1.1 keep-alive), one per calling thread:
+the TCP+handshake tax is paid once per thread, not once per ``predict`` —
+without this, a latency benchmark of the server mostly measures the
+client's connection churn. A connection the server dropped (restart,
+drain) is re-established transparently, once, before the error surfaces.
+``close()`` releases the sockets.
+
 Tracing: ``predict`` runs inside a ``client_predict`` span when a tracer is
 active and ALWAYS ships a W3C ``traceparent`` header for it (creating a
 fresh trace when no span is open), so the server's ``http_request`` span —
@@ -15,10 +22,12 @@ server echoes back is kept on ``client.last_trace_id`` for correlation.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
+import weakref
 from typing import Optional
+from urllib.parse import urlparse
 
 import numpy as np
 
@@ -43,39 +52,108 @@ class ServingError(RuntimeError):
 
 
 class ModelServingClient:
-    def __init__(self, url: str, timeout: float = 10.0):
+    def __init__(self, url: str, timeout: float = 10.0,
+                 keep_alive: bool = True):
         self.url = url.rstrip("/")
         self.timeout = timeout
+        self.keep_alive = keep_alive
+        parsed = urlparse(self.url)
+        if parsed.scheme not in ("http", "https", ""):
+            raise ValueError(f"unsupported scheme {parsed.scheme!r}")
+        self._https = parsed.scheme == "https"
+        self._host = parsed.hostname or "127.0.0.1"
+        self._port = parsed.port or (443 if self._https else 80)
+        # a path-routed base URL (http://gw/serving) prefixes every request
+        self._base_path = parsed.path.rstrip("/")
+        self._local = threading.local()
+        # every thread's connection, for close(): thread-local storage is
+        # only reachable from its own thread, so track them weakly here
+        self._conns: "weakref.WeakSet[http.client.HTTPConnection]" = (
+            weakref.WeakSet())
+        self._conns_lock = threading.Lock()
         self.last_trace_id: Optional[str] = None  # server's X-Trace-Id echo
 
     # -------------------------------------------------------------- plumbing
+    def _connection(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = (http.client.HTTPSConnection if self._https
+                   else http.client.HTTPConnection)
+            conn = cls(self._host, self._port, timeout=self.timeout)
+            self._local.conn = conn
+            with self._conns_lock:
+                self._conns.add(conn)
+        return conn
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            self._local.conn = None
+            try:
+                conn.close()
+            except Exception:  # noqa: BLE001 — already broken
+                pass
+
+    def close(self) -> None:
+        """Close every thread's persistent connection."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self._local = threading.local()
+
     def _request(self, path: str, data: Optional[bytes] = None,
                  headers: Optional[dict] = None):
-        req = urllib.request.Request(self.url + path, data=data,
-                                     headers=headers or {})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                echoed = resp.headers.get("X-Trace-Id")
-                if echoed:
-                    self.last_trace_id = echoed
-                return resp.status, resp.read(), dict(resp.headers)
-        except urllib.error.HTTPError as e:
-            body = e.read()
+        method = "GET" if data is None else "POST"
+        hdrs = dict(headers or {})
+        if not self.keep_alive:
+            hdrs["Connection"] = "close"
+        # one transparent retry when a REUSED connection turns out to have
+        # been closed server-side between requests (idle timeout, restart)
+        # — never on a fresh connection and never on a timeout, so a slow
+        # predict is not silently re-sent
+        for attempt in (0, 1):
+            conn = self._connection()
+            fresh = conn.sock is None
+            try:
+                conn.request(method, self._base_path + path, body=data,
+                             headers=hdrs)
+                resp = conn.getresponse()
+                body = resp.read()
+                break
+            except (http.client.RemoteDisconnected, http.client.BadStatusLine,
+                    ConnectionResetError, BrokenPipeError):
+                self._drop_connection()
+                if fresh or attempt:
+                    raise
+            except (http.client.HTTPException, OSError):
+                self._drop_connection()
+                raise
+        # Title-Case the keys: http.client preserves wire casing, and a
+        # lowercasing proxy must not cost us Retry-After / X-Trace-Id
+        resp_headers = {k.title(): v for k, v in resp.getheaders()}
+        if not self.keep_alive or resp.will_close:
+            self._drop_connection()
+        echoed = resp_headers.get("X-Trace-Id")
+        if echoed:
+            # error responses echo X-Trace-Id too — correlation matters
+            # MOST for failures, so capture it before raising
+            self.last_trace_id = echoed
+        if resp.status >= 400:
             try:
                 message = json.loads(body.decode()).get("error", "")
             except Exception:  # noqa: BLE001 - body may not be JSON
                 message = body.decode(errors="replace")
-            retry = e.headers.get("Retry-After")
-            # error responses echo X-Trace-Id too — correlation matters
-            # MOST for failures, so capture it before raising
-            echoed = e.headers.get("X-Trace-Id")
-            if echoed:
-                self.last_trace_id = echoed
+            retry = resp_headers.get("Retry-After")
             err = ServingError(
-                e.code, message,
+                resp.status, message,
                 float(retry) if retry is not None else None)
             err.trace_id = echoed
-            raise err from None
+            raise err
+        return resp.status, body, resp_headers
 
     # -------------------------------------------------------------- predict
     def predict(self, model: str, inputs, *, version: Optional[int] = None,

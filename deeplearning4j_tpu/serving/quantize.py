@@ -1,0 +1,245 @@
+"""Quantized inference — per-version dtype policies for the serving tier.
+
+A registered model version can be served under a ``dtype_policy`` without
+touching any layer code:
+
+- ``"float32"`` — the model as trained (no wrapper);
+- ``"bf16"``    — weights stored bfloat16 (half the weight bytes for the
+  quantized copy; compute promotes per XLA rules or follows the conf's
+  ``compute_dtype``);
+- ``"int8"``    — weight-only symmetric int8: every float weight matrix /
+  kernel is stored as an ``int8`` tensor plus a float32 per-output-channel
+  scale, dequantized INSIDE the jitted forward. Weights stay int8 in device
+  memory — a ~4x cut in weight bytes moved per forward, which is the
+  resource serving is actually bound by (the training side proved the
+  framework sits on the HBM roofline, ARCHITECTURE.md §8). 1-d params
+  (biases, norm scales) stay float: they are byte-trivial and their
+  precision is disproportionately load-bearing.
+
+The wrapper holds a reference to the base model (its ``states``, conf and
+forward are reused), so a live-object registration keeps the caller's
+float params alive alongside the quantized copy — by design, the caller
+may still be training that object. Checkpoint loads the REGISTRY owns
+call ``release_base_params()`` after calibration, so a path-registered
+quantized version does not pin a full float copy.
+
+The wrapper duck-types the one method the serving stack calls —
+``output(x)`` — so it drops into ``ParallelInference`` / ``ModelRegistry``
+hot-swap / rollback like any other model. Calibration happens at
+registration: the registry runs a sample batch through the float and the
+quantized forward and records the deviation on the version's metadata
+(``ModelVersion.quant_error``), optionally failing registration past a
+tolerance — a bad quantization is caught at publish time, never by a user
+request.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DTYPE_POLICIES = ("float32", "bf16", "int8")
+
+# params small enough that quantizing them saves nothing but risks accuracy
+_MIN_QUANT_SIZE = 64
+
+
+class QTensor:
+    """One int8-quantized weight: ``q`` (int8) × ``scale`` (f32) ≈ original.
+
+    Registered as a JAX pytree node so a params tree holding QTensors flows
+    through ``jax.jit`` boundaries like any other tree; dequantization is
+    traced into the forward, so the int8 buffers are what lives in device
+    memory between requests.
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def dequantize(self, dtype=jnp.float32):
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.asarray(self.q).nbytes + np.asarray(self.scale).nbytes)
+
+
+jax.tree_util.register_pytree_node(
+    QTensor,
+    lambda t: ((t.q, t.scale), None),
+    lambda _, ch: QTensor(*ch))
+
+
+def quantize_array(w, *, min_size: int = _MIN_QUANT_SIZE):
+    """Symmetric int8 quantization of one array; returns a ``QTensor`` or
+    the array unchanged when quantization is not worthwhile (non-float,
+    tiny, or 0/1-d). Scales are per-output-channel (last axis) for >=2-d
+    weights — the axis that is per-unit in every Dense [in, out] and conv
+    HWIO kernel this framework produces."""
+    wn = np.asarray(w)
+    if (not np.issubdtype(wn.dtype, np.floating) or wn.ndim < 2
+            or wn.size < min_size):
+        return w
+    scale = np.max(np.abs(wn), axis=tuple(range(wn.ndim - 1)),
+                   keepdims=True) / 127.0
+    scale = np.where(scale == 0.0, 1.0, scale).astype(np.float32)
+    q = np.clip(np.round(wn / scale), -127, 127).astype(np.int8)
+    return QTensor(jnp.asarray(q), jnp.asarray(scale))
+
+
+def _is_leaf(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def quantize_pytree(params, *, min_size: int = _MIN_QUANT_SIZE):
+    """Quantize every eligible leaf of a params pytree."""
+    return jax.tree_util.tree_map(
+        lambda w: quantize_array(w, min_size=min_size), params)
+
+
+def dequantize_pytree(params, dtype=jnp.float32):
+    """Inverse of ``quantize_pytree`` — meant to run INSIDE a jit."""
+    return jax.tree_util.tree_map(
+        lambda t: t.dequantize(dtype) if isinstance(t, QTensor) else t,
+        params, is_leaf=lambda t: isinstance(t, QTensor))
+
+
+def param_nbytes(params) -> int:
+    """Total bytes across a params tree (QTensors count their int8+scale)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda t: isinstance(t, QTensor)):
+        if isinstance(leaf, QTensor):
+            total += leaf.nbytes
+        elif hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+    return total
+
+
+class QuantizedModel:
+    """Serve-side wrapper holding a quantized copy of a model's params.
+
+    Duck-types ``output`` for both ``MultiLayerNetwork`` (``output(x,
+    mask=None)``) and single/multi-input ``ComputationGraph``
+    (``output(*xs, masks=None)``). The base model object is untouched —
+    training, checkpointing and the float version's own serving keep
+    working; the wrapper only shares its (frozen) ``states`` and conf.
+    """
+
+    def __init__(self, base, policy: str = "int8", *,
+                 min_size: int = _MIN_QUANT_SIZE):
+        if policy not in ("int8", "bf16"):
+            raise ValueError(
+                f"dtype_policy {policy!r} needs no wrapper"
+                if policy == "float32" else
+                f"unknown dtype_policy {policy!r} (one of {DTYPE_POLICIES})")
+        if getattr(base, "params", None) is None:
+            raise ValueError("model has no params to quantize "
+                             "(not init()ed, or not a framework model)")
+        self.base = base
+        self.policy = policy
+        self.conf = base.conf
+        self._is_graph = hasattr(base.conf, "inputs")
+        if policy == "int8":
+            self.qparams = quantize_pytree(base.params, min_size=min_size)
+        else:  # bf16: a straight storage cast, dequantization is a no-op
+            self.qparams = jax.tree_util.tree_map(
+                lambda w: (jnp.asarray(w).astype(jnp.bfloat16)
+                           if hasattr(w, "dtype")
+                           and jnp.issubdtype(jnp.asarray(w).dtype,
+                                              jnp.floating)
+                           else w),
+                base.params)
+        self._fn = None
+
+    # ------------------------------------------------------------- plumbing
+    @property
+    def param_nbytes(self) -> int:
+        return param_nbytes(self.qparams)
+
+    def release_base_params(self) -> None:
+        """Drop the base model's float params (the quantized copy is what
+        serves). Only for a base the CALLER no longer needs — the registry
+        does this for checkpoint loads it owns; after it, the base can no
+        longer train, checkpoint, or run its own float forward."""
+        try:
+            self.base.params = None
+        except AttributeError:  # duck-typed base without settable params
+            pass
+
+    def _out_fn(self):
+        if self._fn is None:
+            base = self.base
+            if self._is_graph:
+                def out(qp, states, inputs, masks):
+                    params = dequantize_pytree(qp)
+                    acts, _, _, _ = base._forward_all(
+                        params, states, inputs, train=False, rng=None,
+                        masks=masks)
+                    return [acts[n] for n in base.conf.outputs]
+            else:
+                def out(qp, states, x, mask):
+                    params = dequantize_pytree(qp)
+                    h, _, _ = base._forward_all(params, states, x,
+                                                train=False, rng=None,
+                                                mask=mask)
+                    return h
+            self._fn = jax.jit(out)
+        return self._fn
+
+    # ------------------------------------------------------------ data path
+    def output(self, *xs, mask=None, masks=None):
+        from deeplearning4j_tpu.nn.multilayer import _as_jnp
+        dtype = self.conf.global_conf.jnp_dtype()
+        if self._is_graph:
+            if len(xs) == 1 and isinstance(xs[0], (list, tuple)):
+                xs = tuple(xs[0])
+            inputs = {n: _as_jnp(x, dtype)
+                      for n, x in zip(self.conf.inputs, xs)}
+            mask_d = None
+            if masks is not None:
+                mask_d = {n: (None if m is None else _as_jnp(m))
+                          for n, m in zip(self.conf.inputs, masks)}
+            outs = self._out_fn()(self.qparams, self.base.states, inputs,
+                                  mask_d)
+            return outs[0] if len(outs) == 1 else outs
+        if len(xs) != 1:
+            raise TypeError(f"output() takes one input, got {len(xs)}")
+        x = _as_jnp(xs[0], dtype)
+        m = None if mask is None else _as_jnp(mask)
+        return self._out_fn()(self.qparams, self.base.states, x, m)
+
+
+def quantize_model(model, policy: str,
+                   *, min_size: int = _MIN_QUANT_SIZE):
+    """Apply a dtype policy; ``"float32"``/None return the model as-is."""
+    if policy in (None, "float32"):
+        return model
+    return QuantizedModel(model, policy, min_size=min_size)
+
+
+def calibrate(base, quantized, sample_batch) -> dict:
+    """Run one sample batch through both forwards; return deviation stats
+    (max absolute error and error relative to the float output range)."""
+    ref = np.asarray(base.output(np.asarray(sample_batch)),
+                     dtype=np.float32)
+    got = np.asarray(quantized.output(np.asarray(sample_batch)),
+                     dtype=np.float32)
+    max_abs = float(np.max(np.abs(got - ref))) if ref.size else 0.0
+    span = float(np.max(np.abs(ref))) if ref.size else 0.0
+    return {"max_abs_err": max_abs,
+            "rel_err": max_abs / (span + 1e-12),
+            "sample_rows": int(np.asarray(sample_batch).shape[0])}
+
+
+def check_tolerance(stats: dict, tolerance: Optional[float]) -> None:
+    if tolerance is not None and stats["rel_err"] > tolerance:
+        raise ValueError(
+            f"quantization error {stats['rel_err']:.4g} exceeds "
+            f"tolerance {tolerance:.4g} — version rejected at registration")

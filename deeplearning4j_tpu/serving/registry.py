@@ -14,15 +14,38 @@ version. Models load from every source the framework already speaks:
 This is the role of the reference's model-server deployments around
 ``ParallelInference.java`` (dl4j-streaming pumping fresh checkpoints into a
 running model), made explicit as an API.
+
+Serving fast path (round 9): registration is where serving pays its
+one-time costs, so no live request ever does —
+
+- **AOT bucket warmup**: every declared batch bucket's forward is executed
+  (and therefore XLA-compiled) at ``register`` time, for EVERY version —
+  including not-yet-active ones — so a later hot-swap or rollback lands on
+  an already-compiled forward. ``warmup="sync"`` blocks registration until
+  warm; ``"async"`` warms on a background thread while ``/readyz`` reports
+  the cold buckets; ``"off"`` restores the old lazy behavior.
+  ``serving_warmup_seconds{model}`` and ``serving_buckets_warm{model}``
+  expose the state.
+- **persistent compile cache**: ``compile_cache_dir=`` points JAX's
+  compilation cache at disk, so a restarted server (or a rollback to an
+  architecture compiled last week) warms from cache instead of compiling.
+- **dtype policy**: ``register(..., dtype_policy="int8"|"bf16")`` serves a
+  weight-quantized wrapper of the version (``serving/quantize.py``),
+  calibrated against ``sample_input`` at registration; the quantization
+  error is recorded on the version and can gate registration
+  (``quant_tolerance``).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.serving import quantize as _quantize
 
 
 class ModelNotFound(KeyError):
@@ -30,15 +53,23 @@ class ModelNotFound(KeyError):
 
 
 class ModelVersion:
-    """One immutable registry entry."""
+    """One immutable registry entry. ``model`` is the object that SERVES
+    (the quantized wrapper under a non-float32 ``dtype_policy``);
+    ``quant_error`` carries the calibration stats when a sample batch was
+    provided at registration."""
 
-    __slots__ = ("version", "model", "source", "registered_at")
+    __slots__ = ("version", "model", "source", "registered_at",
+                 "dtype_policy", "quant_error")
 
-    def __init__(self, version: int, model, source: str):
+    def __init__(self, version: int, model, source: str,
+                 dtype_policy: str = "float32",
+                 quant_error: Optional[dict] = None):
         self.version = version
         self.model = model
         self.source = source
         self.registered_at = time.time()
+        self.dtype_policy = dtype_policy
+        self.quant_error = quant_error
 
 
 class ServedModel:
@@ -50,18 +81,34 @@ class ServedModel:
         self.versions: Dict[int, ModelVersion] = {}
         self.current_version: Optional[int] = None
         self.previous_version: Optional[int] = None
+        # version -> warmup state:
+        #   {"status": "pending"|"warming"|"warm"|"skipped"|"error",
+        #    "buckets": [declared], "warm": [done], "seconds": float,
+        #    "reason": str|None}
+        self.warmup_state: Dict[int, dict] = {}
+        # version -> resolved (row_shape, dtype) spec, kept so rewarm()
+        # can re-run a failed warmup without re-resolving the model
+        self.warmup_spec: Dict[int, Optional[tuple]] = {}
 
     def describe(self) -> dict:
+        def _ver(v: ModelVersion) -> dict:
+            d = {"version": v.version, "source": v.source,
+                 "registered_at": v.registered_at,
+                 "dtype_policy": v.dtype_policy}
+            if v.quant_error is not None:
+                d["quant_error"] = v.quant_error
+            w = self.warmup_state.get(v.version)
+            if w is not None:
+                d["warmup"] = dict(w)
+            return d
+
         return {
             "name": self.name,
             "current_version": self.current_version,
             "previous_version": self.previous_version,
             "healthy": self.inference.healthy,
-            "versions": [
-                {"version": v.version, "source": v.source,
-                 "registered_at": v.registered_at}
-                for v in sorted(self.versions.values(),
-                                key=lambda m: m.version)],
+            "versions": [_ver(v) for v in sorted(self.versions.values(),
+                                                 key=lambda m: m.version)],
         }
 
 
@@ -74,16 +121,27 @@ class ModelRegistry:
     """
 
     def __init__(self, *, metrics=None, max_batch_size: int = 32,
-                 queue_limit: int = 64, wait_ms: float = 2.0, mesh=None):
+                 queue_limit: int = 64, wait_ms: float = 2.0, mesh=None,
+                 buckets: Optional[Sequence[int]] = None,
+                 warmup: str = "sync",
+                 compile_cache_dir: Optional[str] = None):
+        if warmup not in ("sync", "async", "off"):
+            raise ValueError(f"warmup must be sync|async|off, got {warmup!r}")
+        if compile_cache_dir is not None:
+            from deeplearning4j_tpu.util.compile_cache import (
+                enable_persistent_compile_cache)
+            enable_persistent_compile_cache(compile_cache_dir)
         self._models: Dict[str, ServedModel] = {}
         self._lock = threading.RLock()
         self._swap_lock = threading.Lock()  # serializes hot-swaps
         self._metrics = metrics
         self._pi_kw = dict(max_batch_size=max_batch_size,
                            queue_limit=queue_limit, wait_ms=wait_ms,
-                           mesh=mesh)
+                           mesh=mesh, buckets=buckets)
+        self._warmup_mode = warmup
         self._swapping = 0  # >0 while a hot-swap is in progress (readiness)
         self._m_swaps = self._m_version = None
+        self._m_warm_s = self._m_warm_n = None
         if metrics is not None:
             self._m_swaps = metrics.counter(
                 "serving_model_swaps_total",
@@ -91,6 +149,14 @@ class ModelRegistry:
                 ("model", "kind"))
             self._m_version = metrics.gauge(
                 "serving_model_version", "Currently live version", ("model",))
+            self._m_warm_s = metrics.gauge(
+                "serving_warmup_seconds",
+                "Wall seconds the last registration spent pre-compiling "
+                "batch buckets", ("model",))
+            self._m_warm_n = metrics.gauge(
+                "serving_buckets_warm",
+                "Batch buckets of the LIVE version already compiled "
+                "(requests on them never trigger XLA)", ("model",))
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -102,37 +168,255 @@ class ModelRegistry:
 
     # ------------------------------------------------------------ mutation
     def register(self, name: str, model=None, *, path: Optional[str] = None,
-                 activate: bool = True) -> int:
+                 activate: bool = True, dtype_policy: str = "float32",
+                 sample_input=None, input_shape: Optional[Sequence[int]] = None,
+                 quant_tolerance: Optional[float] = None) -> int:
         """Register a new version of ``name``; returns the version number.
 
         Exactly one of ``model`` (a live object) or ``path`` (anything
         ``load_model_guess`` accepts) must be given. The first version of a
-        name activates unconditionally; later ones only when ``activate``.
+        name activates unconditionally; later ones only when ``activate``
+        — and under ``warmup="async"`` the activation happens when the new
+        version's warmup COMPLETES (the hot-swap must land on an already-
+        compiled forward, never put a cold version in front of traffic).
+
+        ``dtype_policy``: serve this version ``"float32"`` (as-is),
+        ``"bf16"`` or ``"int8"`` (weight-quantized wrapper; see
+        ``serving/quantize.py``). With a non-float policy and a
+        ``sample_input`` batch, the quantized output is calibrated against
+        the float one and the deviation recorded on the version
+        (``quant_tolerance`` rejects the registration past that relative
+        error).
+
+        Warmup input spec resolution, per version: ``input_shape`` (a
+        per-row feature shape) > ``sample_input``'s row shape > the conf's
+        ``InputType`` > the first layer's ``n_in``. A model yielding no
+        spec (duck-typed stubs) skips warmup and is treated as warm.
         """
         if (model is None) == (path is None):
             raise ValueError("register() needs exactly one of model=/path=")
+        if dtype_policy not in _quantize.DTYPE_POLICIES:
+            raise ValueError(f"unknown dtype_policy {dtype_policy!r} "
+                             f"(one of {_quantize.DTYPE_POLICIES})")
         source = "object"
         if path is not None:
             model = self.load(path)
             source = str(path)
+        quant_error = None
+        served_obj = model
+        if dtype_policy != "float32":
+            served_obj = _quantize.quantize_model(model, dtype_policy)
+            if sample_input is not None:
+                quant_error = _quantize.calibrate(model, served_obj,
+                                                  sample_input)
+                _quantize.check_tolerance(quant_error, quant_tolerance)
+            if path is not None:
+                # registry-owned checkpoint load: nobody else references
+                # the float model, so don't pin a full float param copy
+                # next to the quantized one for the version's lifetime
+                served_obj.release_base_params()
+        first = False
         with self._lock:
             served = self._models.get(name)
             if served is None:
+                first = True
                 served = ServedModel(
                     name, ParallelInference(
-                        model, mode="batched", metrics=self._metrics,
+                        served_obj, mode="batched", metrics=self._metrics,
                         metrics_name=name, **self._pi_kw))
                 self._models[name] = served
                 version = 1
-                served.versions[version] = ModelVersion(version, model, source)
+            else:
+                version = max(served.versions) + 1
+            served.versions[version] = ModelVersion(
+                version, served_obj, source, dtype_policy=dtype_policy,
+                quant_error=quant_error)
+            if first:
                 served.current_version = version
                 self._note_swap(name, version, "register")
-                return version
-            version = max(served.versions) + 1
-            served.versions[version] = ModelVersion(version, model, source)
-        if activate:
+        spec = self._resolve_row_spec(served_obj, input_shape, sample_input)
+        # async warmup + activate: the swap must land on an already-
+        # compiled forward, so the warmup thread activates when it's warm
+        # (on warmup FAILURE the old version keeps serving — rewarm() then
+        # activate() is the recovery path)
+        defer = (not first and activate and spec is not None
+                 and self._warmup_mode == "async")
+        self._begin_warmup(served, version, spec, activate_after=defer)
+        if not first and activate and not defer:
             self.activate(name, version)
+        if first:
+            with self._lock:
+                self._update_warm_gauge(served)
         return version
+
+    # ------------------------------------------------------------- warmup
+    def _resolve_row_spec(self, model, input_shape,
+                          sample_input) -> Optional[Tuple[tuple, object]]:
+        """(row_shape, host dtype) to warm with, or None (skip warmup)."""
+        if input_shape is not None:
+            return tuple(int(s) for s in input_shape), np.float32
+        if sample_input is not None:
+            s = np.asarray(sample_input)
+            if s.ndim >= 1:
+                # warm with the HOST dtype requests actually arrive in —
+                # the JSON path parses to float32 regardless of model
+                # dtype, and np.random/np.array default to float64, which
+                # no wire format ships: warming '<f8' would leave the live
+                # '<f4' signature cold (and falsely alarm the cold counter)
+                dt = s.dtype if (np.issubdtype(s.dtype, np.floating)
+                                 and s.dtype != np.float64) else np.float32
+                return tuple(s.shape[1:]), dt
+        conf = getattr(model, "conf", None)
+        if conf is None:
+            return None
+        it = getattr(conf, "input_type", None)
+        if it is not None:
+            return tuple(it.batch_shape(1)[1:]), np.float32
+        # single-input graph with a declared InputType
+        input_types = getattr(conf, "input_types", None)
+        inputs = getattr(conf, "inputs", None)
+        if (input_types and inputs and len(inputs) == 1
+                and input_types[0] is not None):
+            return tuple(input_types[0].batch_shape(1)[1:]), np.float32
+        layers = getattr(conf, "layers", None)
+        if layers:
+            n_in = getattr(layers[0], "n_in", None)
+            if n_in:
+                return (int(n_in),), np.float32
+        return None
+
+    def _begin_warmup(self, served: ServedModel, version: int,
+                      spec: Optional[Tuple[tuple, object]],
+                      activate_after: bool = False) -> None:
+        declared = list(served.inference.buckets)
+        served.warmup_spec[version] = spec
+        if self._warmup_mode == "off" or spec is None:
+            with self._lock:
+                served.warmup_state[version] = {
+                    "status": "skipped", "buckets": declared, "warm": [],
+                    "seconds": 0.0,
+                    "reason": ("warmup disabled"
+                               if self._warmup_mode == "off"
+                               else "no input spec (pass input_shape= or "
+                                    "sample_input=)")}
+            return
+        with self._lock:
+            served.warmup_state[version] = {
+                "status": "pending", "buckets": declared, "warm": [],
+                "seconds": 0.0, "reason": None}
+        if self._warmup_mode == "sync":
+            self._run_warmup(served, version, spec, activate_after)
+        else:
+            threading.Thread(target=self._run_warmup,
+                             args=(served, version, spec, activate_after),
+                             name=f"warmup-{served.name}-v{version}",
+                             daemon=True).start()
+
+    def _run_warmup(self, served: ServedModel, version: int,
+                    spec: Tuple[tuple, object],
+                    activate_after: bool = False) -> None:
+        row_shape, dtype = spec
+        state = served.warmup_state[version]
+        model = served.versions[version].model
+        state["status"] = "warming"
+        t0 = time.perf_counter()
+        try:
+            for b in state["buckets"]:
+                served.inference.warmup(row_shape, dtype=dtype, model=model,
+                                        buckets=[b])
+                with self._lock:
+                    state["warm"].append(b)
+                    self._update_warm_gauge(served)
+            with self._lock:
+                state["status"] = "warm"
+                state["seconds"] = round(time.perf_counter() - t0, 4)
+                if self._m_warm_s is not None:
+                    self._m_warm_s.set(state["seconds"], model=served.name)
+        except Exception as e:  # noqa: BLE001 — a warmup failure must not
+            # take the registry down; the version stays cold and /readyz
+            # says why
+            with self._lock:
+                state["status"] = "error"
+                state["reason"] = f"{type(e).__name__}: {e}"
+                state["seconds"] = round(time.perf_counter() - t0, 4)
+                if activate_after:
+                    state["reason"] += ("; deferred activation skipped — "
+                                        "previous version keeps serving")
+            return
+        if activate_after:
+            try:
+                self.activate(served.name, version)
+            except Exception as e:  # noqa: BLE001 — surfaced, not fatal
+                with self._lock:
+                    state["reason"] = (f"warm, but deferred activation "
+                                       f"failed: {type(e).__name__}: {e}")
+
+    def _update_warm_gauge(self, served: ServedModel) -> None:
+        if self._m_warm_n is None:
+            return
+        state = served.warmup_state.get(served.current_version)
+        if state is None:
+            return
+        n = (len(state["buckets"]) if state["status"] == "skipped"
+             else len(state["warm"]))
+        self._m_warm_n.set(n, model=served.name)
+
+    def cold_buckets(self) -> Dict[str, List[int]]:
+        """Per model: declared buckets of the LIVE version not yet warmed
+        (empty when warm, skipped, or warmup disabled). The ``/readyz``
+        payload."""
+        out: Dict[str, List[int]] = {}
+        with self._lock:
+            for name, served in self._models.items():
+                state = served.warmup_state.get(served.current_version)
+                if state is None or state["status"] == "skipped":
+                    continue
+                cold = [b for b in state["buckets"]
+                        if b not in state["warm"]]
+                if cold:
+                    out[name] = cold
+        return out
+
+    def warmed(self) -> bool:
+        """True when no live version still has cold buckets."""
+        return not self.cold_buckets()
+
+    def warmup_errors(self) -> Dict[str, str]:
+        """Per model: the error reason when the LIVE version's warmup
+        FAILED (readyz surfaces this next to the cold buckets, so an
+        operator can tell a crashed warmup from one still running)."""
+        out: Dict[str, str] = {}
+        with self._lock:
+            for name, served in self._models.items():
+                state = served.warmup_state.get(served.current_version)
+                if state is not None and state["status"] == "error":
+                    out[name] = state["reason"] or "warmup failed"
+        return out
+
+    def rewarm(self, name: str, version: Optional[int] = None) -> int:
+        """Re-run bucket warmup for ``version`` (default: live) — the
+        recovery path when registration-time warmup errored (transient
+        OOM, device hiccup) and the process should become ready without
+        a restart. Returns the version warmed."""
+        with self._lock:
+            served = self._get(name)
+            v = served.current_version if version is None else version
+            if v not in served.versions:
+                raise ModelNotFound(f"{name} has no version {v}")
+            spec = served.warmup_spec.get(v)
+        self._begin_warmup(served, v, spec)
+        with self._lock:
+            self._update_warm_gauge(served)
+        return v
+
+    def warmup_state(self, name: str,
+                     version: Optional[int] = None) -> dict:
+        """The warmup record of ``version`` (default: live) of ``name``."""
+        with self._lock:
+            served = self._get(name)
+            v = served.current_version if version is None else version
+            state = served.warmup_state.get(v)
+            return dict(state) if state is not None else {"status": "unknown"}
 
     def activate(self, name: str, version: int, *,
                  _kind: str = "activate") -> None:
@@ -156,6 +440,9 @@ class ModelRegistry:
                     served.previous_version = served.current_version
                     served.current_version = version
                     self._note_swap(name, version, _kind)
+                    # hot-swap keeps warm: the incoming version was warmed
+                    # at ITS registration, so the gauge usually stays full
+                    self._update_warm_gauge(served)
             finally:
                 with self._lock:
                     self._swapping -= 1

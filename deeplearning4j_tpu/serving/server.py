@@ -15,7 +15,9 @@ endpoint                                        behavior
 ``GET /v1/models/<name>``                       one model's description
 ``GET /healthz``                                process liveness (always 200)
 ``GET /readyz``                                 readiness — 503 while draining, mid
-                                                hot-swap, empty, or dispatcher-dead
+                                                hot-swap, empty, dispatcher-dead, or
+                                                bucket warmup incomplete (body lists
+                                                the cold buckets per model)
 ``GET /livez``                                  condensed ``HealthReport`` status
                                                 (``?verbose=1`` → full check list);
                                                 503 only when a critical probe
@@ -44,6 +46,7 @@ server's own span while tracing is active) so callers can correlate.
 from __future__ import annotations
 
 import json
+import socket
 import struct
 import threading
 import time
@@ -95,6 +98,8 @@ class ModelServer:
             "Predict latency (admission to response)", ("model",))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> int:
@@ -107,6 +112,19 @@ class ModelServer:
 
             def log_message(self, *a):  # silence
                 pass
+
+            # keep-alive connections outlive the listener: track them so
+            # stop() can sever idle ones (their handler threads sit in
+            # readline() and would otherwise keep serving after shutdown)
+            def setup(self):
+                super().setup()
+                with server._conns_lock:
+                    server._conns.add(self.connection)
+
+            def finish(self):
+                with server._conns_lock:
+                    server._conns.discard(self.connection)
+                super().finish()
 
             # -------------------------------------------------- responders
             def _respond(self, code: int, body: bytes, content_type: str,
@@ -155,9 +173,8 @@ class ModelServer:
                     else:
                         self._json(server.alerts.describe())
                 elif path == "/readyz":
-                    ready, why = server.readiness()
-                    self._json({"ready": ready, "reason": why},
-                               200 if ready else 503)
+                    ready, body = server.readiness_detail()
+                    self._json(body, 200 if ready else 503)
                 elif path == "/metrics":
                     self._respond(200, server.metrics.exposition().encode(),
                                   "text/plain; version=0.0.4")
@@ -209,6 +226,21 @@ class ModelServer:
             self._httpd.shutdown()
             self._httpd.server_close()
             self._httpd = None
+        # sever surviving keep-alive connections: a persistent client would
+        # otherwise keep getting answers from handler threads parked on
+        # open sockets after the listener is gone
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if shutdown_registry:
             self.registry.shutdown()
 
@@ -226,7 +258,22 @@ class ModelServer:
             return False, "hot-swap in progress"
         if not self.registry.healthy():
             return False, "inference dispatcher down"
+        if not self.registry.warmed():
+            return False, "warmup incomplete"
         return True, "ok"
+
+    def readiness_detail(self) -> Tuple[bool, dict]:
+        """``readiness()`` plus the machine-readable why: while bucket
+        warmup is still running, the 503 body lists exactly which batch
+        buckets would compile if a request hit them now."""
+        ready, why = self.readiness()
+        body: dict = {"ready": ready, "reason": why}
+        if why == "warmup incomplete":
+            body["cold_buckets"] = self.registry.cold_buckets()
+            errors = self.registry.warmup_errors()
+            if errors:  # failed (vs still-running) warmups, and why
+                body["warmup_errors"] = errors
+        return ready, body
 
     @staticmethod
     def _parse_model_ref(ref: str) -> Tuple[str, Optional[int]]:

@@ -1,0 +1,66 @@
+"""Persistent XLA compilation cache — restarts serve hot from disk.
+
+One call points JAX's compilation cache at a directory
+(``jax_compilation_cache_dir``); every backend compile is then written
+there keyed on the HLO hash, and an identical compile in a LATER process —
+a serving restart, a version rollback re-warming the same architecture —
+loads the executable from disk instead of recompiling. CPU, GPU and TPU
+backends all support it on the pinned jax version (verified empirically:
+cache files appear on the CPU mesh).
+
+Two gotchas this module absorbs so callers can't hold it wrong:
+
+- the thresholds: by default JAX only persists compiles that took >= 1s
+  and are >= 64 KiB; a serving warmup full of small per-bucket forwards
+  would persist NOTHING. We lower both floors to "everything".
+- the latch: whether the cache is used is decided ONCE, at the first
+  compile of the process. Setting the dir after anything compiled (the
+  usual case — model loading compiles init fns) silently disables it, so
+  we reset the decision after flipping the config.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_persistent_compile_cache(cache_dir: str) -> str:
+    """Point the process's XLA compilation cache at ``cache_dir``
+    (created if missing). Idempotent; returns the directory. Raises
+    ``ValueError`` if a DIFFERENT directory is already active — the cache
+    decision is process-wide and silently retargeting it would split
+    warm state across two directories."""
+    global _enabled_dir
+    cache_dir = os.path.abspath(str(cache_dir))
+    if _enabled_dir is not None:
+        if _enabled_dir != cache_dir:
+            raise ValueError(
+                f"persistent compile cache already active at {_enabled_dir}"
+                f"; cannot retarget to {cache_dir}")
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    for flag, value in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                        ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(flag, value)
+        except Exception:  # noqa: BLE001 — flag renamed/absent on other jax
+            pass
+    try:
+        # un-latch the per-process "is the cache used" decision (it is
+        # taken at the FIRST compile, usually long before serving starts)
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — private surface; best-effort
+        pass
+    _enabled_dir = cache_dir
+    return cache_dir
+
+
+def persistent_compile_cache_dir() -> Optional[str]:
+    """The active cache directory, or None when not enabled."""
+    return _enabled_dir

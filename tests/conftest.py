@@ -22,6 +22,12 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "smoke: cheap end-to-end harness checks run on every CI tier")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(12345)
