@@ -55,7 +55,53 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     ap.add_argument("--alerts", default=None, metavar="RULES.json",
                     help="evaluate these alert rules against the metrics "
                          "registry in the background during training")
+    ap.add_argument("--elastic", type=int, default=None, metavar="N",
+                    help="run as an elastic multi-process job: N worker "
+                         "processes supervised with automatic failure "
+                         "recovery and shrink-to-surviving-slice "
+                         "(parallel/elastic.py)")
+    ap.add_argument("--min-workers", type=int, default=1,
+                    dest="min_workers",
+                    help="smallest world --elastic may shrink to before "
+                         "the job fails loudly")
+    ap.add_argument("--ckpt-dir", default=None, dest="ckpt_dir",
+                    help="checkpoint/recovery directory (required with "
+                         "--elastic): orbax rotation checkpoints, "
+                         "generation ledger, heartbeats")
+    ap.add_argument("--max-restarts", type=int, default=2,
+                    dest="max_restarts",
+                    help="per-worker restart budget before the supervisor "
+                         "shrinks the world (exponential backoff between "
+                         "restarts)")
+    ap.add_argument("--heartbeat-timeout", type=float, default=120.0,
+                    dest="heartbeat_timeout",
+                    help="seconds without a worker heartbeat before the "
+                         "supervisor declares it hung and recovers")
     args = ap.parse_args(argv)
+
+    if args.elastic is not None:
+        if not args.ckpt_dir:
+            ap.error("--elastic requires --ckpt-dir (the recovery "
+                     "substrate: rotation checkpoints + generation ledger)")
+        # flags that act INSIDE the training process are not plumbed into
+        # the supervised workers — reject rather than silently ignore
+        unsupported = [flag for flag, hit in (
+            ("--workers", args.workers is not None),
+            ("--mode averaging", args.mode != "shared_gradients"),
+            ("--averagingFrequency", args.averagingFrequency != 5),
+            ("--prefetchSize", args.prefetchSize != 2),
+            ("--uiUrl", args.uiUrl is not None),
+            ("--trace", args.trace is not None),
+            ("--watchdog", args.watchdog != "off"),
+        ) if hit]
+        if unsupported:
+            ap.error(
+                f"{', '.join(unsupported)} affect(s) in-process training "
+                "and is not forwarded to --elastic workers (they train "
+                "shared_gradients at the elastic world size); drop it, or "
+                "run without --elastic. --log-json and --alerts ARE "
+                "supported (they observe the supervisor)")
+        return _elastic_train(args)
 
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
     from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
@@ -128,6 +174,67 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
             disable_structured_logging()
     model_serializer.write_model(net, args.modelOutputPath)
     return net
+
+
+def _elastic_train(args):
+    """``train --elastic N``: supervise N elastic worker processes
+    (``python -m deeplearning4j_tpu.parallel.elastic_worker``) over the
+    model/data from --modelPath/--dataPath. Worker death triggers
+    automatic recovery — restart-in-place under a backoff budget, then
+    shrink to the surviving slice down to --min-workers. Rank 0 of the
+    finishing generation writes --modelOutputPath. ``--log-json`` and
+    ``--alerts`` observe the SUPERVISOR (recovery logs, the
+    elastic_restarts_total restart-storm rule)."""
+    from deeplearning4j_tpu.parallel.elastic import (BackoffPolicy,
+                                                     ElasticJobSupervisor,
+                                                     WorkerSpec)
+
+    if args.log_json:
+        from deeplearning4j_tpu.observe import enable_structured_logging
+        if args.log_json == "-":
+            enable_structured_logging(stream=sys.stderr)
+        else:
+            enable_structured_logging(path=args.log_json)
+    alert_mgr = None
+    if args.alerts:
+        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
+                                                default_registry, load_rules)
+        alert_mgr = AlertManager(default_registry(),
+                                 load_rules(args.alerts), [LogSink()],
+                                 interval_s=5.0).start()
+
+    spec = WorkerSpec(argv=[
+        sys.executable, "-m", "deeplearning4j_tpu.parallel.elastic_worker",
+        "--modelPath", args.modelPath,
+        "--dataPath", args.dataPath,
+        "--out", args.modelOutputPath,
+        "--batchSize", str(args.batchSize),
+        "--epochs", str(args.epochs),
+    ])
+    supervisor = ElasticJobSupervisor(
+        spec, num_workers=args.elastic, min_workers=args.min_workers,
+        ckpt_dir=args.ckpt_dir,
+        backoff=BackoffPolicy(max_restarts=args.max_restarts),
+        heartbeat_timeout_s=args.heartbeat_timeout)
+    try:
+        result = supervisor.run()
+    finally:
+        if alert_mgr is not None:
+            alert_mgr.evaluate_once()
+            alert_mgr.stop()
+            firing = alert_mgr.firing()
+            print(f"alerts firing at exit: {firing if firing else 'none'}")
+        if args.log_json:
+            from deeplearning4j_tpu.observe import (
+                disable_structured_logging)
+            disable_structured_logging()
+    last = result.generations[-1]
+    print(f"elastic job {result.status}: {len(result.generations)} "
+          f"generation(s), {result.restarts_total} recovery event(s), "
+          f"final world {last.world} "
+          f"(min_workers={args.min_workers})")
+    print(f"wrote {args.modelOutputPath}")
+    return result
 
 
 def cluster_setup_main(argv: Optional[List[str]] = None, runner=None):

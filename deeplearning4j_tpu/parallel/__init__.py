@@ -38,6 +38,16 @@ from deeplearning4j_tpu.parallel.master import (  # noqa: F401
     TrainingStats,
     init_distributed,
 )
+from deeplearning4j_tpu.parallel.elastic import (  # noqa: F401
+    BackoffPolicy,
+    ElasticJobFailed,
+    ElasticJobResult,
+    ElasticJobSupervisor,
+    ElasticWorkerContext,
+    StaleGenerationError,
+    WorkerSpec,
+    run_elastic_worker,
+)
 from deeplearning4j_tpu.parallel.time_source import (  # noqa: F401
     NTPTimeSource,
     SystemClockTimeSource,

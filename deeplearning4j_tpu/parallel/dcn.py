@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import logging
 import struct
+import uuid
 from typing import Dict, Optional
 
 import numpy as np
@@ -54,6 +55,19 @@ class CrossSliceGradientBridge:
         # the param values as of the last exchange
         self._residual: Optional[Dict] = None
         self._prev: Optional[Dict] = None
+        # monotone per-endpoint frame sequence: receivers drop replays (a
+        # re-delivering broker, the duplicate_dcn fault) instead of
+        # applying the same update twice. The incarnation token makes a
+        # RESTARTED sender (elastic recovery rebuilds the bridge, seq
+        # back at 0) distinguishable from a replay — receivers reset the
+        # peer's high-water mark when it changes
+        self._seq = 0
+        self._incarnation = uuid.uuid4().hex[:8]
+        # slice -> {incarnation: high-water seq}; PER-incarnation marks
+        # (not just the latest) so a broker redelivering a frame from a
+        # peer's previous life is still dropped after that peer restarts.
+        # One entry per peer restart — bounded by restart budgets.
+        self._last_seq: Dict[str, Dict[str, int]] = {}
 
     # -- param-structure helpers (list of dicts = MLN, dict of dicts = CG) --
     @staticmethod
@@ -114,11 +128,24 @@ class CrossSliceGradientBridge:
                     total += len(msg)
         if total == 0:
             return 0  # nothing to say this round
-        header = json.dumps({"slice": self.slice_id,
+        seq = self._seq
+        # consume the seq BEFORE publishing: a publish that raises after
+        # the transport delivered the bytes must not lead to the next
+        # exchange reusing this number (receivers would drop it as a
+        # replay and the residual extracted below would be lost at every
+        # peer); receivers tolerate gaps — the dedup check is <=
+        self._seq = seq + 1
+        header = json.dumps({"slice": self.slice_id, "seq": seq,
+                             "inc": self._incarnation,
                              "threshold": self.threshold,
                              "sections": sections}).encode()
         frame = struct.pack(">I", len(header)) + header + b"".join(blobs)
-        self.publisher.publish(frame)  # may raise: residual then still intact
+        from deeplearning4j_tpu.util import faultinject
+        for out in faultinject.on_dcn_send(self.slice_id, seq, frame):
+            # an injected [] drops the frame IN TRANSIT: the sender has
+            # committed (seq consumed, residual extracted) exactly like a
+            # frame lost on the wire after a successful send
+            self.publisher.publish(out)  # may raise: residual still intact
         for r, msg in pending:
             if msg is None:
                 r[:] = 0.0  # dense payload carried the whole residual
@@ -154,6 +181,16 @@ class CrossSliceGradientBridge:
             if slice_tag == self.slice_id:
                 # own broadcast echoed back (broker fan-out); skip payload
                 continue
+            seq = meta.get("seq")
+            if seq is not None:
+                inc = meta.get("inc")
+                peer = self._last_seq.setdefault(slice_tag, {})
+                last = peer.get(inc)
+                if last is not None and int(seq) <= last:
+                    log.warning("Dropping duplicate frame %s from %s",
+                                seq, slice_tag)
+                    continue
+                peer[inc] = int(seq)
             if dense is None:
                 dense = {lk: {k: np.zeros(int(v.size), np.float32)
                               for k, v in layer.items()}

@@ -1,0 +1,68 @@
+"""Elastic worker entry point for CLI jobs.
+
+``python -m deeplearning4j_tpu.parallel.elastic_worker`` is what the
+``train --elastic N`` supervisor launches: it loads a serialized model
+and an ``.npz`` dataset, joins the generation's ``jax.distributed``
+world from the supervisor's environment (``parallel/elastic.py``), and
+runs the generic elastic runloop — restore, heartbeats, fenced rotation
+checkpoints, resume. Rank 0 of the generation that finishes training
+writes the final model zip to ``--out``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser("elastic-worker")
+    ap.add_argument("--modelPath", required=True,
+                    help="model zip written by ModelSerializer")
+    ap.add_argument("--dataPath", required=True,
+                    help=".npz with 'features' and 'labels' arrays")
+    ap.add_argument("--out", required=True,
+                    help="final model zip (written by rank 0)")
+    ap.add_argument("--batchSize", type=int, default=32)
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--threshold", type=float, default=1e-3)
+    ap.add_argument("--checkpoint-every", type=int, default=1,
+                    dest="checkpoint_every")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.parallel.elastic import run_elastic_worker
+    from deeplearning4j_tpu.util import model_serializer
+
+    z = np.load(args.dataPath)
+    ds = DataSet(z["features"], z["labels"])
+
+    def build_model():
+        return model_serializer.restore_model(args.modelPath)
+
+    def build_iterator():
+        return ListDataSetIterator(ds, args.batchSize)
+
+    def on_done(net, ctx):
+        if ctx.process_id == 0:
+            directory = os.path.dirname(os.path.abspath(args.out))
+            os.makedirs(directory, exist_ok=True)
+            model_serializer.write_model(net, args.out)
+            print(f"[slot {ctx.slot}] wrote {args.out}", flush=True)
+
+    run_elastic_worker(
+        build_model, build_iterator, epochs=args.epochs,
+        master_kwargs={"batch_size_per_worker": args.batchSize,
+                       "threshold": args.threshold},
+        checkpoint_every=args.checkpoint_every,
+        on_done=on_done)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

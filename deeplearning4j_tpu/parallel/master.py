@@ -50,9 +50,26 @@ def init_distributed(coordinator_address: Optional[str] = None,
     `SharedTrainingWrapper.java:214-244`). No-op when single-process."""
     if num_processes is None or num_processes <= 1:
         return
+    _enable_cpu_collectives()
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+
+
+def _enable_cpu_collectives() -> None:
+    """The CPU backend has no native cross-process collectives (XLA raises
+    "Multiprocess computations aren't implemented on the CPU backend") —
+    route them through Gloo TCP. Must run before the backend initializes;
+    a value the operator set explicitly (flag or env) is left alone, and
+    on TPU the CPU-client setting is inert."""
+    try:
+        from jax._src import xla_bridge  # registers the flag
+        current = xla_bridge.CPU_COLLECTIVES_IMPLEMENTATION.value
+        if current in (None, "none") \
+                and not xla_bridge.backends_are_initialized():
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # noqa: BLE001 - older/newer jax: best effort only
+        pass
 
 
 class TrainingStats:
@@ -369,12 +386,27 @@ class SharedTrainingMaster(TrainingMaster):
                         [np.asarray(s.data) for s in shards], axis=0)
                 else:
                     arrays[f"res{i}"] = np.asarray(leaf)
-        np.savez(path, **scalars, **arrays)
+        # atomic: the elastic commit protocol (elastic.py save_checkpoint)
+        # treats this file's EXISTENCE as "shard landed" — a torn write
+        # from a mid-save kill must never be stampable as committed
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:  # handle, not path: savez would
+            np.savez(fh, **scalars, **arrays)  # append .npz to the name
+        os.replace(tmp, path)
 
     def load_state(self, path: str) -> None:
-        """Restore state written by :meth:`save_state` (same process rank,
-        same mesh shape — residual shards are rank-local). The residual is
-        re-placed lazily on the next ``execute_training`` call."""
+        """Restore state written by :meth:`save_state`.
+
+        Single-process meshes tolerate a WORKER-COUNT change (the
+        elastic-shrink restore path): the saved per-worker residual stack
+        is summed and spread evenly over the new worker stack, so the
+        un-transmitted gradient mass and the adapted threshold both
+        survive an N→N-1 world change. A mismatch in the per-parameter
+        shapes themselves (different architecture) still fails loudly.
+        Multi-process runs stay strict — residual shards are rank-local
+        and a shrunk world cannot see the dead rank's shard; skip
+        load_state there and re-accumulate. The residual is re-placed
+        lazily on the next ``execute_training`` call."""
         data = np.load(path)
         self.threshold = float(data["threshold"])
         self._steps_done = int(data["steps_done"])
@@ -421,10 +453,20 @@ class SharedTrainingMaster(TrainingMaster):
                     sharding, np.asarray(s, z.dtype))
             else:
                 if tuple(s.shape) != tuple(z.shape):
-                    raise ValueError(
-                        f"restored residual shape {s.shape} != {z.shape} — "
-                        "resuming on a different worker count drops "
-                        "residuals: skip load_state and re-accumulate")
+                    if tuple(s.shape[1:]) == tuple(z.shape[1:]):
+                        # mesh reshape (worker count changed, e.g. an
+                        # elastic shrink): conserve the un-transmitted
+                        # mass — sum the saved per-worker stack and
+                        # spread it evenly over the new one
+                        total = np.asarray(s, np.float64).sum(axis=0)
+                        s = np.broadcast_to(total / z.shape[0], z.shape)
+                    else:
+                        raise ValueError(
+                            f"restored residual shape {s.shape} != "
+                            f"{z.shape} — the checkpoint is from a "
+                            "different architecture, not just a different "
+                            "worker count: skip load_state and "
+                            "re-accumulate")
                 arr = jnp.asarray(np.asarray(s, z.dtype))
             placed.append(arr)
         return jax.tree_util.tree_unflatten(treedef, placed)

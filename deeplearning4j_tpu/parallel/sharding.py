@@ -47,6 +47,23 @@ def _is_attention(layer) -> bool:
     return isinstance(layer, SelfAttentionLayer)
 
 
+def _require_inferred_preprocessors(net) -> None:
+    """Pair-breaking reads the conf's preprocessor maps, and the INFERRED
+    half (automatic reshape boundaries) only exists after
+    ``conf.finalize()`` runs shape inference (ADVICE round 5: specs
+    computed before that could pair across a reshape and silently gather
+    the activation path). Both network constructors finalize, so this
+    only trips for hand-built configuration objects — loudly."""
+    if getattr(net.conf, "_finalized", True) is False:
+        raise RuntimeError(
+            "tp_param_specs/shard_model need the conf's inferred input "
+            "preprocessors, which are computed by shape inference: call "
+            "net.init() (or conf.finalize()) before requesting "
+            "tensor-parallel specs — otherwise column/row pairs could "
+            "form across a reshape boundary and the all-gather-free "
+            "activation path is silently lost")
+
+
 def _layer_topology(net):
     """(key, layer, consumers) in forward order for both network kinds.
 
@@ -131,6 +148,7 @@ def tp_param_specs(net, axis: str = MODEL_AXIS, mesh: Optional[Mesh] = None):
     -degraded pair is worse than none: the sharded half's activation
     would be gathered anyway).
     """
+    _require_inferred_preprocessors(net)
     topo = _layer_topology(net)
     by_key = {k: layer for k, layer, _ in topo}
     roles: Dict[object, str] = {}

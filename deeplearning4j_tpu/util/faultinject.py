@@ -1,0 +1,294 @@
+"""Deterministic fault injection: make failure paths CI-provable.
+
+The elastic supervisor (``parallel/elastic.py``) exists to survive
+worker death, heartbeat stalls, torn checkpoints and lossy DCN links —
+none of which occur naturally on a clean CI host. This module injects
+those faults *deterministically* from a JSON ``FaultPlan`` so the
+recovery choreography is exercised by ordinary subprocess CPU tests
+instead of being demo-only:
+
+- ``kill``: SIGKILL the worker process the moment it reports step S —
+  a preemption without grace (the reference's fixed-membership design,
+  ``SharedTrainingWrapper.java:131-156``, simply dies here).
+- ``stall``: block inside step S (training and heartbeats both stop) —
+  a hung host; the supervisor's heartbeat watchdog must kill + recover.
+- ``stall_heartbeat``: suppress heartbeats from step S on while training
+  continues — a partitioned/zombie worker; the supervisor must fence it.
+- ``corrupt_checkpoint``: truncate or overwrite checkpoint files right
+  after the save at step S commits — exercises the restore-time
+  integrity fallback (``OrbaxCheckpointManager.restore(fallback=True)``).
+  The checkpoint is a world-level artifact written by whichever rank is
+  0 at that step, so this fault matches on ``step`` alone (``worker``
+  is accepted but ignored).
+- ``drop_dcn`` / ``duplicate_dcn``: drop or duplicate the Nth outbound
+  cross-slice gradient frame (``parallel/dcn.py``) — lossy UDP-ish
+  transport semantics.
+
+Activation: set ``DL4J_TPU_FAULT_PLAN`` to a plan file path (or inline
+JSON) before the process starts. When the variable is unset every hook
+is a single-``is None``-check no-op — the production hot path pays one
+attribute load and a comparison, nothing else.
+
+Faults are keyed on (worker slot, step/seq): pure functions of training
+progress, so a plan replays identically on every run — which is what
+lets tests assert exact recovery points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Dict, List, Optional
+
+ENV_VAR = "DL4J_TPU_FAULT_PLAN"
+
+FAULT_TYPES = ("kill", "stall", "stall_heartbeat", "corrupt_checkpoint",
+               "drop_dcn", "duplicate_dcn")
+CORRUPT_MODES = ("truncate", "garbage", "delete")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One planned fault. ``worker`` is the elastic SLOT id (stable across
+    restarts and renumbering), ``step`` the global training iteration (or
+    checkpoint step for ``corrupt_checkpoint``, frame sequence number for
+    the DCN faults)."""
+
+    type: str
+    worker: object  # int slot, or "*" for any worker
+    step: int
+    mode: str = "truncate"        # corrupt_checkpoint only
+    duration_s: float = 3600.0    # stall only
+    signum: int = int(signal.SIGKILL)
+
+    def matches(self, worker, step: int) -> bool:
+        return (self.worker == "*" or self.worker == worker) \
+            and int(step) == int(self.step)
+
+
+class FaultPlan:
+    """A validated list of :class:`Fault` entries."""
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+
+    # -- construction / validation --------------------------------------
+    @classmethod
+    def parse(cls, spec) -> "FaultPlan":
+        """Build from a parsed dict; raises ``ValueError`` with the
+        offending fault index on any schema problem."""
+        if not isinstance(spec, dict) or "faults" not in spec:
+            raise ValueError(
+                "fault plan must be an object with a 'faults' list")
+        raw = spec["faults"]
+        if not isinstance(raw, list):
+            raise ValueError("'faults' must be a list")
+        faults = []
+        for i, f in enumerate(raw):
+            if not isinstance(f, dict):
+                raise ValueError(f"fault[{i}]: must be an object")
+            unknown = set(f) - {"type", "worker", "step", "mode",
+                                "duration_s", "signal"}
+            if unknown:
+                raise ValueError(
+                    f"fault[{i}]: unknown field(s) {sorted(unknown)}")
+            ftype = f.get("type")
+            if ftype not in FAULT_TYPES:
+                raise ValueError(
+                    f"fault[{i}]: unknown type {ftype!r} "
+                    f"(one of {', '.join(FAULT_TYPES)})")
+            worker = f.get("worker", "*")
+            ok = worker == "*" or (isinstance(worker, int) and worker >= 0) \
+                or (isinstance(worker, str) and worker)
+            if not ok:
+                raise ValueError(
+                    f"fault[{i}]: worker must be a slot index >= 0, a "
+                    f"slice-id string, or '*', got {worker!r}")
+            step = f.get("step")
+            if not isinstance(step, int) or step < 0:
+                raise ValueError(
+                    f"fault[{i}]: step must be an int >= 0, got {step!r}")
+            mode = f.get("mode", "truncate")
+            if ftype == "corrupt_checkpoint" and mode not in CORRUPT_MODES:
+                raise ValueError(
+                    f"fault[{i}]: corrupt mode {mode!r} "
+                    f"(one of {', '.join(CORRUPT_MODES)})")
+            duration = f.get("duration_s", 3600.0)
+            if not isinstance(duration, (int, float)) or duration < 0:
+                raise ValueError(
+                    f"fault[{i}]: duration_s must be >= 0, got {duration!r}")
+            signame = f.get("signal", "KILL")
+            try:
+                signum = int(getattr(signal, f"SIG{signame}"))
+            except (AttributeError, TypeError):
+                raise ValueError(
+                    f"fault[{i}]: unknown signal {signame!r}") from None
+            faults.append(Fault(type=ftype, worker=worker, step=step,
+                                mode=mode, duration_s=float(duration),
+                                signum=signum))
+        return cls(faults)
+
+    @classmethod
+    def load(cls, spec: str) -> "FaultPlan":
+        """From a file path or an inline JSON string."""
+        text = spec
+        if not spec.lstrip().startswith("{"):
+            with open(spec, "r", encoding="utf-8") as fh:
+                text = fh.read()
+        return cls.parse(json.loads(text))
+
+    def lint(self) -> List[str]:
+        """Dry-run lint (no fault is executed): duplicate triggers and
+        shadowed entries that can never fire."""
+        problems: List[str] = []
+        seen: Dict[tuple, int] = {}
+        for i, f in enumerate(self.faults):
+            key = (f.type, f.worker, f.step)
+            if key in seen:
+                problems.append(
+                    f"fault[{i}] duplicates fault[{seen[key]}]: "
+                    f"{f.type} worker={f.worker} step={f.step}")
+            seen[key] = i
+        # a kill/stall at step S shadows any later-step fault on the same
+        # worker within the same generation
+        fatal = {}
+        for i, f in enumerate(self.faults):
+            if f.type in ("kill", "stall") and f.worker != "*":
+                cur = fatal.get(f.worker)
+                if cur is None or f.step < cur[1]:
+                    fatal[f.worker] = (i, f.step)
+        for i, f in enumerate(self.faults):
+            if f.worker == "*" or f.type in ("kill", "stall"):
+                continue
+            hit = fatal.get(f.worker)
+            if hit is not None and f.step > hit[1] \
+                    and f.type in ("stall_heartbeat",):
+                problems.append(
+                    f"fault[{i}] ({f.type} worker={f.worker} step={f.step}) "
+                    f"can never fire: fault[{hit[0]}] kills/stalls that "
+                    f"worker at step {hit[1]} first")
+        return problems
+
+    def find(self, ftype: str, worker, step: int) -> Optional[Fault]:
+        for f in self.faults:
+            if f.type == ftype and f.matches(worker, step):
+                return f
+        return None
+
+
+# -- process-wide activation -------------------------------------------------
+
+_plan: Optional[FaultPlan] = None
+if os.environ.get(ENV_VAR):
+    _plan = FaultPlan.load(os.environ[ENV_VAR])
+
+# injectable for tests: on_step's kill must be observable without dying
+_kill = os.kill
+_sleep = time.sleep
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Activate a plan in-process (tests); ``None`` deactivates."""
+    global _plan
+    _plan = plan
+
+
+# -- hooks (each begins with the single is-None check) -----------------------
+
+def on_step(worker, step: int) -> None:
+    """Call once per completed training iteration. May not return (kill)."""
+    if _plan is None:
+        return
+    f = _plan.find("kill", worker, step)
+    if f is not None:
+        _kill(os.getpid(), f.signum)
+        return
+    f = _plan.find("stall", worker, step)
+    if f is not None:
+        _sleep(f.duration_s)
+
+
+def on_heartbeat(worker, step: int) -> bool:
+    """True → emit the heartbeat; False → suppress it (zombie worker).
+    Suppression is sticky from the configured step onward — a stalled
+    heartbeat does not resume."""
+    if _plan is None:
+        return True
+    for f in _plan.faults:
+        if f.type == "stall_heartbeat" \
+                and (f.worker == "*" or f.worker == worker) \
+                and int(step) >= int(f.step):
+            return False
+    return True
+
+
+def on_checkpoint_saved(worker, step: int, directory: str) -> None:
+    """Call right after a checkpoint at ``step`` commits under
+    ``directory``; applies any planned corruption to the files just
+    written. The model checkpoint is a WORLD-level artifact written by
+    whichever rank is 0 when step ``step`` commits, so the fault's
+    ``worker`` field is ignored here — matching on it would make a
+    fault targeting a non-rank-0 slot silently never fire."""
+    if _plan is None:
+        return
+    for f in _plan.faults:
+        if f.type == "corrupt_checkpoint" and int(step) == int(f.step):
+            corrupt_checkpoint(directory, mode=f.mode)
+            return
+
+
+def on_dcn_send(worker, seq: int, frame: bytes) -> List[bytes]:
+    """Transform one outbound DCN frame: ``[]`` drops it, two copies
+    duplicate it, ``[frame]`` passes through."""
+    if _plan is None:
+        return [frame]
+    if _plan.find("drop_dcn", worker, seq) is not None:
+        return []
+    if _plan.find("duplicate_dcn", worker, seq) is not None:
+        return [frame, frame]
+    return [frame]
+
+
+# -- shared corruption implementation ---------------------------------------
+
+def corrupt_checkpoint(path: str, mode: str = "truncate") -> List[str]:
+    """Damage a checkpoint on disk; returns the files touched.
+
+    ``path`` may be a single file (zip checkpoint) or a directory (an
+    orbax step dir) — directories are walked and every regular file
+    is damaged, so the restore cannot quietly succeed off an
+    untouched shard. Modes: ``truncate`` (keep the first half),
+    ``garbage`` (overwrite the middle with 0xFF), ``delete`` (unlink).
+    """
+    if mode not in CORRUPT_MODES:
+        raise ValueError(f"unknown corrupt mode {mode!r}")
+    targets: List[str] = []
+    if os.path.isdir(path):
+        for root, _dirs, files in os.walk(path):
+            targets.extend(os.path.join(root, f) for f in sorted(files))
+    elif os.path.exists(path):
+        targets.append(path)
+    else:
+        raise FileNotFoundError(f"no checkpoint at {path}")
+    touched = []
+    for t in targets:
+        if mode == "delete":
+            os.unlink(t)
+            touched.append(t)
+            continue
+        size = os.path.getsize(t)
+        with open(t, "r+b") as fh:
+            if mode == "truncate":
+                fh.truncate(max(0, size // 2))
+            else:  # garbage
+                fh.seek(max(0, size // 4))
+                fh.write(b"\xff" * max(1, size // 2))
+        touched.append(t)
+    return touched

@@ -24,6 +24,7 @@ from __future__ import annotations
 import io
 import json
 import zipfile
+import zlib
 from pathlib import Path
 from typing import Optional, Union
 
@@ -131,18 +132,56 @@ def _load_npz(z: zipfile.ZipFile, name: str) -> Optional[dict]:
         return {k: data[k] for k in data.files}
 
 
+def validate_model_zip(path: Union[str, Path]) -> list:
+    """Integrity check for a zip checkpoint; returns a list of problems
+    (empty = valid). Catches the torn-write failure modes a preemption
+    (or the fault injector's ``corrupt_checkpoint``) produces: not a zip
+    at all, truncated central directory, CRC damage in a required member,
+    or required members missing entirely."""
+    problems = []
+    try:
+        with zipfile.ZipFile(path, "r") as z:
+            for required in (CONFIG_NAME, PARAMS_NAME):
+                if required not in z.namelist():
+                    problems.append(f"missing required entry {required!r}")
+            try:
+                bad = z.testzip()
+            except Exception as e:  # noqa: BLE001 - zlib.error, EOFError...
+                # testzip only RETURNS names for CRC mismatches; damage to
+                # the compressed stream itself raises from the inflater
+                bad, problems = None, problems + [f"undecodable entry: {e}"]
+            if bad is not None:
+                problems.append(f"CRC mismatch in entry {bad!r}")
+    except (zipfile.BadZipFile, OSError) as e:
+        problems.append(f"unreadable zip: {e}")
+    return problems
+
+
 def _restore(path: Union[str, Path], *, load_updater: bool = True):
     from deeplearning4j_tpu.nn.conf.graph_conf import ComputationGraphConfiguration
     from deeplearning4j_tpu.nn.conf.network import MultiLayerConfiguration
     from deeplearning4j_tpu.nn.graph import ComputationGraph
     from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 
-    with zipfile.ZipFile(path, "r") as z:
-        conf_d = json.loads(z.read(CONFIG_NAME))
-        params = _load_npz(z, PARAMS_NAME)
-        upd = _load_npz(z, UPDATER_NAME) if load_updater else None
-        states = _load_npz(z, STATES_NAME)
-        meta = json.loads(z.read(META_NAME)) if META_NAME in z.namelist() else {}
+    try:
+        # zipfile verifies each member's CRC as it is read, so damage
+        # surfaces here for free — the full validate_model_zip scan
+        # (a second decompression of every member) runs only on the
+        # failure path, to name the problem in the error
+        with zipfile.ZipFile(path, "r") as z:
+            conf_d = json.loads(z.read(CONFIG_NAME))
+            params = _load_npz(z, PARAMS_NAME)
+            upd = _load_npz(z, UPDATER_NAME) if load_updater else None
+            states = _load_npz(z, STATES_NAME)
+            meta = json.loads(z.read(META_NAME)) \
+                if META_NAME in z.namelist() else {}
+    except (zipfile.BadZipFile, zlib.error, EOFError, KeyError,
+            OSError) as e:
+        problems = validate_model_zip(path)
+        raise ValueError(
+            f"checkpoint {path} failed integrity validation: "
+            + ("; ".join(problems) if problems else str(e))
+            + " — the file is truncated/corrupt or not a model zip") from e
 
     is_graph = "ComputationGraph" in conf_d.get("format", "")
     if is_graph:
@@ -155,6 +194,12 @@ def _restore(path: Union[str, Path], *, load_updater: bool = True):
 
     def put(container, key, pn, arr):
         tgt = container[key] if isinstance(container, dict) else container[int(key)]
+        if pn in tgt and tuple(tgt[pn].shape) != tuple(arr.shape):
+            raise ValueError(
+                f"checkpoint {path}: array {key}/{pn} has shape "
+                f"{tuple(arr.shape)} but the configuration allocates "
+                f"{tuple(tgt[pn].shape)} — checkpoint and config disagree "
+                f"(wrong file, or corrupt)")
         tgt[pn] = jnp.asarray(arr)
 
     for full, arr in params.items():

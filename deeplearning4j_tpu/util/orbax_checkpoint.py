@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -252,31 +252,99 @@ class OrbaxCheckpointManager:
                                           options=self._options)
         self._meta_written = False
 
-    def save(self, step: int, model, *, save_updater: bool = True) -> bool:
+    def save(self, step: int, model, *, save_updater: bool = True,
+             overwrite_existing: bool = False) -> bool:
         """Save at ``step`` (skipped when the interval says so; returns
-        whether a save happened)."""
+        whether a save happened).
+
+        ``overwrite_existing=True``: orbax returns False (writing
+        NOTHING) when a finalized dir for ``step`` already exists — e.g.
+        a corrupt leftover a fallback restore walked past. The elastic
+        commit path must not re-advertise those bytes as freshly saved,
+        so this deletes the stale step dir and saves again."""
         import orbax.checkpoint as ocp
+
+        def _save():
+            return self._mgr.save(
+                step, args=ocp.args.StandardSave(
+                    _state_pytree(model, with_updater=save_updater)))
+
         if not self._meta_written:
             _write_meta(model, self.directory)
             self._meta_written = True
-        return self._mgr.save(
-            step, args=ocp.args.StandardSave(
-                _state_pytree(model, with_updater=save_updater)))
+        ok = _save()
+        if not ok and overwrite_existing \
+                and int(step) in set(self.all_steps()):
+            import shutil
+            shutil.rmtree(os.path.join(self.directory, str(int(step))),
+                          ignore_errors=True)
+            if hasattr(self._mgr, "reload"):
+                self._mgr.reload()  # drop the cached step list
+            ok = _save()
+        return ok
 
     def all_steps(self) -> List[int]:
-        return list(self._mgr.all_steps())
+        """Steps currently retained by the rotation, ascending."""
+        return sorted(int(s) for s in self._mgr.all_steps())
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    #: step actually restored by the last :meth:`restore` call — differs
+    #: from the requested step when ``fallback`` walked to an older one
+    restored_step: Optional[int] = None
+
     def restore(self, step: Optional[int] = None, *,
-                load_updater: bool = True):
-        """Restore the model at ``step`` (default: latest)."""
-        import orbax.checkpoint as ocp
+                load_updater: bool = True, fallback: bool = False,
+                fallback_steps: Optional[Sequence[int]] = None):
+        """Restore the model at ``step`` (default: latest).
+
+        ``fallback=True`` is the integrity-tolerant path: when the chosen
+        step is truncated/corrupt (a preemption mid-write, a fault-
+        injected torn checkpoint), restore walks back through the older
+        retained steps instead of failing — the rotation (``max_to_keep``)
+        exists precisely so the previous good step survives. The step
+        actually used is recorded in :attr:`restored_step`. Without
+        fallback a damaged checkpoint fails fast with a clear error.
+
+        ``fallback_steps`` restricts the walk to an allow-list (the
+        elastic supervisor passes its fence-eligible steps: an orbax dir
+        may hold steps a zombie generation wrote after its fence, and the
+        fallback must not resurrect them)."""
+        steps = sorted(self._mgr.all_steps())
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
             raise ValueError(f"no checkpoints in {self.directory}")
+        candidates = [step]
+        if fallback:
+            pool = steps if fallback_steps is None else \
+                [s for s in steps if s in set(int(x) for x in fallback_steps)]
+            candidates += [s for s in reversed(pool) if s < step]
+        errors = []
+        for s in candidates:
+            try:
+                model = self._restore_step(s, load_updater)
+            except Exception as e:  # noqa: BLE001 - orbax raises many kinds
+                errors.append(f"step {s}: {type(e).__name__}: {e}")
+                if not fallback:
+                    raise ValueError(
+                        f"checkpoint step {s} in {self.directory} is "
+                        f"unrestorable (truncated or corrupt?): {e}") from e
+                continue
+            self.restored_step = s
+            if errors:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "Restored checkpoint step %s after newer step(s) "
+                    "failed integrity: %s", s, "; ".join(errors))
+            return model
+        raise ValueError(
+            f"no restorable checkpoint in {self.directory}: "
+            + "; ".join(errors))
+
+    def _restore_step(self, step: int, load_updater: bool):
+        import orbax.checkpoint as ocp
         model = _build_model(self.directory)
         template = _template_for(model, self._mgr.item_metadata(step))
         state = self._mgr.restore(

@@ -171,6 +171,17 @@ class PreemptionHandler:
         return False
 
     def arm(self) -> "PreemptionHandler":
+        if threading.current_thread() is not threading.main_thread():
+            # signal.signal would raise a bare ValueError("signal only
+            # works in main thread") — say what to do instead
+            raise RuntimeError(
+                "PreemptionHandler.arm() must be called from the main "
+                "thread: CPython only delivers signal handlers there. "
+                "From a worker/background thread, either arm the handler "
+                "on the main thread before spawning, or supervise the "
+                "training process externally with ElasticJobSupervisor "
+                "(deeplearning4j_tpu.parallel.elastic), which handles "
+                "SIGKILL-style death no in-process handler can see")
         for s in self.signals:
             self._previous[s] = signal.signal(s, self._handle)
         # safe-point hook: complete deferred saves between training steps

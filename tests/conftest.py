@@ -26,6 +26,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "smoke: cheap end-to-end harness checks run on every CI tier")
+    config.addinivalue_line(
+        "markers",
+        "multiprocess: spawns real OS worker processes (jax.distributed "
+        "or the elastic supervisor); every such test carries a hard "
+        "subprocess timeout/deadline so a hung worker cannot wedge CI")
 
 
 @pytest.fixture

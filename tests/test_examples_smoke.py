@@ -50,6 +50,36 @@ def test_shipped_alert_rules_lint_clean():
     assert proc.stdout.startswith("OK"), proc.stdout
 
 
+def test_shipped_elastic_alert_rules_lint_clean():
+    """The restart-storm / shrunk-world rules shipped for the elastic
+    supervisor pass the same rule validator."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_alert_rules.py"),
+         os.path.join(EXAMPLES_DIR, "elastic_alert_rules.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
+def test_shipped_fault_plan_lints_clean():
+    """The example ``DL4J_TPU_FAULT_PLAN`` ships lint-clean through
+    ``tools/validate_fault_plan.py`` (schema + dry run, no fault executed)
+    — the alert-rules validator convention for the fault harness."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_fault_plan.py"),
+         "--workers", "3",
+         os.path.join(EXAMPLES_DIR, "fault_plan.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs_clean(script):
     env = dict(

@@ -299,10 +299,13 @@ class TestMasterStateCheckpoint:
                 np.testing.assert_array_equal(np.asarray(pa[k]),
                                               np.asarray(pb[k]))
 
-    def test_load_state_shape_mismatch_raises(self, tmp_path):
-        """Resuming residuals on a different worker count must fail loudly
-        (skip load_state to re-accumulate instead)."""
-        import pytest
+    def test_load_state_worker_count_reshape_trains(self, tmp_path):
+        """Since round 10 (elastic shrink) a checkpoint from a DIFFERENT
+        worker count loads and trains: the saved per-worker residual
+        stack is summed and spread over the new stack, conserving the
+        un-transmitted gradient mass (exact-mass + adapted-threshold
+        semantics locked in tests/test_elastic.py; an ARCHITECTURE
+        mismatch still fails loudly there too)."""
         ds = _data(64)
         m = SharedTrainingMaster(batch_size_per_worker=8, threshold=1e-3,
                                  mesh=make_mesh({"data": 8}))
@@ -313,9 +316,10 @@ class TestMasterStateCheckpoint:
         m4 = SharedTrainingMaster(batch_size_per_worker=8, threshold=1e-3,
                                   mesh=make_mesh({"data": 4}))
         m4.load_state(path)
+        assert m4.threshold == m.threshold
         net4 = _net(lr=0.05)
-        with pytest.raises(ValueError, match="worker count"):
-            DistributedMultiLayerNetwork(net4, m4).fit([ds])
+        DistributedMultiLayerNetwork(net4, m4).fit([ds])
+        assert net4.iteration > 0
 
     def test_orbax_restored_model_trains_under_master(self, tmp_path):
         """Orbax-restored params arrive COMMITTED to one device; the

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Fault-plan file validator: schema check + dry-run lint.
+
+Validates a ``DL4J_TPU_FAULT_PLAN`` file (the ``util.faultinject``
+``FaultPlan`` schema) the same way ``tools/validate_alert_rules.py``
+validates alert rules: importable (``validate_file``/``validate_plan``
+return a list of problems, empty = valid) and runnable
+(``python tools/validate_fault_plan.py PLAN.json [...]``).
+
+Two passes:
+
+1. **schema** — the file must build through ``FaultPlan.parse`` (unknown
+   fault types, bad workers/steps/modes/signals all surface here with
+   the offending fault index);
+2. **dry run** — ``FaultPlan.lint`` flags plans that parse but cannot
+   behave as written: duplicate triggers, and faults shadowed by an
+   earlier kill/stall of the same worker. No fault is executed.
+
+``--workers N`` additionally checks that every integer worker slot is
+inside the job's initial world.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from deeplearning4j_tpu.util.faultinject import FaultPlan  # noqa: E402
+
+
+def validate_plan(spec, num_workers: Optional[int] = None) -> List[str]:
+    """Return a list of problems (empty = valid). ``spec`` is a parsed
+    dict, a JSON string, or a path."""
+    try:
+        if isinstance(spec, dict):
+            plan = FaultPlan.parse(spec)
+        else:
+            plan = FaultPlan.load(spec)
+    except (ValueError, KeyError, TypeError, OSError,
+            json.JSONDecodeError) as e:
+        return [f"schema: {e}"]
+    if not plan.faults:
+        return ["schema: no faults defined"]
+    errors = [f"lint: {p}" for p in plan.lint()]
+    if num_workers is not None:
+        for i, f in enumerate(plan.faults):
+            if isinstance(f.worker, int) and f.worker >= num_workers:
+                errors.append(
+                    f"lint: fault[{i}] targets worker {f.worker} but the "
+                    f"job starts with {num_workers} workers "
+                    f"(slots 0..{num_workers - 1})")
+    return errors
+
+
+def validate_file(path: str,
+                  num_workers: Optional[int] = None) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable plan file: {e}"]
+    return validate_plan(spec, num_workers)
+
+
+def main(argv: List[str]) -> int:
+    num_workers = None
+    if "--workers" in argv:
+        i = argv.index("--workers")
+        try:
+            num_workers = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--workers needs an integer")
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if not argv:
+        print("usage: validate_fault_plan.py [--workers N] PLAN.json "
+              "[PLAN.json ...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_file(path, num_workers)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            n = len(FaultPlan.load(path).faults)
+            print(f"OK   {path}: {n} fault(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
