@@ -582,15 +582,192 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     return server
 
 
+def pipeline_main(argv: Optional[List[str]] = None):
+    """``pipeline`` subcommand: the continuous-training loop
+    (``deeplearning4j_tpu/pipeline/``) as a self-contained product —
+    register the saved model as the serving baseline, stream the dataset
+    through mini-epoch retraining, gate the candidate on a held-out
+    split, canary it at ramped traffic fractions (self-driven synthetic
+    traffic from the eval split; a production deployment attaches a
+    ModelServer and real traffic), and auto-promote or roll back.  The
+    journal under ``--state-dir`` makes the run crash-safe: re-running
+    the same command after a kill resumes at the crashed stage
+    (``DL4J_TPU_FAULT_PLAN`` with worker ``"pipeline"`` injects such
+    kills deterministically).  SIGTERM drains cleanly: the open run is
+    decided as a journaled rollback instead of dying mid-stage."""
+    import signal
+
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu pipeline")
+    p.add_argument("--modelPath", required=True,
+                   help="serving baseline (model zip / DL4J / Keras h5)")
+    p.add_argument("--dataPath", required=True,
+                   help=".npz with 'features' and 'labels': the stream "
+                        "source and (split off) the held-out eval set")
+    p.add_argument("--config", required=True, metavar="PIPELINE.json",
+                   help="pipeline config (schema: pipeline.PipelineConfig; "
+                        "lint with tools/validate_pipeline_config.py)")
+    p.add_argument("--state-dir", required=True, dest="state_dir",
+                   help="journal + candidate-checkpoint directory (the "
+                        "crash-recovery substrate; reuse it to resume)")
+    p.add_argument("--eval-fraction", type=float, default=0.2,
+                   dest="eval_fraction",
+                   help="tail fraction of the dataset held out for the "
+                        "eval gate (never streamed)")
+    p.add_argument("--cycles", type=int, default=None,
+                   help="pipeline runs to execute (default: config)")
+    p.add_argument("--modelOutputPath", default=None,
+                   help="write the final serving model here on exit")
+    p.add_argument("--log-json", default=None, metavar="OUT.jsonl",
+                   dest="log_json",
+                   help="structured JSON-lines logging with trace "
+                        "correlation to this file ('-' for stderr)")
+    p.add_argument("--alerts", default=None, metavar="RULES.json",
+                   help="alert rules evaluated against the pipeline's "
+                        "metrics registry; firing rules roll a canary "
+                        "back (config canary.abort_on_alerts)")
+    p.add_argument("--alert-interval", type=float, default=5.0,
+                   help="seconds between alert evaluation rounds")
+    # in-process-only flags are rejected, not silently ignored — the
+    # same contract train --elastic applies to its worker processes
+    p.add_argument("--trace", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--watchdog", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--uiUrl", default=None, help=argparse.SUPPRESS)
+    p.add_argument("--workers", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    unsupported = [flag for flag, hit in (
+        ("--trace", args.trace is not None),
+        ("--watchdog", args.watchdog is not None),
+        ("--uiUrl", args.uiUrl is not None),
+        ("--workers", args.workers is not None),
+    ) if hit]
+    if unsupported:
+        p.error(f"{', '.join(unsupported)} affect(s) in-process training "
+                "and is not a pipeline flag: the watchdog is configured "
+                "in the pipeline config (train.watchdog) and tracing/UI "
+                "belong to the train subcommand. --log-json and --alerts "
+                "ARE supported (they observe the pipeline)")
+    if not 0.0 < args.eval_fraction < 1.0:
+        p.error(f"--eval-fraction must be in (0, 1), "
+                f"got {args.eval_fraction}")
+
+    import time
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.observe.metrics import default_registry
+    from deeplearning4j_tpu.pipeline import (ContinuousPipeline,
+                                             PipelineConfig, StreamBuffer)
+    from deeplearning4j_tpu.serving import ModelRegistry
+    from deeplearning4j_tpu.streaming import Route
+    from deeplearning4j_tpu.util import model_serializer
+
+    config = PipelineConfig.parse(args.config)
+    if args.log_json:
+        from deeplearning4j_tpu.observe import enable_structured_logging
+        if args.log_json == "-":
+            enable_structured_logging(stream=sys.stderr)
+        else:
+            enable_structured_logging(path=args.log_json)
+    metrics = default_registry()
+    alert_mgr = None
+    if args.alerts:
+        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
+                                                load_rules)
+        alert_mgr = AlertManager(metrics, load_rules(args.alerts),
+                                 [LogSink()],
+                                 interval_s=args.alert_interval).start()
+
+    z = np.load(args.dataPath)
+    features = np.asarray(z["features"], np.float32)
+    labels = np.asarray(z["labels"], np.float32)
+    n_eval = max(1, int(len(features) * args.eval_fraction))
+    eval_set = DataSet(features[-n_eval:], labels[-n_eval:])
+    stream_x, stream_y = features[:-n_eval], labels[:-n_eval]
+
+    registry = ModelRegistry(metrics=metrics, wait_ms=1.0)
+    registry.register(config.name, path=args.modelPath,
+                      sample_input=features[:1])
+
+    bs = config.train["batch_size"]
+    batches = [DataSet(stream_x[i:i + bs], stream_y[i:i + bs])
+               for i in range(0, len(stream_x), bs)]
+    cycles = args.cycles if args.cycles is not None else config.cycles
+    # hold every cycle's pass outright (buffer stores references to the
+    # already-materialized batch list): a cycle that drains less than a
+    # full pass must not leave a later cycle's route blocked in put()
+    buffer = StreamBuffer(
+        capacity=max(1024, (cycles + 1) * max(1, len(batches))))
+
+    def canary_traffic(poll_s):
+        # self-driven canary traffic so weighted routing and shadow
+        # diffs observe real forwards between ticks
+        for i in range(4):
+            registry.predict(config.name,
+                             eval_set.features[i % n_eval:][:2])
+        time.sleep(poll_s)
+
+    pipe = ContinuousPipeline(
+        registry, config.name, args.state_dir, config=config,
+        buffer=buffer, eval_set=eval_set, metrics=metrics,
+        alerts=alert_mgr, sample_input=features[:1],
+        canary_wait=canary_traffic)
+    signal.signal(signal.SIGTERM, lambda *a: pipe.request_stop())
+    # a restarted process registers the ORIGINAL artifact as baseline;
+    # if the journal already committed a promotion, re-apply it so the
+    # resumed pipeline (and --modelOutputPath) serve the promoted weights
+    restored = pipe.restore_promoted()
+    if restored is not None:
+        print(f"restored journaled promotion as v{restored}")
+
+    try:
+        # ONE stream pass per cycle (a real deployment points the route
+        # at a broker): replaying all passes up front would let the
+        # trainer's greedy drain starve later cycles into aborted runs
+        summaries = []
+        for _ in range(cycles):
+            route = (Route().from_source(list(batches))
+                     .to_callable(buffer.put).start())
+            pipe.route = route
+            summaries.append(pipe.run_cycle())
+            route.join(timeout=60)
+            if pipe.stopped:
+                break
+    finally:
+        if alert_mgr is not None:
+            alert_mgr.evaluate_once()
+            alert_mgr.stop()
+            firing = alert_mgr.firing()
+            print(f"alerts firing at exit: {firing if firing else 'none'}")
+        registry.shutdown()
+        if args.log_json:
+            from deeplearning4j_tpu.observe import (
+                disable_structured_logging)
+            disable_structured_logging()
+    for s in summaries:
+        print(f"run {s['run']}: {s['outcome']} "
+              f"(live version {s['live_version']})")
+    if args.modelOutputPath:
+        served = registry.get(config.name)
+        model_serializer.write_model(
+            served.versions[served.current_version].model,
+            args.modelOutputPath)
+        print(f"wrote {args.modelOutputPath}")
+    return summaries
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m deeplearning4j_tpu.cli "
-              "{train,evaluate,serve,nn-server,cloud-setup,profile} ...")
+              "{train,evaluate,serve,pipeline,nn-server,cloud-setup,"
+              "profile} ...")
         return 0 if argv else 2
     cmd, rest = argv[0], argv[1:]
     if cmd == "serve":
         serve_main(rest)
+        return 0
+    if cmd == "pipeline":
+        pipeline_main(rest)
         return 0
     if cmd == "train":
         parallel_wrapper_main(rest)
@@ -614,7 +791,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         cluster_setup_main(rest)
         return 0
     print(f"unknown command {cmd!r}; expected 'train', 'evaluate', "
-          "'serve', 'nn-server', 'cloud-setup', or 'profile'")
+          "'serve', 'pipeline', 'nn-server', 'cloud-setup', or 'profile'")
     return 2
 
 

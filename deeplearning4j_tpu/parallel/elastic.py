@@ -57,6 +57,8 @@ import sys
 import uuid
 from typing import Dict, List, Optional, Sequence
 
+from deeplearning4j_tpu.util.fsio import atomic_write_text as _atomic_write
+
 # Environment seam between supervisor and workers. Everything a worker
 # needs to join its generation arrives through these variables.
 ENV_COORDINATOR = "DL4J_TPU_ELASTIC_COORDINATOR"
@@ -81,13 +83,6 @@ def _free_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
-
-
-def _atomic_write(path: str, text: str) -> None:
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        fh.write(text)
-    os.replace(tmp, path)
 
 
 def _stamp_path(ckpt_dir: str, step: int) -> str:

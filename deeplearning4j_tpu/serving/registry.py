@@ -34,17 +34,41 @@ one-time costs, so no live request ever does —
   calibrated against ``sample_input`` at registration; the quantization
   error is recorded on the version and can gate registration
   (``quant_tolerance``).
+
+Canary deploys (the ``pipeline/`` subsystem's data plane):
+
+- **weighted routing**: ``set_traffic_split(name, {version: fraction})``
+  gives non-live versions deterministic fractions of un-pinned ``predict``
+  traffic (smooth weighted round-robin — no RNG, so tests and replays see
+  exact request counts); the live version serves the remainder through
+  the batching dispatcher.  The split is warm-gated: a version whose AOT
+  bucket warmup has not finished (or failed) is refused a fraction, so a
+  canary never puts a cold forward in front of traffic.
+  ``serving_canary_fraction{model,version}`` exports the live split
+  (cardinality bounded by the registry's own version history — one series
+  per version ever canaried, zeroed when the split clears).
+- **shadow mode**: ``set_shadow(name, version, sample=...)`` duplicates
+  every Nth live request to the candidate OFF the response path (a
+  bounded background queue; overflow drops the sample, never the
+  response) and diffs the outputs: ``shadow_requests_total{model}`` /
+  ``shadow_divergence_total{model}`` count the comparisons and the
+  out-of-tolerance ones, and a bounded in-memory divergence log keeps the
+  worst offenders for inspection.  Any hot-swap (promote, rollback)
+  clears both the split and the shadow — a new live version invalidates
+  the experiment.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceDeadlineExceeded, ParallelInference)
 from deeplearning4j_tpu.serving import quantize as _quantize
 
 
@@ -79,6 +103,10 @@ class ServedModel:
         self.name = name
         self.inference = inference
         self.versions: Dict[int, ModelVersion] = {}
+        # monotonic high-water mark: version numbers are never reused,
+        # even after unregister() — journals and per-version metric
+        # series must never conflate two different candidates
+        self.next_version = 1
         self.current_version: Optional[int] = None
         self.previous_version: Optional[int] = None
         # version -> warmup state:
@@ -89,6 +117,31 @@ class ServedModel:
         # version -> resolved (row_shape, dtype) spec, kept so rewarm()
         # can re-run a failed warmup without re-resolving the model
         self.warmup_spec: Dict[int, Optional[tuple]] = {}
+        # canary data plane: non-live version -> traffic fraction, plus
+        # the smooth-WRR accumulators that make routing deterministic
+        self.traffic_split: Dict[int, float] = {}
+        self._wrr_acc: Dict[int, float] = {}
+        # shadow experiment state (None when off); mutated under the
+        # registry lock, read by the shadow worker
+        self.shadow: Optional[dict] = None
+
+    def pick_weighted(self) -> int:
+        """Smooth weighted round-robin over {current + split versions}.
+        Called under the registry lock.  Deterministic: each version's
+        accumulator grows by its weight every request; the largest
+        accumulator serves and pays 1.  Ties break toward the heavier
+        weight (the live version on an even split), then the lower
+        version — no RNG anywhere, so a 0.25 split serves exactly 1 of
+        every 4 requests from the canary."""
+        weights = dict(self.traffic_split)
+        weights[self.current_version] = max(
+            0.0, 1.0 - sum(self.traffic_split.values()))
+        for v, w in weights.items():
+            self._wrr_acc[v] = self._wrr_acc.get(v, 0.0) + w
+        chosen = max(weights,
+                     key=lambda v: (self._wrr_acc[v], weights[v], -v))
+        self._wrr_acc[chosen] -= 1.0
+        return chosen
 
     def describe(self) -> dict:
         def _ver(v: ModelVersion) -> dict:
@@ -102,7 +155,7 @@ class ServedModel:
                 d["warmup"] = dict(w)
             return d
 
-        return {
+        d = {
             "name": self.name,
             "current_version": self.current_version,
             "previous_version": self.previous_version,
@@ -110,6 +163,18 @@ class ServedModel:
             "versions": [_ver(v) for v in sorted(self.versions.values(),
                                                  key=lambda m: m.version)],
         }
+        # a canary in flight is operator-visible: the /v1/models payload
+        # carries the live split and the shadow experiment's counters
+        if self.traffic_split:
+            d["traffic"] = [{"version": v, "fraction": f}
+                            for v, f in sorted(self.traffic_split.items())]
+        if self.shadow is not None:
+            s = self.shadow
+            d["shadow"] = {"version": s["version"], "sample": s["sample"],
+                           "requests": s["requests"],
+                           "divergences": s["divergences"],
+                           "dropped": s["dropped"]}
+        return d
 
 
 class ModelRegistry:
@@ -142,6 +207,14 @@ class ModelRegistry:
         self._swapping = 0  # >0 while a hot-swap is in progress (readiness)
         self._m_swaps = self._m_version = None
         self._m_warm_s = self._m_warm_n = None
+        self._m_canary = self._m_shadow_req = self._m_shadow_div = None
+        # shadow worker: ONE daemon + bounded queue per registry, started
+        # lazily; overflow drops the shadow sample, never the response
+        self._shadow_queue: "deque" = deque()
+        self._shadow_cv = threading.Condition()
+        self._shadow_inflight = 0
+        self._shadow_stop = False
+        self._shadow_thread: Optional[threading.Thread] = None
         if metrics is not None:
             self._m_swaps = metrics.counter(
                 "serving_model_swaps_total",
@@ -157,6 +230,18 @@ class ModelRegistry:
                 "serving_buckets_warm",
                 "Batch buckets of the LIVE version already compiled "
                 "(requests on them never trigger XLA)", ("model",))
+            self._m_canary = metrics.gauge(
+                "serving_canary_fraction",
+                "Traffic fraction routed to a non-live version "
+                "(0 when the split is cleared)", ("model", "version"))
+            self._m_shadow_req = metrics.counter(
+                "shadow_requests_total",
+                "Live requests duplicated to a shadow candidate",
+                ("model",))
+            self._m_shadow_div = metrics.counter(
+                "shadow_divergence_total",
+                "Shadow comparisons whose output diverged past the "
+                "configured threshold", ("model",))
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -225,9 +310,8 @@ class ModelRegistry:
                         served_obj, mode="batched", metrics=self._metrics,
                         metrics_name=name, **self._pi_kw))
                 self._models[name] = served
-                version = 1
-            else:
-                version = max(served.versions) + 1
+            version = served.next_version
+            served.next_version += 1
             served.versions[version] = ModelVersion(
                 version, served_obj, source, dtype_policy=dtype_policy,
                 quant_error=quant_error)
@@ -418,6 +502,235 @@ class ModelRegistry:
             state = served.warmup_state.get(v)
             return dict(state) if state is not None else {"status": "unknown"}
 
+    def unregister(self, name: str, version: int) -> None:
+        """Retire a non-live version: drop it (and its warmup state, any
+        traffic fraction, any shadow experiment on it) from the registry
+        so a long-running pipeline does not accumulate one full model per
+        rejected candidate. The LIVE version is refused; retiring the
+        previous version clears the rollback target."""
+        with self._lock:
+            served = self._get(name)
+            if version not in served.versions:
+                raise ModelNotFound(f"{name} has no version {version}")
+            if version == served.current_version:
+                raise ValueError(
+                    f"{name} v{version} is the live version; activate "
+                    "another version before unregistering it")
+            if version in served.traffic_split:
+                del served.traffic_split[version]
+                served._wrr_acc = {}
+                if self._m_canary is not None:
+                    self._m_canary.set(0, model=name, version=str(version))
+            if served.shadow is not None \
+                    and served.shadow["version"] == version:
+                served.shadow = None
+            if served.previous_version == version:
+                served.previous_version = None
+            del served.versions[version]
+            served.warmup_state.pop(version, None)
+            served.warmup_spec.pop(version, None)
+
+    # ------------------------------------------------------ canary routing
+    def _require_warm(self, served: ServedModel, version: int,
+                      what: str) -> None:
+        """A version may only receive (or shadow) traffic once its AOT
+        bucket warmup finished — 'skipped' counts (no spec / warmup off),
+        'pending'/'warming'/'error' do not."""
+        state = served.warmup_state.get(version)
+        status = None if state is None else state["status"]
+        if status not in ("warm", "skipped"):
+            raise ValueError(
+                f"{served.name} v{version} is not warmed "
+                f"(warmup status: {status}); a cold version must never "
+                f"receive {what} — rewarm() it first")
+
+    def set_traffic_split(self, name: str,
+                          fractions: Dict[int, float]) -> None:
+        """Route ``fractions`` of un-pinned predict traffic to non-live
+        versions (the live version serves the remainder).  Every target
+        must exist, be warm, and not be the live version; fractions are
+        in (0, 1] and sum to at most 1.  Deterministic smooth-WRR
+        routing; accumulators reset on every split change."""
+        with self._lock:
+            served = self._get(name)
+            total = 0.0
+            for v, f in fractions.items():
+                if v not in served.versions:
+                    raise ModelNotFound(f"{name} has no version {v}")
+                if v == served.current_version:
+                    raise ValueError(
+                        f"{name} v{v} is the live version; split "
+                        "fractions name canary versions only")
+                f = float(f)
+                if not 0.0 < f <= 1.0:
+                    raise ValueError(
+                        f"fraction for v{v} must be in (0, 1], got {f}")
+                self._require_warm(served, v, "a traffic fraction")
+                total += f
+            if total > 1.0 + 1e-9:
+                raise ValueError(
+                    f"split fractions sum to {total:.4g} (> 1.0)")
+            previous = set(served.traffic_split)
+            served.traffic_split = {int(v): float(f)
+                                    for v, f in fractions.items()}
+            served._wrr_acc = {}
+            if self._m_canary is not None:
+                for v in previous - set(served.traffic_split):
+                    self._m_canary.set(0, model=name, version=str(v))
+                for v, f in served.traffic_split.items():
+                    self._m_canary.set(f, model=name, version=str(v))
+
+    def clear_traffic_split(self, name: str) -> None:
+        """End the canary split: all un-pinned traffic returns to the
+        live version's batching dispatcher."""
+        self.set_traffic_split(name, {})
+
+    def get_traffic_split(self, name: str) -> Dict[int, float]:
+        with self._lock:
+            return dict(self._get(name).traffic_split)
+
+    # -------------------------------------------------------- shadow mode
+    def set_shadow(self, name: str, version: int, *, sample: float = 1.0,
+                   divergence_threshold: float = 1e-3,
+                   max_log: int = 100, max_queue: int = 64) -> None:
+        """Duplicate every Nth live request (N = round(1/``sample``)) to
+        ``version`` off the response path and diff the outputs.  The
+        candidate must be warm (it runs a real forward).  Divergences
+        past ``divergence_threshold`` (max-abs difference) increment
+        ``shadow_divergence_total{model}`` and land in a bounded log."""
+        if not 0.0 < float(sample) <= 1.0:
+            raise ValueError(f"sample must be in (0, 1], got {sample}")
+        with self._lock:
+            served = self._get(name)
+            if version not in served.versions:
+                raise ModelNotFound(f"{name} has no version {version}")
+            if version == served.current_version:
+                raise ValueError(
+                    f"{name} v{version} is the live version; shadow "
+                    "mode mirrors traffic to a NON-live candidate")
+            self._require_warm(served, version, "shadow traffic")
+            served.shadow = {
+                "version": int(version), "sample": float(sample),
+                "every": max(1, int(round(1.0 / float(sample)))),
+                "threshold": float(divergence_threshold),
+                "counter": 0, "requests": 0, "divergences": 0,
+                "dropped": 0, "max_queue": int(max_queue),
+                "log": deque(maxlen=int(max_log)),
+            }
+        self._ensure_shadow_worker()
+
+    def clear_shadow(self, name: str) -> None:
+        with self._lock:
+            self._get(name).shadow = None
+
+    def shadow_state(self, name: str) -> Optional[dict]:
+        """Counters of the live shadow experiment (None when off)."""
+        with self._lock:
+            s = self._get(name).shadow
+            if s is None:
+                return None
+            return {k: s[k] for k in ("version", "sample", "requests",
+                                      "divergences", "dropped")}
+
+    def shadow_log(self, name: str) -> List[dict]:
+        """The bounded divergence log, worst-offenders-keep-rolling."""
+        with self._lock:
+            s = self._get(name).shadow
+            return [] if s is None else list(s["log"])
+
+    def _ensure_shadow_worker(self) -> None:
+        with self._shadow_cv:
+            if (self._shadow_thread is not None
+                    and self._shadow_thread.is_alive()):
+                return
+            self._shadow_stop = False
+            self._shadow_thread = threading.Thread(
+                target=self._shadow_loop, name="shadow-worker", daemon=True)
+            self._shadow_thread.start()
+
+    def _enqueue_shadow(self, served: ServedModel, x, live_out) -> None:
+        """Called under the registry lock from the predict path: count the
+        request against the sampling stride and, when it samples, hand
+        (input, live output) to the worker — NEVER the model call itself;
+        the response path pays a deque append at most."""
+        s = served.shadow
+        s["counter"] += 1
+        if s["counter"] % s["every"]:
+            return
+        with self._shadow_cv:
+            # the bound is per EXPERIMENT: one model's backlog must not
+            # silently starve another model's shadow counters
+            pending = sum(1 for item in self._shadow_queue
+                          if item[0] is served)
+            if pending >= s["max_queue"]:
+                s["dropped"] += 1
+                return
+            self._shadow_queue.append(
+                (served, s["version"], np.asarray(x),
+                 np.asarray(live_out)))
+            self._shadow_cv.notify()
+
+    def _shadow_loop(self) -> None:
+        while True:
+            with self._shadow_cv:
+                while not self._shadow_queue:
+                    self._shadow_inflight = 0
+                    self._shadow_cv.notify_all()  # drain_shadow waiters
+                    if self._shadow_stop:
+                        return  # shutdown: don't pin the registry forever
+                    self._shadow_cv.wait()
+                served, version, x, live_out = self._shadow_queue.popleft()
+                self._shadow_inflight = 1
+            try:
+                self._shadow_compare(served, version, x, live_out)
+            except Exception:  # noqa: BLE001 — the worker must survive
+                pass
+
+    def _shadow_compare(self, served: ServedModel, version: int,
+                        x, live_out) -> None:
+        with self._lock:
+            s = served.shadow
+            if s is None or s["version"] != version:
+                return  # experiment ended while queued
+            model = served.versions[version].model
+        try:
+            shadow_out = np.asarray(model.output(x))
+            diff = float(np.max(np.abs(
+                shadow_out.astype(np.float64)
+                - np.asarray(live_out).astype(np.float64))))
+            error = None
+        except Exception as e:  # noqa: BLE001 — a crashing candidate is
+            # maximally divergent, not a worker fault
+            diff, error = float("inf"), f"{type(e).__name__}: {e}"
+        with self._lock:
+            s = served.shadow
+            if s is None or s["version"] != version:
+                return
+            s["requests"] += 1
+            if self._m_shadow_req is not None:
+                self._m_shadow_req.inc(model=served.name)
+            if diff > s["threshold"]:
+                s["divergences"] += 1
+                if self._m_shadow_div is not None:
+                    self._m_shadow_div.inc(model=served.name)
+                entry = {"diff": diff, "rows": int(np.asarray(x).shape[0]),
+                         "ts": time.time()}
+                if error is not None:
+                    entry["error"] = error
+                s["log"].append(entry)
+
+    def drain_shadow(self, timeout_s: float = 5.0) -> bool:
+        """Block until the shadow queue is empty and idle (tests and
+        deterministic canary ticks); True when drained."""
+        deadline = time.monotonic() + timeout_s
+        with self._shadow_cv:
+            while self._shadow_queue or self._shadow_inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._shadow_cv.wait(remaining)
+        return True
+
     def activate(self, name: str, version: int, *,
                  _kind: str = "activate") -> None:
         """Atomic hot-swap of the live version (rollback's forward twin).
@@ -439,6 +752,16 @@ class ModelRegistry:
                 with self._lock:
                     served.previous_version = served.current_version
                     served.current_version = version
+                    # a swap invalidates any canary experiment against the
+                    # OLD live version: clear the split + shadow so no
+                    # stale fraction keeps routing (promote's forward twin)
+                    if served.traffic_split and self._m_canary is not None:
+                        for v in served.traffic_split:
+                            self._m_canary.set(0, model=name,
+                                               version=str(v))
+                    served.traffic_split = {}
+                    served._wrr_acc = {}
+                    served.shadow = None
                     self._note_swap(name, version, _kind)
                     # hot-swap keeps warm: the incoming version was warmed
                     # at ITS registration, so the gauge usually stays full
@@ -517,28 +840,59 @@ class ModelRegistry:
         from the model object that ACTUALLY served the batch, so a hot-swap
         landing mid-request can never mislabel an old model's output with
         the new version number.
+
+        Un-pinned requests honor the canary split: a live
+        ``set_traffic_split`` routes each request deterministically
+        (smooth WRR) to the live dispatcher or a canary version's model;
+        live-path responses additionally feed the shadow sampler when a
+        shadow experiment is armed.
         """
         served = self.get(name)
+        routed = None
         with self._lock:
             current = served.current_version
             if version is not None and version not in served.versions:
                 raise ModelNotFound(f"{name} has no version {version}")
+            if version is None and served.traffic_split:
+                routed = served.pick_weighted()
+                if routed != current:
+                    version = routed
             pinned = (served.versions[version].model
                       if version is not None and version != current else None)
         if pinned is not None:
-            import numpy as np
-            return np.asarray(pinned.output(np.asarray(x))), version
+            # the pinned/canary path runs synchronously (no batching) —
+            # honor the deadline contract the dispatcher gives live
+            # traffic: a response that took longer than its budget is a
+            # 504, never an arbitrarily-late 200. (The forward itself is
+            # not preemptible, so the check is after the fact.)
+            t0 = time.perf_counter()
+            out = np.asarray(pinned.output(np.asarray(x)))
+            if deadline_s is not None \
+                    and time.perf_counter() - t0 > deadline_s:
+                raise InferenceDeadlineExceeded(
+                    f"synchronous predict on {name} v{version} took "
+                    f"{time.perf_counter() - t0:.3f}s "
+                    f"(deadline {deadline_s:.3f}s)")
+            return out, version
         out, model = served.inference.output(x, deadline_s=deadline_s,
                                              return_model=True)
         with self._lock:
             ver = next((mv.version for mv in served.versions.values()
                         if mv.model is model), served.current_version)
+            if served.shadow is not None and ver == served.current_version:
+                self._enqueue_shadow(served, x, out)
         return out, ver
 
     # ----------------------------------------------------------- lifecycle
     def shutdown(self) -> None:
-        """Stop every dispatcher (flushes in-flight batches first)."""
+        """Stop every dispatcher (flushes in-flight batches first) and
+        the shadow worker (a parked daemon thread would otherwise keep
+        the registry and every model graph alive for process lifetime)."""
         with self._lock:
             models = list(self._models.values())
         for m in models:
             m.inference.shutdown()
+        with self._shadow_cv:
+            self._shadow_stop = True
+            self._shadow_queue.clear()
+            self._shadow_cv.notify_all()

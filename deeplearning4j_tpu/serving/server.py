@@ -11,7 +11,10 @@ endpoint                                        behavior
                                                 ``streaming/codec.py`` binary array
                                                 frame (``application/octet-stream``);
                                                 response mirrors the request type
-``GET /v1/models``                              registry listing (versions, health)
+``GET /v1/models``                              registry listing (versions, health,
+                                                per-version canary traffic weights
+                                                and shadow-experiment counters when
+                                                a canary is in flight)
 ``GET /v1/models/<name>``                       one model's description
 ``GET /healthz``                                process liveness (always 200)
 ``GET /readyz``                                 readiness — 503 while draining, mid
@@ -34,6 +37,13 @@ admission overflow · 500 model error · 503 draining/dispatcher-dead ·
 
 Per-request deadlines ride the ``X-Deadline-Ms`` header (or ``deadline_ms``
 in a JSON body) and propagate into the batching dispatcher.
+
+Canary routing: un-pinned predict requests honor the registry's live
+traffic split (``ModelRegistry.set_traffic_split`` — the ``pipeline/``
+subsystem's canary data plane); the ``version`` field / ``X-Model-Version``
+header in the response reports which version actually served, so a client
+can tell it was canaried.  Shadow mode duplicates sampled live requests to
+the candidate off the response path — the HTTP handler never waits on it.
 
 Distributed tracing: a W3C ``traceparent`` request header joins the
 caller's trace — the predict path runs inside an ``http_request`` span

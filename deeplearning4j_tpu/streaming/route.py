@@ -48,6 +48,10 @@ class Route:
         self._on_error: Any = "stop"
         self.error: Optional[Exception] = None
         self.errors: List[Tuple[Any, Exception]] = []
+        # items delivered by a completed background run (None while the
+        # route is still running / never started) — consumers like the
+        # pipeline trainer use it to tell "drained" from "stuck"
+        self.result: Optional[int] = None
 
     def from_source(self, iterable: Iterable) -> "Route":
         self._source = iterable
@@ -151,10 +155,11 @@ class Route:
     def start(self) -> "Route":
         """Run on a background thread (Camel's async route start). A
         failure under the ``stop`` policy lands in ``self.error`` instead
-        of vanishing with the thread."""
+        of vanishing with the thread; a clean drain records the delivered
+        count in ``self.result``."""
         def guarded():
             try:
-                self.run()
+                self.result = self.run()
             except Exception as e:  # noqa: BLE001 - surfaced via .error
                 self.error = e
 
@@ -162,6 +167,15 @@ class Route:
         self._thread.start()
         return self
 
-    def join(self, timeout: Optional[float] = None) -> None:
+    def join(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Wait for a background route; returns the delivered-item count
+        (``None`` when the route stopped on an error — see ``.error``).
+
+        Raises ``TimeoutError`` when the route is still running after
+        ``timeout`` seconds: a stuck stream must be distinguishable from
+        a drained one, not a silent return."""
         if self._thread is not None:
             self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(f"route still running after {timeout}s")
+        return self.result

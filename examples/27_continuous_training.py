@@ -1,0 +1,166 @@
+"""Continuous training: stream -> fit -> eval gate -> canary -> promote,
+as one crash-safe pipeline (``deeplearning4j_tpu/pipeline/``).
+
+The loop every production-ML platform ends up hand-rolling (TFX's
+continuous-training push, the "pipeline glue" of Sculley et al.), built
+from pieces this framework already had and a journaled state machine
+that makes it safe:
+
+1. **healthy cycle**: a streaming route feeds mini-epoch incremental
+   ``fit()`` on a candidate cloned from the serving version (watchdog +
+   TraceListener attached); the candidate passes the held-out eval gate,
+   canaries at 25% then 50% of live traffic (deterministic weighted
+   round-robin, shadow diffs recorded off the response path, all on a
+   ``ManualTimeSource`` — no real waiting) and auto-PROMOTEs into the
+   live slot;
+2. **regression cycle**: the stream turns garbage (inverted labels), the
+   retrained candidate fails the gate and the run auto-ROLLBACKs —
+   the bad model never receives a single live request;
+3. **journal audit**: the fenced journal shows exactly one PROMOTE and
+   one ROLLBACK commit, the canary ramp notes, and the gate numbers that
+   justified each decision — and the shipped pipeline config validates
+   through ``tools/validate_pipeline_config.py``.
+
+Run: python examples/27_continuous_training.py   (CPU-friendly, <2 min)
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.observe.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+from deeplearning4j_tpu.pipeline import (ContinuousPipeline, PipelineConfig,
+                                         StreamBuffer)
+from deeplearning4j_tpu.serving import ModelRegistry
+from deeplearning4j_tpu.streaming import Route
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TOOLS = os.path.join(os.path.dirname(HERE), "tools")
+CONFIG = os.path.join(HERE, "pipeline_config.json")
+
+rng = np.random.default_rng(7)
+W = rng.normal(size=(8, 2)).astype(np.float32)
+
+
+def make_data(n, garbage=False):
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    labels = (x @ W).argmax(1)
+    if garbage:  # the regression: every label inverted — training on
+        labels = 1 - labels  # this actively pushes the candidate wrong
+    return x, np.eye(2, dtype=np.float32)[labels]
+
+
+def build_baseline():
+    conf = (NeuralNetConfiguration.builder().seed(1).list()
+            .layer(DenseLayer(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf).init()
+    net.fit(DataSet(*make_data(128)), epochs=3)
+    return net
+
+
+def run_cycle(registry, state_dir, config, clock, metrics, eval_set,
+              garbage=False):
+    buffer = StreamBuffer()
+    batches = [DataSet(*make_data(16, garbage=garbage)) for _ in range(6)]
+    route = Route().from_source(batches).to_callable(buffer.put).start()
+
+    def canary_wait(poll_s):
+        # between ticks: drive live traffic (so weighted routing + shadow
+        # observe real forwards) and advance the injected clock
+        for i in range(4):
+            registry.predict("model", eval_set.features[2 * i:2 * i + 2])
+        clock.advance(seconds=6)
+
+    pipe = ContinuousPipeline(
+        registry, "model", state_dir, config=config, buffer=buffer,
+        route=route, eval_set=eval_set, metrics=metrics, time_source=clock,
+        sample_input=eval_set.features[:1], canary_wait=canary_wait)
+    summary = pipe.run_cycle()
+    assert route.join(timeout=10) == len(batches)  # drained, not stuck
+    return pipe, summary
+
+
+def main():
+    config = PipelineConfig.parse(CONFIG)
+    metrics = MetricsRegistry()
+    clock = ManualTimeSource(0)
+    eval_set = DataSet(*make_data(64))
+
+    registry = ModelRegistry(metrics=metrics, wait_ms=1.0)
+    baseline = build_baseline()
+    registry.register("model", model=baseline,
+                      sample_input=eval_set.features[:1])
+    print(f"baseline serving as v1 "
+          f"(warmup: {registry.warmup_state('model')['status']})")
+
+    with tempfile.TemporaryDirectory() as state_dir:
+        print("\n=== 1. healthy cycle: stream -> gate -> canary -> "
+              "PROMOTE ===")
+        pipe, summary = run_cycle(registry, state_dir, config, clock,
+                                  metrics, eval_set)
+        print(f"run {summary['run']}: {summary['outcome']} "
+              f"(live v{summary['live_version']})")
+        assert summary["outcome"] == "PROMOTE", summary
+        assert registry.get("model").current_version == 2
+
+        # the canary's data plane left its audit trail in the metrics
+        exposition = metrics.exposition()
+        assert "serving_canary_fraction" in exposition
+        assert "shadow_requests_total" in exposition
+        ramps = [r for r in pipe.sm.stage_history(1)
+                 if r.get("event") == "note"
+                 and r.get("message") == "canary ramp"]
+        print("canary ramp:", [r["data"]["fraction"] for r in ramps],
+              "| shadow:",
+              [r for r in pipe.sm.stage_history(1)
+               if r.get("stage") == "CANARY"
+               and r.get("event") == "commit"][0]["data"]["shadow"])
+
+        print("\n=== 2. regression cycle: garbage stream -> gate FAIL -> "
+              "ROLLBACK ===")
+        pipe2, summary2 = run_cycle(registry, state_dir, config, clock,
+                                    metrics, eval_set, garbage=True)
+        print(f"run {summary2['run']}: {summary2['outcome']} "
+              f"(live v{summary2['live_version']})")
+        assert summary2["outcome"] == "ROLLBACK", summary2
+        assert registry.get("model").current_version == 2  # unchanged
+        gate = [r for r in pipe2.sm.stage_history(2)
+                if r.get("stage") == "EVAL"
+                and r.get("event") == "commit"][0]["data"]
+        print(f"gate: candidate loss {gate['candidate']:.4f} vs "
+              f"threshold {gate['threshold']:.4f} -> FAIL")
+
+        print("\n=== 3. journal audit: one PROMOTE, one ROLLBACK, "
+              "never both per run ===")
+        records = pipe2.sm.journal.records()
+        terminals = [r for r in records if r.get("event") == "commit"
+                     and r.get("stage") in ("PROMOTE", "ROLLBACK")]
+        assert [(r["run"], r["stage"]) for r in terminals] == \
+            [(1, "PROMOTE"), (2, "ROLLBACK")], terminals
+        print(f"{len(records)} journal records; terminals: "
+              f"{[(r['run'], r['stage']) for r in terminals]}")
+
+    sys.path.insert(0, TOOLS)
+    from validate_pipeline_config import validate_file
+    errors = validate_file(CONFIG)
+    assert not errors, errors
+    print(f"\nOK {os.path.basename(CONFIG)}: validates clean")
+
+    for line in metrics.exposition().splitlines():
+        if line.startswith(("pipeline_runs_total", "shadow_")):
+            print(line)
+    print("example 27 complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,21 @@ def test_shipped_elastic_alert_rules_lint_clean():
     assert proc.stdout.startswith("OK"), proc.stdout
 
 
+def test_shipped_pipeline_config_lints_clean():
+    """The continuous-training pipeline config shipped for example 27 /
+    the ``pipeline`` CLI subcommand passes
+    ``tools/validate_pipeline_config.py`` (schema + dry-run lint)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_pipeline_config.py"),
+         os.path.join(EXAMPLES_DIR, "pipeline_config.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
 def test_shipped_fault_plan_lints_clean():
     """The example ``DL4J_TPU_FAULT_PLAN`` ships lint-clean through
     ``tools/validate_fault_plan.py`` (schema + dry run, no fault executed)

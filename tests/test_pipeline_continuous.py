@@ -1,0 +1,1275 @@
+"""Continuous-training pipeline (``deeplearning4j_tpu/pipeline/``).
+
+Covers the subsystem bottom-up:
+
+- journal fencing (GenerationLedger pattern: stale tokens un-committable,
+  zombie appends ineligible on replay, torn lines skipped);
+- state-machine legality (stage order, single-terminal-decision rule,
+  resume points);
+- the registry's canary data plane (deterministic weighted routing,
+  warm-gating, shadow sampling/divergence accounting, describe payloads);
+- the route satellite (result count, join(timeout) raising);
+- gate / trainer / canary-controller units;
+- the E2E acceptance proof: promote path, regression rollback path
+  (gate AND alert-driven), and the crash-resume matrix — the pipeline is
+  killed (fault injector) at the enter and commit of EVERY stage, then
+  restarted, and must converge to the same terminal state with exactly
+  one terminal commit in the journal (single-promote semantics);
+- the CLI: in-process-only flags rejected; a real subprocess run
+  SIGKILLed mid-CANARY by a ``DL4J_TPU_FAULT_PLAN`` resumes on restart.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.observe.metrics import MetricsRegistry
+from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+from deeplearning4j_tpu.pipeline import (AlreadyDecided, CanaryController,
+                                         ContinuousPipeline,
+                                         ContinuousTrainer, EvalGate,
+                                         IllegalTransition, PipelineConfig,
+                                         PipelineJournal,
+                                         PipelineStateMachine, StalePipelineError,
+                                         StreamBuffer, StreamStuck)
+from deeplearning4j_tpu.serving import ModelRegistry
+from deeplearning4j_tpu.streaming import Route
+from deeplearning4j_tpu.util import faultinject
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# journal + fencing
+# ---------------------------------------------------------------------------
+
+class TestJournalFencing:
+    def test_append_replay_round_trip(self, tmp_path):
+        j = PipelineJournal(str(tmp_path))
+        t = j.acquire()
+        j.append(t, {"event": "run", "run": 1})
+        j.append(t, {"event": "enter", "run": 1, "stage": "TRAIN"})
+        recs = j.records()
+        assert [r["event"] for r in recs] == ["run", "enter"]
+        assert [r["seq"] for r in recs] == [1, 2]
+        assert all(r["token"] == t for r in recs)
+
+    def test_stale_token_refused(self, tmp_path):
+        j1 = PipelineJournal(str(tmp_path))
+        t1 = j1.acquire()
+        j1.append(t1, {"event": "run", "run": 1})
+        j2 = PipelineJournal(str(tmp_path))
+        j2.acquire()
+        with pytest.raises(StalePipelineError):
+            j1.append(t1, {"event": "enter", "run": 1, "stage": "TRAIN"})
+
+    def test_zombie_append_ineligible_on_replay(self, tmp_path):
+        """A write that slips past the owner re-read race (simulated by
+        appending the line directly) parses but is NOT part of recovered
+        state: its seq is outside its fenced token's snapshot."""
+        j1 = PipelineJournal(str(tmp_path))
+        t1 = j1.acquire()
+        j1.append(t1, {"event": "run", "run": 1})
+        j2 = PipelineJournal(str(tmp_path))
+        t2 = j2.acquire()  # fences t1 with known_seqs=[1]
+        with open(j1.journal_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"event": "commit", "run": 1,
+                                 "stage": "PROMOTE", "seq": 2,
+                                 "token": t1}) + "\n")
+        assert len(j1._raw_records()) == 2      # the bytes exist
+        recs = j2.records()
+        assert len(recs) == 1                   # the state does not
+        assert recs[0]["event"] == "run"
+        j2.append(t2, {"event": "enter", "run": 1, "stage": "TRAIN"})
+        assert [r["event"] for r in j2.records()] == ["run", "enter"]
+
+    def test_torn_final_line_skipped_and_repaired(self, tmp_path):
+        j = PipelineJournal(str(tmp_path))
+        t = j.acquire()
+        j.append(t, {"event": "run", "run": 1})
+        with open(j.journal_path, "a", encoding="utf-8") as fh:
+            fh.write('{"event": "enter", "run": 1, "seq": 2, "tok')
+        assert [r["event"] for r in j.records()] == ["run"]
+        # a restart must REPAIR the torn tail: its first append starts a
+        # fresh line instead of concatenating into the torn JSON (which
+        # would silently drop the new record from every future replay)
+        j2 = PipelineJournal(str(tmp_path))
+        t2 = j2.acquire()
+        j2.append(t2, {"event": "note", "run": 1})
+        assert [r["event"] for r in j2.records()] == ["run", "note"]
+        j3 = PipelineJournal(str(tmp_path))
+        j3.acquire()
+        assert [r["event"] for r in j3.records()] == ["run", "note"]
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+class TestStateMachine:
+    def test_happy_path_promote(self, tmp_path):
+        sm = PipelineStateMachine(str(tmp_path))
+        assert sm.resume_point() is None
+        run = sm.begin_run()
+        sm.enter("TRAIN")
+        sm.commit("TRAIN", candidate_version=2)
+        sm.enter("EVAL", candidate_version=2)
+        sm.commit("EVAL", passed=True)
+        sm.enter("CANARY", candidate_version=2)
+        sm.note("canary ramp", fraction=0.25)
+        sm.commit("CANARY", decision="promote")
+        sm.enter("PROMOTE", candidate_version=2)
+        sm.commit("PROMOTE", version=2)
+        assert sm.decided(run) == "PROMOTE"
+        assert sm.state().stage == "IDLE"
+        assert sm.begin_run() == run + 1
+
+    def test_illegal_transitions(self, tmp_path):
+        sm = PipelineStateMachine(str(tmp_path))
+        with pytest.raises(IllegalTransition):
+            sm.enter("TRAIN")           # no open run
+        sm.begin_run()
+        with pytest.raises(IllegalTransition):
+            sm.enter("EVAL")            # TRAIN comes first
+        with pytest.raises(IllegalTransition):
+            sm.commit("TRAIN")          # never entered
+        sm.enter("TRAIN")
+        with pytest.raises(IllegalTransition):
+            sm.begin_run()              # run still open
+        sm.commit("TRAIN")
+        with pytest.raises(IllegalTransition):
+            sm.enter("PROMOTE")         # EVAL must gate first
+
+    def test_single_terminal_decision(self, tmp_path):
+        sm = PipelineStateMachine(str(tmp_path))
+        sm.begin_run()
+        sm.enter("TRAIN")
+        sm.commit("TRAIN")
+        sm.enter("EVAL")
+        sm.commit("EVAL", passed=True)
+        sm.enter("CANARY")
+        sm.commit("CANARY", decision="promote")
+        sm.enter("PROMOTE")
+        sm.commit("PROMOTE")
+        with pytest.raises((AlreadyDecided, IllegalTransition)):
+            sm.enter("ROLLBACK")
+        with pytest.raises(IllegalTransition):
+            sm.commit("PROMOTE")
+
+    def test_resume_point_and_fencing(self, tmp_path):
+        a = PipelineStateMachine(str(tmp_path))
+        a.begin_run()
+        a.enter("TRAIN")
+        a.commit("TRAIN", candidate_version=5)
+        a.enter("EVAL", candidate_version=5)
+        # crash here; a new process takes over
+        b = PipelineStateMachine(str(tmp_path))
+        rp = b.resume_point()
+        assert (rp.run, rp.stage, rp.committed) == (1, "EVAL", False)
+        assert rp.data == {"candidate_version": 5}
+        # the old incarnation is now a zombie: un-committable
+        with pytest.raises(StalePipelineError):
+            a.commit("EVAL", passed=True)
+        b.commit("EVAL", passed=False)
+        b.enter("ROLLBACK")
+        b.commit("ROLLBACK", reason="gate failed")
+        assert b.decided(1) == "ROLLBACK"
+
+    def test_notes_do_not_affect_replay(self, tmp_path):
+        a = PipelineStateMachine(str(tmp_path))
+        a.begin_run()
+        a.enter("TRAIN")
+        a.note("operator looked at it", mood="fine")
+        b = PipelineStateMachine(str(tmp_path))
+        assert b.resume_point().stage == "TRAIN"
+        assert not b.resume_point().committed
+
+
+# ---------------------------------------------------------------------------
+# registry canary data plane (duck-typed stub models: no device work)
+# ---------------------------------------------------------------------------
+
+class _Stub:
+    """Duck-typed model returning a constant; registers as warmup-skipped
+    (no input spec), which counts as warm for the traffic gate."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def output(self, x):
+        x = np.asarray(x)
+        return np.full((x.shape[0], 1), self.value, np.float32)
+
+
+def _stub_registry(metrics=None):
+    reg = ModelRegistry(metrics=metrics, wait_ms=0.5, max_batch_size=8)
+    reg.register("m", model=_Stub(1.0))
+    reg.register("m", model=_Stub(2.0), activate=False)
+    return reg
+
+
+class TestWeightedRouting:
+    def test_deterministic_split_exact_counts(self):
+        reg = _stub_registry()
+        try:
+            reg.set_traffic_split("m", {2: 0.25})
+            served = [reg.predict_versioned("m", np.ones((1, 4)))[1]
+                      for _ in range(8)]
+            assert served.count(2) == 2, served
+            assert served.count(1) == 6, served
+        finally:
+            reg.shutdown()
+
+    def test_split_validation(self):
+        reg = _stub_registry()
+        try:
+            with pytest.raises(Exception):
+                reg.set_traffic_split("m", {9: 0.5})     # unknown version
+            with pytest.raises(ValueError):
+                reg.set_traffic_split("m", {1: 0.5})     # live version
+            with pytest.raises(ValueError):
+                reg.set_traffic_split("m", {2: 1.5})     # fraction > 1
+        finally:
+            reg.shutdown()
+
+    def test_cold_version_refused_a_fraction(self):
+        reg = _stub_registry()
+        try:
+            served = reg.get("m")
+            served.warmup_state[2] = {"status": "warming", "buckets": [8],
+                                      "warm": [], "seconds": 0,
+                                      "reason": None}
+            with pytest.raises(ValueError, match="not warmed"):
+                reg.set_traffic_split("m", {2: 0.5})
+            served.warmup_state[2]["status"] = "error"
+            with pytest.raises(ValueError, match="not warmed"):
+                reg.set_traffic_split("m", {2: 0.5})
+        finally:
+            reg.shutdown()
+
+    def test_describe_gauge_and_clear(self):
+        metrics = MetricsRegistry()
+        reg = _stub_registry(metrics)
+        try:
+            reg.set_traffic_split("m", {2: 0.25})
+            d = reg.get("m").describe()
+            assert d["traffic"] == [{"version": 2, "fraction": 0.25}]
+            assert ('serving_canary_fraction{model="m",version="2"} 0.25'
+                    in metrics.exposition())
+            reg.clear_traffic_split("m")
+            assert "traffic" not in reg.get("m").describe()
+            assert ('serving_canary_fraction{model="m",version="2"} 0'
+                    in metrics.exposition())
+        finally:
+            reg.shutdown()
+
+    def test_activate_clears_split(self):
+        reg = _stub_registry()
+        try:
+            reg.set_traffic_split("m", {2: 0.5})
+            reg.activate("m", 2)
+            assert reg.get_traffic_split("m") == {}
+            assert reg.get("m").current_version == 2
+        finally:
+            reg.shutdown()
+
+    def test_pinned_version_bypasses_split(self):
+        reg = _stub_registry()
+        try:
+            reg.set_traffic_split("m", {2: 0.99})
+            out, v = reg.predict_versioned("m", np.ones((1, 4)), version=1)
+            assert v == 1 and float(out[0, 0]) == 1.0
+        finally:
+            reg.shutdown()
+
+
+class TestShadowMode:
+    def test_sampling_stride_and_counts(self):
+        metrics = MetricsRegistry()
+        reg = _stub_registry(metrics)
+        try:
+            reg.set_shadow("m", 2, sample=0.5, divergence_threshold=10.0)
+            for _ in range(8):
+                reg.predict("m", np.ones((1, 4)))
+            assert reg.drain_shadow()
+            state = reg.shadow_state("m")
+            assert state["requests"] == 4      # every 2nd request sampled
+            assert state["divergences"] == 0   # |2-1| < 10
+            assert ('shadow_requests_total{model="m"} 4'
+                    in metrics.exposition())
+        finally:
+            reg.shutdown()
+
+    def test_divergence_counted_and_logged(self):
+        metrics = MetricsRegistry()
+        reg = _stub_registry(metrics)
+        try:
+            reg.set_shadow("m", 2, sample=1.0, divergence_threshold=0.5)
+            for _ in range(3):
+                reg.predict("m", np.ones((2, 4)))
+            assert reg.drain_shadow()
+            state = reg.shadow_state("m")
+            assert state["requests"] == 3
+            assert state["divergences"] == 3   # |2-1| = 1 > 0.5
+            log = reg.shadow_log("m")
+            assert len(log) == 3 and log[0]["diff"] == pytest.approx(1.0)
+            assert ('shadow_divergence_total{model="m"} 3'
+                    in metrics.exposition())
+            d = reg.get("m").describe()["shadow"]
+            assert d["version"] == 2 and d["divergences"] == 3
+        finally:
+            reg.shutdown()
+
+    def test_bounded_divergence_log(self):
+        reg = _stub_registry()
+        try:
+            reg.set_shadow("m", 2, sample=1.0, divergence_threshold=0.0,
+                           max_log=5)
+            for _ in range(12):
+                reg.predict("m", np.ones((1, 4)))
+            assert reg.drain_shadow()
+            assert len(reg.shadow_log("m")) == 5
+            assert reg.shadow_state("m")["divergences"] == 12
+        finally:
+            reg.shutdown()
+
+    def test_crashing_candidate_is_maximally_divergent(self):
+        reg = ModelRegistry(wait_ms=0.5)
+        try:
+            reg.register("m", model=_Stub(1.0))
+
+            class Boom:
+                def output(self, x):
+                    raise RuntimeError("shadow model exploded")
+
+            reg.register("m", model=Boom(), activate=False)
+            reg.set_shadow("m", 2, sample=1.0)
+            reg.predict("m", np.ones((1, 4)))
+            assert reg.drain_shadow()
+            state = reg.shadow_state("m")
+            assert state["divergences"] == 1
+            assert "error" in reg.shadow_log("m")[0]
+        finally:
+            reg.shutdown()
+
+    def test_off_response_path_never_blocks_predict(self):
+        reg = _stub_registry()
+        try:
+            reg.set_shadow("m", 2, sample=1.0, max_queue=2)
+            t0 = time.perf_counter()
+            for _ in range(20):
+                reg.predict("m", np.ones((1, 4)))
+            assert time.perf_counter() - t0 < 5.0
+            reg.drain_shadow()
+            state = reg.shadow_state("m")
+            assert state["requests"] + state["dropped"] == 20
+        finally:
+            reg.shutdown()
+
+    def test_shadow_validation(self):
+        reg = _stub_registry()
+        try:
+            with pytest.raises(ValueError):
+                reg.set_shadow("m", 1)           # live version
+            with pytest.raises(Exception):
+                reg.set_shadow("m", 9)           # unknown version
+            with pytest.raises(ValueError):
+                reg.set_shadow("m", 2, sample=0.0)
+        finally:
+            reg.shutdown()
+
+
+class TestCanaryOverHTTP:
+    def test_v1_models_reports_traffic_and_shadow(self):
+        """Operators must see a canary in flight from the serving API:
+        the /v1/models payload carries the live split + shadow counters."""
+        from urllib.request import urlopen
+        from deeplearning4j_tpu.serving import ModelServer
+
+        reg = _stub_registry()
+        server = ModelServer(reg)
+        try:
+            server.start()
+            reg.set_traffic_split("m", {2: 0.25})
+            reg.set_shadow("m", 2, sample=1.0, divergence_threshold=0.5)
+            for _ in range(4):
+                reg.predict("m", np.ones((1, 4)))
+            assert reg.drain_shadow()
+            body = json.load(urlopen(f"{server.url}/v1/models", timeout=5))
+            m = body["models"][0]
+            assert m["traffic"] == [{"version": 2, "fraction": 0.25}]
+            assert m["shadow"]["version"] == 2
+            assert m["shadow"]["requests"] == 3   # 1 of 4 went to v2
+            assert m["shadow"]["divergences"] == 3
+            one = json.load(urlopen(f"{server.url}/v1/models/m", timeout=5))
+            assert one["traffic"] and one["shadow"]
+        finally:
+            server.stop(drain=False, shutdown_registry=True)
+
+
+# ---------------------------------------------------------------------------
+# route satellite
+# ---------------------------------------------------------------------------
+
+class TestRouteResultAndJoin:
+    def test_background_result_count(self):
+        out = []
+        r = Route().from_source(range(5)).to_list(out).start()
+        assert r.join(timeout=5) == 5
+        assert r.result == 5 and out == list(range(5))
+
+    def test_join_timeout_raises(self):
+        release = []
+
+        def slow(x):
+            while not release:
+                time.sleep(0.01)
+            return x
+
+        r = (Route().from_source(range(3)).transform(slow)
+             .to_list([]).start())
+        with pytest.raises(TimeoutError):
+            r.join(timeout=0.1)
+        release.append(True)
+        assert r.join(timeout=5) == 3
+
+    def test_error_route_returns_none(self):
+        r = (Route().from_source([1, 0]).transform(lambda x: 1 // x)
+             .to_list([]).start())
+        assert r.join(timeout=5) is None
+        assert r.error is not None and r.result is None
+
+
+# ---------------------------------------------------------------------------
+# gate / trainer / canary units
+# ---------------------------------------------------------------------------
+
+class _ScoreModel:
+    """Duck-typed model with a fixed eval loss (gate unit tests)."""
+
+    def __init__(self, loss):
+        self._loss = float(loss)
+
+    def score(self, ds):
+        return self._loss
+
+
+class TestEvalGate:
+    def test_loss_margins(self):
+        ds = DataSet(np.zeros((4, 2), np.float32),
+                     np.zeros((4, 1), np.float32))
+        gate = EvalGate(ds, metric="loss", rel_margin=0.1, abs_margin=0.0)
+        assert gate.evaluate(_ScoreModel(1.05), _ScoreModel(1.0)).passed
+        assert not gate.evaluate(_ScoreModel(1.2), _ScoreModel(1.0)).passed
+        strict = EvalGate(ds, metric="loss")
+        assert not strict.evaluate(_ScoreModel(1.0001),
+                                   _ScoreModel(1.0)).passed
+        r = strict.evaluate(_ScoreModel(0.9), _ScoreModel(1.0))
+        assert r.passed and r.metric == "loss"
+        assert r.to_dict()["baseline"] == 1.0
+
+    def test_validation(self):
+        ds = DataSet(np.zeros((2, 2), np.float32),
+                     np.zeros((2, 1), np.float32))
+        with pytest.raises(ValueError):
+            EvalGate(ds, metric="vibes")
+        with pytest.raises(ValueError):
+            EvalGate(ds, rel_margin=-1)
+
+    def test_journaled_baseline_reused(self):
+        ds = DataSet(np.zeros((2, 2), np.float32),
+                     np.zeros((2, 1), np.float32))
+        gate = EvalGate(ds, metric="loss")
+        r = gate.evaluate(_ScoreModel(0.5), None, baseline_value=1.0)
+        assert r.passed and r.baseline == 1.0
+
+
+class TestStreamBufferAndTrainer:
+    def test_buffer_put_take_close(self):
+        buf = StreamBuffer(capacity=4)
+        buf.put(1)
+        buf.put(2)
+        assert buf.take(1) == [1]
+        assert buf.take(5, timeout_s=0.05) == [2]
+        assert buf.take(1, timeout_s=0.05) == []
+        buf.close()
+        with pytest.raises(RuntimeError):
+            buf.put(3)
+
+    def test_trainer_mini_epochs_and_watchdog_attached(self):
+        from deeplearning4j_tpu.nn.conf import (InputType,
+                                                NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.observe.health import TrainingWatchdog
+        from deeplearning4j_tpu.observe.listener import TraceListener
+
+        conf = (NeuralNetConfiguration.builder().seed(1).list()
+                .layer(DenseLayer(n_out=4, activation="relu"))
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf).init()
+        buf = StreamBuffer()
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            x = rng.normal(size=(8, 4)).astype(np.float32)
+            y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+            buf.put(DataSet(x, y))
+        trainer = ContinuousTrainer(net, buf, batch_size=8,
+                                    batches_per_mini_epoch=2,
+                                    take_timeout_s=0.2,
+                                    watchdog={"action": "log"})
+        kinds = {type(l) for l in trainer.listeners}
+        assert TraceListener in kinds and TrainingWatchdog in kinds
+        stats = trainer.train_mini_epoch()
+        assert stats["examples"] == 16 and stats["batches"] == 2
+        stats = trainer.train_mini_epoch()
+        assert trainer.examples_seen == 32
+        with pytest.raises(StreamStuck):
+            trainer.train_mini_epoch()
+
+    def test_trainer_rebatches_tuples_and_singles(self):
+        from deeplearning4j_tpu.pipeline.trainer import _to_datasets
+        x8 = np.ones((8, 3), np.float32)
+        y8 = np.ones((8, 2), np.float32)
+        single = (np.ones(3, np.float32), np.ones(2, np.float32))
+        out = _to_datasets([DataSet(x8, y8), (x8, y8), single], 5)
+        assert sum(np.asarray(d.features).shape[0] for d in out) == 17
+        assert np.asarray(out[0].features).shape == (5, 3)
+
+
+class _FakeCanaryRegistry:
+    """Duck-typed registry recording the controller's calls."""
+
+    def __init__(self):
+        self.calls = []
+        self.shadow = None
+        self.divergences = 0
+
+    def set_traffic_split(self, name, fractions):
+        self.calls.append(("split", dict(fractions)))
+
+    def clear_traffic_split(self, name):
+        self.calls.append(("clear_split",))
+
+    def set_shadow(self, name, version, **kw):
+        self.shadow = {"version": version, **kw}
+        self.calls.append(("shadow", version))
+
+    def clear_shadow(self, name):
+        self.calls.append(("clear_shadow",))
+        self.shadow = None
+
+    def shadow_state(self, name):
+        if self.shadow is None:
+            return None
+        return {"version": self.shadow["version"], "requests": 10,
+                "divergences": self.divergences, "dropped": 0,
+                "sample": 1.0}
+
+    def drain_shadow(self, timeout_s=5.0):
+        return True
+
+
+class _FakeAlerts:
+    def __init__(self):
+        self.rules = []
+
+    def firing(self):
+        return list(self.rules)
+
+
+class TestCanaryController:
+    SCHEDULE = [{"fraction": 0.1, "hold_s": 10},
+                {"fraction": 0.5, "hold_s": 10}]
+
+    def test_ramp_to_promote(self):
+        reg, clock = _FakeCanaryRegistry(), ManualTimeSource(0)
+        c = CanaryController(reg, "m", 2, schedule=self.SCHEDULE,
+                             time_source=clock, shadow_sample=0.5)
+        c.start()
+        assert ("shadow", 2) in reg.calls
+        assert ("split", {2: 0.1}) in reg.calls
+        assert c.tick() is None            # hold not elapsed
+        clock.advance(seconds=11)
+        assert c.tick() is None            # ramped to step 2
+        assert ("split", {2: 0.5}) in reg.calls
+        clock.advance(seconds=11)
+        assert c.tick() == "promote"
+        assert c.shadow_final["requests"] == 10
+        assert ("clear_split",) in reg.calls
+        assert ("clear_shadow",) in reg.calls
+        assert c.tick() == "promote"       # decision is sticky
+
+    def test_alert_firing_rolls_back(self):
+        reg, clock = _FakeCanaryRegistry(), ManualTimeSource(0)
+        alerts = _FakeAlerts()
+        c = CanaryController(reg, "m", 2, schedule=self.SCHEDULE,
+                             time_source=clock, alerts=alerts,
+                             abort_on_alerts=["predict_slo_burn"])
+        c.start()
+        alerts.rules = ["unrelated_rule"]
+        clock.advance(seconds=11)
+        assert c.tick() is None            # unwatched rule: keep ramping
+        alerts.rules = ["predict_slo_burn"]
+        assert c.tick() == "rollback"
+        assert "predict_slo_burn" in c.reason
+
+    def test_divergence_budget_rolls_back(self):
+        reg, clock = _FakeCanaryRegistry(), ManualTimeSource(0)
+        c = CanaryController(reg, "m", 2, schedule=self.SCHEDULE,
+                             time_source=clock, shadow_sample=1.0,
+                             max_divergences=3)
+        c.start()
+        reg.divergences = 5
+        assert c.tick() == "rollback"
+        assert "divergences" in c.reason
+
+    def test_report_alarm_rolls_back(self):
+        reg, clock = _FakeCanaryRegistry(), ManualTimeSource(0)
+        c = CanaryController(reg, "m", 2, schedule=self.SCHEDULE,
+                             time_source=clock)
+        c.start()
+        c.report_alarm("watchdog: loss divergence")
+        assert c.tick() == "rollback"
+        assert "watchdog" in c.reason
+
+    def test_schedule_validation(self):
+        with pytest.raises(ValueError):
+            CanaryController(_FakeCanaryRegistry(), "m", 2, schedule=[])
+        with pytest.raises(ValueError):
+            CanaryController(_FakeCanaryRegistry(), "m", 2, schedule=[
+                {"fraction": 0.5, "hold_s": 1},
+                {"fraction": 0.2, "hold_s": 1}])  # must increase
+
+
+# ---------------------------------------------------------------------------
+# config schema
+# ---------------------------------------------------------------------------
+
+class TestPipelineConfig:
+    def test_defaults_and_overrides(self):
+        cfg = PipelineConfig.parse({"name": "m",
+                                    "train": {"mini_epochs": 7}})
+        assert cfg.name == "m"
+        assert cfg.train["mini_epochs"] == 7
+        assert cfg.train["batch_size"] == 32      # default retained
+
+    def test_schema_errors_name_the_field(self):
+        for spec, needle in (
+                ({"nope": 1}, "nope"),
+                ({"train": {"batch_size": 0}}, "train.batch_size"),
+                ({"gate": {"metric": "vibes"}}, "gate.metric"),
+                ({"canary": {"schedule": []}}, "canary.schedule"),
+                ({"canary": {"shadow_sample": 2}}, "shadow_sample"),
+                ({"cycles": 0}, "cycles"),
+                ({"train": {"watchdog": "explode"}}, "watchdog")):
+            with pytest.raises(ValueError, match=needle.replace(".", r"\.")):
+                PipelineConfig.parse(spec)
+
+    def test_lint_contradictions(self):
+        cfg = PipelineConfig.parse(
+            {"canary": {"shadow_sample": 0, "max_divergences": 3}})
+        assert any("shadow_sample" in p for p in cfg.lint())
+        assert not PipelineConfig.parse({}).lint()
+
+    def test_shipped_example_config_valid(self):
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        from validate_pipeline_config import validate_file
+        assert validate_file(
+            os.path.join(REPO, "examples", "pipeline_config.json")) == []
+
+
+# ---------------------------------------------------------------------------
+# E2E: promote, rollback, crash-resume matrix
+# ---------------------------------------------------------------------------
+
+_W = np.array(np.random.default_rng(3).normal(size=(6, 2)), np.float32)
+
+
+def _mesh_data(rng, n, invert=False):
+    x = rng.normal(size=(n, 6)).astype(np.float32)
+    labels = (x @ _W).argmax(1)
+    if invert:
+        labels = 1 - labels
+    return x, np.eye(2, dtype=np.float32)[labels]
+
+
+def _small_net(seed=1):
+    from deeplearning4j_tpu.nn.conf import (InputType,
+                                            NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    conf = (NeuralNetConfiguration.builder().seed(seed).list()
+            .layer(DenseLayer(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(6)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+_E2E_CONFIG = {
+    "name": "m",
+    "train": {"batch_size": 16, "batches_per_mini_epoch": 2,
+              "mini_epochs": 2, "take_timeout_s": 0.3,
+              "watchdog": "raise"},
+    "gate": {"metric": "loss", "rel_margin": 0.02, "abs_margin": 0.0},
+    "canary": {"schedule": [{"fraction": 0.25, "hold_s": 10},
+                            {"fraction": 0.5, "hold_s": 10}],
+               "shadow_sample": 0.5, "divergence_threshold": 10.0,
+               "max_divergences": None, "abort_on_alerts": None,
+               "poll_s": 0.01}}
+
+
+def _build_pipeline(state_dir, registry, clock, *, invert=False,
+                    alerts=None, config=None, metrics=None):
+    rng = np.random.default_rng(42)
+    buf = StreamBuffer()
+    for _ in range(6):
+        buf.put(DataSet(*_mesh_data(rng, 16, invert=invert)))
+    eval_set = DataSet(*_mesh_data(np.random.default_rng(43), 64))
+
+    def wait(poll_s):
+        for i in range(4):
+            registry.predict("m", eval_set.features[2 * i:2 * i + 2])
+        clock.advance(seconds=6)
+
+    return ContinuousPipeline(
+        registry, "m", str(state_dir),
+        config=PipelineConfig.parse(config or _E2E_CONFIG),
+        buffer=buf, eval_set=eval_set, time_source=clock,
+        metrics=metrics, alerts=alerts,
+        sample_input=eval_set.features[:1], canary_wait=wait)
+
+
+@pytest.fixture
+def serving_registry():
+    rng = np.random.default_rng(5)
+    net = _small_net()
+    net.fit(DataSet(*_mesh_data(rng, 128)), epochs=3)
+    reg = ModelRegistry(wait_ms=0.5, buckets=[2, 16])
+    reg.register("m", model=net,
+                 sample_input=np.zeros((1, 6), np.float32))
+    yield reg
+    reg.shutdown()
+
+
+class TestPipelineEndToEnd:
+    def test_promote_path(self, tmp_path, serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        summary = pipe.run_cycle()
+        assert summary["outcome"] == "PROMOTE", summary
+        assert serving_registry.get("m").current_version == 2
+        canary = [r for r in pipe.sm.stage_history(1)
+                  if r.get("stage") == "CANARY"
+                  and r.get("event") == "commit"][0]["data"]
+        assert canary["decision"] == "promote"
+        assert canary["shadow"]["requests"] > 0   # shadow diffs recorded
+        # the candidate checkpoint was persisted for cross-process resume
+        assert canary["candidate_version"] == 2
+
+    def test_regression_rolls_back_via_gate(self, tmp_path,
+                                            serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               invert=True)
+        summary = pipe.run_cycle()
+        assert summary["outcome"] == "ROLLBACK", summary
+        assert serving_registry.get("m").current_version == 1  # unchanged
+        ev = [r for r in pipe.sm.stage_history(1)
+              if r.get("stage") == "EVAL"
+              and r.get("event") == "commit"][0]["data"]
+        assert ev["passed"] is False
+
+    def test_canary_rolls_back_on_firing_alert(self, tmp_path,
+                                               serving_registry):
+        clock = ManualTimeSource(0)
+        alerts = _FakeAlerts()
+        cfg = json.loads(json.dumps(_E2E_CONFIG))
+        cfg["gate"] = {"metric": "loss", "rel_margin": 1.0,
+                       "abs_margin": 1.0}  # gate passes; canary decides
+        cfg["canary"]["abort_on_alerts"] = ["slo_burn"]
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               alerts=alerts, config=cfg)
+        orig_wait = pipe.canary_wait
+        ticks = []
+
+        def wait_then_fire(poll_s):
+            orig_wait(poll_s)
+            ticks.append(1)
+            if len(ticks) == 1:
+                alerts.rules = ["slo_burn"]  # SLO burns mid-ramp
+
+        pipe.canary_wait = wait_then_fire
+        summary = pipe.run_cycle()
+        assert summary["outcome"] == "ROLLBACK", summary
+        assert serving_registry.get("m").current_version == 1
+        assert "slo_burn" in summary["detail"]["reason"]
+        # no traffic plumbing survives the rollback
+        assert serving_registry.get_traffic_split("m") == {}
+        assert serving_registry.shadow_state("m") is None
+
+
+# ---------------------------------------------------------------------------
+# crash-resume matrix: kill at every stage boundary, restart, converge
+# ---------------------------------------------------------------------------
+
+class _Killed(BaseException):
+    """Stand-in for SIGKILL: raised by the patched fault-injector kill so
+    the 'process death' unwinds the pipeline mid-transition without
+    tearing down the test process."""
+
+
+@pytest.fixture
+def fault_kill(monkeypatch):
+    """Arm a kill at journal seq N for the 'pipeline' fault slot."""
+
+    def arm(seq):
+        plan = faultinject.FaultPlan.parse(
+            {"faults": [{"type": "kill", "worker": "pipeline",
+                         "step": int(seq)}]})
+        faultinject.set_plan(plan)
+
+    def killer(pid, signum):
+        faultinject.set_plan(None)  # one shot
+        raise _Killed(f"fault-injected kill (pid {pid}, sig {signum})")
+
+    monkeypatch.setattr(faultinject, "_kill", killer)
+    yield arm
+    faultinject.set_plan(None)
+
+
+def _reference_seq_map(tmp_path_factory):
+    """One clean run to learn which journal seq each stage boundary
+    lands on (deterministic: same config, same data)."""
+    rng = np.random.default_rng(5)
+    net = _small_net()
+    net.fit(DataSet(*_mesh_data(rng, 128)), epochs=3)
+    reg = ModelRegistry(wait_ms=0.5, buckets=[2, 16])
+    reg.register("m", model=net,
+                 sample_input=np.zeros((1, 6), np.float32))
+    state = tmp_path_factory.mktemp("ref")
+    pipe = _build_pipeline(state, reg, ManualTimeSource(0))
+    assert pipe.run_cycle()["outcome"] == "PROMOTE"
+    seq_map = {}
+    for r in pipe.sm.journal.records():
+        if r["event"] in ("enter", "commit"):
+            seq_map[(r["stage"], r["event"])] = r["seq"]
+    reg.shutdown()
+    return seq_map
+
+
+_KILL_POINTS = [("TRAIN", "enter"), ("TRAIN", "commit"),
+                ("EVAL", "enter"), ("EVAL", "commit"),
+                ("CANARY", "enter"), ("CANARY", "commit"),
+                ("PROMOTE", "enter"), ("PROMOTE", "commit")]
+
+
+@pytest.fixture(scope="module")
+def seq_map(tmp_path_factory):
+    return _reference_seq_map(tmp_path_factory)
+
+
+class TestCrashResumeMatrix:
+    @pytest.mark.parametrize("stage,event", _KILL_POINTS,
+                             ids=[f"{s}-{e}" for s, e in _KILL_POINTS])
+    def test_kill_restart_converges_to_single_promote(
+            self, tmp_path, serving_registry, fault_kill, seq_map,
+            stage, event):
+        """Kill the pipeline exactly when the (stage, event) record lands
+        in the journal; a fresh pipeline over the same journal + registry
+        must converge to the SAME terminal state as an unkilled run —
+        exactly one PROMOTE commit, zero ROLLBACKs, candidate live."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        fault_kill(seq_map[(stage, event)])
+        with pytest.raises(_Killed):
+            pipe.run_cycle()
+
+        # restart: new machine over the same journal; the dead process's
+        # token is fenced, its in-flight transition un-committable
+        resumed = _build_pipeline(tmp_path, serving_registry, clock)
+        rp = resumed.sm.resume_point()
+        if rp is not None:
+            assert rp.run == 1
+            summary = resumed.run_cycle()
+        else:
+            # the terminal commit itself landed before the kill — the run
+            # is already decided; a new cycle would start run 2
+            summary = {"outcome": resumed.sm.decided(1)}
+        assert summary["outcome"] == "PROMOTE", (stage, event, summary)
+
+        terminals = [r for r in resumed.sm.journal.records()
+                     if r.get("event") == "commit"
+                     and r.get("stage") in ("PROMOTE", "ROLLBACK")]
+        assert [(r["run"], r["stage"]) for r in terminals] == \
+            [(1, "PROMOTE")], terminals
+        served = serving_registry.get("m")
+        promoted = [r for r in resumed.sm.journal.records()
+                    if (r.get("stage"), r.get("event")) ==
+                    ("PROMOTE", "commit")][0]["data"]["version"]
+        assert served.current_version == promoted
+        # the zombie cannot decide the run a second time
+        with pytest.raises((StalePipelineError, AlreadyDecided,
+                            IllegalTransition)):
+            pipe.sm.commit("PROMOTE", version=99)
+
+    def test_kill_at_begin_run_continues_same_run(
+            self, tmp_path, serving_registry, fault_kill):
+        """A crash right after begin_run must not abandon run 1
+        undecided: the restart CONTINUES run 1 (one terminal per run)."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        fault_kill(1)  # the 'run' journal record itself
+        with pytest.raises(_Killed):
+            pipe.run_cycle()
+        resumed = _build_pipeline(tmp_path, serving_registry, clock)
+        assert resumed.sm.open_empty_run()
+        summary = resumed.run_cycle()
+        assert summary["run"] == 1 and summary["outcome"] == "PROMOTE"
+        runs = [r["run"] for r in resumed.sm.journal.records()
+                if r.get("event") == "run"]
+        assert runs == [1]
+
+    def test_kill_mid_canary_rollback_run_stays_rollback(
+            self, tmp_path, serving_registry, fault_kill, seq_map):
+        """The degraded-candidate run killed mid-flight still converges
+        to exactly one ROLLBACK (never a promote) after restart."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               invert=True)
+        fault_kill(seq_map[("EVAL", "commit")])
+        with pytest.raises(_Killed):
+            pipe.run_cycle()
+        resumed = _build_pipeline(tmp_path, serving_registry, clock,
+                                  invert=True)
+        summary = resumed.run_cycle()
+        assert summary["outcome"] == "ROLLBACK", summary
+        terminals = [r for r in resumed.sm.journal.records()
+                     if r.get("event") == "commit"
+                     and r.get("stage") in ("PROMOTE", "ROLLBACK")]
+        assert [(r["run"], r["stage"]) for r in terminals] == \
+            [(1, "ROLLBACK")], terminals
+        assert serving_registry.get("m").current_version == 1
+
+
+# ---------------------------------------------------------------------------
+# review hardening: cross-process promote restore, warm-wait, retention,
+# sync-path deadlines
+# ---------------------------------------------------------------------------
+
+class TestReviewHardening:
+    def test_restore_promoted_across_processes(self, tmp_path,
+                                               serving_registry):
+        """A restarted process registers the ORIGINAL baseline; the
+        journal's committed PROMOTE must be re-applied or the pipeline
+        silently serves (and exports) pre-promotion weights."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        assert pipe.run_cycle()["outcome"] == "PROMOTE"
+        promoted = serving_registry.get("m")
+        promoted_model = promoted.versions[promoted.current_version].model
+        probe = np.zeros((2, 6), np.float32)
+        want = np.asarray(promoted_model.output(probe))
+
+        # "restart": a fresh registry holding only the stale baseline
+        rng = np.random.default_rng(5)
+        baseline = _small_net()
+        baseline.fit(DataSet(*_mesh_data(rng, 128)), epochs=3)
+        fresh = ModelRegistry(wait_ms=0.5, buckets=[2, 16])
+        fresh.register("m", model=baseline,
+                       sample_input=np.zeros((1, 6), np.float32))
+        try:
+            resumed = _build_pipeline(tmp_path, fresh, clock)
+            v = resumed.restore_promoted()
+            assert v is not None
+            served = fresh.get("m")
+            assert served.current_version == v
+            got = np.asarray(served.versions[v].model.output(probe))
+            np.testing.assert_allclose(got, want, rtol=1e-5)
+        finally:
+            fresh.shutdown()
+
+    def test_restore_promoted_noop_without_promote(self, tmp_path,
+                                                   serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               invert=True)
+        assert pipe.run_cycle()["outcome"] == "ROLLBACK"
+        resumed = _build_pipeline(tmp_path, serving_registry, clock)
+        assert resumed.restore_promoted() is None
+
+    def test_rollback_retires_candidate_and_checkpoint(
+            self, tmp_path, serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               invert=True)
+        assert pipe.run_cycle()["outcome"] == "ROLLBACK"
+        served = serving_registry.get("m")
+        assert sorted(served.versions) == [1]      # candidate retired
+        assert 2 not in served.warmup_state
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "candidate_run0001.zip"))
+
+    def test_promote_prunes_older_candidate_zips(self, tmp_path,
+                                                 serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock,
+                               invert=True)
+        assert pipe.run_cycle()["outcome"] == "ROLLBACK"
+        pipe2 = _build_pipeline(tmp_path, serving_registry, clock)
+        assert pipe2.run_cycle()["outcome"] == "PROMOTE"
+        zips = [n for n in os.listdir(str(tmp_path))
+                if n.startswith("candidate_run") and n.endswith(".zip")]
+        assert zips == ["candidate_run0002.zip"]   # promoted run only
+
+    def test_canary_waits_out_async_warmup_error_via_rewarm(
+            self, tmp_path, serving_registry):
+        """A FAILED candidate warmup gets one rewarm() instead of
+        crash-looping on the warm-gated traffic split."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        real = serving_registry.warmup_state
+        flips = []
+
+        def flaky(name, version=None):
+            if version == 2 and not flips:
+                flips.append(1)
+                return {"status": "error", "reason": "transient OOM"}
+            return real(name, version)
+
+        serving_registry.warmup_state = flaky
+        try:
+            summary = pipe.run_cycle()
+        finally:
+            del serving_registry.warmup_state
+        assert summary["outcome"] == "PROMOTE", summary
+        assert flips  # the error path was actually exercised
+
+    def test_canary_rolls_back_when_candidate_never_warms(
+            self, tmp_path, serving_registry):
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        pipe.warm_timeout_s = 0.2
+        serving_registry.warmup_state = \
+            lambda name, version=None: {"status": "warming"}
+        try:
+            summary = pipe.run_cycle()
+        finally:
+            del serving_registry.warmup_state
+        assert summary["outcome"] == "ROLLBACK", summary
+        assert "warm" in summary["detail"]["reason"]
+        assert serving_registry.get("m").current_version == 1
+
+    def test_lost_candidate_resolves_to_rollback_not_crash_loop(
+            self, tmp_path, serving_registry, fault_kill, seq_map):
+        """A resumed run whose candidate is unrecoverable (fresh
+        registry, checkpoint deleted) must DECIDE — a journaled ROLLBACK
+        — instead of raising on every restart forever."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        fault_kill(seq_map[("EVAL", "enter")])
+        with pytest.raises(_Killed):
+            pipe.run_cycle()
+        for n in os.listdir(str(tmp_path)):      # lose the checkpoint
+            if n.endswith(".zip"):
+                os.unlink(os.path.join(str(tmp_path), n))
+        rng = np.random.default_rng(5)
+        baseline = _small_net()
+        baseline.fit(DataSet(*_mesh_data(rng, 128)), epochs=3)
+        fresh = ModelRegistry(wait_ms=0.5, buckets=[2, 16])
+        fresh.register("m", model=baseline,
+                       sample_input=np.zeros((1, 6), np.float32))
+        try:
+            resumed = _build_pipeline(tmp_path, fresh, clock)
+            summary = resumed.run_cycle()
+            assert summary["outcome"] == "ROLLBACK", summary
+            assert "candidate lost" in summary["detail"]["reason"]
+            terminals = [r for r in resumed.sm.journal.records()
+                         if r.get("event") == "commit"
+                         and r.get("stage") in ("PROMOTE", "ROLLBACK")]
+            assert [(r["run"], r["stage"]) for r in terminals] == \
+                [(1, "ROLLBACK")], terminals
+            # and the journal is at IDLE: the next cycle is a fresh run
+            assert resumed.sm.resume_point() is None
+        finally:
+            fresh.shutdown()
+
+    def test_unregister_validation_and_cleanup(self):
+        reg = _stub_registry()
+        try:
+            with pytest.raises(ValueError):
+                reg.unregister("m", 1)             # live version refused
+            reg.set_traffic_split("m", {2: 0.25})
+            reg.set_shadow("m", 2, sample=1.0)
+            reg.unregister("m", 2)
+            assert reg.get_traffic_split("m") == {}
+            assert reg.shadow_state("m") is None
+            assert sorted(reg.get("m").versions) == [1]
+            with pytest.raises(Exception):
+                reg.predict_versioned("m", np.ones((1, 4)), version=2)
+        finally:
+            reg.shutdown()
+
+    def test_versions_never_reused_after_unregister(self):
+        """Journals and per-version metric series must never conflate
+        two candidates under one number."""
+        reg = _stub_registry()
+        try:
+            reg.unregister("m", 2)
+            v = reg.register("m", model=_Stub(3.0), activate=False)
+            assert v == 3
+        finally:
+            reg.shutdown()
+
+    def test_failed_stream_rolls_back_instead_of_promoting(
+            self, tmp_path, serving_registry):
+        """A route that DIED (error set) is not a drained one — the
+        partially-trained candidate must not reach the gate."""
+        clock = ManualTimeSource(0)
+        pipe = _build_pipeline(tmp_path, serving_registry, clock)
+        boom = RuntimeError("kafka gone")
+        bad_route = Route().from_source([1]).to_list([])
+        bad_route.error = boom
+        pipe.route = bad_route
+        # one mini-epoch of data arrives, then the stream 'fails'
+        pipe.buffer = StreamBuffer()
+        rng = np.random.default_rng(42)
+        pipe.buffer.put(DataSet(*_mesh_data(rng, 32)))
+        pipe.config.train["take_timeout_s"] = 0.1
+        summary = pipe.run_cycle()
+        assert summary["outcome"] == "ROLLBACK", summary
+        assert "stream failed" in summary["detail"]["reason"]
+
+    def test_sync_routed_path_honors_deadline(self):
+        from deeplearning4j_tpu.parallel.inference import (
+            InferenceDeadlineExceeded)
+
+        class Slow(_Stub):
+            def output(self, x):
+                time.sleep(0.05)
+                return super().output(x)
+
+        reg = ModelRegistry(wait_ms=0.5)
+        try:
+            reg.register("m", model=_Stub(1.0))
+            reg.register("m", model=Slow(2.0), activate=False)
+            with pytest.raises(InferenceDeadlineExceeded):
+                reg.predict_versioned("m", np.ones((1, 4)), version=2,
+                                      deadline_s=0.001)
+            out, v = reg.predict_versioned("m", np.ones((1, 4)),
+                                           version=2, deadline_s=5.0)
+            assert v == 2 and float(out[0, 0]) == 2.0
+        finally:
+            reg.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestPipelineCLI:
+    def test_rejects_in_process_only_flags(self, tmp_path, capsys):
+        from deeplearning4j_tpu import cli
+        with pytest.raises(SystemExit) as ei:
+            cli.pipeline_main([
+                "--modelPath", "m.zip", "--dataPath", "d.npz",
+                "--config", "c.json", "--state-dir", str(tmp_path),
+                "--trace", "out.json", "--watchdog", "raise"])
+        assert ei.value.code == 2
+        err = capsys.readouterr().err
+        assert "--trace" in err and "--watchdog" in err
+        assert "train.watchdog" in err
+
+    def test_rejects_bad_eval_fraction(self, tmp_path, capsys):
+        from deeplearning4j_tpu import cli
+        with pytest.raises(SystemExit):
+            cli.pipeline_main([
+                "--modelPath", "m.zip", "--dataPath", "d.npz",
+                "--config", "c.json", "--state-dir", str(tmp_path),
+                "--eval-fraction", "1.5"])
+
+    @pytest.mark.multiprocess
+    def test_subprocess_kill_mid_canary_resumes(self, tmp_path):
+        """The acceptance proof, with a REAL process and a REAL SIGKILL:
+        a fault plan kills the pipeline CLI mid-CANARY (journal seq 8 =
+        the first ramp note); re-running the same command resumes from
+        the journal and converges — exactly one PROMOTE, never two."""
+        from deeplearning4j_tpu.util import model_serializer
+
+        rng = np.random.default_rng(5)
+        net = _small_net()
+        net.fit(DataSet(*_mesh_data(rng, 128)), epochs=3)
+        model_path = str(tmp_path / "model.zip")
+        model_serializer.write_model(net, model_path)
+        x, y = _mesh_data(rng, 160)
+        data_path = str(tmp_path / "data.npz")
+        np.savez(data_path, features=x, labels=y)
+        config = dict(_E2E_CONFIG,
+                      canary=dict(_E2E_CONFIG["canary"],
+                                  schedule=[{"fraction": 0.25,
+                                             "hold_s": 0.2},
+                                            {"fraction": 0.5,
+                                             "hold_s": 0.2}],
+                                  poll_s=0.05))
+        config_path = str(tmp_path / "pipeline.json")
+        with open(config_path, "w") as fh:
+            json.dump(config, fh)
+        state_dir = str(tmp_path / "state")
+        plan = json.dumps({"faults": [{"type": "kill",
+                                       "worker": "pipeline", "step": 8}]})
+        argv = [sys.executable, "-m", "deeplearning4j_tpu.cli", "pipeline",
+                "--modelPath", model_path, "--dataPath", data_path,
+                "--config", config_path, "--state-dir", state_dir,
+                "--cycles", "1"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8",
+                   PYTHONPATH=os.pathsep.join(
+                       p for p in (REPO,
+                                   os.environ.get("PYTHONPATH", "")) if p))
+
+        first = subprocess.run(
+            argv, env=dict(env, DL4J_TPU_FAULT_PLAN=plan),
+            timeout=300, capture_output=True, text=True)
+        assert first.returncode == -9, (first.returncode, first.stdout,
+                                        first.stderr)
+        journal = os.path.join(state_dir, "pipeline_journal.jsonl")
+        mid = [json.loads(l) for l in open(journal) if l.endswith("\n")]
+        assert any(r.get("stage") == "CANARY" and r.get("event") == "enter"
+                   for r in mid)
+        assert not any(r.get("stage") in ("PROMOTE", "ROLLBACK")
+                       and r.get("event") == "commit" for r in mid)
+
+        second = subprocess.run(argv, env=env, timeout=300,
+                                capture_output=True, text=True)
+        assert second.returncode == 0, (second.stdout[-2000:],
+                                        second.stderr[-2000:])
+        assert "run 1: PROMOTE" in second.stdout, second.stdout
+        final = [json.loads(l) for l in open(journal) if l.endswith("\n")]
+        terminals = [(r["run"], r["stage"]) for r in final
+                     if r.get("event") == "commit"
+                     and r.get("stage") in ("PROMOTE", "ROLLBACK")]
+        assert terminals == [(1, "PROMOTE")], terminals
+
+        # multi-cycle: each cycle gets its OWN stream pass — a greedy
+        # first cycle must not starve later ones into aborted rollbacks
+        state2 = str(tmp_path / "state2")
+        third = subprocess.run(
+            [a if a != state_dir else state2 for a in argv[:-2]]
+            + ["--cycles", "2"],
+            env=env, timeout=300, capture_output=True, text=True)
+        assert third.returncode == 0, (third.stdout[-2000:],
+                                       third.stderr[-2000:])
+        j2 = os.path.join(state2, "pipeline_journal.jsonl")
+        recs = [json.loads(l) for l in open(j2) if l.endswith("\n")]
+        trains = [r for r in recs if (r.get("stage"), r.get("event"))
+                  == ("TRAIN", "commit")]
+        assert len(trains) == 2
+        assert all("aborted" not in r.get("data", {}) for r in trains), \
+            [r.get("data") for r in trains]

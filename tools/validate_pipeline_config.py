@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Pipeline-config validator: schema check + dry-run lint.
+
+Validates a continuous-training pipeline config (the
+``deeplearning4j_tpu.pipeline.PipelineConfig`` schema the ``pipeline``
+CLI subcommand consumes) the same way ``tools/validate_alert_rules.py``
+and ``tools/validate_fault_plan.py`` validate their files: importable
+(``validate_file``/``validate_config`` return a list of problems, empty
+= valid) and runnable
+(``python tools/validate_pipeline_config.py CONFIG.json [...]``).
+
+Two passes:
+
+1. **schema** — the file must build through ``PipelineConfig.parse``
+   (unknown sections/keys, bad types, malformed canary schedules and
+   gate metrics all surface here with the offending field);
+2. **dry run** — ``PipelineConfig.lint`` flags configs that parse but
+   cannot behave as written: a shadow-divergence budget with shadow
+   sampling off, a schedule that holds nothing, a strict gate with no
+   earlier watchdog signal.  Nothing is executed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from deeplearning4j_tpu.pipeline import PipelineConfig  # noqa: E402
+
+
+def validate_config(spec) -> List[str]:
+    """Return a list of problems (empty = valid). ``spec`` is a parsed
+    dict, a JSON string, or a path."""
+    try:
+        cfg = PipelineConfig.parse(spec)
+    except (ValueError, KeyError, TypeError, OSError,
+            json.JSONDecodeError) as e:
+        return [f"schema: {e}"]
+    return [f"lint: {p}" for p in cfg.lint()]
+
+
+def validate_file(path: str) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable config file: {e}"]
+    return validate_config(spec)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print("usage: validate_pipeline_config.py CONFIG.json "
+              "[CONFIG.json ...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            cfg = PipelineConfig.parse(path)
+            print(f"OK   {path}: pipeline {cfg.name!r}, "
+                  f"{len(cfg.canary['schedule'])} canary step(s), "
+                  f"gate metric {cfg.gate['metric']}")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
