@@ -37,6 +37,12 @@ that entry and never blocks the headline line.
 headline included — with the observe tracer and writes one Chrome-trace
 JSON per config into DIR (``<name>.trace.json``): per-step spans with the
 XLA compile spans attributed to the steps that paid for them.
+
+``--pod-scaling [OUT.json]`` runs the pod-scale elastic series instead of
+the headline (MULTICHIP_r06: step time vs world size on the mesh, and
+the per-step checkpoint save stall sync vs async — the async overlapped
+path must beat the blocking one). ``--save-mode sync|async`` restricts
+the save-stall half to one mode.
 """
 
 import json
@@ -325,6 +331,169 @@ SUITE = {
 }
 
 
+# -- pod-scale elastic series (MULTICHIP_r06) --------------------------------
+
+def _scaling_net(seed=1, width=512):
+    """A model big enough that its checkpoint write is measurable (~1M
+    params ≈ 4 MB of f32 + updater state) but cheap to step on CPU."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(OutputLayer(n_out=10))
+            .set_input_type(InputType.feed_forward(width)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(seed)
+    batch = 128
+    x = jnp.asarray(rng.normal(size=(batch, width)).astype(np.float32))
+    y = jnp.asarray(
+        np.eye(10, dtype=np.float32)[rng.integers(0, 10, size=batch)])
+    return net, DataSet(x, y), batch
+
+
+def _pod_scaling_worlds(steps=8, warmup=3):
+    """Step time vs data-parallel world size on the local mesh — the
+    scaling half of the curve."""
+    import jax
+
+    from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                     ListDataSetIterator)
+    from deeplearning4j_tpu.parallel import (DistributedMultiLayerNetwork,
+                                             SharedTrainingMaster)
+    from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    worlds = []
+    for w in (1, 2, 4, 8):
+        if w > len(devices):
+            break
+        net, ds, batch = _scaling_net()
+        mesh = make_mesh({"data": w}, devices=devices[:w])
+        master = SharedTrainingMaster(batch_size_per_worker=batch // w,
+                                      threshold=1e-3, mesh=mesh)
+        front = DistributedMultiLayerNetwork(net, master)
+        x = np.asarray(ds.features)
+        y = np.asarray(ds.labels)
+        it = lambda: ListDataSetIterator(DataSet(x, y), batch)  # noqa: E731
+        front.fit(it(), epochs=warmup)  # compile + warm
+        t0 = time.perf_counter()
+        front.fit(it(), epochs=steps)
+        wall_ms = (time.perf_counter() - t0) / steps * 1e3
+        worlds.append({"world": w,
+                       "wall_ms_per_step": round(wall_ms, 2),
+                       "items_per_sec": round(batch / wall_ms * 1e3, 1)})
+    return worlds
+
+
+def _pod_save_stall(mode, tmp_dir, steps=6):
+    """Per-step checkpoint stall for one save mode: the wall time the
+    TRAINING thread loses to each per-step checkpoint. sync = full
+    orbax save + finalize on the step path; async = snapshot + bounded
+    submit (AsyncCheckpointSession), commit runs behind the next
+    steps."""
+    import shutil
+
+    from deeplearning4j_tpu.parallel.elastic import (AsyncCheckpointSession,
+                                                     ElasticWorkerContext)
+    from deeplearning4j_tpu.util.orbax_checkpoint import (
+        OrbaxCheckpointManager)
+
+    net, ds, batch = _scaling_net()
+    d = os.path.join(tmp_dir, f"save_{mode}")
+    shutil.rmtree(d, ignore_errors=True)
+    for _ in range(3):
+        net._fit_batch(ds)
+    float(net.score_)
+    stalls = []
+    mgr = OrbaxCheckpointManager(d, max_to_keep=2)
+    session = None
+    committed = 0
+    if mode == "async":
+        ctx = ElasticWorkerContext(
+            coordinator="", num_processes=1, process_id=0, slot=0,
+            generation=1, token="bench", ckpt_dir=d,
+            heartbeat_path=os.path.join(d, "hb"), restore_step=None)
+        session = AsyncCheckpointSession(ctx, manager=mgr,
+                                         max_in_flight=2)
+    t_train0 = time.perf_counter()
+    for step in range(1, steps + 1):
+        net._fit_batch(ds)
+        float(net.score_)
+        t0 = time.perf_counter()
+        if session is not None:
+            session.submit(step, net)
+        else:
+            if mgr.save(step, net, overwrite_existing=True):
+                committed += 1
+            mgr.wait_until_finished()
+        stalls.append(time.perf_counter() - t0)
+    total_wall = time.perf_counter() - t_train0
+    if session is not None:
+        flushed = session.close(timeout=300)
+        committed = len(session.committed)
+    else:
+        flushed = True
+    # a timed-out flush means the saver thread may still be inside a
+    # manager call — do NOT close the manager under it (same rule as
+    # run_elastic_worker); process exit reclaims it, and the record
+    # reports flushed=false
+    if flushed:
+        mgr.close()
+    return {"mode": mode,
+            "save_stall_ms_per_step": round(
+                sum(stalls) / len(stalls) * 1e3, 2),
+            "save_stall_ms_max": round(max(stalls) * 1e3, 2),
+            "wall_ms_per_step_with_saves": round(
+                total_wall / steps * 1e3, 2),
+            "steps": steps, "flushed": flushed,
+            "committed_steps": committed}
+
+
+def _pod_scaling_main(out_path, save_mode):
+    import tempfile
+
+    import jax
+    record = {
+        "metric": "pod_scale_elastic",
+        "series": "MULTICHIP_r06",
+        "config": "3-layer 512-wide MLP (~790k params, Adam), B=128 f32, "
+                  "per-step orbax checkpoint rotation",
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "note": "worlds = step time vs data-axis size on the local "
+                "device mesh (on the virtual CPU mesh collective overhead "
+                "dominates at this model size, so the curve RISES — the "
+                "series exists to track the shape run-over-run and on "
+                "real ICI); save = per-step checkpoint stall on the "
+                "training thread, sync vs async commit path",
+        "worlds": _pod_scaling_worlds(),
+        "save": {},
+    }
+    modes = ("sync", "async") if save_mode is None else (save_mode,)
+    with tempfile.TemporaryDirectory(prefix="pod_bench_") as td:
+        for mode in modes:
+            record["save"][mode] = _pod_save_stall(mode, td)
+    if {"sync", "async"} <= set(record["save"]):
+        sync_ms = record["save"]["sync"]["save_stall_ms_per_step"]
+        async_ms = record["save"]["async"]["save_stall_ms_per_step"]
+        record["async_stall_vs_sync"] = round(async_ms / sync_ms, 4) \
+            if sync_ms > 0 else None
+    line = json.dumps(record, indent=2)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(line)
+
+
 def main():
     record = _with_trace("resnet50_headline", _resnet50_headline)
     if os.environ.get("DL4J_TPU_BENCH_HEADLINE_ONLY") != "1":
@@ -338,7 +507,27 @@ def main():
     print(json.dumps(record))
 
 
+def _parse_pod_args():
+    """(--pod-scaling out_path_or_None, --save-mode or None); returns
+    (False, None, None) when --pod-scaling is absent. Unknown flags
+    (--trace etc.) belong to the headline path and pass through."""
+    if "--pod-scaling" not in sys.argv[1:]:
+        return False, None, None
+    import argparse
+    ap = argparse.ArgumentParser("bench --pod-scaling", add_help=False)
+    ap.add_argument("--pod-scaling", nargs="?", default=None,
+                    metavar="OUT.json", dest="out")
+    ap.add_argument("--save-mode", choices=("sync", "async"),
+                    default=None, dest="mode")
+    args, _unknown = ap.parse_known_args(sys.argv[1:])
+    return True, args.out, args.mode
+
+
 if __name__ == "__main__":
+    pod, _pod_out, _pod_mode = _parse_pod_args()
+    if pod:
+        _pod_scaling_main(_pod_out, _pod_mode)
+        raise SystemExit(0)
     # one retry IN A FRESH PROCESS: the tunneled TPU link occasionally
     # drops a request mid-compile, and jax's cached PJRT client stays
     # broken for the life of the process — only a re-exec gets a new

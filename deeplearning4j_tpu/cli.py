@@ -77,6 +77,31 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                     dest="heartbeat_timeout",
                     help="seconds without a worker heartbeat before the "
                          "supervisor declares it hung and recovers")
+    ap.add_argument("--hosts", type=int, default=None,
+                    help="group the --elastic workers into this many host "
+                         "failure domains: a worker death marks its WHOLE "
+                         "host the victim, restart budgets charge the "
+                         "host, shrink removes the host (per-host slice "
+                         "shapes stay valid). Coordinator bind/advertise "
+                         "addresses come from DL4J_TPU_ELASTIC_BIND_HOST/"
+                         "DL4J_TPU_ELASTIC_ADVERTISE_HOST (default "
+                         "loopback)")
+    ap.add_argument("--min-hosts", type=int, default=1, dest="min_hosts",
+                    help="smallest number of host groups --elastic may "
+                         "shrink to before the job fails loudly")
+    ap.add_argument("--save-mode", choices=("sync", "async"),
+                    default="sync", dest="save_mode",
+                    help="worker checkpoint path: async overlaps orbax "
+                         "saves with training (bounded in-flight, "
+                         "all-ranks commit protocol); sync blocks the "
+                         "step until the save lands")
+    ap.add_argument("--progress-timeout", type=float, default=None,
+                    dest="progress_timeout",
+                    help="arm the partition watchdog: seconds without "
+                         "step progress anywhere (while heartbeats stay "
+                         "alive) before the supervisor resolves a "
+                         "network partition by killing the "
+                         "least-progressed side")
     args = ap.parse_args(argv)
 
     if args.elastic is not None:
@@ -210,12 +235,15 @@ def _elastic_train(args):
         "--out", args.modelOutputPath,
         "--batchSize", str(args.batchSize),
         "--epochs", str(args.epochs),
+        "--save-mode", args.save_mode,
     ])
     supervisor = ElasticJobSupervisor(
         spec, num_workers=args.elastic, min_workers=args.min_workers,
+        num_hosts=args.hosts, min_hosts=args.min_hosts,
         ckpt_dir=args.ckpt_dir,
         backoff=BackoffPolicy(max_restarts=args.max_restarts),
-        heartbeat_timeout_s=args.heartbeat_timeout)
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        progress_timeout_s=args.progress_timeout)
     try:
         result = supervisor.run()
     finally:

@@ -45,12 +45,18 @@ class CrossSliceGradientBridge:
     """
 
     def __init__(self, publisher, consumer, threshold: float = 1e-3,
-                 capacity_fraction: float = 0.25, slice_id: str = "slice"):
+                 capacity_fraction: float = 0.25, slice_id: str = "slice",
+                 host: Optional[int] = None):
+        from deeplearning4j_tpu.util import faultinject
         self.publisher = publisher
         self.consumer = consumer
         self.threshold = float(threshold)
         self.capacity_fraction = capacity_fraction
         self.slice_id = slice_id
+        # host failure domain this endpoint lives in (rides every frame
+        # header so receivers can honor a DCN partition between host
+        # groups); defaults to the elastic supervisor's assignment
+        self.host = faultinject.current_host() if host is None else host
         # {layer_key: {param_name: flat f32 residual}}; _prev mirrors it with
         # the param values as of the last exchange
         self._residual: Optional[Dict] = None
@@ -137,11 +143,13 @@ class CrossSliceGradientBridge:
         self._seq = seq + 1
         header = json.dumps({"slice": self.slice_id, "seq": seq,
                              "inc": self._incarnation,
+                             "host": self.host,
                              "threshold": self.threshold,
                              "sections": sections}).encode()
         frame = struct.pack(">I", len(header)) + header + b"".join(blobs)
         from deeplearning4j_tpu.util import faultinject
-        for out in faultinject.on_dcn_send(self.slice_id, seq, frame):
+        for out in faultinject.on_dcn_send(self.slice_id, seq, frame,
+                                           host=self.host):
             # an injected [] drops the frame IN TRANSIT: the sender has
             # committed (seq consumed, residual extracted) exactly like a
             # frame lost on the wire after a successful send
@@ -160,6 +168,7 @@ class CrossSliceGradientBridge:
         import jax.numpy as jnp
 
         from deeplearning4j_tpu.native import decode_threshold
+        from deeplearning4j_tpu.util import faultinject
 
         self._ensure_residual(params)
         applied = 0
@@ -182,6 +191,13 @@ class CrossSliceGradientBridge:
                 # own broadcast echoed back (broker fan-out); skip payload
                 continue
             seq = meta.get("seq")
+            if seq is not None and not faultinject.on_dcn_recv(
+                    self.slice_id, int(seq), frame_host=meta.get("host"),
+                    host=self.host):
+                log.warning("Dropping frame %s from %s: DCN partition "
+                            "between host groups %s and %s", seq,
+                            slice_tag, self.host, meta.get("host"))
+                continue
             if seq is not None:
                 inc = meta.get("inc")
                 peer = self._last_seq.setdefault(slice_tag, {})

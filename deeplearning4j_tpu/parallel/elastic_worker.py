@@ -30,6 +30,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--threshold", type=float, default=1e-3)
     ap.add_argument("--checkpoint-every", type=int, default=1,
                     dest="checkpoint_every")
+    ap.add_argument("--save-mode", choices=("sync", "async"),
+                    default="sync", dest="save_mode",
+                    help="checkpoint commit path: sync blocks training "
+                         "for the whole save; async overlaps the save "
+                         "with the next steps (bounded in-flight, "
+                         "stamped only after every rank's shard lands)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -60,6 +66,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         master_kwargs={"batch_size_per_worker": args.batchSize,
                        "threshold": args.threshold},
         checkpoint_every=args.checkpoint_every,
+        save_mode=args.save_mode,
         on_done=on_done)
     return 0
 

@@ -44,16 +44,26 @@ from deeplearning4j_tpu.parallel.trainer import ParallelWrapper
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
-                     process_id: Optional[int] = None) -> None:
+                     process_id: Optional[int] = None,
+                     coordinator_bind_address: Optional[str] = None) -> None:
     """Multi-host entry: join the JAX coordination service (replaces the
     reference's Aeron introduction/shard protocol,
-    `SharedTrainingWrapper.java:214-244`). No-op when single-process."""
+    `SharedTrainingWrapper.java:214-244`). No-op when single-process.
+
+    ``coordinator_bind_address`` lets process 0 listen on a different
+    interface than the one peers dial (``coordinator_address`` is the
+    ADVERTISED address) — NAT/container pods where 0.0.0.0 must be bound
+    but a routable name advertised. ``None`` keeps jax's default (bind
+    the advertised address)."""
     if num_processes is None or num_processes <= 1:
         return
     _enable_cpu_collectives()
+    kwargs = {}
+    if coordinator_bind_address is not None:
+        kwargs["coordinator_bind_address"] = coordinator_bind_address
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
-                               process_id=process_id)
+                               process_id=process_id, **kwargs)
 
 
 def _enable_cpu_collectives() -> None:
@@ -362,17 +372,18 @@ class SharedTrainingMaster(TrainingMaster):
     # state too — the reference has no analog (its accumulator dies with
     # the worker; membership is fixed — SharedTrainingWrapper.java:131).
 
-    def save_state(self, path: str) -> None:
-        """Write this PROCESS's compression state (threshold machinery +
-        its local residual shard) as an npz. In a multi-process run every
-        process must save its own file — residual shards differ."""
-        scalars = {
+    def state_snapshot(self) -> dict:
+        """This PROCESS's compression state (threshold machinery + its
+        local residual shard) as host numpy arrays — the rank-local
+        checkpoint shard, decoupled from the live training state so an
+        async save thread can write it while the next step mutates the
+        residual (:func:`write_state_snapshot`)."""
+        snap = {
             "threshold": np.float64(self.threshold),
             "steps_done": np.int64(self._steps_done),
             "shake_restore": np.float64(
                 -1.0 if self._shake_restore is None else self._shake_restore),
         }
-        arrays = {}
         if self._residual is not None:
             leaves = jax.tree_util.tree_leaves(self._residual)
             for i, leaf in enumerate(leaves):
@@ -382,17 +393,28 @@ class SharedTrainingMaster(TrainingMaster):
                     # of the worker-stacked residual (axis 0)
                     shards = sorted(leaf.addressable_shards,
                                     key=lambda s: s.index[0].start or 0)
-                    arrays[f"res{i}"] = np.concatenate(
+                    snap[f"res{i}"] = np.concatenate(
                         [np.asarray(s.data) for s in shards], axis=0)
                 else:
-                    arrays[f"res{i}"] = np.asarray(leaf)
-        # atomic: the elastic commit protocol (elastic.py save_checkpoint)
-        # treats this file's EXISTENCE as "shard landed" — a torn write
-        # from a mid-save kill must never be stampable as committed
+                    snap[f"res{i}"] = np.asarray(leaf).copy()
+        return snap
+
+    @staticmethod
+    def write_state_snapshot(snapshot: dict, path: str) -> None:
+        """Write a :meth:`state_snapshot` npz atomically. The elastic
+        commit protocol (elastic.py) treats this file's EXISTENCE as
+        "shard landed" — a torn write from a mid-save kill must never be
+        stampable as committed."""
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as fh:  # handle, not path: savez would
-            np.savez(fh, **scalars, **arrays)  # append .npz to the name
+            np.savez(fh, **snapshot)  # append .npz to the name
         os.replace(tmp, path)
+
+    def save_state(self, path: str) -> None:
+        """Write this PROCESS's compression state (threshold machinery +
+        its local residual shard) as an npz. In a multi-process run every
+        process must save its own file — residual shards differ."""
+        self.write_state_snapshot(self.state_snapshot(), path)
 
     def load_state(self, path: str) -> None:
         """Restore state written by :meth:`save_state`.

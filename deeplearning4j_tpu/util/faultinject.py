@@ -24,14 +24,37 @@ instead of being demo-only:
   cross-slice gradient frame (``parallel/dcn.py``) — lossy UDP-ish
   transport semantics.
 
+Host-scoped faults (pod-scale failure domains; a "host" in CI is a
+process group the elastic supervisor forms on localhost):
+
+- ``kill_host``: SIGKILL every worker of host group H the moment it
+  reports step S — a whole machine disappearing, the failure domain the
+  per-worker ``kill`` cannot express.
+- ``partition``: from step S on, host group H is cut from the rest of
+  the job — its workers block inside the step (a collective across the
+  partition can never complete) while their background heartbeats stay
+  alive, and every DCN frame crossing the boundary is dropped in both
+  directions. The signature the supervisor's step-progress watchdog
+  keys on: liveness without progress.
+- ``slow_save``: stall the asynchronous checkpoint thread for
+  ``duration_s`` during the save of step S — a slow/hung filesystem;
+  training must keep overlapping and the bounded in-flight window must
+  backpressure instead of accumulating torn saves.
+- ``kill`` additionally accepts a ``phase`` field
+  (``pre_write | mid_shard | pre_stamp``): instead of firing on the
+  training step, the SIGKILL lands at that point of the checkpoint
+  commit protocol — the torn-async-save matrix.
+
 Activation: set ``DL4J_TPU_FAULT_PLAN`` to a plan file path (or inline
 JSON) before the process starts. When the variable is unset every hook
 is a single-``is None``-check no-op — the production hot path pays one
 attribute load and a comparison, nothing else.
 
-Faults are keyed on (worker slot, step/seq): pure functions of training
-progress, so a plan replays identically on every run — which is what
-lets tests assert exact recovery points.
+Faults are keyed on (worker slot, step/seq) — host faults on (host
+group, step/seq): pure functions of training progress, so a plan
+replays identically on every run — which is what lets tests assert
+exact recovery points. The process's own host group arrives through
+``DL4J_TPU_ELASTIC_HOST`` (or :func:`set_host`).
 """
 
 from __future__ import annotations
@@ -44,10 +67,14 @@ import time
 from typing import Dict, List, Optional
 
 ENV_VAR = "DL4J_TPU_FAULT_PLAN"
+ENV_HOST_VAR = "DL4J_TPU_ELASTIC_HOST"
 
 FAULT_TYPES = ("kill", "stall", "stall_heartbeat", "corrupt_checkpoint",
-               "drop_dcn", "duplicate_dcn")
+               "drop_dcn", "duplicate_dcn",
+               "kill_host", "partition", "slow_save")
+HOST_FAULT_TYPES = ("kill_host", "partition")
 CORRUPT_MODES = ("truncate", "garbage", "delete")
+SAVE_PHASES = ("pre_write", "mid_shard", "pre_stamp")
 
 
 @dataclasses.dataclass
@@ -55,17 +82,27 @@ class Fault:
     """One planned fault. ``worker`` is the elastic SLOT id (stable across
     restarts and renumbering), ``step`` the global training iteration (or
     checkpoint step for ``corrupt_checkpoint``, frame sequence number for
-    the DCN faults)."""
+    the DCN faults). Host-scoped faults carry ``host`` (the failure
+    domain) instead of a meaningful ``worker``; a ``kill``/``slow_save``
+    may carry ``phase`` to fire inside the checkpoint commit protocol
+    rather than on the training step."""
 
     type: str
     worker: object  # int slot, or "*" for any worker
     step: int
     mode: str = "truncate"        # corrupt_checkpoint only
-    duration_s: float = 3600.0    # stall only
+    duration_s: float = 3600.0    # stall/partition/slow_save only
     signum: int = int(signal.SIGKILL)
+    host: object = None           # kill_host / partition failure domain
+    phase: Optional[str] = None   # kill/slow_save: commit-protocol phase
 
     def matches(self, worker, step: int) -> bool:
         return (self.worker == "*" or self.worker == worker) \
+            and int(step) == int(self.step)
+
+    def matches_host(self, host, step: int) -> bool:
+        return host is not None \
+            and (self.host == "*" or self.host == host) \
             and int(step) == int(self.step)
 
 
@@ -91,7 +128,7 @@ class FaultPlan:
             if not isinstance(f, dict):
                 raise ValueError(f"fault[{i}]: must be an object")
             unknown = set(f) - {"type", "worker", "step", "mode",
-                                "duration_s", "signal"}
+                                "duration_s", "signal", "host", "phase"}
             if unknown:
                 raise ValueError(
                     f"fault[{i}]: unknown field(s) {sorted(unknown)}")
@@ -107,6 +144,39 @@ class FaultPlan:
                 raise ValueError(
                     f"fault[{i}]: worker must be a slot index >= 0, a "
                     f"slice-id string, or '*', got {worker!r}")
+            host = f.get("host")
+            if ftype in HOST_FAULT_TYPES:
+                if ftype == "kill_host":
+                    host_ok = host == "*" \
+                        or (isinstance(host, int) and host >= 0)
+                else:  # partition: "*" would cut everyone from everyone
+                    host_ok = isinstance(host, int) and host >= 0
+                if not host_ok:
+                    raise ValueError(
+                        f"fault[{i}]: {ftype} needs a host group index "
+                        f">= 0{' (or *)' if ftype == 'kill_host' else ''}, "
+                        f"got {host!r}")
+            elif ftype == "slow_save" and host is not None:
+                # optionally host-scoped: stall the saver thread of every
+                # worker on one host (worker matching is ignored then)
+                if not (host == "*" or (isinstance(host, int) and host >= 0)):
+                    raise ValueError(
+                        f"fault[{i}]: slow_save host must be a host group "
+                        f"index >= 0 or '*', got {host!r}")
+            elif host is not None:
+                raise ValueError(
+                    f"fault[{i}]: 'host' is only valid on "
+                    f"{'/'.join(HOST_FAULT_TYPES)}/slow_save, not {ftype}")
+            phase = f.get("phase")
+            if phase is not None:
+                if ftype not in ("kill", "kill_host", "slow_save"):
+                    raise ValueError(
+                        f"fault[{i}]: 'phase' is only valid on "
+                        f"kill/kill_host/slow_save, not {ftype}")
+                if phase not in SAVE_PHASES:
+                    raise ValueError(
+                        f"fault[{i}]: unknown save phase {phase!r} "
+                        f"(one of {', '.join(SAVE_PHASES)})")
             step = f.get("step")
             if not isinstance(step, int) or step < 0:
                 raise ValueError(
@@ -128,7 +198,7 @@ class FaultPlan:
                     f"fault[{i}]: unknown signal {signame!r}") from None
             faults.append(Fault(type=ftype, worker=worker, step=step,
                                 mode=mode, duration_s=float(duration),
-                                signum=signum))
+                                signum=signum, host=host, phase=phase))
         return cls(faults)
 
     @classmethod
@@ -146,7 +216,7 @@ class FaultPlan:
         problems: List[str] = []
         seen: Dict[tuple, int] = {}
         for i, f in enumerate(self.faults):
-            key = (f.type, f.worker, f.step)
+            key = (f.type, f.worker, f.host, f.step, f.phase)
             if key in seen:
                 problems.append(
                     f"fault[{i}] duplicates fault[{seen[key]}]: "
@@ -165,11 +235,29 @@ class FaultPlan:
                 continue
             hit = fatal.get(f.worker)
             if hit is not None and f.step > hit[1] \
-                    and f.type in ("stall_heartbeat",):
+                    and f.type in ("stall_heartbeat", "slow_save"):
                 problems.append(
                     f"fault[{i}] ({f.type} worker={f.worker} step={f.step}) "
                     f"can never fire: fault[{hit[0]}] kills/stalls that "
                     f"worker at step {hit[1]} first")
+        # same shadowing at host-group granularity: a kill_host/partition
+        # at step S ends that host's generation — a later-step host fault
+        # on the SAME host can never fire within it
+        fatal_host = {}
+        for i, f in enumerate(self.faults):
+            if f.type in HOST_FAULT_TYPES and f.host != "*":
+                cur = fatal_host.get(f.host)
+                if cur is None or f.step < cur[1]:
+                    fatal_host[f.host] = (i, f.step)
+        for i, f in enumerate(self.faults):
+            if f.type not in HOST_FAULT_TYPES or f.host == "*":
+                continue
+            hit = fatal_host.get(f.host)
+            if hit is not None and i != hit[0] and f.step > hit[1]:
+                problems.append(
+                    f"fault[{i}] ({f.type} host={f.host} step={f.step}) "
+                    f"can never fire: fault[{hit[0]}] kills/partitions that "
+                    f"host at step {hit[1]} first")
         return problems
 
     def find(self, ftype: str, worker, step: int) -> Optional[Fault]:
@@ -184,6 +272,12 @@ class FaultPlan:
 _plan: Optional[FaultPlan] = None
 if os.environ.get(ENV_VAR):
     _plan = FaultPlan.load(os.environ[ENV_VAR])
+
+# this process's host group (failure domain); host-scoped faults are
+# inert in processes that never learned theirs
+_host: Optional[int] = None
+if os.environ.get(ENV_HOST_VAR, "").isdigit():
+    _host = int(os.environ[ENV_HOST_VAR])
 
 # injectable for tests: on_step's kill must be observable without dying
 _kill = os.kill
@@ -200,19 +294,90 @@ def set_plan(plan: Optional[FaultPlan]) -> None:
     _plan = plan
 
 
+def current_host() -> Optional[int]:
+    return _host
+
+
+def set_host(host: Optional[int]) -> None:
+    """Declare this process's host group (``None`` = unknown)."""
+    global _host
+    _host = None if host is None else int(host)
+
+
 # -- hooks (each begins with the single is-None check) -----------------------
 
-def on_step(worker, step: int) -> None:
-    """Call once per completed training iteration. May not return (kill)."""
+def on_step(worker, step: int, host=None) -> None:
+    """Call once per completed training iteration. May not return (kill),
+    or may block for a long time (stall / partition)."""
     if _plan is None:
         return
-    f = _plan.find("kill", worker, step)
-    if f is not None:
-        _kill(os.getpid(), f.signum)
-        return
+    host = _host if host is None else host
+    for f in _plan.faults:
+        # phase-scoped kills belong to on_save_phase; skipping them here
+        # (rather than taking the first kill match) keeps a plan that
+        # lists both a phase kill and a plain kill for the same (worker,
+        # step) firing both
+        if f.phase is not None:
+            continue
+        if f.type == "kill" and f.matches(worker, step):
+            _kill(os.getpid(), f.signum)
+            return
+        if f.type == "kill_host" and f.matches_host(host, step):
+            _kill(os.getpid(), f.signum)
+            return
     f = _plan.find("stall", worker, step)
     if f is not None:
         _sleep(f.duration_s)
+        return
+    # partition: this host is cut off — a collective across the boundary
+    # can never complete, so the step blocks while (background)
+    # heartbeats stay alive. Sticky from the configured step onward.
+    for f in _plan.faults:
+        if f.type == "partition" and host is not None and f.host == host \
+                and int(step) >= int(f.step):
+            _sleep(f.duration_s)
+            return
+
+
+def on_save_phase(worker, step: int, phase: str, host=None) -> None:
+    """Call at each phase of the checkpoint commit protocol
+    (``pre_write`` → own shard about to be written, ``mid_shard`` → own
+    shard landed / model write not finalized, ``pre_stamp`` → everything
+    finalized, commit stamp not yet written). Applies phase-scoped kills
+    (the torn-async-save matrix) and ``slow_save`` stalls (a slow
+    filesystem; fires at ``pre_write`` unless the fault names a phase)."""
+    if _plan is None:
+        return
+    host = _host if host is None else host
+    for f in _plan.faults:
+        if f.type == "kill" and f.phase == phase and f.matches(worker, step):
+            _kill(os.getpid(), f.signum)
+            return
+        if f.type == "kill_host" and f.phase == phase \
+                and f.matches_host(host, step):
+            _kill(os.getpid(), f.signum)
+            return
+        if f.type == "slow_save" and (f.phase or "pre_write") == phase:
+            # a host field scopes the stall to that host group (worker
+            # matching is ignored then — the default worker "*" would
+            # otherwise stall everyone)
+            hit = f.matches_host(host, step) if f.host is not None \
+                else f.matches(worker, step)
+            if hit:
+                _sleep(f.duration_s)
+
+
+def partition_active(host_a, host_b, seq: int) -> bool:
+    """Are host groups ``a`` and ``b`` separated at sequence/step
+    ``seq``? True when a planned partition has cut either side off."""
+    if _plan is None or host_a is None or host_b is None \
+            or host_a == host_b:
+        return False
+    for f in _plan.faults:
+        if f.type == "partition" and int(seq) >= int(f.step) \
+                and f.host in (host_a, host_b):
+            return True
+    return False
 
 
 def on_heartbeat(worker, step: int) -> bool:
@@ -244,9 +409,15 @@ def on_checkpoint_saved(worker, step: int, directory: str) -> None:
             return
 
 
-def on_dcn_send(worker, seq: int, frame: bytes) -> List[bytes]:
+def on_dcn_send(worker, seq: int, frame: bytes,
+                host=None) -> List[bytes]:
     """Transform one outbound DCN frame: ``[]`` drops it, two copies
-    duplicate it, ``[frame]`` passes through."""
+    duplicate it, ``[frame]`` passes through. ``host`` is accepted for
+    call symmetry with :func:`on_dcn_recv`; a partition is enforced at
+    the RECEIVER, where the cut is destination-aware — a sender cannot
+    know which of its (possibly fanned-out) recipients sit across the
+    boundary, and a blanket sender-side drop would sever intra-host
+    links the partition model defines as uncut."""
     if _plan is None:
         return [frame]
     if _plan.find("drop_dcn", worker, seq) is not None:
@@ -254,6 +425,17 @@ def on_dcn_send(worker, seq: int, frame: bytes) -> List[bytes]:
     if _plan.find("duplicate_dcn", worker, seq) is not None:
         return [frame, frame]
     return [frame]
+
+
+def on_dcn_recv(worker, seq: int, frame_host=None, host=None) -> bool:
+    """True → deliver the inbound frame; False → drop it (the sender is
+    on the far side of an active partition). Covers the direction
+    ``on_dcn_send`` cannot: frames already in flight from a peer the
+    partition has since cut off."""
+    if _plan is None:
+        return True
+    host = _host if host is None else host
+    return not partition_active(host, frame_host, seq)
 
 
 # -- shared corruption implementation ---------------------------------------

@@ -22,6 +22,7 @@ import numpy as np
 __all__ = [
     "save_model",
     "restore_model",
+    "snapshot_state",
     "AsyncSaveHandle",
     "OrbaxCheckpointManager",
 ]
@@ -115,6 +116,28 @@ def _state_pytree(model, with_updater: bool) -> Dict[str, Any]:
     state["counters"] = {"iteration": np.asarray(model.iteration),
                          "epoch": np.asarray(model.epoch)}
     return _multiprocess_safe(state)
+
+
+def snapshot_state(model, with_updater: bool = True) -> Dict[str, Any]:
+    """Decouple a checkpoint from the live model: the state pytree with
+    every addressable array copied to host numpy at call time. Training
+    may then mutate the model while a background thread feeds the
+    snapshot to :meth:`OrbaxCheckpointManager.save` — the overlapped
+    (async) elastic checkpoint path. Non-addressable global arrays (a
+    genuinely multi-host-sharded model) pass through untouched; those
+    must go through orbax's own sharded async machinery instead."""
+    import jax
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            if x.is_fully_addressable:
+                return np.asarray(x).copy()
+            return x
+        if isinstance(x, np.ndarray):
+            return x.copy()
+        return x
+    return jax.tree_util.tree_map(
+        conv, _state_pytree(model, with_updater=with_updater))
 
 
 def _template_for(model, metadata) -> Dict[str, Any]:
@@ -253,7 +276,8 @@ class OrbaxCheckpointManager:
         self._meta_written = False
 
     def save(self, step: int, model, *, save_updater: bool = True,
-             overwrite_existing: bool = False) -> bool:
+             overwrite_existing: bool = False,
+             state: Optional[Dict[str, Any]] = None) -> bool:
         """Save at ``step`` (skipped when the interval says so; returns
         whether a save happened).
 
@@ -261,13 +285,19 @@ class OrbaxCheckpointManager:
         NOTHING) when a finalized dir for ``step`` already exists — e.g.
         a corrupt leftover a fallback restore walked past. The elastic
         commit path must not re-advertise those bytes as freshly saved,
-        so this deletes the stale step dir and saves again."""
+        so this deletes the stale step dir and saves again.
+
+        ``state``: a pre-built state pytree (see :func:`snapshot_state`)
+        written INSTEAD of reading the live model — the async save path,
+        where ``model`` is only consulted for its immutable config meta
+        while training keeps mutating its arrays."""
         import orbax.checkpoint as ocp
 
         def _save():
             return self._mgr.save(
                 step, args=ocp.args.StandardSave(
-                    _state_pytree(model, with_updater=save_updater)))
+                    state if state is not None
+                    else _state_pytree(model, with_updater=save_updater)))
 
         if not self._meta_written:
             _write_meta(model, self.directory)

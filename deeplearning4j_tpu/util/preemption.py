@@ -39,7 +39,8 @@ class PreemptionHandler:
     def __init__(self, model, checkpoint_path: str,
                  signals=(signal.SIGTERM,), exit_after_save: bool = False,
                  on_preempt: Optional[Callable] = None,
-                 backend: str = "zip"):
+                 backend: str = "zip",
+                 async_saver=None, flush_grace_s: float = 30.0):
         if backend not in ("zip", "orbax"):
             raise ValueError("backend must be 'zip' or 'orbax'")
         self.model = model
@@ -48,6 +49,14 @@ class PreemptionHandler:
         self.exit_after_save = exit_after_save
         self.on_preempt = on_preempt
         self.backend = backend
+        #: anything with ``flush(timeout) -> bool`` (e.g. an elastic
+        #: AsyncCheckpointSession): an in-flight ASYNC checkpoint is
+        #: flushed inside the SIGTERM grace window (after this handler's
+        #: own immediate snapshot) — otherwise the preemption abandons a
+        #: torn step that was seconds from committing
+        self.async_saver = async_saver
+        self.flush_grace_s = flush_grace_s
+        self.flush_timed_out = threading.Event()
         self._previous = {}
         self.preempted = threading.Event()
         self.saved = threading.Event()
@@ -142,6 +151,25 @@ class PreemptionHandler:
                 f"snapshot — use resume() for a pre-existing file")
         return self.resume(self.checkpoint_path)
 
+    def flush_async(self) -> bool:
+        """Drain an in-flight async checkpoint under the bounded grace
+        deadline (``flush_grace_s``). True when everything landed; on
+        timeout the in-flight step stays torn (unstamped — never
+        restorable, by the commit protocol) and ``flush_timed_out`` is
+        set. The SIGTERM handler calls this AFTER taking its own
+        snapshot — a hung flush must not burn the grace window before
+        anything at all is saved."""
+        if self.async_saver is None:
+            return True
+        ok = bool(self.async_saver.flush(timeout=self.flush_grace_s))
+        if not ok:
+            self.flush_timed_out.set()
+            log.warning(
+                "In-flight async checkpoint did not land within the "
+                "%.1fs grace window; the torn step is unstamped and "
+                "will never be restored", self.flush_grace_s)
+        return ok
+
     # -- signal plumbing -------------------------------------------------
     def _handle(self, signum, frame):
         log.warning("Preemption signal %s: checkpointing to %s",
@@ -156,6 +184,12 @@ class PreemptionHandler:
             # the next step boundary.
             log.warning("Deferring preemption checkpoint to the next step "
                         "boundary (%s)", e)
+        # own snapshot FIRST (fast, and safe even if the filesystem that
+        # stalled the async save is the slow one), THEN spend what is
+        # left of the grace window letting the overlapped save commit —
+        # the reverse order could burn the whole window on a hung flush
+        # and lose both checkpoints
+        self.flush_async()
         if self.on_preempt is not None:
             self.on_preempt(self)
         if self.exit_after_save and self.saved.is_set():

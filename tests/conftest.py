@@ -31,6 +31,13 @@ def pytest_configure(config):
         "multiprocess: spawns real OS worker processes (jax.distributed "
         "or the elastic supervisor); every such test carries a hard "
         "subprocess timeout/deadline so a hung worker cannot wedge CI")
+    config.addinivalue_line(
+        "markers",
+        "multihost: simulated multi-host jobs — worker processes grouped "
+        "into host failure domains on localhost (elastic num_hosts); "
+        "implies multiprocess discipline: a hard job_deadline_s / "
+        "subprocess timeout is mandatory so a partitioned or hung host "
+        "group cannot wedge CI")
 
 
 @pytest.fixture

@@ -17,7 +17,9 @@ Two passes:
    earlier kill/stall of the same worker. No fault is executed.
 
 ``--workers N`` additionally checks that every integer worker slot is
-inside the job's initial world.
+inside the job's initial world; ``--hosts H`` does the same for the
+host-scoped fault kinds (``kill_host`` / ``partition`` — and plans that
+use them against a job with no host grouping are flagged).
 """
 
 from __future__ import annotations
@@ -33,7 +35,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 from deeplearning4j_tpu.util.faultinject import FaultPlan  # noqa: E402
 
 
-def validate_plan(spec, num_workers: Optional[int] = None) -> List[str]:
+def validate_plan(spec, num_workers: Optional[int] = None,
+                  num_hosts: Optional[int] = None) -> List[str]:
     """Return a list of problems (empty = valid). ``spec`` is a parsed
     dict, a JSON string, or a path."""
     try:
@@ -54,21 +57,38 @@ def validate_plan(spec, num_workers: Optional[int] = None) -> List[str]:
                     f"lint: fault[{i}] targets worker {f.worker} but the "
                     f"job starts with {num_workers} workers "
                     f"(slots 0..{num_workers - 1})")
+    if num_hosts is not None:
+        for i, f in enumerate(plan.faults):
+            if isinstance(f.host, int) and f.host >= num_hosts:
+                errors.append(
+                    f"lint: fault[{i}] targets host {f.host} but the "
+                    f"job starts with {num_hosts} host groups "
+                    f"(hosts 0..{num_hosts - 1})")
+    elif num_workers is not None:
+        # a job validated without --hosts has no host grouping: its
+        # host-scoped faults would silently never fire
+        for i, f in enumerate(plan.faults):
+            if f.host is not None:
+                errors.append(
+                    f"lint: fault[{i}] is host-scoped ({f.type}) but the "
+                    f"job has no host grouping (pass --hosts H)")
     return errors
 
 
 def validate_file(path: str,
-                  num_workers: Optional[int] = None) -> List[str]:
+                  num_workers: Optional[int] = None,
+                  num_hosts: Optional[int] = None) -> List[str]:
     try:
         with open(path, "r", encoding="utf-8") as fh:
             spec = json.load(fh)
     except (OSError, json.JSONDecodeError) as e:
         return [f"{path}: unreadable plan file: {e}"]
-    return validate_plan(spec, num_workers)
+    return validate_plan(spec, num_workers, num_hosts)
 
 
 def main(argv: List[str]) -> int:
     num_workers = None
+    num_hosts = None
     if "--workers" in argv:
         i = argv.index("--workers")
         try:
@@ -77,13 +97,21 @@ def main(argv: List[str]) -> int:
             print("--workers needs an integer")
             return 2
         argv = argv[:i] + argv[i + 2:]
+    if "--hosts" in argv:
+        i = argv.index("--hosts")
+        try:
+            num_hosts = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--hosts needs an integer")
+            return 2
+        argv = argv[:i] + argv[i + 2:]
     if not argv:
-        print("usage: validate_fault_plan.py [--workers N] PLAN.json "
-              "[PLAN.json ...]")
+        print("usage: validate_fault_plan.py [--workers N] [--hosts H] "
+              "PLAN.json [PLAN.json ...]")
         return 2
     rc = 0
     for path in argv:
-        errors = validate_file(path, num_workers)
+        errors = validate_file(path, num_workers, num_hosts)
         if errors:
             rc = 1
             print(f"FAIL {path}")
