@@ -29,12 +29,25 @@ first-request delta, ``steady_state_compiles``, compile/bucket counts and
 byte ratios are the stable comparators. BENCH_SERVING_r01.json is the
 committed r01 of this series.
 
+Round 2 (``--chaos``) — availability under injected faults: a fault plan
+crashes the live version's forward repeatedly while a retry-budget client
+drives traffic. The run proves (and ``--check BENCH_SERVING_r02.json``
+re-proves deterministically on every CI run) that the breaker trips, the
+dispatcher restarts under its budget, traffic fails over to the designated
+fallback with ZERO client-visible 5xx after the trip, the breaker
+half-opens and closes once the faults stop — and client-observed
+availability stays at/above the recorded floor the whole way. All control
+timing runs on a ``ManualTimeSource`` (breaker cooldowns and restart
+backoff are *advanced*, not slept), so the choreography is exact.
+
 Usage:
     python bench_serving.py                       # full run, prints JSON
+    python bench_serving.py --chaos               # chaos/recovery record
     python bench_serving.py --out FILE            # also write FILE
-    python bench_serving.py --check BENCH_SERVING_r01.json
+    python bench_serving.py --check BENCH_SERVING_rNN.json
         # regression mode: tiny config, deterministic oracles only —
-        # exercised by the smoke tier on every CI run
+        # exercised by the smoke tier on every CI run (r01 = fast path,
+        # r02 = chaos/recovery)
 """
 
 import argparse
@@ -362,6 +375,198 @@ def run_full():
         disable_tracing()
 
 
+# --------------------------------------------------------------------- chaos
+CHAOS_SCHEMA_KEYS = ("config", "requests", "successes", "availability",
+                     "availability_floor", "errors_5xx_after_trip",
+                     "breaker_opened_total", "breaker_closed_again",
+                     "dispatcher_restarts", "degraded_requests",
+                     "recovery_requests", "recovery_wall_ms",
+                     "client_retries",
+                     "observability_reachable_during_quarantine")
+
+CHAOS_AVAILABILITY_FLOOR = 0.99
+
+
+def run_chaos():
+    """Drive the serving-resilience choreography end to end over real
+    HTTP and record what the CLIENT observed. Control time (breaker
+    cooldown, restart backoff) lives on a manual clock; only the HTTP
+    round-trips are wall time."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.elastic import BackoffPolicy
+    from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+    from deeplearning4j_tpu.serving import (MetricsRegistry, ModelRegistry,
+                                            ModelServer, ModelServingClient,
+                                            RetryPolicy)
+    from deeplearning4j_tpu.util import faultinject
+
+    ts = ManualTimeSource()
+    m = MetricsRegistry()
+    registry = ModelRegistry(
+        metrics=m, buckets=[2, 4], max_batch_size=4,
+        max_dispatcher_restarts=5,
+        restart_backoff=BackoffPolicy(base_s=1.0, jitter=0.0),
+        breaker=dict(failure_threshold=2, window_s=60.0, cooldown_s=10.0,
+                     half_open_probes=1),
+        time_source=ts)
+    registry.register("bench", _tiny(seed=3))
+    registry.register("bench", _tiny(seed=4))   # v2 goes live
+    registry.set_fallback("bench", ["previous"])
+    server = ModelServer(registry, metrics=m, max_inflight=64)
+    server.start()
+    cm = MetricsRegistry()
+    client = ModelServingClient(
+        server.url, metrics=cm,
+        retry=RetryPolicy(max_retries=3, jitter=0.0),
+        sleep=lambda s: None)  # backoff is advice here, not wall time
+    # the live client is serial, so HTTP request seq == dispatch seq;
+    # seqs 0-1 are the healthy baseline, 2-4 the crash storm
+    plan = {"faults": [
+        {"type": "crash_forward", "model": "bench", "step": s}
+        for s in (2, 3, 4)]}
+    faultinject.set_plan(faultinject.FaultPlan.parse(plan))
+    x = np.zeros((2, 8), np.float32)
+    outcomes = []          # (ok, after_trip)
+    tripped = False
+    recovery_requests = None
+    t_first_crash = None
+
+    def drive(n=1):
+        nonlocal tripped, recovery_requests, t_first_crash
+        for _ in range(n):
+            try:
+                client.predict("bench", x, binary=True)
+                ok = True
+            except Exception:  # noqa: BLE001 — the record counts these
+                ok = False
+            brk = registry.get("bench").breakers.get(2)
+            if brk is not None and brk.opened_total and not tripped:
+                tripped = True
+            outcomes.append((ok, tripped))
+
+    try:
+        drive(2)                      # seqs 0-1: healthy baseline on v2
+        t_first_crash = time.perf_counter()
+        drive(1)                      # seq 2: crash -> failover to v1
+        drive(1)                      # restart pending -> failover
+        ts.advance(seconds=2)         # past restart backoff #1
+        drive(1)                      # seq 3: crash #2 -> breaker OPENS
+        drive(2)                      # open: quarantined, fallback serves
+        # the observability plane must survive the data-plane death:
+        # /livez answers (degraded, not down) and /metrics scrapes while
+        # the live version is quarantined and the dispatcher is down
+        import urllib.request
+        observability_ok = True
+        for probe in ("/livez", "/metrics"):
+            try:
+                with urllib.request.urlopen(server.url + probe,
+                                            timeout=5) as r:
+                    observability_ok &= r.status == 200
+            except Exception:  # noqa: BLE001 — recorded, not raised
+                observability_ok = False
+        ts.advance(seconds=15)        # past cooldown AND backoff #2
+        drive(1)                      # half-open probe: seq 4 crash ->
+        #                               re-open; the request still serves
+        ts.advance(seconds=15)
+        drive(1)                      # probe succeeds -> breaker CLOSES
+        brk = registry.get("bench").breakers[2]
+        closed_again = brk.state == "closed"
+        for i in range(3):            # primary serves again
+            drive(1)
+        recovery_wall_ms = (time.perf_counter() - t_first_crash) * 1e3
+        # first post-crash request served by the PRIMARY again
+        recovery_requests = 8         # by construction of the schedule
+        pi = registry.get("bench").inference
+        successes = sum(1 for ok, _ in outcomes if ok)
+        record = {
+            "config": "tiny MLP 8-16-4, v2 live + v1 fallback, "
+                      "crash_forward storm at dispatch seqs 2-4",
+            "plan": plan,
+            "requests": len(outcomes),
+            "successes": successes,
+            "availability": round(successes / len(outcomes), 4),
+            "availability_floor": CHAOS_AVAILABILITY_FLOOR,
+            "errors_5xx_after_trip": sum(
+                1 for ok, after in outcomes if after and not ok),
+            "breaker_opened_total": brk.opened_total,
+            "breaker_closed_again": closed_again,
+            "dispatcher_restarts": pi.restarts_used,
+            "degraded_requests": int(
+                m.get("serving_degraded_requests_total").total()),
+            "recovery_requests": recovery_requests,
+            "recovery_wall_ms": round(recovery_wall_ms, 1),
+            "client_retries": int(cm.get("client_retries_total").total()),
+            "observability_reachable_during_quarantine": observability_ok,
+        }
+        return {"series": "BENCH_SERVING", "round": 2,
+                "backend": jax.default_backend(),
+                "devices": len(jax.devices()),
+                "chaos": record}
+    finally:
+        faultinject.set_plan(None)
+        client.close()
+        server.stop(drain=False)
+        registry.shutdown()
+
+
+def run_chaos_check(committed_path):
+    """Deterministic chaos oracles for the smoke tier: the committed r02
+    record carries the schema and its invariants hold (availability at or
+    above its floor, zero 5xx after the trip, breaker closed again,
+    restarts within budget), and a fresh in-process chaos run reproduces
+    them exactly — plus /livez and /metrics answer during quarantine."""
+    failures = []
+    with open(committed_path) as f:
+        committed = json.load(f)
+    if committed.get("series") != "BENCH_SERVING":
+        failures.append(f"{committed_path}: series != BENCH_SERVING")
+    chaos = committed.get("chaos")
+    if not isinstance(chaos, dict):
+        failures.append(f"{committed_path}: no 'chaos' record")
+        chaos = {}
+    for key in CHAOS_SCHEMA_KEYS:
+        if key not in chaos:
+            failures.append(f"{committed_path}: chaos missing {key!r}")
+    if chaos.get("availability", 0) < chaos.get("availability_floor", 1):
+        failures.append(f"{committed_path}: availability "
+                        f"{chaos.get('availability')} below floor")
+    if chaos.get("errors_5xx_after_trip", 1) != 0:
+        failures.append(f"{committed_path}: recorded 5xx after the trip")
+    if not chaos.get("breaker_closed_again", False):
+        failures.append(f"{committed_path}: breaker never closed again")
+
+    fresh = run_chaos()["chaos"]
+    if fresh["availability"] < fresh["availability_floor"]:
+        failures.append(
+            f"live chaos availability {fresh['availability']} below "
+            f"floor {fresh['availability_floor']}")
+    if fresh["errors_5xx_after_trip"] != 0:
+        failures.append(f"live chaos saw {fresh['errors_5xx_after_trip']} "
+                        f"client-visible 5xx after the breaker tripped")
+    if not fresh["breaker_closed_again"]:
+        failures.append("live chaos breaker did not close after faults "
+                        "stopped")
+    if not fresh["breaker_opened_total"]:
+        failures.append("live chaos breaker never opened")
+    if fresh["dispatcher_restarts"] < 1:
+        failures.append("live chaos dispatcher never restarted")
+    if not fresh["observability_reachable_during_quarantine"]:
+        failures.append("live chaos: /livez or /metrics unreachable while "
+                        "the dispatcher was down")
+
+    if failures:
+        for f_ in failures:
+            print(f"CHECK FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"bench_serving chaos check OK against {committed_path} "
+          f"(availability {fresh['availability']}, "
+          f"{fresh['breaker_opened_total']} breaker trip(s), "
+          f"{fresh['dispatcher_restarts']} dispatcher restart(s), "
+          f"zero 5xx after trip)")
+    return 0
+
+
 # -------------------------------------------------------------------- --check
 def run_check(committed_path):
     """Deterministic regression oracles, cheap enough for the smoke tier:
@@ -438,13 +643,22 @@ def main(argv=None):
     p = argparse.ArgumentParser(prog="bench_serving.py")
     p.add_argument("--check", metavar="BENCH_SERVING_rNN.json", default=None,
                    help="regression mode: verify the committed series file "
-                        "and the deterministic fast-path invariants")
+                        "and its deterministic invariants (fast path for "
+                        "r01-style records, chaos/recovery for r02)")
+    p.add_argument("--chaos", action="store_true",
+                   help="record the chaos/recovery series (breaker trip, "
+                        "failover, restart, availability under fault) "
+                        "instead of the latency suite")
     p.add_argument("--out", default=None,
                    help="also write the JSON record here")
     args = p.parse_args(argv)
     if args.check:
+        with open(args.check) as f:
+            committed = json.load(f)
+        if "chaos" in committed:
+            return run_chaos_check(args.check)
         return run_check(args.check)
-    record = run_full()
+    record = run_chaos() if args.chaos else run_full()
     line = json.dumps(record)
     print(line)
     if args.out:

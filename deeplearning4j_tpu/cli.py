@@ -470,6 +470,37 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
                    help="admission limit before requests shed as 429")
     p.add_argument("--deadline-ms", type=float, default=None,
                    help="default per-request deadline (504 past expiry)")
+    p.add_argument("--max-dispatcher-restarts", type=int, default=2,
+                   help="in-place restarts of a crashed batching "
+                        "dispatcher before the crash is terminal "
+                        "(exponential backoff between restarts; 0 "
+                        "restores the old die-forever behavior)")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="forward crashes within --breaker-window that "
+                        "quarantine a model version (per-version circuit "
+                        "breaker; 0 disables breakers)")
+    p.add_argument("--breaker-window", type=float, default=30.0,
+                   help="rolling window (seconds) the crash threshold "
+                        "counts over")
+    p.add_argument("--breaker-cooldown", type=float, default=5.0,
+                   help="seconds an open breaker waits before letting a "
+                        "half-open probe through")
+    p.add_argument("--breaker-probes", type=int, default=1,
+                   help="consecutive probe successes that close a "
+                        "half-open breaker")
+    p.add_argument("--fallback", action="append", default=[],
+                   metavar="NAME=VERSION",
+                   help="failover chain for NAME while its live version "
+                        "is quarantined/crashed: a version number, "
+                        "'previous', or a comma list (repeatable)")
+    p.add_argument("--brownout", action="store_true",
+                   help="enable brownout degradation: under sustained "
+                        "admission saturation, shed X-Priority<=0 "
+                        "traffic with 429 and route un-pinned predicts "
+                        "to the --fallback chain until pressure clears")
+    p.add_argument("--brownout-saturation", type=float, default=0.9,
+                   help="fraction of --max-inflight that counts as "
+                        "saturation pressure")
     p.add_argument("--trace", default=None, metavar="OUT.json",
                    help="trace requests (spans across HTTP, dispatcher and "
                         "device) and write a Chrome trace here on shutdown")
@@ -540,11 +571,41 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
             p.error(f"--input-shape needs DIMS like 28x28x1, got {dims!r}")
         if not shapes[name] or min(shapes[name]) < 1:
             p.error(f"--input-shape needs positive DIMS, got {dims!r}")
+    fallbacks = {}
+    for spec in args.fallback:
+        name, sep, chain = spec.partition("=")
+        if not sep or not chain:
+            p.error(f"--fallback needs NAME=VERSION, got {spec!r}")
+        parsed_chain = []
+        for entry in chain.split(","):
+            entry = entry.strip()
+            if entry == "previous":
+                parsed_chain.append("previous")
+                continue
+            try:
+                parsed_chain.append(int(entry))
+            except ValueError:
+                p.error(f"--fallback {spec!r}: entries are version "
+                        f"numbers or 'previous', got {entry!r}")
+        fallbacks[name] = parsed_chain
+    if args.max_dispatcher_restarts < 0:
+        p.error("--max-dispatcher-restarts must be >= 0")
+    if args.breaker_threshold < 0:
+        p.error("--breaker-threshold must be >= 0 (0 disables)")
+    breaker = None
+    if args.breaker_threshold > 0:
+        breaker = dict(failure_threshold=args.breaker_threshold,
+                       window_s=args.breaker_window,
+                       cooldown_s=args.breaker_cooldown,
+                       half_open_probes=args.breaker_probes)
     registry = ModelRegistry(metrics=default_registry(),
                              max_batch_size=args.max_batch_size,
                              wait_ms=args.wait_ms, buckets=buckets,
                              warmup=args.warmup,
-                             compile_cache_dir=args.compile_cache_dir)
+                             compile_cache_dir=args.compile_cache_dir,
+                             max_dispatcher_restarts=(
+                                 args.max_dispatcher_restarts),
+                             breaker=breaker)
     models = []
     for spec in args.model:
         name, sep, path = spec.partition("=")
@@ -555,7 +616,8 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     # a typo'd NAME in a per-model flag must not silently serve the model
     # unquantized / unwarmed
     for flag, mapping in (("--dtype-policy", policies),
-                          ("--input-shape", shapes)):
+                          ("--input-shape", shapes),
+                          ("--fallback", fallbacks)):
         unknown = sorted(set(mapping) - set(model_names))
         if unknown:
             p.error(f"{flag} names no registered --model: "
@@ -577,12 +639,21 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
         elif state["status"] == "error":
             extra = f" (warmup FAILED: {state['reason']})"
         print(f"registered {name!r} v{version} from {path}{extra}")
+    for name, chain in fallbacks.items():
+        try:
+            registry.set_fallback(name, chain)
+        except (KeyError, ValueError) as e:
+            p.error(f"--fallback {name}: {e}")
+        print(f"fallback chain for {name!r}: {chain}")
+    brownout = None
+    if args.brownout:
+        brownout = dict(saturation=args.brownout_saturation)
     server = ModelServer(
         registry, host=args.host, port=args.port, metrics=default_registry(),
         max_inflight=args.max_inflight,
         default_deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms is not None else None),
-        alerts=alert_mgr)
+        alerts=alert_mgr, brownout=brownout)
     port = server.start()
     print(f"model server listening on {server.url} "
           f"(models: {', '.join(registry.names())}); port {port}")

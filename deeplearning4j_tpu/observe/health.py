@@ -380,18 +380,22 @@ class HealthReport:
 
 class ServingHealth:
     """Folds the serving tier's state into one :class:`HealthReport`:
-    per-model dispatcher liveness (critical — a dead dispatcher never
-    recovers in-process), admission saturation above
+    per-model dispatcher liveness (critical only when the crash is
+    TERMINAL — a supervised dispatcher with a restart pending is degraded,
+    not restart-worthy: the process will heal itself), circuit-breaker
+    quarantines and brownout mode (degraded), admission saturation above
     ``saturation_threshold`` and drain mode (degraded), and registry
     emptiness/hot-swap state. ``extra_probes`` are callables returning a
     :class:`HealthCheck`, the plug point for custom checks."""
 
     def __init__(self, registry=None, admission=None, *,
                  saturation_threshold: float = 0.9,
+                 brownout=None,
                  extra_probes: Optional[List[Callable[[], HealthCheck]]]
                  = None):
         self.registry = registry
         self.admission = admission
+        self.brownout = brownout
         self.saturation_threshold = float(saturation_threshold)
         self.extra_probes = list(extra_probes or [])
 
@@ -403,21 +407,59 @@ class ServingHealth:
                 "registry_models", bool(names),
                 f"{len(names)} model(s) registered: {', '.join(names)}"
                 if names else "no models registered"))
+            breaker_states = getattr(self.registry, "breaker_states", None)
             for name in names:
                 try:
                     inf = self.registry.get(name).inference
                 except Exception:  # noqa: BLE001 - unregistered between
                     continue       # names() and get(); not a failure
                 err = getattr(inf, "dispatcher_error", None)
-                checks.append(HealthCheck(
-                    f"dispatcher:{name}", inf.healthy,
-                    "up" if inf.healthy else
-                    f"dispatcher dead: {err!r}" if err is not None
-                    else "shut down",
-                    critical=True))
+                rst_fn = getattr(inf, "restart_state", None)
+                rst = rst_fn() if callable(rst_fn) else None
+                if inf.healthy:
+                    detail = "up"
+                    if rst is not None and rst["restarts_used"]:
+                        detail = (f"up (supervised: restarted "
+                                  f"{rst['restarts_used']}x of "
+                                  f"{rst['max_restarts']} budget)")
+                    checks.append(HealthCheck(
+                        f"dispatcher:{name}", True, detail, critical=True))
+                elif rst is not None and rst["restart_pending"]:
+                    # a crash the supervisor will heal is NOT a reason to
+                    # kill the process — /livez stays 200 (degraded)
+                    checks.append(HealthCheck(
+                        f"dispatcher:{name}", False,
+                        f"crashed; in-place restart in "
+                        f"{rst['retry_after_s']:.2f}s (used "
+                        f"{rst['restarts_used']}/{rst['max_restarts']})",
+                        critical=False))
+                else:
+                    checks.append(HealthCheck(
+                        f"dispatcher:{name}", False,
+                        f"dispatcher dead: {err!r}" if err is not None
+                        else "shut down",
+                        critical=True))
+                if breaker_states is not None:
+                    try:
+                        tripped = {v: s
+                                   for v, s in breaker_states(name).items()
+                                   if s != "closed"}
+                    except Exception:  # noqa: BLE001 - unregistered race
+                        tripped = {}
+                    if tripped:
+                        checks.append(HealthCheck(
+                            f"breaker:{name}", False,
+                            "quarantined version(s): " + ", ".join(
+                                f"v{v}={s}"
+                                for v, s in sorted(tripped.items()))))
             if self.registry.swapping:
                 checks.append(HealthCheck(
                     "registry_swap", False, "hot-swap in progress"))
+        if self.brownout is not None and self.brownout.active:
+            checks.append(HealthCheck(
+                "brownout", False,
+                "brownout active: "
+                + (self.brownout.describe().get("last_reason") or "")))
         if self.admission is not None:
             inflight = self.admission.inflight
             limit = self.admission.max_inflight

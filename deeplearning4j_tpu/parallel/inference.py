@@ -36,6 +36,16 @@ status codes):
 - a dispatcher-thread crash fails every queued AND future request with
   ``DispatcherCrashed`` instead of stranding waiters forever (the 503 path);
   ``healthy`` / ``dispatcher_error`` surface the state;
+- with ``max_restarts > 0`` the crash is no longer terminal: the next
+  request restarts the dispatcher thread in place, under the elastic
+  supervisor's exponential-backoff ladder (deterministic jitter, an
+  injectable ``restart_clock`` so tests never sleep). While the backoff
+  runs, requests fail fast with a ``retry_after_s`` hint (the serving
+  tier turns that into 503 + ``Retry-After``); the ``dispatched`` flag
+  on the exception distinguishes a request that was IN the dying batch
+  (a real forward failure — circuit-breaker food) from one shed while
+  the restart was pending. ``serving_dispatcher_restarts_total{model}``
+  counts every restart.
 - an optional duck-typed metrics registry (``observe.metrics``-shaped)
   records the batch-size distribution and live queue depth.
 
@@ -62,6 +72,7 @@ from jax.sharding import Mesh
 
 from deeplearning4j_tpu.observe import trace as _trace
 from deeplearning4j_tpu.parallel.sharding import batch_sharding
+from deeplearning4j_tpu.util import faultinject as _faultinject
 
 
 class InferenceDeadlineExceeded(TimeoutError):
@@ -69,7 +80,21 @@ class InferenceDeadlineExceeded(TimeoutError):
 
 
 class DispatcherCrashed(RuntimeError):
-    """The batching dispatcher thread died; the instance serves no more."""
+    """The batching dispatcher thread died.
+
+    ``retry_after_s`` is set when a supervised restart is pending (the
+    failure is transient — come back after the backoff); ``None`` means
+    terminal (no supervision, or budget exhausted). ``dispatched`` is True
+    only for a request that was part of the dying batch — its forward
+    actually ran and crashed the thread, the signal the per-version
+    circuit breaker counts; fast-fail rejections while a restart is
+    pending never carry it."""
+
+    def __init__(self, msg: str, *, retry_after_s: Optional[float] = None,
+                 dispatched: bool = False):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.dispatched = dispatched
 
 
 # _Request lifecycle: PENDING -(dispatcher)-> CLAIMED, or
@@ -167,7 +192,9 @@ class ParallelInference:
                  mesh: Optional[Mesh] = None, metrics=None,
                  metrics_name: str = "default",
                  buckets: Optional[Sequence[int]] = None,
-                 reuse_pad_buffer: bool = True):
+                 reuse_pad_buffer: bool = True,
+                 max_restarts: int = 0, restart_backoff=None,
+                 restart_clock=time.monotonic):
         if mode not in ("sequential", "inplace", "batched"):
             raise ValueError(f"unknown mode {mode!r} (inplace|sequential|batched)")
         self.model = model
@@ -215,7 +242,22 @@ class ParallelInference:
         self._inflight_batch: List[_Request] = []
         self._carry: Optional[_Request] = None  # claimed, awaiting next batch
         self._metrics_name = metrics_name
+        # dispatcher supervision: restart-in-place under the elastic
+        # backoff ladder. max_restarts=0 keeps the old terminal-crash
+        # contract; the clock is injectable so tests drive the backoff
+        # window without sleeping (batching TTLs stay on time.monotonic)
+        self.max_restarts = int(max_restarts)
+        self.restarts_used = 0
+        if restart_backoff is None:
+            from deeplearning4j_tpu.parallel.elastic import BackoffPolicy
+            restart_backoff = BackoffPolicy()
+        self._restart_backoff = restart_backoff
+        self._restart_clock = restart_clock
+        self._restart_at: Optional[float] = None  # restart_clock stamp
+        self._restart_lock = threading.Lock()
+        self._forward_seq = 0  # per-model dispatch counter (chaos keying)
         self._m_batch = self._m_depth = self._m_up = self._m_cold = None
+        self._m_restarts = None
         if metrics is not None:
             self._m_batch = metrics.histogram(
                 "inference_batch_size",
@@ -232,6 +274,10 @@ class ParallelInference:
                 "inference_cold_dispatches_total",
                 "Dispatches padded to an UNDECLARED (never-warmed) bucket — "
                 "each one may pay a live XLA compile", ("model",))
+            self._m_restarts = metrics.counter(
+                "serving_dispatcher_restarts_total",
+                "Supervised in-place restarts of a crashed batching "
+                "dispatcher thread", ("model",))
         if mode == "batched":
             self._worker = threading.Thread(target=self._run, daemon=True)
             self._worker.start()
@@ -273,8 +319,7 @@ class ParallelInference:
         if self._shutdown:
             raise RuntimeError("ParallelInference is shut down")
         if self.dispatcher_error is not None:
-            raise DispatcherCrashed(
-                "inference dispatcher died") from self.dispatcher_error
+            self._ensure_dispatcher()
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         tracer = _trace.get_active_tracer()
@@ -295,9 +340,12 @@ class ParallelInference:
         self._q.put(req)
         # re-check AFTER the put: a crash/shutdown that drained the queue
         # concurrently with this enqueue would otherwise strand the request
-        # (nobody will ever claim it from the dead queue)
+        # (nobody will ever claim it from the dead queue). The exception
+        # carries the pending restart window — "no hint" means terminal
         if self.dispatcher_error is not None:
-            req.fail_unclaimed(DispatcherCrashed("inference dispatcher died"))
+            req.fail_unclaimed(DispatcherCrashed(
+                "inference dispatcher died",
+                retry_after_s=self.restart_state()["retry_after_s"]))
         elif self._shutdown:
             req.fail_unclaimed(RuntimeError("ParallelInference shut down"))
         if self._m_depth is not None:
@@ -330,6 +378,67 @@ class ParallelInference:
     def _model(self):
         with self._model_lock:
             return self.model
+
+    # ----------------------------------------------------------- supervision
+    def _ensure_dispatcher(self) -> None:
+        """Crashed-dispatcher gate on the request path: restart the
+        thread in place once the backoff window has passed, or raise
+        ``DispatcherCrashed`` — with a ``retry_after_s`` hint while the
+        window runs, terminally once the budget is gone. Lazy (no
+        supervisor thread): the restart happens on the first request
+        that finds the window elapsed, which keeps the whole ladder
+        deterministic under an injected clock."""
+        with self._restart_lock:
+            if self.dispatcher_error is None or self._shutdown:
+                return  # restarted concurrently (or shutting down)
+            cause = self.dispatcher_error
+            if self._restart_at is None:
+                msg = ("inference dispatcher died"
+                       if self.max_restarts == 0 else
+                       f"inference dispatcher died (restart budget of "
+                       f"{self.max_restarts} exhausted)")
+                raise DispatcherCrashed(msg) from cause
+            remaining = self._restart_at - self._restart_clock()
+            if remaining > 0:
+                raise DispatcherCrashed(
+                    "inference dispatcher died; restart pending",
+                    retry_after_s=remaining) from cause
+            # the dying thread is past the point where it published the
+            # error (same lock), but may still be failing its casualties —
+            # let it finish before a new thread shares the queue, or it
+            # could fail requests that belong to the NEW dispatcher
+            old = self._worker
+            if old is not None and old is not threading.current_thread():
+                old.join(timeout=5.0)
+            self.restarts_used += 1
+            self.dispatcher_error = None
+            self._restart_at = None
+            self._inflight_batch = []
+            self._carry = None
+            self._worker = threading.Thread(target=self._run, daemon=True)
+            self._worker.start()
+            if self._m_up is not None:
+                self._m_up.set(1, model=self._metrics_name)
+            if self._m_restarts is not None:
+                self._m_restarts.inc(model=self._metrics_name)
+
+    def restart_state(self) -> dict:
+        """Supervision snapshot for health probes: whether the dispatcher
+        is crashed, whether a restart is still possible, and how long
+        until the backoff window opens."""
+        with self._restart_lock:
+            crashed = self.dispatcher_error is not None
+            pending = crashed and self._restart_at is not None
+            retry_after = None
+            if pending:
+                retry_after = max(
+                    0.0, self._restart_at - self._restart_clock())
+            return {"crashed": crashed,
+                    "restart_pending": pending,
+                    "retry_after_s": retry_after,
+                    "restarts_used": self.restarts_used,
+                    "max_restarts": self.max_restarts,
+                    "terminal": crashed and self._restart_at is None}
 
     # ------------------------------------------------------------ fast path
     def _bucket_for(self, n: int) -> Tuple[int, bool]:
@@ -409,23 +518,45 @@ class ParallelInference:
         except BaseException as e:  # noqa: BLE001 — containment seam
             # the crash must not strand waiters: record it, fail everything
             # queued, and let output() fail fast from now on (the serving
-            # layer turns this into 503s instead of hung connections)
-            self.dispatcher_error = e
+            # layer turns this into 503s instead of hung connections).
+            # Under supervision the restart window is scheduled BEFORE the
+            # error becomes visible (same lock as _ensure_dispatcher), so
+            # a racing request can never read "crashed" without a window
+            # and conclude the crash is terminal.
+            retry_after = None
+            with self._restart_lock:
+                if self.restarts_used < self.max_restarts \
+                        and not self._shutdown:
+                    retry_after = self._restart_backoff.delay(
+                        self.restarts_used + 1, seed=self._metrics_name)
+                    self._restart_at = self._restart_clock() + retry_after
+                else:
+                    self._restart_at = None
+                self.dispatcher_error = e
             if self._m_up is not None:
                 self._m_up.set(0, model=self._metrics_name)
-            crash = DispatcherCrashed(f"inference dispatcher died: {e!r}")
             # requests already claimed into the dying batch are no longer in
             # the queue — unblock them too (the thread is dead, no race);
-            # same for a claimed carry request awaiting the next batch
+            # same for a claimed carry request awaiting the next batch.
+            # These requests' forwards DIED (dispatched=True — what the
+            # circuit breaker counts); the still-queued ones never ran.
+            crash = DispatcherCrashed(
+                f"inference dispatcher died: {e!r}",
+                retry_after_s=retry_after, dispatched=True)
             for r in self._inflight_batch:
                 if not r.event.is_set():
                     r.error = crash
                     r.event.set()
+            # the carry was claimed but its forward never ran — like the
+            # queued requests it is a casualty, not breaker evidence
+            undispatched = DispatcherCrashed(
+                f"inference dispatcher died: {e!r}",
+                retry_after_s=retry_after)
             if self._carry is not None and not self._carry.event.is_set():
-                self._carry.error = crash
+                self._carry.error = undispatched
                 self._carry.event.set()
                 self._carry = None
-            self._fail_queued(crash)
+            self._fail_queued(undispatched)
 
     def _run_loop(self) -> None:
         # a claimed request that would overflow the largest declared bucket
@@ -567,6 +698,12 @@ class ParallelInference:
                 sp.set_attribute("padded_to", int(target))
                 if cold:
                     sp.set_attribute("cold_bucket", True)
+            # serving chaos seam: keyed on (model, dispatch seq). A
+            # crash_forward raises a BaseException that deliberately
+            # escapes this handler and kills the dispatcher thread
+            seq = self._forward_seq
+            self._forward_seq += 1
+            _faultinject.on_forward(self._metrics_name, seq)
             out = np.asarray(model.output(self._to_device(x)))
             self.batches_dispatched += 1
             if self._m_batch is not None:

@@ -14,8 +14,14 @@ Turns any trained or imported model into a network service:
   (JSON or binary codec), ``/v1/models``, ``/healthz``, ``/readyz``,
   ``/metrics``; deadlines propagate into the batching dispatcher (504,
   expired work never reaches the device), dispatcher crashes contained as
-  503s;
-- ``client``    — typed client incl. a parsing ``/metrics`` scrape.
+  503s + ``Retry-After``;
+- ``client``    — typed client incl. a parsing ``/metrics`` scrape and an
+  opt-in ``RetryPolicy`` (budgeted backoff retries, hedged requests);
+- ``breaker``   — per-version circuit breakers quarantining a forward
+  that keeps crashing the dispatcher, with registry fallback-chain
+  failover (round 13; ARCHITECTURE.md §17);
+- ``brownout``  — saturation/alert-driven degradation: priority shedding
+  + fallback rerouting with hysteresis, recovering automatically.
 
 The role of the reference ecosystem's serving deployments around
 ``ParallelInference.java`` + the dl4j-streaming routes, made a first-class
@@ -36,11 +42,16 @@ from deeplearning4j_tpu.serving.admission import (  # noqa: F401
     AdmissionRejected,
     Draining,
 )
+from deeplearning4j_tpu.serving.breaker import CircuitBreaker  # noqa: F401
+from deeplearning4j_tpu.serving.brownout import (  # noqa: F401
+    BrownoutController,
+)
 from deeplearning4j_tpu.serving.registry import (  # noqa: F401
     ModelNotFound,
     ModelRegistry,
     ModelVersion,
     ServedModel,
+    VersionQuarantined,
 )
 from deeplearning4j_tpu.serving.quantize import (  # noqa: F401
     DTYPE_POLICIES,
@@ -50,5 +61,6 @@ from deeplearning4j_tpu.serving.quantize import (  # noqa: F401
 from deeplearning4j_tpu.serving.server import ModelServer  # noqa: F401
 from deeplearning4j_tpu.serving.client import (  # noqa: F401
     ModelServingClient,
+    RetryPolicy,
     ServingError,
 )

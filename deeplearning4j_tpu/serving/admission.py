@@ -92,6 +92,13 @@ class AdmissionController:
         with self._lock:
             return self._inflight
 
+    def record_rejection(self, reason: str) -> None:
+        """Count a shed decision made OUTSIDE the slot machinery (the
+        brownout controller rejects at the front door without ever
+        taking a slot) in the same rejection series."""
+        if self._m_rejected is not None:
+            self._m_rejected.inc(reason=reason)
+
     # -------------------------------------------------------------- drain
     @property
     def draining(self) -> bool:

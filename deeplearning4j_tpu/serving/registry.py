@@ -56,6 +56,28 @@ Canary deploys (the ``pipeline/`` subsystem's data plane):
   worst offenders for inspection.  Any hot-swap (promote, rollback)
   clears both the split and the shadow — a new live version invalidates
   the experiment.
+
+Serving resilience (round 13): the data plane self-heals —
+
+- **dispatcher supervision**: ``max_dispatcher_restarts`` lets a crashed
+  batching dispatcher restart in place under the elastic backoff ladder
+  (``ParallelInference`` does the restarting; the registry just wires the
+  budget and the injectable clock through), so a single poisoned batch no
+  longer kills the name until a human intervenes.
+- **per-version circuit breakers** (``serving/breaker.py``): with
+  ``breaker=dict(...)`` every registered version gets a
+  closed→open→half-open breaker fed by forward crashes. A version that
+  keeps crashing the dispatcher is quarantined (its siblings keep the
+  restart budget) and un-pinned traffic fails over to the
+  **fallback chain** — ``set_fallback(name, ["previous"])`` or explicit
+  version numbers (e.g. the int8 policy variant registered alongside) —
+  until the half-open probe proves the forward healthy again.
+  ``serving_breaker_state{model,version}`` (0/1/2) and
+  ``serving_degraded_requests_total{model,reason}`` journal every move.
+- **failover on crash**: any un-pinned request that loses its dispatcher
+  mid-flight is re-served on the fallback chain instead of surfacing a
+  503, when a chain is designated — the acceptance bar for the chaos
+  tests is *zero client-visible 5xx after the breaker trips*.
 """
 
 from __future__ import annotations
@@ -68,12 +90,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import (
-    InferenceDeadlineExceeded, ParallelInference)
+    DispatcherCrashed, InferenceDeadlineExceeded, ParallelInference)
+from deeplearning4j_tpu.serving import breaker as _breaker
 from deeplearning4j_tpu.serving import quantize as _quantize
 
 
 class ModelNotFound(KeyError):
     """Unknown model name or version (the HTTP 404 path)."""
+
+
+class VersionQuarantined(RuntimeError):
+    """The live version's circuit breaker is open and the fallback chain
+    resolved to nothing servable — the 503 + ``Retry-After`` path.
+    ``retry_after_s`` hints when the quarantine could lift."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class ModelVersion:
@@ -124,6 +157,11 @@ class ServedModel:
         # shadow experiment state (None when off); mutated under the
         # registry lock, read by the shadow worker
         self.shadow: Optional[dict] = None
+        # resilience: one breaker per version (when enabled) and the
+        # registry-designated fallback chain — version numbers and/or
+        # "previous", resolved in order at failover time
+        self.breakers: Dict[int, "_breaker.CircuitBreaker"] = {}
+        self.fallbacks: List[object] = []
 
     def pick_weighted(self) -> int:
         """Smooth weighted round-robin over {current + split versions}.
@@ -174,6 +212,12 @@ class ServedModel:
                            "requests": s["requests"],
                            "divergences": s["divergences"],
                            "dropped": s["dropped"]}
+        if self.fallbacks:
+            d["fallbacks"] = list(self.fallbacks)
+        tripped = {str(v): br.state for v, br in sorted(self.breakers.items())
+                   if br.state != _breaker.CLOSED}
+        if tripped:  # a quarantine in flight is operator-visible
+            d["breakers"] = tripped
         return d
 
 
@@ -189,7 +233,19 @@ class ModelRegistry:
                  queue_limit: int = 64, wait_ms: float = 2.0, mesh=None,
                  buckets: Optional[Sequence[int]] = None,
                  warmup: str = "sync",
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 max_dispatcher_restarts: int = 0,
+                 restart_backoff=None,
+                 breaker: Optional[dict] = None,
+                 time_source=None):
+        """Resilience knobs (round 13): ``max_dispatcher_restarts`` lets
+        each name's crashed dispatcher restart in place (0 keeps the
+        terminal-crash contract); ``restart_backoff`` is an elastic
+        ``BackoffPolicy``; ``breaker=dict(failure_threshold=, window_s=,
+        cooldown_s=, half_open_probes=)`` arms a per-version circuit
+        breaker (None = off); ``time_source`` (a
+        ``parallel.time_source.TimeSource``) drives breaker cooldowns AND
+        restart backoff so chaos tests run on a manual clock."""
         if warmup not in ("sync", "async", "off"):
             raise ValueError(f"warmup must be sync|async|off, got {warmup!r}")
         if compile_cache_dir is not None:
@@ -200,14 +256,27 @@ class ModelRegistry:
         self._lock = threading.RLock()
         self._swap_lock = threading.Lock()  # serializes hot-swaps
         self._metrics = metrics
+        self._time_source = time_source
+        restart_clock = (time.monotonic if time_source is None else
+                         lambda: time_source.current_time_millis() / 1e3)
         self._pi_kw = dict(max_batch_size=max_batch_size,
                            queue_limit=queue_limit, wait_ms=wait_ms,
-                           mesh=mesh, buckets=buckets)
+                           mesh=mesh, buckets=buckets,
+                           max_restarts=int(max_dispatcher_restarts),
+                           restart_clock=restart_clock)
+        if restart_backoff is not None:
+            self._pi_kw["restart_backoff"] = restart_backoff
+        self._breaker_kw = dict(breaker) if breaker is not None else None
+        if self._breaker_kw is not None:
+            # fail fast on a typo'd knob, not at first registration
+            _breaker.CircuitBreaker(time_source=time_source,
+                                    **self._breaker_kw)
         self._warmup_mode = warmup
         self._swapping = 0  # >0 while a hot-swap is in progress (readiness)
         self._m_swaps = self._m_version = None
         self._m_warm_s = self._m_warm_n = None
         self._m_canary = self._m_shadow_req = self._m_shadow_div = None
+        self._m_breaker = self._m_degraded = None
         # shadow worker: ONE daemon + bounded queue per registry, started
         # lazily; overflow drops the shadow sample, never the response
         self._shadow_queue: "deque" = deque()
@@ -242,6 +311,17 @@ class ModelRegistry:
                 "shadow_divergence_total",
                 "Shadow comparisons whose output diverged past the "
                 "configured threshold", ("model",))
+            self._m_breaker = metrics.gauge(
+                "serving_breaker_state",
+                "Per-version circuit breaker: 0 closed, 1 open "
+                "(quarantined), 2 half-open (probing). Cardinality "
+                "bounded by the registry's own version history",
+                ("model", "version"))
+            self._m_degraded = metrics.counter(
+                "serving_degraded_requests_total",
+                "Requests served on a fallback/degraded version instead "
+                "of the one that should have served them",
+                ("model", "reason"))
 
     # ------------------------------------------------------------- loading
     @staticmethod
@@ -315,6 +395,13 @@ class ModelRegistry:
             served.versions[version] = ModelVersion(
                 version, served_obj, source, dtype_policy=dtype_policy,
                 quant_error=quant_error)
+            if self._breaker_kw is not None:
+                served.breakers[version] = _breaker.CircuitBreaker(
+                    time_source=self._time_source,
+                    name=f"{name}:v{version}", **self._breaker_kw)
+                if self._m_breaker is not None:
+                    self._m_breaker.set(0, model=name,
+                                        version=str(version))
             if first:
                 served.current_version = version
                 self._note_swap(name, version, "register")
@@ -529,6 +616,133 @@ class ModelRegistry:
             del served.versions[version]
             served.warmup_state.pop(version, None)
             served.warmup_spec.pop(version, None)
+            if served.breakers.pop(version, None) is not None \
+                    and self._m_breaker is not None:
+                self._m_breaker.set(0, model=name, version=str(version))
+            # explicit version numbers in the fallback chain die with the
+            # version (resolution would skip them anyway; keeping them
+            # would advertise a fallback that can never serve)
+            served.fallbacks = [f for f in served.fallbacks
+                                if f == "previous" or f != version]
+
+    # ------------------------------------------- resilience: breaker/fallback
+    def set_fallback(self, name: str, chain: Sequence[object]) -> None:
+        """Designate the failover chain for ``name``: an ordered list of
+        version numbers and/or the string ``"previous"`` (re-resolved at
+        failover time against whatever is then the previous version).
+        Resolution skips entries that are missing, not warm, or whose own
+        breaker is not closed — the first survivor serves."""
+        with self._lock:
+            served = self._get(name)
+            parsed: List[object] = []
+            for entry in chain:
+                if entry == "previous":
+                    parsed.append("previous")
+                    continue
+                v = int(entry)
+                if v not in served.versions:
+                    raise ModelNotFound(f"{name} has no version {v}")
+                parsed.append(v)
+            served.fallbacks = parsed
+
+    def get_fallback(self, name: str) -> List[object]:
+        with self._lock:
+            return list(self._get(name).fallbacks)
+
+    def _resolve_fallback_locked(self, served: ServedModel,
+                                 exclude: Optional[int] = None
+                                 ) -> Optional[int]:
+        """First chain entry that can actually serve. Called under the
+        registry lock."""
+        for entry in served.fallbacks:
+            v = served.previous_version if entry == "previous" else entry
+            if v is None or v == exclude or v not in served.versions:
+                continue
+            state = served.warmup_state.get(v)
+            status = None if state is None else state["status"]
+            if status not in ("warm", "skipped"):
+                continue  # a cold fallback is no fallback
+            br = served.breakers.get(v)
+            if br is not None and br.state != _breaker.CLOSED:
+                continue  # it is quarantined too
+            return v
+        return None
+
+    def resolve_fallback(self, name: str,
+                         exclude: Optional[int] = None) -> Optional[int]:
+        """Public resolution (``exclude`` defaults to nothing): the
+        version a degraded/brownout request would be served on, or None."""
+        with self._lock:
+            return self._resolve_fallback_locked(self._get(name), exclude)
+
+    def note_degraded(self, name: str, reason: str) -> None:
+        """Count a request served degraded for ``reason`` (the HTTP
+        front-end's brownout rerouting reports through here so every
+        degraded request lands in ONE series)."""
+        if self._m_degraded is not None:
+            self._m_degraded.inc(model=name, reason=reason)
+
+    def breaker_state(self, name: str,
+                      version: Optional[int] = None) -> Optional[str]:
+        """``closed`` / ``open`` / ``half_open`` for ``version`` (default:
+        live), or None when breakers are disabled."""
+        with self._lock:
+            served = self._get(name)
+            v = served.current_version if version is None else version
+            br = served.breakers.get(v)
+            return None if br is None else br.state
+
+    def breaker_states(self, name: str) -> Dict[int, str]:
+        """Every version's breaker state (empty when disabled)."""
+        with self._lock:
+            return {v: br.state
+                    for v, br in self._get(name).breakers.items()}
+
+    def _breaker_of(self, served: ServedModel,
+                    version: Optional[int]
+                    ) -> Optional["_breaker.CircuitBreaker"]:
+        if version is None:
+            return None
+        return served.breakers.get(version)
+
+    def _note_breaker(self, served: ServedModel, version: int,
+                      br: "_breaker.CircuitBreaker") -> None:
+        if self._m_breaker is not None:
+            self._m_breaker.set(br.code, model=served.name,
+                                version=str(version))
+
+    def _serve_degraded(self, served: ServedModel, x, deadline_s,
+                        exclude: Optional[int], reason: str,
+                        original: Optional[BaseException] = None):
+        """Serve one request on the fallback chain (synchronous pinned
+        path — the dispatcher belongs to the version we are escaping).
+        Raises ``original`` (or :class:`VersionQuarantined`) when the
+        chain resolves to nothing."""
+        with self._lock:
+            fb = self._resolve_fallback_locked(served, exclude=exclude)
+            model = served.versions[fb].model if fb is not None else None
+        if fb is None:
+            if original is not None:
+                raise original
+            br = served.breakers.get(exclude) if exclude is not None \
+                else None
+            raise VersionQuarantined(
+                f"{served.name} v{exclude} is quarantined (circuit "
+                f"breaker open) and the fallback chain resolved to "
+                f"nothing servable",
+                retry_after_s=br.retry_after_s() if br is not None
+                else None)
+        t0 = time.perf_counter()
+        out = np.asarray(model.output(np.asarray(x)))
+        if deadline_s is not None \
+                and time.perf_counter() - t0 > deadline_s:
+            raise InferenceDeadlineExceeded(
+                f"degraded predict on {served.name} v{fb} took "
+                f"{time.perf_counter() - t0:.3f}s "
+                f"(deadline {deadline_s:.3f}s)")
+        if self._m_degraded is not None:
+            self._m_degraded.inc(model=served.name, reason=reason)
+        return out, fb
 
     # ------------------------------------------------------ canary routing
     def _require_warm(self, served: ServedModel, version: int,
@@ -846,9 +1060,19 @@ class ModelRegistry:
         (smooth WRR) to the live dispatcher or a canary version's model;
         live-path responses additionally feed the shadow sampler when a
         shadow experiment is armed.
+
+        Resilience (un-pinned, dispatcher-bound requests only): the live
+        version's circuit breaker is consulted before dispatch — open
+        means the request serves on the fallback chain (or raises
+        :class:`VersionQuarantined` when the chain is empty); half-open
+        admits one probe at a time. A ``DispatcherCrashed`` whose request
+        actually reached the forward feeds the breaker, and the request
+        itself is re-served on the fallback chain when one exists — the
+        crash stays invisible to the client.
         """
         served = self.get(name)
         routed = None
+        unpinned = version is None
         with self._lock:
             current = served.current_version
             if version is not None and version not in served.versions:
@@ -859,6 +1083,8 @@ class ModelRegistry:
                     version = routed
             pinned = (served.versions[version].model
                       if version is not None and version != current else None)
+            brk = (self._breaker_of(served, current)
+                   if unpinned and pinned is None else None)
         if pinned is not None:
             # the pinned/canary path runs synchronously (no batching) —
             # honor the deadline contract the dispatcher gives live
@@ -874,8 +1100,43 @@ class ModelRegistry:
                     f"{time.perf_counter() - t0:.3f}s "
                     f"(deadline {deadline_s:.3f}s)")
             return out, version
-        out, model = served.inference.output(x, deadline_s=deadline_s,
-                                             return_model=True)
+        route = _breaker.ALLOW if brk is None else brk.allow()
+        if brk is not None:
+            # allow() may have flipped open -> half_open; keep the gauge
+            # truthful at every decision point
+            self._note_breaker(served, current, brk)
+        if route == _breaker.FALLBACK:
+            return self._serve_degraded(served, x, deadline_s,
+                                        exclude=current,
+                                        reason="breaker_open")
+        try:
+            out, model = served.inference.output(x, deadline_s=deadline_s,
+                                                 return_model=True)
+        except DispatcherCrashed as e:
+            if brk is not None:
+                if getattr(e, "dispatched", False):
+                    # the forward of the LIVE version took the thread
+                    # down — breaker evidence (probe or regular traffic)
+                    brk.record_failure(probe=route == _breaker.PROBE)
+                elif route == _breaker.PROBE:
+                    # the probe never reached the forward (restart still
+                    # pending): no verdict, release the probe slot
+                    brk.abort_probe()
+                self._note_breaker(served, current, brk)
+            if not unpinned:
+                raise
+            # failover: the crash stays invisible when a chain exists
+            return self._serve_degraded(served, x, deadline_s,
+                                        exclude=current,
+                                        reason="crash_failover",
+                                        original=e)
+        except BaseException:
+            if brk is not None and route == _breaker.PROBE:
+                brk.abort_probe()  # 504/model error is not a crash verdict
+            raise
+        if brk is not None:
+            brk.record_success(probe=route == _breaker.PROBE)
+            self._note_breaker(served, current, brk)
         with self._lock:
             ver = next((mv.version for mv in served.versions.values()
                         if mv.model is model), served.current_version)

@@ -32,11 +32,23 @@ endpoint                                        behavior
 
 Status mapping (the contract the tests reconcile against the metrics):
 200 served · 400 malformed · 404 unknown model/version · 429 + ``Retry-After``
-admission overflow · 500 model error · 503 draining/dispatcher-dead ·
-504 deadline exceeded (expired requests are never dispatched to the device).
+admission overflow/brownout shed · 500 model error · 503 + ``Retry-After``
+draining/dispatcher-dead/quarantined · 504 deadline exceeded (expired
+requests are never dispatched to the device).
 
 Per-request deadlines ride the ``X-Deadline-Ms`` header (or ``deadline_ms``
 in a JSON body) and propagate into the batching dispatcher.
+
+Serving resilience (round 13): every dispatcher-crash 503 carries
+``Retry-After`` (the supervised restart's remaining backoff when one is
+pending); a breaker failover or brownout reroute answers 200 with an
+``X-Degraded: breaker|brownout`` header and the version that actually
+served. Request priorities ride ``X-Priority`` (0 batch, 1 standard,
+2 interactive); while the attached :class:`BrownoutController` is engaged,
+low-priority requests shed with 429 + ``Retry-After`` and un-pinned
+predicts degrade to the registry's fallback chain. The serving chaos
+faults (``util/faultinject.py``: ``reject_admission`` / ``drop_response``)
+hook the front door here, keyed on a per-model request sequence.
 
 Canary routing: un-pinned predict requests honor the registry's live
 traffic split (``ModelRegistry.set_traffic_split`` — the ``pipeline/``
@@ -73,11 +85,33 @@ from deeplearning4j_tpu.parallel.inference import (DispatcherCrashed,
                                                    InferenceDeadlineExceeded)
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
                                                   AdmissionRejected, Draining)
-from deeplearning4j_tpu.serving.registry import ModelNotFound, ModelRegistry
+from deeplearning4j_tpu.serving.brownout import BrownoutController
+from deeplearning4j_tpu.serving.registry import (ModelNotFound,
+                                                 ModelRegistry,
+                                                 VersionQuarantined)
 from deeplearning4j_tpu.streaming.codec import (deserialize_array,
                                                 serialize_array)
+from deeplearning4j_tpu.util import faultinject as _faultinject
 
 BINARY_CONTENT_TYPE = "application/octet-stream"
+
+
+class _DroppedResponder:
+    """Stand-in handler for a ``drop_response`` chaos fault: the request
+    is processed for real (admission, dispatch, metrics) but every write
+    is swallowed — then the server severs the connection, exactly like a
+    network that ate the answer after the work was done."""
+
+    __slots__ = ("headers",)
+
+    def __init__(self, handler):
+        self.headers = handler.headers
+
+    def _json(self, *a, **k) -> None:
+        pass
+
+    def _respond(self, *a, **k) -> None:
+        pass
 
 
 class ModelServer:
@@ -88,7 +122,7 @@ class ModelServer:
                  metrics: Optional[MetricsRegistry] = None,
                  max_inflight: int = 64, retry_after_s: float = 0.05,
                  default_deadline_s: Optional[float] = None,
-                 alerts=None):
+                 alerts=None, brownout=None):
         self.registry = registry
         self.host = host
         self.port = port
@@ -96,16 +130,32 @@ class ModelServer:
         self.default_deadline_s = default_deadline_s
         self.admission = AdmissionController(
             max_inflight, retry_after_s=retry_after_s, metrics=self.metrics)
+        self.alerts = alerts  # an observe.alerts.AlertManager, or None
+        # brownout degradation: a ready BrownoutController, or a dict of
+        # its kwargs (admission/alerts/metrics wired in here), or None
+        if isinstance(brownout, dict):
+            brownout = BrownoutController(
+                admission=self.admission, alerts=alerts,
+                metrics=self.metrics, **brownout)
+        self.brownout: Optional[BrownoutController] = brownout
         from deeplearning4j_tpu.observe.health import ServingHealth
         self.health = ServingHealth(registry=registry,
-                                    admission=self.admission)
-        self.alerts = alerts  # an observe.alerts.AlertManager, or None
+                                    admission=self.admission,
+                                    brownout=self.brownout)
+        # per-model HTTP request sequence — the serving chaos faults
+        # (reject_admission / drop_response) key on it
+        self._req_seq: dict = {}
+        self._req_seq_lock = threading.Lock()
         self._m_requests = self.metrics.counter(
             "serving_requests_total",
             "Predict requests by model and HTTP status", ("model", "status"))
         self._m_latency = self.metrics.histogram(
             "serving_request_latency_seconds",
             "Predict latency (admission to response)", ("model",))
+        self._m_dropped = self.metrics.counter(
+            "serving_dropped_responses_total",
+            "Responses computed but never delivered (connection severed "
+            "— the drop_response chaos fault)", ("model",))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._conns: set = set()
@@ -316,28 +366,106 @@ class ModelServer:
             sp.set_attribute(
                 "status", self._predict_timed(handler, name, version, raw))
 
+    def _next_seq(self, name: str) -> int:
+        """Per-model request sequence (chaos-fault keying). Unknown
+        names return -1 and are never counted: the dict's cardinality
+        is bounded by the registry's own names — a URL probe must not
+        grow server state, the same rule the metric labels follow."""
+        if not self.registry.has(name):
+            return -1
+        with self._req_seq_lock:
+            seq = self._req_seq.get(name, 0)
+            self._req_seq[name] = seq + 1
+            return seq
+
+    @staticmethod
+    def _priority(handler) -> int:
+        """``X-Priority``: 0 batch, 1 standard (default), 2 interactive —
+        garbage parses as standard, never as an error."""
+        try:
+            return int(handler.headers.get("X-Priority", "1"))
+        except (TypeError, ValueError):
+            return 1
+
+    @staticmethod
+    def _sever(handler) -> None:
+        """Close the connection without a response (drop_response)."""
+        try:
+            handler.connection.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            handler.connection.close()
+        except OSError:
+            pass
+        handler.close_connection = True
+
+    def _retry_headers(self,
+                       retry_after_s: Optional[float] = None
+                       ) -> Tuple[Tuple[str, str], ...]:
+        retry = (retry_after_s if retry_after_s is not None
+                 else self.admission.retry_after_s)
+        return (("Retry-After", f"{max(retry, 0.001):.3f}"),)
+
     def _predict_timed(self, handler, name: str, version: Optional[int],
                        raw: bytes) -> int:
         t0 = time.perf_counter()
         status = 500
+        dropped = False
         try:
+            # serving chaos seam, keyed on (model, request seq). A
+            # drop_response fault does all the work below for real but
+            # swallows the writes — the connection is severed on the way
+            # out, like a network that ate the answer
+            seq = self._next_seq(name)
+            out = handler
+            if seq >= 0 and not _faultinject.on_response(name, seq):
+                dropped = True
+                out = _DroppedResponder(handler)
+            if seq >= 0 and not _faultinject.on_admission(name, seq):
+                status = 429
+                out._json({"error": "injected admission rejection "
+                                    "(chaos fault)"}, 429,
+                          headers=self._retry_headers())
+                return status
+            degrade_to = None
+            if self.brownout is not None and self.brownout.observe():
+                prio = self._priority(handler)
+                if self.brownout.should_shed(prio):
+                    status = 429
+                    self.admission.record_rejection("brownout")
+                    out._json(
+                        {"error": f"brownout: shedding priority {prio} "
+                                  f"traffic"}, 429,
+                        headers=self._retry_headers(
+                            self.brownout.retry_after_s))
+                    return status
+                if self.brownout.degrade and version is None:
+                    try:
+                        degrade_to = self.registry.resolve_fallback(name)
+                    except ModelNotFound:
+                        degrade_to = None  # 404s downstream as before
             try:
                 slot = self.admission.admit()
             except AdmissionRejected as e:
                 status = 429
-                handler._json(
+                out._json(
                     {"error": str(e)}, 429,
-                    headers=(("Retry-After",
-                              f"{max(e.retry_after_s, 0.001):.3f}"),))
+                    headers=self._retry_headers(e.retry_after_s))
                 return status
             except Draining:
                 status = 503
-                handler._json({"error": "server is draining"}, 503)
+                out._json({"error": "server is draining"}, 503)
                 return status
             with slot:
-                status = self._predict_admitted(handler, name, version, raw)
+                status = self._predict_admitted(out, name, version, raw,
+                                                degrade_to)
             return status
         finally:
+            if dropped:
+                self._sever(handler)
+                self._m_dropped.inc(
+                    model=name if self.registry.has(name) else "_unknown")
             # unknown names collapse to one sentinel label — URL probes must
             # not grow the metric registry without bound (same bounded-
             # cardinality rule as the UI server's route labels)
@@ -346,7 +474,8 @@ class ModelServer:
             self._m_latency.observe(time.perf_counter() - t0, model=label)
 
     def _predict_admitted(self, handler, name: str, version: Optional[int],
-                          raw: bytes) -> int:
+                          raw: bytes,
+                          degrade_to: Optional[int] = None) -> int:
         binary = False
         try:
             content_type = (handler.headers.get("Content-Type") or "").split(
@@ -369,17 +498,37 @@ class ModelServer:
             if x.ndim == 0:
                 handler._json({"error": "inputs must be at least 1-d"}, 400)
                 return 400
+            # brownout: an un-pinned predict degrades to the registry's
+            # fallback chain while the brownout holds (the quantized /
+            # previous version the operator designated)
+            degraded = None
+            if degrade_to is not None and version is None:
+                served = self.registry.get(name)
+                if degrade_to != served.current_version:
+                    version = degrade_to
+                    degraded = "brownout"
+                    self.registry.note_degraded(name, "brownout")
             # version attributed from the model that ACTUALLY served the
             # batch — a hot-swap landing mid-request must not mislabel
             out, v = self.registry.predict_versioned(
                 name, x, version=version, deadline_s=deadline_s)
+            if degraded is None and version is None:
+                # the registry served a breaker failover? the response
+                # says so, so a client can tell it was degraded
+                state = self.registry.breaker_state(name)
+                if state is not None and state != "closed" \
+                        and v != self.registry.get(name).current_version:
+                    degraded = "breaker"
+            extra = (("X-Degraded", degraded),) if degraded else ()
             if binary:
                 handler._respond(200, serialize_array(out),
                                  BINARY_CONTENT_TYPE,
-                                 headers=(("X-Model-Version", str(v)),))
+                                 headers=(("X-Model-Version", str(v)),)
+                                 + extra)
             else:
                 handler._json({"model": name, "version": v,
-                               "outputs": np.asarray(out).tolist()})
+                               "outputs": np.asarray(out).tolist()},
+                              headers=extra)
             return 200
         except ModelNotFound as e:
             handler._json({"error": str(e)}, 404)
@@ -387,8 +536,20 @@ class ModelServer:
         except InferenceDeadlineExceeded as e:
             handler._json({"error": str(e)}, 504)
             return 504
+        except VersionQuarantined as e:
+            # breaker open, nothing to fail over to: back off and retry —
+            # the hint is the remaining quarantine cooldown
+            handler._json({"error": str(e)}, 503,
+                          headers=self._retry_headers(e.retry_after_s))
+            return 503
         except DispatcherCrashed as e:
-            handler._json({"error": str(e)}, 503)
+            # transient under supervision (Retry-After = the restart
+            # backoff remaining), terminal without — either way a
+            # backoff-aware client now gets a concrete hint instead of
+            # hammering a dead dispatcher
+            handler._json({"error": str(e)}, 503,
+                          headers=self._retry_headers(
+                              getattr(e, "retry_after_s", None)))
             return 503
         except (ValueError, KeyError, json.JSONDecodeError,
                 UnicodeDecodeError, struct.error) as e:

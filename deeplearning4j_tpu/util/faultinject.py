@@ -45,15 +45,36 @@ process group the elastic supervisor forms on localhost):
   training step, the SIGKILL lands at that point of the checkpoint
   commit protocol — the torn-async-save matrix.
 
+Serving-scoped faults (the serving chaos harness; a "model" here is a
+registered serving name, the sequence a per-model request/forward
+counter):
+
+- ``crash_forward``: the model's forward raises a non-``Exception``
+  error at dispatch sequence S — the batching dispatcher thread DIES
+  (the containment seam ``ParallelInference._run`` exists for), which
+  is what trips restart supervision and the per-version circuit
+  breaker. Keyed on the per-model *forward* sequence (dispatches, not
+  HTTP requests — a coalesced batch is one forward).
+- ``slow_forward``: the forward at dispatch sequence S blocks for
+  ``duration_s`` — a latency spike; drives deadline/brownout paths.
+- ``reject_admission``: the HTTP front-end sheds request S (per-model
+  *request* sequence) at the door with 429 + ``Retry-After`` — a
+  simulated overload the resilient client must absorb via its retry
+  budget.
+- ``drop_response``: the front-end processes request S fully, then
+  severs the connection without writing the response — the network
+  eating an answer; proves the client's reconnect + retry path.
+
 Activation: set ``DL4J_TPU_FAULT_PLAN`` to a plan file path (or inline
 JSON) before the process starts. When the variable is unset every hook
 is a single-``is None``-check no-op — the production hot path pays one
 attribute load and a comparison, nothing else.
 
 Faults are keyed on (worker slot, step/seq) — host faults on (host
-group, step/seq): pure functions of training progress, so a plan
-replays identically on every run — which is what lets tests assert
-exact recovery points. The process's own host group arrives through
+group, step/seq), serving faults on (model name, request/forward seq):
+pure functions of training/traffic progress, so a plan replays
+identically on every run — which is what lets tests assert exact
+recovery points. The process's own host group arrives through
 ``DL4J_TPU_ELASTIC_HOST`` (or :func:`set_host`).
 """
 
@@ -71,10 +92,22 @@ ENV_HOST_VAR = "DL4J_TPU_ELASTIC_HOST"
 
 FAULT_TYPES = ("kill", "stall", "stall_heartbeat", "corrupt_checkpoint",
                "drop_dcn", "duplicate_dcn",
-               "kill_host", "partition", "slow_save")
+               "kill_host", "partition", "slow_save",
+               "crash_forward", "slow_forward", "reject_admission",
+               "drop_response")
 HOST_FAULT_TYPES = ("kill_host", "partition")
+SERVING_FAULT_TYPES = ("crash_forward", "slow_forward", "reject_admission",
+                       "drop_response")
 CORRUPT_MODES = ("truncate", "garbage", "delete")
 SAVE_PHASES = ("pre_write", "mid_shard", "pre_stamp")
+
+
+class InjectedDispatcherCrash(BaseException):
+    """``crash_forward``'s payload. Deliberately NOT an ``Exception``:
+    a model error is contained per request (the 500 path), but this must
+    escape ``ParallelInference._dispatch_batch``'s per-request handler
+    and kill the dispatcher thread itself — the failure mode the
+    supervision/breaker machinery exists for."""
 
 
 @dataclasses.dataclass
@@ -95,6 +128,7 @@ class Fault:
     signum: int = int(signal.SIGKILL)
     host: object = None           # kill_host / partition failure domain
     phase: Optional[str] = None   # kill/slow_save: commit-protocol phase
+    model: object = None          # serving faults: model name, or "*"
 
     def matches(self, worker, step: int) -> bool:
         return (self.worker == "*" or self.worker == worker) \
@@ -104,6 +138,10 @@ class Fault:
         return host is not None \
             and (self.host == "*" or self.host == host) \
             and int(step) == int(self.step)
+
+    def matches_model(self, model, seq: int) -> bool:
+        return (self.model == "*" or self.model == model) \
+            and int(seq) == int(self.step)
 
 
 class FaultPlan:
@@ -128,7 +166,8 @@ class FaultPlan:
             if not isinstance(f, dict):
                 raise ValueError(f"fault[{i}]: must be an object")
             unknown = set(f) - {"type", "worker", "step", "mode",
-                                "duration_s", "signal", "host", "phase"}
+                                "duration_s", "signal", "host", "phase",
+                                "model"}
             if unknown:
                 raise ValueError(
                     f"fault[{i}]: unknown field(s) {sorted(unknown)}")
@@ -137,6 +176,23 @@ class FaultPlan:
                 raise ValueError(
                     f"fault[{i}]: unknown type {ftype!r} "
                     f"(one of {', '.join(FAULT_TYPES)})")
+            model = f.get("model")
+            if ftype in SERVING_FAULT_TYPES:
+                if not (isinstance(model, str) and model):
+                    raise ValueError(
+                        f"fault[{i}]: {ftype} needs a 'model' name "
+                        f"(a registered serving name, or '*'), "
+                        f"got {model!r}")
+                for bad in ("worker", "host", "phase", "mode"):
+                    if bad in f:
+                        raise ValueError(
+                            f"fault[{i}]: {bad!r} is not valid on the "
+                            f"serving fault {ftype} (keyed on model + "
+                            f"request/forward seq)")
+            elif model is not None:
+                raise ValueError(
+                    f"fault[{i}]: 'model' is only valid on "
+                    f"{'/'.join(SERVING_FAULT_TYPES)}, not {ftype}")
             worker = f.get("worker", "*")
             ok = worker == "*" or (isinstance(worker, int) and worker >= 0) \
                 or (isinstance(worker, str) and worker)
@@ -198,7 +254,8 @@ class FaultPlan:
                     f"fault[{i}]: unknown signal {signame!r}") from None
             faults.append(Fault(type=ftype, worker=worker, step=step,
                                 mode=mode, duration_s=float(duration),
-                                signum=signum, host=host, phase=phase))
+                                signum=signum, host=host, phase=phase,
+                                model=model))
         return cls(faults)
 
     @classmethod
@@ -216,7 +273,7 @@ class FaultPlan:
         problems: List[str] = []
         seen: Dict[tuple, int] = {}
         for i, f in enumerate(self.faults):
-            key = (f.type, f.worker, f.host, f.step, f.phase)
+            key = (f.type, f.worker, f.host, f.step, f.phase, f.model)
             if key in seen:
                 problems.append(
                     f"fault[{i}] duplicates fault[{seen[key]}]: "
@@ -258,6 +315,30 @@ class FaultPlan:
                     f"fault[{i}] ({f.type} host={f.host} step={f.step}) "
                     f"can never fire: fault[{hit[0]}] kills/partitions that "
                     f"host at step {hit[1]} first")
+        # serving shadows are same-sequence, not later-step (dispatchers
+        # restart, so a crash does not end the timeline): an admission
+        # rejection at request S means the response path for S is never
+        # reached, and a crash_forward at dispatch S fires before a
+        # slow_forward stall of the same dispatch ever starts
+        by_key: Dict[tuple, int] = {}
+        for i, f in enumerate(self.faults):
+            if f.type in SERVING_FAULT_TYPES:
+                by_key.setdefault((f.type, f.model, f.step), i)
+        for i, f in enumerate(self.faults):
+            if f.type == "drop_response":
+                hit = by_key.get(("reject_admission", f.model, f.step))
+                if hit is not None:
+                    problems.append(
+                        f"fault[{i}] (drop_response model={f.model} "
+                        f"seq={f.step}) can never fire: fault[{hit}] "
+                        f"rejects that request at admission first")
+            elif f.type == "slow_forward":
+                hit = by_key.get(("crash_forward", f.model, f.step))
+                if hit is not None:
+                    problems.append(
+                        f"fault[{i}] (slow_forward model={f.model} "
+                        f"seq={f.step}) can never fire: fault[{hit}] "
+                        f"crashes that dispatch first")
         return problems
 
     def find(self, ftype: str, worker, step: int) -> Optional[Fault]:
@@ -436,6 +517,49 @@ def on_dcn_recv(worker, seq: int, frame_host=None, host=None) -> bool:
         return True
     host = _host if host is None else host
     return not partition_active(host, frame_host, seq)
+
+
+def on_forward(model: str, seq: int) -> None:
+    """Call once per dispatched forward of serving ``model`` (dispatch
+    sequence ``seq``). May raise :class:`InjectedDispatcherCrash`
+    (``crash_forward`` — kills the dispatcher thread) or block for
+    ``duration_s`` (``slow_forward``). A crash shadows a stall planned
+    for the same dispatch."""
+    if _plan is None:
+        return
+    for f in _plan.faults:
+        if f.type == "crash_forward" and f.matches_model(model, seq):
+            raise InjectedDispatcherCrash(
+                f"injected crash_forward: {model} forward #{seq}")
+    for f in _plan.faults:
+        if f.type == "slow_forward" and f.matches_model(model, seq):
+            _sleep(f.duration_s)
+            return
+
+
+def on_admission(model: str, seq: int) -> bool:
+    """True → admit request ``seq`` of ``model``; False → the front-end
+    sheds it with 429 + ``Retry-After`` (``reject_admission``: a
+    simulated overload the client's retry budget must absorb)."""
+    if _plan is None:
+        return True
+    for f in _plan.faults:
+        if f.type == "reject_admission" and f.matches_model(model, seq):
+            return False
+    return True
+
+
+def on_response(model: str, seq: int) -> bool:
+    """True → write the response for request ``seq``; False → the
+    front-end severs the connection after doing the work
+    (``drop_response``: the network ate the answer — the client must
+    reconnect and retry)."""
+    if _plan is None:
+        return True
+    for f in _plan.faults:
+        if f.type == "drop_response" and f.matches_model(model, seq):
+            return False
+    return True
 
 
 # -- shared corruption implementation ---------------------------------------

@@ -95,6 +95,37 @@ def test_shipped_fault_plan_lints_clean():
     assert proc.stdout.startswith("OK"), proc.stdout
 
 
+def test_shipped_serving_fault_plan_lints_clean():
+    """The serving chaos plan (crash_forward / slow_forward /
+    reject_admission / drop_response keyed on model + request seq) ships
+    lint-clean, with ``--models`` confirming every fault names a model
+    the documented ``serve`` invocation registers."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_fault_plan.py"),
+         "--models", "mnist",
+         os.path.join(EXAMPLES_DIR, "serving_fault_plan.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
+def test_shipped_serving_alert_rules_lint_clean():
+    """The breaker/brownout/restart-storm rules shipped for the serving
+    resilience tier pass the alert-rule validator."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_alert_rules.py"),
+         os.path.join(EXAMPLES_DIR, "serving_alert_rules.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs_clean(script):
     env = dict(

@@ -19,7 +19,11 @@ Two passes:
 ``--workers N`` additionally checks that every integer worker slot is
 inside the job's initial world; ``--hosts H`` does the same for the
 host-scoped fault kinds (``kill_host`` / ``partition`` — and plans that
-use them against a job with no host grouping are flagged).
+use them against a job with no host grouping are flagged);
+``--models NAME,NAME`` does the same for the serving-scoped kinds
+(``crash_forward`` / ``slow_forward`` / ``reject_admission`` /
+``drop_response``) — a fault naming a model the server never registers
+would silently never fire.
 """
 
 from __future__ import annotations
@@ -36,7 +40,8 @@ from deeplearning4j_tpu.util.faultinject import FaultPlan  # noqa: E402
 
 
 def validate_plan(spec, num_workers: Optional[int] = None,
-                  num_hosts: Optional[int] = None) -> List[str]:
+                  num_hosts: Optional[int] = None,
+                  models: Optional[List[str]] = None) -> List[str]:
     """Return a list of problems (empty = valid). ``spec`` is a parsed
     dict, a JSON string, or a path."""
     try:
@@ -50,6 +55,14 @@ def validate_plan(spec, num_workers: Optional[int] = None,
     if not plan.faults:
         return ["schema: no faults defined"]
     errors = [f"lint: {p}" for p in plan.lint()]
+    if models is not None:
+        for i, f in enumerate(plan.faults):
+            if f.model is not None and f.model != "*" \
+                    and f.model not in models:
+                errors.append(
+                    f"lint: fault[{i}] targets model {f.model!r} but the "
+                    f"server registers {sorted(models)} — it would "
+                    f"silently never fire")
     if num_workers is not None:
         for i, f in enumerate(plan.faults):
             if isinstance(f.worker, int) and f.worker >= num_workers:
@@ -77,18 +90,20 @@ def validate_plan(spec, num_workers: Optional[int] = None,
 
 def validate_file(path: str,
                   num_workers: Optional[int] = None,
-                  num_hosts: Optional[int] = None) -> List[str]:
+                  num_hosts: Optional[int] = None,
+                  models: Optional[List[str]] = None) -> List[str]:
     try:
         with open(path, "r", encoding="utf-8") as fh:
             spec = json.load(fh)
     except (OSError, json.JSONDecodeError) as e:
         return [f"{path}: unreadable plan file: {e}"]
-    return validate_plan(spec, num_workers, num_hosts)
+    return validate_plan(spec, num_workers, num_hosts, models)
 
 
 def main(argv: List[str]) -> int:
     num_workers = None
     num_hosts = None
+    models = None
     if "--workers" in argv:
         i = argv.index("--workers")
         try:
@@ -105,13 +120,23 @@ def main(argv: List[str]) -> int:
             print("--hosts needs an integer")
             return 2
         argv = argv[:i] + argv[i + 2:]
+    if "--models" in argv:
+        i = argv.index("--models")
+        try:
+            models = [m for m in argv[i + 1].split(",") if m]
+        except IndexError:
+            models = []
+        if not models:
+            print("--models needs a comma-separated name list")
+            return 2
+        argv = argv[:i] + argv[i + 2:]
     if not argv:
         print("usage: validate_fault_plan.py [--workers N] [--hosts H] "
-              "PLAN.json [PLAN.json ...]")
+              "[--models NAME,NAME] PLAN.json [PLAN.json ...]")
         return 2
     rc = 0
     for path in argv:
-        errors = validate_file(path, num_workers, num_hosts)
+        errors = validate_file(path, num_workers, num_hosts, models)
         if errors:
             rc = 1
             print(f"FAIL {path}")
