@@ -102,6 +102,12 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                          "alive) before the supervisor resolves a "
                          "network partition by killing the "
                          "least-progressed side")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    dest="metrics_port",
+                    help="with --elastic: serve the job-wide metrics "
+                         "union (workers re-labeled {slot,host,"
+                         "generation} + supervisor series) at this "
+                         "port's /metrics (0 = ephemeral)")
     args = ap.parse_args(argv)
 
     if args.elastic is not None:
@@ -110,13 +116,14 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                      "substrate: rotation checkpoints + generation ledger)")
         # flags that act INSIDE the training process are not plumbed into
         # the supervised workers — reject rather than silently ignore
+        # (--trace IS supported: workers stream spans back and the
+        # supervisor writes ONE merged fleet trace)
         unsupported = [flag for flag, hit in (
             ("--workers", args.workers is not None),
             ("--mode averaging", args.mode != "shared_gradients"),
             ("--averagingFrequency", args.averagingFrequency != 5),
             ("--prefetchSize", args.prefetchSize != 2),
             ("--uiUrl", args.uiUrl is not None),
-            ("--trace", args.trace is not None),
             ("--watchdog", args.watchdog != "off"),
         ) if hit]
         if unsupported:
@@ -124,9 +131,12 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                 f"{', '.join(unsupported)} affect(s) in-process training "
                 "and is not forwarded to --elastic workers (they train "
                 "shared_gradients at the elastic world size); drop it, or "
-                "run without --elastic. --log-json and --alerts ARE "
-                "supported (they observe the supervisor)")
+                "run without --elastic. --log-json, --alerts, --trace and "
+                "--metrics-port ARE supported (they observe the fleet)")
         return _elastic_train(args)
+    if args.metrics_port is not None:
+        ap.error("--metrics-port only applies to --elastic jobs (the "
+                 "in-process serve command exposes /metrics itself)")
 
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
     from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
@@ -207,9 +217,12 @@ def _elastic_train(args):
     model/data from --modelPath/--dataPath. Worker death triggers
     automatic recovery — restart-in-place under a backoff budget, then
     shrink to the surviving slice down to --min-workers. Rank 0 of the
-    finishing generation writes --modelOutputPath. ``--log-json`` and
-    ``--alerts`` observe the SUPERVISOR (recovery logs, the
-    elastic_restarts_total restart-storm rule)."""
+    finishing generation writes --modelOutputPath. ``--log-json``
+    observes the supervisor; ``--alerts`` evaluates against the FLEET
+    union (worker ``training_*`` series re-labeled
+    ``{slot,host,generation}`` plus the supervisor's ``elastic_*``
+    series — a FleetRegistry is created for the rules even without
+    ``--metrics-port``); ``--trace`` writes ONE merged fleet timeline."""
     from deeplearning4j_tpu.parallel.elastic import (BackoffPolicy,
                                                      ElasticJobSupervisor,
                                                      WorkerSpec)
@@ -220,13 +233,13 @@ def _elastic_train(args):
             enable_structured_logging(stream=sys.stderr)
         else:
             enable_structured_logging(path=args.log_json)
-    alert_mgr = None
-    if args.alerts:
-        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
-                                                default_registry, load_rules)
-        alert_mgr = AlertManager(default_registry(),
-                                 load_rules(args.alerts), [LogSink()],
-                                 interval_s=5.0).start()
+    tracer = None
+    if args.trace:
+        # fleet tracing: the supervisor's generation/decision spans land
+        # in its own ring; workers stream theirs back through the
+        # ckpt-dir trace files; ONE merged timeline is written at exit
+        from deeplearning4j_tpu.observe import default_registry, enable_tracing
+        tracer = enable_tracing(metrics=default_registry())
 
     spec = WorkerSpec(argv=[
         sys.executable, "-m", "deeplearning4j_tpu.parallel.elastic_worker",
@@ -237,13 +250,29 @@ def _elastic_train(args):
         "--epochs", str(args.epochs),
         "--save-mode", args.save_mode,
     ])
+    fleet = None
+    if args.alerts and args.metrics_port is None:
+        # --alerts observes the FLEET: the rules must see the job-wide
+        # union ({slot,host,generation}-labeled worker series), so a
+        # FleetRegistry exists whenever rules do, scrape port or not
+        from deeplearning4j_tpu.observe import FleetRegistry, default_registry
+        fleet = FleetRegistry(local=default_registry())
     supervisor = ElasticJobSupervisor(
         spec, num_workers=args.elastic, min_workers=args.min_workers,
         num_hosts=args.hosts, min_hosts=args.min_hosts,
         ckpt_dir=args.ckpt_dir,
         backoff=BackoffPolicy(max_restarts=args.max_restarts),
         heartbeat_timeout_s=args.heartbeat_timeout,
-        progress_timeout_s=args.progress_timeout)
+        progress_timeout_s=args.progress_timeout,
+        metrics_port=args.metrics_port, fleet=fleet)
+    alert_mgr = None
+    if args.alerts:
+        from deeplearning4j_tpu.observe import AlertManager, LogSink, load_rules
+        alert_mgr = AlertManager(
+            supervisor.fleet, load_rules(args.alerts), [LogSink()],
+            interval_s=5.0).start()
+        supervisor.alerts = alert_mgr  # surfaced at /alerts on the
+        # --metrics-port server
     try:
         result = supervisor.run()
     finally:
@@ -252,6 +281,11 @@ def _elastic_train(args):
             alert_mgr.stop()
             firing = alert_mgr.firing()
             print(f"alerts firing at exit: {firing if firing else 'none'}")
+        if tracer is not None:
+            from deeplearning4j_tpu.observe import disable_tracing
+            n = supervisor.write_fleet_trace(args.trace)
+            print(f"wrote merged fleet trace ({n} events) to {args.trace}")
+            disable_tracing()
         if args.log_json:
             from deeplearning4j_tpu.observe import (
                 disable_structured_logging)

@@ -29,7 +29,16 @@ Dapper-style request tracing the reference never had):
 - ``alerts``   — threshold/absence/rate-of-change/multiwindow burn-rate
   rules evaluated over any registry's Prometheus exposition, with a
   deduping firing/resolved state machine, pluggable sinks and the
-  ``AlertManager`` background evaluator (injectable clock).
+  ``AlertManager`` background evaluator (injectable clock);
+- ``fleet``    — the multi-process operator plane for elastic/pod jobs:
+  worker-side metrics snapshot files + crash-durable span streams,
+  supervisor-side ``FleetRegistry`` federation (relabeled
+  ``{slot,host,generation}`` union served at ``/metrics`` and fed to the
+  alert engine) and ``merge_chrome_traces`` clock-aligned trace
+  stitching;
+- ``incident`` — the flight recorder: one bounded, schema'd
+  ``incident_*`` bundle per elastic recovery decision
+  (``tools/validate_incident.py`` lints it).
 """
 
 from deeplearning4j_tpu.observe.metrics import (  # noqa: F401
@@ -55,10 +64,19 @@ from deeplearning4j_tpu.observe.trace import (  # noqa: F401
     span,
 )
 from deeplearning4j_tpu.observe.export import (  # noqa: F401
+    merge_chrome_traces,
     text_timeline,
     to_chrome_trace,
     write_chrome_trace,
 )
+from deeplearning4j_tpu.observe.fleet import (  # noqa: F401
+    FleetMetricsServer,
+    FleetRegistry,
+    MetricsFileExporter,
+    SpanFileWriter,
+    read_span_file,
+)
+from deeplearning4j_tpu.observe.incident import IncidentRecorder  # noqa: F401
 from deeplearning4j_tpu.observe.listener import TraceListener  # noqa: F401
 from deeplearning4j_tpu.observe.jaxhook import install_jax_hook  # noqa: F401
 from deeplearning4j_tpu.observe.log import (  # noqa: F401

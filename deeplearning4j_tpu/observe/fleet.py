@@ -1,0 +1,392 @@
+"""Fleet observability: cross-process metrics federation + span streaming.
+
+Rounds 7-8 built a deep observability stack — and left it strictly
+single-process, while the failures that matter (elastic shrink, host
+kills, DCN partitions) are multi-process. This module is the operator
+plane that spans the JOB instead of the process:
+
+- :class:`MetricsFileExporter` — the worker side of federation: writes
+  the registry's Prometheus exposition atomically to a snapshot file
+  next to the worker's heartbeat file. Deliberately file-based (not a
+  scrape socket): deterministic in CI, crash-durable up to the last
+  completed iteration, and the supervisor already owns the directory.
+- :class:`FleetRegistry` — the supervisor side: merges every worker
+  snapshot through ``parse_prometheus_text`` (the established exposition
+  contract), re-labels each series with ``{slot,host,generation}`` under
+  a cardinality bound, and serves the union from :meth:`exposition` —
+  duck-typing the ``MetricsRegistry`` surface the existing
+  :class:`~deeplearning4j_tpu.observe.alerts.AlertManager` and
+  ``/metrics`` handlers consume, so burn-rate rules can watch the whole
+  job unchanged.
+- :class:`FleetMetricsServer` — a minimal HTTP front-end (``/metrics``,
+  ``/healthz``, ``/alerts``) for supervisor processes, reusing the
+  ModelServer's response plumbing (``observe.metrics.respond``).
+- :class:`SpanFileWriter` / :func:`read_span_file` — crash-durable trace
+  streaming: a ``TraceRecorder`` drop-in that ALSO appends every
+  completed span as one JSON line, so a SIGKILLed worker keeps every
+  span up to its last finished iteration.  The file opens with a meta
+  line carrying the process's ``EPOCH_ANCHOR`` — the clock-alignment
+  rule ``observe.export.merge_chrome_traces`` uses to put every
+  process's monotonic timestamps on one wall-clock timeline.
+
+Everything here follows the ``enable_tracing()`` discipline: a worker
+without the supervisor's env vars, or a supervisor without a fleet
+registry, pays a single ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.observe.metrics import (MetricsRegistry,
+                                                _format_value, _label_str,
+                                                parse_prometheus_text)
+from deeplearning4j_tpu.observe.trace import EPOCH_ANCHOR, Span, TraceRecorder
+from deeplearning4j_tpu.util.fsio import atomic_write_text
+
+#: labels the federation owns; a worker-side label with the same name is
+#: overwritten (the supervisor's placement assignment is authoritative)
+FEDERATION_LABELS = ("slot", "host", "generation")
+
+
+class MetricsFileExporter:
+    """Worker-side federation endpoint: write the registry's exposition
+    text atomically to ``path`` (tmp + ``os.replace``, the heartbeat
+    discipline — the supervisor never reads a torn snapshot). Export
+    errors are swallowed: a full disk must not fail a training step."""
+
+    def __init__(self, registry: MetricsRegistry, path: str):
+        self.registry = registry
+        self.path = str(path)
+        self.exports = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+
+    def export(self) -> bool:
+        with self._lock:
+            try:
+                atomic_write_text(self.path, self.registry.exposition())
+                self.exports += 1
+                return True
+            except OSError:
+                self.errors += 1
+                return False
+
+
+class FleetRegistry:
+    """Supervisor-side union of a local registry and N worker snapshots.
+
+    Duck-types the ``MetricsRegistry`` surface its consumers use
+    (``counter``/``gauge``/``histogram``/``get``/``exposition``):
+    instruments delegate to the LOCAL registry (where the supervisor's
+    own ``elastic_*`` series and the AlertManager's state live);
+    :meth:`exposition` appends the re-labeled union of every registered
+    source, so ``AlertManager(metrics=fleet)`` and a ``/metrics``
+    handler see one job-wide exposition.
+
+    Federated series are re-labeled with the source's
+    ``{slot,host,generation}`` assignment and capped at ``max_series``
+    total (cardinality bound); drops and scrape failures are themselves
+    exported (``fleet_federation_dropped_series_total`` /
+    ``fleet_federation_scrape_errors_total``) — silent truncation would
+    read as "all quiet".
+    """
+
+    def __init__(self, local: Optional[MetricsRegistry] = None, *,
+                 max_series: int = 2000):
+        self.local = local if local is not None else MetricsRegistry()
+        self.max_series = int(max_series)
+        self._sources: Dict[Any, Tuple[str, Dict[str, str]]] = {}
+        self._lock = threading.Lock()
+        self._m_sources = self.local.gauge(
+            "fleet_sources", "Worker metric snapshots federated")
+        self._m_dropped = self.local.counter(
+            "fleet_federation_dropped_series_total",
+            "Federated series dropped by the cardinality bound")
+        self._m_errors = self.local.counter(
+            "fleet_federation_scrape_errors_total",
+            "Worker snapshot files that could not be read/parsed")
+
+    # ------------------------------------------------------------- sources
+    def set_source(self, key: Any, path: str,
+                   labels: Dict[str, Any]) -> None:
+        """Register (or update) one worker snapshot file under ``key``
+        (the slot id); ``labels`` is the federation's label assignment
+        (slot/host/generation)."""
+        with self._lock:
+            self._sources[key] = (str(path),
+                                  {str(k): str(v) for k, v in labels.items()})
+            self._m_sources.set(len(self._sources))
+
+    def remove_source(self, key: Any) -> None:
+        with self._lock:
+            self._sources.pop(key, None)
+            self._m_sources.set(len(self._sources))
+
+    def clear_sources(self) -> None:
+        with self._lock:
+            self._sources.clear()
+            self._m_sources.set(0)
+
+    def sources(self) -> Dict[Any, Tuple[str, Dict[str, str]]]:
+        with self._lock:
+            return dict(self._sources)
+
+    # --------------------------------------------------- instrument surface
+    def counter(self, *a, **kw):
+        return self.local.counter(*a, **kw)
+
+    def gauge(self, *a, **kw):
+        return self.local.gauge(*a, **kw)
+
+    def histogram(self, *a, **kw):
+        return self.local.histogram(*a, **kw)
+
+    def get(self, name: str):
+        return self.local.get(name)
+
+    # ----------------------------------------------------------- exposition
+    def federated_lines(self) -> List[str]:
+        """The re-labeled union of every source, one sample line per
+        series, capped at ``max_series``. Untyped on purpose: the HELP/
+        TYPE headers belong to the writer; ``parse_prometheus_text``
+        (the consumer contract) ignores them either way."""
+        lines: List[str] = []
+        dropped = 0
+        errors = 0
+        snapshot = self.sources()
+        for key in sorted(snapshot, key=str):
+            path, fed_labels = snapshot[key]
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    sample = parse_prometheus_text(fh.read())
+            except FileNotFoundError:
+                # a registered-but-not-yet-written snapshot (the
+                # supervisor pre-unlinks it at launch; the worker's
+                # first export lands only after jax init) is a normal
+                # boot window, not a scrape failure
+                continue
+            except (OSError, ValueError, AssertionError, IndexError):
+                errors += 1
+                continue
+            for name in sorted(sample):
+                for label_key in sorted(sample[name]):
+                    if len(lines) >= self.max_series:
+                        dropped += 1
+                        continue
+                    merged = dict(label_key)
+                    merged.update(fed_labels)  # federation labels win
+                    pairs = sorted(merged.items())
+                    lines.append(
+                        f"{name}{_label_str((), (), extra=pairs)} "
+                        f"{_format_value(sample[name][label_key])}")
+        if dropped:
+            self._m_dropped.inc(dropped)
+        if errors:
+            self._m_errors.inc(errors)
+        return lines
+
+    def exposition(self) -> str:
+        text = self.local.exposition()
+        fed = self.federated_lines()
+        if fed:
+            text += "\n".join(fed) + "\n"
+        return text
+
+
+class FleetMetricsServer:
+    """Minimal observability front-end for supervisor processes: GET
+    ``/metrics`` (the :class:`FleetRegistry` union, Prometheus text),
+    ``/healthz``, and ``/alerts`` when an ``AlertManager`` is attached —
+    the ModelServer's HTTP plumbing without the model surface."""
+
+    def __init__(self, registry, *, host: str = "127.0.0.1", port: int = 0,
+                 alerts=None):
+        self.registry = registry
+        self.host = host
+        self.port = int(port)
+        self.alerts = alerts
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        """Bind (port 0 → ephemeral) and serve on a daemon thread;
+        returns the bound port."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from deeplearning4j_tpu.observe.metrics import respond, respond_json
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence
+                pass
+
+            def do_GET(self):
+                from urllib.parse import urlparse
+                path = urlparse(self.path).path
+                if path == "/metrics":
+                    respond(self, 200,
+                            server.registry.exposition().encode(),
+                            "text/plain; version=0.0.4")
+                elif path == "/healthz":
+                    respond_json(self, {"status": "ok"})
+                elif path == "/alerts":
+                    if server.alerts is None:
+                        respond_json(self,
+                                     {"error": "no alert manager attached"},
+                                     404)
+                    else:
+                        respond_json(self, server.alerts.describe())
+                else:
+                    respond_json(self, {"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="fleet-metrics-server")
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+
+# ---------------------------------------------------------------------------
+# crash-durable span streaming
+# ---------------------------------------------------------------------------
+
+# the ONE attr sanitization rule, shared with every exporter
+from deeplearning4j_tpu.observe.export import sanitize_attr as _safe_attr
+
+
+class SpanFileWriter(TraceRecorder):
+    """A :class:`TraceRecorder` drop-in that ALSO appends every completed
+    span as one JSON line to ``path`` — crash-durable: a SIGKILLed worker
+    keeps every span up to its last finished iteration, which is exactly
+    what the incident bundle and the merged fleet trace need from a
+    victim.  The first line is a ``meta`` record carrying the process's
+    monotonic↔epoch anchor (``observe.trace.EPOCH_ANCHOR``), the
+    clock-alignment datum :func:`read_span_file` hands to
+    ``merge_chrome_traces``.  A dead stream (disk full) detaches; the
+    in-memory ring keeps recording (the ``LogHub`` contract).
+
+    The file is TRUNCATED on open: one stream = one process = one
+    anchor. A re-run supervisor re-using the same checkpoint dir (and
+    therefore the same per-generation filenames) must not leave a stale
+    process's spans under a fresh anchor — the merge rule is that a
+    mis-aligned row is worse than a missing one."""
+
+    def __init__(self, path: str, *, label: str, capacity: int = 65536,
+                 extra_meta: Optional[Dict[str, Any]] = None):
+        super().__init__(capacity)
+        self.path = str(path)
+        self.label = label
+        self._file_lock = threading.Lock()
+        self._fh = open(self.path, "w", encoding="utf-8")
+        meta: Dict[str, Any] = {
+            "kind": "meta", "label": label, "pid": os.getpid(),
+            "anchor_perf_ns": EPOCH_ANCHOR[0],
+            "anchor_epoch_us": EPOCH_ANCHOR[1],
+        }
+        if extra_meta:
+            meta.update({str(k): _safe_attr(v)
+                         for k, v in extra_meta.items()})
+        self._write_line(meta)
+
+    def add(self, span: Span) -> None:
+        super().add(span)
+        rec: Dict[str, Any] = {
+            "kind": "span", "name": span.name, "cat": span.category,
+            "trace": span.trace_id, "span": span.span_id,
+            "parent": span.parent_id, "start_ns": span.start_ns,
+            "end_ns": span.end_ns, "tid": span.thread_id,
+            "tname": span.thread_name,
+        }
+        if span.attrs:
+            rec["attrs"] = {str(k): _safe_attr(v)
+                            for k, v in span.attrs.items()}
+        if span.error:
+            rec["error"] = span.error
+        if span.links:
+            rec["links"] = [{"trace": l.trace_id, "span": l.span_id}
+                            for l in span.links]
+        self._write_line(rec)
+
+    def _write_line(self, obj: Dict[str, Any]) -> None:
+        with self._file_lock:
+            fh = self._fh
+            if fh is None:
+                return
+            try:
+                fh.write(json.dumps(obj) + "\n")
+                fh.flush()
+            except Exception:  # noqa: BLE001 - a dead stream must never
+                # raise into an instrumented hot path; the ring records on
+                self._fh = None
+                try:
+                    fh.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def close(self) -> None:
+        with self._file_lock:
+            fh, self._fh = self._fh, None
+            if fh is not None:
+                fh.close()
+
+
+def read_span_file(path: str) -> Dict[str, Any]:
+    """Parse one :class:`SpanFileWriter` output file:
+    ``{"label", "pid", "anchor": (perf_ns, epoch_us), "spans": [dict]}``.
+    Torn final lines (the writer was SIGKILLed mid-write) and unparseable
+    lines are skipped — the surviving spans are the point."""
+    out: Dict[str, Any] = {"label": os.path.basename(path), "pid": None,
+                           "anchor": None, "meta": {}, "spans": []}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if not line.endswith("\n"):
+                continue  # torn tail: that span never fully landed
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if not isinstance(rec, dict):
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                if out["anchor"] is not None:
+                    # defense in depth: the writer truncates on open, so
+                    # a second meta line means two processes wrote one
+                    # file — only the FIRST anchor can align the spans
+                    # that follow it; keep it
+                    continue
+                out["label"] = rec.get("label", out["label"])
+                out["pid"] = rec.get("pid")
+                out["meta"] = {k: v for k, v in rec.items()
+                               if k not in ("kind",)}
+                try:
+                    out["anchor"] = (int(rec["anchor_perf_ns"]),
+                                     int(rec["anchor_epoch_us"]))
+                except (KeyError, TypeError, ValueError):
+                    pass
+            elif kind == "span":
+                if not isinstance(rec.get("start_ns"), int) \
+                        or not isinstance(rec.get("end_ns"), int):
+                    continue
+                out["spans"].append(rec)
+    return out

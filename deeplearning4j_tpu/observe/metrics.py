@@ -291,6 +291,31 @@ def instrument_http(registry: MetricsRegistry,
     return observe
 
 
+def respond(handler, code: int, body: bytes, content_type: str,
+            headers: Sequence[Tuple[str, str]] = ()) -> None:
+    """The one HTTP response shape every front-end shares (ModelServer,
+    the fleet metrics server): status + Content-Type/Length + extra
+    headers + any trace-correlation headers the handler staged on
+    ``_trace_headers`` — so keep-alive clients always get an exact
+    Content-Length and traced requests always echo their ids."""
+    handler.send_response(code)
+    handler.send_header("Content-Type", content_type)
+    handler.send_header("Content-Length", str(len(body)))
+    for k, v in headers:
+        handler.send_header(k, v)
+    for k, v in getattr(handler, "_trace_headers", ()):
+        handler.send_header(k, v)
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def respond_json(handler, obj, code: int = 200,
+                 headers: Sequence[Tuple[str, str]] = ()) -> None:
+    import json
+    respond(handler, code, json.dumps(obj).encode(), "application/json",
+            headers)
+
+
 class HTTPObserverMixin:
     """Handler mixin recording request count + latency through an
     ``instrument_http`` observer. Mix in BEFORE ``BaseHTTPRequestHandler``:

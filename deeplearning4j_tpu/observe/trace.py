@@ -384,3 +384,13 @@ def current_traceparent() -> Optional[str]:
     """The active context's W3C header value, or None (off / no open span)."""
     tr = _active_tracer
     return None if tr is None else tr.current_traceparent()
+
+
+def current_span_ids() -> Tuple[Optional[str], Optional[str]]:
+    """``(trace_id, span_id)`` of the span open on THIS execution
+    context, or ``(None, None)``. Reads the shared contextvar directly —
+    the ids are tracer-independent, so correlation stampers (log
+    records, pipeline journal lines) work for explicitly-passed tracers
+    too, not just the process-wide active one."""
+    cur = _current_ctx.get()
+    return (None, None) if cur is None else cur

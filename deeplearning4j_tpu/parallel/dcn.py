@@ -31,6 +31,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from deeplearning4j_tpu.native import encode_threshold, extract_threshold
+# stdlib-only module: safe to import at the top without cycles. While no
+# tracer is enabled, every span site below is a single None check
+from deeplearning4j_tpu.observe.trace import parse_traceparent
+from deeplearning4j_tpu.observe.trace import span as _span
 
 log = logging.getLogger(__name__)
 
@@ -141,19 +145,31 @@ class CrossSliceGradientBridge:
         # replay and the residual extracted below would be lost at every
         # peer); receivers tolerate gaps — the dedup check is <=
         self._seq = seq + 1
-        header = json.dumps({"slice": self.slice_id, "seq": seq,
-                             "inc": self._incarnation,
-                             "host": self.host,
-                             "threshold": self.threshold,
-                             "sections": sections}).encode()
-        frame = struct.pack(">I", len(header)) + header + b"".join(blobs)
-        from deeplearning4j_tpu.util import faultinject
-        for out in faultinject.on_dcn_send(self.slice_id, seq, frame,
-                                           host=self.host):
-            # an injected [] drops the frame IN TRANSIT: the sender has
-            # committed (seq consumed, residual extracted) exactly like a
-            # frame lost on the wire after a successful send
-            self.publisher.publish(out)  # may raise: residual still intact
+        with _span("dcn_send", category="dcn",
+                   attrs={"slice": self.slice_id, "seq": seq,
+                          "sections": len(sections)}) as sp:
+            header_obj = {"slice": self.slice_id, "seq": seq,
+                          "inc": self._incarnation,
+                          "host": self.host,
+                          "threshold": self.threshold,
+                          "sections": sections}
+            if sp is not None:
+                # the send span's identity rides the frame: the receiver
+                # links its dcn_recv to it, so a cross-worker exchange
+                # renders as a flow arrow in the merged fleet trace
+                header_obj["tp"] = sp.context.traceparent()
+            header = json.dumps(header_obj).encode()
+            frame = struct.pack(">I", len(header)) + header + b"".join(blobs)
+            if sp is not None:
+                sp.set_attribute("bytes", len(frame))
+            from deeplearning4j_tpu.util import faultinject
+            for out in faultinject.on_dcn_send(self.slice_id, seq, frame,
+                                               host=self.host):
+                # an injected [] drops the frame IN TRANSIT: the sender
+                # has committed (seq consumed, residual extracted)
+                # exactly like a frame lost on the wire after a
+                # successful send
+                self.publisher.publish(out)  # may raise: residual intact
         for r, msg in pending:
             if msg is None:
                 r[:] = 0.0  # dense payload carried the whole residual
@@ -167,7 +183,6 @@ class CrossSliceGradientBridge:
         params pytree (jax arrays stay jax arrays) and the frame count."""
         import jax.numpy as jnp
 
-        from deeplearning4j_tpu.native import decode_threshold
         from deeplearning4j_tpu.util import faultinject
 
         self._ensure_residual(params)
@@ -211,41 +226,16 @@ class CrossSliceGradientBridge:
                 dense = {lk: {k: np.zeros(int(v.size), np.float32)
                               for k, v in layer.items()}
                          for lk, layer in self._layers(params)}
-            off = 4 + hlen
-            decoded_any = False
-            try:
-                for s in sections:
-                    count, size = int(s["count"]), int(s["size"])
-                    if count < -1 or size < 0:
-                        raise ValueError("negative section count/size")
-                    is_dense = count == -1
-                    n_bytes = (size if is_dense else count) * 4
-                    if off + n_bytes > len(frame):
-                        raise ValueError("frame truncated mid-section")
-                    payload = frame[off:off + n_bytes]
-                    off += n_bytes
-                    lk = s["layer"]
-                    # validate against the LOCAL model: unknown names or size
-                    # mismatches (version-skewed peer, corrupt frame) are
-                    # skipped — never an out-of-bounds write in the decoder
-                    target = dense.get(lk, {}).get(s["param"]) \
-                        if isinstance(dense.get(lk), dict) else None
-                    if target is None or len(target) != size:
-                        log.warning("Skipping mismatched section %r/%r from %s",
-                                    lk, s["param"], meta.get("slice"))
-                        continue
-                    if is_dense:
-                        target += np.frombuffer(payload, np.float32)
-                    else:
-                        msg = np.frombuffer(payload, np.int32)
-                        decode_threshold(msg, thr, len(target), out=target)
-                    decoded_any = decoded_any or n_bytes > 0
-            except (ValueError, KeyError, TypeError) as e:
-                # a malformed frame must not kill training or discard the
-                # frames already decoded into `dense` this call
-                log.warning("Dropping malformed frame from %s: %s",
-                            meta.get("slice"), e)
-                continue
+            with _span("dcn_recv", category="dcn",
+                       attrs={"slice": self.slice_id, "from": slice_tag,
+                              "seq": seq, "bytes": len(frame)}) as sp:
+                if sp is not None:
+                    # link to the sender's dcn_send span (flow arrow in
+                    # the merged trace); add_link(None) is a no-op for
+                    # frames from un-traced peers
+                    sp.add_link(parse_traceparent(meta.get("tp")))
+                decoded_any = self._decode_frame(frame, hlen, sections,
+                                                 thr, dense, meta)
             if decoded_any:
                 applied += 1
         if dense is None or applied == 0:
@@ -270,3 +260,45 @@ class CrossSliceGradientBridge:
                 self._prev[lk][k] = np.asarray(
                     layer[k], np.float32).reshape(-1).copy()
         return new_params, applied
+
+    def _decode_frame(self, frame, hlen, sections, thr, dense, meta) -> bool:
+        """Decode one frame's sections into ``dense``; False when the
+        frame was malformed (dropped without touching training or the
+        frames already decoded this call)."""
+        from deeplearning4j_tpu.native import decode_threshold
+        off = 4 + hlen
+        decoded_any = False
+        try:
+            for s in sections:
+                count, size = int(s["count"]), int(s["size"])
+                if count < -1 or size < 0:
+                    raise ValueError("negative section count/size")
+                is_dense = count == -1
+                n_bytes = (size if is_dense else count) * 4
+                if off + n_bytes > len(frame):
+                    raise ValueError("frame truncated mid-section")
+                payload = frame[off:off + n_bytes]
+                off += n_bytes
+                lk = s["layer"]
+                # validate against the LOCAL model: unknown names or size
+                # mismatches (version-skewed peer, corrupt frame) are
+                # skipped — never an out-of-bounds write in the decoder
+                target = dense.get(lk, {}).get(s["param"]) \
+                    if isinstance(dense.get(lk), dict) else None
+                if target is None or len(target) != size:
+                    log.warning("Skipping mismatched section %r/%r from %s",
+                                lk, s["param"], meta.get("slice"))
+                    continue
+                if is_dense:
+                    target += np.frombuffer(payload, np.float32)
+                else:
+                    msg = np.frombuffer(payload, np.int32)
+                    decode_threshold(msg, thr, len(target), out=target)
+                decoded_any = decoded_any or n_bytes > 0
+        except (ValueError, KeyError, TypeError) as e:
+            # a malformed frame must not kill training or discard the
+            # frames already decoded into `dense` this call
+            log.warning("Dropping malformed frame from %s: %s",
+                        meta.get("slice"), e)
+            return False
+        return decoded_any

@@ -106,6 +106,15 @@ ENV_PROGRESS_BEAT = "DL4J_TPU_ELASTIC_PROGRESS_BEAT_S"
 # address peers dial — the pod-scale replacement for hardcoded loopback
 ENV_BIND_HOST = "DL4J_TPU_ELASTIC_BIND_HOST"
 ENV_ADVERTISE_HOST = "DL4J_TPU_ELASTIC_ADVERTISE_HOST"
+# fleet observability seam: the supervisor's per-generation elastic_job
+# span context (W3C traceparent — worker spans parent into the job
+# trace), the directory workers stream their spans into (crash-durable
+# JSONL, merged by observe.export.merge_chrome_traces), and the file a
+# worker writes its Prometheus exposition snapshots to (scraped by the
+# supervisor's FleetRegistry). All three absent → every hook is a no-op.
+ENV_TRACEPARENT = "DL4J_TPU_ELASTIC_TRACEPARENT"
+ENV_TRACE_DIR = "DL4J_TPU_ELASTIC_TRACE_DIR"
+ENV_METRICS_FILE = "DL4J_TPU_ELASTIC_METRICS_FILE"
 
 GENERATION_FILE = "elastic_generation.json"
 LEDGER_FILE = "elastic_ledger.json"
@@ -418,7 +427,10 @@ class ElasticJobSupervisor:
                  progress_timeout_s: Optional[float] = None,
                  clock=None, sleep_fn=None, launcher=None,
                  metrics=None, port_fn=_free_port,
-                 job_id: str = "elastic"):
+                 job_id: str = "elastic",
+                 fleet=None, metrics_port: Optional[int] = None,
+                 incidents: bool = True,
+                 incident_dir: Optional[str] = None):
         if num_workers < 1 or min_workers < 1 or min_workers > num_workers:
             raise ValueError(
                 f"need 1 <= min_workers <= num_workers, got "
@@ -485,6 +497,28 @@ class ElasticJobSupervisor:
             "elastic_partitions_total",
             "Network partitions resolved by the step-progress watchdog")
         self._domains: Dict[object, _Domain] = {}
+        # -- fleet observability (each piece a no-op when absent) ---------
+        #: FleetRegistry serving the job-wide metrics union; created
+        #: automatically when --metrics-port asks for the scrape endpoint
+        self.fleet = fleet
+        if self.fleet is None and metrics_port is not None:
+            from deeplearning4j_tpu.observe.fleet import FleetRegistry
+            self.fleet = FleetRegistry(local=metrics)
+        self.metrics_port = metrics_port
+        self.metrics_server = None
+        #: optional AlertManager surfaced at the metrics server's
+        #: /alerts endpoint (the CLI attaches its --alerts manager here
+        #: before run())
+        self.alerts = None
+        #: where workers stream crash-durable span files (set per
+        #: generation only while a tracer is active in THIS process)
+        self.trace_dir = os.path.join(self.ckpt_dir, "trace")
+        self.incidents = None
+        if incidents:
+            from deeplearning4j_tpu.observe.incident import IncidentRecorder
+            self.incidents = IncidentRecorder(
+                incident_dir if incident_dir is not None
+                else os.path.join(self.ckpt_dir, "incidents"))
 
     # -- failure domains ---------------------------------------------------
     def host_of(self, slot_id: int) -> Optional[int]:
@@ -533,6 +567,73 @@ class ElasticJobSupervisor:
 
     # -- main loop --------------------------------------------------------
     def run(self, *, raise_on_failure: bool = True) -> ElasticJobResult:
+        if self.metrics_port is not None and self.metrics_server is None:
+            from deeplearning4j_tpu.observe.fleet import FleetMetricsServer
+            self.metrics_server = FleetMetricsServer(
+                self.fleet, port=self.metrics_port, alerts=self.alerts)
+            self.metrics_server.start()
+            self._log.info("fleet metrics server up",
+                           url=self.metrics_server.url())
+        try:
+            return self._run(raise_on_failure=raise_on_failure)
+        finally:
+            if self.metrics_server is not None:
+                self.metrics_server.stop()
+                self.metrics_server = None
+
+    def _gen_span_start(self, generation, token, world, restore_step):
+        """Per-generation ``elastic_job`` root span (None while tracing
+        is off). Its context ships to workers as a W3C traceparent, so
+        every worker's train/recovery/checkpoint spans parent into one
+        job trace per generation."""
+        from deeplearning4j_tpu.observe import get_active_tracer
+        tr = get_active_tracer()
+        if tr is None:
+            return None
+        return tr.start_span(
+            "elastic_job", category="elastic",
+            attrs={"job_id": self.job_id, "generation": generation,
+                   "token": token, "world": str(world),
+                   "restore_step": restore_step})
+
+    def _gen_span_end(self, gen_span, outcome: str) -> None:
+        if gen_span is None:
+            return
+        from deeplearning4j_tpu.observe import get_active_tracer
+        tr = get_active_tracer()
+        gen_span.set_attribute("outcome", outcome)
+        if tr is not None:
+            tr.end_span(gen_span)
+
+    def _record_decision(self, gen_span, generation, decision, primary,
+                         reason) -> None:
+        """The supervisor's restart/shrink/fail call as a point-in-time
+        span (category ``decision`` — merge_chrome_traces renders it as
+        an instant event on the supervisor row)."""
+        from deeplearning4j_tpu.observe import get_active_tracer
+        tr = get_active_tracer()
+        if tr is None:
+            return
+        import time as _time
+        now = _time.perf_counter_ns()
+        tr.record(f"elastic_{decision}", now, now, category="decision",
+                  parent=None if gen_span is None else gen_span.context,
+                  attrs={"generation": generation, "decision": decision,
+                         "primary_slot": primary.slot_id,
+                         "reason": reason or ""})
+
+    def _run(self, *, raise_on_failure: bool) -> ElasticJobResult:
+        # the trace dir holds THIS run's span streams: a previous run on
+        # the same ckpt_dir reuses generation numbering, and its stale
+        # files would contaminate write_fleet_trace's merge (hours-old
+        # anchors stretch the timeline) and incident bundles (old
+        # evidence presented as current)
+        try:
+            for name in os.listdir(self.trace_dir):
+                if name.endswith(".jsonl"):
+                    os.unlink(os.path.join(self.trace_dir, name))
+        except OSError:
+            pass
         result = ElasticJobResult(status="failed")
         world = list(range(self.num_workers))
         generation = 0
@@ -555,8 +656,11 @@ class ElasticJobSupervisor:
                           json.dumps({"generation": generation,
                                       "token": token,
                                       "world_size": len(world)}))
+            gen_span = self._gen_span_start(generation, token, world,
+                                            restore_step)
             self._launch_generation(generation, token, world, slots,
-                                    restore_step, eligible)
+                                    restore_step, eligible,
+                                    gen_span=gen_span)
             self._world_gauge.set(len(world))
             self._gen_gauge.set(generation)
             self._hosts_gauge.set(self._live_hosts(world))
@@ -569,12 +673,14 @@ class ElasticJobSupervisor:
             if outcome == "completed":
                 record.outcome = "completed"
                 result.status = "completed"
+                self._gen_span_end(gen_span, "completed")
                 self._log.info("job completed", generation=generation,
                                world=world)
                 return result
             if outcome == "deadline":
                 record.outcome = "failed"
                 self._kill_world([slots[s] for s in world])
+                self._gen_span_end(gen_span, "deadline")
                 result.reason = (f"job deadline "
                                  f"({self.job_deadline_s}s) exceeded")
                 return self._fail(result, raise_on_failure)
@@ -587,6 +693,7 @@ class ElasticJobSupervisor:
             record.primary_slot = primary.slot_id
             record.primary_host = self.host_of(primary.slot_id)
             with span("elastic_recovery", category="elastic",
+                      parent=None if gen_span is None else gen_span.context,
                       attrs={"generation": generation,
                              "primary_slot": primary.slot_id,
                              "primary_host": record.primary_host,
@@ -595,7 +702,7 @@ class ElasticJobSupervisor:
                 self._kill_world([slots[s] for s in world])
                 for d in dead:
                     self._deaths.inc(reason=d.death_reason or "exit")
-                decision, delay, new_world = self._decide(
+                decision, delay, new_world, ladder = self._decide(
                     primary, world, result)
                 record.decision = decision
                 if decision == "fail":
@@ -610,6 +717,13 @@ class ElasticJobSupervisor:
                         f"{self.min_workers}"
                         + (f" / min_hosts={self.min_hosts}"
                            if self.num_hosts is not None else ""))
+                    self._record_decision(gen_span, generation, decision,
+                                          primary, result.reason)
+                    self._write_incident(generation, decision,
+                                         result.reason, 0.0, ladder,
+                                         primary, dead, world, world,
+                                         slots, restore_step)
+                    self._gen_span_end(gen_span, "failed")
                     self._log.error("job failed",
                                     generation=generation,
                                     slot=primary.slot_id,
@@ -617,15 +731,23 @@ class ElasticJobSupervisor:
                     return self._fail(result, raise_on_failure)
                 self._restarts.inc(decision=decision)
                 result.restarts_total += 1
+                reason = (f"{primary.death_reason or 'exit'} on slot "
+                          f"{primary.slot_id}")
+                self._record_decision(gen_span, generation, decision,
+                                      primary, reason)
+                self._write_incident(generation, decision, reason, delay,
+                                     ladder, primary, dead, world,
+                                     new_world, slots, restore_step)
                 self._log.warning(
                     "recovering", generation=generation,
                     decision=decision, primary_slot=primary.slot_id,
                     death_reason=primary.death_reason,
                     backoff_s=round(delay, 3), next_world=new_world)
-                if delay > 0:
-                    result.backoff_delays.append(delay)
-                    self.sleep_fn(delay)
-                world = new_world
+            self._gen_span_end(gen_span, "recovered")
+            if delay > 0:
+                result.backoff_delays.append(delay)
+                self.sleep_fn(delay)
+            world = new_world
 
     def _fail(self, result: ElasticJobResult,
               raise_on_failure: bool) -> ElasticJobResult:
@@ -638,7 +760,9 @@ class ElasticJobSupervisor:
     # -- recovery decision -------------------------------------------------
     def _decide(self, primary: _Slot, world: List[int],
                 result: ElasticJobResult):
-        """(decision, backoff_delay, new_world) for one recovery round.
+        """(decision, backoff_delay, new_world, ladder) for one recovery
+        round; ``ladder`` is the per-rung reasoning the incident bundle
+        records (which rungs were considered, which one was taken, why).
 
         Only the PRIMARY victim's failure DOMAIN is charged: peers die
         as collateral when the world breaks (their collectives can never
@@ -646,32 +770,123 @@ class ElasticJobSupervisor:
         a cascade of budget exhaustion. With host grouping the domain is
         the whole host — shrink removes every slot of the victim host,
         keeping per-host slice shapes intact down to ``min_hosts``."""
+        ladder: List[dict] = []
         domain = self._domain_of(primary.slot_id)
-        if not primary.live \
-                and domain.startup_retries_used < self.startup_retries:
+        startup_eligible = not primary.live \
+            and domain.startup_retries_used < self.startup_retries
+        ladder.append({
+            "rung": "startup_retry", "taken": startup_eligible,
+            "detail": (f"never live, retries used "
+                       f"{domain.startup_retries_used}/"
+                       f"{self.startup_retries}" if not primary.live
+                       else "worker was live: not a startup flake")})
+        if startup_eligible:
             # never became live: a port race / startup flake, not a
             # training fault — retry in place without touching the budget
             domain.startup_retries_used += 1
-            return "restart", 0.0, list(world)
-        if domain.restarts_used < self.backoff.max_restarts:
+            return "restart", 0.0, list(world), ladder
+        budget_left = domain.restarts_used < self.backoff.max_restarts
+        ladder.append({
+            "rung": "restart", "taken": budget_left,
+            "detail": (f"domain budget {domain.restarts_used}/"
+                       f"{self.backoff.max_restarts} used")})
+        if budget_left:
             domain.restarts_used += 1
             host = self.host_of(primary.slot_id)
             seed = f"{self.job_id}:h{host}" if host is not None \
                 else f"{self.job_id}:{primary.slot_id}"
             delay = self.backoff.delay(domain.restarts_used, seed=seed)
-            return "restart", delay, list(world)
+            return "restart", delay, list(world), ladder
         victims = set(self._domain_slots(primary.slot_id, world))
         survivors = [s for s in world if s not in victims]
-        if len(survivors) >= self.min_workers \
-                and self._live_hosts(survivors) >= self.min_hosts:
-            return "shrink", 0.0, survivors
-        return "fail", 0.0, list(world)
+        can_shrink = len(survivors) >= self.min_workers \
+            and self._live_hosts(survivors) >= self.min_hosts
+        ladder.append({
+            "rung": "shrink", "taken": can_shrink,
+            "detail": (f"survivors {survivors} vs floors min_workers="
+                       f"{self.min_workers}, min_hosts={self.min_hosts}")})
+        if can_shrink:
+            return "shrink", 0.0, survivors, ladder
+        ladder.append({"rung": "fail", "taken": True,
+                       "detail": "cannot restart or shrink further"})
+        return "fail", 0.0, list(world), ladder
+
+    # -- incident flight recorder ------------------------------------------
+    def _write_incident(self, generation: int, decision: str, reason: str,
+                        delay: float, ladder: List[dict], primary: _Slot,
+                        dead: List[_Slot], world_before: List[int],
+                        world_after: List[int], slots: Dict[int, _Slot],
+                        restore_step: Optional[int]) -> None:
+        """Assemble the bounded incident bundle for one recovery
+        decision. Best-effort by design: a broken flight recorder is a
+        log line, never a second incident."""
+        if self.incidents is None:
+            return
+        try:
+            dead_ids = {d.slot_id for d in dead}
+            workers = []
+            for slot_id in world_before:
+                s = slots[slot_id]
+                workers.append({
+                    "slot": slot_id, "host": self.host_of(slot_id),
+                    "last_step": s.last_step, "live": s.live,
+                    "death_reason": s.death_reason,
+                    "exit_code": s.exit_code})
+            log_tails = {d.slot_id: self.tail_log(d.slot_id, generation)
+                         for d in dead}
+            span_files = []
+            try:
+                # only the dying generation's streams: a long job writes
+                # one file per generation per worker, and copying them
+                # ALL into every bundle would grow incident disk with
+                # job age (the flight recorder must stay bounded)
+                tag = f".gen{generation:03d}."
+                span_files = sorted(
+                    os.path.join(self.trace_dir, n)
+                    for n in os.listdir(self.trace_dir)
+                    if n.endswith(".jsonl") and tag in n)
+            except OSError:
+                pass
+            live_spans = None
+            from deeplearning4j_tpu.observe import get_active_tracer
+            tr = get_active_tracer()
+            if tr is not None:
+                live_spans = ("supervisor", tr.recorder.spans())
+            metrics_text = (self.fleet.exposition() if self.fleet is not None
+                            else self.metrics.exposition())
+            env = self.spec.environment()
+            path = self.incidents.record(
+                job_id=self.job_id, generation=generation,
+                ts_ms=self.clock.current_time_millis(),
+                decision=decision, reason=reason, backoff_s=delay,
+                ladder=ladder,
+                victim={"slot": primary.slot_id,
+                        "host": self.host_of(primary.slot_id),
+                        "death_reason": primary.death_reason},
+                dead_slots=sorted(dead_ids),
+                world_before=world_before, world_after=world_after,
+                workers=workers,
+                checkpoint={"restore_step": restore_step,
+                            # the generation is already fenced at
+                            # decision time: this is the exact step the
+                            # recovered world will resume from
+                            "next_restore_step": self.latest_eligible_step(),
+                            "eligible_steps": self.eligible_steps()},
+                fault_plan_env=env.get("DL4J_TPU_FAULT_PLAN"),
+                metrics_text=metrics_text,
+                span_files=span_files, live_spans=live_spans,
+                log_tails=log_tails)
+            self._log.info("incident bundle written", path=path,
+                           decision=decision, generation=generation)
+        except Exception as e:  # noqa: BLE001 - never fail recovery
+            self._log.error("incident bundle failed", error=str(e))
 
     # -- process management ------------------------------------------------
     def _launch_generation(self, generation: int, token: str,
                            world: List[int], slots: Dict[int, _Slot],
                            restore_step: Optional[int],
-                           eligible: Optional[Sequence[int]] = None) -> None:
+                           eligible: Optional[Sequence[int]] = None,
+                           gen_span=None) -> None:
         if eligible is None:
             eligible = self.eligible_steps()
         eligible_env = ",".join(str(s) for s in eligible)
@@ -682,6 +897,14 @@ class ElasticJobSupervisor:
             self.spec.resolved_advertise_host(), port)
         log_dir = os.path.join(self.ckpt_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
+        if gen_span is not None:
+            os.makedirs(self.trace_dir, exist_ok=True)
+        if self.fleet is not None:
+            # the federation follows the CURRENT world: sources of shrunk
+            # slots drop out (their series go absent, which the absence
+            # rules can alert on) and survivors re-register under the new
+            # generation label
+            self.fleet.clear_sources()
         now = self.clock.current_time_millis()
         for pid, slot_id in enumerate(sorted(world)):
             s = slots[slot_id]
@@ -723,6 +946,25 @@ class ElasticJobSupervisor:
             if host is not None:
                 env[ENV_HOST] = str(host)
                 env[ENV_NUM_HOSTS] = str(self.num_hosts)
+            if gen_span is not None:
+                # worker spans parent into this generation's job trace
+                # and stream crash-durably into the supervisor's trace dir
+                env[ENV_TRACEPARENT] = gen_span.context.traceparent()
+                env[ENV_TRACE_DIR] = self.trace_dir
+            if self.fleet is not None:
+                metrics_path = os.path.join(
+                    self.ckpt_dir, f"metrics.slot{slot_id}.prom")
+                try:
+                    # a stale snapshot from the previous generation must
+                    # not masquerade as this incarnation's series
+                    os.unlink(metrics_path)
+                except OSError:
+                    pass
+                env[ENV_METRICS_FILE] = metrics_path
+                labels = {"slot": slot_id, "generation": generation}
+                if host is not None:
+                    labels["host"] = host
+                self.fleet.set_source(slot_id, metrics_path, labels)
             if bind != "127.0.0.1":
                 # process 0 must LISTEN on the bind interface while peers
                 # dial the advertised one (ctx.init_distributed forwards
@@ -899,9 +1141,19 @@ class ElasticJobSupervisor:
             fh.close()
             s.proc._elastic_log = None
 
+    #: hard cap on one tail_log read — the ring-buffer discipline: a
+    #: multi-GB worker log must never be slurped whole into the
+    #: supervisor (or an incident bundle) because a caller asked big
+    TAIL_LOG_CAP = 1 << 20
+
     def tail_log(self, slot_id: int, generation: int,
                  n_bytes: int = 4000) -> str:
-        """Last bytes of one worker incarnation's captured output."""
+        """Last bytes of one worker incarnation's captured output.
+        Tolerates the worker truncating/rotating its own log mid-read
+        (the computed tail offset may no longer exist — re-read from the
+        top instead of returning garbage or raising) and caps the read
+        at :data:`TAIL_LOG_CAP` regardless of ``n_bytes``."""
+        n_bytes = max(0, min(int(n_bytes), self.TAIL_LOG_CAP))
         path = os.path.join(self.ckpt_dir, "logs",
                             f"gen{generation:03d}_slot{slot_id}.log")
         try:
@@ -909,9 +1161,39 @@ class ElasticJobSupervisor:
                 fh.seek(0, os.SEEK_END)
                 size = fh.tell()
                 fh.seek(max(0, size - n_bytes))
-                return fh.read().decode(errors="replace")
-        except OSError:
+                data = fh.read(n_bytes)
+                if not data and size > 0:
+                    # truncated/rotated between tell() and read(): the
+                    # offset we computed is past the new EOF
+                    fh.seek(0)
+                    data = fh.read(n_bytes)
+                return data.decode(errors="replace")
+        except (OSError, ValueError):
             return ""
+
+    def write_fleet_trace(self, path: str) -> int:
+        """Stitch every worker span stream under ``trace_dir`` plus the
+        supervisor's own recorded spans into ONE Perfetto-loadable
+        timeline at ``path`` (``observe.export.merge_chrome_traces``);
+        returns the event count (0 = tracing never ran)."""
+        from deeplearning4j_tpu.observe import get_active_tracer
+        from deeplearning4j_tpu.observe.export import merge_chrome_traces
+        from deeplearning4j_tpu.observe.trace import EPOCH_ANCHOR
+        sources: List[object] = []
+        try:
+            sources.extend(sorted(
+                os.path.join(self.trace_dir, n)
+                for n in os.listdir(self.trace_dir)
+                if n.endswith(".jsonl")))
+        except OSError:
+            pass
+        tr = get_active_tracer()
+        if tr is not None and len(tr.recorder):
+            sources.append({"label": "supervisor",
+                            "spans": tr.recorder.spans(),
+                            "anchor": EPOCH_ANCHOR})
+        obj = merge_chrome_traces(sources, out=path)
+        return len(obj["traceEvents"])
 
 
 # -- worker side -------------------------------------------------------------
@@ -949,6 +1231,14 @@ class ElasticWorkerContext:
     #: interface process 0's coordinator must LISTEN on when it differs
     #: from the advertised address (None → jax binds the advertised one)
     bind_host: Optional[str] = None
+    #: fleet observability seam (all None outside a fleet-observing
+    #: supervisor — every dependent hook is then a no-op): the
+    #: supervisor's per-generation elastic_job span context, the
+    #: directory this worker streams its spans into, and the file it
+    #: writes Prometheus exposition snapshots to
+    traceparent: Optional[str] = None
+    trace_dir: Optional[str] = None
+    metrics_file: Optional[str] = None
     _beats: int = 0
     _last_step: int = 0
     _beat_thread: object = None
@@ -987,7 +1277,10 @@ class ElasticWorkerContext:
             if ENV_NUM_HOSTS in env else None,
             progress_beat_s=float(env[ENV_PROGRESS_BEAT])
             if env.get(ENV_PROGRESS_BEAT) else None,
-            bind_host=env.get(ENV_BIND_HOST) or None)
+            bind_host=env.get(ENV_BIND_HOST) or None,
+            traceparent=env.get(ENV_TRACEPARENT) or None,
+            trace_dir=env.get(ENV_TRACE_DIR) or None,
+            metrics_file=env.get(ENV_METRICS_FILE) or None)
         if ctx.host is not None:
             from deeplearning4j_tpu.util import faultinject
             faultinject.set_host(ctx.host)  # host-scoped faults key on it
@@ -1375,6 +1668,29 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
         raise RuntimeError(
             "run_elastic_worker needs the supervisor environment "
             f"({ENV_TOKEN} etc.) — launch through ElasticJobSupervisor")
+    # fleet observability: both hooks ride the supervisor env and are
+    # no-ops without it (standalone workers pay one None check)
+    tracer = None
+    exporter = None
+    obs_registry = None
+    if ctx.metrics_file is not None:
+        from deeplearning4j_tpu.observe import default_registry
+        from deeplearning4j_tpu.observe.fleet import MetricsFileExporter
+        obs_registry = default_registry()
+        exporter = MetricsFileExporter(obs_registry, ctx.metrics_file)
+    if ctx.trace_dir is not None:
+        os.makedirs(ctx.trace_dir, exist_ok=True)
+        from deeplearning4j_tpu.observe import Tracer, enable_tracing
+        from deeplearning4j_tpu.observe.fleet import SpanFileWriter
+        span_writer = SpanFileWriter(
+            os.path.join(
+                ctx.trace_dir,
+                f"spans.gen{ctx.generation:03d}.slot{ctx.slot}.jsonl"),
+            label=f"slot {ctx.slot} gen {ctx.generation}",
+            extra_meta={"slot": ctx.slot, "generation": ctx.generation,
+                        "host": ctx.host, "rank": ctx.process_id})
+        tracer = enable_tracing(Tracer(span_writer),
+                                metrics=obs_registry)
     ctx.init_distributed()
     from deeplearning4j_tpu.parallel.master import (
         DistributedMultiLayerNetwork, SharedTrainingMaster)
@@ -1409,6 +1725,16 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
             master.load_state(state_path)
     front = DistributedMultiLayerNetwork(net, master)
 
+    if tracer is not None or exporter is not None:
+        # per-iteration train_iteration spans (parented into the job
+        # trace via the root span below) + training_* series for the
+        # supervisor's federation — appended BEFORE _Beat so the span
+        # for step S is crash-durably written before a planned kill at
+        # S fires in the heartbeat listener
+        from deeplearning4j_tpu.observe import TraceListener
+        net.listeners.append(TraceListener(
+            tracer=tracer, metrics=obs_registry, model_name="elastic"))
+
     class _Beat:
         def iteration_done(self, model, iteration, epoch):
             # the fault hook runs BEFORE the heartbeat: a worker blocked
@@ -1418,6 +1744,8 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
             # its victim choice on
             faultinject.on_step(ctx.slot, iteration, host=ctx.host)
             ctx.heartbeat(iteration)
+            if exporter is not None:
+                exporter.export()
 
     net.listeners.append(_Beat())
 
@@ -1428,6 +1756,8 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
             active_processes={0},
             barrier_sync_key_prefix=f"save_g{ctx.generation}")
     ctx.heartbeat(0)  # first beat: the world formed, jax is up
+    if exporter is not None:
+        exporter.export()  # series visible to the fleet before step 1
     ctx.start_heartbeat_thread()  # no-op unless the supervisor armed it
     session = None
     if save_mode == "async":
@@ -1436,16 +1766,30 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
                                          max_in_flight=max_in_flight)
     start_epoch = int(net.epoch)
     flushed = True
+    import contextlib
+    root_cm = contextlib.nullcontext()
+    if tracer is not None:
+        # the ambient context for everything this worker records:
+        # parented to the supervisor's per-generation elastic_job span,
+        # so train_iteration / checkpoint / DCN spans join the job trace
+        from deeplearning4j_tpu.observe import parse_traceparent
+        root_cm = tracer.span(
+            "elastic_worker", parent=parse_traceparent(ctx.traceparent),
+            category="elastic",
+            attrs={"slot": ctx.slot, "rank": ctx.process_id,
+                   "generation": ctx.generation,
+                   "restored_step": restored_step})
     try:
-        for epoch in range(start_epoch, epochs):
-            front.fit(build_iterator(), epochs=1)
-            step = epoch + 1
-            ctx.heartbeat(net.iteration)
-            if step % max(1, checkpoint_every) == 0 or step == epochs:
-                if session is not None:
-                    session.submit(step, net)
-                else:
-                    ctx.save_checkpoint(step, net, master, manager)
+        with root_cm:
+            for epoch in range(start_epoch, epochs):
+                front.fit(build_iterator(), epochs=1)
+                step = epoch + 1
+                ctx.heartbeat(net.iteration)
+                if step % max(1, checkpoint_every) == 0 or step == epochs:
+                    if session is not None:
+                        session.submit(step, net)
+                    else:
+                        ctx.save_checkpoint(step, net, master, manager)
     finally:
         if session is not None:
             flushed = session.close(timeout=flush_timeout_s)
@@ -1456,6 +1800,8 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
                 print(f"[slot {ctx.slot}] async checkpoint torn: {err}",
                       flush=True)
         ctx.stop_heartbeat_thread()
+        if exporter is not None:
+            exporter.export()  # final snapshot: the last committed step
         # a timed-out flush means the saver thread may still be INSIDE a
         # manager call — closing the manager under it would crash the
         # worker; the in-flight step stays torn (unstamped) and the

@@ -569,7 +569,25 @@ class ContinuousPipeline:
     def run_cycle(self) -> dict:
         """Advance the journal to this run's terminal commit — starting a
         fresh run from IDLE, or finishing a crashed predecessor's run
-        from its resume point — and return the run summary."""
+        from its resume point — and return the run summary.
+
+        While a tracer is active the whole cycle runs inside a
+        ``pipeline_run`` span, so every journal append made during it
+        (``PipelineJournal.append`` stamps the active trace id) and every
+        log line is correlatable back to the cycle that decided."""
+        from deeplearning4j_tpu.observe import trace as _trace
+        tracer = self.tracer if self.tracer is not None \
+            else _trace.get_active_tracer()
+        if tracer is None:
+            return self._run_cycle_inner()
+        with tracer.span("pipeline_run", category="pipeline",
+                         attrs={"pipeline": self.name}) as sp:
+            summary = self._run_cycle_inner()
+            sp.set_attribute("run", summary.get("run"))
+            sp.set_attribute("outcome", summary.get("outcome"))
+            return summary
+
+    def _run_cycle_inner(self) -> dict:
         st = self.sm.state()
         if st.stage == "IDLE":
             # a predecessor that crashed right after begin_run left an

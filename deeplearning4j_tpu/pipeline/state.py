@@ -38,6 +38,7 @@ import os
 import time
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.observe import trace as _trace
 from deeplearning4j_tpu.util import faultinject
 from deeplearning4j_tpu.util.fsio import atomic_write_text
 
@@ -169,6 +170,16 @@ class PipelineJournal:
         rec["seq"] = seq
         rec["token"] = token
         rec["ts"] = time.time()
+        # trace correlation (the LogRecord contract): a journal line
+        # written inside a traced pipeline run carries the active span's
+        # ids, so a promote/rollback decision is joinable with the spans
+        # and logs that caused it. Reads the context directly (ids are
+        # tracer-independent) so an explicitly-passed runner tracer
+        # correlates too; no open span → no fields, no cost.
+        trace_id, span_id = _trace.current_span_ids()
+        if trace_id is not None:
+            rec.setdefault("trace_id", trace_id)
+            rec.setdefault("span_id", span_id)
         line = json.dumps(rec, sort_keys=True)
         with open(self.journal_path, "a", encoding="utf-8") as fh:
             fh.write(line + "\n")

@@ -81,6 +81,8 @@ import numpy as np
 from deeplearning4j_tpu.observe import trace as _trace
 from deeplearning4j_tpu.observe.metrics import (MetricsRegistry,
                                                 default_registry)
+from deeplearning4j_tpu.observe.metrics import respond as _respond_http
+from deeplearning4j_tpu.observe.metrics import respond_json as _respond_json
 from deeplearning4j_tpu.parallel.inference import (DispatcherCrashed,
                                                    InferenceDeadlineExceeded)
 from deeplearning4j_tpu.serving.admission import (AdmissionController,
@@ -187,24 +189,16 @@ class ModelServer:
                 super().finish()
 
             # -------------------------------------------------- responders
+            # the shared plumbing (observe.metrics.respond): status +
+            # exact Content-Length + extra headers + the staged trace
+            # correlation headers, whichever branch answered
             def _respond(self, code: int, body: bytes, content_type: str,
                          headers: Tuple[Tuple[str, str], ...] = ()) -> None:
-                self.send_response(code)
-                self.send_header("Content-Type", content_type)
-                self.send_header("Content-Length", str(len(body)))
-                for k, v in headers:
-                    self.send_header(k, v)
-                # trace correlation headers ride EVERY response of a traced
-                # request, whichever branch answered it
-                for k, v in getattr(self, "_trace_headers", ()):
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(body)
+                _respond_http(self, code, body, content_type, headers)
 
             def _json(self, obj, code: int = 200,
                       headers: Tuple[Tuple[str, str], ...] = ()) -> None:
-                self._respond(code, json.dumps(obj).encode(),
-                              "application/json", headers)
+                _respond_json(self, obj, code, headers)
 
             # ------------------------------------------------------- GETs
             def do_GET(self):

@@ -126,6 +126,83 @@ def test_shipped_serving_alert_rules_lint_clean():
     assert proc.stdout.startswith("OK"), proc.stdout
 
 
+def test_incident_validator_over_injected_kill_bundle(tmp_path):
+    """The incident flight recorder's validator, run as a user would:
+    a smoke-tier supervisor (manual clock, scripted launcher) suffers an
+    injected worker kill, shrinks, writes an ``incident_*`` bundle —
+    and ``tools/validate_incident.py`` passes it via the real CLI."""
+    from deeplearning4j_tpu.observe import MetricsRegistry
+    from deeplearning4j_tpu.parallel import elastic
+    from deeplearning4j_tpu.parallel.elastic import (BackoffPolicy,
+                                                     ElasticJobSupervisor,
+                                                     WorkerSpec)
+    from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+
+    class _Proc:
+        rc = None
+
+        def poll(self):
+            return self.rc
+
+        def kill(self):
+            self.rc = -9 if self.rc is None else self.rc
+
+        def wait(self, timeout=None):
+            return self.rc
+
+    class _World:
+        def __init__(self, clock):
+            self.clock = clock
+            self.procs = {}
+            self.tick = 0
+
+        def launch(self, argv, env, cwd, log_path):
+            p = _Proc()
+            self.procs[int(env[elastic.ENV_SLOT])] = (env, p)
+            with open(log_path, "w", encoding="utf-8") as fh:
+                fh.write("worker boot\n")
+            return p
+
+        def sleep(self, seconds):
+            self.clock.advance(seconds=max(seconds, 1.0))
+            self.tick += 1
+            for slot, (env, p) in self.procs.items():
+                if p.rc is not None:
+                    continue
+                with open(env[elastic.ENV_HEARTBEAT], "w",
+                          encoding="utf-8") as fh:
+                    fh.write(f"1:{self.tick}:{self.tick}")
+            if self.tick == 2:
+                self.procs[1][1].rc = -9   # the injected kill
+            elif self.tick >= 3:
+                for slot, (env, p) in self.procs.items():
+                    if p.rc is None:
+                        p.rc = 0
+
+    clock = ManualTimeSource(start_ms=1_000)
+    world = _World(clock)
+    sup = ElasticJobSupervisor(
+        WorkerSpec(argv=["worker"], env={}), 2, min_workers=1,
+        ckpt_dir=str(tmp_path / "ckpt"), clock=clock,
+        sleep_fn=world.sleep, launcher=world, metrics=MetricsRegistry(),
+        port_fn=lambda: 45999, poll_interval_s=1.0,
+        backoff=BackoffPolicy(max_restarts=0))
+    result = sup.run()
+    assert result.status == "completed"
+    assert sup.incidents is not None and len(sup.incidents.bundles) == 1
+    bundle = sup.incidents.bundles[0]
+
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_incident.py"), bundle],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+    assert "shrink" in proc.stdout and "victim slot 1" in proc.stdout
+
+
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs_clean(script):
     env = dict(

@@ -521,8 +521,20 @@ class TestSharedMetricsCore:
             c.knn(0, 3)
             c.knn_new(np.zeros(4, np.float32), 2)
             reqs = metrics.get("http_requests_total")
-            assert reqs.value(server="knn", path="/knn", status="200") == 1
-            assert reqs.value(server="knn", path="/knnnew", status="200") == 1
+
+            # the mixin records AFTER the response bytes are written; the
+            # client can observe the body first — poll briefly (the same
+            # discipline the UI-server test below applies)
+            def _poll(path, want):
+                for _ in range(200):
+                    if reqs.value(server="knn", path=path,
+                                  status="200") == want:
+                        break
+                    time.sleep(0.005)
+                assert reqs.value(server="knn", path=path,
+                                  status="200") == want
+            _poll("/knn", 1)
+            _poll("/knnnew", 1)
             assert metrics.get("http_request_latency_seconds").count(
                 server="knn", path="/knn") == 1
             # a malformed request line (rejected before self.path is set)
@@ -534,7 +546,7 @@ class TestSharedMetricsCore:
                 assert s.recv(64)  # error reply, not a dropped connection
             # ...and the server keeps serving afterwards
             c.knn(0, 1)
-            assert reqs.value(server="knn", path="/knn", status="200") == 2
+            _poll("/knn", 2)
         finally:
             srv.stop()
 

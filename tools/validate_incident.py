@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Incident-bundle validator: schema check + bounds lint.
+
+Validates an ``incident_<generation>_<seq>/`` directory written by
+``observe.incident.IncidentRecorder`` the same way
+``tools/validate_fault_plan.py`` validates fault plans: importable
+(``validate_bundle`` returns a list of problems, empty = valid) and
+runnable (``python tools/validate_incident.py BUNDLE_DIR [...]``).
+
+Two passes:
+
+1. **schema** — ``incident.json`` must exist, parse, and carry every
+   required field with the right shape (schema version, decision action
+   from the known set, victim/world/worker records, decision ladder,
+   declared bounds and files);
+2. **bounds lint** — the bundle must honor its own declared bounds
+   (span files ≤ ``max_spans`` span lines each, ``logs.jsonl`` ≤
+   ``max_log_lines``, victim log tails ≤ ``max_log_bytes``) and every
+   declared file must actually exist — a flight recorder that silently
+   truncates or dangles references is lying to the operator.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from deeplearning4j_tpu.observe.incident import (  # noqa: E402
+    DECISIONS,
+    KIND,
+    SCHEMA_VERSION,
+)
+
+
+def _is_int(v: Any) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool)
+
+
+def _check_manifest(m: Any) -> List[str]:
+    errors: List[str] = []
+    if not isinstance(m, dict):
+        return ["incident.json: top level is not an object"]
+    if m.get("schema") != SCHEMA_VERSION:
+        errors.append(f"schema: expected version {SCHEMA_VERSION}, "
+                      f"got {m.get('schema')!r}")
+    if m.get("kind") != KIND:
+        errors.append(f"schema: kind must be {KIND!r}, got {m.get('kind')!r}")
+    for field, typ in (("job_id", str), ("generation", int), ("seq", int),
+                      ("ts_ms", int)):
+        v = m.get(field)
+        if not isinstance(v, typ) or isinstance(v, bool):
+            errors.append(f"schema: {field} missing or not {typ.__name__}")
+
+    dec = m.get("decision")
+    if not isinstance(dec, dict):
+        errors.append("schema: decision missing")
+    else:
+        if dec.get("action") not in DECISIONS:
+            errors.append(f"schema: decision.action {dec.get('action')!r} "
+                          f"not in {DECISIONS}")
+        if not isinstance(dec.get("reason"), str) or not dec.get("reason"):
+            errors.append("schema: decision.reason missing/empty")
+        if not isinstance(dec.get("ladder"), list) or not dec.get("ladder"):
+            errors.append("schema: decision.ladder missing/empty")
+        else:
+            for i, rung in enumerate(dec["ladder"]):
+                if not isinstance(rung, dict) or "rung" not in rung \
+                        or "taken" not in rung:
+                    errors.append(f"schema: ladder[{i}] needs rung/taken")
+
+    victim = m.get("victim")
+    if not isinstance(victim, dict) or not _is_int(victim.get("slot")):
+        errors.append("schema: victim.slot missing or not an int")
+
+    world = m.get("world")
+    if not isinstance(world, dict) \
+            or not isinstance(world.get("before"), list) \
+            or not isinstance(world.get("after"), list):
+        errors.append("schema: world.before/world.after missing")
+
+    if not isinstance(m.get("dead_slots"), list):
+        errors.append("schema: dead_slots missing")
+
+    workers = m.get("workers")
+    if not isinstance(workers, list) or not workers:
+        errors.append("schema: workers missing/empty")
+    else:
+        for i, w in enumerate(workers):
+            if not isinstance(w, dict) or not _is_int(w.get("slot")):
+                errors.append(f"schema: workers[{i}].slot missing")
+            elif "last_step" not in w:
+                errors.append(f"schema: workers[{i}].last_step missing "
+                              "(null is fine; absence is not)")
+
+    ckpt = m.get("checkpoint")
+    if not isinstance(ckpt, dict) or "restore_step" not in ckpt:
+        errors.append("schema: checkpoint.restore_step missing")
+
+    bounds = m.get("bounds")
+    if not isinstance(bounds, dict) or not all(
+            _is_int(bounds.get(k)) and bounds.get(k) > 0
+            for k in ("max_spans", "max_log_lines", "max_log_bytes")):
+        errors.append("schema: bounds.max_spans/max_log_lines/"
+                      "max_log_bytes missing or non-positive")
+
+    if not isinstance(m.get("files"), dict):
+        errors.append("schema: files missing")
+    return errors
+
+
+def _count_lines(path: str, *, span_lines: bool = False) -> int:
+    n = 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            if span_lines and '"meta"' in line:
+                continue  # the anchor/meta header is not a span
+            n += 1
+    return n
+
+
+def validate_bundle(path: str) -> List[str]:
+    """Return a list of problems (empty = valid) for one bundle dir."""
+    manifest_path = os.path.join(path, "incident.json")
+    try:
+        with open(manifest_path, encoding="utf-8") as fh:
+            m = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{manifest_path}: unreadable manifest: {e}"]
+    errors = _check_manifest(m)
+    if errors:
+        return errors
+
+    bounds = m["bounds"]
+    files = m["files"]
+
+    metrics = files.get("metrics")
+    if metrics is not None and not os.path.exists(
+            os.path.join(path, metrics)):
+        errors.append(f"files: declared metrics file {metrics!r} missing")
+
+    spans_dir = files.get("spans_dir")
+    if spans_dir is not None:
+        full = os.path.join(path, spans_dir)
+        if not os.path.isdir(full):
+            errors.append(f"files: declared spans dir {spans_dir!r} missing")
+        else:
+            for name in sorted(os.listdir(full)):
+                n = _count_lines(os.path.join(full, name), span_lines=True)
+                if n > bounds["max_spans"]:
+                    errors.append(
+                        f"bounds: {spans_dir}/{name} has {n} spans "
+                        f"> max_spans={bounds['max_spans']}")
+
+    logs = files.get("logs")
+    if logs is not None:
+        full = os.path.join(path, logs)
+        if not os.path.exists(full):
+            errors.append(f"files: declared log file {logs!r} missing")
+        else:
+            n = _count_lines(full)
+            if n > bounds["max_log_lines"]:
+                errors.append(f"bounds: {logs} has {n} lines "
+                              f"> max_log_lines={bounds['max_log_lines']}")
+
+    tails = files.get("log_tail_dir")
+    if tails is not None:
+        full = os.path.join(path, tails)
+        if not os.path.isdir(full):
+            errors.append(f"files: declared log-tail dir {tails!r} missing")
+        else:
+            for name in sorted(os.listdir(full)):
+                size = os.path.getsize(os.path.join(full, name))
+                if size > bounds["max_log_bytes"]:
+                    errors.append(
+                        f"bounds: {tails}/{name} is {size} bytes "
+                        f"> max_log_bytes={bounds['max_log_bytes']}")
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print("usage: validate_incident.py BUNDLE_DIR [BUNDLE_DIR ...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_bundle(path)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            with open(os.path.join(path, "incident.json"),
+                      encoding="utf-8") as fh:
+                m = json.load(fh)
+            print(f"OK   {path}: generation {m['generation']} "
+                  f"{m['decision']['action']} "
+                  f"(victim slot {m['victim']['slot']})")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
