@@ -43,6 +43,18 @@ the headline (MULTICHIP_r06: step time vs world size on the mesh, and
 the per-step checkpoint save stall sync vs async — the async overlapped
 path must beat the blocking one). ``--save-mode sync|async`` restricts
 the save-stall half to one mode.
+
+``--train-pipeline [OUT.json]`` runs the training input-pipeline + fused
+updater series (BENCH_TRAIN_r01): step time over an ETL-bound iterator
+with prefetch off vs on (the ``fit(prefetch_depth=...)`` async wrap must
+hide the host work), host_wait per step, transfer bytes, steady-state
+compile counts, and the fused Pallas optimizer step vs the stock
+per-param chain (timing + numerical agreement + kernel-launch count).
+``--train-pipeline --check COMMITTED.json`` validates a committed record
+(prefetch-on faster, zero steady-state compiles) plus LIVE oracles on
+this machine: fused-vs-stock agreement ≤2e-5, exactly one pallas_call
+per fusable tensor in the train-step jaxpr, none with the seam clear,
+zero steady-state compiles — exits non-zero on any violation.
 """
 
 import json
@@ -494,6 +506,311 @@ def _pod_scaling_main(out_path, save_mode):
     print(line)
 
 
+# -- training input pipeline + fused updater series (BENCH_TRAIN_r01) --------
+
+class _OneHotETLIterator:
+    """Transfer-bound input source: every batch costs an ingest latency
+    (``io_ms`` of GIL-released wait — the remote-storage read profile) plus
+    real numpy decode work (one-hot encode), the stall the async prefetch
+    wrap exists to hide behind the running step. Yields fresh numpy-backed
+    DataSets, so it is safe to device_put/mutate downstream."""
+
+    def __init__(self, n_batches, batch, t, vocab, n_labels=10, seed=0,
+                 io_ms=15.0):
+        self.n_batches = int(n_batches)
+        self.batch, self.t, self.vocab = int(batch), int(t), int(vocab)
+        self.n_labels = int(n_labels)
+        self.seed = int(seed)
+        self.io_ms = float(io_ms)
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        rng = np.random.default_rng(self.seed)
+        eye = np.eye(self.vocab, dtype=np.float32)
+        for _ in range(self.n_batches):
+            time.sleep(self.io_ms / 1e3)  # the read we are hiding
+            ids = rng.integers(0, self.vocab, size=(self.batch, self.t))
+            x = eye[ids].reshape(self.batch, self.t * self.vocab)
+            y = np.eye(self.n_labels, dtype=np.float32)[
+                rng.integers(0, self.n_labels, size=self.batch)]
+            yield DataSet(x, y)
+
+
+def _pipeline_net(n_in, width=128, n_labels=10, seed=1):
+    """Small dense model over wide one-hot input: the step is cheap enough
+    that an unhidden ETL stage dominates the loop."""
+    from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .list()
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(OutputLayer(n_out=n_labels))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _prefetch_run(net, depth, n_batches, batch, t, vocab, seed):
+    """One epoch over the ETL-bound iterator at one prefetch depth, with the
+    production observability attached — the TraceListener reads the score
+    every step, the per-iteration sync every monitored training run pays.
+    Returns wall/step, host_wait/step (from the fit loop's trace spans),
+    transfer MB (from the exported counter) and compiles on this thread."""
+    from deeplearning4j_tpu.observe import (Tracer, disable_tracing,
+                                            enable_tracing)
+    from deeplearning4j_tpu.observe.listener import TraceListener
+    from deeplearning4j_tpu.observe.metrics import MetricsRegistry
+
+    it = _OneHotETLIterator(n_batches, batch, t, vocab, seed=seed)
+    metrics = MetricsRegistry()
+    tracer = enable_tracing(Tracer(metrics=metrics))
+    listener = TraceListener(tracer, metrics, model_name="bench")
+    net.listeners.append(listener)
+    try:
+        t0 = time.perf_counter()
+        net.fit(it, epochs=1, prefetch_depth=depth)
+        float(net.score_)  # drain the dispatch queue before stopping the clock
+        dt = time.perf_counter() - t0
+        compiles = tracer.thread_compile_count()
+    finally:
+        net.listeners.remove(listener)
+        disable_tracing()
+    host_wait_ms = sum(s.end_ns - s.start_ns
+                       for s in tracer.recorder.spans()
+                       if s.name == "host_wait" and s.end_ns) / 1e6
+    xfer = metrics.get("training_transfer_bytes_total")
+    return {
+        "prefetch_depth": depth,
+        "wall_ms_per_step": round(dt / n_batches * 1e3, 2),
+        "host_wait_ms_per_step": round(host_wait_ms / n_batches, 2),
+        "transfer_mb_total": round(
+            (xfer.value(model="bench") if xfer is not None else 0) / 2**20, 2),
+        "steady_state_compiles": int(compiles),
+    }
+
+
+def _max_param_diff(a, b):
+    """max |Δ| over every parameter tensor of two same-structure nets."""
+    import jax
+    return max(float(np.max(np.abs(np.asarray(x) - np.asarray(y))))
+               for x, y in zip(jax.tree_util.tree_leaves(a.params),
+                               jax.tree_util.tree_leaves(b.params)))
+
+
+def _count_pallas_eqns(jaxpr):
+    """pallas_call equations in a jaxpr, recursing into sub-jaxprs (pjit
+    bodies, scan/cond branches)."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            n += 1
+        for v in eqn.params.values():
+            for u in (v if isinstance(v, (tuple, list)) else (v,)):
+                inner = getattr(u, "jaxpr", u)
+                if hasattr(inner, "eqns"):
+                    n += _count_pallas_eqns(inner)
+    return n
+
+
+def _pallas_call_counts(net, ds):
+    """(pallas_call eqns in the traced train step, fusable param tensors).
+    With the fused updater registered the two must be EQUAL — one kernel
+    launch per parameter's read-modify-write, no per-param op chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+
+    fn = net._get_train_step(False)
+    closed = jax.make_jaxpr(fn)(
+        net.params, net.states, net.updater_states,
+        jnp.float32(0.0), jnp.float32(0.0),
+        jnp.asarray(np.asarray(ds.features), jnp.float32),
+        jnp.asarray(np.asarray(ds.labels), jnp.float32),
+        None, None, jax.random.PRNGKey(0), None)
+    probe = PallasUpdaterHelper()
+    fusable = sum(1 for i, layer_params in enumerate(net.params)
+                  for n, p in layer_params.items()
+                  if probe.supports(net._updaters[i][n], p, p))
+    return _count_pallas_eqns(closed.jaxpr), fusable
+
+
+def _fused_updater_bench():
+    """Fused Pallas optimizer step vs the stock per-param chain on twin
+    nets (same seed, same data): wall time each way, post-run numerical
+    agreement, and the kernel-launch oracle."""
+    import jax
+
+    from deeplearning4j_tpu.nn import helpers as _helpers
+    from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+
+    net_a, ds, batch = _scaling_net(seed=7)
+    net_b, _, _ = _scaling_net(seed=7)
+    _helpers.clear_helper("updater")
+    try:
+        rec = {"config": "3-layer 512-wide MLP (~790k params, Adam), "
+                         "B=128 f32 (the pod-scaling net)"}
+        # per-update agreement contract first: fresh twins, 3 identical
+        # steps each way — the tolerance is per update, not compounded
+        # over a long chaotic trajectory
+        tw_a, tw_ds, _ = _scaling_net(seed=11, width=64)
+        tw_b, _, _ = _scaling_net(seed=11, width=64)
+        for _ in range(3):
+            tw_a._fit_batch(tw_ds)
+        _helpers.set_helper("updater", PallasUpdaterHelper())
+        for _ in range(3):
+            tw_b._fit_batch(tw_ds)
+        rec["max_abs_param_diff"] = float(_max_param_diff(tw_a, tw_b))
+        rec["agreement_steps"] = 3
+        _helpers.clear_helper("updater")
+        rec["stock"] = _measure(net_a, ds, batch)
+        _helpers.set_helper("updater", PallasUpdaterHelper())
+        rec["fused"] = _measure(net_b, ds, batch)
+        stock_ms = rec["stock"]["wall_ms_per_step"]
+        fused_ms = rec["fused"]["wall_ms_per_step"]
+        rec["fused_vs_stock"] = round(fused_ms / stock_ms, 4) \
+            if stock_ms > 0 else None
+        pallas, fusable = _pallas_call_counts(net_b, ds)
+        rec["pallas_calls_in_train_step"] = pallas
+        rec["fusable_tensors"] = fusable
+        if jax.default_backend() != "tpu":
+            rec["note"] = ("interpret-mode Pallas off-TPU: the fused timing "
+                           "measures the seam, not the kernel — the "
+                           "correctness/launch-count oracles are the "
+                           "backend-portable signal")
+        return rec
+    finally:
+        _helpers.clear_helper("updater")
+
+
+def _train_pipeline_main(out_path):
+    import jax
+
+    vocab, t, batch, n_batches = 256, 32, 64, 24
+    net = _pipeline_net(t * vocab)
+    # compile outside the measured windows (identical shapes throughout)
+    net.fit(_OneHotETLIterator(2, batch, t, vocab, seed=99), epochs=1,
+            prefetch_depth=0)
+    float(net.score_)
+
+    prefetch = {
+        "off": _prefetch_run(net, 0, n_batches, batch, t, vocab, seed=5),
+        "on": _prefetch_run(net, 2, n_batches, batch, t, vocab, seed=6),
+    }
+    on_ms = prefetch["on"]["wall_ms_per_step"]
+    record = {
+        "metric": "train_pipeline",
+        "series": "BENCH_TRAIN_r01",
+        "backend": jax.default_backend(),
+        "n_devices": len(jax.devices()),
+        "config": f"dense-128 over {t * vocab}-wide one-hot, B={batch}, "
+                  f"{n_batches} batches/epoch, 15ms ingest latency + numpy "
+                  "decode per batch, Adam, f32, TraceListener attached "
+                  "(per-step score sync)",
+        "note": "prefetch off = the fit thread pays ingest + decode + "
+                "transfer between steps; on = AsyncDataSetIterator producer "
+                "+ device_put stage hides them behind the running step, so "
+                "host_wait collapses",
+        "prefetch": prefetch,
+        "prefetch_speedup": round(
+            prefetch["off"]["wall_ms_per_step"] / on_ms, 4) if on_ms else None,
+        "fused_updater": _fused_updater_bench(),
+    }
+    line = json.dumps(record, indent=2)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fh:
+            fh.write(line + "\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+    print(line)
+
+
+def _train_check(path):
+    """Validate a committed BENCH_TRAIN record + live functional oracles.
+    Timing claims are checked against the COMMITTED record (live timing on
+    an arbitrary CI box is noise); correctness claims are re-proven live."""
+    errors = []
+
+    def expect(cond, msg):
+        if not cond:
+            errors.append(msg)
+
+    with open(path, encoding="utf-8") as fh:
+        rec = json.load(fh)
+    expect(rec.get("metric") == "train_pipeline", "metric != train_pipeline")
+    pre = rec.get("prefetch") or {}
+    expect("off" in pre and "on" in pre, "prefetch.off/on missing")
+    if "off" in pre and "on" in pre:
+        expect(pre["on"]["wall_ms_per_step"] < pre["off"]["wall_ms_per_step"],
+               "committed record: prefetch-on not faster than prefetch-off")
+        expect(pre["on"]["host_wait_ms_per_step"]
+               <= pre["off"]["host_wait_ms_per_step"],
+               "committed record: prefetch did not reduce host_wait")
+        for k in ("off", "on"):
+            expect(pre[k].get("steady_state_compiles") == 0,
+                   f"committed record: prefetch.{k} recompiled in steady "
+                   f"state")
+            expect(pre[k].get("transfer_mb_total", 0) > 0,
+                   f"committed record: prefetch.{k} transfer counter empty")
+    fu = rec.get("fused_updater") or {}
+    expect(fu.get("max_abs_param_diff", 1.0) <= 2e-5,
+           "committed record: fused/stock divergence > 2e-5")
+    expect(fu.get("fusable_tensors", 0) > 0
+           and fu.get("pallas_calls_in_train_step")
+           == fu.get("fusable_tensors"),
+           "committed record: kernel launches != fusable tensors")
+
+    # live oracles — re-proven on this machine, every run
+    from deeplearning4j_tpu.nn import helpers as _helpers
+    from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+    from deeplearning4j_tpu.observe import (Tracer, disable_tracing,
+                                            enable_tracing)
+
+    net_a, ds, _ = _scaling_net(seed=3, width=64)
+    net_b, _, _ = _scaling_net(seed=3, width=64)
+    _helpers.clear_helper("updater")
+    try:
+        for _ in range(3):
+            net_a._fit_batch(ds)
+        pallas0, _ = _pallas_call_counts(net_a, ds)
+        expect(pallas0 == 0,
+               f"live: {pallas0} pallas_call(s) with the updater seam clear")
+        _helpers.set_helper("updater", PallasUpdaterHelper())
+        for _ in range(3):
+            net_b._fit_batch(ds)
+        diff = _max_param_diff(net_a, net_b)
+        expect(diff <= 2e-5,
+               f"live: fused diverged from stock by {diff:.2e} > 2e-5")
+        pallas, fusable = _pallas_call_counts(net_b, ds)
+        expect(fusable > 0 and pallas == fusable,
+               f"live: {pallas} pallas_call(s) for {fusable} fusable tensors")
+        tracer = enable_tracing(Tracer())
+        try:
+            for _ in range(3):
+                net_b._fit_batch(ds)
+            float(net_b.score_)
+            live_compiles = tracer.thread_compile_count()
+            expect(live_compiles == 0,
+                   f"live: {live_compiles} steady-state compile(s) on the "
+                   f"fused path")
+        finally:
+            disable_tracing()
+    finally:
+        _helpers.clear_helper("updater")
+
+    if errors:
+        for e in errors:
+            print(f"train-pipeline check FAILED: {e}", file=sys.stderr)
+        raise SystemExit(1)
+    print(f"train-pipeline check OK: {path} (prefetch speedup "
+          f"{rec.get('prefetch_speedup')}x committed; fused updater agrees "
+          f"live, one kernel per tensor, zero steady-state compiles)")
+
+
 def main():
     record = _with_trace("resnet50_headline", _resnet50_headline)
     if os.environ.get("DL4J_TPU_BENCH_HEADLINE_ONLY") != "1":
@@ -505,6 +822,21 @@ def main():
                 suite[name] = {"error": f"{type(e).__name__}: {e}"}
         record["suite"] = suite
     print(json.dumps(record))
+
+
+def _parse_train_args():
+    """(--train-pipeline present, out path or None, --check path or None);
+    (False, None, None) when the flag is absent. Unknown flags pass
+    through, mirroring _parse_pod_args."""
+    if "--train-pipeline" not in sys.argv[1:]:
+        return False, None, None
+    import argparse
+    ap = argparse.ArgumentParser("bench --train-pipeline", add_help=False)
+    ap.add_argument("--train-pipeline", nargs="?", default=None,
+                    metavar="OUT.json", dest="out")
+    ap.add_argument("--check", default=None, metavar="COMMITTED.json")
+    args, _unknown = ap.parse_known_args(sys.argv[1:])
+    return True, args.out, args.check
 
 
 def _parse_pod_args():
@@ -524,6 +856,13 @@ def _parse_pod_args():
 
 
 if __name__ == "__main__":
+    train, _train_out, _train_check_path = _parse_train_args()
+    if train:
+        if _train_check_path:
+            _train_check(_train_check_path)
+        else:
+            _train_pipeline_main(_train_out)
+        raise SystemExit(0)
     pod, _pod_out, _pod_mode = _parse_pod_args()
     if pod:
         _pod_scaling_main(_pod_out, _pod_mode)

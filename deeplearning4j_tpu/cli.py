@@ -139,7 +139,6 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                  "in-process serve command exposes /metrics itself)")
 
     from deeplearning4j_tpu.datasets.dataset import DataSet, ListDataSetIterator
-    from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
     from deeplearning4j_tpu.parallel import ParallelWrapper
     from deeplearning4j_tpu.parallel.mesh import make_mesh
     from deeplearning4j_tpu.util import model_serializer
@@ -148,8 +147,6 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     z = np.load(args.dataPath)
     ds = DataSet(z["features"], z["labels"])
     it = ListDataSetIterator(ds, args.batchSize, shuffle=True)
-    if args.prefetchSize > 0:
-        it = AsyncDataSetIterator(it, queue_size=args.prefetchSize)
     if args.uiUrl:
         from deeplearning4j_tpu.ui import StatsListener
         from deeplearning4j_tpu.ui.remote import RemoteUIStatsStorageRouter
@@ -191,7 +188,7 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                          averaging_frequency=args.averagingFrequency,
                          metrics=(None if tracer is None else tracer.metrics))
     try:
-        pw.fit(it, epochs=args.epochs)
+        pw.fit(it, epochs=args.epochs, prefetch_depth=args.prefetchSize)
     finally:
         if alert_mgr is not None:
             alert_mgr.evaluate_once()  # final round so late series count

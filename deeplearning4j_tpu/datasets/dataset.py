@@ -105,6 +105,24 @@ class MultiDataSet:
         return int(np.asarray(self.features[0]).shape[0])
 
 
+def batch_nbytes(ds) -> int:
+    """Host→device payload of one batch: features/labels/masks bytes, for
+    both DataSet and MultiDataSet faces. Shared by ParallelWrapper and the
+    single-process fit paths so ``training_transfer_bytes_total`` means the
+    same thing everywhere."""
+    total = 0
+    if isinstance(ds, MultiDataSet):
+        groups = [ds.features, ds.labels, ds.features_masks or (),
+                  ds.labels_masks or ()]
+        arrays = [a for g in groups for a in g]
+    else:
+        arrays = [ds.features, ds.labels, ds.features_mask, ds.labels_mask]
+    for a in arrays:
+        if a is not None:
+            total += int(getattr(a, "nbytes", 0))
+    return total
+
+
 class DataSetIterator:
     """Iterator SPI (reset + iteration). Subclasses yield DataSet batches."""
 

@@ -36,7 +36,9 @@ class AsyncDataSetIterator(DataSetIterator):
         self.device_put = device_put
 
     def reset(self) -> None:
-        self.base.reset()
+        # plain lists/generators have no reset; the fit loops re-iterate them
+        if hasattr(self.base, "reset"):
+            self.base.reset()
 
     def __iter__(self) -> Iterator[DataSet]:
         q: "queue.Queue" = queue.Queue(maxsize=self.queue_size)
@@ -83,6 +85,58 @@ class AsyncDataSetIterator(DataSetIterator):
 
 class AsyncMultiDataSetIterator(AsyncDataSetIterator):
     """Same prefetch for MultiDataSet streams (AsyncMultiDataSetIterator)."""
+
+
+def device_put_batch(ds):
+    """Async-stage device put: moves one DataSet/MultiDataSet's arrays
+    (features, labels, masks) onto the accelerator and returns it — the
+    ``device_put`` callable the prefetch pipeline hands to
+    :class:`AsyncDataSetIterator`, so the host→device transfer of batch N+1
+    overlaps the device computing batch N."""
+    import jax
+
+    put = lambda a: jax.device_put(np.asarray(a))  # noqa: E731
+    if hasattr(ds, "features_masks"):  # MultiDataSet face
+        ds.features = [put(f) for f in ds.features]
+        ds.labels = [put(l) for l in ds.labels]
+        if ds.features_masks is not None:
+            ds.features_masks = [None if m is None else put(m)
+                                 for m in ds.features_masks]
+        if ds.labels_masks is not None:
+            ds.labels_masks = [None if m is None else put(m)
+                               for m in ds.labels_masks]
+        return ds
+    ds.features = put(ds.features)
+    ds.labels = put(ds.labels)
+    if ds.features_mask is not None:
+        ds.features_mask = put(ds.features_mask)
+    if ds.labels_mask is not None:
+        ds.labels_mask = put(ds.labels_mask)
+    return ds
+
+
+def wrap_for_prefetch(iterator, prefetch_depth, device_put=device_put_batch):
+    """Auto-wrap a fit() data source in async host→device prefetch.
+
+    Returns ``iterator`` unchanged when prefetch cannot help or is refused:
+    depth <= 0, a single-batch list, an iterator that is already an
+    :class:`AsyncDataSetIterator`, or one that opts out via
+    ``async_supported = False`` (:class:`AsyncShieldDataSetIterator` — the
+    reference's contract at ``MultiLayerNetwork.java:1267``). Everything
+    else gets a producer thread with ``prefetch_depth`` queue slots and a
+    device-put stage, so batch N+1 is host-prepared AND device-resident
+    while the device runs batch N."""
+    depth = 2 if prefetch_depth is None else int(prefetch_depth)
+    if depth <= 0:
+        return iterator
+    if isinstance(iterator, AsyncDataSetIterator):
+        return iterator  # caller already chose its own prefetch config
+    if not getattr(iterator, "async_supported", True):
+        return iterator
+    if isinstance(iterator, (list, tuple)) and len(iterator) <= 1:
+        return iterator  # nothing to overlap with
+    return AsyncDataSetIterator(iterator, queue_size=depth,
+                                device_put=device_put)
 
 
 class MultipleEpochsIterator(DataSetIterator):
@@ -329,6 +383,12 @@ class DefaultCallback(DataSetCallback):
         import jax
         ds.features = jax.device_put(np.asarray(ds.features))
         ds.labels = jax.device_put(np.asarray(ds.labels))
+        # masks ride along too — a masked RNN batch would otherwise
+        # re-transfer its masks on the training thread every step
+        if ds.features_mask is not None:
+            ds.features_mask = jax.device_put(np.asarray(ds.features_mask))
+        if ds.labels_mask is not None:
+            ds.labels_mask = jax.device_put(np.asarray(ds.labels_mask))
 
 
 class AsyncShieldDataSetIterator(DataSetIterator):

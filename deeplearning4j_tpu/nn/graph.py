@@ -57,6 +57,9 @@ class ComputationGraph:
         self._updaters: Dict[str, Dict[str, Updater]] = {}
         self._rnn_carries: Optional[Dict[str, Any]] = None
         self._rnn_pos = 0
+        # cumulative host→device batch payload shipped by fit(); the
+        # TraceListener exports deltas as training_transfer_bytes_total
+        self.transfer_bytes = 0
 
     # ---------------------------------------------------------------- score
     @property
@@ -258,6 +261,10 @@ class ComputationGraph:
 
     # ------------------------------------------------------------ train step
     def _apply_updates(self, params, grads, upd_states, it, ep):
+        # "updater" helper seam (see MultiLayerNetwork._apply_updates):
+        # a registered fused kernel takes the whole per-param RMW
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        uhelper = _helpers.get_helper("updater")
         new_params: Params = {}
         new_upd = {}
         for vd in self.conf.layer_vertices():
@@ -271,6 +278,11 @@ class ComputationGraph:
             for n, g in g_layer.items():
                 u = self._updaters[name][n]
                 lr = u.lr_at(it, ep)
+                if uhelper is not None and uhelper.supports(u, params[name][n], g):
+                    p_new[n], s_new[n] = uhelper.apply(
+                        u, params[name][n], g, upd_states[name][n], lr,
+                        it + 1.0)
+                    continue
                 upd, s = u.update(g, upd_states[name][n], lr, it + 1.0)
                 p_new[n] = params[name][n] - upd.astype(params[name][n].dtype)
                 s_new[n] = s
@@ -395,10 +407,20 @@ class ComputationGraph:
         return self
 
     # ------------------------------------------------------------------- fit
-    def fit(self, data, labels=None, *, epochs: int = 1) -> "ComputationGraph":
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            prefetch_depth: Optional[int] = None) -> "ComputationGraph":
+        """Train. Iterator sources are auto-wrapped in async host→device
+        prefetch (see MultiLayerNetwork.fit): ``prefetch_depth`` queue
+        slots (default 2), 0 disables, ``async_supported = False`` opts
+        out; ``host_wait`` span + ``training_transfer_bytes_total`` expose
+        any residual input-pipeline stall."""
         if self.params is None:
             self.init()
-        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        from deeplearning4j_tpu.datasets.dataset import (DataSet,
+                                                         MultiDataSet,
+                                                         batch_nbytes)
+        from deeplearning4j_tpu.datasets.iterators import wrap_for_prefetch
+        from deeplearning4j_tpu.observe import trace as _trace
 
         if labels is not None:
             iterator = [MultiDataSet(
@@ -408,6 +430,7 @@ class ComputationGraph:
             iterator = [data]
         else:
             iterator = data
+        iterator = wrap_for_prefetch(iterator, prefetch_depth)
 
         for _ in range(epochs):
             for listener in self.listeners:
@@ -415,7 +438,13 @@ class ComputationGraph:
                     listener.on_epoch_start(self)
             if hasattr(iterator, "reset"):
                 iterator.reset()
-            for ds in iterator:
+            batches = iter(iterator)
+            while True:
+                with _trace.span("host_wait", category="train"):
+                    ds = next(batches, None)
+                if ds is None:
+                    break
+                self.transfer_bytes += batch_nbytes(ds)
                 self._fit_batch(ds)
             self.epoch += 1
             for listener in self.listeners:

@@ -21,7 +21,7 @@ _HELPERS: Dict[str, object] = {}
 _VERSION = 0  # bumped on every registry change; part of every jit cache key
 
 KINDS = ("lstm", "convolution", "subsampling", "batch_norm", "lrn",
-         "attention")
+         "attention", "updater")
 
 
 def evict_stale_jit_entries(cache: Dict, current_version: int) -> None:
@@ -87,6 +87,28 @@ def auto_flash_attention_enabled() -> bool:
     return _AUTO_FLASH
 
 
+# -- fused-LSTM auto-registration ---------------------------------------------
+# When NO lstm helper is registered, a standard LSTM on a TPU backend in the
+# fused kernel's win region (see layers/recurrent.py:_AUTO_LSTM_MIN_T)
+# automatically uses PallasLSTMHelper — same promotion pattern as the causal
+# flash fallback above. Registering any lstm helper, or
+# set_auto_fused_lstm(False), overrides.
+_AUTO_LSTM = True
+
+
+def set_auto_fused_lstm(enabled: bool) -> None:
+    """Opt out of (or back into) the automatic fused-LSTM fallback.
+    Bumps the registry version so already-compiled networks retrace."""
+    global _AUTO_LSTM, _VERSION
+    if _AUTO_LSTM != bool(enabled):
+        _AUTO_LSTM = bool(enabled)
+        _VERSION += 1
+
+
+def auto_fused_lstm_enabled() -> bool:
+    return _AUTO_LSTM
+
+
 class LSTMHelper:
     """Interface (`LSTMHelper.java:34`): accelerate the LSTM sequence pass."""
 
@@ -94,6 +116,27 @@ class LSTMHelper:
         return False
 
     def forward_seq(self, layer, params, x, carry):  # pragma: no cover
+        raise NotImplementedError
+
+
+class UpdaterHelper:
+    """Interface for fused optimizer-update kernels (the role ND4J's native
+    updater ops play under ``UpdaterBlock.update``). ``apply`` performs the
+    WHOLE read-modify-write for one parameter tensor — new param AND new
+    updater state — so a kernel implementation can fuse the per-param
+    elementwise chain into one launch over donated buffers.
+
+    ``_apply_updates`` consults the seam per parameter at trace time; the
+    registry version is part of every train-step jit cache key, so
+    registration after compile retraces (same contract as the layer kinds).
+    A helper must only accept (``supports``) updaters whose math it
+    reproduces within the equivalence tolerance of tests/test_helpers.py."""
+
+    def supports(self, updater, param, grad) -> bool:  # pragma: no cover
+        return False
+
+    def apply(self, updater, param, grad, state, lr, t):  # pragma: no cover
+        """Returns ``(new_param, new_state)`` for one parameter tensor."""
         raise NotImplementedError
 
 

@@ -32,6 +32,36 @@ from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 
 
+# -- fused-LSTM auto-registration (helpers.set_auto_fused_lstm to opt out) ----
+# Win region for auto-using PallasLSTMHelper with NO helper registered:
+# long sequences with lane-aligned, modest hidden sizes. Measured on v5e the
+# fused kernel TIES stock XLA at H=512/T=128 (pallas_kernels.py header) — XLA
+# already keeps that carry on-chip — so the auto gate only takes shapes where
+# the sequential scan's per-step launch overhead dominates: T >= 256 steps
+# and H in {128, 256} (VMEM-resident h/c, one (H,4H) tile per step).
+_AUTO_LSTM_MIN_T = 256
+_AUTO_LSTM_MAX_H = 256
+_auto_lstm_cache: dict = {}
+
+
+def _auto_lstm_helper():
+    """The auto-fallback candidate, or None off the kernel's target backend
+    (on CPU the interpreter would be a slowdown, not a win)."""
+    if jax.default_backend() != "tpu":
+        return None
+    h = _auto_lstm_cache.get("std")
+    if h is None:
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasLSTMHelper
+        h = _auto_lstm_cache["std"] = PallasLSTMHelper()
+    return h
+
+
+def _auto_lstm_win_region(layer, x) -> bool:
+    return (x.shape[1] >= _AUTO_LSTM_MIN_T
+            and layer.n_out % 128 == 0
+            and layer.n_out <= _AUTO_LSTM_MAX_H)
+
+
 def check_carry_capacity(named_layers, t_total: int, context: str) -> None:
     """Reject sequences longer than any finite carry BEFORE a jitted step
     silently clamps a dynamic_update_slice write. One implementation for all
@@ -183,6 +213,14 @@ class LSTMLayer(BaseRecurrentLayer, Layer):
         helper = _helpers.get_helper("lstm")
         if helper is not None and helper.supports(self, mask):
             return helper.forward_seq(self, params, x, carry)
+        if (helper is None and _helpers.auto_fused_lstm_enabled()
+                and _auto_lstm_win_region(self, x)):
+            # no helper registered: auto-use the fused kernel in its win
+            # region (same promotion pattern as the causal-flash fallback in
+            # layers/attention.py); opt out via helpers.set_auto_fused_lstm
+            cand = _auto_lstm_helper()
+            if cand is not None and cand.supports(self, mask):
+                return cand.forward_seq(self, params, x, carry)
         n, t, _ = x.shape
         if carry is None:
             carry = self.init_carry(n, x.dtype)

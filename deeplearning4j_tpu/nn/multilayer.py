@@ -65,6 +65,9 @@ class MultiLayerNetwork:
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries: Optional[List[Any]] = None
         self._rnn_pos = 0
+        # cumulative host→device batch payload shipped by fit(); the
+        # TraceListener exports deltas as training_transfer_bytes_total
+        self.transfer_bytes = 0
         # resolve per-layer / per-param updaters once
         self._updaters: List[Dict[str, Updater]] = []
 
@@ -229,6 +232,11 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------ train step
     def _apply_updates(self, params, grads, upd_states, it, ep):
+        # "updater" helper seam: a registered fused kernel (e.g.
+        # PallasUpdaterHelper) takes the whole per-param read-modify-write;
+        # consulted at trace time, versioned into the train-step cache key
+        from deeplearning4j_tpu.nn import helpers as _helpers
+        uhelper = _helpers.get_helper("updater")
         new_params, new_upd = [], []
         for i, l in enumerate(self.layers):
             g_layer = grads[i]
@@ -240,6 +248,10 @@ class MultiLayerNetwork:
                 u = self._updaters[i][n]
                 lr = u.lr_at(it, ep)
                 t = it + 1.0  # 1-based step count for Adam-family bias correction
+                if uhelper is not None and uhelper.supports(u, params[i][n], g):
+                    p_new[n], s_new[n] = uhelper.apply(
+                        u, params[i][n], g, upd_states[i][n], lr, t)
+                    continue
                 upd, s = u.update(g, upd_states[i][n], lr, t)
                 p_new[n] = params[i][n] - upd.astype(params[i][n].dtype)
                 s_new[n] = s
@@ -287,11 +299,24 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------------- fit
     def fit(self, data, labels=None, *, epochs: int = 1,
-            features_mask=None, labels_mask=None) -> "MultiLayerNetwork":
-        """Train. ``data`` is (x, y) arrays, a DataSet, or a DataSetIterator."""
+            features_mask=None, labels_mask=None,
+            prefetch_depth: Optional[int] = None) -> "MultiLayerNetwork":
+        """Train. ``data`` is (x, y) arrays, a DataSet, or a DataSetIterator.
+
+        Iterator sources are auto-wrapped in async host→device prefetch
+        (``AsyncDataSetIterator`` + device-put stage): a producer thread
+        prepares and ships batch N+1 while the device runs batch N, so the
+        step never stalls on ETL or the transfer. ``prefetch_depth`` sets
+        the queue depth (default 2 — double buffering); 0 disables.
+        Iterators with ``async_supported = False`` (AsyncShield) are never
+        wrapped. The per-batch wait shows up as a ``host_wait`` trace span
+        and the shipped payload as ``training_transfer_bytes_total``."""
         if self.params is None:
             self.init()
-        from deeplearning4j_tpu.datasets.dataset import DataSet  # local import, no cycle
+        from deeplearning4j_tpu.datasets.dataset import (DataSet,  # no cycle
+                                                         batch_nbytes)
+        from deeplearning4j_tpu.datasets.iterators import wrap_for_prefetch
+        from deeplearning4j_tpu.observe import trace as _trace
 
         if labels is not None:
             iterator = [DataSet(data, labels, features_mask, labels_mask)]
@@ -299,6 +324,7 @@ class MultiLayerNetwork:
             iterator = [data]
         else:
             iterator = data  # assume iterable of DataSet
+        iterator = wrap_for_prefetch(iterator, prefetch_depth)
 
         for ep in range(epochs):
             for listener in self.listeners:
@@ -307,7 +333,15 @@ class MultiLayerNetwork:
             epoch_iter = iterator
             if hasattr(epoch_iter, "reset"):
                 epoch_iter.reset()
-            for ds in epoch_iter:
+            batches = iter(epoch_iter)
+            while True:
+                # host_wait = time the training thread blocks on the input
+                # pipeline; ~zero when prefetch keeps the queue warm
+                with _trace.span("host_wait", category="train"):
+                    ds = next(batches, None)
+                if ds is None:
+                    break
+                self.transfer_bytes += batch_nbytes(ds)
                 self._fit_batch(ds)
             self.epoch += 1
             for listener in self.listeners:

@@ -30,7 +30,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from deeplearning4j_tpu.nn.helpers import AttentionHelper, LSTMHelper
+from deeplearning4j_tpu.nn.helpers import (AttentionHelper, LSTMHelper,
+                                            UpdaterHelper)
 
 
 def _lstm_kernel(hidden: int, t_total: int,
@@ -158,6 +159,157 @@ class PallasLSTMHelper(LSTMHelper):
         rw = params["RW"][:, :4 * layer.n_out]
         ys, hn, cn = lstm_fused(xw, rw, h0, c0, self.interpret)
         return jnp.swapaxes(ys, 0, 1), (hn, cn)
+
+
+# -- fused optimizer update ---------------------------------------------------
+#
+# One kernel launch per parameter tensor replaces the stock per-param
+# elementwise chain (~10 XLA ops for Adam: two muls+adds for the moments, a
+# sqrt, a divide, the bias-corrected step, the subtraction). param/m/v ride
+# through ``input_output_aliases`` so the launch is a true in-place
+# read-modify-write over the train step's donated buffers. The bias-correction
+# scalars (which depend on the traced iteration count) are computed OUTSIDE
+# the kernel — identical ops to the stock updater math — and arrive as one
+# small SMEM coefficient row, so the kernel body is pure elementwise work on
+# (rows, 128) f32 tiles.
+
+_UPD_BLOCK_ROWS = 256  # (256, 128) f32 blocks: 128 KiB per operand in VMEM
+
+
+def _adam_kernel(amsgrad: bool, coef_ref, *refs):
+    # coef row: [beta1, beta2, eps, alpha, 0, 0] where
+    # alpha = lr * sqrt(1 - beta2^t) / (1 - beta1^t) (precomputed outside)
+    b1, b2, eps, alpha = (coef_ref[0, 0], coef_ref[0, 1], coef_ref[0, 2],
+                          coef_ref[0, 3])
+    if amsgrad:
+        p_ref, m_ref, v_ref, vh_ref, g_ref, po, mo, vo, vho = refs
+    else:
+        p_ref, m_ref, v_ref, g_ref, po, mo, vo = refs
+    g = g_ref[...]
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    denom = v
+    if amsgrad:
+        denom = jnp.maximum(vh_ref[...], v)
+        vho[...] = denom
+    po[...] = p_ref[...] - alpha * m / (jnp.sqrt(denom) + eps)
+    mo[...] = m
+    vo[...] = v
+
+
+def _nadam_kernel(coef_ref, p_ref, m_ref, v_ref, g_ref, po, mo, vo):
+    # coef row: [beta1, beta2, eps, lr, 1-beta1^t, 1-beta2^t] — the kernel
+    # divides by the same (1 - beta^t) denominators the stock path does, so
+    # the math is op-for-op identical
+    b1, b2, eps, lr = (coef_ref[0, 0], coef_ref[0, 1], coef_ref[0, 2],
+                       coef_ref[0, 3])
+    om1, om2 = coef_ref[0, 4], coef_ref[0, 5]
+    g = g_ref[...]
+    m = b1 * m_ref[...] + (1.0 - b1) * g
+    v = b2 * v_ref[...] + (1.0 - b2) * g * g
+    m_hat = m / om1
+    v_hat = v / om2
+    m_bar = b1 * m_hat + (1.0 - b1) * g / om1
+    po[...] = p_ref[...] - lr * m_bar / (jnp.sqrt(v_hat) + eps)
+    mo[...] = m
+    vo[...] = v
+
+
+def _fused_update_rows(kind: str, coef, bufs, *, interpret: bool):
+    """Run the fused update on (R, 128) row-tiled operands.
+
+    ``bufs`` = (p, m, v[, v_hat], g); returns the same tuple minus ``g``,
+    updated. All state operands alias their outputs (in-place RMW)."""
+    R = bufs[0].shape[0]
+    block_r = min(_UPD_BLOCK_ROWS, R)
+    grid = (R // block_r,)
+    bs = lambda: pl.BlockSpec((block_r, 128), lambda i: (i, 0))  # noqa: E731
+    n_state = len(bufs) - 1  # p/m/v(/v_hat) alias; g does not
+    kernel = (_nadam_kernel if kind == "nadam"
+              else functools.partial(_adam_kernel, kind == "amsgrad"))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)]
+                 + [bs() for _ in bufs],
+        out_specs=[bs() for _ in range(n_state)],
+        out_shape=[jax.ShapeDtypeStruct((R, 128), bufs[0].dtype)
+                   for _ in range(n_state)],
+        input_output_aliases={1 + i: i for i in range(n_state)},
+        interpret=interpret,
+    )(coef, *bufs)
+
+
+class PallasUpdaterHelper(UpdaterHelper):
+    """Fused Adam/Nadam/AMSGrad update: new param + new moments in ONE
+    kernel launch per parameter tensor, in place over donated buffers.
+    Other updater classes (and non-f32 params) fall back to the stock XLA
+    chain via ``supports``. ``interpret=True`` runs the kernel in the
+    Pallas interpreter (CPU testing)."""
+
+    def __init__(self, interpret: bool = None):
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        self.interpret = interpret
+
+    def supports(self, updater, param, grad) -> bool:
+        from deeplearning4j_tpu.nn.updaters import Adam, AMSGrad, Nadam
+
+        # exact types only: a subclass may override the math the kernel bakes
+        if type(updater) not in (Adam, Nadam, AMSGrad):
+            return False
+        return (param.dtype == jnp.float32
+                and getattr(grad, "shape", None) == param.shape
+                and param.size > 0)
+
+    @staticmethod
+    def _rows(a, block_r):
+        """Flatten + zero-pad to (R, 128) with R a multiple of ``block_r``.
+        Zero padding is closed under the Adam-family math (moments stay 0,
+        sqrt(0)+eps keeps the quotient finite), so padded lanes never
+        contaminate real ones."""
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        rows = -(-n // 128)
+        r_pad = -(-rows // block_r) * block_r
+        pad = r_pad * 128 - n
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return flat.reshape(r_pad, 128)
+
+    def apply(self, updater, param, grad, state, lr, t):
+        from deeplearning4j_tpu.nn.updaters import AMSGrad, Nadam
+
+        f32 = jnp.float32
+        b1 = jnp.asarray(updater.beta1, f32)
+        b2 = jnp.asarray(updater.beta2, f32)
+        eps = jnp.asarray(updater.epsilon, f32)
+        lr = jnp.asarray(lr, f32)
+        t = jnp.asarray(t, f32)
+        om1 = 1.0 - updater.beta1 ** t  # same exponentiation as the stock path
+        om2 = 1.0 - updater.beta2 ** t
+        if isinstance(updater, Nadam):
+            kind = "nadam"
+            coef = jnp.stack([b1, b2, eps, lr, om1, om2])
+            names = ("m", "v")
+        else:
+            kind = "amsgrad" if isinstance(updater, AMSGrad) else "adam"
+            alpha = lr * jnp.sqrt(om2) / om1
+            coef = jnp.stack([b1, b2, eps, alpha, jnp.zeros((), f32),
+                              jnp.zeros((), f32)])
+            names = ("m", "v", "v_hat") if kind == "amsgrad" else ("m", "v")
+
+        rows = -(-param.size // 128)
+        block_r = min(_UPD_BLOCK_ROWS, -(-rows // 8) * 8)  # f32 tile: 8 rows
+        to_rows = lambda a: self._rows(a, block_r)  # noqa: E731
+        bufs = ([to_rows(param)] + [to_rows(state[n]) for n in names]
+                + [to_rows(grad.astype(param.dtype))])
+        outs = _fused_update_rows(kind, coef.reshape(1, 6), tuple(bufs),
+                                  interpret=self.interpret)
+        unrows = lambda a: a.reshape(-1)[:param.size].reshape(param.shape)  # noqa: E731
+        new_param = unrows(outs[0])
+        new_state = {n: unrows(outs[1 + i]) for i, n in enumerate(names)}
+        return new_param, new_state
 
 
 class PallasFlashAttentionHelper(AttentionHelper):

@@ -22,6 +22,8 @@ Exported series (all labeled ``model``):
 - ``training_compile_total``          counter   (XLA recompiles attributed
   to training steps, sampled from the active tracer's compile counter)
 - ``training_last_batch_size``        gauge
+- ``training_transfer_bytes_total``   counter   (host→device batch payload,
+  sampled from the model's ``transfer_bytes`` accumulator)
 """
 
 from __future__ import annotations
@@ -79,8 +81,12 @@ class TraceListener(TrainingListener):
         self._m_batch = m.gauge(
             "training_last_batch_size", "Rows in the last training batch",
             ("model",))
+        self._m_transfer = m.counter(
+            "training_transfer_bytes_total",
+            "Host to device bytes shipped with training batches", ("model",))
         self._t_last: Optional[int] = None
         self._compiles_seen: Optional[int] = None
+        self._transfer_seen: Optional[int] = None
 
     # ------------------------------------------------------------- helpers
     def _active(self) -> Optional[_trace.Tracer]:
@@ -98,6 +104,12 @@ class TraceListener(TrainingListener):
             tracer = self._active()
             if tracer is not None:
                 self._compiles_seen = tracer.thread_compile_count()
+        # likewise baseline the model's transfer accumulator so bytes shipped
+        # before this listener attached are not replayed into the counter
+        if self._transfer_seen is None:
+            total = getattr(model, "transfer_bytes", None)
+            if total is not None:
+                self._transfer_seen = int(total)
 
     def iteration_done(self, model, iteration: int, epoch: int) -> None:
         now = time.perf_counter_ns()
@@ -124,6 +136,18 @@ class TraceListener(TrainingListener):
                 self._m_compiles.inc(count - self._compiles_seen,
                                      model=self.model_name)
                 self._compiles_seen = count
+        # transfer bytes: the fit loops accumulate model.transfer_bytes per
+        # batch; export the delta since the last window (baselined at epoch
+        # start so history before this listener attached is not replayed)
+        total = getattr(model, "transfer_bytes", None)
+        if total is not None:
+            total = int(total)
+            if self._transfer_seen is None:
+                self._transfer_seen = 0
+            if total > self._transfer_seen:
+                self._m_transfer.inc(total - self._transfer_seen,
+                                     model=self.model_name)
+                self._transfer_seen = total
         if self._t_last is not None:
             dt_s = (now - self._t_last) / 1e9
             self._m_step_time.observe(dt_s, model=self.model_name)

@@ -38,13 +38,7 @@ from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, make_mesh, shard_map
 from deeplearning4j_tpu.parallel.sharding import batch_sharding, shard_model
 
 
-def _batch_nbytes(ds) -> int:
-    """Host→device payload of one DataSet (features/labels/masks)."""
-    total = 0
-    for a in (ds.features, ds.labels, ds.features_mask, ds.labels_mask):
-        if a is not None:
-            total += int(getattr(a, "nbytes", 0))
-    return total
+from deeplearning4j_tpu.datasets.dataset import batch_nbytes as _batch_nbytes
 
 
 def make_pure_step(net, train: bool = True):
@@ -147,8 +141,15 @@ class ParallelWrapper:
         return e
 
     # ------------------------------------------------------------------ fit
-    def fit(self, data, labels=None, *, epochs: int = 1) -> "ParallelWrapper":
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            prefetch_depth: Optional[int] = None) -> "ParallelWrapper":
+        """``prefetch_depth`` (default 2, 0 disables) wraps iterator sources
+        in AsyncDataSetIterator so a producer thread hides the host-side
+        batch preparation — the ParallelWrapperMain ``--prefetchSize``
+        semantics. No device-put stage here: batches are sharded over the
+        mesh per step, so placement happens with the sharding applied."""
         from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.iterators import wrap_for_prefetch
 
         if labels is not None:
             iterator = [DataSet(data, labels)]
@@ -156,6 +157,8 @@ class ParallelWrapper:
             iterator = [data]
         else:
             iterator = data
+        iterator = wrap_for_prefetch(iterator, prefetch_depth,
+                                     device_put=None)
 
         with _trace.span("parallel_fit", category="train",
                          attrs={"mode": self.mode, "workers": self.n_workers,
@@ -312,9 +315,11 @@ class ParallelWrapper:
                               [d.features for d in pending])
             lms = stack_masks([d.labels_mask for d in pending],
                               [d.labels for d in pending])
+            from deeplearning4j_tpu.nn import helpers as _helpers
             key = ("avg", kk, xs.shape, ys.shape,
                    None if fms is None else fms.shape,
-                   None if lms is None else lms.shape)
+                   None if lms is None else lms.shape,
+                   _helpers.version())  # updater-helper changes must retrace
             if self._avg_step is None or self._avg_step[0] != key:
                 self._avg_step = (key, self._build_avg_step(
                     kk, xs.ndim, ys.ndim, fms is not None, lms is not None,

@@ -139,12 +139,16 @@ class ContinuousTrainer:
                  batch_size: int = 32, batches_per_mini_epoch: int = 4,
                  take_timeout_s: float = 5.0,
                  metrics=None, tracer=None,
-                 model_name: str = "candidate", watchdog=None):
+                 model_name: str = "candidate", watchdog=None,
+                 prefetch_depth: Optional[int] = None):
         self.model = model
         self.buffer = buffer
         self.batch_size = int(batch_size)
         self.batches_per_mini_epoch = int(batches_per_mini_epoch)
         self.take_timeout_s = float(take_timeout_s)
+        # forwarded to fit(): mini-epoch batch lists are small, so the
+        # default (None → fit decides) usually skips the async wrap
+        self.prefetch_depth = prefetch_depth
         self.examples_seen = 0
         self.mini_epochs = 0
         self.listeners = attach_observability(
@@ -175,7 +179,8 @@ class ContinuousTrainer:
                 f"no stream items within {self.take_timeout_s}s")
         batches = _to_datasets(items, self.batch_size)
         n = sum(int(np.asarray(b.features).shape[0]) for b in batches)
-        self.model.fit(batches, epochs=1)  # fit() takes any DataSet iterable
+        self.model.fit(batches, epochs=1,  # fit() takes any DataSet iterable
+                       prefetch_depth=self.prefetch_depth)
         self.examples_seen += n
         self.mini_epochs += 1
         return {"examples": n, "batches": len(batches),

@@ -261,3 +261,240 @@ class TestAutoFlashAttention:
             assert reg_calls and not auto_calls
         finally:
             helpers.clear_helper("attention")
+
+
+def _mlp_net(updater, seed=5, width=48):
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(updater)
+            .list()
+            .layer(DenseLayer(n_out=width, activation="relu"))
+            .layer(OutputLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(16)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _mlp_data(rng, b=32):
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    x = rng.normal(size=(b, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, size=b)]
+    return DataSet(x, y)
+
+
+def _count_pallas_eqns(jaxpr):
+    """pallas_call equations, recursing into pjit/scan/cond sub-jaxprs."""
+    n = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            n += 1
+        for v in eqn.params.values():
+            for u in (v if isinstance(v, (tuple, list)) else (v,)):
+                inner = getattr(u, "jaxpr", u)
+                if hasattr(inner, "eqns"):
+                    n += _count_pallas_eqns(inner)
+    return n
+
+
+def _train_step_jaxpr(net, ds):
+    fn = net._get_train_step(False)
+    return jax.make_jaxpr(fn)(
+        net.params, net.states, net.updater_states,
+        jnp.float32(0.0), jnp.float32(0.0),
+        jnp.asarray(np.asarray(ds.features)),
+        jnp.asarray(np.asarray(ds.labels)),
+        None, None, jax.random.PRNGKey(0), None).jaxpr
+
+
+class TestPallasUpdaterHelper:
+    """Fused optimizer-update kernel behind the "updater" helper seam: the
+    whole param+m+v read-modify-write as ONE kernel over donated buffers.
+    Same validation contract as the fused LSTM (ValidateCudnnLSTM pattern):
+    numerics vs stock XLA, consult/clear behavior, launch-count oracle."""
+
+    ALL_UPDATERS = "Sgd NoOp Nesterovs Adam AdaMax Nadam AMSGrad " \
+                   "AdaGrad AdaDelta RmsProp".split()
+
+    def test_supports_gating(self):
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+        from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+        h = PallasUpdaterHelper()
+        p = jnp.zeros((24, 16), jnp.float32)
+        assert h.supports(Adam(1e-3), p, p)
+        assert not h.supports(Sgd(1e-2), p, p)  # no state to fuse
+        # EXACT types only: a subclass may override update() — its math is
+        # unknown to the kernel, so it must take the stock path
+
+        class TweakedAdam(Adam):
+            pass
+
+        assert not h.supports(TweakedAdam(1e-3), p, p)
+        assert not h.supports(Adam(1e-3), p.astype(jnp.bfloat16),
+                              p.astype(jnp.bfloat16))
+        assert not h.supports(Adam(1e-3), p, jnp.zeros((24, 8), jnp.float32))
+
+    @pytest.mark.parametrize("name", ALL_UPDATERS)
+    def test_matches_stock_every_updater(self, rng, name):
+        """Twin nets, 3 identical steps: fused-registered params must land
+        on the stock-path params within 2e-5 for EVERY shipped updater —
+        fused classes agree through the kernel, the rest must be untouched
+        by the seam (exact fallback)."""
+        import deeplearning4j_tpu.nn.updaters as U
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+        upd = getattr(U, name)(1e-2)
+        ds = _mlp_data(rng)
+        stock = _mlp_net(upd)
+        fused = _mlp_net(upd)
+        for _ in range(3):
+            stock._fit_batch(ds)
+        helpers.set_helper("updater", PallasUpdaterHelper())
+        for _ in range(3):
+            fused._fit_batch(ds)
+        for lb, lf in zip(stock.params, fused.params):
+            for k in lb:
+                np.testing.assert_allclose(
+                    np.asarray(lf[k]), np.asarray(lb[k]),
+                    rtol=2e-5, atol=2e-5,
+                    err_msg=f"{name}: fused diverged from stock on {k}")
+
+    def test_consulted_and_clear_restores_stock(self, rng):
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+        from deeplearning4j_tpu.nn.updaters import Adam
+        net = _mlp_net(Adam(1e-3))
+        ds = _mlp_data(rng)
+        net._fit_batch(ds)  # compiles the stock step first
+
+        calls = []
+
+        class Spy(PallasUpdaterHelper):
+            def apply(self, updater, param, grad, state, lr, t):
+                calls.append(param.shape)
+                return super().apply(updater, param, grad, state, lr, t)
+
+        helpers.set_helper("updater", Spy())
+        net._fit_batch(ds)
+        # consulted once per fusable tensor (w+b per layer), despite the
+        # already-compiled stock step: registry version keys the jit cache
+        assert len(calls) == 4
+        helpers.clear_helper("updater")
+        calls.clear()
+        net._fit_batch(ds)
+        assert not calls
+
+    def test_one_kernel_launch_per_tensor(self, rng):
+        """HLO/compile-count oracle: with the fused updater registered the
+        train step carries exactly ONE pallas_call per fusable parameter
+        tensor — and none at all without it (no silent leftovers)."""
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+        from deeplearning4j_tpu.nn.updaters import Adam
+        net = _mlp_net(Adam(1e-3))
+        ds = _mlp_data(rng)
+        assert _count_pallas_eqns(_train_step_jaxpr(net, ds)) == 0
+        helpers.set_helper("updater", PallasUpdaterHelper())
+        assert _count_pallas_eqns(_train_step_jaxpr(net, ds)) == 4
+
+    def test_nonsquare_and_vector_params_pad_correctly(self, rng):
+        """The (R,128) lane-tiling flattens/zero-pads every shape; padding
+        must never leak into the real elements (Adam math is closed under
+        zero rows: 0-grad 0-state rows stay 0)."""
+        from deeplearning4j_tpu.nn.pallas_kernels import PallasUpdaterHelper
+        from deeplearning4j_tpu.nn.updaters import Adam
+        h = PallasUpdaterHelper(interpret=True)
+        u = Adam(1e-3)
+        rng_np = np.random.default_rng(3)
+        for shape in ((5,), (3, 7), (129,), (130, 257)):
+            p = jnp.asarray(rng_np.normal(size=shape).astype(np.float32))
+            g = jnp.asarray(rng_np.normal(size=shape).astype(np.float32))
+            state = {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+            upd_ref, s_ref = u.update(g, state, 1e-3, 1.0)
+            p_ref = p - upd_ref
+            p_new, s_new = h.apply(u, p, g, state, 1e-3, 1.0)
+            assert p_new.shape == p.shape
+            np.testing.assert_allclose(np.asarray(p_new), np.asarray(p_ref),
+                                       rtol=2e-5, atol=2e-6)
+            for k in s_ref:
+                np.testing.assert_allclose(
+                    np.asarray(s_new[k]), np.asarray(s_ref[k]),
+                    rtol=2e-5, atol=2e-6)
+
+
+class TestAutoFusedLSTM:
+    """With NO helper registered, LSTM forward at T >= 256 and lane-aligned
+    modest H auto-uses the fused kernel (opt-out via set_auto_fused_lstm) —
+    the same promotion pattern as the causal-flash auto fallback."""
+
+    def _spy(self, calls):
+        class Spy:
+            def supports(self, layer, mask):
+                return mask is None
+
+            def forward_seq(self, layer, params, x, carry):
+                calls.append(x.shape)
+                # distinguishable-but-wrong output: only SELECTION is under
+                # test (numerics are covered by TestPallasLSTMEquivalence)
+                return jnp.zeros(x.shape[:2] + (layer.n_out,)) + 7.0, carry
+        return Spy()
+
+    def _layer(self, h=128):
+        layer = LSTMLayer(n_in=8, n_out=h)
+        params = layer.init_params(jax.random.PRNGKey(0))
+        return layer, params
+
+    def test_win_region_predicate(self):
+        from deeplearning4j_tpu.nn.layers import recurrent as R
+        x = np.zeros((2, 256, 8), np.float32)
+        short = np.zeros((2, 128, 8), np.float32)
+        assert R._auto_lstm_win_region(LSTMLayer(n_in=8, n_out=128), x)
+        assert R._auto_lstm_win_region(LSTMLayer(n_in=8, n_out=256), x)
+        assert not R._auto_lstm_win_region(LSTMLayer(n_in=8, n_out=128), short)
+        assert not R._auto_lstm_win_region(LSTMLayer(n_in=8, n_out=96), x)
+        assert not R._auto_lstm_win_region(LSTMLayer(n_in=8, n_out=384), x)
+
+    def test_auto_used_in_win_region_only(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import recurrent as R
+        calls = []
+        monkeypatch.setattr(R, "_auto_lstm_helper", lambda: self._spy(calls))
+        layer, params = self._layer()
+        x = jnp.ones((2, 256, 8), jnp.float32)
+        y, _ = layer.forward_seq(params, x)
+        assert len(calls) == 1 and float(y[0, 0, 0]) == 7.0
+        # below the threshold: the stock scan path
+        y2, _ = layer.forward_seq(params, jnp.ones((2, 16, 8), jnp.float32))
+        assert len(calls) == 1 and float(y2[0, 0, 0]) != 7.0
+        # masked sequences: the helper's supports() veto is honored
+        layer.forward_seq(params, x, mask=jnp.ones((2, 256), jnp.float32))
+        assert len(calls) == 1
+
+    def test_opt_out_and_version_bump(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import recurrent as R
+        calls = []
+        monkeypatch.setattr(R, "_auto_lstm_helper", lambda: self._spy(calls))
+        layer, params = self._layer()
+        x = jnp.ones((2, 256, 8), jnp.float32)
+        v0 = helpers.version()
+        helpers.set_auto_fused_lstm(False)
+        try:
+            assert helpers.version() == v0 + 1  # compiled nets must retrace
+            layer.forward_seq(params, x)
+            assert not calls
+        finally:
+            helpers.set_auto_fused_lstm(True)
+        assert helpers.version() == v0 + 2
+        layer.forward_seq(params, x)
+        assert len(calls) == 1
+
+    def test_registered_helper_takes_precedence(self, monkeypatch):
+        from deeplearning4j_tpu.nn.layers import recurrent as R
+        auto_calls, reg_calls = [], []
+        monkeypatch.setattr(R, "_auto_lstm_helper",
+                            lambda: self._spy(auto_calls))
+        helpers.set_helper("lstm", self._spy(reg_calls))
+        layer, params = self._layer()
+        layer.forward_seq(params, jnp.ones((2, 256, 8), jnp.float32))
+        assert reg_calls and not auto_calls
+
+    def test_off_tpu_factory_declines(self):
+        from deeplearning4j_tpu.nn.layers import recurrent as R
+        if jax.default_backend() == "tpu":
+            assert R._auto_lstm_helper() is not None
+        else:
+            # interpret-mode would be a slowdown, not a win — never auto
+            assert R._auto_lstm_helper() is None
