@@ -31,6 +31,20 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     ap.add_argument("--modelOutputPath", required=True)
     ap.add_argument("--workers", type=int, default=None,
                     help="mesh data-axis size (default: all devices)")
+    ap.add_argument("--mesh", default=None, metavar="AXES",
+                    help="2-D GSPMD mesh, e.g. data=4,model=2 (-1 infers "
+                         "one axis from the device count): params are "
+                         "placed by --sharding-rules over the model "
+                         "axis, batches shard over data. With --elastic "
+                         "the data size MUST be -1 or absent — the world "
+                         "is dynamic (each generation's process count IS "
+                         "the data axis); model axes are per-host slices")
+    ap.add_argument("--sharding-rules", default=None, dest="sharding_rules",
+                    metavar="RULES.json",
+                    help="partition rule file (regex over param path -> "
+                         "PartitionSpec; lint with "
+                         "tools/validate_sharding_rules.py); default: "
+                         "the built-in Megatron 2-D rule set")
     ap.add_argument("--mode", choices=("shared_gradients", "averaging"),
                     default="shared_gradients")
     ap.add_argument("--averagingFrequency", type=int, default=5)
@@ -110,6 +124,28 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                          "port's /metrics (0 = ephemeral)")
     args = ap.parse_args(argv)
 
+    mesh_axes = None
+    if args.mesh:
+        from deeplearning4j_tpu.parallel.mesh import parse_mesh_axes
+        try:
+            mesh_axes = parse_mesh_axes(args.mesh)
+        except ValueError as e:
+            ap.error(f"--mesh: {e}")
+        if args.workers is not None:
+            ap.error("--workers and --mesh both size the data axis; "
+                     "use --mesh data=N[,model=M] alone")
+    if args.sharding_rules and not args.mesh:
+        ap.error("--sharding-rules needs --mesh (the rules place params "
+                 "over the mesh's model axes)")
+    if args.sharding_rules:
+        # an unreadable/invalid rule file fails BEFORE training (and
+        # before worker processes are launched under --elastic)
+        from deeplearning4j_tpu.parallel.sharding import load_sharding_rules
+        try:
+            load_sharding_rules(args.sharding_rules)
+        except (OSError, ValueError) as e:
+            ap.error(f"--sharding-rules: {e}")
+
     if args.elastic is not None:
         if not args.ckpt_dir:
             ap.error("--elastic requires --ckpt-dir (the recovery "
@@ -133,7 +169,15 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                 "shared_gradients at the elastic world size); drop it, or "
                 "run without --elastic. --log-json, --alerts, --trace and "
                 "--metrics-port ARE supported (they observe the fleet)")
-        return _elastic_train(args)
+        if mesh_axes is not None and mesh_axes.get("data", -1) != -1:
+            # the elastic world is dynamic: each generation's process
+            # count IS the data extent, so a pinned size is a lie the
+            # first shrink would expose
+            ap.error(f"--mesh data={mesh_axes['data']} cannot be pinned "
+                     "under --elastic (the supervisor sizes the data axis "
+                     "to the live world); use data=-1 or omit it, e.g. "
+                     "--mesh model=2")
+        return _elastic_train(args, mesh_axes=mesh_axes)
     if args.metrics_port is not None:
         ap.error("--metrics-port only applies to --elastic jobs (the "
                  "in-process serve command exposes /metrics itself)")
@@ -182,13 +226,42 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                                  load_rules(args.alerts), [LogSink()],
                                  interval_s=5.0).start()
     mesh = None
-    if args.workers:
-        mesh = make_mesh({"data": args.workers})
-    pw = ParallelWrapper(net, mesh, mode=args.mode,
-                         averaging_frequency=args.averagingFrequency,
-                         metrics=(None if tracer is None else tracer.metrics))
+    gspmd = mesh_axes is not None and any(
+        k != "data" and int(v) > 1 for k, v in mesh_axes.items())
+    if gspmd:
+        # DP×MP: the jitted train step IS the distributed program — the
+        # replica-averaging knobs have nothing to act on
+        unsupported = [flag for flag, hit in (
+            ("--mode averaging", args.mode != "shared_gradients"),
+            ("--averagingFrequency", args.averagingFrequency != 5),
+        ) if hit]
+        if unsupported:
+            ap.error(f"{', '.join(unsupported)} drive(s) the replica-"
+                     "averaging ParallelWrapper and do(es) not apply to a "
+                     "--mesh with model axes (GSPMD shards ONE program)")
+        from deeplearning4j_tpu.parallel.sharding import (
+            load_sharding_rules, shard_model_with_rules)
+        mesh = make_mesh(mesh_axes)
+        rules = (load_sharding_rules(args.sharding_rules)
+                 if args.sharding_rules else None)
+        shard_model_with_rules(net, mesh, rules)
+        print(f"GSPMD mesh {args.mesh}: params placed by "
+              f"{args.sharding_rules or 'the default 2-D rule set'}")
+        pw = None
+    else:
+        if mesh_axes is not None:  # data-only --mesh ≡ --workers
+            mesh = make_mesh(mesh_axes)
+        elif args.workers:
+            mesh = make_mesh({"data": args.workers})
+        pw = ParallelWrapper(net, mesh, mode=args.mode,
+                             averaging_frequency=args.averagingFrequency,
+                             metrics=(None if tracer is None
+                                      else tracer.metrics))
     try:
-        pw.fit(it, epochs=args.epochs, prefetch_depth=args.prefetchSize)
+        if pw is not None:
+            pw.fit(it, epochs=args.epochs, prefetch_depth=args.prefetchSize)
+        else:
+            net.fit(it, epochs=args.epochs)
     finally:
         if alert_mgr is not None:
             alert_mgr.evaluate_once()  # final round so late series count
@@ -208,7 +281,7 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     return net
 
 
-def _elastic_train(args):
+def _elastic_train(args, mesh_axes=None):
     """``train --elastic N``: supervise N elastic worker processes
     (``python -m deeplearning4j_tpu.parallel.elastic_worker``) over the
     model/data from --modelPath/--dataPath. Worker death triggers
@@ -238,6 +311,11 @@ def _elastic_train(args):
         from deeplearning4j_tpu.observe import default_registry, enable_tracing
         tracer = enable_tracing(metrics=default_registry())
 
+    worker_mesh = None
+    if mesh_axes:
+        # the data axis is the live world size, owned by the supervisor;
+        # only the per-host model axes ride the WorkerSpec
+        worker_mesh = {k: v for k, v in mesh_axes.items() if k != "data"}
     spec = WorkerSpec(argv=[
         sys.executable, "-m", "deeplearning4j_tpu.parallel.elastic_worker",
         "--modelPath", args.modelPath,
@@ -246,7 +324,8 @@ def _elastic_train(args):
         "--batchSize", str(args.batchSize),
         "--epochs", str(args.epochs),
         "--save-mode", args.save_mode,
-    ])
+    ], mesh_axes=worker_mesh or None,
+        sharding_rules=args.sharding_rules)
     fleet = None
     if args.alerts and args.metrics_port is None:
         # --alerts observes the FLEET: the rules must see the job-wide
@@ -475,6 +554,17 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     p.add_argument("--max-batch-size", type=int, default=32)
     p.add_argument("--wait-ms", type=float, default=2.0,
                    help="batching window measured from the oldest request")
+    p.add_argument("--mesh", default=None, metavar="AXES",
+                   help="serve every --model GSPMD-sharded over this 2-D "
+                        "mesh, e.g. data=4,model=2 (-1 infers one axis): "
+                        "params placed by --sharding-rules, request "
+                        "batches sharded over data, buckets rounded to "
+                        "the data-axis size")
+    p.add_argument("--sharding-rules", default=None, dest="sharding_rules",
+                   metavar="RULES.json",
+                   help="partition rule file for --mesh (default: the "
+                        "built-in Megatron 2-D rule set); lint with "
+                        "tools/validate_sharding_rules.py")
     p.add_argument("--buckets", default=None, metavar="N,N,...",
                    help="declared batch buckets (default: powers of two up "
                         "to --max-batch-size); these are pre-compiled at "
@@ -572,6 +662,29 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
         print(f"alerting on {len(alert_mgr.rules)} rule(s) from "
               f"{args.alerts} (state at /alerts)")
 
+    serve_mesh = None
+    serve_rules = None
+    if args.sharding_rules and not args.mesh:
+        p.error("--sharding-rules needs --mesh (the rules place params "
+                "over the mesh's model axes)")
+    if args.mesh:
+        from deeplearning4j_tpu.parallel.mesh import (make_mesh,
+                                                      parse_mesh_axes)
+        try:
+            serve_mesh = make_mesh(parse_mesh_axes(args.mesh))
+        except ValueError as e:
+            p.error(f"--mesh: {e}")
+        if args.sharding_rules:
+            from deeplearning4j_tpu.parallel.sharding import (
+                load_sharding_rules)
+            try:
+                serve_rules = load_sharding_rules(args.sharding_rules)
+            except (OSError, ValueError) as e:
+                p.error(f"--sharding-rules: {e}")
+        if args.dtype_policy:
+            p.error("--dtype-policy cannot combine with --mesh (GSPMD-"
+                    "sharded serving is float32-only)")
+
     buckets = None
     if args.buckets:
         try:
@@ -657,8 +770,10 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
     for name, path in models:
         version = registry.register(
             name, path=path, dtype_policy=policies.get(name, "float32"),
-            input_shape=shapes.get(name))
+            input_shape=shapes.get(name),
+            mesh=serve_mesh, sharding_rules=serve_rules)
         state = registry.warmup_state(name, version)
+        mesh_tag = "" if serve_mesh is None else f" [mesh {args.mesh}]"
         extra = ""
         if state["status"] == "warm":
             extra = (f" (warmed {len(state['warm'])} bucket(s) in "
@@ -669,7 +784,7 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
             extra = f" (warmup skipped: {state['reason']})"
         elif state["status"] == "error":
             extra = f" (warmup FAILED: {state['reason']})"
-        print(f"registered {name!r} v{version} from {path}{extra}")
+        print(f"registered {name!r} v{version} from {path}{mesh_tag}{extra}")
     for name, chain in fallbacks.items():
         try:
             registry.set_fallback(name, chain)

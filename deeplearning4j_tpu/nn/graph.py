@@ -42,6 +42,28 @@ def _as_jnp(x, dtype=None):
 class ComputationGraph:
     """DAG network over a ComputationGraphConfiguration."""
 
+    # set by parallel.sharding.shard_model_with_rules: when present, fit()/
+    # output() place incoming batches over the mesh's data axis so pjit sees
+    # a consistent DP x MP layout end to end (GSPMD handles the rest), and
+    # the train step pins updated params/opt-state back to the placed specs
+    _mesh = None
+    _param_shardings = None
+    _upd_shardings = None
+
+    def _pin_placements(self, new_params, new_upd):
+        """Inside-jit: constrain step outputs to the rule-placed shardings
+        (see MultiLayerNetwork._pin_placements — one GSPMD-drifted leaf
+        re-layouts every later compile)."""
+        if self._param_shardings is not None:
+            new_params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_params,
+                self._param_shardings)
+        if self._upd_shardings is not None and new_upd is not None:
+            new_upd = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_upd,
+                self._upd_shardings)
+        return new_params, new_upd
+
     def __init__(self, conf: ComputationGraphConfiguration):
         conf.finalize()
         self.conf = conf
@@ -318,6 +340,7 @@ class ComputationGraph:
                     (loss, (new_states, new_carries)), grads = \
                         jax.value_and_grad(lf, has_aux=True)(params)
                 new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
+                new_params, new_upd = self._pin_placements(new_params, new_upd)
                 return (new_params, new_states, new_upd, loss, new_carries,
                         it + 1.0, rng_next)
 
@@ -349,6 +372,8 @@ class ComputationGraph:
                             lf, has_aux=True)(params)
                     new_params, new_upd = self._apply_updates(
                         params, grads, upd, it, ep)
+                    new_params, new_upd = self._pin_placements(new_params,
+                                                               new_upd)
                     return (new_params, new_states, new_upd, it + 1.0, rng), loss
 
                 (params, states, upd, _, _), losses = jax.lax.scan(
@@ -473,6 +498,11 @@ class ComputationGraph:
         lmasks = None
         if mds.labels_masks is not None:
             lmasks = [None if m is None else _as_jnp(m) for m in mds.labels_masks]
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import place_batch
+            mesh = self._mesh
+            inputs, labels, masks, lmasks = jax.tree_util.tree_map(
+                lambda a: place_batch(a, mesh), (inputs, labels, masks, lmasks))
 
         from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
         if normalize_backprop_type(self.conf.backprop_type) == "truncated_bptt":
@@ -582,6 +612,11 @@ class ComputationGraph:
         if masks is not None:
             mask_d = {n: (None if m is None else _as_jnp(m))
                       for n, m in zip(self.conf.inputs, masks)}
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import place_batch
+            mesh = self._mesh
+            inputs, mask_d = jax.tree_util.tree_map(
+                lambda a: place_batch(a, mesh), (inputs, mask_d))
         outs = self._output_fn()(self.params, self.states, inputs, mask_d)
         return outs[0] if len(outs) == 1 else outs
 

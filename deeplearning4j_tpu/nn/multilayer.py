@@ -50,6 +50,30 @@ def _as_jnp(x, dtype=None):
 class MultiLayerNetwork:
     """Sequential network over a MultiLayerConfiguration."""
 
+    # set by parallel.sharding.shard_model_with_rules: when present, fit()/
+    # output() place incoming batches over the mesh's data axis so pjit sees
+    # a consistent DP x MP layout end to end (GSPMD handles the rest), and
+    # the train step pins updated params/opt-state back to the placed specs
+    _mesh = None
+    _param_shardings = None
+    _upd_shardings = None
+
+    def _pin_placements(self, new_params, new_upd):
+        """Inside-jit: constrain step outputs to the rule-placed shardings.
+        Without this GSPMD may emit one param with a sharding of its own
+        choosing and every subsequent compile re-layouts around the drifted
+        leaf (observed: a replicated positional table coming back
+        model-sharded cost 18 forward all-gathers)."""
+        if self._param_shardings is not None:
+            new_params = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_params,
+                self._param_shardings)
+        if self._upd_shardings is not None and new_upd is not None:
+            new_upd = jax.tree_util.tree_map(
+                jax.lax.with_sharding_constraint, new_upd,
+                self._upd_shardings)
+        return new_params, new_upd
+
     def __init__(self, conf: MultiLayerConfiguration):
         conf.finalize()
         self.conf = conf
@@ -278,6 +302,7 @@ class MultiLayerNetwork:
             with schedule_tick(it, ep):  # dropout pSchedule sees the device tick
                 (loss, (new_states, new_carries)), grads = jax.value_and_grad(lf, has_aux=True)(params)
             new_params, new_upd = self._apply_updates(params, grads, upd_states, it, ep)
+            new_params, new_upd = self._pin_placements(new_params, new_upd)
             if tbptt:
                 new_carries = jax.tree_util.tree_map(jax.lax.stop_gradient, new_carries)
             return new_params, new_states, new_upd, loss, new_carries, it + 1.0, rng_next
@@ -372,6 +397,8 @@ class MultiLayerNetwork:
                             lf, has_aux=True)(params)
                     new_params, new_upd = self._apply_updates(
                         params, grads, upd, it, ep)
+                    new_params, new_upd = self._pin_placements(new_params,
+                                                               new_upd)
                     return (new_params, new_states, new_upd, it + 1.0, rng), loss
 
                 (params, states, upd, _, _), losses = jax.lax.scan(
@@ -424,6 +451,12 @@ class MultiLayerNetwork:
         y = _as_jnp(ds.labels, dtype)
         mask = None if ds.features_mask is None else _as_jnp(ds.features_mask)
         lmask = None if ds.labels_mask is None else _as_jnp(ds.labels_mask)
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import place_batch
+            x = place_batch(x, self._mesh)
+            y = place_batch(y, self._mesh)
+            mask = place_batch(mask, self._mesh)
+            lmask = place_batch(lmask, self._mesh)
 
         from deeplearning4j_tpu.nn.conf.network import normalize_backprop_type
         if (normalize_backprop_type(self.conf.backprop_type) == "truncated_bptt"
@@ -516,6 +549,10 @@ class MultiLayerNetwork:
         dtype = self.conf.global_conf.jnp_dtype()
         x = _as_jnp(x, dtype)
         mask = None if mask is None else _as_jnp(mask)
+        if self._mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import place_batch
+            x = place_batch(x, self._mesh)
+            mask = place_batch(mask, self._mesh)
         return self._output_fn()(self.params, self.states, x, mask)
 
     def feed_forward(self, x, train: bool = False) -> List[Array]:

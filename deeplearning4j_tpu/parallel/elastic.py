@@ -100,6 +100,12 @@ ENV_CKPT_DIR = "DL4J_TPU_ELASTIC_CKPT_DIR"
 ENV_HEARTBEAT = "DL4J_TPU_ELASTIC_HEARTBEAT_FILE"
 ENV_RESTORE_STEP = "DL4J_TPU_ELASTIC_RESTORE_STEP"
 ENV_ELIGIBLE_STEPS = "DL4J_TPU_ELASTIC_ELIGIBLE_STEPS"
+# pod mesh over the elastic env: the per-host mesh slice shape
+# (``parse_mesh_axes`` grammar, e.g. "model=2" — the data axis is always
+# the generation's process count) and an optional sharding-rules JSON
+# path workers place params with (absent → DEFAULT_2D_RULES)
+ENV_MESH = "DL4J_TPU_ELASTIC_MESH"
+ENV_SHARDING_RULES = "DL4J_TPU_ELASTIC_SHARDING_RULES"
 ENV_PROGRESS_BEAT = "DL4J_TPU_ELASTIC_PROGRESS_BEAT_S"
 # operator-level coordinator addressing (read by WorkerSpec, overridable
 # per-spec): where process 0 binds its coordination service and the
@@ -258,6 +264,25 @@ class WorkerSpec:
     # vars, then loopback — the pre-pod behavior stays the default
     bind_host: Optional[str] = None
     advertise_host: Optional[str] = None
+    # pod mesh: each worker owns a mesh SLICE of this shape (ICI inside
+    # the host); the data axis always spans the generation's processes
+    # (DCN across hosts) and must be -1/absent here. E.g.
+    # ``{"model": 2}`` → every worker gets 2 local devices sharded over
+    # the model axis while training stays data-parallel across workers.
+    mesh_axes: Optional[Dict[str, int]] = None
+    # sharding-rules JSON path forwarded to workers (None → the shipped
+    # DEFAULT_2D_RULES)
+    sharding_rules: Optional[str] = None
+
+    def local_mesh_devices(self) -> int:
+        """Devices each worker's mesh slice needs (the product of the
+        non-data axes; 1 = classic one-device-per-worker)."""
+        n = 1
+        for name, size in (self.mesh_axes or {}).items():
+            if name == "data":
+                continue
+            n *= max(1, int(size))
+        return n
 
     def resolved_bind_host(self) -> str:
         if self.bind_host:
@@ -290,6 +315,17 @@ class WorkerSpec:
                 env["XLA_FLAGS"] = " ".join(kept)
             else:
                 del env["XLA_FLAGS"]
+        n_local = self.local_mesh_devices()
+        if n_local > 1:
+            # the worker owns a multi-device mesh slice: on the CPU
+            # (host) platform that slice must be forced into existence;
+            # on real accelerators the flag is inert and the host's
+            # locally-attached chips form the slice
+            kept = [t for t in env.get("XLA_FLAGS", "").split()
+                    if t and not t.startswith(
+                        "--xla_force_host_platform_device_count")]
+            kept.append(f"--xla_force_host_platform_device_count={n_local}")
+            env["XLA_FLAGS"] = " ".join(kept)
         return env
 
 
@@ -942,6 +978,11 @@ class ElasticJobSupervisor:
                 else str(restore_step),
                 ENV_ELIGIBLE_STEPS: eligible_env,
             })
+            if self.spec.mesh_axes:
+                from deeplearning4j_tpu.parallel.mesh import format_mesh_axes
+                env[ENV_MESH] = format_mesh_axes(self.spec.mesh_axes)
+            if self.spec.sharding_rules:
+                env[ENV_SHARDING_RULES] = self.spec.sharding_rules
             host = self.host_of(slot_id)
             if host is not None:
                 env[ENV_HOST] = str(host)
@@ -1204,6 +1245,13 @@ class StaleGenerationError(RuntimeError):
     may be trusted."""
 
 
+def _parse_env_mesh(spec: Optional[str]) -> Optional[Dict[str, int]]:
+    if not spec:
+        return None
+    from deeplearning4j_tpu.parallel.mesh import parse_mesh_axes
+    return parse_mesh_axes(spec)
+
+
 @dataclasses.dataclass
 class ElasticWorkerContext:
     """A worker's view of its elastic world, decoded from the supervisor's
@@ -1225,6 +1273,11 @@ class ElasticWorkerContext:
     #: host failure domain (None = no host grouping)
     host: Optional[int] = None
     num_hosts: Optional[int] = None
+    #: per-host mesh slice shape from the supervisor (non-data axes of
+    #: the pod mesh; None = classic one-device-per-worker data
+    #: parallelism) and the sharding-rules JSON path to place params with
+    mesh_axes: Optional[Dict[str, int]] = None
+    sharding_rules_path: Optional[str] = None
     #: background-heartbeat cadence; set by the supervisor when its
     #: step-progress (partition) watchdog is armed
     progress_beat_s: Optional[float] = None
@@ -1275,6 +1328,8 @@ class ElasticWorkerContext:
             host=int(host) if host is not None else None,
             num_hosts=int(env[ENV_NUM_HOSTS])
             if ENV_NUM_HOSTS in env else None,
+            mesh_axes=_parse_env_mesh(env.get(ENV_MESH)),
+            sharding_rules_path=env.get(ENV_SHARDING_RULES) or None,
             progress_beat_s=float(env[ENV_PROGRESS_BEAT])
             if env.get(ENV_PROGRESS_BEAT) else None,
             bind_host=env.get(ENV_BIND_HOST) or None,
@@ -1373,6 +1428,42 @@ class ElasticWorkerContext:
         return os.path.join(
             self.ckpt_dir,
             f"master_state.step{int(step):08d}.w{world}.r{rank}.npz")
+
+    def pod_mesh_axes(self) -> Dict[str, int]:
+        """The generation's pod mesh shape: ``data`` spans the CURRENT
+        processes (DCN across hosts), any supervisor-forwarded extra
+        axes live inside each host's slice (ICI). Shrinks change only
+        the data extent — the model sharding survives a generation."""
+        axes = {"data": self.num_processes}
+        for name, size in (self.mesh_axes or {}).items():
+            if name != "data":
+                axes[name] = int(size)
+        return axes
+
+    def save_checkpoint_sharded(self, step: int, model, manager,
+                                peer_wait_s: float = 120.0) -> None:
+        """Pod-mesh commit: EVERY rank participates in one collective
+        orbax save — each process writes exactly the model shards its
+        devices own (genuinely sharded bytes, not a replicated copy from
+        rank 0) — then rank 0 alone runs the fencing commit (stamp,
+        prune). No master residual shards on this path: GSPMD owns the
+        gradient exchange, so the stamp waits on no peer files."""
+        from deeplearning4j_tpu.util import faultinject
+        self.check_fence()
+        self._mark_saving(+1)
+        try:
+            faultinject.on_save_phase(self.slot, step, "pre_write",
+                                      host=self.host)
+            ok = manager.save(step, model,
+                              overwrite_existing=(self.process_id == 0))
+            faultinject.on_save_phase(self.slot, step, "mid_shard",
+                                      host=self.host)
+            if self.process_id == 0:
+                self._commit_step(step, manager, save_model_fn=lambda: ok,
+                                  expect_shards=False,
+                                  peer_wait_s=peer_wait_s)
+        finally:
+            self._mark_saving(-1)
 
     def save_checkpoint(self, step: int, model, master=None, manager=None,
                         peer_wait_s: float = 120.0) -> None:
@@ -1699,31 +1790,67 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
     from deeplearning4j_tpu.util.orbax_checkpoint import (
         OrbaxCheckpointManager)
 
+    pod_axes = ctx.pod_mesh_axes()
+    model_parallel = any(k != "data" and int(v) > 1
+                         for k, v in pod_axes.items())
+    pod_mesh = make_mesh(pod_axes) if model_parallel else None
+    rules = None
+    if ctx.sharding_rules_path:
+        from deeplearning4j_tpu.parallel.sharding import load_sharding_rules
+        rules = load_sharding_rules(ctx.sharding_rules_path)
+    if model_parallel and save_mode == "async":
+        # the overlapped session snapshots to host numpy, which would
+        # gather the model shards; pod-mesh saves go through orbax's own
+        # collective sharded writer instead
+        print(f"[slot {ctx.slot}] pod mesh active: async save_mode "
+              f"falls back to sync collective saves", flush=True)
+        save_mode = "sync"
+
     if ctx.restore_step is not None:
         # every process restores independently (active_processes={pid}:
         # read-only restores need no cross-process barrier); fallback
-        # walks to an older retained step when the chosen one is corrupt
+        # walks to an older retained step when the chosen one is corrupt.
+        # On a pod mesh the restore reshards STRAIGHT INTO this
+        # generation's mesh — a 2×4 checkpoint restores onto a 1×4
+        # world after a host-failure shrink (the data extent changed,
+        # the rules re-place every param on the surviving slice)
         with OrbaxCheckpointManager(
                 ctx.ckpt_dir, active_processes={ctx.process_id},
                 barrier_sync_key_prefix=(
                     f"restore_g{ctx.generation}_p{ctx.process_id}")) as mgr:
             net = mgr.restore(ctx.restore_step, fallback=True,
-                              fallback_steps=ctx.eligible_steps)
+                              fallback_steps=ctx.eligible_steps,
+                              mesh=pod_mesh, sharding_rules=rules)
             restored_step = mgr.restored_step
     else:
         net = build_model()
         restored_step = None
+        if pod_mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import (
+                shard_model_with_rules)
+            shard_model_with_rules(net, pod_mesh, rules)
 
-    mesh = make_mesh({"data": ctx.num_processes})
-    master = SharedTrainingMaster(mesh=mesh, **(master_kwargs or {}))
-    if restored_step is not None:
-        state_path = ctx.master_state_path(restored_step)
-        if os.path.exists(state_path):
-            # same world size as the writer → exact resume including
-            # residuals; after a shrink the file (keyed by world size)
-            # does not exist and residuals re-accumulate
-            master.load_state(state_path)
-    front = DistributedMultiLayerNetwork(net, master)
+    if pod_mesh is not None:
+        # DP×MP via GSPMD: the jitted train step IS the distributed
+        # program (batch over data, params over model — gradient
+        # exchange compiled in); no deterministic-broadcast master
+        from deeplearning4j_tpu.parallel.mesh import format_mesh_axes
+        print(f"[slot {ctx.slot}] pod mesh "
+              f"{format_mesh_axes(pod_axes)} (GSPMD 2-D)", flush=True)
+        mesh = pod_mesh
+        master = None
+        front = net
+    else:
+        mesh = make_mesh({"data": ctx.num_processes})
+        master = SharedTrainingMaster(mesh=mesh, **(master_kwargs or {}))
+        if restored_step is not None:
+            state_path = ctx.master_state_path(restored_step)
+            if os.path.exists(state_path):
+                # same world size as the writer → exact resume including
+                # residuals; after a shrink the file (keyed by world
+                # size) does not exist and residuals re-accumulate
+                master.load_state(state_path)
+        front = DistributedMultiLayerNetwork(net, master)
 
     if tracer is not None or exporter is not None:
         # per-iteration train_iteration spans (parented into the job
@@ -1750,7 +1877,13 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
     net.listeners.append(_Beat())
 
     manager = None
-    if ctx.process_id == 0:
+    if pod_mesh is not None and ctx.num_processes > 1:
+        # params are sharded ACROSS processes: every rank owns shards
+        # only it can write, so every rank joins the collective save
+        manager = OrbaxCheckpointManager(
+            ctx.ckpt_dir, max_to_keep=max_to_keep,
+            barrier_sync_key_prefix=f"save_g{ctx.generation}")
+    elif ctx.process_id == 0:
         manager = OrbaxCheckpointManager(
             ctx.ckpt_dir, max_to_keep=max_to_keep,
             active_processes={0},
@@ -1788,6 +1921,8 @@ def run_elastic_worker(build_model, build_iterator, *, epochs: int,
                 if step % max(1, checkpoint_every) == 0 or step == epochs:
                     if session is not None:
                         session.submit(step, net)
+                    elif pod_mesh is not None:
+                        ctx.save_checkpoint_sharded(step, net, manager)
                     else:
                         ctx.save_checkpoint(step, net, master, manager)
     finally:

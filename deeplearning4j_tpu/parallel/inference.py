@@ -454,17 +454,34 @@ class ParallelInference:
             target = -(-target // d) * d
         return target, False
 
-    def _to_device(self, x: np.ndarray):
+    def _to_device(self, x: np.ndarray, mesh=None):
         """Host batch → device array, exactly as the dispatcher ships it
         (shared by the dispatch hot path and warmup so the compiled shapes
-        and shardings are identical)."""
+        and shardings are identical). ``mesh`` overrides the dispatcher
+        mesh — warmup of a NOT-yet-activated version placed on its own
+        mesh must ship batches the way that version's dispatches will."""
         xj = jnp.asarray(x)
-        if self.mesh is not None:
-            xj = jax.device_put(xj, batch_sharding(self.mesh, xj.ndim))
+        mesh = self.mesh if mesh is None else mesh
+        if mesh is not None:
+            xj = jax.device_put(xj, batch_sharding(mesh, xj.ndim))
         return xj
 
+    def set_mesh(self, mesh: Optional[Mesh]) -> None:
+        """Repoint batch sharding at ``mesh`` (None = single-device) and
+        re-round the declared buckets to its data-axis size. Called by the
+        registry when a hot-swap activates a version placed on a different
+        mesh than the dispatcher's current one — batches for a GSPMD-
+        sharded version must land on ITS device set or the forward raises
+        an incompatible-devices error. With the power-of-two defaults the
+        re-rounding is a no-op for any data axis that divides the old one
+        (a shrink keeps every bucket); a grow may widen small buckets."""
+        self.mesh = mesh
+        d = 1 if mesh is None else mesh.shape.get("data", 1)
+        self.buckets = tuple(sorted({-(-b // d) * d for b in self.buckets}))
+
     def warmup(self, row_shape: Sequence[int], *, dtype=np.float32,
-               model=None, buckets: Optional[Sequence[int]] = None) -> dict:
+               model=None, buckets: Optional[Sequence[int]] = None,
+               mesh=None) -> dict:
         """Execute the forward for every declared bucket ahead of time.
 
         ``row_shape`` is the per-row feature shape (no batch dim); ``model``
@@ -476,6 +493,7 @@ class ParallelInference:
         first live request would compile anyway.
 
         Returns ``{bucket: seconds}`` for the buckets warmed by THIS call.
+        ``mesh`` overrides the batch placement (see ``_to_device``).
         """
         model = self._model() if model is None else model
         report = {}
@@ -483,7 +501,7 @@ class ParallelInference:
                   [self._bucket_for(int(x))[0] for x in buckets]):
             x = np.zeros((b,) + tuple(row_shape), dtype)
             t0 = time.perf_counter()
-            np.asarray(model.output(self._to_device(x)))
+            np.asarray(model.output(self._to_device(x, mesh=mesh)))
             report[b] = time.perf_counter() - t0
             try:
                 self._warmed_keys.setdefault(model, set()).add(

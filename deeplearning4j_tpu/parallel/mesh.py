@@ -9,6 +9,8 @@ lays collectives onto ICI links following the mesh topology.
 
 from __future__ import annotations
 
+import inspect
+
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -21,17 +23,38 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map_impl
 
 
+def _shard_map_check_kwarg() -> Optional[str]:
+    """Which disable-replication-checking kwarg THIS jax's shard_map takes
+    (``check_vma`` on recent jax, ``check_rep`` before, None when neither
+    is inspectable). Resolved from the wrapper's signature, NOT by probing
+    with try/except TypeError: a bare retry-on-TypeError also swallowed
+    genuine TypeErrors raised while tracing the user ``fn`` (e.g. a body
+    with the wrong arity), silently re-running the broken trace and then
+    reporting a misleading missing-kwarg failure."""
+    try:
+        params = inspect.signature(_shard_map_impl).parameters
+    except (TypeError, ValueError):  # pragma: no cover - C accelerated impl
+        return None
+    for kw in ("check_vma", "check_rep"):
+        if kw in params:
+            return kw
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):  # pragma: no cover - jax version
+        return "check_vma"
+    return None  # pragma: no cover - neither kwarg exists on this jax
+
+
+_CHECK_KWARG = _shard_map_check_kwarg()
+
+
 def shard_map(fn, *, mesh, in_specs, out_specs):
     """Version-tolerant shard_map with replication checking disabled
-    (the kwarg is ``check_vma`` on recent jax, ``check_rep`` before)."""
-    for kw in ("check_vma", "check_rep"):
-        try:
-            return _shard_map_impl(fn, mesh=mesh, in_specs=in_specs,
-                                   out_specs=out_specs, **{kw: False})
-        except TypeError:  # pragma: no cover - depends on jax version
-            continue
-    return _shard_map_impl(fn, mesh=mesh, in_specs=in_specs,  # pragma: no cover
-                           out_specs=out_specs)
+    (the kwarg is ``check_vma`` on recent jax, ``check_rep`` before).
+    The kwarg is resolved once from the implementation's signature, so a
+    TypeError raised from the user's ``fn`` propagates untouched."""
+    kwargs = {} if _CHECK_KWARG is None else {_CHECK_KWARG: False}
+    return _shard_map_impl(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)
 
 # Canonical axis names used across the framework.
 DATA_AXIS = "data"
@@ -39,6 +62,47 @@ MODEL_AXIS = "model"
 PIPELINE_AXIS = "pipe"
 SEQUENCE_AXIS = "seq"
 EXPERT_AXIS = "expert"
+
+
+def parse_mesh_axes(spec: str) -> Dict[str, int]:
+    """Parse the CLI/env mesh-shape grammar ``"data=4,model=2"`` into the
+    ``{axis: size}`` dict :func:`make_mesh` takes. ``-1`` (at most one
+    axis) means inferred. The string form is what crosses process
+    boundaries — the ``train``/``serve`` flags and the elastic
+    supervisor→worker environment both carry it."""
+    axes: Dict[str, int] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"bad mesh axis {part!r} in {spec!r} (want name=size, "
+                f"e.g. data=4,model=2)")
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if not name or name in axes:
+            raise ValueError(f"bad or duplicate mesh axis name in {spec!r}")
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(
+                f"mesh axis {name!r} has non-integer size {size!r}") from None
+        if n == 0 or n < -1:
+            raise ValueError(
+                f"mesh axis {name!r} size must be positive or -1 "
+                f"(inferred), got {n}")
+        axes[name] = n
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    if sum(1 for s in axes.values() if s == -1) > 1:
+        raise ValueError(f"at most one mesh axis may be -1: {spec!r}")
+    return axes
+
+
+def format_mesh_axes(axes: Dict[str, int]) -> str:
+    """Inverse of :func:`parse_mesh_axes` (axis order preserved)."""
+    return ",".join(f"{k}={int(v)}" for k, v in axes.items())
 
 
 def make_mesh(axes: Optional[Dict[str, int]] = None,

@@ -9,9 +9,13 @@ matching collectives (all-gather / reduce-scatter) around the matmuls.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import json
+import re
+
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
@@ -24,6 +28,22 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def batch_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (batch) dimension over the data axis."""
     return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def place_batch(x, mesh: Optional[Mesh], axis: str = DATA_AXIS):
+    """Shard one batch array's leading dim over the mesh's data axis —
+    the end-to-end input half of a DP×MP step (params carry the model
+    axis; the batch carries data). No-op for ``None`` leaves, meshes
+    without the axis, and ragged batches that don't divide it (those
+    run on the replicated path, same contract as ParallelWrapper's
+    tail-batch handling)."""
+    if x is None or mesh is None:
+        return x
+    d = int(mesh.shape.get(axis, 1))
+    ndim = getattr(x, "ndim", 0)
+    if d <= 1 or ndim == 0 or x.shape[0] % d:
+        return x
+    return jax.device_put(x, batch_sharding(mesh, ndim, axis))
 
 
 _COLUMN = "column"
@@ -197,12 +217,246 @@ def tp_param_specs(net, axis: str = MODEL_AXIS, mesh: Optional[Mesh] = None):
             for (i, layer), p in zip(enumerate(net.layers), net.params)]
 
 
+# -- rule-based sharding: regex-over-param-path → PartitionSpec --------------
+#
+# The config-driven layer above tp_param_specs: one rule line shards any
+# model without touching layer code. A rule is (regex, PartitionSpec);
+# rules are tried in order against the '/'-joined param path ("vertex/W"
+# for graphs, "0/W" for MultiLayerNetwork layer lists) and the FIRST
+# match wins. Scalar / size-1 leaves are never partitioned; a param no
+# rule matches fails loudly — a silently-replicated tensor is how a
+# "sharded" job quietly stops fitting in HBM.
+
+Rule = Tuple[str, P]
+
+#: Shipped default rule set for the framework's transformer naming
+#: convention (``transformer_encoder_block``/``transformer_decoder_block``
+#: vertex names, ``embed``/``out`` heads). Reproduces the Megatron
+#: column→row pairs ``tp_param_specs`` derives from topology, PLUS the
+#: vocab path the pairing rule refuses on principle: the embedding table
+#: is vocab-ROW-sharded (``jnp.take`` over a sharded axis-0 compiles to
+#: masked local takes + one all-reduce, no gather) and the LM head is
+#: vocab-COLUMN-sharded — its logits stay sharded through the
+#: log-sum-exp cross-entropy (``losses.mcxent_logits`` routes softmax
+#: losses through ``log_softmax``), so the whole path compiles with ZERO
+#: all-gathers (asserted in tests/test_sharding_rules.py against HLO).
+DEFAULT_2D_RULES: Tuple[Rule, ...] = (
+    # vocab path: row-sharded embedding take …
+    (r"(^|/)embed[^/]*/W$", P(MODEL_AXIS, None)),
+    # … and column-sharded logits (+LSE loss keeps them sharded)
+    (r"(^|/)(out|output|logits|lm_head)[^/]*/W$", P(None, MODEL_AXIS)),
+    (r"(^|/)(out|output|logits|lm_head)[^/]*/b$", P(MODEL_AXIS)),
+    # Megatron attention block: QKV column-split, output row-split
+    (r"/Wqkv$", P(None, MODEL_AXIS)),
+    (r"/bqkv$", P(MODEL_AXIS)),
+    (r"/Wo$", P(MODEL_AXIS, None)),
+    (r"/bo$", P()),
+    # Megatron paired FFN: first matmul column, second row
+    (r"ff1[^/]*/W$", P(None, MODEL_AXIS)),
+    (r"ff1[^/]*/b$", P(MODEL_AXIS)),
+    (r"ff2[^/]*/W$", P(MODEL_AXIS, None)),
+    # everything else (LayerNorm/BN scale-shift, positional tables,
+    # recurrent cells, conv) replicates
+    (r".*", P()),
+)
+
+
+def _path_name(path) -> str:
+    """'/'-joined name for a tree_util key path: dict keys and sequence
+    indices both render bare (``0/W``, ``block0-att/Wqkv``)."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def _as_spec(spec) -> P:
+    if isinstance(spec, P):
+        return spec
+    if spec is None:
+        return P()
+    if isinstance(spec, (list, tuple)):
+        return P(*[None if (s is None or s == "null") else str(s)
+                   for s in spec])
+    raise ValueError(f"bad partition spec {spec!r} (want PartitionSpec, "
+                     f"None, or a list of axis names / null)")
+
+
+def normalize_rules(rules: Sequence) -> List[Rule]:
+    """Validate + canonicalize a rule list: each entry becomes
+    ``(compiled-ok regex string, PartitionSpec)``."""
+    out: List[Rule] = []
+    for i, entry in enumerate(rules):
+        try:
+            pattern, spec = entry
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"rule[{i}] must be a (regex, spec) pair, got {entry!r}"
+            ) from None
+        if not isinstance(pattern, str):
+            raise ValueError(f"rule[{i}] pattern must be a string, "
+                             f"got {type(pattern).__name__}")
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise ValueError(f"rule[{i}] regex {pattern!r} invalid: {e}") \
+                from None
+        out.append((pattern, _as_spec(spec)))
+    if not out:
+        raise ValueError("empty sharding rule list")
+    return out
+
+
+def load_sharding_rules(source) -> List[Rule]:
+    """Load rules from a JSON file path / file object / parsed dict.
+
+    Schema: ``{"rules": [[regex, [axis-or-null, ...]], ...]}`` — the
+    spec array gives one entry per tensor dimension (trailing dims may
+    be omitted = unsharded), ``null`` meaning replicated on that dim.
+    """
+    if isinstance(source, dict):
+        doc = source
+    elif hasattr(source, "read"):
+        doc = json.load(source)
+    else:
+        with open(source, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    if not isinstance(doc, dict) or "rules" not in doc:
+        raise ValueError("sharding rules file must be an object with a "
+                         "'rules' array")
+    return normalize_rules(doc["rules"])
+
+
+def _is_scalar_leaf(leaf) -> bool:
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return True
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Sequence, params):
+    """Map a param pytree to a same-structure PartitionSpec pytree by
+    first-match regex over each leaf's '/'-joined path (the
+    fmengine/EasyLM ``match_partition_rules`` pattern). Scalar and
+    size-1 leaves are never partitioned (always ``P()``); a leaf no rule
+    matches raises — add a catch-all ``(".*", P())`` rule to opt into
+    replicate-by-default."""
+    rules = normalize_rules(rules)
+
+    def match(path, leaf):
+        name = _path_name(path)
+        if _is_scalar_leaf(leaf):
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        raise ValueError(f"Partition rule not found for param: {name}")
+
+    return jax.tree_util.tree_map_with_path(match, params)
+
+
+def lint_partition_rules(rules: Sequence, params) -> List[str]:
+    """Dry-run lint against a sample model's param tree: returns
+    warnings (empty = clean) for unmatched params (would raise at
+    placement time), dead rules (match nothing), and shadowed rules
+    (every leaf they match is claimed by an earlier rule)."""
+    rules = normalize_rules(rules)
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    names = [_path_name(p) for p, leaf in leaves
+             if not _is_scalar_leaf(leaf)]
+    problems: List[str] = []
+    hits: List[set] = [set() for _ in rules]
+    first_hit: Dict[str, int] = {}
+    for name in names:
+        matched = False
+        for i, (pattern, _spec) in enumerate(rules):
+            if re.search(pattern, name):
+                hits[i].add(name)
+                if not matched:
+                    first_hit[name] = i
+                matched = True
+        if not matched:
+            problems.append(f"param {name!r} matches no rule (placement "
+                            f"would fail loudly)")
+    for i, (pattern, _spec) in enumerate(rules):
+        if not hits[i]:
+            problems.append(f"rule[{i}] {pattern!r} matches no param of "
+                            f"the sample model (dead rule?)")
+        elif all(first_hit[n] != i for n in hits[i]):
+            winners = sorted({first_hit[n] for n in hits[i]})
+            problems.append(
+                f"rule[{i}] {pattern!r} is fully shadowed by earlier "
+                f"rule(s) {winners} — it can never win a match")
+    return problems
+
+
+def shard_model_with_rules(net, mesh: Mesh, rules: Optional[Sequence] = None
+                           ) -> None:
+    """Place a model on a DP×MP mesh from a rule list, in-place (the
+    config-line counterpart of :func:`shard_model`): params by
+    first-match rule, updater-state leaves sharing the param's spec when
+    shapes match, layer states replicated. Records the mesh on the net
+    (``net._mesh``) so ``fit``/``output`` shard incoming batches over
+    the ``data`` axis end to end.
+
+    ``rules=None`` uses :data:`DEFAULT_2D_RULES`. A matched leaf whose
+    dims do not divide the named axes degrades to replicated (same
+    contract as ``shard_model``'s Megatron path)."""
+    specs = match_partition_rules(
+        DEFAULT_2D_RULES if rules is None else rules, net.params)
+    repl = replicated(mesh)
+    placed: Dict[str, Tuple[tuple, P]] = {}
+
+    def place_param(path, v, spec):
+        if not _leaf_sharding_ok(v.shape, spec, mesh):
+            spec = P()
+        placed[_path_name(path)] = (tuple(v.shape), spec)
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    new_params = jax.tree_util.tree_map_with_path(place_param, net.params,
+                                                  specs)
+    if net.updater_states is not None:
+        def upd_sharding(path, s):
+            # updater moments live at <param-path>/<slot-name> and share
+            # the param's spec when shapes match (momentum etc.)
+            shape_spec = placed.get(_path_name(path[:-1]))
+            if shape_spec is not None and tuple(s.shape) == shape_spec[0]:
+                return NamedSharding(mesh, shape_spec[1])
+            return repl
+        upd_sh = jax.tree_util.tree_map_with_path(upd_sharding,
+                                                  net.updater_states)
+        net.updater_states = jax.tree_util.tree_map(
+            jax.device_put, net.updater_states, upd_sh)
+        net._upd_shardings = upd_sh
+    net.params = new_params
+    net.states = jax.device_put(net.states, repl)
+    net._mesh = mesh
+    # the train step pins its updated params/opt-state to these (GSPMD
+    # would otherwise pick its own output shardings — one drifted leaf
+    # re-layouts every later compile and re-introduces all-gathers)
+    net._param_shardings = jax.tree_util.tree_map(
+        lambda v: v.sharding, new_params)
+    # steps compiled before placement know nothing about the pins
+    net._jit_cache.clear()
+
+
 def _leaf_sharding_ok(shape, spec: P, mesh: Mesh) -> bool:
     for dim, ax in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
         if ax is None:
             continue
-        if dim % mesh.shape[ax]:
-            return False
+        for a in (ax if isinstance(ax, tuple) else (ax,)):
+            # an axis the mesh does not have (e.g. 2-D rules on a
+            # data-only mesh) degrades to replicated, same as a
+            # non-dividing dim
+            if a not in mesh.shape or dim % mesh.shape[a]:
+                return False
     return True
 
 

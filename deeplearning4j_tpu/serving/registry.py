@@ -116,17 +116,21 @@ class ModelVersion:
     provided at registration."""
 
     __slots__ = ("version", "model", "source", "registered_at",
-                 "dtype_policy", "quant_error")
+                 "dtype_policy", "quant_error", "mesh")
 
     def __init__(self, version: int, model, source: str,
                  dtype_policy: str = "float32",
-                 quant_error: Optional[dict] = None):
+                 quant_error: Optional[dict] = None, mesh=None):
         self.version = version
         self.model = model
         self.source = source
         self.registered_at = time.time()
         self.dtype_policy = dtype_policy
         self.quant_error = quant_error
+        # the jax Mesh this version's params are placed on (None =
+        # replicated/single-device); activation repoints the dispatcher's
+        # batch sharding at it
+        self.mesh = mesh
 
 
 class ServedModel:
@@ -188,6 +192,8 @@ class ServedModel:
                  "dtype_policy": v.dtype_policy}
             if v.quant_error is not None:
                 d["quant_error"] = v.quant_error
+            if v.mesh is not None:  # GSPMD placement is operator-visible
+                d["mesh"] = {k: int(s) for k, s in v.mesh.shape.items()}
             w = self.warmup_state.get(v.version)
             if w is not None:
                 d["warmup"] = dict(w)
@@ -335,7 +341,8 @@ class ModelRegistry:
     def register(self, name: str, model=None, *, path: Optional[str] = None,
                  activate: bool = True, dtype_policy: str = "float32",
                  sample_input=None, input_shape: Optional[Sequence[int]] = None,
-                 quant_tolerance: Optional[float] = None) -> int:
+                 quant_tolerance: Optional[float] = None,
+                 mesh=None, sharding_rules=None) -> int:
         """Register a new version of ``name``; returns the version number.
 
         Exactly one of ``model`` (a live object) or ``path`` (anything
@@ -357,16 +364,33 @@ class ModelRegistry:
         per-row feature shape) > ``sample_input``'s row shape > the conf's
         ``InputType`` > the first layer's ``n_in``. A model yielding no
         spec (duck-typed stubs) skips warmup and is treated as warm.
+
+        ``mesh`` serves this version GSPMD-sharded: params are placed by
+        ``sharding_rules`` (default: the Megatron 2-D rule set) over the
+        mesh, warmup batches ship data-axis-sharded to the same device
+        set, and activation repoints the dispatcher's batch sharding at
+        this mesh. ``float32`` only (a quantized wrapper's packed params
+        do not go through the rule matcher). Canary/shadow splits across
+        versions on DIFFERENT device sets are not supported — activate
+        the sharded version outright.
         """
         if (model is None) == (path is None):
             raise ValueError("register() needs exactly one of model=/path=")
         if dtype_policy not in _quantize.DTYPE_POLICIES:
             raise ValueError(f"unknown dtype_policy {dtype_policy!r} "
                              f"(one of {_quantize.DTYPE_POLICIES})")
+        if mesh is not None and dtype_policy != "float32":
+            raise ValueError(
+                "mesh= (GSPMD-sharded serving) requires dtype_policy="
+                f"'float32', got {dtype_policy!r}")
         source = "object"
         if path is not None:
             model = self.load(path)
             source = str(path)
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import (
+                shard_model_with_rules)
+            shard_model_with_rules(model, mesh, sharding_rules)
         quant_error = None
         served_obj = model
         if dtype_policy != "float32":
@@ -385,16 +409,21 @@ class ModelRegistry:
             served = self._models.get(name)
             if served is None:
                 first = True
+                pi_kw = dict(self._pi_kw)
+                if mesh is not None:
+                    # the dispatcher is born on the version's mesh so
+                    # buckets round to ITS data axis from the start
+                    pi_kw["mesh"] = mesh
                 served = ServedModel(
                     name, ParallelInference(
                         served_obj, mode="batched", metrics=self._metrics,
-                        metrics_name=name, **self._pi_kw))
+                        metrics_name=name, **pi_kw))
                 self._models[name] = served
             version = served.next_version
             served.next_version += 1
             served.versions[version] = ModelVersion(
                 version, served_obj, source, dtype_policy=dtype_policy,
-                quant_error=quant_error)
+                quant_error=quant_error, mesh=mesh)
             if self._breaker_kw is not None:
                 served.breakers[version] = _breaker.CircuitBreaker(
                     time_source=self._time_source,
@@ -489,12 +518,15 @@ class ModelRegistry:
         row_shape, dtype = spec
         state = served.warmup_state[version]
         model = served.versions[version].model
+        # a version placed on its own mesh warms with ITS batch sharding,
+        # not the dispatcher's current one (they differ until activation)
+        vmesh = served.versions[version].mesh
         state["status"] = "warming"
         t0 = time.perf_counter()
         try:
             for b in state["buckets"]:
                 served.inference.warmup(row_shape, dtype=dtype, model=model,
-                                        buckets=[b])
+                                        buckets=[b], mesh=vmesh)
                 with self._lock:
                     state["warm"].append(b)
                     self._update_warm_gauge(served)
@@ -962,7 +994,15 @@ class ModelRegistry:
             try:
                 # the swap itself is atomic inside ParallelInference; the
                 # _swapping counter only widens the readiness signal around it
-                served.inference.update_model(served.versions[version].model)
+                incoming = served.versions[version]
+                vmesh = incoming.mesh if incoming.mesh is not None \
+                    else self._pi_kw.get("mesh")
+                if vmesh is not served.inference.mesh:
+                    # batches must land on the incoming version's device
+                    # set; swapped-out-of-order requests in flight finish
+                    # on the OLD model, which still holds its own placement
+                    served.inference.set_mesh(vmesh)
+                served.inference.update_model(incoming.model)
                 with self._lock:
                     served.previous_version = served.current_version
                     served.current_version = version

@@ -154,6 +154,46 @@ def _template_for(model, metadata) -> Dict[str, Any]:
     return _state_pytree(model, with_updater=has_updater)
 
 
+def _sharded_template(model, template: Dict[str, Any], mesh,
+                      rules=None) -> Dict[str, Any]:
+    """Rewrite the params/updater_states halves of a restore template as
+    ``ShapeDtypeStruct``s carrying the rule-derived target shardings, so
+    orbax restores each leaf DIRECTLY into its mesh placement — the
+    reshard-on-restore path (a 2×4 checkpoint restored onto a 1×4 mesh
+    re-slices shards; no full-host materialization on the pod path).
+    ``states``/``counters`` stay as-is (replicated small state)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deeplearning4j_tpu.parallel.sharding import (
+        DEFAULT_2D_RULES, _leaf_sharding_ok, _path_name,
+        match_partition_rules)
+
+    specs = match_partition_rules(
+        DEFAULT_2D_RULES if rules is None else rules, model.params)
+    placed: Dict[str, Any] = {}
+
+    def conv_param(path, v, spec):
+        if not _leaf_sharding_ok(v.shape, spec, mesh):
+            spec = P()
+        placed[_path_name(path)] = (tuple(v.shape), spec)
+        return jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                    sharding=NamedSharding(mesh, spec))
+
+    out = dict(template)
+    out["params"] = jax.tree_util.tree_map_with_path(
+        conv_param, template["params"], specs)
+    if "updater_states" in out:
+        def conv_upd(path, s):
+            shape_spec = placed.get(_path_name(path[:-1]))
+            spec = (shape_spec[1] if shape_spec is not None
+                    and tuple(s.shape) == shape_spec[0] else P())
+            return jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                        sharding=NamedSharding(mesh, spec))
+        out["updater_states"] = jax.tree_util.tree_map_with_path(
+            conv_upd, template["updater_states"])
+    return out
+
+
 def _apply_state(model, state: Dict[str, Any], load_updater: bool):
     model.params = state["params"]
     model.states = state["states"]
@@ -218,9 +258,17 @@ def save_model(model, directory: str, *, save_updater: bool = True,
     return None
 
 
-def restore_model(directory: str, *, load_updater: bool = True):
+def restore_model(directory: str, *, load_updater: bool = True,
+                  mesh=None, sharding_rules=None):
     """Restore a model saved by :func:`save_model`. Works regardless of
-    whether the checkpoint contains updater state."""
+    whether the checkpoint contains updater state.
+
+    ``mesh`` (+ optional ``sharding_rules``) restores STRAIGHT INTO a
+    rule-sharded placement on that mesh — the checkpoint's own mesh
+    shape is irrelevant (reshard-on-restore: a 2×4 save restores onto a
+    1×4 mesh), and the returned model has ``fit``/``output`` honoring
+    the mesh exactly as after
+    :func:`deeplearning4j_tpu.parallel.sharding.shard_model_with_rules`."""
     import orbax.checkpoint as ocp
 
     directory = _canonical_dir(directory)
@@ -228,9 +276,17 @@ def restore_model(directory: str, *, load_updater: bool = True):
     target = os.path.join(directory, "state")
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         template = _template_for(model, ckptr.metadata(target))
+        if mesh is not None:
+            template = _sharded_template(model, template, mesh,
+                                         sharding_rules)
         state = ckptr.restore(target,
                               args=ocp.args.StandardRestore(template))
-    return _apply_state(model, state, load_updater)
+    model = _apply_state(model, state, load_updater)
+    if mesh is not None:
+        from deeplearning4j_tpu.parallel.sharding import (
+            shard_model_with_rules)
+        shard_model_with_rules(model, mesh, sharding_rules)
+    return model
 
 
 # -- step-managed rotation ---------------------------------------------------
@@ -326,8 +382,13 @@ class OrbaxCheckpointManager:
 
     def restore(self, step: Optional[int] = None, *,
                 load_updater: bool = True, fallback: bool = False,
-                fallback_steps: Optional[Sequence[int]] = None):
+                fallback_steps: Optional[Sequence[int]] = None,
+                mesh=None, sharding_rules=None):
         """Restore the model at ``step`` (default: latest).
+
+        ``mesh``/``sharding_rules`` restore straight into a rule-sharded
+        placement regardless of the mesh the checkpoint was saved under
+        (see :func:`restore_model` — the elastic reshard-on-shrink path).
 
         ``fallback=True`` is the integrity-tolerant path: when the chosen
         step is truncated/corrupt (a preemption mid-write, a fault-
@@ -354,7 +415,8 @@ class OrbaxCheckpointManager:
         errors = []
         for s in candidates:
             try:
-                model = self._restore_step(s, load_updater)
+                model = self._restore_step(s, load_updater, mesh=mesh,
+                                           sharding_rules=sharding_rules)
             except Exception as e:  # noqa: BLE001 - orbax raises many kinds
                 errors.append(f"step {s}: {type(e).__name__}: {e}")
                 if not fallback:
@@ -373,13 +435,22 @@ class OrbaxCheckpointManager:
             f"no restorable checkpoint in {self.directory}: "
             + "; ".join(errors))
 
-    def _restore_step(self, step: int, load_updater: bool):
+    def _restore_step(self, step: int, load_updater: bool, *,
+                      mesh=None, sharding_rules=None):
         import orbax.checkpoint as ocp
         model = _build_model(self.directory)
         template = _template_for(model, self._mgr.item_metadata(step))
+        if mesh is not None:
+            template = _sharded_template(model, template, mesh,
+                                         sharding_rules)
         state = self._mgr.restore(
             step, args=ocp.args.StandardRestore(template))
-        return _apply_state(model, state, load_updater)
+        model = _apply_state(model, state, load_updater)
+        if mesh is not None:
+            from deeplearning4j_tpu.parallel.sharding import (
+                shard_model_with_rules)
+            shard_model_with_rules(model, mesh, sharding_rules)
+        return model
 
     def wait_until_finished(self) -> None:
         self._mgr.wait_until_finished()

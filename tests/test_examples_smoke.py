@@ -64,6 +64,23 @@ def test_shipped_elastic_alert_rules_lint_clean():
     assert proc.stdout.startswith("OK"), proc.stdout
 
 
+def test_shipped_sharding_rules_lint_clean():
+    """The shipped ``--sharding-rules`` file (the JSON rendition of the
+    Megatron 2-D rule set) passes ``tools/validate_sharding_rules.py``:
+    schema + dry-run lint against the sample TransformerLM, with every
+    spec axis checked against a data=4,model=2 mesh."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_sharding_rules.py"),
+         "--mesh", "data=4,model=2",
+         os.path.join(EXAMPLES_DIR, "sharding_rules.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
 def test_shipped_pipeline_config_lints_clean():
     """The continuous-training pipeline config shipped for example 27 /
     the ``pipeline`` CLI subcommand passes

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Sharding-rule file validator: schema check + dry-run lint.
+
+Validates a ``--sharding-rules`` file (the ``parallel.sharding``
+``load_sharding_rules`` schema) the same way
+``tools/validate_fault_plan.py`` validates fault plans: importable
+(``validate_file``/``validate_rules`` return a list of problems,
+empty = valid) and runnable
+(``python tools/validate_sharding_rules.py RULES.json [...]``).
+
+Two passes:
+
+1. **schema** — the file must build through ``load_sharding_rules``
+   (non-list rules, uncompilable regexes, bad spec arrays all surface
+   here with the offending rule index);
+2. **dry run** — ``lint_partition_rules`` matches the rules against a
+   sample model's param tree and flags rules that parse but cannot
+   behave as written: params NO rule matches (``match_partition_rules``
+   would raise at placement time), dead rules (match nothing in the
+   sample), and shadowed rules (every leaf they match is claimed by an
+   earlier rule — first match wins). Nothing is placed on devices.
+
+The default sample model is a tiny ``TransformerLM`` (the vertex-name
+universe the shipped Megatron rule set targets: ``embed/W``, ``Wqkv``,
+``ff1``/``ff2``, ``out/W``); ``--sample-model PATH`` lints against a
+serialized model of your own instead. ``--mesh data=4,model=2``
+additionally checks every spec axis against the mesh's axis names — a
+typo'd axis would raise at placement, not here, without it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from deeplearning4j_tpu.parallel.sharding import (  # noqa: E402
+    lint_partition_rules, load_sharding_rules, normalize_rules)
+
+
+def _sample_params(sample_model: Optional[str] = None):
+    """Param pytree to lint against: a saved model's, or the tiny LM."""
+    if sample_model is not None:
+        from deeplearning4j_tpu.util.model_guesser import load_model_guess
+        return load_model_guess(sample_model).params
+    from deeplearning4j_tpu.nn.graph import ComputationGraph
+    from deeplearning4j_tpu.zoo.models import TransformerLM
+    net = ComputationGraph(TransformerLM(
+        vocab_size=32, max_length=8, n_layers=1, d_model=8, n_heads=2,
+        d_ff=16, seed=0).conf()).init()
+    return net.params
+
+
+def validate_rules(spec, sample_params=None,
+                   mesh_axes: Optional[dict] = None) -> List[str]:
+    """Return a list of problems (empty = valid). ``spec`` is a parsed
+    dict, a file object, or a path. ``sample_params`` is the param
+    pytree the dry run matches against (default: the tiny LM's)."""
+    try:
+        rules = load_sharding_rules(spec)
+        normalize_rules(rules)
+    except (ValueError, KeyError, TypeError, OSError,
+            json.JSONDecodeError) as e:
+        return [f"schema: {e}"]
+    if not rules:
+        return ["schema: no rules defined"]
+    errors: List[str] = []
+    if mesh_axes is not None:
+        for i, (pattern, p) in enumerate(rules):
+            for dim in p:  # a dim entry is an axis name, a tuple of
+                # axis names, or None (replicated)
+                for axis in (dim if isinstance(dim, tuple) else (dim,)):
+                    if axis is not None and axis not in mesh_axes:
+                        errors.append(
+                            f"schema: rule[{i}] ({pattern!r}) names mesh "
+                            f"axis {axis!r} but the mesh has "
+                            f"{sorted(mesh_axes)} — placement would raise")
+    if sample_params is None:
+        sample_params = _sample_params()
+    errors += [f"lint: {w}"
+               for w in lint_partition_rules(rules, sample_params)]
+    return errors
+
+
+def validate_file(path: str, sample_params=None,
+                  mesh_axes: Optional[dict] = None) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable rules file: {e}"]
+    return validate_rules(spec, sample_params, mesh_axes)
+
+
+def main(argv: List[str]) -> int:
+    sample_model = None
+    mesh_axes = None
+    if "--sample-model" in argv:
+        i = argv.index("--sample-model")
+        try:
+            sample_model = argv[i + 1]
+        except IndexError:
+            print("--sample-model needs a model path")
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if "--mesh" in argv:
+        i = argv.index("--mesh")
+        from deeplearning4j_tpu.parallel.mesh import parse_mesh_axes
+        try:
+            mesh_axes = parse_mesh_axes(argv[i + 1])
+        except (IndexError, ValueError) as e:
+            print(f"--mesh: {e}")
+            return 2
+        argv = argv[:i] + argv[i + 2:]
+    if not argv:
+        print("usage: validate_sharding_rules.py [--sample-model PATH] "
+              "[--mesh data=4,model=2] RULES.json [RULES.json ...]")
+        return 2
+    sample_params = _sample_params(sample_model)
+    rc = 0
+    for path in argv:
+        errors = validate_file(path, sample_params, mesh_axes)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            n = len(load_sharding_rules(path))
+            print(f"OK   {path}: {n} rule(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
