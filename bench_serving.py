@@ -40,14 +40,28 @@ availability stays at/above the recorded floor the whole way. All control
 timing runs on a ``ManualTimeSource`` (breaker cooldowns and restart
 backoff are *advanced*, not slept), so the choreography is exact.
 
+Round 3 (``--slo``) — the request-cost & SLO plane under open-loop load:
+a cost-metered, tail-sampled server carries a latency SLO whose threshold
+sits below the lowest histogram bucket, so every request is a
+deterministic budget violation. The run proves (and ``--check
+BENCH_SERVING_r03.json`` re-proves on every CI run) that the compiled
+burn-rate rule fires exactly once and resolves on traffic silence (pure
+``ManualTimeSource``, zero control-path sleeps), the cost ledger's
+conservation invariant holds with zero steady-state compiles (compile
+time is excluded from request bills by construction), the tail sampler
+both keeps the injected stall's trace and drops the boring ones, and the
+latency histogram's tail-bucket exemplar names a trace that
+``capture_bundle`` actually returns.
+
 Usage:
     python bench_serving.py                       # full run, prints JSON
     python bench_serving.py --chaos               # chaos/recovery record
+    python bench_serving.py --slo                 # cost/SLO-plane record
     python bench_serving.py --out FILE            # also write FILE
     python bench_serving.py --check BENCH_SERVING_rNN.json
         # regression mode: tiny config, deterministic oracles only —
         # exercised by the smoke tier on every CI run (r01 = fast path,
-        # r02 = chaos/recovery)
+        # r02 = chaos/recovery, r03 = cost/SLO plane)
 """
 
 import argparse
@@ -567,6 +581,218 @@ def run_chaos_check(committed_path):
     return 0
 
 
+# ----------------------------------------------------------------------- slo
+SLO_SCHEMA_KEYS = ("config", "slo_spec", "compliance", "burn",
+                   "alert_states", "open_loop", "steady_state_compiles",
+                   "cost", "sampler", "exemplar_trace_captured")
+
+
+class _ListSink:
+    """In-memory keep target for the tail sampler (the bench needs the
+    accounting, not the disk format)."""
+
+    def __init__(self):
+        self.spans = []
+
+    def add(self, span):
+        self.spans.append(span)
+
+
+def run_slo():
+    """Round 3 — the request-cost & SLO plane under open-loop load.
+
+    Open-loop traffic (fixed arrival rate) with one injected
+    ``slow_forward`` stall runs against a server carrying a latency SLO
+    whose threshold sits below the lowest histogram bucket — every
+    request is a deterministic budget violation, so the burn-rate
+    choreography (fire exactly once, resolve on silence) is exact on a
+    ``ManualTimeSource`` with zero control-path sleeps. The record
+    captures what the plane promises: compliance + burn at fire time,
+    the cost ledger's conservation invariant (attributed + unattributed
+    == total device ms, compile time separate), the tail sampler's
+    keep/drop accounting, and that the latency histogram's tail-bucket
+    exemplar names a trace ``capture_bundle`` can actually return."""
+    import jax
+
+    from deeplearning4j_tpu.observe import (AlertManager, CallbackSink,
+                                            MetricsRegistry, TailSampler,
+                                            Tracer, disable_tracing,
+                                            enable_tracing, load_slos,
+                                            parse_prometheus_text)
+    from deeplearning4j_tpu.observe.incident import capture_bundle
+    from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+    from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                            ModelServingClient)
+    from deeplearning4j_tpu.util import faultinject
+
+    m = MetricsRegistry()
+    sampler = TailSampler(_ListSink(), default_slow_ms=150.0, metrics=m)
+    tracer = enable_tracing(Tracer(sampler), metrics=m)
+    slo_set = load_slos({"slos": [{
+        "name": "bench-latency", "sli": "latency",
+        "metric": "serving_request_latency_seconds",
+        "labels": {"model": "bench"},
+        "threshold_ms": 0.001, "objective": 0.99,
+        "windows": [{"long_s": 3600, "short_s": 10, "factor": 2.0}]}]})
+    clock = ManualTimeSource(0)
+    notes = []
+    mgr = AlertManager(m, slo_set.rules(), [CallbackSink(notes.append)],
+                       time_source=clock)
+    registry = ModelRegistry(metrics=m, buckets=[1, 2, 4], warmup="sync",
+                             max_batch_size=4)
+    registry.register("bench", _tiny(seed=3))
+    server = ModelServer(registry, metrics=m, max_inflight=64,
+                         alerts=mgr, slo=slo_set)
+    server.start()
+    client = ModelServingClient(server.url)
+    faultinject.set_plan(faultinject.FaultPlan.parse({"faults": [
+        {"type": "slow_forward", "model": "bench", "step": 5,
+         "duration_s": 0.3}]}))
+    try:
+        mgr.evaluate_once()   # baseline sample at t=0
+        c0 = tracer.compile_count
+        open_loop = _open_loop(client, (8,), target_rps=40.0,
+                               duration_s=2.0, slo_ms=50.0)
+        leaked = tracer.compile_count - c0
+
+        clock.advance(seconds=5)
+        mgr.evaluate_once()   # the burn-rate rule fires here
+        status = slo_set.status(metrics=m, alerts=mgr)
+        entry = status["slos"][0]
+        compliance, burn = entry["compliance"], entry["burn"][0]
+        clock.advance(seconds=400)
+        mgr.evaluate_once()   # traffic silence: short window drains
+        states = [n.state for n in notes
+                  if n.rule == "slo_burn:bench-latency"]
+
+        # the tail-bucket exemplar must name a retrievable trace
+        parsed = parse_prometheus_text(m.exposition())
+        tail_le, tail_tid = -1.0, None
+        for (series, labels), ex in parsed.exemplars.items():
+            ld = dict(labels)
+            if series != "serving_request_latency_seconds_bucket" \
+                    or ld.get("model") != "bench":
+                continue
+            le = float(ld["le"])
+            if le != float("inf") and le > tail_le:
+                tail_le, tail_tid = le, ex.labels.get("trace_id")
+        bundle = capture_bundle(seconds=120, metrics=m, cost=server.cost,
+                                sampler=sampler, max_spans=4096)
+        captured = tail_tid is not None and any(
+            e.get("args", {}).get("trace_id") == tail_tid
+            for e in bundle["trace"]["traceEvents"])
+
+        cons = server.cost.conservation("bench")
+        acct = sampler.describe()
+        record = {
+            "config": "tiny MLP 8-16-4 warm, open-loop 40 rps x 2 s, one "
+                      "300 ms slow_forward stall at dispatch seq 5, "
+                      "latency SLO threshold below the lowest bucket",
+            "slo_spec": slo_set.describe()[0],
+            "compliance": compliance,
+            "burn": burn,
+            "alert_states": states,
+            "open_loop": open_loop,
+            "steady_state_compiles": leaked,
+            "cost": {
+                "conservation_ok": cons["ok"],
+                "error_ms": round(cons["error_ms"], 9),
+                "device_ms": round(cons["device_ms"], 3),
+                "attributed_device_ms": round(
+                    cons["attributed_device_ms"], 3),
+                "unattributed_device_ms": round(
+                    cons["unattributed_device_ms"], 3),
+                "compile_ms": round(cons["compile_ms"], 3),
+                "requests": cons["requests"],
+                "batches": cons["batches"]},
+            "sampler": {
+                "kept_traces": acct["kept_traces"],
+                "kept_spans": acct["kept_spans"],
+                "dropped_traces": acct["dropped_traces"],
+                "dropped_spans": acct["dropped_spans"],
+                "keep_reasons": acct["keep_reasons"],
+                "bytes_written": acct["bytes_written"]},
+            "exemplar_trace_captured": captured,
+        }
+        return {"series": "BENCH_SERVING", "round": 3,
+                "backend": jax.default_backend(),
+                "devices": len(jax.devices()),
+                "slo": record}
+    finally:
+        faultinject.set_plan(None)
+        client.close()
+        server.stop(drain=False)
+        registry.shutdown()
+        disable_tracing()
+        sampler.close()
+
+
+def run_slo_check(committed_path):
+    """Deterministic SLO/cost oracles for the smoke tier: the committed
+    r03 record carries the schema and its invariants hold, and a fresh
+    in-process run reproduces every one of them — fire-once/resolve
+    choreography, cost conservation with zero steady-state compiles,
+    tail-sampler keeps AND drops, exemplar-to-trace retrievability.
+    Latency/throughput numbers are deliberately not gated."""
+    failures = []
+    with open(committed_path) as f:
+        committed = json.load(f)
+    if committed.get("series") != "BENCH_SERVING":
+        failures.append(f"{committed_path}: series != BENCH_SERVING")
+    rec = committed.get("slo")
+    if not isinstance(rec, dict):
+        failures.append(f"{committed_path}: no 'slo' record")
+        rec = {}
+    for key in SLO_SCHEMA_KEYS:
+        if key not in rec:
+            failures.append(f"{committed_path}: slo missing {key!r}")
+
+    def _gate(r, where):
+        out = []
+        if r.get("alert_states") != ["firing", "resolved"]:
+            out.append(f"{where}: burn alert did not fire exactly once "
+                       f"and resolve (states {r.get('alert_states')})")
+        if r.get("compliance", {}).get("met") is not False:
+            out.append(f"{where}: sub-bucket threshold did not violate "
+                       f"compliance")
+        if not r.get("burn", {}).get("active", False):
+            out.append(f"{where}: burn windows never went active")
+        if not r.get("cost", {}).get("conservation_ok", False):
+            out.append(f"{where}: cost ledger conservation broken "
+                       f"(error {r.get('cost', {}).get('error_ms')} ms)")
+        if r.get("cost", {}).get("requests", 0) < 1:
+            out.append(f"{where}: ledger attributed no requests")
+        if r.get("steady_state_compiles", 1) != 0:
+            out.append(f"{where}: compiles leaked into measured traffic "
+                       f"(compile exclusion untestable)")
+        if r.get("sampler", {}).get("kept_traces", 0) < 1:
+            out.append(f"{where}: tail sampler kept nothing (the stall "
+                       f"trace must earn its keep)")
+        if r.get("sampler", {}).get("dropped_traces", 0) < 1:
+            out.append(f"{where}: tail sampler dropped nothing (it is "
+                       f"not sampling)")
+        if not r.get("exemplar_trace_captured", False):
+            out.append(f"{where}: tail-bucket exemplar's trace not "
+                       f"retrievable from the capture bundle")
+        return out
+
+    failures += _gate(rec, committed_path)
+    fresh = run_slo()["slo"]
+    failures += _gate(fresh, "live slo run")
+
+    if failures:
+        for f_ in failures:
+            print(f"CHECK FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(f"bench_serving slo check OK against {committed_path} "
+          f"(fired once + resolved, conservation error "
+          f"{fresh['cost']['error_ms']} ms, "
+          f"{fresh['sampler']['kept_traces']} trace(s) kept / "
+          f"{fresh['sampler']['dropped_traces']} dropped, "
+          f"exemplar trace captured)")
+    return 0
+
+
 # -------------------------------------------------------------------- --check
 def run_check(committed_path):
     """Deterministic regression oracles, cheap enough for the smoke tier:
@@ -644,11 +870,17 @@ def main(argv=None):
     p.add_argument("--check", metavar="BENCH_SERVING_rNN.json", default=None,
                    help="regression mode: verify the committed series file "
                         "and its deterministic invariants (fast path for "
-                        "r01-style records, chaos/recovery for r02)")
+                        "r01-style records, chaos/recovery for r02, "
+                        "SLO/cost plane for r03)")
     p.add_argument("--chaos", action="store_true",
                    help="record the chaos/recovery series (breaker trip, "
                         "failover, restart, availability under fault) "
                         "instead of the latency suite")
+    p.add_argument("--slo", action="store_true",
+                   help="record the request-cost & SLO series (burn-rate "
+                        "fire/resolve, cost-ledger conservation, tail "
+                        "sampling, exemplar retrievability) instead of "
+                        "the latency suite")
     p.add_argument("--out", default=None,
                    help="also write the JSON record here")
     args = p.parse_args(argv)
@@ -657,8 +889,15 @@ def main(argv=None):
             committed = json.load(f)
         if "chaos" in committed:
             return run_chaos_check(args.check)
+        if "slo" in committed:
+            return run_slo_check(args.check)
         return run_check(args.check)
-    record = run_chaos() if args.chaos else run_full()
+    if args.slo:
+        record = run_slo()
+    elif args.chaos:
+        record = run_chaos()
+    else:
+        record = run_full()
     line = json.dumps(record)
     print(line)
     if args.out:

@@ -69,6 +69,11 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     ap.add_argument("--alerts", default=None, metavar="RULES.json",
                     help="evaluate these alert rules against the metrics "
                          "registry in the background during training")
+    ap.add_argument("--slo", default=None, metavar="SLO.json",
+                    help="load SLO definitions (observe/slo.py schema) and "
+                         "evaluate their burn-rate rules alongside --alerts; "
+                         "under --elastic the set is surfaced at /slo on "
+                         "the --metrics-port server")
     ap.add_argument("--elastic", type=int, default=None, metavar="N",
                     help="run as an elastic multi-process job: N worker "
                          "processes supervised with automatic failure "
@@ -167,8 +172,9 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
                 f"{', '.join(unsupported)} affect(s) in-process training "
                 "and is not forwarded to --elastic workers (they train "
                 "shared_gradients at the elastic world size); drop it, or "
-                "run without --elastic. --log-json, --alerts, --trace and "
-                "--metrics-port ARE supported (they observe the fleet)")
+                "run without --elastic. --log-json, --alerts, --slo, "
+                "--trace and --metrics-port ARE supported (they observe "
+                "the fleet)")
         if mesh_axes is not None and mesh_axes.get("data", -1) != -1:
             # the elastic world is dynamic: each generation's process
             # count IS the data extent, so a pinned size is a lie the
@@ -206,24 +212,29 @@ def parallel_wrapper_main(argv: Optional[List[str]] = None):
     if args.trace:
         from deeplearning4j_tpu.observe import default_registry, enable_tracing
         tracer = enable_tracing(metrics=default_registry())
-    if args.trace or args.watchdog != "off" or args.alerts:
+    if args.trace or args.watchdog != "off" or args.alerts or args.slo:
         # one attachment path for TraceListener AND the watchdog. With
-        # --alerts the TraceListener is attached even without --trace:
-        # it is what exports the training_* series into the registry the
-        # rules evaluate (spans stay off while tracing is not enabled)
+        # --alerts/--slo the TraceListener is attached even without
+        # --trace: it is what exports the training_* series into the
+        # registry the rules evaluate (spans stay off while tracing is
+        # not enabled)
         from deeplearning4j_tpu.observe import (attach_observability,
                                                 default_registry)
         attach_observability(
             net, tracer=tracer, metrics=default_registry(),
-            trace=bool(args.trace) or bool(args.alerts),
+            trace=bool(args.trace) or bool(args.alerts) or bool(args.slo),
             watchdog=(None if args.watchdog == "off"
                       else {"action": args.watchdog}))
     alert_mgr = None
-    if args.alerts:
+    if args.alerts or args.slo:
         from deeplearning4j_tpu.observe import (AlertManager, LogSink,
-                                                default_registry, load_rules)
+                                                default_registry, load_rules,
+                                                load_slos)
+        rules = list(load_rules(args.alerts)) if args.alerts else []
+        if args.slo:
+            rules += load_slos(args.slo).rules()
         alert_mgr = AlertManager(default_registry(),
-                                 load_rules(args.alerts), [LogSink()],
+                                 rules, [LogSink()],
                                  interval_s=5.0).start()
     mesh = None
     gspmd = mesh_axes is not None and any(
@@ -327,10 +338,10 @@ def _elastic_train(args, mesh_axes=None):
     ], mesh_axes=worker_mesh or None,
         sharding_rules=args.sharding_rules)
     fleet = None
-    if args.alerts and args.metrics_port is None:
-        # --alerts observes the FLEET: the rules must see the job-wide
-        # union ({slot,host,generation}-labeled worker series), so a
-        # FleetRegistry exists whenever rules do, scrape port or not
+    if (args.alerts or args.slo) and args.metrics_port is None:
+        # --alerts/--slo observe the FLEET: the rules must see the
+        # job-wide union ({slot,host,generation}-labeled worker series),
+        # so a FleetRegistry exists whenever rules do, scrape port or not
         from deeplearning4j_tpu.observe import FleetRegistry, default_registry
         fleet = FleetRegistry(local=default_registry())
     supervisor = ElasticJobSupervisor(
@@ -342,10 +353,17 @@ def _elastic_train(args, mesh_axes=None):
         progress_timeout_s=args.progress_timeout,
         metrics_port=args.metrics_port, fleet=fleet)
     alert_mgr = None
-    if args.alerts:
-        from deeplearning4j_tpu.observe import AlertManager, LogSink, load_rules
+    if args.alerts or args.slo:
+        from deeplearning4j_tpu.observe import (AlertManager, LogSink,
+                                                load_rules, load_slos)
+        rules = list(load_rules(args.alerts)) if args.alerts else []
+        if args.slo:
+            slo_set = load_slos(args.slo)
+            rules += slo_set.rules()
+            supervisor.slo = slo_set  # surfaced at /slo on the
+            # --metrics-port server
         alert_mgr = AlertManager(
-            supervisor.fleet, load_rules(args.alerts), [LogSink()],
+            supervisor.fleet, rules, [LogSink()],
             interval_s=5.0).start()
         supervisor.alerts = alert_mgr  # surfaced at /alerts on the
         # --metrics-port server
@@ -634,6 +652,10 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
                         "background; state served at /alerts")
     p.add_argument("--alert-interval", type=float, default=15.0,
                    help="seconds between alert evaluation rounds")
+    p.add_argument("--slo", default=None, metavar="SLO.json",
+                   help="load SLO definitions (observe/slo.py schema): "
+                        "their burn-rate rules join --alerts evaluation "
+                        "and compliance is served at /slo")
     args = p.parse_args(argv)
 
     import os
@@ -652,15 +674,27 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
             enable_structured_logging(stream=sys.stderr)
         else:
             enable_structured_logging(path=args.log_json)
+    slo_set = None
+    if args.slo:
+        from deeplearning4j_tpu.observe import load_slos
+        try:
+            slo_set = load_slos(args.slo)
+        except (ValueError, OSError) as e:
+            p.error(f"--slo: {e}")
+        print(f"serving {len(slo_set.slos)} SLO(s) from {args.slo} "
+              "(compliance at /slo)")
     alert_mgr = None
-    if args.alerts:
+    if args.alerts or slo_set is not None:
         from deeplearning4j_tpu.observe import (AlertManager, LogSink,
                                                 load_rules)
+        rules = list(load_rules(args.alerts)) if args.alerts else []
+        if slo_set is not None:
+            rules += slo_set.rules()
         alert_mgr = AlertManager(default_registry(),
-                                 load_rules(args.alerts), [LogSink()],
+                                 rules, [LogSink()],
                                  interval_s=args.alert_interval).start()
         print(f"alerting on {len(alert_mgr.rules)} rule(s) from "
-              f"{args.alerts} (state at /alerts)")
+              f"{args.alerts or args.slo} (state at /alerts)")
 
     serve_mesh = None
     serve_rules = None
@@ -799,7 +833,7 @@ def serve_main(argv: Optional[List[str]] = None, block: bool = True):
         max_inflight=args.max_inflight,
         default_deadline_s=(args.deadline_ms / 1e3
                             if args.deadline_ms is not None else None),
-        alerts=alert_mgr, brownout=brownout)
+        alerts=alert_mgr, brownout=brownout, slo=slo_set)
     port = server.start()
     print(f"model server listening on {server.url} "
           f"(models: {', '.join(registry.names())}); port {port}")

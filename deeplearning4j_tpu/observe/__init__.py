@@ -38,16 +38,27 @@ Dapper-style request tracing the reference never had):
   stitching;
 - ``incident`` — the flight recorder: one bounded, schema'd
   ``incident_*`` bundle per elastic recovery decision
-  (``tools/validate_incident.py`` lints it).
+  (``tools/validate_incident.py`` lints it), plus ``capture_bundle``:
+  the ``/debug/capture?seconds=N`` on-demand mini bundle;
+- ``cost``     — the request-cost ledger: per-request device-time
+  apportionment from ``batch_execute`` spans (compile excluded),
+  conservation-checked, billed once into ``request_device_ms`` with
+  exemplars;
+- ``slo``      — declarative SLOs (latency-threshold and availability)
+  compiled into multiwindow burn-rate rules for the alert engine, with
+  a ``/slo`` compliance surface.
 """
 
 from deeplearning4j_tpu.observe.metrics import (  # noqa: F401
     Counter,
+    Exemplar,
     Gauge,
     Histogram,
     HTTPObserverMixin,
     MetricsRegistry,
     default_registry,
+    exemplar_trace_ids,
+    format_exemplar,
     instrument_http,
     parse_prometheus_text,
 )
@@ -74,9 +85,23 @@ from deeplearning4j_tpu.observe.fleet import (  # noqa: F401
     FleetRegistry,
     MetricsFileExporter,
     SpanFileWriter,
+    TailSampler,
     read_span_file,
 )
-from deeplearning4j_tpu.observe.incident import IncidentRecorder  # noqa: F401
+from deeplearning4j_tpu.observe.incident import (  # noqa: F401
+    IncidentRecorder,
+    capture_bundle,
+)
+from deeplearning4j_tpu.observe.cost import (  # noqa: F401
+    CostLedger,
+    RequestCost,
+)
+from deeplearning4j_tpu.observe.slo import (  # noqa: F401
+    SLO,
+    LatencyBurnRateRule,
+    SLOSet,
+    load_slos,
+)
 from deeplearning4j_tpu.observe.listener import TraceListener  # noqa: F401
 from deeplearning4j_tpu.observe.jaxhook import install_jax_hook  # noqa: F401
 from deeplearning4j_tpu.observe.log import (  # noqa: F401

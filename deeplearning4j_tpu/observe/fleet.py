@@ -28,6 +28,16 @@ plane that spans the JOB instead of the process:
   line carrying the process's ``EPOCH_ANCHOR`` — the clock-alignment
   rule ``observe.export.merge_chrome_traces`` uses to put every
   process's monotonic timestamps on one wall-clock timeline.
+- :class:`TailSampler` — Dapper-style tail-based sampling between the
+  recorder and any span sink: complete traces persist only when slow,
+  errored, exemplar-referenced, or alert-flagged (plus a deterministic
+  probabilistic floor), under a bounded disk budget with drop
+  accounting — always-on tracing at always-affordable cost.
+
+Federation preserves exemplars: a worker's ``# {trace_id="..."}``
+histogram annotations survive the parse → re-label → re-render cycle, so
+a p99 bucket on the SUPERVISOR's ``/metrics`` still names the worker
+trace that caused it.
 
 Everything here follows the ``enable_tracing()`` discipline: a worker
 without the supervisor's env vars, or a supervisor without a fleet
@@ -43,6 +53,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.observe.metrics import (MetricsRegistry,
                                                 _format_value, _label_str,
+                                                exemplar_trace_ids,
+                                                format_exemplar,
                                                 parse_prometheus_text)
 from deeplearning4j_tpu.observe.trace import EPOCH_ANCHOR, Span, TraceRecorder
 from deeplearning4j_tpu.util.fsio import atomic_write_text
@@ -172,6 +184,7 @@ class FleetRegistry:
             except (OSError, ValueError, AssertionError, IndexError):
                 errors += 1
                 continue
+            exemplars = getattr(sample, "exemplars", {})
             for name in sorted(sample):
                 for label_key in sorted(sample[name]):
                     if len(lines) >= self.max_series:
@@ -180,9 +193,16 @@ class FleetRegistry:
                     merged = dict(label_key)
                     merged.update(fed_labels)  # federation labels win
                     pairs = sorted(merged.items())
-                    lines.append(
-                        f"{name}{_label_str((), (), extra=pairs)} "
-                        f"{_format_value(sample[name][label_key])}")
+                    line = (f"{name}{_label_str((), (), extra=pairs)} "
+                            f"{_format_value(sample[name][label_key])}")
+                    # exemplars ride along under the SAME cardinality
+                    # bound (an annotation on a kept series, never an
+                    # extra series): the supervisor's p99 bucket keeps
+                    # naming the worker trace that caused it
+                    ex = exemplars.get((name, label_key))
+                    if ex is not None:
+                        line += " " + format_exemplar(ex)
+                    lines.append(line)
         if dropped:
             self._m_dropped.inc(dropped)
         if errors:
@@ -200,15 +220,17 @@ class FleetRegistry:
 class FleetMetricsServer:
     """Minimal observability front-end for supervisor processes: GET
     ``/metrics`` (the :class:`FleetRegistry` union, Prometheus text),
-    ``/healthz``, and ``/alerts`` when an ``AlertManager`` is attached —
-    the ModelServer's HTTP plumbing without the model surface."""
+    ``/healthz``, ``/alerts`` when an ``AlertManager`` is attached, and
+    ``/slo`` when an :class:`~.slo.SLOSet` is attached — the
+    ModelServer's HTTP plumbing without the model surface."""
 
     def __init__(self, registry, *, host: str = "127.0.0.1", port: int = 0,
-                 alerts=None):
+                 alerts=None, slo=None):
         self.registry = registry
         self.host = host
         self.port = int(port)
         self.alerts = alerts
+        self.slo = slo
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
@@ -242,6 +264,15 @@ class FleetMetricsServer:
                                      404)
                     else:
                         respond_json(self, server.alerts.describe())
+                elif path == "/slo":
+                    if server.slo is None:
+                        respond_json(self,
+                                     {"error": "no slo config attached"},
+                                     404)
+                    else:
+                        respond_json(self, server.slo.status(
+                            metrics=server.registry,
+                            alerts=server.alerts))
                 else:
                     respond_json(self, {"error": "not found"}, 404)
 
@@ -348,6 +379,234 @@ class SpanFileWriter(TraceRecorder):
             fh, self._fh = self._fh, None
             if fh is not None:
                 fh.close()
+
+
+class TailSampler(TraceRecorder):
+    """Tail-based trace sampling at the recorder/sink seam.
+
+    Sits where a :class:`SpanFileWriter` (or any ``add(span)`` sink)
+    would: install it as the tracer's recorder and every completed span
+    still lands in the in-memory ring (``super().add``) — the on-demand
+    capture window keeps working — but the SINK only receives COMPLETE
+    traces that earn their disk.  The decision runs when a trace's local
+    root ends (a span with no parent, or one whose name is a configured
+    root kind — a server whose root carries a remote ``traceparent``
+    parent names ``http_request`` in ``slow_ms``), first match wins:
+
+    ==========  ======================================================
+    keep        predicate
+    ==========  ======================================================
+    error       any span in the trace carries ``error``
+    slow        root duration >= ``slow_ms[root.name]``
+                (else ``default_slow_ms``) milliseconds
+    exemplar    the trace_id is referenced by a histogram exemplar in
+                ``exemplar_source`` (a registry or a callable → set)
+    alert       the attached ``AlertManager`` has any rule firing
+    floor       deterministic probabilistic floor:
+                ``int(trace_id[:8], 16) / 0xFFFFFFFF < probability``
+    ==========  ======================================================
+
+    Everything else drops.  Kept traces spend a bounded disk budget
+    (``max_bytes``, estimated per span) — once exhausted, even keepers
+    drop (counted separately: a full disk silently masquerading as "no
+    slow traces" would be the worst lie).  Unfinished traces buffer up
+    to ``max_pending`` before the oldest is evicted (a crashed client
+    that never closes its root must not pin memory forever).  Every
+    outcome is counted; :meth:`describe` is the accounting surface the
+    bench commits."""
+
+    def __init__(self, sink=None, *, slow_ms: Optional[Dict[str, float]]
+                 = None, default_slow_ms: float = 250.0,
+                 probability: float = 0.0,
+                 max_bytes: int = 8 * 1024 * 1024,
+                 max_pending: int = 512, capacity: int = 65536,
+                 exemplar_source=None, alerts=None, metrics=None):
+        super().__init__(capacity)
+        if not 0.0 <= float(probability) <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.sink = sink
+        self.slow_ms = dict(slow_ms or {})
+        self.default_slow_ms = float(default_slow_ms)
+        self.probability = float(probability)
+        self.max_bytes = int(max_bytes)
+        self.max_pending = int(max_pending)
+        self.exemplar_source = exemplar_source
+        self.alerts = alerts
+        self._ts_lock = threading.Lock()
+        self._pending: "Dict[str, List[Span]]" = {}
+        self._decided: "Dict[str, bool]" = {}
+        self._decided_cap = 4096
+        self.kept_traces = 0
+        self.kept_spans = 0
+        self.dropped_traces = 0
+        self.dropped_spans = 0
+        self.dropped_budget_traces = 0
+        self.dropped_pending_traces = 0
+        self.bytes_written = 0
+        self.keep_reasons: Dict[str, int] = {}
+        self._m_traces = None
+        if metrics is not None:
+            self._m_traces = metrics.counter(
+                "trace_tail_traces_total",
+                "Tail-sampling decisions by outcome",
+                ("decision",))
+
+    # ----------------------------------------------------------- recording
+    def add(self, span: Span) -> None:
+        super().add(span)          # the ring always records
+        if self.sink is None:
+            return
+        trace_id = span.trace_id
+        with self._ts_lock:
+            verdict = self._decided.get(trace_id)
+            if verdict is not None:
+                # late arrival on an already-decided trace follows it
+                if verdict:
+                    self._emit_locked([span])
+                else:
+                    self.dropped_spans += 1
+                return
+            buf = self._pending.setdefault(trace_id, [])
+            buf.append(span)
+            if not (span.parent_id is None or span.name in self.slow_ms):
+                self._evict_pending_locked()
+                return
+            spans = self._pending.pop(trace_id)
+        # the keep predicates read OTHER subsystems (registry locks,
+        # the alert manager's lock) — never under our own lock
+        keep, reason = self._decide(span, spans)
+        with self._ts_lock:
+            self._remember_locked(trace_id, keep)
+            if not keep:
+                self.dropped_traces += 1
+                self.dropped_spans += len(spans)
+            else:
+                est = sum(self._span_bytes(s) for s in spans)
+                if self.bytes_written + est > self.max_bytes:
+                    self._remember_locked(trace_id, False)
+                    self.dropped_budget_traces += 1
+                    self.dropped_traces += 1
+                    self.dropped_spans += len(spans)
+                    reason = "drop_budget"
+                    keep = False
+                else:
+                    self.kept_traces += 1
+                    self.keep_reasons[reason] = \
+                        self.keep_reasons.get(reason, 0) + 1
+                    self._emit_locked(spans)
+        if self._m_traces is not None:
+            # reason is the keep reason, "drop", or "drop_budget"
+            self._m_traces.inc(decision=reason)
+
+    # ----------------------------------------------------------- decisions
+    def _decide(self, root: Span, spans: List[Span]) -> Tuple[bool, str]:
+        if any(s.error for s in spans):
+            return True, "error"
+        end_ns = root.end_ns if root.end_ns is not None else root.start_ns
+        dur_ms = max(end_ns - root.start_ns, 0) / 1e6
+        if dur_ms >= self.slow_ms.get(root.name, self.default_slow_ms):
+            return True, "slow"
+        if root.trace_id in self._exemplar_ids():
+            return True, "exemplar"
+        if self.alerts is not None and self.alerts.firing():
+            return True, "alert"
+        if self.probability > 0.0 and self._floor_hit(root.trace_id):
+            return True, "floor"
+        return False, "drop"
+
+    def _exemplar_ids(self) -> set:
+        src = self.exemplar_source
+        if src is None:
+            return set()
+        try:
+            if callable(src):
+                return set(src())
+            return exemplar_trace_ids(src)
+        except Exception:  # noqa: BLE001 - sampling must never raise
+            return set()
+
+    def _floor_hit(self, trace_id: str) -> bool:
+        try:
+            return int(trace_id[:8], 16) / 0xFFFFFFFF < self.probability
+        except (ValueError, IndexError):
+            return False
+
+    # ------------------------------------------------------------ plumbing
+    def _remember_locked(self, trace_id: str, keep: bool) -> None:
+        self._decided[trace_id] = keep
+        while len(self._decided) > self._decided_cap:
+            self._decided.pop(next(iter(self._decided)))
+
+    def _evict_pending_locked(self) -> None:
+        while len(self._pending) > self.max_pending:
+            tid = next(iter(self._pending))
+            spans = self._pending.pop(tid)
+            self._remember_locked(tid, False)
+            self.dropped_pending_traces += 1
+            self.dropped_traces += 1
+            self.dropped_spans += len(spans)
+
+    @staticmethod
+    def _span_bytes(span: Span) -> int:
+        # the JSON-line estimate (ids + fixed fields + attrs); cheap on
+        # purpose — the budget bounds disk, it does not meter it
+        n = 160 + len(span.name) + len(span.trace_id) + len(span.span_id)
+        for k, v in (span.attrs or {}).items():
+            n += len(str(k)) + len(str(v)) + 8
+        return n
+
+    def _emit_locked(self, spans: List[Span]) -> None:
+        for s in spans:
+            self.bytes_written += self._span_bytes(s)
+            self.kept_spans += 1
+            try:
+                self.sink.add(s)
+            except Exception:  # noqa: BLE001 - a dead sink must not
+                pass           # raise into the instrumented hot path
+
+    def flush_trace(self, trace_id: str) -> bool:
+        """Force-keep one buffered trace (the on-demand capture's
+        escape hatch for a trace the policy would drop)."""
+        with self._ts_lock:
+            spans = self._pending.pop(trace_id, None)
+            if spans is None:
+                return False
+            self._remember_locked(trace_id, True)
+            self.kept_traces += 1
+            self.keep_reasons["forced"] = \
+                self.keep_reasons.get("forced", 0) + 1
+            self._emit_locked(spans)
+            return True
+
+    def describe(self) -> Dict[str, Any]:
+        with self._ts_lock:
+            return {
+                "kept_traces": self.kept_traces,
+                "kept_spans": self.kept_spans,
+                "dropped_traces": self.dropped_traces,
+                "dropped_spans": self.dropped_spans,
+                "dropped_budget_traces": self.dropped_budget_traces,
+                "dropped_pending_traces": self.dropped_pending_traces,
+                "pending_traces": len(self._pending),
+                "bytes_written": self.bytes_written,
+                "max_bytes": self.max_bytes,
+                "probability": self.probability,
+                "default_slow_ms": self.default_slow_ms,
+                "slow_ms": dict(self.slow_ms),
+                "keep_reasons": dict(self.keep_reasons),
+            }
+
+    def close(self) -> None:
+        """Drop undecided traces (they are incomplete by definition) and
+        close the sink when it can be closed."""
+        with self._ts_lock:
+            for tid, spans in list(self._pending.items()):
+                self.dropped_pending_traces += 1
+                self.dropped_traces += 1
+                self.dropped_spans += len(spans)
+            self._pending.clear()
+        if hasattr(self.sink, "close"):
+            self.sink.close()
 
 
 def read_span_file(path: str) -> Dict[str, Any]:

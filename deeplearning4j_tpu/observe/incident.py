@@ -231,3 +231,65 @@ class IncidentRecorder:
             json.dump(manifest, fh, indent=1, sort_keys=True)
         self.bundles.append(bundle)
         return bundle
+
+
+# ---------------------------------------------------------------------------
+# on-demand capture — the /debug/capture?seconds=N mini bundle
+# ---------------------------------------------------------------------------
+
+CAPTURE_KIND = "debug_capture"
+DEFAULT_MAX_COST_ENTRIES = 50  # cost-ledger slice bound
+
+
+def capture_bundle(*, seconds: float, tracer=None, metrics=None,
+                   cost=None, sampler=None,
+                   max_spans: int = DEFAULT_MAX_SPANS,
+                   max_cost_entries: int = DEFAULT_MAX_COST_ENTRIES
+                   ) -> Dict[str, Any]:
+    """Assemble the on-demand mini incident bundle: the last
+    ``seconds`` of completed spans as a Chrome trace (straight from the
+    active recorder's ring — tail-sampling never thins it), the metrics
+    exposition snapshot, a bounded cost-ledger slice, and the tail
+    sampler's accounting.  The full flight recorder answers "why did
+    recovery act"; this answers "what is this process doing RIGHT NOW"
+    without restarting anything.  Same bounds discipline
+    (``max_spans``, ``max_cost_entries``), echoed in the payload so a
+    truncated capture can never masquerade as a complete one."""
+    from deeplearning4j_tpu.observe.export import to_chrome_trace
+    from deeplearning4j_tpu.observe.trace import get_active_tracer
+    if tracer is None:
+        tracer = get_active_tracer()
+    seconds = max(float(seconds), 0.0)
+    max_spans = int(max_spans)
+
+    spans: List[Any] = []
+    total_done = 0
+    if tracer is not None:
+        import time as _time
+        cutoff_ns = _time.perf_counter_ns() - int(seconds * 1e9)
+        done = [s for s in tracer.recorder.spans()
+                if s.end_ns is not None]
+        windowed = [s for s in done if s.end_ns >= cutoff_ns]
+        total_done = len(windowed)
+        spans = windowed[-max_spans:]
+
+    bundle: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION, "kind": CAPTURE_KIND,
+        "seconds": seconds,
+        "bounds": {"max_spans": max_spans,
+                   "max_cost_entries": int(max_cost_entries),
+                   "span_count": len(spans),
+                   "spans_truncated": total_done > len(spans)},
+        "trace": to_chrome_trace(
+            spans, service=getattr(tracer, "service",
+                                   "deeplearning4j_tpu")),
+        "metrics": metrics.exposition() if metrics is not None else None,
+        "cost": None,
+        "sampler": None,
+    }
+    if cost is not None:
+        bundle["cost"] = {"recent": cost.recent(int(max_cost_entries)),
+                          "totals": cost.describe()}
+    if sampler is not None and hasattr(sampler, "describe"):
+        bundle["sampler"] = sampler.describe()
+    return bundle

@@ -15,13 +15,22 @@ Conventions follow the Prometheus client library:
   name return the SAME instrument (get-or-create), mismatched label names
   raise;
 - histograms are cumulative (every bucket counts all observations ≤ its
-  upper bound, ``+Inf`` always present) with ``_sum`` and ``_count`` series.
+  upper bound, ``+Inf`` always present) with ``_sum`` and ``_count`` series;
+- histogram observations made under an active span carry an OpenMetrics
+  **exemplar** — the bucket line grows a ``# {trace_id="..."} value ts``
+  suffix linking the latest observation that landed in that bucket to its
+  trace. The grammar is locked by round-trip tests: exemplar labels are
+  escaped exactly like series labels, ``parse_prometheus_text`` captures
+  exemplars on its ``.exemplars`` side table (the mapping contract is
+  unchanged for existing consumers), and fleet federation re-renders them
+  verbatim under relabeling.
 """
 
 from __future__ import annotations
 
 import math
 import threading
+import time
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 # latency-oriented default buckets (seconds), matching the Prometheus client
@@ -51,6 +60,51 @@ def _label_str(names: Sequence[str], values: Tuple[str, ...],
         return ""
     inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
     return "{" + inner + "}"
+
+
+class Exemplar:
+    """One OpenMetrics exemplar: a label set (``trace_id`` by convention),
+    the observed value that set it, and an optional unix timestamp."""
+
+    __slots__ = ("labels", "value", "ts")
+
+    def __init__(self, labels: Dict[str, str], value: float,
+                 ts: Optional[float] = None):
+        self.labels = {str(k): str(v) for k, v in dict(labels).items()}
+        self.value = float(value)
+        self.ts = None if ts is None else float(ts)
+
+    def __eq__(self, other):
+        return (isinstance(other, Exemplar)
+                and self.labels == other.labels
+                and self.value == other.value and self.ts == other.ts)
+
+    def __repr__(self):
+        return f"Exemplar({self.labels!r}, {self.value!r}, {self.ts!r})"
+
+
+def format_exemplar(ex: Exemplar) -> str:
+    """THE exemplar suffix grammar: ``# {k="v",...} value [timestamp]``,
+    label values escaped exactly like series labels."""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in ex.labels.items())
+    s = f"# {{{inner}}} {_format_value(ex.value)}"
+    if ex.ts is not None:
+        s += f" {_format_value(ex.ts)}"
+    return s
+
+
+# lazily bound: metrics must stay importable without pulling trace first
+_trace_ctx = None
+
+
+def _current_trace_id() -> Optional[str]:
+    global _trace_ctx
+    if _trace_ctx is None:
+        from deeplearning4j_tpu.observe import trace as _t
+        _trace_ctx = _t._current_ctx
+    cur = _trace_ctx.get()
+    return None if cur is None else cur[0]
 
 
 class _Metric:
@@ -170,17 +224,32 @@ class Histogram(_Metric):
         self.buckets = tuple(bs)
         self._counts: Dict[Tuple[str, ...], List[int]] = {}
         self._sums: Dict[Tuple[str, ...], float] = {}
+        # (series key, bucket index) -> latest Exemplar landing there
+        self._exemplars: Dict[Tuple[Tuple[str, ...], int], Exemplar] = {}
 
     def observe(self, value: float, **labels) -> None:
         key = self._key(labels)
         value = float(value)
+        # an observation made inside an active span links the bucket to
+        # its trace — the p99 bucket names a trace you can actually open
+        trace_id = _current_trace_id()
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, ub in enumerate(self.buckets):
                 if value <= ub:
                     counts[i] += 1
+                    if trace_id is not None:
+                        self._exemplars[(key, i)] = Exemplar(
+                            {"trace_id": trace_id}, value, time.time())
                     break
             self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def exemplars(self, **labels) -> Dict[float, Exemplar]:
+        """One series' exemplars keyed by bucket upper bound."""
+        key = self._key(labels)
+        with self._lock:
+            return {self.buckets[i]: ex
+                    for (k, i), ex in self._exemplars.items() if k == key}
 
     def count(self, **labels) -> int:
         key = self._key(labels)
@@ -201,13 +270,18 @@ class Histogram(_Metric):
         with self._lock:
             items = sorted((k, list(c), self._sums.get(k, 0.0))
                            for k, c in self._counts.items())
+            exemplars = dict(self._exemplars)
         for key, counts, total in items:
             cum = 0
-            for ub, c in zip(self.buckets, counts):
+            for i, (ub, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 le = _label_str(self.label_names, key,
                                 extra=[("le", _format_value(ub))])
-                lines.append(f"{self.name}_bucket{le} {cum}")
+                line = f"{self.name}_bucket{le} {cum}"
+                ex = exemplars.get((key, i))
+                if ex is not None:
+                    line += " " + format_exemplar(ex)
+                lines.append(line)
             lbl = _label_str(self.label_names, key)
             lines.append(f"{self.name}_sum{lbl} {_format_value(total)}")
             lines.append(f"{self.name}_count{lbl} {cum}")
@@ -354,43 +428,106 @@ class HTTPObserverMixin:
                     time.perf_counter() - t0)
 
 
-def parse_prometheus_text(text: str) -> Dict[str, Dict[Tuple[Tuple[str, str],
-                                                             ...], float]]:
+class ParsedExposition(dict):
+    """``parse_prometheus_text``'s result: the plain
+    ``{series: {sorted label pairs: value}}`` mapping every existing
+    consumer indexes, plus an ``exemplars`` side table keyed by
+    ``(series, sorted label pairs)`` so federation and the tail sampler
+    can round-trip exemplars without a second parse."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.exemplars: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                             Exemplar] = {}
+
+
+def _scan_labels(s: str, i: int) -> Tuple[Dict[str, str], int]:
+    """Quote-aware label-block scanner: ``s[i]`` is ``{``; returns the
+    label dict and the index just past the closing ``}``. Left-to-right
+    with escape handling, so a ``}`` (or ``#``) INSIDE a label value can
+    never truncate the block — the property the exemplar suffix (which
+    contains its own ``}``) depends on."""
+    labels: Dict[str, str] = {}
+    i += 1
+    while True:
+        while s[i] in ", ":
+            i += 1
+        if s[i] == "}":
+            return labels, i + 1
+        eq = s.index("=", i)
+        key = s[i:eq].strip()
+        assert s[eq + 1] == '"'
+        j = eq + 2
+        buf = []
+        while s[j] != '"':
+            if s[j] == "\\":
+                nxt = s[j + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+            else:
+                buf.append(s[j])
+                j += 1
+        labels[key] = "".join(buf)
+        i = j + 1
+
+
+def _parse_scalar(token: str) -> float:
+    if token == "+Inf":
+        return math.inf
+    if token == "-Inf":
+        return -math.inf
+    return float(token)
+
+
+def parse_prometheus_text(text: str) -> ParsedExposition:
     """Parse an exposition back into ``{series: {sorted label pairs: value}}``
-    — the reconciliation half of the round trip used by the tests and the
-    client's ``metrics()`` scrape. Handles escaped label values."""
-    out: Dict[str, Dict[Tuple[Tuple[str, str], ...], float]] = {}
+    — the reconciliation half of the round trip used by the tests, the
+    alert engine, fleet federation and the client's ``metrics()`` scrape.
+    Handles escaped label values; exemplar suffixes
+    (``# {trace_id="..."} v ts``) land on the result's ``.exemplars``."""
+    out = ParsedExposition()
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        if "{" in line:
-            name, rest = line.split("{", 1)
-            label_part, value_part = rest.rsplit("}", 1)
-            labels = {}
-            i = 0
-            while i < len(label_part):
-                eq = label_part.index("=", i)
-                key = label_part[i:eq].strip().lstrip(",").strip()
-                assert label_part[eq + 1] == '"'
-                j = eq + 2
-                buf = []
-                while label_part[j] != '"':
-                    if label_part[j] == "\\":
-                        nxt = label_part[j + 1]
-                        buf.append({"n": "\n", "\\": "\\", '"': '"'}
-                                   .get(nxt, nxt))
-                        j += 2
-                    else:
-                        buf.append(label_part[j])
-                        j += 1
-                labels[key] = "".join(buf)
-                i = j + 1
-            value = value_part.strip()
+        brace = line.find("{")
+        space = line.find(" ")
+        if brace != -1 and (space == -1 or brace < space):
+            name = line[:brace]
+            labels, i = _scan_labels(line, brace)
+            rest = line[i:].strip()
         else:
-            name, value = line.split(None, 1)
+            name, rest = line.split(None, 1)
             labels = {}
-        v = math.inf if value == "+Inf" else (
-            -math.inf if value == "-Inf" else float(value))
-        out.setdefault(name, {})[tuple(sorted(labels.items()))] = v
+        exemplar = None
+        hash_pos = rest.find("#")
+        if hash_pos != -1:
+            value_tok = rest[:hash_pos].strip()
+            ex_part = rest[hash_pos + 1:].strip()
+            if ex_part.startswith("{"):
+                ex_labels, k = _scan_labels(ex_part, 0)
+                tail = ex_part[k:].split()
+                if tail:
+                    exemplar = Exemplar(
+                        ex_labels, _parse_scalar(tail[0]),
+                        _parse_scalar(tail[1]) if len(tail) > 1 else None)
+        else:
+            value_tok = rest
+        key = tuple(sorted(labels.items()))
+        out.setdefault(name, {})[key] = _parse_scalar(value_tok)
+        if exemplar is not None:
+            out.exemplars[(name, key)] = exemplar
     return out
+
+
+def exemplar_trace_ids(source) -> set:
+    """Every ``trace_id`` referenced by an exemplar in ``source`` (a
+    registry — anything with ``exposition()`` — or raw exposition text).
+    Reads through the ``parse_prometheus_text`` contract, so it works on
+    local and federated registries alike; the ``TailSampler``'s
+    exemplar-referenced keep set."""
+    text = source.exposition() if hasattr(source, "exposition") \
+        else str(source)
+    parsed = parse_prometheus_text(text)
+    return {ex.labels["trace_id"] for ex in parsed.exemplars.values()
+            if "trace_id" in ex.labels}

@@ -198,6 +198,11 @@ class Tracer:
         self.service = service
         self.compile_count = 0  # xla_compile spans seen (the recompile alarm)
         self._compiles_by_thread: Dict[int, int] = {}
+        # per-thread compile+lowering SECONDS (xla_compile AND
+        # jax_lowering): the cost plane's exclusion source — a dispatcher
+        # thread's delta around a batch is exactly the compile time that
+        # batch must not bill to its requests
+        self._compile_s_by_thread: Dict[int, float] = {}
         self._compile_lock = threading.Lock()
 
     # ------------------------------------------------------------- context
@@ -281,8 +286,11 @@ class Tracer:
         now = time.perf_counter_ns()
         self.record(span_name, now - int(duration_s * 1e9), now,
                     category="compile")
+        tid = threading.get_ident()
+        with self._compile_lock:
+            self._compile_s_by_thread[tid] = \
+                self._compile_s_by_thread.get(tid, 0.0) + float(duration_s)
         if span_name == "xla_compile":
-            tid = threading.get_ident()
             with self._compile_lock:
                 self.compile_count += 1
                 self._compiles_by_thread[tid] = \
@@ -303,6 +311,16 @@ class Tracer:
         tid = thread_id if thread_id is not None else threading.get_ident()
         with self._compile_lock:
             return self._compiles_by_thread.get(tid, 0)
+
+    def thread_compile_seconds(self,
+                               thread_id: Optional[int] = None) -> float:
+        """Cumulative ``xla_compile`` + ``jax_lowering`` seconds observed
+        on one thread (default: the calling thread). The request-cost
+        plane brackets each coalesced batch with this counter so a cold
+        bucket's compile never bills to the requests that triggered it."""
+        tid = thread_id if thread_id is not None else threading.get_ident()
+        with self._compile_lock:
+            return self._compile_s_by_thread.get(tid, 0.0)
 
     # -------------------------------------------------------------- export
     def chrome_trace(self) -> dict:

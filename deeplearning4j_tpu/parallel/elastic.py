@@ -546,6 +546,9 @@ class ElasticJobSupervisor:
         #: /alerts endpoint (the CLI attaches its --alerts manager here
         #: before run())
         self.alerts = None
+        #: optional SLOSet surfaced at the metrics server's /slo
+        #: endpoint (the CLI attaches its --slo set here before run())
+        self.slo = None
         #: where workers stream crash-durable span files (set per
         #: generation only while a tracer is active in THIS process)
         self.trace_dir = os.path.join(self.ckpt_dir, "trace")
@@ -606,7 +609,8 @@ class ElasticJobSupervisor:
         if self.metrics_port is not None and self.metrics_server is None:
             from deeplearning4j_tpu.observe.fleet import FleetMetricsServer
             self.metrics_server = FleetMetricsServer(
-                self.fleet, port=self.metrics_port, alerts=self.alerts)
+                self.fleet, port=self.metrics_port, alerts=self.alerts,
+                slo=getattr(self, "slo", None))
             self.metrics_server.start()
             self._log.info("fleet metrics server up",
                            url=self.metrics_server.url())

@@ -194,7 +194,7 @@ class ParallelInference:
                  buckets: Optional[Sequence[int]] = None,
                  reuse_pad_buffer: bool = True,
                  max_restarts: int = 0, restart_backoff=None,
-                 restart_clock=time.monotonic):
+                 restart_clock=time.monotonic, cost=None):
         if mode not in ("sequential", "inplace", "batched"):
             raise ValueError(f"unknown mode {mode!r} (inplace|sequential|batched)")
         self.model = model
@@ -242,6 +242,10 @@ class ParallelInference:
         self._inflight_batch: List[_Request] = []
         self._carry: Optional[_Request] = None  # claimed, awaiting next batch
         self._metrics_name = metrics_name
+        # optional observe.cost.CostLedger: each batch_execute span's
+        # device time is apportioned row-weighted across its requests
+        # (compile time excluded — attributed to the model instead)
+        self.cost = cost
         # dispatcher supervision: restart-in-place under the elastic
         # backoff ladder. max_restarts=0 keeps the old terminal-crash
         # contract; the clock is injectable so tests drive the backoff
@@ -642,13 +646,25 @@ class ParallelInference:
                               parent=r.ctx, category="serve",
                               attrs={"model": self._metrics_name})
         # the device call runs INSIDE this span on the dispatcher thread, so
-        # a compile of a new batch bucket nests under the batch that paid
+        # a compile of a new batch bucket nests under the batch that paid.
+        # Compiles run synchronously on THIS thread, so the per-thread
+        # compile-seconds delta around the span is exactly the compile
+        # time the cost ledger must exclude from request attribution.
+        compile_s0 = tracer.thread_compile_seconds()
         with tracer.span("batch_execute", category="serve",
                          attrs={"model": self._metrics_name, "rows": n,
                                 "requests": len(batch)}) as sp:
             for r in batch:
                 sp.add_link(r.ctx)
             self._dispatch_batch(batch, n, sp)
+        if self.cost is not None and sp.end_ns is not None:
+            compile_ms = (tracer.thread_compile_seconds() - compile_s0) * 1e3
+            self.cost.record_batch(
+                self._metrics_name,
+                span_ms=(sp.end_ns - sp.start_ns) / 1e6,
+                compile_ms=compile_ms,
+                requests=[(r.ctx.trace_id if r.ctx is not None else None,
+                           int(r.x.shape[0])) for r in batch])
 
     def _assemble(self, batch: List[_Request], n: int,
                   target: int) -> np.ndarray:

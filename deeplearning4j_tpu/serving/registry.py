@@ -243,7 +243,7 @@ class ModelRegistry:
                  max_dispatcher_restarts: int = 0,
                  restart_backoff=None,
                  breaker: Optional[dict] = None,
-                 time_source=None):
+                 time_source=None, cost=None):
         """Resilience knobs (round 13): ``max_dispatcher_restarts`` lets
         each name's crashed dispatcher restart in place (0 keeps the
         terminal-crash contract); ``restart_backoff`` is an elastic
@@ -265,11 +265,15 @@ class ModelRegistry:
         self._time_source = time_source
         restart_clock = (time.monotonic if time_source is None else
                          lambda: time_source.current_time_millis() / 1e3)
+        # optional observe.cost.CostLedger shared by every dispatcher:
+        # per-request device-time attribution (the /v1/models cost block
+        # and the X-Device-Ms header read it)
+        self.cost = cost
         self._pi_kw = dict(max_batch_size=max_batch_size,
                            queue_limit=queue_limit, wait_ms=wait_ms,
                            mesh=mesh, buckets=buckets,
                            max_restarts=int(max_dispatcher_restarts),
-                           restart_clock=restart_clock)
+                           restart_clock=restart_clock, cost=cost)
         if restart_backoff is not None:
             self._pi_kw["restart_backoff"] = restart_backoff
         self._breaker_kw = dict(breaker) if breaker is not None else None
@@ -1052,6 +1056,16 @@ class ModelRegistry:
     def get(self, name: str) -> ServedModel:
         with self._lock:
             return self._get(name)
+
+    def set_cost_ledger(self, ledger) -> None:
+        """Attach (or swap) the cost ledger for every present AND future
+        dispatcher — the ModelServer wires its own ledger through here
+        when the registry was built without one."""
+        with self._lock:
+            self.cost = ledger
+            self._pi_kw["cost"] = ledger
+            for served in self._models.values():
+                served.inference.cost = ledger
 
     def has(self, name: str) -> bool:
         with self._lock:

@@ -27,8 +27,21 @@ endpoint                                        behavior
                                                 fails (dead dispatcher)
 ``GET /alerts``                                 the attached ``AlertManager``'s
                                                 rule states + firing set
+``GET /slo``                                    the attached ``SLOSet``'s
+                                                compliance + burn rates + rule
+                                                states (``observe/slo.py``)
 ``GET /metrics``                                Prometheus text exposition
+``GET /debug/capture?seconds=N``                on-demand mini bundle: last-N-
+                                                seconds spans as a Chrome trace
+                                                + metrics snapshot + cost-ledger
+                                                slice (``observe.incident
+                                                .capture_bundle`` bounds)
 ==============================================  ==================================
+
+Request cost: every dispatcher-served predict response carries
+``X-Device-Ms`` — the request's row-weighted share of its batches'
+device time (compile time excluded), billed from the shared
+``observe.cost.CostLedger`` that ``/v1/models`` also surfaces.
 
 Status mapping (the contract the tests reconcile against the metrics):
 200 served · 400 malformed · 404 unknown model/version · 429 + ``Retry-After``
@@ -124,7 +137,7 @@ class ModelServer:
                  metrics: Optional[MetricsRegistry] = None,
                  max_inflight: int = 64, retry_after_s: float = 0.05,
                  default_deadline_s: Optional[float] = None,
-                 alerts=None, brownout=None):
+                 alerts=None, brownout=None, slo=None, cost=None):
         self.registry = registry
         self.host = host
         self.port = port
@@ -133,6 +146,18 @@ class ModelServer:
         self.admission = AdmissionController(
             max_inflight, retry_after_s=retry_after_s, metrics=self.metrics)
         self.alerts = alerts  # an observe.alerts.AlertManager, or None
+        self.slo = slo        # an observe.slo.SLOSet, or None
+        # the cost ledger is always on (the X-Device-Ms / /v1/models
+        # contract): use the one given, else the registry's, else a fresh
+        # one — and make sure the registry's dispatchers feed it
+        if cost is None:
+            cost = getattr(registry, "cost", None)
+        if cost is None:
+            from deeplearning4j_tpu.observe.cost import CostLedger
+            cost = CostLedger(self.metrics)
+        self.cost = cost
+        if getattr(registry, "cost", None) is not cost:
+            registry.set_cost_ledger(cost)
         # brownout degradation: a ready BrownoutController, or a dict of
         # its kwargs (admission/alerts/metrics wired in here), or None
         if isinstance(brownout, dict):
@@ -226,14 +251,39 @@ class ModelServer:
                                    404)
                     else:
                         self._json(server.alerts.describe())
+                elif path == "/slo":
+                    if server.slo is None:
+                        self._json({"error": "no slo config attached"}, 404)
+                    else:
+                        self._json(server.slo.status(
+                            metrics=server.metrics, alerts=server.alerts))
                 elif path == "/readyz":
                     ready, body = server.readiness_detail()
                     self._json(body, 200 if ready else 503)
                 elif path == "/metrics":
                     self._respond(200, server.metrics.exposition().encode(),
                                   "text/plain; version=0.0.4")
+                elif path == "/debug/capture":
+                    try:
+                        seconds = float(parse_qs(parsed.query).get(
+                            "seconds", ["60"])[0])
+                    except (TypeError, ValueError):
+                        self._json({"error": "seconds must be a number"},
+                                   400)
+                        return
+                    from deeplearning4j_tpu.observe.incident import \
+                        capture_bundle
+                    tracer = _trace.get_active_tracer()
+                    sampler = (tracer.recorder if tracer is not None
+                               and hasattr(tracer.recorder, "describe")
+                               else None)
+                    self._json(capture_bundle(
+                        seconds=seconds, tracer=tracer,
+                        metrics=server.metrics, cost=server.cost,
+                        sampler=sampler))
                 elif path == "/v1/models":
-                    self._json({"models": server.registry.list_models()})
+                    self._json({"models": server.registry.list_models(),
+                                "cost": server.cost.describe()})
                 elif path.startswith("/v1/models/"):
                     name = path[len("/v1/models/"):]
                     try:
@@ -514,6 +564,17 @@ class ModelServer:
                         and v != self.registry.get(name).current_version:
                     degraded = "breaker"
             extra = (("X-Degraded", degraded),) if degraded else ()
+            # bill the request's device-time share HERE, where the
+            # priority header is known; dispatcher-served requests get
+            # the X-Device-Ms header, synchronous paths (pinned version,
+            # canary, degraded) have no ledger entry and no header
+            if self.cost is not None:
+                trace_id, _ = _trace.current_span_ids()
+                device_ms = self.cost.bill(
+                    trace_id, model=name,
+                    priority=str(self._priority(handler)))
+                if device_ms is not None:
+                    extra += (("X-Device-Ms", f"{device_ms:.6f}"),)
             if binary:
                 handler._respond(200, serialize_array(out),
                                  BINARY_CONTENT_TYPE,

@@ -1,0 +1,237 @@
+"""Request cost & SLOs: the economics plane closed end to end.
+
+The fourth observability pillar (``observe/cost.py``, ``observe/slo.py``,
+tail sampling in ``observe/fleet.py``, ``capture_bundle`` in
+``observe/incident.py``) on top of the spans/metrics/alerts from
+examples 25-26 — *who pays, is the promise kept, and can you open the
+trace that broke it*:
+
+- a cost-metered model server under chaos traffic (one ``slow_forward``
+  fault): every dispatcher-served response carries ``X-Device-Ms`` — its
+  row-weighted share of the coalesced batches' device time — and the
+  ledger's conservation invariant (attributed + unattributed == total)
+  holds exactly;
+- a declarative latency SLO (``observe/slo.py`` schema, the same file
+  format ``serve --slo`` loads) whose threshold sits below the lowest
+  histogram bucket, so every request is a deterministic violation: the
+  auto-generated multiwindow burn-rate rule FIRES exactly once on an
+  injected ``ManualTimeSource`` clock and RESOLVES once traffic stops —
+  no wall-clock windows, no sleeps in the control path;
+- the slow request's trace id shows up as the tail-bucket **exemplar**
+  on ``serving_request_latency_seconds`` (OpenMetrics
+  ``# {trace_id="…"}`` annotation), and ``/debug/capture?seconds=N``
+  returns its complete trace — client span → http_request →
+  inference_request/queue_wait — which validates clean under
+  ``tools/validate_trace.py``;
+- a :class:`TailSampler` installed as the tracer's recorder keeps the
+  slow/error traces on disk and drops the boring ones, with every
+  outcome counted;
+- the shipped ``examples/slo_config.json`` passes
+  ``tools/validate_slo_config.py``.
+
+Run: python examples/28_cost_slo_and_sampling.py   (CPU-friendly, <1 min)
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.observe import (AlertManager, CallbackSink, LogSink,
+                                        MetricsRegistry, TailSampler, Tracer,
+                                        SpanFileWriter, disable_tracing,
+                                        enable_tracing, load_slos,
+                                        parse_prometheus_text,
+                                        read_span_file)
+from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+from deeplearning4j_tpu.serving import ModelRegistry, ModelServer
+from deeplearning4j_tpu.serving.client import ModelServingClient
+from deeplearning4j_tpu.util import faultinject
+from urllib.request import Request, urlopen
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+SLO_CONFIG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "slo_config.json")
+
+# every request violates this (threshold below the lowest latency bucket)
+# — the deterministic burn knob: no wall-clock sleeps needed to blow the
+# error budget, the bucket math does it
+SLOS = {"slos": [{
+    "name": "econ-latency", "sli": "latency",
+    "metric": "serving_request_latency_seconds",
+    "labels": {"model": "econ"},
+    "threshold_ms": 0.001, "objective": 0.99,
+    "windows": [{"long_s": 3600, "short_s": 10, "factor": 2.0}],
+    "severity": "page"}]}
+
+
+class TinyModel:
+    """Microseconds per batch — the slow_forward fault IS the latency."""
+
+    def output(self, x):
+        x = np.asarray(x)
+        return x.sum(axis=tuple(range(1, x.ndim)), keepdims=True)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="example28_")
+    metrics = MetricsRegistry()
+    span_path = os.path.join(tmp, "kept_spans.jsonl")
+
+    # tail sampling at the recorder/sink seam: the ring records EVERY
+    # span (the capture window below needs that), the file only earns
+    # complete traces that are slow (>=100 ms at their root) or errored
+    sampler = TailSampler(SpanFileWriter(span_path, label="example28"),
+                          slow_ms={"client_predict": 100.0},
+                          default_slow_ms=100.0, metrics=metrics)
+    enable_tracing(Tracer(sampler), metrics=metrics)
+
+    slo_set = load_slos(SLOS)
+    clock = ManualTimeSource(0)
+    notes = []
+    mgr = AlertManager(metrics, slo_set.rules(),
+                       [LogSink(), CallbackSink(notes.append)],
+                       time_source=clock)
+
+    registry = ModelRegistry(metrics=metrics, wait_ms=1.0)
+    registry.register("econ", model=TinyModel())
+    server = ModelServer(registry, metrics=metrics, alerts=mgr, slo=slo_set)
+    port = server.start()
+    url = f"http://127.0.0.1:{port}"
+
+    print("=== 1. chaos traffic through a cost-metered, tail-sampled "
+          "server ===")
+    # the 4th dispatched forward of 'econ' blocks 250 ms — a latency
+    # spike the sampler must keep and the tail bucket must exemplify
+    faultinject.set_plan(faultinject.FaultPlan.parse({"faults": [
+        {"type": "slow_forward", "model": "econ", "step": 3,
+         "duration_s": 0.25}]}))
+    client = ModelServingClient(url)
+    mgr.evaluate_once()  # baseline sample at t=0
+
+    trace_ids = []
+    for _ in range(6):
+        out = client.predict("econ", [[1.0, 2.0, 3.0, 4.0]])
+        assert np.asarray(out).shape == (1, 1)
+        trace_ids.append(client.last_trace_id)
+    slow_tid = trace_ids[3]
+    assert slow_tid is not None and len(set(trace_ids)) == 6
+
+    # X-Device-Ms: the per-request bill, echoed on the wire. Billing is
+    # keyed by trace id through the dispatcher, so it rides any plain
+    # HTTP request too (the header lands once the batch is ledgered)
+    body = json.dumps({"inputs": [[1.0, 2.0, 3.0, 4.0]]}).encode()
+    device_hdr = None
+    for _ in range(5):
+        with urlopen(Request(f"{url}/v1/models/econ/predict", body),
+                     timeout=10) as r:
+            device_hdr = r.headers.get("X-Device-Ms")
+        if device_hdr is not None:
+            break
+    assert device_hdr is not None, "no X-Device-Ms header on any response"
+    print(f"slow trace {slow_tid[:8]}…; X-Device-Ms={device_hdr}")
+
+    slow_ms = server.cost.device_ms(slow_tid)
+    assert slow_ms is not None and slow_ms >= 200.0, slow_ms
+    cons = server.cost.conservation("econ")
+    assert cons["ok"], cons
+    print(f"ledger: slow request billed {slow_ms:.1f} device-ms; "
+          f"conservation error {cons['error_ms']:.9f} ms over "
+          f"{cons['batches']} batch(es)\n")
+
+    print("=== 2. the SLO's burn-rate rule fires once and resolves ===")
+    clock.advance(seconds=5)
+    fired = mgr.evaluate_once()
+    assert any(n.rule == "slo_burn:econ-latency" and n.state == "firing"
+               for n in fired), mgr.describe()
+    status = json.load(urlopen(f"{url}/slo", timeout=5))
+    entry = status["slos"][0]
+    assert entry["alert"]["state"] == "firing"
+    assert entry["compliance"]["met"] is False
+    assert entry["burn"][0]["active"] is True
+    print(f"/slo: compliance ratio={entry['compliance']['ratio']:.3f} "
+          f"(objective {entry['objective']}), "
+          f"burn long={entry['burn'][0]['long']:.1f}x budget, "
+          f"alert={entry['alert']['state']}")
+
+    # recovery is traffic silence: the short window's delta drains to 0
+    clock.advance(seconds=400)
+    resolved = mgr.evaluate_once()
+    assert any(n.rule == "slo_burn:econ-latency" and n.state == "resolved"
+               for n in resolved), mgr.describe()
+    burn_notes = [n for n in notes if n.rule == "slo_burn:econ-latency"]
+    assert [n.state for n in burn_notes] == ["firing", "resolved"], \
+        [n.state for n in burn_notes]
+    print("resolved; sink saw exactly one firing + one resolved "
+          "notification\n")
+
+    print("=== 3. tail-bucket exemplar -> /debug/capture -> valid "
+          "trace ===")
+    parsed = parse_prometheus_text(metrics.exposition())
+    tail_le, tail_exemplar = -1.0, None
+    for (series, labels), ex in parsed.exemplars.items():
+        ld = dict(labels)
+        if series != "serving_request_latency_seconds_bucket" \
+                or ld.get("model") != "econ":
+            continue
+        le = float(ld["le"])
+        if le != float("inf") and le > tail_le:
+            tail_le, tail_exemplar = le, ex
+    assert tail_exemplar is not None, "no latency exemplars exposed"
+    ex_tid = tail_exemplar.labels.get("trace_id")
+    assert ex_tid == slow_tid, (ex_tid, slow_tid)
+    print(f"le={tail_le} bucket exemplar names the slow trace "
+          f"{ex_tid[:8]}… (value {tail_exemplar.value:.3f}s)")
+
+    bundle = json.load(urlopen(f"{url}/debug/capture?seconds=60",
+                               timeout=10))
+    events = bundle["trace"]["traceEvents"]
+    names = {e["name"] for e in events
+             if e.get("args", {}).get("trace_id") == slow_tid}
+    assert {"client_predict", "http_request", "inference_request",
+            "queue_wait"} <= names, names
+    assert any(e["name"] == "batch_execute" for e in events)
+    assert bundle["cost"]["totals"]["conservation"]["ok"]
+    assert bundle["sampler"] is not None  # the sampler self-identifies
+    trace_path = os.path.join(tmp, "capture_trace.json")
+    with open(trace_path, "w") as fh:
+        json.dump(bundle["trace"], fh)
+    sys.path.insert(0, TOOLS)
+    from validate_trace import validate_file as validate_trace_file
+    errors = validate_trace_file(trace_path)
+    assert not errors, errors
+    print(f"capture: {bundle['bounds']['span_count']} span(s), slow trace "
+          f"complete ({sorted(names)}), chrome trace validates clean\n")
+
+    print("=== 4. sampler accounting + shipped config lint ===")
+    faultinject.set_plan(None)
+    server.stop(drain=True, shutdown_registry=True)
+    disable_tracing()
+    sampler.close()
+
+    acct = sampler.describe()
+    assert acct["kept_traces"] >= 1, acct
+    assert acct["dropped_traces"] >= 1, acct       # fast traces drop
+    assert acct["keep_reasons"].get("slow", 0) >= 1, acct
+    kept = read_span_file(span_path)
+    kept_ids = {s["trace"] for s in kept["spans"]}
+    assert slow_tid in kept_ids, "slow trace never reached the sink"
+    fast_kept = kept_ids & set(trace_ids[:3])
+    assert not fast_kept, f"fast traces leaked to disk: {fast_kept}"
+    print(f"sampler: kept {acct['kept_traces']} trace(s) "
+          f"({acct['keep_reasons']}), dropped {acct['dropped_traces']}; "
+          f"{len(kept['spans'])} span(s) on disk, slow trace among them")
+
+    from validate_slo_config import validate_file as validate_slo_file
+    errors = validate_slo_file(SLO_CONFIG)
+    assert not errors, errors
+    print(f"OK {os.path.basename(SLO_CONFIG)}: "
+          f"{len(load_slos(SLO_CONFIG).slos)} slo(s) valid")
+    print("example 28 complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,668 @@
+"""Request-cost & SLO plane tests (`observe/cost.py`, `observe/slo.py`,
+tail sampling in `observe/fleet.py`, the serving surfaces that expose
+them): the OpenMetrics exemplar grammar round-trips (nasty label values,
++Inf buckets, federation relabeling), the cost ledger's row-weighted
+apportionment conserves with compile time excluded (re-proven end to end
+against a REAL cold-bucket XLA compile with `tracer.compile_count` as
+the oracle), declarative SLOs compile into burn-rate rules that fire
+exactly once and resolve on an injectable clock, the tail sampler's
+keep/drop decision table is exercised with explicit-ns spans (no
+sleeps), and the live `ModelServer` serves `/slo`, `/debug/capture` and
+the `X-Device-Ms` header. The smoke tier re-proves the committed
+BENCH_SERVING_r03 record's invariants on every CI run.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe import (AlertManager, CallbackSink,
+                                        CostLedger, Exemplar, FleetRegistry,
+                                        MetricsRegistry, TailSampler, Tracer,
+                                        disable_tracing, enable_tracing,
+                                        exemplar_trace_ids, format_exemplar,
+                                        load_slos, parse_prometheus_text)
+from deeplearning4j_tpu.observe.slo import latency_counts
+from deeplearning4j_tpu.observe.trace import Span
+from deeplearning4j_tpu.parallel.time_source import ManualTimeSource
+from deeplearning4j_tpu.serving import (ModelRegistry, ModelServer,
+                                        ModelServingClient)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _SumModel:
+    """Numpy-only forward: serving-path tests without XLA in the way."""
+
+    def output(self, x):
+        x = np.asarray(x)
+        return x.sum(axis=tuple(range(1, x.ndim)), keepdims=True)
+
+
+# ----------------------------------------------------------------- exemplars
+class TestExemplarGrammar:
+    def test_observation_in_span_exposes_bucket_exemplar(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", ("model",), buckets=[0.1, 1.0])
+        t = Tracer()
+        with t.span("req") as sp:
+            h.observe(0.5, model="a")
+        text = m.exposition()
+        assert "# {" in text and sp.trace_id in text
+        parsed = parse_prometheus_text(text)
+        ex = parsed.exemplars[("lat_bucket", (("le", "1"), ("model", "a")))]
+        assert ex.labels["trace_id"] == sp.trace_id
+        assert ex.value == pytest.approx(0.5)
+        assert exemplar_trace_ids(m) == {sp.trace_id}
+        # the exemplar annotates the bucket the observation FELL INTO,
+        # not every cumulative bucket above it
+        assert ("lat_bucket", (("le", "+Inf"), ("model", "a"))) \
+            not in parsed.exemplars
+
+    def test_observation_outside_any_span_has_no_exemplar(self):
+        m = MetricsRegistry()
+        m.histogram("lat", "latency").observe(0.5)
+        assert "# {" not in m.exposition()
+        assert exemplar_trace_ids(m) == set()
+
+    def test_exemplar_lands_on_inf_bucket_for_tail_observations(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", buckets=[0.1])
+        t = Tracer()
+        with t.span("slowest") as sp:
+            h.observe(9.0)
+        parsed = parse_prometheus_text(m.exposition())
+        ex = parsed.exemplars[("lat_bucket", (("le", "+Inf"),))]
+        assert ex.labels["trace_id"] == sp.trace_id
+        assert exemplar_trace_ids(m.exposition()) == {sp.trace_id}
+
+    def test_last_write_wins_per_bucket(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", buckets=[1.0])
+        t = Tracer()
+        with t.span("a"):
+            h.observe(0.2)
+        with t.span("b") as sp_b:
+            h.observe(0.3)
+        assert h.exemplars()[1.0].labels["trace_id"] == sp_b.trace_id
+        assert h.count() == 2  # the counts are untouched by exemplars
+
+    def test_grammar_round_trips_escaped_label_values(self):
+        # the suffix grammar must survive the same hostile values the
+        # base exposition's escaping tests use
+        for weird in ['a"b\\c\nd', '\\n-literal', '{brace}', 'a=b,c',
+                      'trailing\\']:
+            ex = Exemplar({"trace_id": weird}, 1.5, 12.25)
+            line = 'h_bucket{le="1"} 3 ' + format_exemplar(ex)
+            assert "\n" not in line  # one line per series, always
+            parsed = parse_prometheus_text(line + "\n")
+            assert parsed["h_bucket"][(("le", "1"),)] == 3
+            got = parsed.exemplars[("h_bucket", (("le", "1"),))]
+            assert got.labels["trace_id"] == weird
+            assert got.value == pytest.approx(1.5)
+            assert got.ts == pytest.approx(12.25)
+
+    def test_federation_preserves_worker_exemplars(self, tmp_path):
+        worker = MetricsRegistry()
+        h = worker.histogram("serving_request_latency_seconds", "lat",
+                             ("model",), buckets=[0.1, 1.0])
+        t = Tracer()
+        with t.span("worker_req") as sp:
+            h.observe(0.5, model="m")
+        snap = tmp_path / "w0.prom"
+        snap.write_text(worker.exposition(), encoding="utf-8")
+
+        fleet = FleetRegistry()
+        fleet.set_source(0, str(snap), {"slot": "0", "host": "h0",
+                                        "generation": "1"})
+        text = fleet.exposition()
+        # the relabeled bucket series still carries the annotation
+        assert sp.trace_id in text
+        assert exemplar_trace_ids(text) >= {sp.trace_id}
+        parsed = parse_prometheus_text(text)
+        keys = [k for k in parsed.exemplars
+                if k[0] == "serving_request_latency_seconds_bucket"]
+        assert keys, "federated bucket lost its exemplar"
+        labels = dict(keys[0][1])
+        assert labels["slot"] == "0" and labels["model"] == "m"
+
+
+# --------------------------------------------------------------- cost ledger
+class TestCostLedger:
+    def test_row_weighted_apportionment_conserves(self):
+        led = CostLedger()
+        led.record_batch("m", span_ms=8.0,
+                         requests=[("a", 6), ("b", 2)])
+        assert led.device_ms("a") == pytest.approx(6.0)
+        assert led.device_ms("b") == pytest.approx(2.0)
+        cons = led.conservation("m")
+        assert cons["ok"] and cons["error_ms"] == pytest.approx(0.0)
+        assert cons["requests"] == 2 and cons["batches"] == 1
+
+    def test_traceless_rows_land_unattributed(self):
+        led = CostLedger()
+        led.record_batch("m", span_ms=8.0,
+                         requests=[("a", 3), (None, 1)])
+        assert led.device_ms("a") == pytest.approx(6.0)
+        t = led.totals("m")
+        assert t["unattributed_device_ms"] == pytest.approx(2.0)
+        assert led.conservation("m")["ok"]
+
+    def test_compile_ms_excluded_and_attributed_to_model(self):
+        m = MetricsRegistry()
+        led = CostLedger(m)
+        led.record_batch("m", span_ms=10.0, compile_ms=4.0,
+                         requests=[("a", 1)])
+        # the request pays the steady-state remainder, never the compile
+        assert led.device_ms("a") == pytest.approx(6.0)
+        t = led.totals("m")
+        assert t["compile_ms"] == pytest.approx(4.0)
+        assert t["device_ms"] == pytest.approx(6.0)
+        assert m.get("request_compile_device_ms_total").value(
+            model="m") == pytest.approx(4.0)
+        assert led.conservation("m")["ok"]
+
+    def test_compile_ms_clamped_to_span(self):
+        led = CostLedger()
+        led.record_batch("m", span_ms=3.0, compile_ms=30.0,
+                         requests=[("a", 1)])
+        assert led.device_ms("a") == pytest.approx(0.0)
+        assert led.totals("m")["compile_ms"] == pytest.approx(3.0)
+        assert led.conservation("m")["ok"]
+
+    def test_bill_observes_once(self):
+        m = MetricsRegistry()
+        led = CostLedger(m)
+        led.record_batch("m", span_ms=4.0, requests=[("a", 1)])
+        assert led.bill("a", model="m") == pytest.approx(4.0)
+        assert led.bill("a", model="m") == pytest.approx(4.0)
+        hist = m.get("request_device_ms")
+        assert hist.count(model="m", priority="1") == 1
+        assert led.bill(None, model="m") is None
+        assert led.bill("unknown", model="m") is None
+
+    def test_retried_request_accumulates_across_batches(self):
+        led = CostLedger()
+        led.record_batch("m", span_ms=4.0, requests=[("a", 1)])
+        led.record_batch("m", span_ms=6.0, requests=[("a", 1)])
+        rc = led.recent(1)[0]
+        assert rc["device_ms"] == pytest.approx(10.0)
+        assert rc["batches"] == 2
+        assert led.conservation("m")["ok"]
+
+    def test_capacity_eviction_keeps_conservation(self):
+        led = CostLedger(capacity=4)
+        for i in range(6):
+            led.record_batch("m", span_ms=1.0, requests=[(f"t{i}", 1)])
+        assert led.evicted == 2
+        assert led.device_ms("t0") is None  # oldest evicted
+        assert led.device_ms("t5") == pytest.approx(1.0)
+        # eviction forgets the per-request entry, not the totals
+        cons = led.conservation("m")
+        assert cons["ok"] and cons["attributed_device_ms"] == \
+            pytest.approx(6.0)
+        d = led.describe()
+        assert d["tracked_requests"] == 4 and d["evicted_requests"] == 2
+        assert d["conservation"]["ok"]
+
+    def test_zero_row_batch_still_conserves(self):
+        led = CostLedger()
+        led.record_batch("m", span_ms=5.0, requests=())
+        t = led.totals("m")
+        assert t["unattributed_device_ms"] == pytest.approx(5.0)
+        assert led.conservation("m")["ok"]
+
+
+class TestCompileExclusionEndToEnd:
+    def test_cold_bucket_compile_never_bills_the_request(self):
+        """A real XLA compile inside `batch_execute` (cold bucket, no
+        warmup) lands in the model's compile bucket — with
+        `tracer.compile_count` as the independent oracle — and the
+        triggering request's bill stays steady-state small."""
+        from tests.test_serving import small_net
+
+        m = MetricsRegistry()
+        tracer = enable_tracing(Tracer(), metrics=m)
+        registry = ModelRegistry(metrics=m, warmup="off")
+        registry.register("cold", small_net(seed=5))
+        server = ModelServer(registry, metrics=m)
+        server.start()
+        client = ModelServingClient(server.url)
+        try:
+            c0 = tracer.compile_count
+            out = client.predict("cold", np.ones((1, 12), np.float32))
+            assert np.asarray(out).shape == (1, 4)
+            # the ledger entry lands just after the batch span closes on
+            # the dispatcher thread; the oracle (compile_count) is
+            # already final once the response is back
+            deadline = time.time() + 10.0
+            while server.cost.totals("cold")["batches"] < 1:
+                assert time.time() < deadline, "batch never ledgered"
+                time.sleep(0.005)
+            assert tracer.compile_count > c0, \
+                "cold-bucket predict did not compile; oracle broken"
+            t = server.cost.totals("cold")
+            assert t["compile_ms"] > 0.0, \
+                "real compile not excluded from the batch span"
+            assert m.get("request_compile_device_ms_total").value(
+                model="cold") == pytest.approx(t["compile_ms"])
+            billed = server.cost.device_ms(client.last_trace_id)
+            assert billed is not None and billed < t["compile_ms"], \
+                (billed, t["compile_ms"])
+            assert server.cost.conservation("cold")["ok"]
+
+            # warm path: same shape again must not grow the compile side
+            c1, comp1 = tracer.compile_count, t["compile_ms"]
+            client.predict("cold", np.ones((1, 12), np.float32))
+            deadline = time.time() + 10.0
+            while server.cost.totals("cold")["batches"] < 2:
+                assert time.time() < deadline, "batch never ledgered"
+                time.sleep(0.005)
+            assert tracer.compile_count == c1
+            assert server.cost.totals("cold")["compile_ms"] == \
+                pytest.approx(comp1)
+        finally:
+            client.close()
+            server.stop(drain=False)
+            registry.shutdown()
+            disable_tracing()
+
+
+# ---------------------------------------------------------------------- SLOs
+class TestSLOMath:
+    def test_latency_counts_judges_against_bucket_bounds(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", buckets=[0.1, 1.0])
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v)
+        sample = parse_prometheus_text(m.exposition())
+        assert latency_counts(sample, "lat", 0.1) == (1.0, 3.0)
+        assert latency_counts(sample, "lat", 1.0) == (2.0, 3.0)
+        # sub-bucket threshold: every event a violation, deliberately
+        assert latency_counts(sample, "lat", 0.001) == (0.0, 3.0)
+        assert latency_counts(sample, "absent", 0.1) is None
+
+    def test_latency_label_subset_matching_sums_series(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency", ("model", "route"),
+                        buckets=[0.1])
+        h.observe(0.05, model="a", route="x")
+        h.observe(0.05, model="a", route="y")
+        h.observe(0.05, model="b", route="x")
+        sample = parse_prometheus_text(m.exposition())
+        assert latency_counts(sample, "lat", 0.1,
+                              {"model": "a"}) == (2.0, 2.0)
+
+    def test_availability_compliance_from_error_labels(self):
+        slo = load_slos({"slos": [{
+            "name": "avail", "sli": "availability",
+            "metric": "reqs_total", "error_labels": {"status": "500"},
+            "objective": 0.9}]}).slos[0]
+        m = MetricsRegistry()
+        c = m.counter("reqs_total", "r", ("status",))
+        c.inc(19, status="200")
+        c.inc(1, status="500")
+        comp = slo.compliance(parse_prometheus_text(m.exposition()))
+        assert comp == {"good": 19.0, "total": 20.0, "ratio": 0.95,
+                        "met": True}
+
+    def test_load_slos_schema_errors_name_the_entry(self):
+        cases = [
+            ({"slos": [{"name": "x", "sli": "wat", "metric": "m"}]},
+             "unknown sli"),
+            ({"slos": [{"name": "x", "sli": "latency", "metric": "m",
+                        "threshold_ms": 1, "objective": 1.5}]},
+             "objective"),
+            ({"slos": [{"name": "x", "sli": "latency", "metric": "m"}]},
+             "threshold_ms"),
+            ({"slos": [{"name": "x", "sli": "availability",
+                        "metric": "m"}]}, "error_labels"),
+            ({"slos": [{"name": "x", "sli": "latency", "metric": "m",
+                        "threshold_ms": 1, "windows": []}]}, "windows"),
+            ({"slos": [{"name": "x", "sli": "latency", "metric": "m",
+                        "threshold_ms": 1,
+                        "windows": [{"long_s": 60}]}]}, "long_s"),
+            ({"slos": [{"sli": "latency", "metric": "m",
+                        "threshold_ms": 1}]}, "name"),
+            ({"slos": ["nope"]}, "not an object"),
+            ({"nope": []}, "slos"),
+        ]
+        for spec, needle in cases:
+            with pytest.raises(ValueError, match=needle):
+                load_slos(spec)
+        dup = {"name": "x", "sli": "latency", "metric": "m",
+               "threshold_ms": 1}
+        with pytest.raises(ValueError, match="duplicate"):
+            load_slos({"slos": [dup, dict(dup)]})
+
+    def test_burn_rule_fires_once_and_resolves_on_manual_clock(self):
+        m = MetricsRegistry()
+        h = m.histogram("serving_request_latency_seconds", "lat",
+                        ("model",))
+        slo_set = load_slos({"slos": [{
+            "name": "lat", "sli": "latency",
+            "metric": "serving_request_latency_seconds",
+            "labels": {"model": "m"},
+            "threshold_ms": 0.001, "objective": 0.99,
+            "windows": [{"long_s": 3600, "short_s": 10, "factor": 2.0}]}]})
+        clock = ManualTimeSource(0)
+        notes = []
+        mgr = AlertManager(m, slo_set.rules(), [CallbackSink(notes.append)],
+                           time_source=clock)
+        mgr.evaluate_once()                      # baseline, nothing yet
+        for _ in range(20):                      # 20 violations
+            h.observe(0.05, model="m")
+        clock.advance(seconds=5)
+        fired = mgr.evaluate_once()
+        assert [n.state for n in fired] == ["firing"]
+        status = slo_set.status(metrics=m, alerts=mgr)
+        entry = status["slos"][0]
+        assert entry["alert"]["state"] == "firing"
+        assert entry["compliance"]["met"] is False
+        b = entry["burn"][0]
+        assert b["active"] and b["long"] == pytest.approx(100.0)
+        # recovery is traffic silence: the short window drains to zero
+        clock.advance(seconds=400)
+        resolved = mgr.evaluate_once()
+        assert [n.state for n in resolved] == ["resolved"]
+        clock.advance(seconds=60)
+        assert mgr.evaluate_once() == []         # deduped: no flapping
+        assert [n.state for n in notes] == ["firing", "resolved"]
+
+    def test_status_without_manager_reports_unmanaged(self):
+        m = MetricsRegistry()
+        m.histogram("serving_request_latency_seconds", "lat",
+                    ("model",)).observe(0.01, model="m")
+        slo_set = load_slos({"slos": [{
+            "name": "lat", "sli": "latency",
+            "metric": "serving_request_latency_seconds",
+            "labels": {"model": "m"}, "threshold_ms": 250}]})
+        entry = slo_set.status(metrics=m)["slos"][0]
+        assert entry["alert"] == {"rule": "slo_burn:lat",
+                                  "state": "unmanaged"}
+        assert entry["compliance"]["met"] is True
+        # one scrape has no deltas: burn is zero, never None-crashes
+        assert all(b["long"] == 0.0 for b in entry["burn"])
+
+
+# -------------------------------------------------------------- tail sampler
+def _span(name, trace, *, span_id="s", parent=None, start_ns=0,
+          dur_ms=1.0, error=None):
+    sp = Span(name, trace_id=trace, span_id=span_id, parent_id=parent,
+              start_ns=start_ns)
+    sp.end_ns = start_ns + int(dur_ms * 1e6)
+    sp.error = error
+    return sp
+
+
+class _ListSink:
+    def __init__(self):
+        self.spans = []
+
+    def add(self, span):
+        self.spans.append(span)
+
+
+class TestTailSampler:
+    def test_slow_root_kept_fast_dropped_complete_traces(self):
+        m = MetricsRegistry()
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=100.0, metrics=m)
+        # fast trace: child buffered, root decides → drop both spans
+        ts.add(_span("work", "fast", parent="r", dur_ms=1.0))
+        ts.add(_span("root", "fast", dur_ms=5.0))
+        # slow trace: kept as a COMPLETE trace, child included
+        ts.add(_span("work", "slow", parent="r", dur_ms=90.0))
+        ts.add(_span("root", "slow", dur_ms=150.0))
+        assert {s.trace_id for s in sink.spans} == {"slow"}
+        assert len(sink.spans) == 2
+        d = ts.describe()
+        assert d["kept_traces"] == 1 and d["kept_spans"] == 2
+        assert d["dropped_traces"] == 1 and d["dropped_spans"] == 2
+        assert d["keep_reasons"] == {"slow": 1}
+        dec = m.get("trace_tail_traces_total")
+        assert dec.value(decision="slow") == 1
+        assert dec.value(decision="drop") == 1
+
+    def test_error_beats_slow_in_keep_order(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=1.0)
+        ts.add(_span("work", "t", parent="r", dur_ms=0.1, error="boom"))
+        ts.add(_span("root", "t", dur_ms=500.0))
+        assert ts.describe()["keep_reasons"] == {"error": 1}
+
+    def test_named_root_kind_decides_with_own_threshold(self):
+        # a server root with a remote traceparent HAS a parent; naming it
+        # in slow_ms makes it the decision point
+        sink = _ListSink()
+        ts = TailSampler(sink, slow_ms={"http_request": 50.0},
+                         default_slow_ms=10_000.0)
+        ts.add(_span("http_request", "t", parent="remote", dur_ms=60.0))
+        assert ts.describe()["keep_reasons"] == {"slow": 1}
+
+    def test_exemplar_referenced_trace_kept(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=10_000.0,
+                         exemplar_source=lambda: {"hot"})
+        ts.add(_span("root", "hot", dur_ms=1.0))
+        ts.add(_span("root", "cold", span_id="s2", dur_ms=1.0))
+        assert ts.describe()["keep_reasons"] == {"exemplar": 1}
+        assert {s.trace_id for s in sink.spans} == {"hot"}
+
+    def test_exemplar_source_as_registry(self):
+        m = MetricsRegistry()
+        h = m.histogram("lat", "latency")
+        t = Tracer()
+        with t.span("req") as sp:
+            h.observe(0.5)
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=10_000.0, exemplar_source=m)
+        ts.add(_span("root", sp.trace_id, dur_ms=1.0))
+        assert ts.describe()["keep_reasons"] == {"exemplar": 1}
+
+    def test_firing_alerts_keep_everything(self):
+        class _Mgr:
+            def firing(self):
+                return ["latency_slo"]
+
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=10_000.0, alerts=_Mgr())
+        ts.add(_span("root", "t", dur_ms=1.0))
+        assert ts.describe()["keep_reasons"] == {"alert": 1}
+
+    def test_probability_floor_is_deterministic_in_trace_id(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=10_000.0, probability=0.5)
+        ts.add(_span("root", "00000000aaaa", dur_ms=1.0))  # 0.0 < 0.5
+        ts.add(_span("root", "ffffffffaaaa", span_id="s2",
+                     dur_ms=1.0))                          # 1.0 >= 0.5
+        d = ts.describe()
+        assert d["keep_reasons"] == {"floor": 1}
+        assert d["dropped_traces"] == 1
+        with pytest.raises(ValueError):
+            TailSampler(sink, probability=1.5)
+
+    def test_disk_budget_drops_are_counted_separately(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=1.0, max_bytes=10)
+        ts.add(_span("root", "slow-but-broke", dur_ms=500.0))
+        d = ts.describe()
+        assert d["kept_traces"] == 0
+        assert d["dropped_budget_traces"] == 1
+        assert d["dropped_traces"] == 1
+        assert not sink.spans
+
+    def test_pending_eviction_bounds_unfinished_traces(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=1.0, max_pending=2)
+        for i in range(4):  # children only: roots never arrive
+            ts.add(_span("work", f"t{i}", parent="r", dur_ms=1.0))
+        d = ts.describe()
+        assert d["dropped_pending_traces"] == 2
+        assert d["pending_traces"] == 2
+        # the evicted trace's verdict is remembered: its late root drops
+        ts.add(_span("root", "t0", dur_ms=500.0))
+        assert ts.describe()["kept_traces"] == 0
+
+    def test_late_spans_follow_the_decided_verdict(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=100.0)
+        ts.add(_span("root", "keep", dur_ms=150.0))
+        ts.add(_span("late", "keep", span_id="s2", parent="x", dur_ms=1.0))
+        ts.add(_span("root", "drop", span_id="s3", dur_ms=1.0))
+        ts.add(_span("late", "drop", span_id="s4", parent="x", dur_ms=1.0))
+        d = ts.describe()
+        assert d["kept_spans"] == 2 and len(sink.spans) == 2
+        assert d["dropped_spans"] == 2
+
+    def test_ring_records_everything_regardless_of_sink_verdict(self):
+        sink = _ListSink()
+        ts = TailSampler(sink, default_slow_ms=100.0)
+        ts.add(_span("root", "drop", dur_ms=1.0))
+        assert [s.trace_id for s in ts.spans()] == ["drop"]
+
+    def test_close_drops_undecided_and_closes_sink(self):
+        class _ClosableSink(_ListSink):
+            closed = False
+
+            def close(self):
+                self.closed = True
+
+        sink = _ClosableSink()
+        ts = TailSampler(sink, default_slow_ms=1.0)
+        ts.add(_span("work", "t", parent="r", dur_ms=1.0))
+        ts.close()
+        d = ts.describe()
+        assert d["dropped_pending_traces"] == 1 and d["pending_traces"] == 0
+        assert sink.closed
+
+
+# --------------------------------------------------------- serving endpoints
+class TestServingCostSLOEndpoints:
+    def test_slo_endpoint_capture_and_device_ms_header(self):
+        m = MetricsRegistry()
+        enable_tracing(Tracer(), metrics=m)
+        slo_set = load_slos({"slos": [{
+            "name": "lat", "sli": "latency",
+            "metric": "serving_request_latency_seconds",
+            "labels": {"model": "m"}, "threshold_ms": 0.001,
+            "objective": 0.99,
+            "windows": [{"long_s": 3600, "short_s": 10, "factor": 2.0}]}]})
+        clock = ManualTimeSource(0)
+        mgr = AlertManager(m, slo_set.rules(), [], time_source=clock)
+        registry = ModelRegistry(metrics=m)
+        registry.register("m", _SumModel())
+        server = ModelServer(registry, metrics=m, alerts=mgr, slo=slo_set)
+        port = server.start()
+        url = f"http://127.0.0.1:{port}"
+        client = ModelServingClient(url)
+        try:
+            mgr.evaluate_once()
+            for _ in range(4):
+                client.predict("m", [[1.0, 2.0]])
+            tid = client.last_trace_id
+            assert tid is not None
+
+            # X-Device-Ms rides the response once the batch is ledgered
+            # (the entry lands just after the batch span closes, so the
+            # first response may legitimately predate it)
+            body = json.dumps({"inputs": [[1.0, 2.0]]}).encode()
+            hdr = None
+            for _ in range(10):
+                with urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"{url}/v1/models/m/predict", body),
+                        timeout=10) as r:
+                    hdr = r.headers.get("X-Device-Ms")
+                if hdr is not None:
+                    break
+            assert hdr is not None and float(hdr) >= 0.0
+
+            clock.advance(seconds=5)
+            mgr.evaluate_once()
+            status = json.load(urllib.request.urlopen(f"{url}/slo",
+                                                      timeout=5))
+            entry = status["slos"][0]
+            assert entry["name"] == "lat"
+            assert entry["alert"]["state"] == "firing"
+            assert entry["compliance"]["met"] is False
+            assert entry["burn"][0]["active"] is True
+
+            bundle = json.load(urllib.request.urlopen(
+                f"{url}/debug/capture?seconds=60", timeout=10))
+            assert bundle["kind"] == "debug_capture"
+            events = bundle["trace"]["traceEvents"]
+            assert any(e.get("args", {}).get("trace_id") == tid
+                       for e in events)
+            assert bundle["cost"]["totals"]["conservation"]["ok"]
+            recent_ids = {rc["trace_id"] for rc in bundle["cost"]["recent"]}
+            assert tid in recent_ids
+            assert bundle["metrics"] is not None
+
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"{url}/debug/capture?seconds=nope", timeout=5)
+            assert ei.value.code == 400
+        finally:
+            client.close()
+            server.stop(drain=False)
+            registry.shutdown()
+            disable_tracing()
+
+    def test_slo_endpoint_404_without_config(self):
+        m = MetricsRegistry()
+        registry = ModelRegistry(metrics=m)
+        server = ModelServer(registry, metrics=m)
+        port = server.start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/slo", timeout=5)
+            assert ei.value.code == 404
+        finally:
+            server.stop(drain=False)
+            registry.shutdown()
+
+
+# --------------------------------------------------------------- bench --slo
+@pytest.mark.smoke
+class TestBenchServingSLOCheck:
+    def test_slo_check_mode_passes_against_committed_series(self):
+        """The r03 cost/SLO record's invariants re-prove themselves on
+        every CI run: burn-rate fire-once/resolve, ledger conservation
+        with zero steady-state compiles, tail-sampler keeps AND drops,
+        exemplar-to-trace retrievability."""
+        committed = os.path.join(REPO_ROOT, "BENCH_SERVING_r03.json")
+        assert os.path.exists(committed), \
+            "BENCH_SERVING_r03.json must be committed with the series"
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bench_serving.py"),
+             "--check", committed],
+            capture_output=True, text=True, timeout=300,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, \
+            f"stdout: {proc.stdout}\nstderr: {proc.stderr}"
+        assert "slo check OK" in proc.stdout
+
+    def test_committed_slo_series_records_acceptance_numbers(self):
+        with open(os.path.join(REPO_ROOT, "BENCH_SERVING_r03.json")) as f:
+            rec = json.load(f)
+        assert rec["series"] == "BENCH_SERVING" and rec["round"] == 3
+        slo = rec["slo"]
+        assert slo["alert_states"] == ["firing", "resolved"]
+        assert slo["compliance"]["met"] is False
+        assert slo["burn"]["active"] is True
+        assert slo["cost"]["conservation_ok"] is True
+        assert slo["cost"]["requests"] >= 1
+        assert slo["steady_state_compiles"] == 0
+        assert slo["sampler"]["kept_traces"] >= 1
+        assert slo["sampler"]["dropped_traces"] >= 1
+        assert slo["exemplar_trace_captured"] is True

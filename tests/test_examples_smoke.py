@@ -32,7 +32,7 @@ pytestmark = pytest.mark.skipif(
 
 
 def test_all_examples_present():
-    assert len(EXAMPLES) >= 26, EXAMPLES
+    assert len(EXAMPLES) >= 28, EXAMPLES
 
 
 def test_shipped_alert_rules_lint_clean():
@@ -136,6 +136,21 @@ def test_shipped_serving_alert_rules_lint_clean():
         [sys.executable,
          os.path.join(REPO, "tools", "validate_alert_rules.py"),
          os.path.join(EXAMPLES_DIR, "serving_alert_rules.json")],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        timeout=300, capture_output=True, text=True)
+    assert proc.returncode == 0, (
+        f"validator exited {proc.returncode}\n{proc.stdout}\n{proc.stderr}")
+    assert proc.stdout.startswith("OK"), proc.stdout
+
+
+def test_shipped_slo_config_lints_clean():
+    """The SLO definitions shipped for ``serve --slo`` / ``train --slo``
+    pass ``tools/validate_slo_config.py`` (schema + burn-rule dry run
+    against empty and sampled registries, /slo payload assembly)."""
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "tools", "validate_slo_config.py"),
+         os.path.join(EXAMPLES_DIR, "slo_config.json")],
         env=dict(os.environ, JAX_PLATFORMS="cpu"),
         timeout=300, capture_output=True, text=True)
     assert proc.returncode == 0, (

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""SLO config validator: schema check + dry-run lint.
+
+Validates a ``--slo SLO.json`` file (the ``observe.slo`` ``load_slos``
+schema) the same way ``tools/validate_alert_rules.py`` validates alert
+rules: importable (``validate_file``/``validate_slos`` return a list of
+problems, empty = valid) and runnable (``python
+tools/validate_slo_config.py SLO.json [...]``).
+
+Two passes:
+
+1. **schema** — the file must build through ``load_slos`` (unknown SLI
+   kinds, objectives outside (0, 1), a latency SLO without
+   ``threshold_ms``, an availability SLO without ``error_labels``, bad
+   windows and duplicate names all surface here with the offending SLO
+   index);
+2. **dry run** — every compiled burn-rate rule is evaluated once
+   against an EMPTY metrics registry and once against a registry
+   carrying one sample of each referenced metric (a histogram
+   observation for latency SLOs so the bucket math executes, a labeled
+   counter increment for availability SLOs), so a config that crashes
+   on real series — rather than merely staying inactive — is caught
+   before it ships.  ``SLOSet.status()`` runs over the sampled
+   exposition too: the /slo payload must assemble cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from deeplearning4j_tpu.observe.alerts import AlertManager  # noqa: E402
+from deeplearning4j_tpu.observe.metrics import MetricsRegistry  # noqa: E402
+from deeplearning4j_tpu.observe.slo import load_slos  # noqa: E402
+from deeplearning4j_tpu.parallel.time_source import (  # noqa: E402
+    ManualTimeSource)
+
+
+def _seed_registry(slo_set) -> MetricsRegistry:
+    """One sample per referenced metric, shaped for its SLI: latency
+    SLOs get a real histogram observation (bucket series must exist for
+    the good/total split to execute), availability SLOs get a counter
+    increment carrying the SLO's error labels."""
+    reg = MetricsRegistry()
+    for s in slo_set.slos:
+        labels = dict(s.labels or {})
+        if s.sli == "latency":
+            try:
+                h = reg.histogram(s.metric, "dry-run sample",
+                                  tuple(labels.keys()))
+            except ValueError:
+                continue  # same metric referenced twice, other shape
+            h.observe(0.001, **labels)
+        else:
+            err = dict(labels)
+            err.update(s.error_labels or {})
+            try:
+                c = reg.counter(s.metric, "dry-run sample",
+                                tuple(err.keys()))
+            except ValueError:
+                continue
+            c.inc(**err)
+    return reg
+
+
+def _dry_run(slo_set, reg: MetricsRegistry, tag: str) -> List[str]:
+    errors: List[str] = []
+    clock = ManualTimeSource(0)
+    mgr = AlertManager(reg, slo_set.rules(), sinks=[], time_source=clock)
+    try:
+        mgr.evaluate_once()
+        clock.advance(seconds=3600)
+        mgr.evaluate_once()
+    except Exception as e:  # noqa: BLE001 - report, don't crash the lint
+        errors.append(f"dry-run ({tag}): {type(e).__name__}: {e}")
+    # the /slo payload must assemble over the same registry + manager
+    try:
+        status = slo_set.status(metrics=reg, alerts=mgr)
+        if len(status["slos"]) != len(slo_set.slos):
+            errors.append(f"dry-run ({tag}): status() reported "
+                          f"{len(status['slos'])} of "
+                          f"{len(slo_set.slos)} slo(s)")
+    except Exception as e:  # noqa: BLE001
+        errors.append(f"dry-run ({tag}): status(): "
+                      f"{type(e).__name__}: {e}")
+    return errors
+
+
+def validate_slos(spec) -> List[str]:
+    """Return a list of problems (empty = valid). ``spec`` is anything
+    ``load_slos`` accepts: a path, a JSON string, or a parsed
+    dict/list."""
+    try:
+        slo_set = load_slos(spec)
+    except (ValueError, KeyError, TypeError, json.JSONDecodeError) as e:
+        return [f"schema: {e}"]
+    if not slo_set.slos:
+        return ["schema: no slos defined"]
+    errors: List[str] = []
+    errors += _dry_run(slo_set, MetricsRegistry(), "empty registry")
+    errors += _dry_run(slo_set, _seed_registry(slo_set),
+                       "sampled registry")
+    return errors
+
+
+def validate_file(path: str) -> List[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            spec = json.load(fh)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{path}: unreadable slo file: {e}"]
+    return validate_slos(spec)
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print("usage: validate_slo_config.py SLO.json [SLO.json ...]")
+        return 2
+    rc = 0
+    for path in argv:
+        errors = validate_file(path)
+        if errors:
+            rc = 1
+            print(f"FAIL {path}")
+            for e in errors:
+                print(f"  - {e}")
+        else:
+            n = len(load_slos(path).slos)
+            print(f"OK   {path}: {n} slo(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
